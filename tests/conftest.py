@@ -1,0 +1,17 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+sharding tests run anywhere (the driver's multi-chip dry-run uses the same
+mechanism). Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Children spawned by the actor runtime inherit these so any jax import in a
+# storage-volume process also lands on CPU.
+os.environ.setdefault("TORCHSTORE_TPU_TEST_MODE", "1")

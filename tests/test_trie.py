@@ -1,0 +1,76 @@
+import pytest
+
+from torchstore_tpu.storage_utils.trie import Trie
+
+
+def test_basic_mapping():
+    t = Trie()
+    t["a/b/c"] = 1
+    t["a/b"] = 2
+    t["x"] = 3
+    assert t["a/b/c"] == 1
+    assert t["a/b"] == 2
+    assert len(t) == 3
+    assert "a/b" in t
+    assert "a" not in t  # interior node, no value
+    del t["a/b"]
+    assert "a/b" not in t
+    assert t["a/b/c"] == 1
+    assert len(t) == 2
+
+
+def test_missing_key():
+    t = Trie()
+    with pytest.raises(KeyError):
+        t["nope"]
+    with pytest.raises(KeyError):
+        del t["nope"]
+
+
+def test_overwrite():
+    t = Trie()
+    t["k"] = 1
+    t["k"] = 2
+    assert t["k"] == 2 and len(t) == 1
+
+
+def test_prefix_listing():
+    t = Trie()
+    for k in ["sd/v0/layer1", "sd/v0/layer2", "sd/v1/layer1", "other"]:
+        t[k] = True
+    assert sorted(t.keys().filter_by_prefix("sd/v0")) == [
+        "sd/v0/layer1",
+        "sd/v0/layer2",
+    ]
+    assert sorted(t.keys().filter_by_prefix("sd")) == [
+        "sd/v0/layer1",
+        "sd/v0/layer2",
+        "sd/v1/layer1",
+    ]
+    assert list(t.keys().filter_by_prefix("nothing")) == []
+    assert sorted(t.keys()) == sorted(
+        ["sd/v0/layer1", "sd/v0/layer2", "sd/v1/layer1", "other"]
+    )
+
+
+def test_prefix_is_segment_wise():
+    t = Trie()
+    t["ab/c"] = 1
+    t["abc/d"] = 2
+    # "ab" matches only the segment path ab/..., not abc/...
+    assert list(t.keys().filter_by_prefix("ab")) == ["ab/c"]
+
+
+def test_exact_key_in_prefix_listing():
+    t = Trie()
+    t["a"] = 1
+    t["a/b"] = 2
+    assert sorted(t.keys().filter_by_prefix("a")) == ["a", "a/b"]
+
+
+def test_pruning_keeps_siblings():
+    t = Trie()
+    t["a/b/c"] = 1
+    t["a/b/d"] = 2
+    del t["a/b/c"]
+    assert list(t.keys()) == ["a/b/d"]

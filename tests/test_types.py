@@ -1,0 +1,72 @@
+import pickle
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.transport.types import Request, TensorSlice
+from torchstore_tpu.utils import Box
+
+
+def make_slice(**kw):
+    defaults = dict(
+        offsets=(0, 0),
+        local_shape=(2, 4),
+        global_shape=(4, 4),
+        coordinates=(0,),
+        mesh_shape=(2,),
+    )
+    defaults.update(kw)
+    return TensorSlice(**defaults)
+
+
+class TestTensorSlice:
+    def test_box(self):
+        ts = make_slice(offsets=(2, 0))
+        assert ts.box == Box((2, 0), (2, 4))
+        assert ts.nelements == 8
+
+    def test_full(self):
+        assert make_slice(local_shape=(4, 4)).is_full()
+        assert not make_slice().is_full()
+
+    def test_numpy_ints_normalized(self):
+        ts = make_slice(offsets=(np.int64(1), np.int64(0)))
+        assert ts.offsets == (1, 0) and type(ts.offsets[0]) is int
+
+    def test_rank_mismatch(self):
+        with pytest.raises(ValueError):
+            make_slice(offsets=(0,))
+
+    def test_with_box(self):
+        ts = make_slice()
+        sub = ts.with_box(Box((1, 1), (1, 2)))
+        assert sub.offsets == (1, 1) and sub.local_shape == (1, 2)
+        assert sub.global_shape == ts.global_shape
+
+
+class TestRequest:
+    def test_from_tensor(self):
+        r = Request.from_tensor("k", np.ones((2, 2)))
+        assert r.nbytes == 32 and not r.is_object
+
+    def test_from_objects(self):
+        r = Request.from_objects("k", {"a": 1})
+        assert r.is_object and r.objects == {"a": 1}
+
+    def test_slice_shape_validation(self):
+        with pytest.raises(ValueError, match="local_shape"):
+            Request.from_tensor_slice("k", make_slice(), np.ones((3, 3)))
+
+    def test_meta_only_strips_data(self):
+        r = Request.from_tensor_slice("k", make_slice(), np.ones((2, 4)))
+        m = r.meta_only()
+        assert m.tensor_val is None and m.tensor_slice == r.tensor_slice
+        o = Request.from_objects("k", {"big": "payload"}).meta_only()
+        assert o.objects is None and o.is_object
+
+    def test_pickle_strips_destination_view(self):
+        r = Request.from_tensor("k", np.ones(4))
+        r.destination_view = np.zeros(4)
+        r2 = pickle.loads(pickle.dumps(r))
+        assert r2.destination_view is None
+        np.testing.assert_array_equal(r2.tensor_val, r.tensor_val)

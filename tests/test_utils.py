@@ -1,0 +1,181 @@
+"""Pure-unit tests for the reshard math (mirrors the reference's
+tests/test_utils.py coverage: intersection, destination views, assembly with
+gaps/overlap/size-mismatch, byte views)."""
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.utils import (
+    Box,
+    assemble_tensor,
+    bounding_box,
+    get_destination_view,
+    intersect_boxes,
+    tensors_overlap_in_memory,
+    to_byte_view,
+)
+
+
+class TestBox:
+    def test_contains(self):
+        outer = Box((0, 0), (10, 10))
+        assert outer.contains(Box((2, 3), (4, 5)))
+        assert outer.contains(outer)
+        assert not outer.contains(Box((8, 8), (4, 4)))
+
+    def test_rank_mismatch(self):
+        with pytest.raises(ValueError):
+            Box((0,), (1, 2))
+
+    def test_index(self):
+        x = np.arange(100).reshape(10, 10)
+        box = Box((2, 3), (4, 5))
+        assert x[box.to_index()].shape == (4, 5)
+
+
+class TestIntersection:
+    def test_overlap_1d(self):
+        r = intersect_boxes(Box((0,), (10,)), Box((5,), (10,)))
+        assert r == Box((5,), (5,))
+
+    def test_disjoint(self):
+        assert intersect_boxes(Box((0,), (5,)), Box((5,), (5,))) is None
+        assert intersect_boxes(Box((0, 0), (2, 2)), Box((0, 2), (2, 2))) is None
+
+    def test_2d_partial(self):
+        r = intersect_boxes(Box((0, 0), (4, 4)), Box((2, 2), (4, 4)))
+        assert r == Box((2, 2), (2, 2))
+
+    def test_contained(self):
+        r = intersect_boxes(Box((0, 0), (8, 8)), Box((1, 2), (3, 4)))
+        assert r == Box((1, 2), (3, 4))
+
+    def test_rank_mismatch(self):
+        with pytest.raises(ValueError):
+            intersect_boxes(Box((0,), (1,)), Box((0, 0), (1, 1)))
+
+
+class TestDestinationView:
+    def test_full(self):
+        dest = np.zeros((4, 4))
+        v = get_destination_view(dest, Box((0, 0), (4, 4)), Box((0, 0), (4, 4)))
+        assert v is dest or v.base is dest
+
+    def test_row_block_contiguous(self):
+        dest = np.zeros((8, 4))
+        v = get_destination_view(dest, Box((0, 0), (8, 4)), Box((2, 0), (3, 4)))
+        assert v is not None and v.shape == (3, 4) and v.flags["C_CONTIGUOUS"]
+        v[:] = 1.0
+        assert dest[2:5].sum() == 12.0
+
+    def test_column_block_not_contiguous(self):
+        dest = np.zeros((8, 4))
+        v = get_destination_view(dest, Box((0, 0), (8, 4)), Box((0, 1), (8, 2)))
+        assert v is None
+
+    def test_column_block_allowed_when_not_required(self):
+        dest = np.zeros((8, 4))
+        v = get_destination_view(
+            dest, Box((0, 0), (8, 4)), Box((0, 1), (8, 2)), require_contiguous=False
+        )
+        assert v is not None and v.shape == (8, 2)
+
+    def test_outside(self):
+        dest = np.zeros((4,))
+        assert get_destination_view(dest, Box((4,), (4,)), Box((0,), (2,))) is None
+
+    def test_offset_dest(self):
+        dest = np.zeros((4, 4))
+        v = get_destination_view(dest, Box((4, 0), (4, 4)), Box((5, 0), (2, 4)))
+        assert v is not None and v.shape == (2, 4)
+        v[:] = 7
+        assert dest[1:3].sum() == 7 * 8
+
+    def test_single_element_always_ok(self):
+        dest = np.zeros((4, 4))
+        v = get_destination_view(dest, Box((0, 0), (4, 4)), Box((1, 1), (1, 1)))
+        assert v is not None
+
+
+class TestAssemble:
+    def test_1d_tiles(self):
+        parts = [(np.arange(5.0), (0,)), (np.arange(5.0, 10.0), (5,))]
+        out, off = assemble_tensor(parts)
+        assert off == (0,)
+        np.testing.assert_array_equal(out, np.arange(10.0))
+
+    def test_2d_quadrants(self):
+        full = np.arange(16.0).reshape(4, 4)
+        parts = [
+            (full[:2, :2].copy(), (0, 0)),
+            (full[:2, 2:].copy(), (0, 2)),
+            (full[2:, :2].copy(), (2, 0)),
+            (full[2:, 2:].copy(), (2, 2)),
+        ]
+        out, off = assemble_tensor(parts)
+        assert off == (0, 0)
+        np.testing.assert_array_equal(out, full)
+
+    def test_offset_region(self):
+        parts = [(np.ones((2, 2)), (2, 2)), (np.ones((2, 2)) * 2, (2, 4))]
+        out, off = assemble_tensor(parts)
+        assert off == (2, 2)
+        assert out.shape == (2, 4)
+
+    def test_single_part_no_copy(self):
+        p = np.arange(6.0).reshape(2, 3)
+        out, off = assemble_tensor([(p, (4, 0))])
+        assert out is p and off == (4, 0)
+
+    def test_gap_raises(self):
+        parts = [(np.ones((2,)), (0,)), (np.ones((2,)), (4,))]
+        with pytest.raises(ValueError, match="do not tile"):
+            assemble_tensor(parts)
+
+    def test_dtype_mismatch(self):
+        parts = [
+            (np.ones((2,), np.float32), (0,)),
+            (np.ones((2,), np.float64), (2,)),
+        ]
+        with pytest.raises(ValueError, match="dtype"):
+            assemble_tensor(parts)
+
+    def test_overlapping_replicas_allowed(self):
+        # Replicated shards produce overlapping parts; last-writer wins and
+        # coverage accounting still >= bbox size.
+        parts = [(np.ones((4,)), (0,)), (np.ones((4,)) * 2, (0,))]
+        out, _ = assemble_tensor(parts)
+        np.testing.assert_array_equal(out, np.full((4,), 2.0))
+
+    def test_bounding_box(self):
+        bb = bounding_box([Box((1, 1), (2, 2)), Box((3, 0), (1, 4))])
+        assert bb == Box((1, 0), (3, 4))
+
+
+class TestMemoryOverlap:
+    def test_views_overlap(self):
+        dest = np.zeros((10,))
+        assert tensors_overlap_in_memory(dest, [dest[0:5], dest[5:10]])
+
+    def test_copy_does_not(self):
+        dest = np.zeros((10,))
+        assert not tensors_overlap_in_memory(dest, [dest[0:5].copy()])
+
+    def test_other_array(self):
+        dest = np.zeros((10,))
+        other = np.zeros((10,))
+        assert not tensors_overlap_in_memory(dest, [other[0:5]])
+
+
+class TestByteView:
+    def test_roundtrip(self):
+        x = np.arange(10, dtype=np.float32)
+        b = to_byte_view(x)
+        assert b.dtype == np.uint8 and b.nbytes == 40
+        b[0:4] = np.frombuffer(np.float32(99.0).tobytes(), dtype=np.uint8)
+        assert x[0] == 99.0
+
+    def test_non_contiguous_rejected(self):
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        with pytest.raises(ValueError):
+            to_byte_view(x[:, 1:3])

@@ -1,0 +1,15 @@
+"""torchstore_tpu: a TPU-native distributed async tensor store.
+
+Same capabilities as meta-pytorch/torchstore (RL-style weight sync: publish a
+sharded state_dict from one actor group, pull it into a differently sharded
+model in another, with automatic resharding + transport selection), designed
+TPU-first: jax.Array/NamedSharding sharding metadata, storage volumes on TPU
+(host, chip) coordinates, and a same-host-SHM / bulk-TCP(DCN) / RPC transport
+ladder.
+"""
+
+from torchstore_tpu.logging import init_logging
+
+init_logging()
+
+__version__ = "0.1.0"

@@ -1,0 +1,89 @@
+"""Central configuration object.
+
+The reference configures itself through ~12 scattered env vars (SURVEY §5,
+"config/flag system"; an author comment at
+/root/reference/torchstore/transport/torchcomms/buffer.py:30-33 wishes for
+strategy-level config). This build provides a real config object from day
+one: every knob lives on ``StoreConfig``, env vars are read once as defaults,
+and user code can override programmatically via ``initialize(config=...)``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    return int(val) if val is not None else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class StoreConfig:
+    """All tunables for one store instance. Field defaults come from env vars
+    (prefix ``TORCHSTORE_TPU_``) so operator overrides keep working, but the
+    object is the source of truth once a store is initialized."""
+
+    # --- transports ---------------------------------------------------------
+    shm_enabled: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_SHM_ENABLED", True)
+    )
+    bulk_tcp_enabled: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_BULK_TCP_ENABLED", True)
+    )
+    ici_enabled: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_ICI_ENABLED", True)
+    )
+    mutable_shm: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_MUTABLE_SHM", False)
+    )
+    # Chunk size for bulk socket transfers (bytes).
+    bulk_chunk_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_BULK_CHUNK_BYTES", 8 * 1024 * 1024
+        )
+    )
+    # Use the native C++ data-path library when built.
+    use_native: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_USE_NATIVE", True)
+    )
+
+    # --- timeouts (seconds) -------------------------------------------------
+    rpc_timeout: float = field(
+        default_factory=lambda: float(_env_str("TORCHSTORE_TPU_RPC_TIMEOUT", "120"))
+    )
+    handshake_timeout: float = field(
+        default_factory=lambda: float(
+            _env_str("TORCHSTORE_TPU_HANDSHAKE_TIMEOUT", "60")
+        )
+    )
+
+    # --- logging ------------------------------------------------------------
+    log_level: str = field(
+        default_factory=lambda: _env_str("TORCHSTORE_TPU_LOG_LEVEL", "WARNING")
+    )
+
+    def merged(self, **overrides) -> "StoreConfig":
+        return replace(self, **overrides)
+
+
+_default_config: StoreConfig | None = None
+
+
+def default_config() -> StoreConfig:
+    global _default_config
+    if _default_config is None:
+        _default_config = StoreConfig()
+    return _default_config

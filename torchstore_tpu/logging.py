@@ -1,0 +1,92 @@
+"""Logging + lightweight latency/throughput tracking.
+
+Equivalent of /root/reference/torchstore/logging.py:13-66: root-level config
+from an env var, and a ``LatencyTracker`` that records named steps plus
+end-to-end wall time and formats GB/s when a byte count is supplied.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+_INITIALIZED = False
+
+ENV_LOG_LEVEL = "TORCHSTORE_TPU_LOG_LEVEL"
+
+
+def init_logging() -> None:
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    level_name = os.environ.get(ENV_LOG_LEVEL, "WARNING").upper()
+    level = getattr(logging, level_name, logging.WARNING)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    logging.getLogger("torchstore_tpu").setLevel(level)
+    _INITIALIZED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    init_logging()
+    return logging.getLogger(name)
+
+
+def set_log_level(level_name: str) -> None:
+    """Apply a config-driven log level (overrides the env-var default chosen
+    at import). Called by ``initialize(config=...)`` so ``StoreConfig.log_level``
+    is authoritative once a store exists."""
+    level = getattr(logging, level_name.upper(), logging.WARNING)
+    logging.getLogger("torchstore_tpu").setLevel(level)
+
+
+def _format_throughput(nbytes: int, seconds: float) -> str:
+    if seconds <= 0:
+        return "inf GB/s"
+    return f"{nbytes / seconds / 1e9:.3f} GB/s"
+
+
+class LatencyTracker:
+    """Per-step + end-to-end wall-clock tracking with optional GB/s.
+
+    ``track_step`` records the time since the previous mark; ``log_summary``
+    emits one line per step plus the total. INFO level is used for weight-sync
+    phases so users see throughput without enabling debug (reference behavior,
+    /root/reference/torchstore/logging.py:31-66).
+    """
+
+    def __init__(self, name: str, logger: Optional[logging.Logger] = None) -> None:
+        self.name = name
+        self.logger = logger or get_logger("torchstore_tpu.latency")
+        self._start = time.perf_counter()
+        self._last = self._start
+        self.steps: list[tuple[str, float, Optional[int]]] = []
+
+    def track_step(self, step: str, nbytes: Optional[int] = None) -> float:
+        now = time.perf_counter()
+        elapsed = now - self._last
+        self._last = now
+        self.steps.append((step, elapsed, nbytes))
+        return elapsed
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._start
+
+    def log_summary(self, level: int = logging.DEBUG) -> None:
+        total = self.elapsed
+        total_bytes = 0
+        for step, elapsed, nbytes in self.steps:
+            extra = ""
+            if nbytes is not None:
+                total_bytes += nbytes
+                extra = f" ({_format_throughput(nbytes, elapsed)})"
+            self.logger.log(level, "[%s] %s: %.4fs%s", self.name, step, elapsed, extra)
+        extra = ""
+        if total_bytes:
+            extra = f" ({_format_throughput(total_bytes, total)})"
+        self.logger.log(level, "[%s] e2e: %.4fs%s", self.name, total, extra)

@@ -1,0 +1,3 @@
+from torchstore_tpu.storage_utils.trie import Trie, TrieKeysView
+
+__all__ = ["Trie", "TrieKeysView"]

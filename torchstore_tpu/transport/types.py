@@ -1,0 +1,135 @@
+"""Wire vocabulary: ``TensorSlice`` + ``Request``.
+
+TPU-native equivalent of /root/reference/torchstore/transport/types.py:20-218.
+Where the reference derives shard metadata from torch DTensor internals
+(``_compute_local_shape_and_global_offset``), we derive it from
+``jax.sharding.NamedSharding`` shard indices (see ``torchstore_tpu.sharding``).
+This module itself is jax-free: it only describes shards.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.utils import Box
+
+
+@dataclass(frozen=True)
+class TensorSlice:
+    """Metadata describing one shard of a global array.
+
+    ``coordinates``/``mesh_shape`` identify the shard's position in the device
+    mesh (used by the controller's full-commit check); ``offsets`` /
+    ``local_shape`` / ``global_shape`` place the shard in the global index
+    space (used by the resharding planner). Mirrors the reference's
+    ``TensorSlice`` (/root/reference/torchstore/transport/types.py:20-55).
+    """
+
+    offsets: tuple[int, ...]
+    local_shape: tuple[int, ...]
+    global_shape: tuple[int, ...]
+    coordinates: tuple[int, ...]
+    mesh_shape: tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "offsets", tuple(int(x) for x in self.offsets))
+        object.__setattr__(self, "local_shape", tuple(int(x) for x in self.local_shape))
+        object.__setattr__(
+            self, "global_shape", tuple(int(x) for x in self.global_shape)
+        )
+        object.__setattr__(self, "coordinates", tuple(int(x) for x in self.coordinates))
+        object.__setattr__(self, "mesh_shape", tuple(int(x) for x in self.mesh_shape))
+        if len(self.offsets) != len(self.local_shape) or len(self.offsets) != len(
+            self.global_shape
+        ):
+            raise ValueError(f"rank mismatch in {self!r}")
+
+    @property
+    def box(self) -> Box:
+        return Box(self.offsets, self.local_shape)
+
+    @property
+    def nelements(self) -> int:
+        return math.prod(self.local_shape) if self.local_shape else 1
+
+    def is_full(self) -> bool:
+        return self.local_shape == self.global_shape and all(
+            o == 0 for o in self.offsets
+        )
+
+    def with_box(self, box: Box) -> "TensorSlice":
+        """A slice describing ``box`` of the same global array / mesh position."""
+        return replace(self, offsets=box.offsets, local_shape=box.shape)
+
+
+@dataclass
+class Request:
+    """One logical store operation on one key.
+
+    ``tensor_val`` is a host numpy array (the shard's data on put, or the
+    in-place destination on get); ``tensor_slice`` is present for sharded
+    values; ``objects`` carries arbitrary picklable payloads. ``meta_only()``
+    strips data before metadata-plane RPCs — the controller must never see
+    tensor bytes (two-plane invariant, SURVEY §2.2.1; reference
+    /root/reference/torchstore/transport/types.py:88-218).
+    """
+
+    key: str
+    tensor_val: Optional[np.ndarray] = None
+    tensor_slice: Optional[TensorSlice] = None
+    objects: Any = None
+    is_object: bool = False
+    # Attached by the client when an in-place destination view exists for this
+    # (sub-)request; never serialized to the server (stripped by meta_only).
+    destination_view: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @classmethod
+    def from_tensor(cls, key: str, tensor: np.ndarray) -> "Request":
+        return cls(key=key, tensor_val=np.asarray(tensor))
+
+    @classmethod
+    def from_objects(cls, key: str, objects: Any) -> "Request":
+        return cls(key=key, objects=objects, is_object=True)
+
+    @classmethod
+    def from_tensor_slice(
+        cls, key: str, tensor_slice: TensorSlice, tensor: Optional[np.ndarray] = None
+    ) -> "Request":
+        if tensor is not None:
+            tensor = np.asarray(tensor)
+            if tuple(tensor.shape) != tensor_slice.local_shape:
+                raise ValueError(
+                    f"shard data shape {tensor.shape} != slice local_shape "
+                    f"{tensor_slice.local_shape} for key {key!r}"
+                )
+        return cls(key=key, tensor_val=tensor, tensor_slice=tensor_slice)
+
+    @classmethod
+    def meta_request(cls, key: str) -> "Request":
+        return cls(key=key)
+
+    def meta_only(self) -> "Request":
+        """Copy carrying metadata only (never tensor bytes or object payloads)."""
+        return Request(
+            key=self.key,
+            tensor_val=None,
+            tensor_slice=self.tensor_slice,
+            objects=None,
+            is_object=self.is_object,
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.tensor_val.nbytes) if self.tensor_val is not None else 0
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["destination_view"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)

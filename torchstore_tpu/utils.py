@@ -1,0 +1,199 @@
+"""Reshard math and small helpers (numpy-only, no jax imports at module scope).
+
+This is the TPU-native equivalent of the reference's ``torchstore/utils.py``
+(see /root/reference/torchstore/utils.py:25-307): byte views for bulk
+transports, global->local destination-view mapping for in-place writes,
+interval intersection of tensor slices, and bounding-box assembly of fetched
+parts. All math operates on host ``numpy`` arrays; ``jax.Array`` values are
+converted to host views at the client boundary (see ``sharding.py``).
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Box:
+    """An axis-aligned region of a global index space: ``offsets`` + ``shape``."""
+
+    offsets: tuple[int, ...]
+    shape: tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.offsets) != len(self.shape):
+            raise ValueError(
+                f"rank mismatch: offsets={self.offsets} shape={self.shape}"
+            )
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def stops(self) -> tuple[int, ...]:
+        return tuple(o + s for o, s in zip(self.offsets, self.shape))
+
+    def contains(self, other: "Box") -> bool:
+        return all(
+            oo >= so and oo + osz <= so + ssz
+            for so, ssz, oo, osz in zip(
+                self.offsets, self.shape, other.offsets, other.shape
+            )
+        )
+
+    def to_index(self) -> tuple[slice, ...]:
+        return tuple(slice(o, o + s) for o, s in zip(self.offsets, self.shape))
+
+
+def intersect_boxes(a: Box, b: Box) -> Optional[Box]:
+    """Per-dimension interval intersection; None when disjoint.
+
+    Equivalent role to the reference's ``get_slice_intersection``
+    (/root/reference/torchstore/utils.py:248-307), expressed over ``Box``
+    regions in global coordinates.
+    """
+    if a.ndim != b.ndim:
+        raise ValueError(f"rank mismatch: {a} vs {b}")
+    offsets = []
+    shape = []
+    for ao, asz, bo, bsz in zip(a.offsets, a.shape, b.offsets, b.shape):
+        start = max(ao, bo)
+        stop = min(ao + asz, bo + bsz)
+        if stop <= start:
+            return None
+        offsets.append(start)
+        shape.append(stop - start)
+    return Box(tuple(offsets), tuple(shape))
+
+
+def to_byte_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view over a contiguous array (for bulk/byte transports).
+
+    Mirrors the role of the reference's ``to_byte_view``
+    (/root/reference/torchstore/utils.py:25-33).
+    """
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("to_byte_view requires a C-contiguous array")
+    return arr.view(np.uint8).reshape(-1)
+
+
+def get_destination_view(
+    dest: np.ndarray,
+    dest_box: Box,
+    region: Box,
+    require_contiguous: bool = True,
+) -> Optional[np.ndarray]:
+    """View into ``dest`` (which occupies ``dest_box`` of the global space)
+    covering global ``region``; None when the region is not representable as
+    a single C-contiguous view and ``require_contiguous`` is set.
+
+    The contiguity requirement exists because byte-oriented transports (SHM,
+    bulk TCP, ICI staging) land data into a flat destination buffer — same
+    constraint as the reference's RDMA path
+    (/root/reference/torchstore/utils.py:36-98).
+    """
+    if not dest_box.contains(region):
+        return None
+    rel = tuple(ro - do for ro, do in zip(region.offsets, dest_box.offsets))
+    index = tuple(slice(r, r + s) for r, s in zip(rel, region.shape))
+    view = dest[index]
+    if require_contiguous and view.size > 1 and not view.flags["C_CONTIGUOUS"]:
+        return None
+    return view
+
+
+def tensors_overlap_in_memory(dest: np.ndarray, parts: Sequence[np.ndarray]) -> bool:
+    """True when every part aliases memory inside ``dest`` (i.e. all parts
+    already landed in-place and no assembly copy is needed). Equivalent of
+    /root/reference/torchstore/utils.py:101-120."""
+    if dest.size == 0:
+        return False
+    d0, d1 = byte_range(dest)
+    for p in parts:
+        if p.size == 0:
+            continue
+        p0, p1 = byte_range(p)
+        if p0 < d0 or p1 > d1 or p.base is None:
+            return False
+    return True
+
+
+def byte_range(arr: np.ndarray) -> tuple[int, int]:
+    """[lo, hi) byte address range touched by ``arr`` under arbitrary
+    (including negative) strides."""
+    start = arr.__array_interface__["data"][0]
+    if arr.size == 0:
+        return (start, start)
+    lo = start
+    hi = start
+    for sz, st in zip(arr.shape, arr.strides):
+        if sz > 1:
+            extent = (sz - 1) * st
+            if extent > 0:
+                hi += extent
+            else:
+                lo += extent
+    return (lo, hi + arr.itemsize)
+
+
+def bounding_box(boxes: Sequence[Box]) -> Box:
+    if not boxes:
+        raise ValueError("bounding_box of no boxes")
+    ndim = boxes[0].ndim
+    mins = [min(b.offsets[d] for b in boxes) for d in range(ndim)]
+    maxs = [max(b.offsets[d] + b.shape[d] for b in boxes) for d in range(ndim)]
+    return Box(tuple(mins), tuple(m - n for m, n in zip(maxs, mins)))
+
+
+def assemble_tensor(
+    parts: Sequence[tuple[np.ndarray, tuple[int, ...]]],
+) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Assemble fetched parts (each with its global offsets) into one array.
+
+    Returns ``(array, offsets)`` where ``offsets`` is the global offset of the
+    assembled bounding box (so a full fetch yields offsets == zeros).
+    Equivalent of /root/reference/torchstore/utils.py:158-245.
+    """
+    if not parts:
+        raise ValueError("assemble_tensor of no parts")
+    dtype = parts[0][0].dtype
+    for p, _ in parts:
+        if p.dtype != dtype:
+            raise ValueError(f"dtype mismatch during assembly: {p.dtype} vs {dtype}")
+        if p.ndim != parts[0][0].ndim:
+            raise ValueError("rank mismatch during assembly")
+    boxes = [Box(tuple(off), tuple(p.shape)) for p, off in parts]
+    bbox = bounding_box(boxes)
+    if len(parts) == 1 and boxes[0] == bbox:
+        return parts[0][0], bbox.offsets
+    out = np.empty(bbox.shape, dtype=dtype)
+    covered = 0
+    for (p, off), box in zip(parts, boxes):
+        rel = tuple(o - bo for o, bo in zip(off, bbox.offsets))
+        out[tuple(slice(r, r + s) for r, s in zip(rel, p.shape))] = p
+        covered += box.size
+    if covered < bbox.size:
+        raise ValueError(
+            f"assembled parts cover {covered} elements but bounding box has "
+            f"{bbox.size}; parts do not tile the requested region"
+        )
+    return out, bbox.offsets
+
+
+def get_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def get_hostname() -> str:
+    return socket.gethostname()
