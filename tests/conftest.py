@@ -5,13 +5,31 @@ mechanism). Must run before jax is imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# On this image a sitecustomize force-sets jax_platforms="axon,cpu" (real TPU
+# tunnel), overriding the env var — override it back at config level.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
 # Children spawned by the actor runtime inherit these so any jax import in a
 # storage-volume process also lands on CPU.
 os.environ.setdefault("TORCHSTORE_TPU_TEST_MODE", "1")
+
+import pytest
+
+
+@pytest.fixture
+def anyio_backend():
+    # pytest-asyncio isn't in this image; async tests run via anyio's plugin
+    # in auto mode (see pyproject.toml) on the stdlib asyncio backend.
+    return "asyncio"

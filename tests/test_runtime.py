@@ -1,0 +1,160 @@
+"""Actor runtime tests: spawn, endpoints, fan-out, zero-copy tensor frames,
+error propagation, rank env, singleton registry, shutdown."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.runtime import (
+    Actor,
+    ActorMeshRef,
+    RemoteActorError,
+    endpoint,
+    get_or_spawn_singleton,
+    spawn_actors,
+    stop_singleton,
+)
+
+
+class EchoActor(Actor):
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+        self.state = {}
+
+    @endpoint
+    async def echo(self, x):
+        return x
+
+    @endpoint
+    def scale_array(self, arr: np.ndarray) -> np.ndarray:
+        return arr * self.scale
+
+    @endpoint
+    async def my_rank(self):
+        return int(os.environ["RANK"]), int(os.environ["WORLD_SIZE"])
+
+    @endpoint
+    async def put(self, k, v):
+        self.state[k] = v
+
+    @endpoint
+    async def get(self, k):
+        return self.state[k]
+
+    @endpoint
+    async def boom(self):
+        raise KeyError("kaboom")
+
+    def not_an_endpoint(self):
+        return "secret"
+
+    @endpoint
+    async def peer_get(self, ref, k):
+        # Actor-to-actor call: refs must be usable from inside actor processes.
+        return await ref.get.call_one(k)
+
+
+@pytest.fixture
+async def mesh():
+    m = await spawn_actors(2, EchoActor, "echo", scale=3.0)
+    yield m
+    await m.stop()
+
+
+async def test_call_one_roundtrip(mesh):
+    assert await mesh.refs[0].echo.call_one({"a": [1, 2]}) == {"a": [1, 2]}
+
+
+async def test_fanout_and_rank_env(mesh):
+    ranks = await mesh.my_rank.call()
+    assert ranks == [(0, 2), (1, 2)]
+
+
+async def test_numpy_zero_copy_roundtrip(mesh):
+    arr = np.arange(1_000_000, dtype=np.float32).reshape(1000, 1000)
+    out = await mesh.refs[1].scale_array.call_one(arr)
+    np.testing.assert_allclose(out, arr * 3.0)
+    assert out.dtype == np.float32
+
+
+async def test_state_persists_across_calls(mesh):
+    await mesh.refs[0].put.call_one("k", np.ones(4))
+    np.testing.assert_array_equal(await mesh.refs[0].get.call_one("k"), np.ones(4))
+
+
+async def test_remote_exception_type_preserved(mesh):
+    with pytest.raises(KeyError, match="kaboom"):
+        await mesh.refs[0].boom.call_one()
+    # Remote traceback is attached as the cause chain.
+    try:
+        await mesh.refs[0].boom.call_one()
+    except KeyError as exc:
+        assert isinstance(exc.__cause__, RemoteActorError)
+        assert "kaboom" in str(exc.__cause__)
+
+
+async def test_non_endpoint_rejected(mesh):
+    with pytest.raises(RemoteActorError, match="not an @endpoint"):
+        await mesh.refs[0].not_an_endpoint.call_one()
+
+
+async def test_missing_key_error(mesh):
+    with pytest.raises(KeyError):
+        await mesh.refs[0].get.call_one("missing")
+
+
+async def test_actor_to_actor_calls(mesh):
+    await mesh.refs[1].put.call_one("shared", 42)
+    ref = mesh.refs[1]
+    assert await mesh.refs[0].peer_get.call_one(ref, "shared") == 42
+
+
+async def test_mesh_ref_pickles_without_processes(mesh):
+    import pickle
+
+    m2 = pickle.loads(pickle.dumps(mesh))
+    assert isinstance(m2, ActorMeshRef)
+    assert await m2.refs[0].echo.call_one(7) == 7
+
+
+async def test_concurrent_calls_multiplexed(mesh):
+    outs = await asyncio.gather(*(mesh.refs[0].echo.call_one(i) for i in range(50)))
+    assert outs == list(range(50))
+
+
+async def test_mesh_indexing(mesh):
+    sub = mesh[1]
+    assert len(sub) == 1
+    assert await sub.my_rank.call_one() == (1, 2)
+
+
+async def test_call_one_on_multi_mesh_rejected(mesh):
+    with pytest.raises(ValueError, match="mesh of size 2"):
+        await mesh.my_rank.call_one()
+
+
+async def test_singleton_registry():
+    ref1 = await get_or_spawn_singleton("single_test", EchoActor, scale=2.0)
+    ref2 = await get_or_spawn_singleton("single_test", EchoActor, scale=9.0)
+    assert ref1.port == ref2.port  # cached, not respawned
+    out = await ref1.scale_array.call_one(np.ones(2))
+    np.testing.assert_array_equal(out, np.full(2, 2.0))
+    await stop_singleton("single_test")
+
+
+async def test_spawn_failure_surfaces():
+    class Exploding(Actor):
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+    from torchstore_tpu.runtime import ActorDiedError
+
+    with pytest.raises(ActorDiedError, match="bad init"):
+        await spawn_actors(1, _ExplodingActor, "exploding")
+
+
+class _ExplodingActor(Actor):
+    def __init__(self):
+        raise RuntimeError("bad init")

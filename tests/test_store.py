@@ -1,0 +1,159 @@
+"""Store integration tests: put/get across actor processes, objects, exists,
+delete idempotency, batches, error paths (reference tests/test_store.py)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.runtime import Actor, endpoint, spawn_actors
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="t")
+    yield "t"
+    await ts.shutdown("t")
+
+
+async def test_tensor_roundtrip(store):
+    x = np.arange(24.0, dtype=np.float32).reshape(4, 6)
+    await ts.put("x", x, store_name=store)
+    out = await ts.get("x", store_name=store)
+    np.testing.assert_array_equal(out, x)
+    assert out.dtype == np.float32
+
+
+async def test_object_roundtrip(store):
+    await ts.put("obj", {"lr": 1e-3, "betas": (0.9, 0.95)}, store_name=store)
+    assert await ts.get("obj", store_name=store) == {"lr": 1e-3, "betas": (0.9, 0.95)}
+
+
+async def test_scalar_stored_as_object(store):
+    await ts.put("s", 3.5, store_name=store)
+    assert await ts.get("s", store_name=store) == 3.5
+
+
+async def test_missing_key_raises(store):
+    with pytest.raises(KeyError, match="not found"):
+        await ts.get("nope", store_name=store)
+
+
+async def test_exists(store):
+    assert not await ts.exists("k", store_name=store)
+    await ts.put("k", np.ones(3), store_name=store)
+    assert await ts.exists("k", store_name=store)
+
+
+async def test_overwrite_same_key(store):
+    await ts.put("k", np.ones(4), store_name=store)
+    await ts.put("k", np.full(4, 2.0), store_name=store)
+    np.testing.assert_array_equal(
+        await ts.get("k", store_name=store), np.full(4, 2.0)
+    )
+
+
+async def test_overwrite_type_confusion_rejected(store):
+    await ts.put("k", np.ones(4), store_name=store)
+    with pytest.raises(ValueError, match="already stored"):
+        await ts.put("k", {"an": "object"}, store_name=store)
+
+
+async def test_delete_and_idempotency(store):
+    await ts.put("k", np.ones(2), store_name=store)
+    await ts.delete("k", store_name=store)
+    assert not await ts.exists("k", store_name=store)
+    # Deleting again (and deleting missing keys) is a no-op.
+    await ts.delete("k", store_name=store)
+    await ts.delete_batch(["k", "never-existed"], store_name=store)
+
+
+async def test_keys_prefix(store):
+    for k in ["sd/v0/a", "sd/v0/b", "sd/v1/a", "zzz"]:
+        await ts.put(k, np.ones(1), store_name=store)
+    assert await ts.keys("sd/v0", store_name=store) == ["sd/v0/a", "sd/v0/b"]
+    assert len(await ts.keys(store_name=store)) == 4
+
+
+async def test_put_get_batch(store):
+    items = {f"b/{i}": np.full((3,), float(i)) for i in range(5)}
+    items["b/obj"] = ["any", "object"]
+    await ts.put_batch(items, store_name=store)
+    out = await ts.get_batch({k: None for k in items}, store_name=store)
+    for i in range(5):
+        np.testing.assert_array_equal(out[f"b/{i}"], np.full((3,), float(i)))
+    assert out["b/obj"] == ["any", "object"]
+
+
+async def test_get_batch_all_or_nothing(store):
+    await ts.put("present", np.ones(2), store_name=store)
+    with pytest.raises(KeyError):
+        await ts.get_batch({"present": None, "absent": None}, store_name=store)
+
+
+async def test_inplace_get_into_numpy(store):
+    x = np.arange(12.0).reshape(3, 4)
+    await ts.put("x", x, store_name=store)
+    dest = np.zeros((3, 4))
+    out = await ts.get("x", like=dest, store_name=store)
+    assert out is dest
+    np.testing.assert_array_equal(dest, x)
+
+
+async def test_non_contiguous_put(store):
+    base = np.arange(64.0).reshape(8, 8)
+    noncontig = base[:, 1:5]
+    assert not noncontig.flags["C_CONTIGUOUS"]
+    await ts.put("nc", noncontig, store_name=store)
+    np.testing.assert_array_equal(await ts.get("nc", store_name=store), noncontig)
+
+
+async def test_bfloat16_roundtrip(store):
+    import ml_dtypes
+
+    x = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    await ts.put("bf16", x, store_name=store)
+    out = await ts.get("bf16", store_name=store)
+    assert out.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(out, x)
+
+
+class WorkerActor(Actor):
+    """README 4-actor example pattern: actors discover the store via the
+    published handle and exchange tensors."""
+
+    def __init__(self):
+        import os
+
+        self.rank = int(os.environ["RANK"])
+        self.world = int(os.environ["WORLD_SIZE"])
+
+    @endpoint
+    async def store_tensor(self):
+        await ts.put(f"worker/{self.rank}", np.full((4,), float(self.rank)), store_name="t")
+
+    @endpoint
+    async def fetch_neighbor(self):
+        other = (self.rank + 1) % self.world
+        out = await ts.get(f"worker/{other}", store_name="t")
+        return float(out[0])
+
+
+async def test_cross_actor_exchange(store):
+    actors = await spawn_actors(3, WorkerActor, "workers")
+    try:
+        await actors.store_tensor.call()
+        got = await actors.fetch_neighbor.call()
+        assert got == [1.0, 2.0, 0.0]
+    finally:
+        await actors.stop()
+
+
+async def test_concurrent_puts_and_gets(store):
+    async def one(i):
+        await ts.put(f"c/{i}", np.full((8,), float(i)), store_name=store)
+        out = await ts.get(f"c/{i}", store_name=store)
+        assert out[0] == float(i)
+
+    await asyncio.gather(*(one(i) for i in range(16)))

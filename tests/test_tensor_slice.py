@@ -1,0 +1,160 @@
+"""TensorSlice protocol tests: explicit slice reads, multi-volume sharded
+puts from rank actors, partial-commit rejection, fully-replicated demotion
+(reference tests/test_tensor_slice.py)."""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import LocalRankStrategy, Shard, TensorSlice
+from torchstore_tpu.runtime import Actor, endpoint, spawn_actors
+
+GLOBAL = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+
+
+def row_slice(rank, world, mesh_shape=None):
+    rows = GLOBAL.shape[0] // world
+    return TensorSlice(
+        offsets=(rank * rows, 0),
+        local_shape=(rows, GLOBAL.shape[1]),
+        global_shape=GLOBAL.shape,
+        coordinates=(rank,),
+        mesh_shape=mesh_shape or (world,),
+    )
+
+
+class RankPutActor(Actor):
+    def __init__(self):
+        import os
+
+        self.rank = int(os.environ["RANK"])
+        self.world = int(os.environ["WORLD_SIZE"])
+
+    @endpoint
+    async def put_shard(self, key: str):
+        sl = row_slice(self.rank, self.world)
+        data = GLOBAL[sl.box.to_index()]
+        await ts.put(key, Shard(data, sl), store_name="tsl")
+
+    @endpoint
+    async def get_shard(self, key: str, other_rank: int):
+        sl = row_slice(other_rank, self.world)
+        out = await ts.get(key, like=sl, store_name="tsl")
+        return np.asarray(out)
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(
+        num_storage_volumes=4, strategy=LocalRankStrategy(), store_name="tsl"
+    )
+    yield "tsl"
+    await ts.shutdown("tsl")
+
+
+async def test_multi_volume_sharded_put_and_slice_get(store):
+    actors = await spawn_actors(4, RankPutActor, "rankput")
+    try:
+        await actors.put_shard.call("w")
+        # Each rank reads its neighbor's shard — crosses volumes.
+        outs = await actors.get_shard.call("w", 0)
+        for out in outs:
+            np.testing.assert_array_equal(out, GLOBAL[0:2])
+    finally:
+        await actors.stop()
+    # Full fetch from the parent client assembles across all 4 volumes.
+    full = await ts.get("w", store_name=store)
+    np.testing.assert_array_equal(full, GLOBAL)
+
+
+async def test_partial_commit_rejected(store):
+    actors = await spawn_actors(4, RankPutActor, "rankput2")
+    try:
+        # Only ranks 0 and 1 put (mesh_shape says 4 coords are expected).
+        await actors[0].put_shard.call_one("p")
+        await actors[1].put_shard.call_one("p")
+        assert await ts.exists("p", store_name=store)  # present but partial
+        with pytest.raises(KeyError, match="partially committed"):
+            await ts.get("p", store_name=store)
+        # Completing the commit unlocks reads.
+        await actors[2].put_shard.call_one("p")
+        await actors[3].put_shard.call_one("p")
+        np.testing.assert_array_equal(
+            await ts.get("p", store_name=store), GLOBAL
+        )
+    finally:
+        await actors.stop()
+
+
+async def test_explicit_slice_read_of_full_tensor(store):
+    await ts.put("full", GLOBAL, store_name=store)
+    want = TensorSlice(
+        offsets=(2, 4), local_shape=(3, 2), global_shape=(8, 8),
+        coordinates=(), mesh_shape=(),
+    )
+    out = await ts.get("full", like=want, store_name=store)
+    np.testing.assert_array_equal(out, GLOBAL[2:5, 4:6])
+
+
+async def test_slice_read_spanning_shards(store):
+    actors = await spawn_actors(4, RankPutActor, "rankput3")
+    try:
+        await actors.put_shard.call("w2")
+    finally:
+        await actors.stop()
+    # Rows 1..6 span three stored shards (each shard holds 2 rows).
+    want = TensorSlice(
+        offsets=(1, 0), local_shape=(6, 8), global_shape=(8, 8),
+        coordinates=(), mesh_shape=(),
+    )
+    out = await ts.get("w2", like=want, store_name=store)
+    np.testing.assert_array_equal(out, GLOBAL[1:7])
+
+
+async def test_inplace_shard_get(store):
+    await ts.put("full", GLOBAL, store_name=store)
+    sl = row_slice(1, 4)
+    dest = np.zeros(sl.local_shape, dtype=np.float32)
+    out = await ts.get("full", like=Shard(dest, sl), store_name=store)
+    assert out is dest
+    np.testing.assert_array_equal(dest, GLOBAL[2:4])
+
+
+async def test_fully_replicated_jax_demotion(store):
+    jax = pytest.importorskip("jax")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    x = jax.device_put(GLOBAL, NamedSharding(mesh, P()))
+    await ts.put("rep", x, store_name=store)
+    # Demoted to a plain TENSOR: immediately fully committed, readable whole.
+    out = await ts.get("rep", store_name=store)
+    np.testing.assert_array_equal(out, GLOBAL)
+
+
+async def test_expert_parallel_distinct_keys(store):
+    # EP pattern: each "expert" is a separate key, fully local to its rank
+    # (reference MoE demotion use case).
+    actors = await spawn_actors(4, _ExpertActor, "experts")
+    try:
+        await actors.put_expert.call()
+    finally:
+        await actors.stop()
+    for e in range(4):
+        out = await ts.get(f"expert/{e}", store_name=store)
+        np.testing.assert_array_equal(out, np.full((4, 4), float(e)))
+
+
+class _ExpertActor(Actor):
+    def __init__(self):
+        import os
+
+        self.rank = int(os.environ["RANK"])
+
+    @endpoint
+    async def put_expert(self):
+        await ts.put(
+            f"expert/{self.rank}",
+            np.full((4, 4), float(self.rank)),
+            store_name="tsl",
+        )

@@ -1,15 +1,68 @@
 """torchstore_tpu: a TPU-native distributed async tensor store.
 
 Same capabilities as meta-pytorch/torchstore (RL-style weight sync: publish a
-sharded state_dict from one actor group, pull it into a differently sharded
-model in another, with automatic resharding + transport selection), designed
-TPU-first: jax.Array/NamedSharding sharding metadata, storage volumes on TPU
-(host, chip) coordinates, and a same-host-SHM / bulk-TCP(DCN) / RPC transport
-ladder.
+sharded model ``state_dict`` from one actor group and pull it into a
+differently-sharded model in another, with automatic resharding + transport
+selection), designed TPU-first: ``jax.Array`` + ``NamedSharding`` sharding
+metadata, storage volumes on TPU (host, chip) coordinates, and a same-host
+SHM / bulk-TCP (ICI-adjacent / DCN) / RPC transport ladder.
 """
 
+from torchstore_tpu.api import (
+    DEFAULT_STORE,
+    Shard,
+    client,
+    delete,
+    delete_batch,
+    exists,
+    get,
+    get_batch,
+    initialize,
+    keys,
+    put,
+    put_batch,
+    reset_client,
+    shutdown,
+)
+from torchstore_tpu.client import LocalClient
+from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import init_logging
+from torchstore_tpu.strategy import (
+    HostStrategy,
+    LocalRankStrategy,
+    SingletonStrategy,
+    StoreStrategy,
+)
+from torchstore_tpu.transport.factory import TransportType
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 
 init_logging()
 
 __version__ = "0.1.0"
+
+__all__ = [
+    "DEFAULT_STORE",
+    "HostStrategy",
+    "LocalClient",
+    "LocalRankStrategy",
+    "Request",
+    "Shard",
+    "SingletonStrategy",
+    "StoreConfig",
+    "StoreStrategy",
+    "TensorMeta",
+    "TensorSlice",
+    "TransportType",
+    "client",
+    "delete",
+    "delete_batch",
+    "exists",
+    "get",
+    "get_batch",
+    "initialize",
+    "keys",
+    "put",
+    "put_batch",
+    "reset_client",
+    "shutdown",
+]

@@ -1,0 +1,202 @@
+"""Public module-level async API.
+
+TPU-native equivalent of /root/reference/torchstore/api.py:27-438: a store
+registry keyed by ``store_name``, ``initialize`` spawning volumes + the
+controller, and module-level ``put/get/...`` delegating to a cached
+``LocalClient``. Store handles are published through an env var
+(``TORCHSTORE_TPU_STORE_<name>``) so actor processes spawned afterwards
+discover the controller the way Monarch's global actor naming served the
+reference (/root/reference/torchstore/api.py:118-123).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from torchstore_tpu.client import LocalClient, Shard
+from torchstore_tpu.config import StoreConfig, default_config
+from torchstore_tpu.controller import Controller
+from torchstore_tpu.logging import get_logger, set_log_level
+from torchstore_tpu.runtime import (
+    ActorMesh,
+    ActorRef,
+    get_or_spawn_singleton,
+    spawn_actors,
+    stop_singleton,
+)
+from torchstore_tpu.storage_volume import StorageVolume
+from torchstore_tpu.strategy import (
+    LocalRankStrategy,
+    SingletonStrategy,
+    StoreStrategy,
+)
+
+logger = get_logger("torchstore_tpu.api")
+
+ENV_STORE_PREFIX = "TORCHSTORE_TPU_STORE_"
+DEFAULT_STORE = "default"
+
+
+@dataclass
+class _StoreHandle:
+    controller: ActorRef
+    volume_mesh: Optional[ActorMesh]  # only in the initializing process
+    client: Optional[LocalClient]
+    config: StoreConfig
+    owner: bool
+
+
+_stores: dict[str, _StoreHandle] = {}
+
+
+def _publish_handle(store_name: str, controller: ActorRef) -> None:
+    payload = base64.b64encode(pickle.dumps(controller)).decode()
+    os.environ[ENV_STORE_PREFIX + store_name] = payload
+
+
+def _discover_handle(store_name: str) -> Optional[ActorRef]:
+    payload = os.environ.get(ENV_STORE_PREFIX + store_name)
+    if not payload:
+        return None
+    return pickle.loads(base64.b64decode(payload))
+
+
+async def initialize(
+    num_storage_volumes: int = 1,
+    strategy: Optional[StoreStrategy] = None,
+    store_name: str = DEFAULT_STORE,
+    config: Optional[StoreConfig] = None,
+) -> ActorRef:
+    """Boot a store: spawn volume actors, the singleton controller, wire them
+    (/root/reference/torchstore/api.py:33-81)."""
+    if store_name in _stores:
+        raise RuntimeError(f"store {store_name!r} already initialized")
+    config = config or default_config()
+    set_log_level(config.log_level)
+    if strategy is None:
+        strategy = (
+            SingletonStrategy() if num_storage_volumes == 1 else LocalRankStrategy()
+        )
+    volume_mesh = await spawn_actors(
+        num_storage_volumes,
+        StorageVolume,
+        f"ts_{store_name}_volume",
+        strategy,
+    )
+    controller = await get_or_spawn_singleton(f"ts_{store_name}_controller", Controller)
+    await controller.init.call_one(strategy, volume_mesh.refs)
+    _publish_handle(store_name, controller)
+    _stores[store_name] = _StoreHandle(
+        controller=controller,
+        volume_mesh=volume_mesh,
+        client=None,
+        config=config,
+        owner=True,
+    )
+    return controller
+
+
+def client(store_name: str = DEFAULT_STORE) -> LocalClient:
+    """The per-process cached LocalClient
+    (/root/reference/torchstore/api.py:141-153)."""
+    handle = _stores.get(store_name)
+    if handle is None:
+        controller = _discover_handle(store_name)
+        if controller is None:
+            raise RuntimeError(
+                f"store {store_name!r} is not initialized in this process and "
+                "no published handle was found; call ts.initialize() first"
+            )
+        handle = _StoreHandle(
+            controller=controller,
+            volume_mesh=None,
+            client=None,
+            config=default_config(),
+            owner=False,
+        )
+        _stores[store_name] = handle
+    if handle.client is None:
+        handle.client = LocalClient(handle.controller, handle.config)
+    return handle.client
+
+
+def reset_client(store_name: str = DEFAULT_STORE) -> None:
+    handle = _stores.get(store_name)
+    if handle is not None:
+        handle.client = None
+
+
+async def put(key: str, value: Any, store_name: str = DEFAULT_STORE) -> None:
+    await client(store_name).put(key, value)
+
+
+async def put_batch(items: dict[str, Any], store_name: str = DEFAULT_STORE) -> None:
+    await client(store_name).put_batch(items)
+
+
+async def get(key: str, like: Any = None, store_name: str = DEFAULT_STORE) -> Any:
+    return await client(store_name).get(key, like)
+
+
+async def get_batch(
+    items: dict[str, Any], store_name: str = DEFAULT_STORE
+) -> dict[str, Any]:
+    return await client(store_name).get_batch(items)
+
+
+async def delete(key: str, store_name: str = DEFAULT_STORE) -> None:
+    await client(store_name).delete(key)
+
+
+async def delete_batch(keys: list[str], store_name: str = DEFAULT_STORE) -> None:
+    await client(store_name).delete_batch(keys)
+
+
+async def keys(
+    prefix: Optional[str] = None, store_name: str = DEFAULT_STORE
+) -> list[str]:
+    return await client(store_name).keys(prefix)
+
+
+async def exists(key: str, store_name: str = DEFAULT_STORE) -> bool:
+    return await client(store_name).exists(key)
+
+
+async def shutdown(store_name: str = DEFAULT_STORE) -> None:
+    """Tear down a store. In the initializing process this resets + stops the
+    volume/controller actors; elsewhere it only drops local caches
+    (/root/reference/torchstore/api.py:100-109)."""
+    handle = _stores.pop(store_name, None)
+    if handle is None:
+        return
+    if handle.owner:
+        try:
+            await handle.controller.teardown.call_one()
+        except Exception:
+            logger.exception("controller teardown failed")
+        if handle.volume_mesh is not None:
+            await handle.volume_mesh.stop()
+        await stop_singleton(f"ts_{store_name}_controller")
+        os.environ.pop(ENV_STORE_PREFIX + store_name, None)
+
+
+__all__ = [
+    "DEFAULT_STORE",
+    "Shard",
+    "client",
+    "delete",
+    "delete_batch",
+    "exists",
+    "get",
+    "get_batch",
+    "initialize",
+    "keys",
+    "put",
+    "put_batch",
+    "reset_client",
+    "shutdown",
+]

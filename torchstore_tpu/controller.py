@@ -1,0 +1,230 @@
+"""Metadata plane: the ``Controller`` actor.
+
+TPU-native equivalent of /root/reference/torchstore/controller.py:22-293.
+Holds the key -> {volume_id -> StorageInfo} index in a prefix trie, tracks
+sharded-commit progress (a sharded key is readable only once every mesh
+coordinate has landed), and answers locate/notify/delete/keys. The controller
+never carries tensor bytes — clients notify it with ``meta_only`` requests
+after the data plane transfer completes (two-plane invariant, SURVEY §2.2.1).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.runtime import Actor, ActorRef, endpoint
+from torchstore_tpu.storage_utils.trie import Trie
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+
+logger = get_logger("torchstore_tpu.controller")
+
+
+class ObjectType(Enum):
+    OBJECT = "object"
+    TENSOR = "tensor"
+    TENSOR_SLICE = "tensor_slice"
+
+
+def _object_type(meta: Request) -> ObjectType:
+    if meta.is_object:
+        return ObjectType.OBJECT
+    if meta.tensor_slice is not None:
+        return ObjectType.TENSOR_SLICE
+    return ObjectType.TENSOR
+
+
+class PartiallyCommittedError(KeyError):
+    pass
+
+
+class StoreKeyError(KeyError):
+    pass
+
+
+@dataclass
+class StorageInfo:
+    """What one volume holds for one key
+    (/root/reference/torchstore/controller.py:36-64)."""
+
+    object_type: ObjectType
+    tensor_meta: Optional[TensorMeta] = None
+    # coords -> TensorSlice, for TENSOR_SLICE keys.
+    tensor_slices: dict[tuple, TensorSlice] = field(default_factory=dict)
+
+    def merge(self, meta: Request) -> None:
+        incoming = _object_type(meta)
+        if incoming != self.object_type:
+            raise ValueError(
+                f"type confusion: stored {self.object_type} vs incoming {incoming}"
+            )
+        if meta.tensor_slice is not None:
+            self.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
+        if meta.tensor_meta is not None:
+            self.tensor_meta = meta.tensor_meta
+
+    @classmethod
+    def from_meta(cls, meta: Request) -> "StorageInfo":
+        info = cls(object_type=_object_type(meta), tensor_meta=meta.tensor_meta)
+        if meta.tensor_slice is not None:
+            info.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
+        return info
+
+
+class Controller(Actor):
+    def __init__(self) -> None:
+        self.index = Trie()  # key -> {volume_id: StorageInfo}
+        self.strategy = None
+        self.volume_refs: dict[str, ActorRef] = {}
+        self.volume_hostnames: dict[str, str] = {}
+
+    # ---- bootstrap -------------------------------------------------------
+
+    @endpoint
+    async def init(self, strategy, volume_refs: list[ActorRef]) -> dict[str, Any]:
+        """Resolve volume ids via a get_id fan-out (reference
+        /root/reference/torchstore/strategy.py:98-109) and adopt the strategy."""
+        import asyncio
+
+        self.strategy = strategy
+        infos = await asyncio.gather(*(ref.get_id.call_one() for ref in volume_refs))
+        self.volume_refs = {}
+        self.volume_hostnames = {}
+        for ref, info in zip(volume_refs, infos):
+            vid = str(info["volume_id"])
+            if vid in self.volume_refs:
+                raise ValueError(
+                    f"duplicate volume id {vid!r}; check strategy env wiring"
+                )
+            self.volume_refs[vid] = ref
+            self.volume_hostnames[vid] = info["hostname"]
+        return {
+            "volume_ids": sorted(self.volume_refs),
+            "hostnames": self.volume_hostnames,
+        }
+
+    @endpoint
+    async def get_volume_map(self) -> dict[str, dict]:
+        return {
+            vid: {"ref": ref, "hostname": self.volume_hostnames[vid]}
+            for vid, ref in self.volume_refs.items()
+        }
+
+    @endpoint
+    async def get_strategy(self):
+        return self.strategy
+
+    # ---- commit tracking -------------------------------------------------
+
+    def _committed_state(self, volume_infos: dict[str, StorageInfo]) -> str:
+        """'committed' | 'partial' for one key. A sharded key is fully
+        committed when stored coords across all volumes cover
+        product(mesh_shape) (/root/reference/torchstore/controller.py:66-104)."""
+        any_info = next(iter(volume_infos.values()))
+        if any_info.object_type != ObjectType.TENSOR_SLICE:
+            return "committed"
+        coords: set[tuple] = set()
+        mesh_shape: Optional[tuple] = None
+        for info in volume_infos.values():
+            coords.update(info.tensor_slices.keys())
+            for ts in info.tensor_slices.values():
+                mesh_shape = ts.mesh_shape
+        expected = math.prod(mesh_shape) if mesh_shape else 0
+        return "committed" if len(coords) >= expected else "partial"
+
+    # ---- endpoints -------------------------------------------------------
+
+    @endpoint
+    async def locate_volumes(
+        self,
+        keys: list[str],
+        missing_ok: bool = False,
+        require_fully_committed: bool = True,
+    ) -> dict[str, dict[str, StorageInfo]]:
+        out: dict[str, dict[str, StorageInfo]] = {}
+        for key in keys:
+            infos = self.index.get(key)
+            if infos is None:
+                if missing_ok:
+                    continue
+                raise StoreKeyError(f"Key {key!r} not found in store")
+            if require_fully_committed and self._committed_state(infos) == "partial":
+                raise PartiallyCommittedError(
+                    f"Key {key!r} is only partially committed; not all mesh "
+                    "coordinates have been stored yet"
+                )
+            out[key] = infos
+        return out
+
+    @endpoint
+    async def contains(self, key: str) -> str:
+        infos = self.index.get(key)
+        if infos is None:
+            return "missing"
+        return self._committed_state(infos)
+
+    @endpoint
+    async def notify_put_batch(self, metas: list[Request], volume_id: str) -> None:
+        for meta in metas:
+            if meta.tensor_val is not None or meta.objects is not None:
+                raise ValueError(
+                    "controller must never receive data payloads; send "
+                    "meta_only() requests"
+                )
+            infos = self.index.get(meta.key)
+            if infos is not None and meta.tensor_slice is not None:
+                # Re-publishing a key under a different layout (mesh shape or
+                # global shape changed) invalidates every previously indexed
+                # shard — otherwise stale old-layout shards would satisfy the
+                # commit check and be served alongside new data.
+                stale = False
+                for prev in infos.values():
+                    for ts in prev.tensor_slices.values():
+                        if (
+                            ts.mesh_shape != meta.tensor_slice.mesh_shape
+                            or ts.global_shape != meta.tensor_slice.global_shape
+                        ):
+                            stale = True
+                if stale:
+                    infos = None
+            if infos is None:
+                infos = {}
+                self.index[meta.key] = infos
+            info = infos.get(volume_id)
+            if info is None:
+                infos[volume_id] = StorageInfo.from_meta(meta)
+            else:
+                info.merge(meta)
+
+    @endpoint
+    async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:
+        """Remove keys from the index FIRST (notify-before-delete ordering,
+        /root/reference/torchstore/client.py:408-411) and return which
+        volumes held each key so the client can clear the data plane."""
+        by_volume: dict[str, list[str]] = {}
+        for key in keys:
+            infos = self.index.pop(key, None)
+            if infos is None:
+                continue  # idempotent delete
+            for vid in infos:
+                by_volume.setdefault(vid, []).append(key)
+        return by_volume
+
+    @endpoint
+    async def keys(self, prefix: Optional[str] = None) -> list[str]:
+        if prefix is None:
+            return sorted(self.index)
+        return sorted(self.index.keys().filter_by_prefix(prefix))
+
+    @endpoint
+    async def teardown(self) -> None:
+        import asyncio
+
+        self.index = Trie()
+        await asyncio.gather(
+            *(ref.reset.call_one() for ref in self.volume_refs.values()),
+            return_exceptions=True,
+        )

@@ -1,0 +1,27 @@
+from torchstore_tpu.runtime.actors import (
+    Actor,
+    ActorDiedError,
+    ActorMesh,
+    ActorMeshRef,
+    ActorRef,
+    RemoteActorError,
+    close_all_connections,
+    endpoint,
+    get_or_spawn_singleton,
+    spawn_actors,
+    stop_singleton,
+)
+
+__all__ = [
+    "Actor",
+    "ActorDiedError",
+    "ActorMesh",
+    "ActorMeshRef",
+    "ActorRef",
+    "RemoteActorError",
+    "close_all_connections",
+    "endpoint",
+    "get_or_spawn_singleton",
+    "spawn_actors",
+    "stop_singleton",
+]

@@ -1,0 +1,104 @@
+"""Wire serialization for the actor runtime.
+
+Replaces Monarch's hyperactor codec (SURVEY §2.3 row 1). Messages are pickled
+with protocol 5 and **out-of-band buffers**: large tensor payloads (numpy
+arrays riding a ``Request`` or transport buffer) are not copied into the
+pickle stream — their memory is framed separately and written directly to the
+socket, and reconstructed zero-copy on the receiving side. There is no frame
+size limit (the reference had to raise ``HYPERACTOR_CODEC_MAX_FRAME_LENGTH``
+for big tensors, /root/reference/torchstore/__init__.py:37-44; this codec
+streams arbitrarily large frames in chunks).
+
+Frame layout:
+    u32 magic | u8 kind | u64 payload_len | u32 nbufs | u64 buf_len * nbufs
+    | payload bytes | buffer bytes...
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+from typing import Any
+
+MAGIC = 0x7E5701AB
+
+_HEADER = struct.Struct("<IBQI")
+_U64 = struct.Struct("<Q")
+
+# Message kinds.
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ERROR = 2
+KIND_CONTROL = 3
+
+# Streaming chunk size for writing very large buffers.
+_WRITE_CHUNK = 4 * 1024 * 1024
+
+
+class SerializationError(RuntimeError):
+    pass
+
+
+def dumps(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
+    buffers: list[pickle.PickleBuffer] = []
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    return payload, buffers
+
+
+def loads(payload: bytes, buffers: list[bytes | bytearray | memoryview]) -> Any:
+    return pickle.loads(payload, buffers=buffers)
+
+
+async def write_message(writer: asyncio.StreamWriter, kind: int, obj: Any) -> None:
+    payload, buffers = dumps(obj)
+    raws = [b.raw() for b in buffers]
+    header = bytearray(_HEADER.pack(MAGIC, kind, len(payload), len(raws)))
+    for raw in raws:
+        header += _U64.pack(raw.nbytes)
+    writer.write(bytes(header))
+    writer.write(payload)
+    for raw in raws:
+        flat = raw.cast("B") if raw.ndim != 1 or raw.format != "B" else raw
+        if flat.nbytes <= _WRITE_CHUNK:
+            writer.write(flat)
+        else:
+            for off in range(0, flat.nbytes, _WRITE_CHUNK):
+                writer.write(flat[off : off + _WRITE_CHUNK])
+                await writer.drain()
+    await writer.drain()
+    for b in buffers:
+        b.release()
+
+
+async def read_message(reader: asyncio.StreamReader) -> tuple[int, Any]:
+    header = await reader.readexactly(_HEADER.size)
+    magic, kind, payload_len, nbufs = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise SerializationError(f"bad frame magic {magic:#x}")
+    buf_lens = []
+    if nbufs:
+        lens_raw = await reader.readexactly(_U64.size * nbufs)
+        buf_lens = [
+            _U64.unpack_from(lens_raw, i * _U64.size)[0] for i in range(nbufs)
+        ]
+    payload = await reader.readexactly(payload_len)
+    buffers: list[bytearray] = []
+    for blen in buf_lens:
+        buf = bytearray(blen)
+        await _read_into(reader, memoryview(buf))
+        buffers.append(buf)
+    return kind, loads(payload, buffers)
+
+
+async def _read_into(reader: asyncio.StreamReader, view: memoryview) -> None:
+    # readexactly would allocate+copy; read into the target in chunks instead.
+    remaining = view.nbytes
+    pos = 0
+    while remaining:
+        chunk = await reader.read(min(remaining, _WRITE_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(bytes(view[:pos]), view.nbytes)
+        view[pos : pos + len(chunk)] = chunk
+        pos += len(chunk)
+        remaining -= len(chunk)

@@ -1,0 +1,258 @@
+"""Data plane: ``StorageVolume`` actor + ``InMemoryStore``.
+
+TPU-native equivalent of /root/reference/torchstore/storage_volume.py:51-407.
+A volume is one actor process holding host-memory entries:
+
+    key -> {"type": "tensor",  "tensor": np.ndarray}
+         | {"type": "sharded", "shards": {coords: {"slice": TensorSlice,
+                                                   "tensor": np.ndarray}}}
+         | {"type": "object",  "obj": Any}
+
+Volumes are jax-free (host numpy only) so they spawn fast and never touch the
+TPU runtime; device arrays are converted at the client boundary. Transfer
+mechanics live entirely in the transport buffer that rides each RPC.
+"""
+
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.runtime import Actor, endpoint
+from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+from torchstore_tpu.utils import get_hostname
+
+logger = get_logger("torchstore_tpu.storage_volume")
+
+
+class KeyNotFoundError(KeyError):
+    pass
+
+
+class PartialShardError(KeyError):
+    pass
+
+
+class StorageImpl(ABC):
+    """Pluggable storage backend behind a volume (reference
+    /root/reference/torchstore/storage_volume.py:102-150)."""
+
+    @abstractmethod
+    def extract_existing(self, metas: list[Request]) -> dict[int, np.ndarray]: ...
+
+    @abstractmethod
+    def store(self, metas: list[Request], values: dict[int, Any]) -> None: ...
+
+    @abstractmethod
+    def get_data(self, meta: Request) -> Any: ...
+
+    @abstractmethod
+    def get_meta(self, meta: Request) -> Any: ...
+
+    @abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+    @abstractmethod
+    def reset(self) -> None: ...
+
+
+class InMemoryStore(StorageImpl):
+    def __init__(self) -> None:
+        self.kv: dict[str, dict] = {}
+
+    # ---- write path ------------------------------------------------------
+
+    def _check_type(self, key: str, entry: dict, incoming: str) -> None:
+        if entry["type"] != incoming:
+            raise ValueError(
+                f"key {key!r} already stored as {entry['type']!r}; cannot "
+                f"overwrite with {incoming!r} (delete first)"
+            )
+
+    def extract_existing(self, metas: list[Request]) -> dict[int, np.ndarray]:
+        """Existing stored arrays for in-place overwrite: a second put of the
+        same key writes into the same memory so SHM/bulk clients aliasing the
+        buffer observe updates (reference invariant 6,
+        /root/reference/torchstore/storage_volume.py:161-207)."""
+        out: dict[int, np.ndarray] = {}
+        for idx, meta in enumerate(metas):
+            entry = self.kv.get(meta.key)
+            if entry is None:
+                continue
+            incoming = (
+                "object"
+                if meta.is_object
+                else ("sharded" if meta.tensor_slice is not None else "tensor")
+            )
+            self._check_type(meta.key, entry, incoming)
+            if incoming == "tensor":
+                out[idx] = entry["tensor"]
+            elif incoming == "sharded":
+                shard = entry["shards"].get(meta.tensor_slice.coordinates)
+                if shard is not None and (
+                    shard["slice"].local_shape == meta.tensor_slice.local_shape
+                ):
+                    out[idx] = shard["tensor"]
+        return out
+
+    def store(self, metas: list[Request], values: dict[int, Any]) -> None:
+        for idx, meta in enumerate(metas):
+            if idx not in values:
+                raise ValueError(f"transport produced no value for {meta.key!r}")
+            value = values[idx]
+            if meta.is_object:
+                self.kv[meta.key] = {"type": "object", "obj": value}
+            elif meta.tensor_slice is not None:
+                entry = self.kv.setdefault(meta.key, {"type": "sharded", "shards": {}})
+                self._check_type(meta.key, entry, "sharded")
+                ts = meta.tensor_slice
+                entry["shards"][ts.coordinates] = {
+                    "slice": ts,
+                    "tensor": np.asarray(value),
+                }
+            else:
+                entry = self.kv.get(meta.key)
+                if entry is not None:
+                    self._check_type(meta.key, entry, "tensor")
+                self.kv[meta.key] = {"type": "tensor", "tensor": np.asarray(value)}
+
+    # ---- read path -------------------------------------------------------
+
+    def _entry(self, key: str) -> dict:
+        entry = self.kv.get(key)
+        if entry is None:
+            raise KeyNotFoundError(f"Key {key!r} not found in storage volume")
+        return entry
+
+    def get_data(self, meta: Request) -> Any:
+        entry = self._entry(meta.key)
+        if entry["type"] == "object":
+            return entry["obj"]
+        if meta.tensor_slice is None:
+            if entry["type"] == "tensor":
+                return entry["tensor"]
+            shards = entry["shards"]
+            if len(shards) == 1:
+                (shard,) = shards.values()
+                if shard["slice"].is_full():
+                    return shard["tensor"]
+            raise PartialShardError(
+                f"Key {meta.key!r} is sharded across coordinates "
+                f"{sorted(shards)}; a slice request is required"
+            )
+        box = meta.tensor_slice.box
+        if entry["type"] == "tensor":
+            # Slice extraction from a full tensor
+            # (/root/reference/torchstore/storage_volume.py:220-237).
+            tensor = entry["tensor"]
+            if not TensorSlice(
+                offsets=(0,) * tensor.ndim,
+                local_shape=tensor.shape,
+                global_shape=tensor.shape,
+                coordinates=(),
+                mesh_shape=(),
+            ).box.contains(box):
+                raise PartialShardError(
+                    f"requested region {box} outside stored tensor "
+                    f"{tensor.shape} for key {meta.key!r}"
+                )
+            return tensor[box.to_index()]
+        shard = entry["shards"].get(meta.tensor_slice.coordinates)
+        if shard is None:
+            raise PartialShardError(
+                f"no shard at coordinates {meta.tensor_slice.coordinates} "
+                f"for key {meta.key!r}"
+            )
+        stored: TensorSlice = shard["slice"]
+        if not stored.box.contains(box):
+            # Volumes serve sub-slices of stored shards only when fully
+            # contained (/root/reference/torchstore/storage_volume.py:239-280);
+            # the client's planner guarantees this by construction.
+            raise PartialShardError(
+                f"requested region {box} not contained in stored shard "
+                f"{stored.box} for key {meta.key!r}"
+            )
+        rel = tuple(
+            slice(o - so, o - so + s)
+            for o, so, s in zip(box.offsets, stored.offsets, box.shape)
+        )
+        return shard["tensor"][rel]
+
+    def get_meta(self, meta: Request) -> Any:
+        entry = self._entry(meta.key)
+        if entry["type"] == "object":
+            return "obj"
+        data = self.get_data(meta)
+        return TensorMeta.of(data)
+
+    def delete(self, key: str) -> bool:
+        return self.kv.pop(key, None) is not None
+
+    def reset(self) -> None:
+        self.kv.clear()
+
+
+class StorageVolume(Actor):
+    """Data-plane actor (/root/reference/torchstore/storage_volume.py:27-99)."""
+
+    def __init__(self, strategy=None, storage: Optional[StorageImpl] = None):
+        if strategy is not None:
+            self.volume_id = strategy.get_volume_id()
+        else:
+            self.volume_id = os.environ.get("RANK", "0")
+        self.store: StorageImpl = storage or InMemoryStore()
+        self.ctx = TransportContext()
+
+    @endpoint
+    async def get_id(self) -> dict:
+        return {
+            "volume_id": self.volume_id,
+            "hostname": get_hostname(),
+            "pid": os.getpid(),
+        }
+
+    @endpoint
+    async def handshake(
+        self, buffer: TransportBuffer, metas: list[Request], op: str
+    ) -> Any:
+        existing = self.store.extract_existing(metas) if op == "put" else {}
+        return buffer.recv_handshake(self.ctx, metas, existing, op)
+
+    @endpoint
+    async def put(self, buffer: TransportBuffer, metas: list[Request]) -> None:
+        existing = self.store.extract_existing(metas)
+        values = buffer.handle_put_request(self.ctx, metas, existing)
+        self.store.store(metas, values)
+
+    @endpoint
+    async def get(
+        self, buffer: TransportBuffer, metas: list[Request]
+    ) -> TransportBuffer:
+        entries = [self.store.get_data(meta) for meta in metas]
+        buffer.handle_get_request(self.ctx, metas, entries)
+        return buffer
+
+    @endpoint
+    async def get_meta(self, metas: list[Request]) -> list[Any]:
+        return [self.store.get_meta(meta) for meta in metas]
+
+    @endpoint
+    async def delete_batch(self, keys: list[str]) -> int:
+        # Idempotent: missing keys ignored so cleanup retries are safe
+        # (/root/reference/torchstore/api.py:308).
+        deleted = 0
+        for key in keys:
+            if self.store.delete(key):
+                self.ctx.delete_key(key)
+                deleted += 1
+        return deleted
+
+    @endpoint
+    async def reset(self) -> None:
+        self.store.reset()
+        self.ctx.clear()

@@ -1,0 +1,109 @@
+"""Client<->volume mapping strategies.
+
+TPU-native equivalent of /root/reference/torchstore/strategy.py:29-245. A
+strategy decides (a) each volume's id, computed INSIDE the volume process
+from its env (rank / hostname — on a TPU pod these are the (host, chip)
+coordinates), and (b) which volume a given client writes to. Strategies are
+small picklable objects shared by controller, clients and volumes.
+"""
+
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Optional
+
+from torchstore_tpu.runtime import ActorRef
+from torchstore_tpu.transport.buffers import TransportContext
+from torchstore_tpu.utils import get_hostname
+
+
+@dataclass
+class StorageVolumeRef:
+    """Bundle handed to transports: actor handle + volume id + the client's
+    transport context + optional forced transport + remote hostname
+    (/root/reference/torchstore/strategy.py:29-51)."""
+
+    actor: ActorRef
+    volume_id: str
+    transport_context: TransportContext
+    hostname: str = ""
+    transport_type: Optional[str] = None  # forced override, else auto-ladder
+    extra: dict = field(default_factory=dict)
+
+    def is_same_host(self) -> bool:
+        return self.hostname == get_hostname()
+
+
+class StoreStrategy(ABC):
+    """Base strategy. ``default_transport_type`` forces one transport for
+    every volume mapped by this strategy (reference
+    /root/reference/torchstore/strategy.py:65-66)."""
+
+    def __init__(self, default_transport_type: Optional[str] = None) -> None:
+        self.default_transport_type = default_transport_type
+
+    @abstractmethod
+    def get_volume_id(self) -> str:
+        """Runs inside the volume process (reads its own rank/hostname env)."""
+
+    @abstractmethod
+    def get_client_id(self) -> str:
+        """Runs inside the client process."""
+
+    def select_volume_id(self, client_id: str, volume_ids: list[str]) -> str:
+        """Which volume a client writes to. Default: the volume whose id
+        matches the client id."""
+        if client_id in volume_ids:
+            return client_id
+        raise ValueError(
+            f"no storage volume for client id {client_id!r}; "
+            f"volumes: {sorted(volume_ids)}"
+        )
+
+    def num_volumes(self, num_clients: int) -> int:
+        return num_clients
+
+
+class LocalRankStrategy(StoreStrategy):
+    """One volume per rank; clients map to the volume of their own rank.
+    Client id precedence RANK > LOCAL_RANK matches the reference
+    (/root/reference/torchstore/strategy.py:164-188)."""
+
+    def get_volume_id(self) -> str:
+        return os.environ.get("RANK", os.environ.get("LOCAL_RANK", "0"))
+
+    def get_client_id(self) -> str:
+        return os.environ.get("RANK", os.environ.get("LOCAL_RANK", "0"))
+
+
+class HostStrategy(StoreStrategy):
+    """One volume per host (/root/reference/torchstore/strategy.py:146-161).
+    ``TORCHSTORE_TPU_HOSTNAME`` overrides for tests emulating multi-host."""
+
+    def get_volume_id(self) -> str:
+        return os.environ.get("TORCHSTORE_TPU_HOSTNAME", get_hostname())
+
+    def get_client_id(self) -> str:
+        return os.environ.get("TORCHSTORE_TPU_HOSTNAME", get_hostname())
+
+
+class SingletonStrategy(StoreStrategy):
+    """Single shared volume (the reference's deprecated
+    ControllerStorageVolumes, /root/reference/torchstore/strategy.py:191-245,
+    kept here as the simple default for one-volume stores)."""
+
+    VOLUME_ID = "0"
+
+    def get_volume_id(self) -> str:
+        return self.VOLUME_ID
+
+    def get_client_id(self) -> str:
+        return self.VOLUME_ID
+
+    def select_volume_id(self, client_id: str, volume_ids: list[str]) -> str:
+        return self.VOLUME_ID
+
+    def num_volumes(self, num_clients: int) -> int:
+        return 1

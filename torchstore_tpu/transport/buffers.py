@@ -1,0 +1,182 @@
+"""Transport buffer contract + client-side transport caches.
+
+TPU-native equivalent of /root/reference/torchstore/transport/buffers.py:20-361.
+The same five-phase lifecycle makes transports pluggable and independently
+testable (SURVEY §5 "distributed communication backend"):
+
+    client                                server (storage volume)
+    ------                                -----------------------
+    perform_handshake ──RPC──────────────▶ recv_handshake
+    _pre_put_hook / _pre_get_hook
+    volume.put/get(buffer, metas) ──RPC──▶ handle_put_request /
+                                           handle_get_request
+    _handle_storage_volume_response ◀─────(buffer rides the response)
+    _post_request_success; drop() in finally
+
+The buffer object itself is serialized into the RPC both ways; client-only
+references (live arrays, caches) are stripped in ``__getstate__`` by each
+implementation.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import TYPE_CHECKING, Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.transport.types import Request
+
+if TYPE_CHECKING:
+    from torchstore_tpu.strategy import StorageVolumeRef
+
+logger = get_logger("torchstore_tpu.transport")
+
+
+class TransportCache:
+    """Base class for per-volume client-side caches (connections, segments,
+    registrations). Reference: /root/reference/torchstore/transport/buffers.py:20-38."""
+
+    def delete_key(self, key: str) -> None:  # noqa: B027 - optional hook
+        pass
+
+    def clear(self) -> None:  # noqa: B027 - optional hook
+        pass
+
+
+class TransportContext:
+    """Type-keyed lazy registry of ``TransportCache`` instances, one per
+    client (and one per storage volume server side). Reference:
+    /root/reference/torchstore/transport/buffers.py:39-69."""
+
+    def __init__(self) -> None:
+        self._caches: dict[type, TransportCache] = {}
+
+    def get_cache(self, cache_cls: type, *args, **kwargs) -> Any:
+        cache = self._caches.get(cache_cls)
+        if cache is None:
+            cache = cache_cls(*args, **kwargs)
+            self._caches[cache_cls] = cache
+        return cache
+
+    def delete_key(self, key: str) -> None:
+        for cache in self._caches.values():
+            cache.delete_key(key)
+
+    def clear(self) -> None:
+        for cache in self._caches.values():
+            cache.clear()
+        self._caches.clear()
+
+
+class TransportBuffer(ABC):
+    """One instance per request batch; orchestrates the transfer lifecycle.
+
+    Subclasses implement the hooks; this base drives ordering, error
+    propagation and guaranteed resource release (``drop()`` runs in a
+    ``finally`` for both success and failure — reference invariant,
+    /root/reference/torchstore/transport/buffers.py:196-257).
+    """
+
+    requires_handshake: bool = False
+    supports_inplace: bool = True
+    requires_contiguous_inplace: bool = False
+    supports_batch_puts: bool = True
+    supports_batch_gets: bool = True
+
+    # ---- client-side lifecycle ------------------------------------------
+
+    async def put_to_storage_volume(
+        self, volume: "StorageVolumeRef", requests: list[Request]
+    ) -> None:
+        for req in requests:
+            if not req.is_object and req.tensor_val is None:
+                raise ValueError(
+                    f"put of key {req.key!r} carries no tensor data "
+                    "(Shard.data must not be None on puts)"
+                )
+        try:
+            if self.requires_handshake:
+                await self._perform_handshake(volume, requests, op="put")
+            await self._pre_put_hook(volume, requests)
+            metas = [r.meta_only() for r in requests]
+            await volume.actor.put.call_one(self, metas)
+            self._post_request_success(volume)
+        finally:
+            self.drop()
+
+    async def get_from_storage_volume(
+        self, volume: "StorageVolumeRef", requests: list[Request]
+    ) -> list[np.ndarray]:
+        try:
+            if self.requires_handshake:
+                await self._perform_handshake(volume, requests, op="get")
+            await self._pre_get_hook(volume, requests)
+            metas = [r.meta_only() for r in requests]
+            remote = await volume.actor.get.call_one(self, metas)
+            results = self._handle_storage_volume_response(volume, remote, requests)
+            self._post_request_success(volume)
+            return results
+        finally:
+            self.drop()
+
+    async def _perform_handshake(
+        self, volume: "StorageVolumeRef", requests: list[Request], op: str
+    ) -> None:
+        self._pre_handshake(volume, requests, op)
+        metas = [r.meta_only() for r in requests]
+        reply = await volume.actor.handshake.call_one(self, metas, op)
+        self._post_handshake(volume, requests, reply, op)
+
+    # ---- hooks (client) --------------------------------------------------
+
+    def _pre_handshake(self, volume, requests, op) -> None:  # noqa: B027
+        pass
+
+    def _post_handshake(self, volume, requests, reply, op) -> None:  # noqa: B027
+        pass
+
+    async def _pre_put_hook(self, volume, requests) -> None:  # noqa: B027
+        pass
+
+    async def _pre_get_hook(self, volume, requests) -> None:  # noqa: B027
+        pass
+
+    @abstractmethod
+    def _handle_storage_volume_response(
+        self, volume, remote: "TransportBuffer", requests: list[Request]
+    ) -> list[np.ndarray]:
+        """Land fetched data: into destination views when attached, else
+        return fresh arrays, in request order."""
+
+    def _post_request_success(self, volume) -> None:  # noqa: B027
+        """Promote any handshake-scoped resources to the reusable cache —
+        only reached on success, so failed requests cannot poison caches
+        (reference invariant 5, SURVEY §2.2)."""
+
+    def drop(self) -> None:  # noqa: B027
+        """Release pinned/staged resources; safe to call multiple times."""
+
+    # ---- hooks (server side, run inside the storage-volume process) ------
+
+    def recv_handshake(
+        self, ctx: TransportContext, metas: list[Request], existing: dict, op: str
+    ) -> Any:
+        """Server-side handshake step; returns a (picklable) reply."""
+        return None
+
+    @abstractmethod
+    def handle_put_request(
+        self, ctx: TransportContext, metas: list[Request], existing: dict[str, Any]
+    ) -> dict[int, np.ndarray]:
+        """Materialize incoming data server-side: returns {request_index:
+        host array} for the store to keep. ``existing`` maps request index ->
+        previously stored array for in-place reuse (invariant 6)."""
+
+    @abstractmethod
+    def handle_get_request(
+        self, ctx: TransportContext, metas: list[Request], entries: list[Any]
+    ) -> None:
+        """Load outgoing data into this buffer server-side. ``entries`` are
+        the store's arrays/objects in request order."""

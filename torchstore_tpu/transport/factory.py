@@ -1,0 +1,106 @@
+"""Transport selection ladder.
+
+Equivalent of /root/reference/torchstore/transport/__init__.py:38-108. The
+reference ladder (SHM -> uniflow RDMA/NVLink -> legacy RDMA -> ibverbs ->
+Gloo -> RPC) maps to TPU rungs:
+
+    shm   same-host POSIX shared memory between client and volume
+    bulk  dedicated-socket bulk transfer (ICI-adjacent within a pod via
+          host staging; DCN across pods)
+    rpc   payload rides the actor-RPC frames (always available)
+
+Selection is per-volume at request time: forced type on the
+``StorageVolumeRef``/strategy wins, else the best available rung probes in.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import TYPE_CHECKING, Optional
+
+from torchstore_tpu.config import StoreConfig, default_config
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.transport.buffers import TransportBuffer
+from torchstore_tpu.transport.rpc import RPCTransportBuffer
+
+if TYPE_CHECKING:
+    from torchstore_tpu.strategy import StorageVolumeRef
+
+logger = get_logger("torchstore_tpu.transport")
+
+
+class TransportType(str, Enum):
+    UNSET = "unset"
+    RPC = "rpc"
+    SHM = "shm"
+    BULK = "bulk"
+
+
+def shm_available(volume: "StorageVolumeRef", config: StoreConfig) -> bool:
+    if not config.shm_enabled or not volume.is_same_host():
+        return False
+    try:
+        from torchstore_tpu.transport import shared_memory  # noqa: F401
+
+        return shared_memory.is_available()
+    except ImportError:
+        return False
+
+
+def bulk_available(volume: "StorageVolumeRef", config: StoreConfig) -> bool:
+    if not config.bulk_tcp_enabled:
+        return False
+    try:
+        from torchstore_tpu.transport import bulk  # noqa: F401
+
+        return bulk.is_available()
+    except ImportError:
+        return False
+
+
+_logged_resolution = False
+
+
+def create_transport_buffer(
+    volume: "StorageVolumeRef", config: Optional[StoreConfig] = None
+) -> TransportBuffer:
+    config = config or default_config()
+    forced = volume.transport_type
+    if forced in (None, TransportType.UNSET, TransportType.UNSET.value):
+        chosen = _auto_select(volume, config)
+    else:
+        chosen = TransportType(forced)
+    global _logged_resolution
+    if not _logged_resolution:
+        logger.info(
+            "transport resolution: volume=%s same_host=%s -> %s",
+            volume.volume_id,
+            volume.is_same_host(),
+            chosen.value,
+        )
+        _logged_resolution = True
+    try:
+        if chosen == TransportType.SHM:
+            from torchstore_tpu.transport.shared_memory import (
+                SharedMemoryTransportBuffer,
+            )
+
+            return SharedMemoryTransportBuffer(config)
+        if chosen == TransportType.BULK:
+            from torchstore_tpu.transport.bulk import BulkTransportBuffer
+
+            return BulkTransportBuffer(config)
+    except ImportError as exc:
+        raise RuntimeError(
+            f"transport {chosen.value!r} was forced but is not available "
+            f"in this build: {exc}"
+        ) from exc
+    return RPCTransportBuffer()
+
+
+def _auto_select(volume: "StorageVolumeRef", config: StoreConfig) -> TransportType:
+    if shm_available(volume, config):
+        return TransportType.SHM
+    if bulk_available(volume, config):
+        return TransportType.BULK
+    return TransportType.RPC

@@ -19,6 +19,37 @@ from torchstore_tpu.utils import Box
 
 
 @dataclass(frozen=True)
+class TensorMeta:
+    """Shape + dtype of a tensor payload; travels on meta-only requests so
+    servers/transports can allocate destinations without the data."""
+
+    shape: tuple[int, ...]
+    dtype: str  # numpy dtype string, e.g. "float32", "bfloat16"
+
+    @classmethod
+    def of(cls, arr: np.ndarray) -> "TensorMeta":
+        return cls(shape=tuple(int(s) for s in arr.shape), dtype=str(arr.dtype))
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _np_dtype(self.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return math.prod(self.shape) * self.np_dtype.itemsize
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 lives in ml_dtypes (jax's numpy extension types).
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass(frozen=True)
 class TensorSlice:
     """Metadata describing one shard of a global array.
 
@@ -83,6 +114,7 @@ class Request:
     tensor_slice: Optional[TensorSlice] = None
     objects: Any = None
     is_object: bool = False
+    tensor_meta: Optional[TensorMeta] = None
     # Attached by the client when an in-place destination view exists for this
     # (sub-)request; never serialized to the server (stripped by meta_only).
     destination_view: Optional[np.ndarray] = field(default=None, repr=False)
@@ -114,12 +146,16 @@ class Request:
 
     def meta_only(self) -> "Request":
         """Copy carrying metadata only (never tensor bytes or object payloads)."""
+        meta = self.tensor_meta
+        if meta is None and self.tensor_val is not None:
+            meta = TensorMeta.of(self.tensor_val)
         return Request(
             key=self.key,
             tensor_val=None,
             tensor_slice=self.tensor_slice,
             objects=None,
             is_object=self.is_object,
+            tensor_meta=meta,
         )
 
     @property
