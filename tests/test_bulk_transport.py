@@ -1,0 +1,158 @@
+"""Bulk transport tests: forced-bulk integration, promote-on-success cache
+semantics, abort on failure, registration cache weakref eviction, large
+transfers (reference tests/test_torchcomms_transport.py +
+test_rdma_memory_cache.py)."""
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.transport.bulk import BulkClientCache, BulkTransportBuffer
+from torchstore_tpu.transport.cache import ArrayRegistrationCache
+
+
+class TestRegistrationCache:
+    def test_hit_keyed_by_ptr_and_size(self):
+        cache = ArrayRegistrationCache()
+        a = np.ones(16, np.float32)
+        r1 = cache.register(a)
+        r2 = cache.register(a)
+        assert r1 is r2 and len(cache) == 1
+
+    def test_weakref_eviction(self):
+        # Plain ndarrays aren't weakref-able; subclasses (and jax buffers)
+        # are — eviction fires when the owner dies.
+        class Weakable(np.ndarray):
+            pass
+
+        cache = ArrayRegistrationCache()
+        a = np.ones(16, np.float32).view(Weakable)
+        cache.register(a)
+        assert len(cache) == 1
+        del a
+        gc.collect()
+        assert len(cache) == 0
+
+    def test_view_keeps_registration_alive(self):
+        class Weakable(np.ndarray):
+            pass
+
+        cache = ArrayRegistrationCache()
+        a = np.ones(16, np.float32).view(Weakable)
+        view = a[:4]
+        cache.register(a)
+        del a
+        gc.collect()
+        assert len(cache) == 1  # view keeps the owner alive
+        del view
+        gc.collect()
+        assert len(cache) == 0
+
+    def test_fifo_bound_for_plain_arrays(self):
+        cache = ArrayRegistrationCache(maxsize=4)
+        keep = [np.ones(i + 1, np.float32) for i in range(8)]
+        for a in keep:
+            cache.register(a)
+        assert len(cache) == 4
+
+    def test_clear(self):
+        cache = ArrayRegistrationCache()
+        cache.register(np.ones(4))
+        cache.clear()
+        assert len(cache) == 0
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(
+        store_name="blk",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+    yield "blk"
+    await ts.shutdown("blk")
+
+
+async def test_forced_bulk_roundtrip(store):
+    x = np.random.rand(64, 64).astype(np.float32)
+    await ts.put("w", x, store_name=store)
+    np.testing.assert_array_equal(await ts.get("w", store_name=store), x)
+
+
+async def test_objects_and_tensors_mixed_batch(store):
+    await ts.put_batch(
+        {"t": np.arange(8.0), "o": {"cfg": True}, "t2": np.ones((3, 3))},
+        store_name=store,
+    )
+    out = await ts.get_batch({"t": None, "o": None, "t2": None}, store_name=store)
+    np.testing.assert_array_equal(out["t"], np.arange(8.0))
+    assert out["o"] == {"cfg": True}
+
+
+async def test_connection_promoted_and_reused(store):
+    client = ts.client(store)
+    await client.put("a", np.ones(4))
+    cache = client._ctx.get_cache(BulkClientCache)
+    assert len(cache.connections) == 1
+    conn = next(iter(cache.connections.values()))
+    await client.put("b", np.ones(4))
+    await client.get("a")
+    # Same connection object survived across requests.
+    assert next(iter(cache.connections.values())) is conn
+
+
+async def test_large_tensor_bulk(store):
+    x = np.random.rand(2048, 1024).astype(np.float32)  # 8 MB, > chunk
+    await ts.put("big", x, store_name=store)
+    out = await ts.get("big", store_name=store)
+    np.testing.assert_array_equal(out, x)
+
+
+async def test_concurrent_bulk_ops(store):
+    async def one(i):
+        x = np.full((256,), float(i), np.float32)
+        await ts.put(f"c/{i}", x, store_name=store)
+        out = await ts.get(f"c/{i}", store_name=store)
+        np.testing.assert_array_equal(out, x)
+
+    await asyncio.gather(*(one(i) for i in range(8)))
+
+
+async def test_failed_put_does_not_poison_cache(store):
+    client = ts.client(store)
+    await client.put("good", np.ones(4))  # promote a connection
+    # A put that fails server-side (type confusion) after bytes were sent.
+    with pytest.raises(ValueError, match="already stored"):
+        await client.put("good", {"now": "object"})
+    # The promoted connection still works for subsequent ops.
+    np.testing.assert_array_equal(await client.get("good"), np.ones(4))
+    await client.put("after", np.full(2, 5.0))
+    np.testing.assert_array_equal(await client.get("after"), np.full(2, 5.0))
+
+
+async def test_sharded_reshard_over_bulk(store):
+    jax = pytest.importorskip("jax")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    g = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    await ts.put(
+        "s", jax.device_put(g, NamedSharding(mesh, P("x", "y"))), store_name=store
+    )
+    like = jax.device_put(
+        np.zeros_like(g),
+        NamedSharding(Mesh(np.array(jax.devices()).reshape(2, 4), ("a", "b")), P("b", "a")),
+    )
+    out = await ts.get("s", like=like, store_name=store)
+    np.testing.assert_array_equal(np.asarray(out), g)
+
+
+async def test_inplace_bulk_get(store):
+    x = np.arange(12.0).reshape(3, 4)
+    await ts.put("x", x, store_name=store)
+    dest = np.zeros((3, 4))
+    out = await ts.get("x", like=dest, store_name=store)
+    assert out is dest
+    np.testing.assert_array_equal(dest, x)

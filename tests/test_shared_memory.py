@@ -1,0 +1,175 @@
+"""SHM transport tests: segment round-trips, descriptor-reuse handshake,
+staged-get ownership transfer, cache invalidation, mutable mode, cross-
+process zero-copy semantics (reference tests/test_shared_memory.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.config import StoreConfig
+from torchstore_tpu.transport import shared_memory as shm
+from torchstore_tpu.transport.buffers import TransportContext
+from torchstore_tpu.transport.shared_memory import (
+    ShmClientCache,
+    ShmDescriptor,
+    ShmSegment,
+    ShmServerCache,
+    SharedMemoryTransportBuffer,
+)
+from torchstore_tpu.transport.types import Request, TensorMeta
+
+pytestmark = pytest.mark.skipif(
+    not shm.is_available(), reason="/dev/shm not available"
+)
+
+
+class TestSegment:
+    def test_create_view_attach_roundtrip(self):
+        seg = ShmSegment.create(64)
+        meta = TensorMeta(shape=(4, 4), dtype="float32")
+        seg.view(meta)[:] = np.arange(16.0).reshape(4, 4)
+        other = ShmSegment.attach(seg.name, seg.size)
+        np.testing.assert_array_equal(
+            other.view(meta), np.arange(16.0).reshape(4, 4)
+        )
+        seg.unlink()
+        assert not os.path.exists(os.path.join(shm.SHM_DIR, seg.name))
+
+    def test_unlink_idempotent(self):
+        seg = ShmSegment.create(8)
+        seg.unlink()
+        seg.unlink()
+
+    def test_attach_missing_raises(self):
+        with pytest.raises(FileNotFoundError):
+            ShmSegment.attach("ts_shm_never_existed", 8)
+
+
+class TestServerCache:
+    def test_put_replaces_and_unlinks(self):
+        cache = ShmServerCache()
+        a = ShmSegment.create(16)
+        b = ShmSegment.create(16)
+        meta = TensorMeta(shape=(4,), dtype="float32")
+        cache.put("k", None, a, meta)
+        cache.put("k", None, b, meta)
+        assert not os.path.exists(os.path.join(shm.SHM_DIR, a.name))
+        assert os.path.exists(os.path.join(shm.SHM_DIR, b.name))
+        cache.delete_key("k")
+        assert not os.path.exists(os.path.join(shm.SHM_DIR, b.name))
+
+    def test_shard_coords_tracked_separately(self):
+        cache = ShmServerCache()
+        meta = TensorMeta(shape=(4,), dtype="float32")
+        s0, s1 = ShmSegment.create(16), ShmSegment.create(16)
+        cache.put("k", (0,), s0, meta)
+        cache.put("k", (1,), s1, meta)
+        assert cache.lookup("k", (0,))[0] is s0
+        assert len(cache.segments_for("k")) == 2
+        cache.clear()
+        assert not os.path.exists(os.path.join(shm.SHM_DIR, s0.name))
+
+
+class TestBufferUnit:
+    def test_pickle_strips_client_state(self):
+        import pickle
+
+        buf = SharedMemoryTransportBuffer(StoreConfig())
+        buf._client_segments[0] = "not-picklable-marker"
+        buf.descriptors[0] = ShmDescriptor("n", 8, TensorMeta((2,), "float32"))
+        b2 = pickle.loads(pickle.dumps(buf))
+        assert b2._client_segments == {} and b2.config is None
+        assert b2.descriptors[0].segment_name == "n"
+
+    def test_handshake_offers_reuse_only_on_meta_match(self):
+        ctx = TransportContext()
+        cache = ctx.get_cache(ShmServerCache)
+        seg = ShmSegment.create(16)
+        meta = TensorMeta((4,), "float32")
+        cache.put("k", None, seg, meta)
+        buf = SharedMemoryTransportBuffer()
+        req = Request.from_tensor("k", np.zeros(4, np.float32)).meta_only()
+        offered = buf.recv_handshake(ctx, [req], {}, "put")
+        assert offered[0].segment_name == seg.name
+        # Different shape -> no offer.
+        req2 = Request.from_tensor("k", np.zeros(8, np.float32)).meta_only()
+        assert buf.recv_handshake(ctx, [req2], {}, "put") == {}
+        cache.clear()
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(
+        store_name="shm",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    yield "shm"
+    await ts.shutdown("shm")
+
+
+async def test_forced_shm_roundtrip(store):
+    x = np.random.rand(128, 64).astype(np.float32)
+    await ts.put("w", x, store_name=store)
+    np.testing.assert_array_equal(await ts.get("w", store_name=store), x)
+
+
+async def test_overwrite_reuses_segment(store):
+    x = np.zeros((64, 64), np.float32)
+    await ts.put("w", x, store_name=store)
+    # Overwrite with same shape/dtype: handshake must offer the old segment.
+    y = np.random.rand(64, 64).astype(np.float32)
+    await ts.put("w", y, store_name=store)
+    np.testing.assert_array_equal(await ts.get("w", store_name=store), y)
+
+
+async def test_objects_ride_shm_buffer(store):
+    await ts.put("obj", {"a": [1, 2]}, store_name=store)
+    assert await ts.get("obj", store_name=store) == {"a": [1, 2]}
+
+
+async def test_slice_get_staged_segment_cleaned(store):
+    x = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    await ts.put("w", x, store_name=store)
+    before = set(os.listdir(shm.SHM_DIR))
+    want = ts.TensorSlice(
+        offsets=(2, 0), local_shape=(3, 8), global_shape=(8, 8),
+        coordinates=(), mesh_shape=(),
+    )
+    out = await ts.get("w", like=want, store_name=store)
+    np.testing.assert_array_equal(out, x[2:5])
+    after = set(os.listdir(shm.SHM_DIR))
+    # The staged segment for the slice was unlinked by the client.
+    leaked = {n for n in after - before if n.startswith("ts_shm_")}
+    assert not leaked, f"staged segments leaked: {leaked}"
+
+
+async def test_delete_unlinks_segments(store):
+    await ts.put("w", np.ones((32, 32), np.float32), store_name=store)
+    # Find volume-owned segments for this store.
+    await ts.get("w", store_name=store)
+    await ts.delete("w", store_name=store)
+    with pytest.raises(KeyError):
+        await ts.get("w", store_name=store)
+
+
+async def test_large_tensor_shm(store):
+    x = np.random.rand(1024, 1024).astype(np.float32)  # 4 MB
+    await ts.put("big", x, store_name=store)
+    out = await ts.get("big", store_name=store)
+    np.testing.assert_array_equal(out, x)
+
+
+async def test_shm_no_segment_leak_after_shutdown():
+    before = {n for n in os.listdir(shm.SHM_DIR) if n.startswith("ts_shm_")}
+    await ts.initialize(
+        store_name="shmleak",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    await ts.put("a", np.ones((16, 16), np.float32), store_name="shmleak")
+    await ts.put("b", np.ones((8,), np.float32), store_name="shmleak")
+    await ts.get("a", store_name="shmleak")
+    await ts.shutdown("shmleak")
+    after = {n for n in os.listdir(shm.SHM_DIR) if n.startswith("ts_shm_")}
+    assert after <= before, f"leaked: {after - before}"

@@ -1,0 +1,171 @@
+"""state_dict layer tests: flatten/unflatten fidelity, commit-marker
+protocol, dtype cast, in-place + resharded fetches, flax/optax round trips
+(reference tests/test_state_dict.py; oracle here = the dense source dict)."""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.state_dict_utils import (
+    NoMatchingPush,
+    cast_floating_tensors,
+    flatten_state_dict,
+    unflatten_state_dict,
+)
+
+jax = pytest.importorskip("jax")
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+class TestFlatten:
+    def test_nested_roundtrip(self):
+        sd = {
+            "model": {"layer1": {"w": np.ones((2, 2)), "b": np.zeros(2)}},
+            "step": 7,
+            "lists": [np.ones(1), {"deep": np.zeros(1)}],
+            "tup": (1, 2),
+        }
+        flat, mapping = flatten_state_dict(sd)
+        assert "model/layer1/w" in flat and "lists/1/deep" in flat
+        out = unflatten_state_dict(flat, mapping)
+        assert out["step"] == 7
+        assert isinstance(out["lists"], list) and isinstance(out["tup"], tuple)
+        np.testing.assert_array_equal(out["model"]["layer1"]["w"], np.ones((2, 2)))
+
+    def test_int_keys_preserved(self):
+        sd = {"layers": {0: np.ones(1), 1: np.zeros(1)}}
+        flat, mapping = flatten_state_dict(sd)
+        out = unflatten_state_dict(flat, mapping)
+        assert set(out["layers"].keys()) == {0, 1}
+
+    def test_namedtuple_roundtrip(self):
+        state = optax.ScaleByAdamState(
+            count=np.zeros((), np.int32), mu={"w": np.ones(2)}, nu={"w": np.ones(2)}
+        )
+        flat, mapping = flatten_state_dict({"opt": state})
+        out = unflatten_state_dict(flat, mapping)
+        assert isinstance(out["opt"], optax.ScaleByAdamState)
+        np.testing.assert_array_equal(out["opt"].mu["w"], np.ones(2))
+
+    def test_cast_floating_only(self):
+        flat = {"w": np.ones(2, np.float32), "step": np.array(3, np.int32), "s": "x"}
+        out = cast_floating_tensors(flat, np.float16)
+        assert out["w"].dtype == np.float16
+        assert out["step"].dtype == np.int32
+        assert out["s"] == "x"
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="sd")
+    yield "sd"
+    await ts.shutdown("sd")
+
+
+async def test_roundtrip_plain(store):
+    sd = {
+        "w1": np.random.rand(4, 4).astype(np.float32),
+        "meta": {"epoch": 3, "name": "run1"},
+        "nested": {"b": np.arange(5.0)},
+    }
+    await ts.put_state_dict("v0", sd, store_name=store)
+    out = await ts.get_state_dict("v0", store_name=store)
+    np.testing.assert_array_equal(out["w1"], sd["w1"])
+    assert out["meta"] == {"epoch": 3, "name": "run1"}
+    np.testing.assert_array_equal(out["nested"]["b"], np.arange(5.0))
+
+
+async def test_commit_marker_required(store):
+    # Entries without the MAPPING marker are invisible to get_state_dict.
+    await ts.put("v1/w", np.ones(2), store_name=store)
+    with pytest.raises(NoMatchingPush, match="no matching push"):
+        await ts.get_state_dict("v1", store_name=store)
+
+
+async def test_inplace_user_dict(store):
+    sd = {"a": np.arange(6.0).reshape(2, 3), "b": {"c": np.ones(4)}}
+    await ts.put_state_dict("v2", sd, store_name=store)
+    user = {"a": np.zeros((2, 3)), "b": {"c": np.zeros(4)}}
+    out = await ts.get_state_dict("v2", user_state_dict=user, store_name=store)
+    np.testing.assert_array_equal(out["a"], sd["a"])
+    # numpy targets are filled in place
+    np.testing.assert_array_equal(user["a"], sd["a"])
+
+
+async def test_structure_mismatch_strict(store):
+    await ts.put_state_dict("v3", {"a": np.ones(2)}, store_name=store)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        await ts.get_state_dict(
+            "v3", user_state_dict={"a": np.zeros(2), "extra": np.zeros(1)},
+            store_name=store,
+        )
+
+
+async def test_transfer_dtype_cast(store):
+    import ml_dtypes
+
+    sd = {"w": np.random.rand(8, 8).astype(np.float32), "step": np.array(1)}
+    await ts.put_state_dict("v4", sd, transfer_dtype=ml_dtypes.bfloat16, store_name=store)
+    out = await ts.get_state_dict("v4", store_name=store)
+    assert out["w"].dtype == ml_dtypes.bfloat16
+    assert out["step"].dtype == sd["step"].dtype
+    np.testing.assert_allclose(
+        out["w"].astype(np.float32), sd["w"], atol=1e-2
+    )
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(8)(x)
+
+
+async def test_flax_params_and_optax_state_roundtrip(store):
+    model = MLP()
+    params = model.init(jax.random.key(0), jnp.ones((1, 16)))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    await ts.put_state_dict("ckpt", {"params": params, "opt": opt_state}, store_name=store)
+    out = await ts.get_state_dict("ckpt", store_name=store)
+    # Model still runs with restored params.
+    restored = jax.tree.map(jnp.asarray, out["params"])
+    y0 = model.apply(params, jnp.ones((2, 16)))
+    y1 = model.apply(restored, jnp.ones((2, 16)))
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), rtol=1e-6)
+
+
+async def test_sharded_state_dict_reshard_on_get(store):
+    # The RL weight-sync core: trainer publishes 8-way sharded params,
+    # generator pulls them 2x4-sharded.
+    devs = np.array(jax.devices())
+    mesh_src = Mesh(devs.reshape(8), ("fsdp",))
+    mesh_dst = Mesh(devs.reshape(2, 4), ("dp", "tp"))
+    w = np.random.rand(16, 32).astype(np.float32)
+    b = np.random.rand(32).astype(np.float32)
+    sd = {
+        "w": jax.device_put(w, NamedSharding(mesh_src, P("fsdp", None))),
+        "b": jax.device_put(b, NamedSharding(mesh_src, P())),
+    }
+    await ts.put_state_dict("weights", sd, store_name=store)
+    user = {
+        "w": jax.device_put(np.zeros_like(w), NamedSharding(mesh_dst, P(None, "tp"))),
+        "b": jax.device_put(np.zeros_like(b), NamedSharding(mesh_dst, P())),
+    }
+    out = await ts.get_state_dict("weights", user_state_dict=user, store_name=store)
+    assert out["w"].sharding.spec == P(None, "tp")
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    np.testing.assert_array_equal(np.asarray(out["b"]), b)
+
+
+async def test_versioned_checkpoints_coexist(store):
+    await ts.put_state_dict("v0", {"w": np.zeros(2)}, store_name=store)
+    await ts.put_state_dict("v1", {"w": np.ones(2)}, store_name=store)
+    out0 = await ts.get_state_dict("v0", store_name=store)
+    out1 = await ts.get_state_dict("v1", store_name=store)
+    np.testing.assert_array_equal(out0["w"], np.zeros(2))
+    np.testing.assert_array_equal(out1["w"], np.ones(2))

@@ -10,9 +10,15 @@ import torchstore_tpu as ts
 from torchstore_tpu.runtime import Actor, endpoint, spawn_actors
 
 
-@pytest.fixture
-async def store():
-    await ts.initialize(store_name="t")
+@pytest.fixture(params=["auto", "rpc"])
+async def store(request):
+    # "auto" resolves to shm on a same-host volume once the SHM transport is
+    # available; the "rpc" row keeps the fallback rung covered (reference
+    # strategy x transport parameterization, tests/utils.py:33-69).
+    strategy = ts.SingletonStrategy(
+        default_transport_type=None if request.param == "auto" else request.param
+    )
+    await ts.initialize(store_name="t", strategy=strategy)
     yield "t"
     await ts.shutdown("t")
 

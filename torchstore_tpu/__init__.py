@@ -17,10 +17,12 @@ from torchstore_tpu.api import (
     exists,
     get,
     get_batch,
+    get_state_dict,
     initialize,
     keys,
     put,
     put_batch,
+    put_state_dict,
     reset_client,
     shutdown,
 )
@@ -59,10 +61,12 @@ __all__ = [
     "exists",
     "get",
     "get_batch",
+    "get_state_dict",
     "initialize",
     "keys",
     "put",
     "put_batch",
+    "put_state_dict",
     "reset_client",
     "shutdown",
 ]

@@ -166,6 +166,31 @@ async def exists(key: str, store_name: str = DEFAULT_STORE) -> bool:
     return await client(store_name).exists(key)
 
 
+async def put_state_dict(
+    key: str,
+    state_dict: Any,
+    transfer_dtype=None,
+    store_name: str = DEFAULT_STORE,
+) -> None:
+    from torchstore_tpu import state_dict_utils
+
+    await state_dict_utils.put_state_dict(
+        client(store_name), key, state_dict, transfer_dtype=transfer_dtype
+    )
+
+
+async def get_state_dict(
+    key: str,
+    user_state_dict: Any = None,
+    store_name: str = DEFAULT_STORE,
+) -> Any:
+    from torchstore_tpu import state_dict_utils
+
+    return await state_dict_utils.get_state_dict(
+        client(store_name), key, user_state_dict
+    )
+
+
 async def shutdown(store_name: str = DEFAULT_STORE) -> None:
     """Tear down a store. In the initializing process this resets + stops the
     volume/controller actors; elsewhere it only drops local caches
@@ -193,10 +218,12 @@ __all__ = [
     "exists",
     "get",
     "get_batch",
+    "get_state_dict",
     "initialize",
     "keys",
     "put",
     "put_batch",
+    "put_state_dict",
     "reset_client",
     "shutdown",
 ]

@@ -1,0 +1,262 @@
+"""state_dict sync layer: flatten / commit-marker / dtype-cast / unflatten.
+
+TPU-native equivalent of /root/reference/torchstore/state_dict_utils.py:27-275.
+Protocol (invariant 3, SURVEY §2.2): all tensor entries are put under
+``key/<flat_path>`` first, then ``key/MAPPING`` is written LAST as the commit
+marker — its presence implies a complete state dict; readers fetch it first
+and fail with "no matching push" when absent.
+
+Flattening is dependency-free (dict / list / tuple / NamedTuple recursion)
+so it handles flax param trees, optax optimizer states and plain nested
+dicts without importing jax; leaves may be jax.Arrays (sharded puts/gets go
+through the normal resharding pipeline), numpy arrays, or arbitrary objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu import sharding as shd
+from torchstore_tpu.logging import LatencyTracker, get_logger
+
+logger = get_logger("torchstore_tpu.state_dict")
+
+MAPPING_KEY = "MAPPING"
+_SEP = "/"
+
+
+class NoMatchingPush(KeyError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# flatten / unflatten
+# --------------------------------------------------------------------------
+
+
+def _is_leaf(value: Any) -> bool:
+    if isinstance(value, dict):
+        return False
+    if isinstance(value, (list, tuple)):
+        return False
+    return True
+
+
+def flatten_state_dict(sd: Any) -> tuple[dict[str, Any], dict]:
+    """Returns ({flat_path: leaf}, mapping). ``mapping`` is a picklable
+    template that records the container structure (incl. NamedTuple types by
+    import path) for exact reconstruction — the role DCP's
+    ``flatten_state_dict`` plays in the reference."""
+    flat: dict[str, Any] = {}
+
+    def rec(value: Any, path: list[str]) -> dict:
+        if isinstance(value, dict):
+            return {
+                "kind": "dict",
+                "items": {
+                    str(k): rec(v, path + [str(k)]) for k, v in value.items()
+                },
+                "key_types": {str(k): _key_type(k) for k in value},
+            }
+        if isinstance(value, (list, tuple)):
+            kind = "list" if isinstance(value, list) else "tuple"
+            entry: dict = {
+                "kind": kind,
+                "items": [rec(v, path + [str(i)]) for i, v in enumerate(value)],
+            }
+            if isinstance(value, tuple) and hasattr(value, "_fields"):
+                entry["kind"] = "namedtuple"
+                entry["cls"] = f"{type(value).__module__}:{type(value).__qualname__}"
+            return entry
+        flat_key = _SEP.join(path)
+        if flat_key in flat:
+            raise ValueError(f"duplicate flattened key {flat_key!r}")
+        flat[flat_key] = value
+        return {"kind": "leaf", "key": flat_key}
+
+    mapping = rec(sd, [])
+    return flat, mapping
+
+
+def _key_type(key: Any) -> str:
+    if isinstance(key, int):
+        return "int"
+    return "str"
+
+
+def unflatten_state_dict(flat: dict[str, Any], mapping: dict) -> Any:
+    def rec(entry: dict) -> Any:
+        kind = entry["kind"]
+        if kind == "leaf":
+            return flat[entry["key"]]
+        if kind == "dict":
+            key_types = entry.get("key_types", {})
+            return {
+                (int(k) if key_types.get(k) == "int" else k): rec(v)
+                for k, v in entry["items"].items()
+            }
+        children = [rec(v) for v in entry["items"]]
+        if kind == "list":
+            return children
+        if kind == "tuple":
+            return tuple(children)
+        if kind == "namedtuple":
+            cls = _resolve_class(entry["cls"])
+            if cls is None:
+                return tuple(children)
+            return cls(*children)
+        raise ValueError(f"corrupt mapping entry {entry!r}")
+
+    return rec(mapping)
+
+
+def _resolve_class(spec: str):
+    mod_name, _, qual = spec.partition(":")
+    try:
+        import importlib
+
+        obj = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj
+    except Exception:
+        logger.warning("cannot resolve NamedTuple class %s; using plain tuple", spec)
+        return None
+
+
+# --------------------------------------------------------------------------
+# dtype cast
+# --------------------------------------------------------------------------
+
+
+def _is_floating(value: Any) -> bool:
+    dtype = getattr(value, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return np.issubdtype(np.dtype(dtype), np.floating) or "bfloat16" in str(dtype)
+    except TypeError:
+        return "float" in str(dtype)
+
+
+def cast_floating_tensors(flat: dict[str, Any], transfer_dtype) -> dict[str, Any]:
+    """Cast floating leaves to ``transfer_dtype`` before transfer (reference
+    /root/reference/torchstore/state_dict_utils.py:177-189). jax.Arrays cast
+    on-device (one fused XLA op per leaf); numpy casts on host."""
+    out = {}
+    for key, value in flat.items():
+        if _is_floating(value):
+            out[key] = value.astype(transfer_dtype)
+        else:
+            out[key] = value
+    return out
+
+
+# --------------------------------------------------------------------------
+# put / get
+# --------------------------------------------------------------------------
+
+
+def _store_key(key: str, flat_key: str) -> str:
+    return f"{key}{_SEP}{flat_key}" if flat_key else key
+
+
+async def put_state_dict(
+    client,
+    key: str,
+    state_dict: Any,
+    transfer_dtype=None,
+) -> None:
+    tracker = LatencyTracker(f"put_state_dict[{key}]")
+    flat, mapping = flatten_state_dict(state_dict)
+    if MAPPING_KEY in flat:
+        raise ValueError(
+            f"{MAPPING_KEY!r} is a reserved top-level state-dict key (it is "
+            "the commit marker); rename that entry"
+        )
+    if transfer_dtype is not None:
+        flat = cast_floating_tensors(flat, transfer_dtype)
+    tracker.track_step("flatten")
+    await client.put_batch({_store_key(key, k): v for k, v in flat.items()})
+    nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
+    tracker.track_step("put_batch", nbytes)
+    # Commit marker LAST: its presence implies every entry above landed.
+    await client.put(_store_key(key, MAPPING_KEY), {"mapping": mapping})
+    tracker.track_step("commit_marker")
+    tracker.log_summary(level=20)  # INFO: weight-sync phases are user-facing
+
+
+async def get_state_dict(
+    client,
+    key: str,
+    user_state_dict: Any = None,
+) -> Any:
+    """Fetch a complete state dict. With ``user_state_dict``, its leaves act
+    as fetch targets (sharded jax.Arrays reshard on the fly; numpy arrays are
+    filled in place) and the stored mapping must match the user structure
+    exactly (strict=True parity,
+    /root/reference/torchstore/state_dict_utils.py:146-174)."""
+    tracker = LatencyTracker(f"get_state_dict[{key}]")
+    try:
+        marker = await client.get(_store_key(key, MAPPING_KEY))
+    except KeyError as exc:
+        raise NoMatchingPush(
+            f"no matching push for state dict key {key!r} (commit marker "
+            "absent: either never pushed or push still in flight)"
+        ) from exc
+    mapping = marker["mapping"]
+    tracker.track_step("mapping")
+
+    if user_state_dict is not None:
+        user_flat, user_mapping = flatten_state_dict(user_state_dict)
+        stored_keys = _leaf_keys(mapping)
+        if set(user_flat.keys()) != stored_keys:
+            missing = stored_keys - set(user_flat)
+            extra = set(user_flat) - stored_keys
+            raise ValueError(
+                f"state dict structure mismatch for {key!r}: "
+                f"missing in user dict: {sorted(missing)[:5]}, "
+                f"extra in user dict: {sorted(extra)[:5]}"
+            )
+        targets = {
+            _store_key(key, k): (v if _is_fetch_target(v) else None)
+            for k, v in user_flat.items()
+        }
+        fetched = await client.get_batch(targets)
+        flat = {k: fetched[_store_key(key, k)] for k in user_flat}
+        mapping = user_mapping
+    else:
+        leaf_keys = sorted(_leaf_keys(mapping))
+        fetched = await client.get_batch(
+            {_store_key(key, k): None for k in leaf_keys}
+        )
+        flat = {k: fetched[_store_key(key, k)] for k in leaf_keys}
+    nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
+    tracker.track_step("get_batch", nbytes)
+    result = unflatten_state_dict(flat, mapping)
+    tracker.track_step("unflatten")
+    tracker.log_summary(level=20)
+    return result
+
+
+def _leaf_keys(mapping: dict) -> set[str]:
+    out: set[str] = set()
+
+    def rec(entry: dict) -> None:
+        if entry["kind"] == "leaf":
+            out.add(entry["key"])
+        elif entry["kind"] == "dict":
+            for v in entry["items"].values():
+                rec(v)
+        else:
+            for v in entry["items"]:
+                rec(v)
+
+    rec(mapping)
+    return out
+
+
+def _is_fetch_target(value: Any) -> bool:
+    return isinstance(value, np.ndarray) or shd.is_jax_array(value)

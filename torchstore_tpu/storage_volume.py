@@ -25,7 +25,7 @@ from torchstore_tpu.logging import get_logger
 from torchstore_tpu.runtime import Actor, endpoint
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
-from torchstore_tpu.utils import get_hostname
+from torchstore_tpu.utils import get_hostname, maybe_await
 
 logger = get_logger("torchstore_tpu.storage_volume")
 
@@ -221,12 +221,14 @@ class StorageVolume(Actor):
         self, buffer: TransportBuffer, metas: list[Request], op: str
     ) -> Any:
         existing = self.store.extract_existing(metas) if op == "put" else {}
-        return buffer.recv_handshake(self.ctx, metas, existing, op)
+        return await maybe_await(buffer.recv_handshake(self.ctx, metas, existing, op))
 
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> None:
         existing = self.store.extract_existing(metas)
-        values = buffer.handle_put_request(self.ctx, metas, existing)
+        values = await maybe_await(
+            buffer.handle_put_request(self.ctx, metas, existing)
+        )
         self.store.store(metas, values)
 
     @endpoint
@@ -234,7 +236,7 @@ class StorageVolume(Actor):
         self, buffer: TransportBuffer, metas: list[Request]
     ) -> TransportBuffer:
         entries = [self.store.get_data(meta) for meta in metas]
-        buffer.handle_get_request(self.ctx, metas, entries)
+        await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
         return buffer
 
     @endpoint

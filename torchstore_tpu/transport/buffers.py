@@ -27,6 +27,7 @@ import numpy as np
 
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.transport.types import Request
+from torchstore_tpu.utils import maybe_await
 
 if TYPE_CHECKING:
     from torchstore_tpu.strategy import StorageVolumeRef
@@ -115,7 +116,9 @@ class TransportBuffer(ABC):
             await self._pre_get_hook(volume, requests)
             metas = [r.meta_only() for r in requests]
             remote = await volume.actor.get.call_one(self, metas)
-            results = self._handle_storage_volume_response(volume, remote, requests)
+            results = await maybe_await(
+                self._handle_storage_volume_response(volume, remote, requests)
+            )
             self._post_request_success(volume)
             return results
         finally:
@@ -163,7 +166,9 @@ class TransportBuffer(ABC):
     def recv_handshake(
         self, ctx: TransportContext, metas: list[Request], existing: dict, op: str
     ) -> Any:
-        """Server-side handshake step; returns a (picklable) reply."""
+        """Server-side handshake step; returns a (picklable) reply. May be a
+        coroutine (socket-backed transports await IO inside the volume's
+        event loop)."""
         return None
 
     @abstractmethod
@@ -171,12 +176,14 @@ class TransportBuffer(ABC):
         self, ctx: TransportContext, metas: list[Request], existing: dict[str, Any]
     ) -> dict[int, np.ndarray]:
         """Materialize incoming data server-side: returns {request_index:
-        host array} for the store to keep. ``existing`` maps request index ->
-        previously stored array for in-place reuse (invariant 6)."""
+        host array} for the store to keep (may be a coroutine). ``existing``
+        maps request index -> previously stored array for in-place reuse
+        (invariant 6)."""
 
     @abstractmethod
     def handle_get_request(
         self, ctx: TransportContext, metas: list[Request], entries: list[Any]
     ) -> None:
-        """Load outgoing data into this buffer server-side. ``entries`` are
-        the store's arrays/objects in request order."""
+        """Load outgoing data into this buffer server-side (may be a
+        coroutine). ``entries`` are the store's arrays/objects in request
+        order."""

@@ -1,0 +1,80 @@
+"""Registration caches for byte transports.
+
+Equivalent of /root/reference/torchstore/transport/torchcomms/cache.py:150-186
+(``RdmaMemoryCache``): buffers registered once per (data_ptr, nbytes) and
+auto-evicted when the owning array dies (weakref). In pure-Python mode
+registration just pins a memoryview; the native backend hooks here to pin
+pages / pre-register with the transfer engine.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from torchstore_tpu.transport.buffers import TransportCache
+
+
+class ArrayRegistration:
+    """Bookkeeping record for a registered buffer. Holds NO strong reference
+    to the array (a registration must not extend the buffer's lifetime —
+    eviction is the point); the native backend pins pages at the kernel
+    level here instead."""
+
+    def __init__(self, array: np.ndarray):
+        self.ptr = array.__array_interface__["data"][0]
+        self.nbytes = array.nbytes
+        self.native_handle: Optional[object] = None
+
+    def release(self) -> None:
+        self.native_handle = None
+
+
+class ArrayRegistrationCache(TransportCache):
+    """(data_ptr, nbytes) -> registration. Evicted when the array's memory
+    owner is garbage collected (weakref.finalize) for weakref-able owners
+    (ndarray subclasses, jax buffers); plain ndarrays fall back to FIFO
+    capacity eviction so the cache stays bounded."""
+
+    def __init__(self, maxsize: int = 1024) -> None:
+        self.maxsize = maxsize
+        self._entries: dict[tuple[int, int], ArrayRegistration] = {}
+        self._finalizers: dict[tuple[int, int], weakref.finalize] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def register(self, array: np.ndarray) -> ArrayRegistration:
+        key = (array.__array_interface__["data"][0], array.nbytes)
+        entry = self._entries.get(key)
+        if entry is not None:
+            return entry
+        entry = ArrayRegistration(array)
+        while len(self._entries) >= self.maxsize:
+            self._evict(next(iter(self._entries)))
+        self._entries[key] = entry
+        owner = array.base if array.base is not None else array
+        try:
+            self._finalizers[key] = weakref.finalize(owner, self._evict, key)
+        except TypeError:
+            pass  # plain ndarrays aren't weakref-able; FIFO bound applies
+        return entry
+
+    def lookup(self, array: np.ndarray) -> Optional[ArrayRegistration]:
+        return self._entries.get(
+            (array.__array_interface__["data"][0], array.nbytes)
+        )
+
+    def _evict(self, key: tuple[int, int]) -> None:
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            entry.release()
+        fin = self._finalizers.pop(key, None)
+        if fin is not None:
+            fin.detach()
+
+    def clear(self) -> None:
+        for key in list(self._entries):
+            self._evict(key)

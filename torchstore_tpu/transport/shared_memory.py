@@ -1,0 +1,392 @@
+"""Shared-memory transport: the same-host fast path.
+
+TPU-native equivalent of /root/reference/torchstore/transport/shared_memory.py
+(:41-523). The storage volume owns tensor storage living in POSIX shared
+memory (``/dev/shm`` files + mmap — same substrate as ``shm_open``, and the
+ABI the native C++ backend accelerates); clients copy directly into/out of
+those segments, so a put is exactly one memcpy client-side and zero copies
+server-side (the volume's stored array IS a view of the segment).
+
+PUT:  handshake returns existing descriptors for reuse -> client allocates or
+      attaches + copies -> volume attaches and stores the view.
+GET:  volume returns a descriptor — zero-copy when the entry already lives in
+      one of its segments, else a staged copy whose ownership transfers to
+      the client (client unlinks after landing it).
+
+Caches: ``ShmServerCache`` (volume side: key -> owned segment),
+``ShmClientCache`` (client side: segment name -> attachment), both invalidated
+per-key on delete (reference cache semantics, shared_memory.py:56-131).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.config import StoreConfig
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.transport.buffers import (
+    TransportBuffer,
+    TransportCache,
+    TransportContext,
+)
+from torchstore_tpu.transport.types import Request, TensorMeta
+
+logger = get_logger("torchstore_tpu.transport.shm")
+
+SHM_DIR = "/dev/shm"
+
+
+def is_available() -> bool:
+    return os.path.isdir(SHM_DIR) and os.access(SHM_DIR, os.W_OK)
+
+
+# --------------------------------------------------------------------------
+# segments
+# --------------------------------------------------------------------------
+
+
+class ShmSegment:
+    """A named shared-memory segment (file in /dev/shm + mmap)."""
+
+    def __init__(self, name: str, size: int, mm: mmap.mmap, owner: bool):
+        self.name = name
+        self.size = size
+        self.mmap = mm
+        self.owner = owner
+        self._closed = False
+
+    @staticmethod
+    def _path(name: str) -> str:
+        return os.path.join(SHM_DIR, name)
+
+    @classmethod
+    def create(cls, size: int, name: Optional[str] = None) -> "ShmSegment":
+        name = name or f"ts_shm_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        fd = os.open(cls._path(name), os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        return cls(name, size, mm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str, size: int) -> "ShmSegment":
+        fd = os.open(cls._path(name), os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        return cls(name, size, mm, owner=False)
+
+    def view(self, meta: TensorMeta, offset: int = 0) -> np.ndarray:
+        return np.frombuffer(
+            self.mmap, dtype=meta.np_dtype, count=int(np.prod(meta.shape) or 1), offset=offset
+        ).reshape(meta.shape)
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self._path(self.name))
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        # The mmap stays open while numpy views reference it; python frees the
+        # mapping at GC. Unlink only removes the name.
+        self._closed = True
+
+
+@dataclass
+class ShmDescriptor:
+    """Picklable handle to a tensor inside a segment."""
+
+    segment_name: str
+    segment_size: int
+    meta: TensorMeta
+    offset: int = 0
+    # 'volume' -> long-lived, volume owns; 'client' -> staged for one get,
+    # the client unlinks after landing the data.
+    owner: str = "volume"
+
+
+# --------------------------------------------------------------------------
+# caches
+# --------------------------------------------------------------------------
+
+
+STAGED_TTL_S = 120.0
+
+
+class ShmServerCache(TransportCache):
+    """Volume-side: (key, shard coords|None) -> (segment, meta) for segments
+    that back stored tensors/shards, plus staged-get segments awaiting client
+    pickup (normally unlinked by the client; reaped here after a TTL so a
+    crashed client cannot fill /dev/shm)."""
+
+    def __init__(self) -> None:
+        self.by_key: dict[str, dict[Optional[tuple], tuple[ShmSegment, TensorMeta]]] = {}
+        self.staged: dict[str, tuple[ShmSegment, float]] = {}
+
+    def track_staged(self, seg: ShmSegment) -> None:
+        import time
+
+        now = time.monotonic()
+        self.staged[seg.name] = (seg, now)
+        for name, (old, ts) in list(self.staged.items()):
+            if now - ts > STAGED_TTL_S:
+                old.unlink()  # no-op if the client already unlinked it
+                del self.staged[name]
+
+    def lookup(self, key: str, coords: Optional[tuple]):
+        return self.by_key.get(key, {}).get(coords)
+
+    def put(
+        self, key: str, coords: Optional[tuple], seg: ShmSegment, meta: TensorMeta
+    ) -> None:
+        entries = self.by_key.setdefault(key, {})
+        prev = entries.get(coords)
+        if prev is not None and prev[0].name != seg.name:
+            prev[0].unlink()
+        entries[coords] = (seg, meta)
+
+    def segments_for(self, key: str):
+        return [seg for seg, _ in self.by_key.get(key, {}).values()]
+
+    def delete_key(self, key: str) -> None:
+        for seg, _ in self.by_key.pop(key, {}).values():
+            seg.unlink()
+
+    def clear(self) -> None:
+        for entries in self.by_key.values():
+            for seg, _ in entries.values():
+                seg.unlink()
+        self.by_key.clear()
+        for seg, _ in self.staged.values():
+            seg.unlink()
+        self.staged.clear()
+
+
+class ShmClientCache(TransportCache):
+    """Client-side: segment name -> attachment, so repeat transfers skip the
+    open+mmap syscalls. Keyed back to store keys for invalidation."""
+
+    def __init__(self) -> None:
+        self.segments: dict[str, ShmSegment] = {}
+        self.key_to_segments: dict[str, set[str]] = {}
+
+    def attach(self, desc: ShmDescriptor, key: str) -> ShmSegment:
+        seg = self.segments.get(desc.segment_name)
+        if seg is None:
+            seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
+            self.segments[desc.segment_name] = seg
+        self.key_to_segments.setdefault(key, set()).add(desc.segment_name)
+        return seg
+
+    def delete_key(self, key: str) -> None:
+        for name in self.key_to_segments.pop(key, ()):  # drop attachments
+            seg = self.segments.pop(name, None)
+            if seg is not None:
+                seg.close()
+
+    def clear(self) -> None:
+        for seg in self.segments.values():
+            seg.close()
+        self.segments.clear()
+        self.key_to_segments.clear()
+
+
+# --------------------------------------------------------------------------
+# the transport buffer
+# --------------------------------------------------------------------------
+
+
+class SharedMemoryTransportBuffer(TransportBuffer):
+    requires_handshake = True
+    supports_inplace = True
+    requires_contiguous_inplace = False
+    supports_batch_puts = True
+    supports_batch_gets = True
+
+    def __init__(self, config: Optional[StoreConfig] = None):
+        self.config = config
+        self.descriptors: dict[int, ShmDescriptor] = {}
+        self.objects: dict[int, Any] = {}
+        # Client-only staging state (never pickled).
+        self._client_segments: dict[int, ShmSegment] = {}
+        self._reuse: dict[int, ShmDescriptor] = {}
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_client_segments"] = {}
+        state["_reuse"] = {}
+        state["config"] = None
+        return state
+
+    # ---- client: put -----------------------------------------------------
+
+    def _post_handshake(self, volume, requests, reply, op) -> None:
+        if op != "put":
+            return
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        offered: dict[int, ShmDescriptor] = reply or {}
+        for idx, req in enumerate(requests):
+            if req.is_object:
+                self.objects[idx] = req.objects
+                continue
+            arr = np.ascontiguousarray(req.tensor_val)
+            meta = TensorMeta.of(arr)
+            desc = offered.get(idx)
+            if desc is not None and desc.meta == meta:
+                seg = cache.attach(desc, req.key)
+            else:
+                seg = ShmSegment.create(max(arr.nbytes, 1))
+                desc = ShmDescriptor(seg.name, seg.size, meta)
+                cache.segments[seg.name] = seg
+                cache.key_to_segments.setdefault(req.key, set()).add(seg.name)
+            # THE hot memcpy: client array -> shared segment.
+            np.copyto(seg.view(meta, desc.offset), arr)
+            self.descriptors[idx] = desc
+            self._client_segments[idx] = seg
+
+    # ---- server: put -----------------------------------------------------
+
+    def recv_handshake(
+        self, ctx: TransportContext, metas: list[Request], existing: dict, op: str
+    ) -> Any:
+        if op != "put":
+            return None
+        cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        offered: dict[int, ShmDescriptor] = {}
+        for idx, meta in enumerate(metas):
+            if meta.tensor_meta is None:
+                continue
+            coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
+            entry = cache.lookup(meta.key, coords)
+            if entry is None:
+                continue
+            seg, stored_meta = entry
+            if stored_meta == meta.tensor_meta:
+                # Same shape/dtype: offer the existing segment for in-place
+                # reuse (descriptor-reuse handshake, reference
+                # shared_memory.py:340-360).
+                offered[idx] = ShmDescriptor(seg.name, seg.size, stored_meta)
+        return offered
+
+    def handle_put_request(
+        self, ctx: TransportContext, metas: list[Request], existing: dict
+    ) -> dict[int, Any]:
+        cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        out: dict[int, Any] = {}
+        for idx, obj in self.objects.items():
+            out[idx] = obj
+        for idx, desc in self.descriptors.items():
+            meta = metas[idx]
+            coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
+            current = cache.lookup(meta.key, coords)
+            if current is not None and current[0].name == desc.segment_name:
+                seg = current[0]
+            else:
+                seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
+                seg.owner = True  # volume takes ownership of the lifetime
+            cache.put(meta.key, coords, seg, desc.meta)
+            out[idx] = seg.view(desc.meta, desc.offset)
+        return out
+
+    # ---- server: get -----------------------------------------------------
+
+    def handle_get_request(
+        self, ctx: TransportContext, metas: list[Request], entries: list[Any]
+    ) -> None:
+        cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        for idx, (meta, entry) in enumerate(zip(metas, entries)):
+            if meta.is_object:
+                self.objects[idx] = entry
+                continue
+            entry = np.asarray(entry)
+            served = next(
+                (
+                    seg
+                    for seg in cache.segments_for(meta.key)
+                    if _aliases_whole(entry, seg)
+                ),
+                None,
+            )
+            if served is not None:
+                self.descriptors[idx] = ShmDescriptor(
+                    served.name, served.size, TensorMeta.of(entry)
+                )
+                continue
+            contig = np.ascontiguousarray(entry)
+            seg = ShmSegment.create(max(contig.nbytes, 1))
+            tmeta = TensorMeta.of(contig)
+            np.copyto(seg.view(tmeta), contig)
+            # Ownership transfers to the client, which unlinks after landing;
+            # the server reaps it after a TTL if the client never does.
+            cache.track_staged(seg)
+            self.descriptors[idx] = ShmDescriptor(
+                seg.name, seg.size, tmeta, owner="client"
+            )
+
+    # ---- client: get -----------------------------------------------------
+
+    def _handle_storage_volume_response(
+        self, volume, remote: "SharedMemoryTransportBuffer", requests
+    ) -> list[Any]:
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        mutable = bool(self.config and self.config.mutable_shm)
+        results: list[Any] = []
+        for idx, req in enumerate(requests):
+            if req.is_object or idx in remote.objects:
+                results.append(remote.objects[idx])
+                continue
+            desc = remote.descriptors[idx]
+            if desc.owner == "client":
+                seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
+                src = seg.view(desc.meta, desc.offset)
+                landed = self._land(req, src)
+                seg.unlink()
+                results.append(landed)
+            else:
+                seg = cache.attach(desc, req.key)
+                src = seg.view(desc.meta, desc.offset)
+                if mutable and req.destination_view is None:
+                    # Zero-copy read: caller sees the live segment. Mutations
+                    # by later puts become visible — opt-in via config.
+                    results.append(src)
+                else:
+                    results.append(self._land(req, src))
+        return results
+
+    @staticmethod
+    def _land(req: Request, src: np.ndarray) -> np.ndarray:
+        if req.destination_view is not None:
+            np.copyto(req.destination_view, src)
+            return req.destination_view
+        return src.copy()
+
+    def drop(self) -> None:
+        self.descriptors = {}
+        self.objects = {}
+        self._client_segments = {}
+        self._reuse = {}
+
+
+def _aliases_whole(entry: np.ndarray, seg: ShmSegment) -> bool:
+    """True when ``entry`` is exactly the array stored over ``seg``'s buffer
+    start (whole-tensor fetch of a SHM-backed entry -> zero-copy get)."""
+    if not entry.flags["C_CONTIGUOUS"]:
+        return False
+    try:
+        seg_start = np.frombuffer(seg.mmap, dtype=np.uint8, count=1).__array_interface__[
+            "data"
+        ][0]
+    except ValueError:
+        return False
+    start = entry.__array_interface__["data"][0]
+    return start == seg_start and entry.nbytes <= seg.size

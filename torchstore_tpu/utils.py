@@ -188,6 +188,16 @@ def assemble_tensor(
     return out, bbox.offsets
 
 
+async def maybe_await(value):
+    """Await ``value`` when it is a coroutine, else return it — lets
+    transport hooks be either sync or async."""
+    import inspect
+
+    if inspect.iscoroutine(value):
+        return await value
+    return value
+
+
 def get_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
