@@ -1,0 +1,84 @@
+"""Headline benchmark: full state_dict weight-sync throughput.
+
+Measures the BASELINE.md north-star flow — a trainer publishing a model-scale
+state dict and a consumer pulling all of it back (put_state_dict +
+get_state_dict round trip) through real storage-volume processes over the
+same-host SHM transport. This is the store's data plane end to end: flatten,
+commit-marker protocol, metadata RPCs, segment handshakes, and the hot
+memcpys.
+
+Host-resident arrays are used deliberately: on this image the TPU chip is
+reached through a tunnel whose device->host path measures ~0.01 GB/s, which
+would benchmark the tunnel, not the framework. The store's TPU coupling
+(NamedSharding put/get) is exercised by the test suite and dryrun_multichip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is value / REFERENCE_GBPS where REFERENCE_GBPS approximates
+the reference's CUDA+RDMA same-host weight-sync path (no number is published
+by the reference — see BASELINE.md; 10 GB/s is the proxy the north star's
+">=80% of the CUDA+RDMA path" is scored against).
+"""
+
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_GBPS = 10.0
+
+N_TENSORS = 32
+TENSOR_MB = 32  # 32 x 32MB = 1 GiB per direction
+ITERS = 3
+
+
+async def run() -> dict:
+    import torchstore_tpu as ts
+
+    await ts.initialize(
+        store_name="bench",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    n_elem = TENSOR_MB * 1024 * 1024 // 4
+    sd = {
+        "layers": {
+            str(i): np.random.rand(n_elem).astype(np.float32)
+            for i in range(N_TENSORS)
+        }
+    }
+    total_bytes = sum(v.nbytes for v in sd["layers"].values())
+    user = {
+        "layers": {str(i): np.zeros(n_elem, np.float32) for i in range(N_TENSORS)}
+    }
+
+    best = 0.0
+    for it in range(ITERS):
+        t0 = time.perf_counter()
+        await ts.put_state_dict("bench/sd", sd, store_name="bench")
+        t1 = time.perf_counter()
+        out = await ts.get_state_dict(
+            "bench/sd", user_state_dict=user, store_name="bench"
+        )
+        t2 = time.perf_counter()
+        gbps = 2 * total_bytes / 1e9 / (t2 - t0)
+        best = max(best, gbps)
+        print(
+            f"# iter {it}: put {total_bytes/1e9/(t1-t0):.2f} GB/s, "
+            f"get {total_bytes/1e9/(t2-t1):.2f} GB/s, round-trip {gbps:.2f} GB/s",
+            file=sys.stderr,
+        )
+    for i in range(N_TENSORS):
+        np.testing.assert_array_equal(out["layers"][str(i)], sd["layers"][str(i)])
+    await ts.shutdown("bench")
+    return {
+        "metric": "state_dict_sync_round_trip",
+        "value": round(best, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(best / REFERENCE_GBPS, 3),
+    }
+
+
+if __name__ == "__main__":
+    result = asyncio.run(run())
+    print(json.dumps(result))

@@ -1,0 +1,3 @@
+from torchstore_tpu.models.llama import Llama, LlamaConfig, init_params
+
+__all__ = ["Llama", "LlamaConfig", "init_params"]
