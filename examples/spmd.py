@@ -1,0 +1,62 @@
+"""SPMD demo: N ranks bootstrap one store collectively and exchange tensors
+(equivalent of the reference's example/torchstore_spmd.py). This launcher
+spawns the ranks itself; under a real multi-host launcher just run the
+worker body on every rank. Run:
+
+    python examples/spmd.py
+"""
+
+import asyncio
+import multiprocessing as mp
+import os
+
+import numpy as np
+
+WORLD = 4
+
+
+def worker(rank: int, port: int) -> None:
+    os.environ.update(
+        {
+            "RANK": str(rank),
+            "LOCAL_RANK": str(rank),
+            "WORLD_SIZE": str(WORLD),
+            "LOCAL_WORLD_SIZE": str(WORLD),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        }
+    )
+    asyncio.run(body(rank))
+
+
+async def body(rank: int) -> None:
+    import torchstore_tpu as ts
+    from torchstore_tpu.spmd import _spmd_sessions
+
+    await ts.initialize_spmd(store_name="spmd_demo")
+    await ts.put(f"{rank}_tensor", np.full(4, float(rank)), store_name="spmd_demo")
+    session = _spmd_sessions["spmd_demo"]
+    await session.client.barrier("puts", WORLD)
+    other = (rank + 1) % WORLD
+    fetched = await ts.get(f"{other}_tensor", store_name="spmd_demo")
+    print(f"Rank=[{rank}] fetched {fetched} from rank {other}")
+    await session.client.barrier("reads", WORLD)
+    await ts.shutdown("spmd_demo")
+
+
+def main() -> None:
+    from torchstore_tpu.utils import get_free_port
+
+    port = get_free_port()
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=worker, args=(r, port)) for r in range(WORLD)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(120)
+    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    print("SPMD example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""RL weight sync: a learner actor trains a flax Llama and publishes weights;
+generator actors pull them (resharded) and run inference.
+
+Equivalent of the reference's example/torchstore_rl.py, TPU-first: the
+learner trains fsdp-sharded on its mesh, generators pull tensor-parallel on
+theirs — the store reshards automatically. Run:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/torchstore_rl.py
+"""
+
+import asyncio
+
+import numpy as np
+
+import torchstore_tpu as ts
+from torchstore_tpu.runtime import Actor, endpoint, spawn_actors
+
+STORE = "rl_example"
+STEPS = 3
+
+
+def _cpu_jax():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+class Learner(Actor):
+    def __init__(self):
+        jax = _cpu_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from torchstore_tpu import parallel
+        from torchstore_tpu.models.llama import Llama, LlamaConfig
+
+        self.jax = jax
+        cfg = LlamaConfig.tiny()
+        self.model = Llama(cfg)
+        self.mesh = parallel.make_mesh({"fsdp": 4})
+        boxed = self.model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+        self.params = parallel.unbox(parallel.shard_params(boxed, self.mesh))
+        self.optimizer = optax.adamw(1e-3)
+        self.opt_state = self.optimizer.init(self.params)
+        self.step_fn = parallel.make_train_step(self.model, self.optimizer)
+        self.vocab = cfg.vocab_size
+
+    @endpoint
+    async def train_and_publish(self, version: int) -> float:
+        jax = self.jax
+        tokens = jax.random.randint(
+            jax.random.key(version), (4, 16), 0, self.vocab
+        )
+        self.params, self.opt_state, loss = self.step_fn(
+            self.params, self.opt_state, tokens
+        )
+        await ts.put_state_dict(f"policy/v{version}", {"params": self.params},
+                                store_name=STORE)
+        return float(loss)
+
+
+class Generator(Actor):
+    def __init__(self):
+        jax = _cpu_jax()
+        import jax.numpy as jnp
+
+        from torchstore_tpu import parallel
+        from torchstore_tpu.models.llama import Llama, LlamaConfig
+
+        self.jax = jax
+        cfg = LlamaConfig.tiny()
+        self.model = Llama(cfg)
+        self.mesh = parallel.make_mesh({"tp": 8})
+        boxed = self.model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+        self.template = parallel.unbox(parallel.shard_params(boxed, self.mesh))
+
+    @endpoint
+    async def sync_and_generate(self, version: int) -> list[int]:
+        import jax.numpy as jnp
+
+        synced = await ts.get_state_dict(
+            f"policy/v{version}", user_state_dict={"params": self.template},
+            store_name=STORE,
+        )
+        self.template = synced["params"]
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        logits = self.model.apply(self.template, prompt)
+        return [int(t) for t in jnp.argmax(logits[0, -2:], axis=-1)]
+
+
+async def main():
+    await ts.initialize(store_name=STORE)
+    learner = await spawn_actors(1, Learner, "learner")
+    generators = await spawn_actors(2, Generator, "generator")
+    try:
+        for version in range(STEPS):
+            loss = await learner.train_and_publish.call_one(version)
+            outs = await generators.sync_and_generate.call(version)
+            print(f"step {version}: loss={loss:.4f} generator_tokens={outs}")
+            assert outs[0] == outs[1], "generators must agree after sync"
+    finally:
+        await generators.stop()
+        await learner.stop()
+        await ts.shutdown(STORE)
+    print("RL weight-sync example OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

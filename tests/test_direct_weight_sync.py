@@ -1,0 +1,197 @@
+"""Direct (one-hop) weight sync tests: exact match, resharding overlap,
+replica dedup, refresh semantics, transfer_dtype, TCP + SHM paths, and the
+store-integrated handle flow (reference tests/test_direct_weight_sync.py)."""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.direct_weight_sync import (
+    DirectWeightSyncDest,
+    DirectWeightSyncSource,
+)
+
+jax = pytest.importorskip("jax")
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def make_sharded(arr, mesh_shape, names, spec):
+    mesh = Mesh(np.array(jax.devices()[: int(np.prod(mesh_shape))]).reshape(mesh_shape), names)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+@pytest.fixture
+async def pair():
+    source = DirectWeightSyncSource()
+    dest = DirectWeightSyncDest()
+    yield source, dest
+    await dest.close()
+    await source.close()
+
+
+async def test_exact_match_numpy(pair):
+    source, dest = pair
+    w = np.random.rand(16, 8).astype(np.float32)
+    handles = await source.register({"w": w})
+    out = await dest.pull(handles, {"w": np.zeros_like(w)})
+    np.testing.assert_array_equal(out["w"], w)
+
+
+async def test_tcp_path(tmp_path):
+    source = DirectWeightSyncSource(use_shm=False)
+    dest = DirectWeightSyncDest()
+    try:
+        w = np.random.rand(64).astype(np.float32)
+        handles = await source.register({"w": w})
+        assert handles["w"][0].shm_name is None
+        out = await dest.pull(handles, {"w": np.zeros_like(w)})
+        np.testing.assert_array_equal(out["w"], w)
+    finally:
+        await dest.close()
+        await source.close()
+
+
+@pytest.mark.parametrize("src_spec,dst_spec", [
+    (P("x"), P(None, "x")),
+    (P("x", None), P(None, "x")),
+    (P(None, "x"), P("x", None)),
+])
+async def test_resharding_overlap(pair, src_spec, dst_spec):
+    source, dest = pair
+    w = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    src = make_sharded(w, (4,), ("x",), src_spec)
+    handles = await source.register({"w": src})
+    target = make_sharded(np.zeros_like(w), (4,), ("x",), dst_spec)
+    out = await dest.pull(handles, {"w": target})
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    assert out["w"].sharding.spec == dst_spec
+
+
+async def test_replicated_shards_deduped(pair):
+    source, dest = pair
+    w = np.random.rand(8, 4).astype(np.float32)
+    # dp-replicated source: 2x2 mesh, sharded on one axis only -> each
+    # region has 2 replicas across coords.
+    src = make_sharded(w, (2, 2), ("dp", "x"), P("x"))
+    handles = await source.register({"w": src})
+    assert len(handles["w"]) == 4  # all shards registered
+    out = await dest.pull(handles, {"w": np.zeros_like(w)})
+    np.testing.assert_array_equal(out["w"], w)
+    # The cached plan covers each region once despite replicas.
+    regions = [(op.region.offsets, op.region.shape) for op in dest._plan]
+    assert len(regions) == len(set(regions)) == 2
+
+
+async def test_refresh_re_stages(pair):
+    source, dest = pair
+    w = np.zeros(8, np.float32)
+    handles = await source.register({"w": w})
+    out = await dest.pull(handles, {"w": np.zeros_like(w)})
+    np.testing.assert_array_equal(out["w"], np.zeros(8))
+    # Training step produced new values.
+    source.update_sources({"w": np.full(8, 7.0, np.float32)})
+    await source.refresh()
+    out = await dest.pull(handles, {"w": np.zeros_like(w)})
+    np.testing.assert_array_equal(out["w"], np.full(8, 7.0))
+
+
+async def test_transfer_dtype_cast(pair):
+    import ml_dtypes
+
+    source, dest = pair
+    w = np.random.rand(32).astype(np.float32)
+    handles = await source.register({"w": w}, transfer_dtype=ml_dtypes.bfloat16)
+    assert handles["w"][0].meta.dtype == "bfloat16"
+    out = await dest.pull(
+        handles, {"w": np.zeros(32, ml_dtypes.bfloat16)}
+    )
+    np.testing.assert_allclose(
+        out["w"].astype(np.float32), w, atol=1e-2
+    )
+
+
+async def test_non_tensor_leaves_skipped(pair):
+    source, dest = pair
+    handles = await source.register({"w": np.ones(4), "cfg": {"lr": 1e-3}})
+    assert "cfg/lr" not in handles
+    out = await dest.pull(handles, {"w": np.zeros(4), "cfg": {"lr": 0.0}})
+    np.testing.assert_array_equal(out["w"], np.ones(4))
+    assert out["cfg"]["lr"] == 0.0  # untouched by the direct path
+
+
+async def test_dead_buffer_raises(pair):
+    source, dest = pair
+    source_b = DirectWeightSyncSource(use_shm=False)
+    handles = await source_b.register({"w": np.ones(4)})
+    await source_b.close()
+    # Re-register on a fresh source -> old buffer ids are gone server-side.
+    source_c = DirectWeightSyncSource(use_shm=False)
+    await source_c.register({"other": np.ones(2)})
+    try:
+        bad = {
+            "w": [
+                type(h)(**{**h.__dict__, "port": source_c.server.port, "buffer_id": 999})
+                for h in handles["w"]
+            ]
+        }
+        with pytest.raises(KeyError, match="no longer has buffer"):
+            await dest.pull(bad, {"w": np.zeros(4)})
+    finally:
+        await source_c.close()
+
+
+async def test_store_integrated_direct_sync():
+    await ts.initialize(store_name="dws")
+    try:
+        w = np.random.rand(32, 16).astype(np.float32)
+        sd = {"model": {"w": w}}
+        await ts.put_state_dict("direct/v0", sd, direct=True, store_name="dws")
+        out = await ts.get_state_dict(
+            "direct/v0",
+            user_state_dict={"model": {"w": np.zeros_like(w)}},
+            direct=True,
+            store_name="dws",
+        )
+        np.testing.assert_array_equal(out["model"]["w"], w)
+        # Second publish refreshes the same registered buffers.
+        sd2 = {"model": {"w": w * 2}}
+        await ts.put_state_dict("direct/v0", sd2, direct=True, store_name="dws")
+        out2 = await ts.get_state_dict(
+            "direct/v0",
+            user_state_dict={"model": {"w": np.zeros_like(w)}},
+            direct=True,
+            store_name="dws",
+        )
+        np.testing.assert_array_equal(out2["model"]["w"], w * 2)
+    finally:
+        await ts.shutdown("dws")
+
+
+async def test_store_direct_missing_push():
+    await ts.initialize(store_name="dws2")
+    try:
+        from torchstore_tpu.state_dict_utils import NoMatchingPush
+
+        with pytest.raises(NoMatchingPush):
+            await ts.get_state_dict(
+                "never", user_state_dict={"w": np.zeros(2)}, direct=True,
+                store_name="dws2",
+            )
+    finally:
+        await ts.shutdown("dws2")
+
+
+async def test_sharded_source_to_sharded_dest_e2e():
+    # The flagship flow: trainer fsdp-8 -> generator tp-2x4, one hop.
+    await ts.initialize(store_name="dws3")
+    try:
+        w = np.random.rand(64, 32).astype(np.float32)
+        src = make_sharded(w, (8,), ("fsdp",), P("fsdp", None))
+        await ts.put_state_dict("m", {"w": src}, direct=True, store_name="dws3")
+        target = make_sharded(np.zeros_like(w), (2, 4), ("dp", "tp"), P(None, "tp"))
+        out = await ts.get_state_dict(
+            "m", user_state_dict={"w": target}, direct=True, store_name="dws3"
+        )
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    finally:
+        await ts.shutdown("dws3")

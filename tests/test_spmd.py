@@ -1,0 +1,164 @@
+"""SPMD bootstrap tests: env parsing/validation + full multi-process
+lifecycle (rendezvous, per-host volume spawn, handle broadcast, cross-rank
+put/get, two-phase shutdown) — reference tests/test_spmd.py mechanisms."""
+
+import asyncio
+import json
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.spmd import SPMDEnv
+from torchstore_tpu.utils import get_free_port
+
+
+class TestSPMDEnv:
+    def _env(self, **kw):
+        base = {
+            "RANK": "1",
+            "WORLD_SIZE": "4",
+            "LOCAL_RANK": "1",
+            "LOCAL_WORLD_SIZE": "4",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": "29500",
+        }
+        base.update(kw)
+        return base
+
+    def test_parse(self, monkeypatch):
+        for k, v in self._env().items():
+            monkeypatch.setenv(k, v)
+        env = SPMDEnv.from_env()
+        assert env.rank == 1 and env.world_size == 4
+        assert env.num_hosts == 1 and env.host_rank == 0
+
+    def test_multi_host_derivation(self, monkeypatch):
+        for k, v in self._env(
+            RANK="5", WORLD_SIZE="8", LOCAL_RANK="1", LOCAL_WORLD_SIZE="4"
+        ).items():
+            monkeypatch.setenv(k, v)
+        env = SPMDEnv.from_env()
+        assert env.num_hosts == 2 and env.host_rank == 1
+
+    def test_missing_vars(self, monkeypatch):
+        monkeypatch.delenv("RANK", raising=False)
+        monkeypatch.delenv("MASTER_ADDR", raising=False)
+        with pytest.raises(RuntimeError, match="missing"):
+            SPMDEnv.from_env()
+
+    def test_rank_out_of_range(self, monkeypatch):
+        for k, v in self._env(RANK="4").items():
+            monkeypatch.setenv(k, v)
+        with pytest.raises(ValueError, match="out of range"):
+            SPMDEnv.from_env()
+
+    def test_world_not_divisible(self, monkeypatch):
+        for k, v in self._env(WORLD_SIZE="6", LOCAL_WORLD_SIZE="4", RANK="0", LOCAL_RANK="0").items():
+            monkeypatch.setenv(k, v)
+        with pytest.raises(ValueError, match="divisible"):
+            SPMDEnv.from_env()
+
+
+async def test_rendezvous_kv():
+    from torchstore_tpu.runtime.rendezvous import RendezvousClient, RendezvousServer
+
+    server = RendezvousServer()
+    port = await server.start("127.0.0.1", 0)
+    a = RendezvousClient("127.0.0.1", port)
+    b = RendezvousClient("127.0.0.1", port)
+    await a.connect()
+    await b.connect()
+    try:
+        # Blocking get resolves once the other client sets.
+        get_task = asyncio.ensure_future(b.get("k"))
+        await asyncio.sleep(0.05)
+        assert not get_task.done()
+        await a.set("k", {"v": 1})
+        assert await get_task == {"v": 1}
+        assert await a.add("c", 2) == 2
+        assert await b.add("c", 3) == 5
+        await a.wait_counter("c", 5)
+        assert await b.check("k") and not await b.check("nope")
+        await asyncio.gather(a.barrier("x", 2), b.barrier("x", 2))
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()
+
+
+def _spmd_worker(rank: int, world: int, port: int, result_dir: str) -> None:
+    env = {
+        "RANK": str(rank),
+        "LOCAL_RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "LOCAL_WORLD_SIZE": str(world),
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+    }
+    os.environ.update(env)
+    result = {"rank": rank, "ok": False}
+    try:
+        asyncio.run(_spmd_scenario(rank, world, result))
+    except Exception as exc:  # noqa: BLE001 - reported to parent
+        import traceback
+
+        result["error"] = f"{exc!r}\n{traceback.format_exc()}"
+    with open(os.path.join(result_dir, f"rank_{rank}.json"), "w") as f:
+        json.dump(result, f)
+
+
+async def _spmd_scenario(rank: int, world: int, result: dict) -> None:
+    import torchstore_tpu as ts
+
+    await ts.initialize_spmd(store_name="spmdtest")
+    # Each rank publishes its shard of a global array + a rank tensor.
+    g = np.arange(float(world * 4), dtype=np.float32).reshape(world, 4)
+    sl = ts.TensorSlice(
+        offsets=(rank, 0), local_shape=(1, 4), global_shape=(world, 4),
+        coordinates=(rank,), mesh_shape=(world,),
+    )
+    await ts.put("g", ts.Shard(g[rank : rank + 1], sl), store_name="spmdtest")
+    await ts.put(f"r{rank}", np.full(2, float(rank)), store_name="spmdtest")
+    # Barrier via the session's rendezvous, then cross-rank reads.
+    from torchstore_tpu.spmd import _spmd_sessions
+
+    session = _spmd_sessions["spmdtest"]
+    await session.client.barrier("puts_done", world)
+    other = (rank + 1) % world
+    peer = await ts.get(f"r{other}", store_name="spmdtest")
+    assert peer[0] == float(other), peer
+    full = await ts.get("g", store_name="spmdtest")
+    np.testing.assert_array_equal(full, g)
+    await session.client.barrier("reads_done", world)
+    await ts.shutdown("spmdtest")
+    result["ok"] = True
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_spmd_full_lifecycle(tmp_path, world):
+    port = get_free_port()
+    ctx = mp.get_context("spawn")
+    # Not daemonic: workers spawn their own volume actor children.
+    procs = [
+        ctx.Process(
+            target=_spmd_worker, args=(r, world, port, str(tmp_path)), daemon=False
+        )
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        for p in procs:
+            p.join(timeout=180)
+            assert not p.is_alive(), "spmd worker hung"
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    for r in range(world):
+        path = tmp_path / f"rank_{r}.json"
+        assert path.exists(), f"rank {r} produced no result"
+        result = json.loads(path.read_text())
+        assert result["ok"], f"rank {r} failed: {result.get('error')}"

@@ -19,6 +19,7 @@ from torchstore_tpu.api import (
     get_batch,
     get_state_dict,
     initialize,
+    initialize_spmd,
     keys,
     put,
     put_batch,
@@ -63,6 +64,7 @@ __all__ = [
     "get_batch",
     "get_state_dict",
     "initialize",
+    "initialize_spmd",
     "keys",
     "put",
     "put_batch",

@@ -100,6 +100,18 @@ async def initialize(
     return controller
 
 
+async def initialize_spmd(
+    strategy: Optional[StoreStrategy] = None,
+    store_name: str = DEFAULT_STORE,
+    config: Optional[StoreConfig] = None,
+) -> None:
+    """Collective bootstrap from torchrun-style env — call on every rank
+    (/root/reference/torchstore/spmd.py:246-362)."""
+    from torchstore_tpu import spmd as spmd_mod
+
+    await spmd_mod.initialize(strategy=strategy, store_name=store_name, config=config)
+
+
 def client(store_name: str = DEFAULT_STORE) -> LocalClient:
     """The per-process cached LocalClient
     (/root/reference/torchstore/api.py:141-153)."""
@@ -170,34 +182,53 @@ async def put_state_dict(
     key: str,
     state_dict: Any,
     transfer_dtype=None,
+    direct: bool = False,
+    rank: int = 0,
+    num_ranks: int = 1,
     store_name: str = DEFAULT_STORE,
 ) -> None:
     from torchstore_tpu import state_dict_utils
 
     await state_dict_utils.put_state_dict(
-        client(store_name), key, state_dict, transfer_dtype=transfer_dtype
+        client(store_name),
+        key,
+        state_dict,
+        transfer_dtype=transfer_dtype,
+        direct=direct,
+        rank=rank,
+        num_ranks=num_ranks,
     )
 
 
 async def get_state_dict(
     key: str,
     user_state_dict: Any = None,
+    direct: bool = False,
     store_name: str = DEFAULT_STORE,
 ) -> Any:
     from torchstore_tpu import state_dict_utils
 
     return await state_dict_utils.get_state_dict(
-        client(store_name), key, user_state_dict
+        client(store_name), key, user_state_dict, direct=direct
     )
 
 
 async def shutdown(store_name: str = DEFAULT_STORE) -> None:
-    """Tear down a store. In the initializing process this resets + stops the
-    volume/controller actors; elsewhere it only drops local caches
+    """Tear down a store. Routes to the SPMD session when one owns this
+    store; otherwise, in the initializing process this resets + stops the
+    volume/controller actors, elsewhere it only drops local caches
     (/root/reference/torchstore/api.py:100-109)."""
+    from torchstore_tpu import spmd as spmd_mod
+
+    if await spmd_mod.shutdown(store_name):
+        return
     handle = _stores.pop(store_name, None)
     if handle is None:
         return
+    if handle.client is not None:
+        from torchstore_tpu import state_dict_utils
+
+        await state_dict_utils.close_direct_caches(handle.client)
     if handle.owner:
         try:
             await handle.controller.teardown.call_one()
@@ -220,6 +251,7 @@ __all__ = [
     "get_batch",
     "get_state_dict",
     "initialize",
+    "initialize_spmd",
     "keys",
     "put",
     "put_batch",

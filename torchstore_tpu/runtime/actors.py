@@ -439,7 +439,14 @@ async def _child_async(pipe, actor_cls, name: str, args: tuple, kwargs: dict) ->
         server.register(name, actor)
         bind_host = os.environ.get("TORCHSTORE_TPU_BIND_HOST", "127.0.0.1")
         port = await server.start(bind_host)
-        pipe.send(("ready", bind_host, port))
+        # Refs must carry a REACHABLE address: a 0.0.0.0 bind (multi-host
+        # DCN) advertises the real hostname/IP instead.
+        advertise = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST")
+        if advertise is None:
+            advertise = (
+                socket.gethostname() if bind_host in ("0.0.0.0", "::") else bind_host
+            )
+        pipe.send(("ready", advertise, port))
     except BaseException:
         pipe.send(("error", traceback.format_exc(), None))
         raise

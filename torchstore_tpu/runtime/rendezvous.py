@@ -1,0 +1,219 @@
+"""Rendezvous KV service: the TCPStore replacement.
+
+The reference bootstraps SPMD worlds over torch's C++ ``TCPStore``
+(/root/reference/torchstore/spmd.py:310-326, transport/gloo.py:62-92). This
+is the native-runtime equivalent: a tiny asyncio KV server with blocking
+gets and atomic counters — enough for handle broadcast, barriers, and
+connection bootstrap. Rank 0 hosts it on MASTER_ADDR:MASTER_PORT; every rank
+connects as a client.
+
+Ops: SET key value | GET key (blocks until set) | ADD key delta (atomic,
+returns new value) | CHECK key (non-blocking presence).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.runtime.serialization import (
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    read_message,
+    write_message,
+)
+
+logger = get_logger("torchstore_tpu.rendezvous")
+
+DEFAULT_TIMEOUT_S = 300.0
+
+
+class RendezvousServer:
+    def __init__(self) -> None:
+        self.kv: dict[str, Any] = {}
+        self.counters: dict[str, int] = {}
+        self._changed = asyncio.Condition()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def _handle(self, reader, writer) -> None:
+        self._writers.add(writer)
+        write_lock = asyncio.Lock()
+        tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                _, msg = await read_message(reader)
+                task = asyncio.ensure_future(
+                    self._dispatch(msg, writer, write_lock)
+                )
+                tasks.add(task)
+                task.add_done_callback(tasks.discard)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            for task in tasks:
+                task.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: dict, writer, write_lock) -> None:
+        op = msg["op"]
+        try:
+            if op == "set":
+                async with self._changed:
+                    self.kv[msg["key"]] = msg["value"]
+                    self._changed.notify_all()
+                value = True
+            elif op == "get":
+                async with self._changed:
+                    while msg["key"] not in self.kv:
+                        await self._changed.wait()
+                    value = self.kv[msg["key"]]
+            elif op == "add":
+                async with self._changed:
+                    self.counters[msg["key"]] = (
+                        self.counters.get(msg["key"], 0) + msg["delta"]
+                    )
+                    value = self.counters[msg["key"]]
+                    self._changed.notify_all()
+            elif op == "wait_counter":
+                async with self._changed:
+                    while self.counters.get(msg["key"], 0) < msg["target"]:
+                        await self._changed.wait()
+                    value = self.counters[msg["key"]]
+            elif op == "check":
+                value = msg["key"] in self.kv
+            else:
+                raise ValueError(f"unknown rendezvous op {op!r}")
+            ok = True
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            value, ok = repr(exc), False
+        async with write_lock:
+            await write_message(
+                writer, KIND_RESPONSE, {"id": msg["id"], "value": value, "ok": ok}
+            )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for writer in list(self._writers):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+            self._server = None
+
+
+class RendezvousClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader = None
+        self._writer = None
+        self._lock = asyncio.Lock()
+        self._next_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+
+    async def connect(self, timeout: float = DEFAULT_TIMEOUT_S) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                break
+            except (ConnectionError, OSError):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.2)  # rank 0 may not be up yet
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                _, msg = await read_message(self._reader)
+                fut = self._pending.pop(msg["id"], None)
+                if fut is not None and not fut.done():
+                    if msg.get("ok", True):
+                        fut.set_result(msg["value"])
+                    else:
+                        fut.set_exception(RuntimeError(msg["value"]))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as exc:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"rendezvous lost: {exc!r}"))
+            self._pending.clear()
+        except asyncio.CancelledError:
+            raise
+
+    async def _request(self, op: str, timeout: float = DEFAULT_TIMEOUT_S, **body):
+        req_id = self._next_id
+        self._next_id += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._lock:
+            await write_message(
+                self._writer, KIND_REQUEST, {"op": op, "id": req_id, **body}
+            )
+        return await asyncio.wait_for(fut, timeout=timeout)
+
+    async def set(self, key: str, value: Any) -> None:
+        await self._request("set", key=key, value=value)
+
+    async def get(self, key: str, timeout: float = DEFAULT_TIMEOUT_S) -> Any:
+        return await self._request("get", timeout=timeout, key=key)
+
+    async def add(self, key: str, delta: int = 1) -> int:
+        return await self._request("add", key=key, delta=delta)
+
+    async def wait_counter(
+        self, key: str, target: int, timeout: float = DEFAULT_TIMEOUT_S
+    ) -> int:
+        return await self._request(
+            "wait_counter", timeout=timeout, key=key, target=target
+        )
+
+    async def check(self, key: str) -> bool:
+        return await self._request("check", key=key)
+
+    async def barrier(
+        self, name: str, world_size: int, timeout: float = DEFAULT_TIMEOUT_S
+    ) -> None:
+        await self.add(f"barrier/{name}", 1)
+        await self.wait_counter(f"barrier/{name}", world_size, timeout=timeout)
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+
+
+def pickle_handle(obj: Any) -> bytes:
+    return pickle.dumps(obj)
+
+
+def unpickle_handle(raw: bytes) -> Any:
+    return pickle.loads(raw)

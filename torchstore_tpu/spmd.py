@@ -1,0 +1,271 @@
+"""SPMD bootstrap: bring up one store across a torchrun-style world.
+
+TPU-native equivalent of /root/reference/torchstore/spmd.py:43-365. Every
+rank reads the standard launcher env (RANK/WORLD_SIZE/LOCAL_RANK/
+LOCAL_WORLD_SIZE/MASTER_ADDR/MASTER_PORT — the same vars a jax multi-host
+pod launcher exports), rendezvouses on a KV service hosted by rank 0, and:
+
+- each host's LOCAL_RANK-0 spawns that host's storage volumes (per-rank for
+  LocalRankStrategy, one for HostStrategy) and publishes their refs — this
+  generalizes the reference's rank-0-spawns-everything to multi-host without
+  a remote-spawn dependency;
+- global rank 0 collects all volume refs, spawns the controller, runs
+  ``Controller.init``, and broadcasts the pickled controller handle;
+- every rank builds its LocalClient from the broadcast handle.
+
+Shutdown is two-phase with a status broadcast so non-primary ranks learn of
+primary failure (reference _SPMDSession, spmd.py:106-203).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from torchstore_tpu import api
+from torchstore_tpu.config import StoreConfig, default_config
+from torchstore_tpu.controller import Controller
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.runtime import ActorMesh, get_or_spawn_singleton, spawn_actors, stop_singleton
+from torchstore_tpu.runtime.rendezvous import (
+    RendezvousClient,
+    RendezvousServer,
+    pickle_handle,
+    unpickle_handle,
+)
+from torchstore_tpu.storage_volume import StorageVolume
+from torchstore_tpu.strategy import HostStrategy, LocalRankStrategy, StoreStrategy
+
+logger = get_logger("torchstore_tpu.spmd")
+
+
+@dataclass(frozen=True)
+class SPMDEnv:
+    rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    master_addr: str
+    master_port: int
+
+    @classmethod
+    def from_env(cls) -> "SPMDEnv":
+        missing = [
+            name
+            for name in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT")
+            if name not in os.environ
+        ]
+        if missing:
+            raise RuntimeError(
+                f"SPMD env incomplete: missing {missing}; launch via a "
+                "torchrun-style launcher or export them manually"
+            )
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["WORLD_SIZE"])
+        local_world = int(os.environ.get("LOCAL_WORLD_SIZE", world))
+        local_rank = int(os.environ.get("LOCAL_RANK", rank % max(local_world, 1)))
+        if not (0 <= rank < world):
+            raise ValueError(f"RANK {rank} out of range for WORLD_SIZE {world}")
+        if not (0 <= local_rank < local_world):
+            raise ValueError(
+                f"LOCAL_RANK {local_rank} out of range for "
+                f"LOCAL_WORLD_SIZE {local_world}"
+            )
+        if world % local_world != 0:
+            raise ValueError(
+                f"WORLD_SIZE {world} not divisible by LOCAL_WORLD_SIZE {local_world}"
+            )
+        return cls(
+            rank=rank,
+            world_size=world,
+            local_rank=local_rank,
+            local_world_size=local_world,
+            master_addr=os.environ["MASTER_ADDR"],
+            master_port=int(os.environ["MASTER_PORT"]),
+        )
+
+    @property
+    def num_hosts(self) -> int:
+        return self.world_size // self.local_world_size
+
+    @property
+    def host_rank(self) -> int:
+        return self.rank // self.local_world_size
+
+
+class _SPMDSession:
+    def __init__(
+        self,
+        env: SPMDEnv,
+        store_name: str,
+        server: Optional[RendezvousServer],
+        client: RendezvousClient,
+        volume_mesh: Optional[ActorMesh],
+        controller_is_local: bool,
+    ):
+        self.env = env
+        self.store_name = store_name
+        self.server = server
+        self.client = client
+        self.volume_mesh = volume_mesh
+        self.controller_is_local = controller_is_local
+
+    async def shutdown(self) -> None:
+        """Two-phase: everyone signals done; rank 0 tears down and broadcasts
+        status; the rest read it (so a primary failure is observable)."""
+        env = self.env
+        key = f"spmd/{self.store_name}/shutdown"
+        try:
+            await self.client.add(f"{key}/ready", 1)
+            if env.rank == 0:
+                await self.client.wait_counter(f"{key}/ready", env.world_size)
+                status = "ok"
+                try:
+                    handle = api._stores.get(self.store_name)
+                    if handle is not None:
+                        await handle.controller.teardown.call_one()
+                except Exception as exc:
+                    status = f"controller teardown failed: {exc!r}"
+                await self.client.set(f"{key}/status", status)
+            status = await self.client.get(f"{key}/status")
+            if status != "ok":
+                logger.warning("spmd shutdown status: %s", status)
+            # Final ack: rank 0 must not stop the rendezvous server until
+            # every rank has read the status (a force-closed connection would
+            # turn a clean shutdown into ConnectionError on slow ranks).
+            await self.client.add(f"{key}/acked", 1)
+            if env.rank == 0:
+                await self.client.wait_counter(f"{key}/acked", env.world_size)
+        finally:
+            handle = api._stores.get(self.store_name)
+            if handle is not None and handle.client is not None:
+                from torchstore_tpu import state_dict_utils
+
+                await state_dict_utils.close_direct_caches(handle.client)
+            if self.volume_mesh is not None:
+                await self.volume_mesh.stop()
+            if self.controller_is_local:
+                await stop_singleton(f"ts_{self.store_name}_controller")
+            await self.client.close()
+            if self.server is not None:
+                await self.server.stop()
+            api._stores.pop(self.store_name, None)
+            os.environ.pop(api.ENV_STORE_PREFIX + self.store_name, None)
+
+
+_spmd_sessions: dict[str, _SPMDSession] = {}
+
+
+async def initialize(
+    strategy: Optional[StoreStrategy] = None,
+    store_name: str = api.DEFAULT_STORE,
+    config: Optional[StoreConfig] = None,
+) -> None:
+    """Collective store bootstrap — call from every rank of the world."""
+    env = SPMDEnv.from_env()
+    config = config or default_config()
+    if strategy is None:
+        strategy = LocalRankStrategy()
+    if not isinstance(strategy, (LocalRankStrategy, HostStrategy)):
+        raise ValueError(
+            "SPMD initialization supports LocalRankStrategy and HostStrategy "
+            f"only (got {type(strategy).__name__})"
+        )
+    if store_name in _spmd_sessions:
+        raise RuntimeError(f"SPMD store {store_name!r} already initialized")
+
+    # --- rendezvous -------------------------------------------------------
+    server = None
+    if env.rank == 0:
+        server = RendezvousServer()
+        # Bind all interfaces unconditionally: launchers often export
+        # MASTER_ADDR=$(hostname) even single-host, and LOCAL_WORLD_SIZE may
+        # be absent, making host-count detection unreliable.
+        await server.start("0.0.0.0", env.master_port)
+    client = RendezvousClient(env.master_addr, env.master_port)
+    await client.connect()
+    ns = f"spmd/{store_name}"
+
+    multi_host = env.num_hosts > 1
+    # --- per-host volume spawn -------------------------------------------
+    volume_mesh: Optional[ActorMesh] = None
+    if env.local_rank == 0:
+        if isinstance(strategy, LocalRankStrategy):
+            num_local = env.local_world_size
+            base_rank = env.host_rank * env.local_world_size
+
+            def env_fn(i: int) -> dict[str, str]:
+                extra = {
+                    "RANK": str(base_rank + i),
+                    "LOCAL_RANK": str(i),
+                    "WORLD_SIZE": str(env.world_size),
+                    "LOCAL_WORLD_SIZE": str(env.local_world_size),
+                }
+                if multi_host:
+                    extra["TORCHSTORE_TPU_BIND_HOST"] = "0.0.0.0"
+                return extra
+
+        else:  # HostStrategy: one volume per host
+            num_local = 1
+
+            def env_fn(i: int) -> dict[str, str]:
+                extra = {}
+                if multi_host:
+                    extra["TORCHSTORE_TPU_BIND_HOST"] = "0.0.0.0"
+                return extra
+
+        volume_mesh = await spawn_actors(
+            num_local,
+            StorageVolume,
+            f"ts_{store_name}_volume_h{env.host_rank}",
+            strategy,
+            env_fn=env_fn,
+        )
+        await client.set(
+            f"{ns}/volumes/{env.host_rank}", pickle_handle(volume_mesh.refs)
+        )
+
+    # --- controller on rank 0 --------------------------------------------
+    if env.rank == 0:
+        all_refs = []
+        for host in range(env.num_hosts):
+            raw = await client.get(f"{ns}/volumes/{host}")
+            all_refs.extend(unpickle_handle(raw))
+        controller = await get_or_spawn_singleton(
+            f"ts_{store_name}_controller", Controller
+        )
+        await controller.init.call_one(strategy, all_refs)
+        await client.set(f"{ns}/controller", pickle_handle(controller))
+    raw = await client.get(f"{ns}/controller")
+    controller = unpickle_handle(raw)
+
+    api._publish_handle(store_name, controller)
+    api._stores[store_name] = api._StoreHandle(
+        controller=controller,
+        volume_mesh=volume_mesh,
+        client=None,
+        config=config,
+        owner=False,  # teardown is the SPMD session's job, not api.shutdown's
+    )
+    _spmd_sessions[store_name] = _SPMDSession(
+        env=env,
+        store_name=store_name,
+        server=server,
+        client=client,
+        volume_mesh=volume_mesh,
+        controller_is_local=(env.rank == 0),
+    )
+    await client.barrier(f"{ns}/ready", env.world_size)
+
+
+async def shutdown(store_name: str = api.DEFAULT_STORE) -> bool:
+    """Collective shutdown; returns False when no SPMD session exists (the
+    caller falls back to plain api.shutdown — reference routing,
+    /root/reference/torchstore/api.py:100-109)."""
+    session = _spmd_sessions.pop(store_name, None)
+    if session is None:
+        return False
+    await session.shutdown()
+    return True

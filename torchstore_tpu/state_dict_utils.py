@@ -14,6 +14,7 @@ through the normal resharding pipeline), numpy arrays, or arbitrary objects.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Optional
 
 import numpy as np
@@ -163,12 +164,112 @@ def _store_key(key: str, flat_key: str) -> str:
     return f"{key}{_SEP}{flat_key}" if flat_key else key
 
 
+class _DirectSyncCache:
+    """Per-client registry of direct-sync sources/dests keyed by state-dict
+    key (the reference's _DirectRDMACache,
+    /root/reference/torchstore/state_dict_utils.py:27-45)."""
+
+    def __init__(self) -> None:
+        self.sources: dict[str, Any] = {}
+        self.dests: dict[str, tuple[Any, dict]] = {}
+
+    async def close(self) -> None:
+        for source in self.sources.values():
+            await source.close()
+        for dest, _ in self.dests.values():
+            await dest.close()
+        self.sources.clear()
+        self.dests.clear()
+
+
+# Weakly keyed by the client object: a GC'd client cannot hand its cache to
+# an unrelated new client via id() reuse.
+_direct_caches: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _direct_cache(client) -> _DirectSyncCache:
+    cache = _direct_caches.get(client)
+    if cache is None:
+        cache = _DirectSyncCache()
+        _direct_caches[client] = cache
+    return cache
+
+
+async def close_direct_caches(client) -> None:
+    """Release SHM segments / peer-server sockets held for this client's
+    direct-sync sessions (called from shutdown paths)."""
+    cache = _direct_caches.pop(client, None)
+    if cache is not None:
+        await cache.close()
+
+
+async def _put_state_dict_direct(
+    client, key: str, state_dict: Any, transfer_dtype, rank: int, num_ranks: int
+) -> None:
+    from torchstore_tpu.direct_weight_sync import DirectWeightSyncSource
+
+    cache = _direct_cache(client)
+    source = cache.sources.get(key)
+    if source is None:
+        source = DirectWeightSyncSource()
+        handles = await source.register(state_dict, rank, transfer_dtype)
+        cache.sources[key] = source
+        await client.put(f"{key}{_SEP}rank_{rank}", {"handles": handles})
+        if rank == 0:
+            # num_ranks is the direct-mode commit marker: written by rank 0,
+            # readers fetch it first (reference :241-247).
+            await client.put(f"{key}{_SEP}num_ranks", num_ranks)
+    else:
+        source.update_sources(state_dict)
+        await source.refresh()
+
+
+async def _get_state_dict_direct(client, key: str, user_state_dict: Any) -> Any:
+    from torchstore_tpu.direct_weight_sync import DirectWeightSyncDest
+
+    if user_state_dict is None:
+        raise ValueError("direct get_state_dict requires user_state_dict targets")
+    cache = _direct_cache(client)
+    entry = cache.dests.get(key)
+    if entry is None:
+        try:
+            num_ranks = await client.get(f"{key}{_SEP}num_ranks")
+        except KeyError as exc:
+            raise NoMatchingPush(
+                f"no matching direct push for state dict key {key!r}"
+            ) from exc
+        all_handles: dict[str, list] = {}
+        for rank in range(num_ranks):
+            try:
+                published = await client.get(f"{key}{_SEP}rank_{rank}")
+            except KeyError as exc:
+                # num_ranks (written by rank 0) can land before other ranks
+                # publish their handles; keep the retry contract intact.
+                raise NoMatchingPush(
+                    f"direct push for {key!r} incomplete: rank {rank} has not "
+                    "published handles yet"
+                ) from exc
+            for flat_key, handle_list in published["handles"].items():
+                all_handles.setdefault(flat_key, []).extend(handle_list)
+        entry = (DirectWeightSyncDest(), all_handles)
+        cache.dests[key] = entry
+    dest, all_handles = entry
+    return await dest.pull(all_handles, user_state_dict)
+
+
 async def put_state_dict(
     client,
     key: str,
     state_dict: Any,
     transfer_dtype=None,
+    direct: bool = False,
+    rank: int = 0,
+    num_ranks: int = 1,
 ) -> None:
+    if direct:
+        return await _put_state_dict_direct(
+            client, key, state_dict, transfer_dtype, rank, num_ranks
+        )
     tracker = LatencyTracker(f"put_state_dict[{key}]")
     flat, mapping = flatten_state_dict(state_dict)
     if MAPPING_KEY in flat:
@@ -192,12 +293,15 @@ async def get_state_dict(
     client,
     key: str,
     user_state_dict: Any = None,
+    direct: bool = False,
 ) -> Any:
     """Fetch a complete state dict. With ``user_state_dict``, its leaves act
     as fetch targets (sharded jax.Arrays reshard on the fly; numpy arrays are
     filled in place) and the stored mapping must match the user structure
     exactly (strict=True parity,
     /root/reference/torchstore/state_dict_utils.py:146-174)."""
+    if direct:
+        return await _get_state_dict_direct(client, key, user_state_dict)
     tracker = LatencyTracker(f"get_state_dict[{key}]")
     try:
         marker = await client.get(_store_key(key, MAPPING_KEY))
