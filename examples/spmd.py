@@ -31,16 +31,14 @@ def worker(rank: int, port: int) -> None:
 
 async def body(rank: int) -> None:
     import torchstore_tpu as ts
-    from torchstore_tpu.spmd import _spmd_sessions
 
     await ts.initialize_spmd(store_name="spmd_demo")
     await ts.put(f"{rank}_tensor", np.full(4, float(rank)), store_name="spmd_demo")
-    session = _spmd_sessions["spmd_demo"]
-    await session.client.barrier("puts", WORLD)
+    await ts.barrier("puts", store_name="spmd_demo")
     other = (rank + 1) % WORLD
     fetched = await ts.get(f"{other}_tensor", store_name="spmd_demo")
     print(f"Rank=[{rank}] fetched {fetched} from rank {other}")
-    await session.client.barrier("reads", WORLD)
+    await ts.barrier("reads", store_name="spmd_demo")
     await ts.shutdown("spmd_demo")
 
 

@@ -1,0 +1,155 @@
+// torchstore_tpu native data path.
+//
+// The reference's hot transfer loops live in native dependencies (Monarch's
+// Rust RDMA engine, torch's C++ SHM, Gloo — SURVEY §2.3). This library is
+// the TPU build's equivalent for the host-side data plane: multi-threaded
+// memcpy for SHM/staging copies (the measured bottleneck of the pure-Python
+// path), POSIX shared-memory helpers, and GIL-free file-descriptor bulk IO.
+// Bound via ctypes (no pybind11 in this image).
+//
+// Build: make -C native   ->  native/libtsnative.so
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr size_t kMinPerThread = 4u << 20;  // 4 MiB per thread minimum
+
+void copy_range(char* dst, const char* src, size_t n) {
+  std::memcpy(dst, src, n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Multi-threaded memcpy. nthreads <= 0 -> auto (hardware_concurrency capped
+// so we never oversubscribe for small copies).
+void ts_parallel_memcpy(void* dst, const void* src, uint64_t n, int nthreads) {
+  if (n == 0) return;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  size_t want = nthreads > 0 ? static_cast<size_t>(nthreads)
+                             : static_cast<size_t>(hw);
+  size_t by_size = n / kMinPerThread;
+  size_t threads = std::min(want, std::max<size_t>(1, by_size));
+  threads = std::min<size_t>(threads, 16);
+  if (threads <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  size_t chunk = n / threads;
+  char* d = static_cast<char*>(dst);
+  const char* s = static_cast<const char*>(src);
+  for (size_t i = 0; i + 1 < threads; ++i) {
+    pool.emplace_back(copy_range, d + i * chunk, s + i * chunk, chunk);
+  }
+  copy_range(d + (threads - 1) * chunk, s + (threads - 1) * chunk,
+             n - (threads - 1) * chunk);
+  for (auto& t : pool) t.join();
+}
+
+// Strided 2D copy: rows of row_bytes from src (pitch src_stride) to dst
+// (pitch dst_stride), parallelized over rows. Covers the common
+// "copy a row-block slice" landing pattern without a Python loop.
+void ts_copy_2d(void* dst, uint64_t dst_stride, const void* src,
+                uint64_t src_stride, uint64_t row_bytes, uint64_t rows,
+                int nthreads) {
+  if (rows == 0 || row_bytes == 0) return;
+  uint64_t total = rows * row_bytes;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  size_t want = nthreads > 0 ? static_cast<size_t>(nthreads)
+                             : static_cast<size_t>(hw);
+  size_t threads =
+      std::min(want, std::max<uint64_t>(1, total / kMinPerThread));
+  threads = std::min<size_t>(threads, 16);
+  char* d = static_cast<char*>(dst);
+  const char* s = static_cast<const char*>(src);
+  auto worker = [=](uint64_t row_lo, uint64_t row_hi) {
+    for (uint64_t r = row_lo; r < row_hi; ++r) {
+      std::memcpy(d + r * dst_stride, s + r * src_stride, row_bytes);
+    }
+  };
+  if (threads <= 1) {
+    worker(0, rows);
+    return;
+  }
+  std::vector<std::thread> pool;
+  uint64_t per = rows / threads;
+  for (size_t i = 0; i + 1 < threads; ++i) {
+    pool.emplace_back(worker, i * per, (i + 1) * per);
+  }
+  worker((threads - 1) * per, rows);
+  for (auto& t : pool) t.join();
+}
+
+// POSIX SHM helpers (the ABI /dev/shm files share with Python's mmap path).
+int ts_shm_create(const char* path, uint64_t size) {
+  int fd = open(path, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    int err = -errno;
+    close(fd);
+    unlink(path);
+    return err;
+  }
+  return fd;
+}
+
+int ts_shm_unlink(const char* path) {
+  return unlink(path) == 0 ? 0 : -errno;
+}
+
+// Advise the kernel we'll touch the whole mapping (prefault large segments).
+int ts_prefault(void* addr, uint64_t size) {
+  if (madvise(addr, size, MADV_WILLNEED) != 0) return -errno;
+  return 0;
+}
+
+// Blocking full-length fd IO, releasing the GIL on the Python side (called
+// via ctypes from executor threads). Returns bytes moved or -errno.
+int64_t ts_write_fd(int fd, const void* buf, uint64_t n) {
+  const char* p = static_cast<const char*>(buf);
+  uint64_t done = 0;
+  while (done < n) {
+    ssize_t w = ::send(fd, p + done, n - done, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    done += static_cast<uint64_t>(w);
+  }
+  return static_cast<int64_t>(done);
+}
+
+int64_t ts_read_fd(int fd, void* buf, uint64_t n) {
+  char* p = static_cast<char*>(buf);
+  uint64_t done = 0;
+  while (done < n) {
+    ssize_t r = ::recv(fd, p + done, n - done, 0);
+    if (r == 0) return static_cast<int64_t>(done);  // peer closed
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    done += static_cast<uint64_t>(r);
+  }
+  return static_cast<int64_t>(done);
+}
+
+uint32_t ts_version() { return 1; }
+
+}  // extern "C"

@@ -121,17 +121,13 @@ async def _spmd_scenario(rank: int, world: int, result: dict) -> None:
     )
     await ts.put("g", ts.Shard(g[rank : rank + 1], sl), store_name="spmdtest")
     await ts.put(f"r{rank}", np.full(2, float(rank)), store_name="spmdtest")
-    # Barrier via the session's rendezvous, then cross-rank reads.
-    from torchstore_tpu.spmd import _spmd_sessions
-
-    session = _spmd_sessions["spmdtest"]
-    await session.client.barrier("puts_done", world)
+    await ts.barrier("puts_done", store_name="spmdtest")
     other = (rank + 1) % world
     peer = await ts.get(f"r{other}", store_name="spmdtest")
     assert peer[0] == float(other), peer
     full = await ts.get("g", store_name="spmdtest")
     np.testing.assert_array_equal(full, g)
-    await session.client.barrier("reads_done", world)
+    await ts.barrier("reads_done", store_name="spmdtest")
     await ts.shutdown("spmdtest")
     result["ok"] = True
 

@@ -11,6 +11,7 @@ SHM / bulk-TCP (ICI-adjacent / DCN) / RPC transport ladder.
 from torchstore_tpu.api import (
     DEFAULT_STORE,
     Shard,
+    barrier,
     client,
     delete,
     delete_batch,
@@ -56,6 +57,7 @@ __all__ = [
     "TensorMeta",
     "TensorSlice",
     "TransportType",
+    "barrier",
     "client",
     "delete",
     "delete_batch",

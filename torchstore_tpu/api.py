@@ -77,6 +77,10 @@ async def initialize(
         raise RuntimeError(f"store {store_name!r} already initialized")
     config = config or default_config()
     set_log_level(config.log_level)
+    if config.use_native:
+        from torchstore_tpu import native
+
+        native.get_lib()  # build/load once at bootstrap, not mid-transfer
     if strategy is None:
         strategy = (
             SingletonStrategy() if num_storage_volumes == 1 else LocalRankStrategy()
@@ -213,6 +217,23 @@ async def get_state_dict(
     )
 
 
+async def barrier(
+    name: str, store_name: str = DEFAULT_STORE, timeout: float = 300.0
+) -> None:
+    """Collective barrier across the SPMD world that initialized this store
+    (put-barrier-get is the canonical exchange pattern). Requires
+    ``initialize_spmd``."""
+    from torchstore_tpu import spmd as spmd_mod
+
+    session = spmd_mod._spmd_sessions.get(store_name)
+    if session is None:
+        raise RuntimeError(
+            f"barrier requires an SPMD-initialized store (none for "
+            f"{store_name!r}); call ts.initialize_spmd() first"
+        )
+    await session.client.barrier(name, session.env.world_size, timeout=timeout)
+
+
 async def shutdown(store_name: str = DEFAULT_STORE) -> None:
     """Tear down a store. Routes to the SPMD session when one owns this
     store; otherwise, in the initializing process this resets + stops the
@@ -243,6 +264,7 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
 __all__ = [
     "DEFAULT_STORE",
     "Shard",
+    "barrier",
     "client",
     "delete",
     "delete_batch",

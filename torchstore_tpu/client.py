@@ -19,6 +19,7 @@ from torchstore_tpu import sharding as shd
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
+from torchstore_tpu.native import copy_into
 from torchstore_tpu.runtime import ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
@@ -362,7 +363,7 @@ class LocalClient:
                     f"fetched region {region} does not fit destination "
                     f"{dest_box} for key {req.key!r}"
                 )
-            np.copyto(view, out)
+            copy_into(view, out)
             return dest
         return out
 

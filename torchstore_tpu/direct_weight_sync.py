@@ -29,6 +29,7 @@ import numpy as np
 
 from torchstore_tpu import sharding as shd
 from torchstore_tpu.logging import LatencyTracker, get_logger
+from torchstore_tpu.native import copy_into
 from torchstore_tpu.state_dict_utils import flatten_state_dict
 from torchstore_tpu.transport import shared_memory as shm
 from torchstore_tpu.transport.types import TensorMeta, TensorSlice
@@ -162,13 +163,27 @@ class DirectWeightSyncSource:
         # Advertise the same reachable name the actor runtime uses.
         hostname = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST", get_hostname())
         for flat_key, value in flat.items():
+            if (
+                transfer_dtype is not None
+                and shd.is_jax_array(value)
+                and _is_floating(value)
+            ):
+                # Cast on device (ops.device_cast: fused XLA / pallas kernel)
+                # so the HBM->host copy moves the transfer dtype's bytes.
+                from torchstore_tpu.ops import device_cast
+
+                value = device_cast(value, transfer_dtype)
             shards = self._shards_of(value)
             if shards is None:
                 continue  # non-tensor leaves don't take the direct path
             self._sources[flat_key] = value
             handle_list: list[WeightHandle] = []
             for ts_slice, host_arr in shards:
-                if transfer_dtype is not None and _is_floating(host_arr):
+                if (
+                    transfer_dtype is not None
+                    and _is_floating(host_arr)
+                    and host_arr.dtype != np.dtype(transfer_dtype)
+                ):
                     host_arr = host_arr.astype(transfer_dtype)
                 host_arr = np.ascontiguousarray(host_arr)
                 buffer_id = self._next_id
@@ -219,6 +234,14 @@ class DirectWeightSyncSource:
         if not self._registered:
             raise RuntimeError("register() must run before refresh()")
         for flat_key, value in self._sources.items():
+            if (
+                self._transfer_dtype is not None
+                and shd.is_jax_array(value)
+                and _is_floating(value)
+            ):
+                from torchstore_tpu.ops import device_cast
+
+                value = device_cast(value, self._transfer_dtype)
             shards = self._shards_of(value)
             handles = self.handles[flat_key]
             if shards is None or len(shards) != len(handles):
@@ -229,7 +252,11 @@ class DirectWeightSyncSource:
                     "after changing a param's sharding"
                 )
             for (_, host_arr), handle in zip(shards, handles):
-                if self._transfer_dtype is not None and _is_floating(host_arr):
+                if (
+                    self._transfer_dtype is not None
+                    and _is_floating(host_arr)
+                    and host_arr.dtype != np.dtype(self._transfer_dtype)
+                ):
                     host_arr = host_arr.astype(self._transfer_dtype)
                 np.copyto(
                     self.server.buffers[handle.buffer_id],
@@ -342,9 +369,25 @@ class DirectWeightSyncDest:
         unchanged (reference cached-plan invariant)."""
         tracker = LatencyTracker("direct_pull")
         dest_flat, mapping = flatten_state_dict(dest_state_dict)
+        # The signature must cover the dest layouts, not just key names — a
+        # changed target sharding must rebuild the plan (and re-run its
+        # coverage validation), never reuse a stale one.
+        target_sig = tuple(
+            sorted(
+                (
+                    k,
+                    tuple(
+                        (ts.offsets, ts.local_shape, ts.global_shape)
+                        for ts in _target_slices(v)
+                    ),
+                )
+                for k, v in dest_flat.items()
+                if _is_tensor_like(v)
+            )
+        )
         sig = (
             tuple(sorted((k, len(v)) for k, v in all_handles.items())),
-            tuple(sorted(dest_flat)),
+            target_sig,
         )
         if self._plan is None or self._plan_sig != sig:
             self._plan = self._build_plan(all_handles, dest_flat)
@@ -361,8 +404,22 @@ class DirectWeightSyncDest:
                 for want in _target_slices(target)
             ]
 
-        ops_bytes = sum(op.region.size * op.handle.meta.np_dtype.itemsize for op in self._plan)
-        await asyncio.gather(*(self._run_op(op, landings) for op in self._plan))
+        # Each source shard is read ONCE per pull, however many dest regions
+        # overlap it — K overlapping ops must not multiply wire traffic.
+        unique: dict[int, WeightHandle] = {}
+        for op in self._plan:
+            unique.setdefault(op.handle.buffer_id, op.handle)
+        shard_raws = dict(
+            zip(
+                unique.keys(),
+                await asyncio.gather(
+                    *(self._read_shard(h) for h in unique.values())
+                ),
+            )
+        )
+        for op in self._plan:
+            self._apply_op(op, shard_raws[op.handle.buffer_id], landings)
+        ops_bytes = sum(h.meta.nbytes for h in unique.values())
         tracker.track_step("reads", ops_bytes)
 
         out_flat = dict(dest_flat)
@@ -374,8 +431,7 @@ class DirectWeightSyncDest:
 
         return unflatten_state_dict(out_flat, mapping)
 
-    async def _run_op(self, op: _TransferOp, landings) -> None:
-        src = await self._read_shard(op.handle)
+    def _apply_op(self, op: _TransferOp, src: np.ndarray, landings) -> None:
         shard_arr = src.reshape(op.handle.meta.shape)
         for want, buf in landings[op.flat_key]:
             inter = intersect_boxes(op.region, want.box)
@@ -390,7 +446,7 @@ class DirectWeightSyncDest:
             view = get_destination_view(
                 buf, want.box, inter, require_contiguous=False
             )
-            np.copyto(view, shard_arr[rel_src])
+            copy_into(view, shard_arr[rel_src])
 
     async def _read_shard(self, handle: WeightHandle) -> np.ndarray:
         """One-hop read of a source buffer: SHM attach on the same host, TCP

@@ -1,0 +1,154 @@
+"""ctypes bindings for the native data-path library (native/libtsnative.so).
+
+Fail-open: when the library is absent we attempt one `make` build (the
+toolchain is part of the deployment image); if that fails, every helper
+falls back to numpy — the store stays fully functional, just slower. Gated
+by ``StoreConfig.use_native`` / TORCHSTORE_TPU_USE_NATIVE.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from torchstore_tpu.config import default_config
+from torchstore_tpu.logging import get_logger
+
+logger = get_logger("torchstore_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtsnative.so")
+
+# Below this size the ctypes call overhead beats the threading win.
+PARALLEL_THRESHOLD = 8 * 1024 * 1024
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    """Build the library once, under a cross-process file lock so N actor
+    processes starting together don't race `make` (a loser could otherwise
+    dlopen a half-written .so). Called from initialize()/volume startup, not
+    from the transfer hot path."""
+    makefile = os.path.join(_NATIVE_DIR, "Makefile")
+    if not os.path.exists(makefile) or not os.access(_NATIVE_DIR, os.W_OK):
+        return False
+    import fcntl
+
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+    try:
+        with open(lock_path, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            if os.path.exists(_LIB_PATH):  # another process built it
+                return True
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=60,
+            )
+            return os.path.exists(_LIB_PATH)
+    except Exception as exc:
+        logger.warning("native build failed (falling back to numpy): %s", exc)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not default_config().use_native:
+        return None
+    if not os.path.exists(_LIB_PATH) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ts_parallel_memcpy.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.ts_parallel_memcpy.restype = None
+        lib.ts_copy_2d.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.ts_copy_2d.restype = None
+        lib.ts_read_fd.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ts_read_fd.restype = ctypes.c_int64
+        lib.ts_write_fd.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
+        lib.ts_write_fd.restype = ctypes.c_int64
+        lib.ts_version.restype = ctypes.c_uint32
+        assert lib.ts_version() == 1
+        _lib = lib
+        logger.info("native data path loaded (%s)", _LIB_PATH)
+    except Exception as exc:
+        logger.warning("native library unusable, using numpy fallback: %s", exc)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _addr(arr: np.ndarray) -> int:
+    return arr.__array_interface__["data"][0]
+
+
+def fast_copy(dst: np.ndarray, src: np.ndarray) -> None:
+    """np.copyto with a multi-threaded native path for large contiguous
+    same-dtype copies (the store's hot memcpy)."""
+    lib = get_lib()
+    if (
+        lib is not None
+        and dst.dtype == src.dtype
+        and dst.shape == src.shape
+        and dst.nbytes >= PARALLEL_THRESHOLD
+        and dst.flags["C_CONTIGUOUS"]
+        and src.flags["C_CONTIGUOUS"]
+    ):
+        lib.ts_parallel_memcpy(_addr(dst), _addr(src), dst.nbytes, 0)
+        return
+    np.copyto(dst, src)
+
+
+def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
+    """Best copy path for a landing: contiguous native memcpy, then the
+    native strided row-block path, then numpy."""
+    if (
+        dst.flags["C_CONTIGUOUS"]
+        and src.flags["C_CONTIGUOUS"]
+        and dst.dtype == src.dtype
+        and dst.shape == src.shape
+    ):
+        fast_copy(dst, src)
+        return
+    if fast_copy_2d(dst, src):
+        return
+    np.copyto(dst, src)
+
+
+def fast_copy_2d(dst: np.ndarray, src: np.ndarray) -> bool:
+    """Row-block strided copy (2D, same row length, contiguous rows).
+    Returns False when the pattern doesn't apply (caller uses numpy)."""
+    lib = get_lib()
+    if (
+        lib is None
+        or dst.ndim != 2
+        or src.shape != dst.shape
+        or dst.dtype != src.dtype
+        or dst.strides[1] != dst.itemsize
+        or src.strides[1] != src.itemsize
+        or dst.nbytes < PARALLEL_THRESHOLD
+    ):
+        return False
+    lib.ts_copy_2d(
+        _addr(dst), dst.strides[0], _addr(src), src.strides[0],
+        dst.shape[1] * dst.itemsize, dst.shape[0], 0,
+    )
+    return True

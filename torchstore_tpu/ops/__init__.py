@@ -1,0 +1,3 @@
+from torchstore_tpu.ops.staging import device_cast, pallas_cast
+
+__all__ = ["device_cast", "pallas_cast"]

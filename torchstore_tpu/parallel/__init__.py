@@ -88,6 +88,22 @@ def unbox(params):
     return meta.unbox(params)
 
 
+def reshard(x, sharding):
+    """In-process resharding over ICI: when source and destination live in
+    the same jax runtime (one process, or SPMD multi-controller where every
+    participant calls this), ``device_put`` compiles to direct device-to-
+    device transfers / XLA collectives over ICI — no host round trip.
+
+    This is the TPU answer to the reference's device-side RDMA rung
+    (SURVEY §2.3 monarch.rdma): between *separate* actor groups with
+    separate runtimes the store's SHM/bulk transports carry the bytes, but
+    whenever the caller's own mesh holds both layouts this path wins by an
+    order of magnitude."""
+    import jax
+
+    return jax.device_put(x, sharding)
+
+
 def make_train_step(model, optimizer):
     """A jittable causal-LM train step (loss = next-token cross-entropy).
     Sharding propagates from the input shardings (params/opt_state/tokens

@@ -224,7 +224,9 @@ async def _put_state_dict_direct(
         await source.refresh()
 
 
-async def _get_state_dict_direct(client, key: str, user_state_dict: Any) -> Any:
+async def _get_state_dict_direct(
+    client, key: str, user_state_dict: Any, _retry: bool = True
+) -> Any:
     from torchstore_tpu.direct_weight_sync import DirectWeightSyncDest
 
     if user_state_dict is None:
@@ -254,7 +256,18 @@ async def _get_state_dict_direct(client, key: str, user_state_dict: Any) -> Any:
         entry = (DirectWeightSyncDest(), all_handles)
         cache.dests[key] = entry
     dest, all_handles = entry
-    return await dest.pull(all_handles, user_state_dict)
+    try:
+        return await dest.pull(all_handles, user_state_dict)
+    except (ConnectionError, OSError, KeyError):
+        if not _retry:
+            raise
+        # The source may have restarted and re-published fresh handles under
+        # the same key — invalidate the cached set and retry once.
+        cache.dests.pop(key, None)
+        await dest.close()
+        return await _get_state_dict_direct(
+            client, key, user_state_dict, _retry=False
+        )
 
 
 async def put_state_dict(

@@ -207,6 +207,9 @@ class StorageVolume(Actor):
             self.volume_id = os.environ.get("RANK", "0")
         self.store: StorageImpl = storage or InMemoryStore()
         self.ctx = TransportContext()
+        from torchstore_tpu import native
+
+        native.get_lib()  # load (or wait for) the native data path at startup
 
     @endpoint
     async def get_id(self) -> dict:

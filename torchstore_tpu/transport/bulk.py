@@ -37,6 +37,7 @@ import numpy as np
 
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.native import fast_copy
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -510,7 +511,7 @@ class BulkTransportBuffer(TransportBuffer):
             arr = np.frombuffer(raw, dtype=meta.np_dtype).reshape(meta.shape)
             prev = existing.get(idx)
             if prev is not None and prev.shape == arr.shape and prev.dtype == arr.dtype:
-                np.copyto(prev, arr)  # in-place reuse (invariant 6)
+                fast_copy(prev, arr)  # in-place reuse (invariant 6)
                 out[idx] = prev
             else:
                 out[idx] = arr

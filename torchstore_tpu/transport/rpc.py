@@ -17,6 +17,7 @@ from typing import Any, Optional
 import numpy as np
 
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
+from torchstore_tpu.native import fast_copy
 from torchstore_tpu.transport.types import Request
 
 
@@ -81,7 +82,7 @@ class RPCTransportBuffer(TransportBuffer):
             ):
                 # In-place overwrite reuses storage so SHM/bulk clients that
                 # alias the stored buffer observe the update (invariant 6).
-                np.copyto(prev, arr)
+                fast_copy(prev, arr)
                 out[idx] = prev
             else:
                 out[idx] = arr

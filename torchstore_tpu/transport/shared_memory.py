@@ -30,6 +30,7 @@ import numpy as np
 
 from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.native import fast_copy
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -249,8 +250,9 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 desc = ShmDescriptor(seg.name, seg.size, meta)
                 cache.segments[seg.name] = seg
                 cache.key_to_segments.setdefault(req.key, set()).add(seg.name)
-            # THE hot memcpy: client array -> shared segment.
-            np.copyto(seg.view(meta, desc.offset), arr)
+            # THE hot memcpy: client array -> shared segment (native
+            # multi-threaded path on multi-core hosts).
+            fast_copy(seg.view(meta, desc.offset), arr)
             self.descriptors[idx] = desc
             self._client_segments[idx] = seg
 
@@ -325,7 +327,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             contig = np.ascontiguousarray(entry)
             seg = ShmSegment.create(max(contig.nbytes, 1))
             tmeta = TensorMeta.of(contig)
-            np.copyto(seg.view(tmeta), contig)
+            fast_copy(seg.view(tmeta), contig)
             # Ownership transfers to the client, which unlinks after landing;
             # the server reaps it after a TTL if the client never does.
             cache.track_staged(seg)
@@ -366,7 +368,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
     @staticmethod
     def _land(req: Request, src: np.ndarray) -> np.ndarray:
         if req.destination_view is not None:
-            np.copyto(req.destination_view, src)
+            fast_copy(req.destination_view, src)
             return req.destination_view
         return src.copy()
 
