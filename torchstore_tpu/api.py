@@ -91,8 +91,16 @@ async def initialize(
         f"ts_{store_name}_volume",
         strategy,
     )
-    controller = await get_or_spawn_singleton(f"ts_{store_name}_controller", Controller)
-    await controller.init.call_one(strategy, volume_mesh.refs)
+    try:
+        controller = await get_or_spawn_singleton(
+            f"ts_{store_name}_controller", Controller
+        )
+        await controller.init.call_one(strategy, volume_mesh.refs)
+    except BaseException:
+        # Failed bootstrap must not leak volume processes.
+        await volume_mesh.stop()
+        await stop_singleton(f"ts_{store_name}_controller")
+        raise
     _publish_handle(store_name, controller)
     _stores[store_name] = _StoreHandle(
         controller=controller,

@@ -226,12 +226,20 @@ class MoE(nn.Module):
         return jnp.einsum("besh,bse->bsh", out, gates)
 
 
+def _constrain(x, axes):
+    """Activation sharding constraint via logical axes; a no-op outside a
+    flax logical_axis_rules context (see parallel.activation_rules). 'seq'
+    maps to the sp mesh axis — sequence parallelism for long contexts."""
+    return nn.with_logical_constraint(x, axes)
+
+
 class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
+        x = _constrain(x, ("batch", "seq", "embed"))
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="attn_norm")(x), positions
         )
@@ -239,7 +247,7 @@ class Block(nn.Module):
         x = x + mlp_cls(cfg, name="mlp")(
             RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
         )
-        return x
+        return _constrain(x, ("batch", "seq", "embed"))
 
 
 class Llama(nn.Module):

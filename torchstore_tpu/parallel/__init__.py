@@ -88,6 +88,21 @@ def unbox(params):
     return meta.unbox(params)
 
 
+def activation_rules(mesh, rules=DEFAULT_RULES):
+    """flax ``logical_axis_rules`` context manager resolving our logical
+    axes against ``mesh`` — activates the model's activation sharding
+    constraints (batch->dp/fsdp, seq->sp for sequence parallelism)."""
+    import flax.linen as nn
+
+    # Different logical axes may share one mesh axis (they live on different
+    # tensors); per-tensor axis-uniqueness is handled in logical_to_mesh_axes.
+    resolved = [
+        (name, next((c for c in candidates if c in mesh.axis_names), None))
+        for name, candidates in rules
+    ]
+    return nn.logical_axis_rules(resolved)
+
+
 def reshard(x, sharding):
     """In-process resharding over ICI: when source and destination live in
     the same jax runtime (one process, or SPMD multi-controller where every
