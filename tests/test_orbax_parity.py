@@ -1,0 +1,74 @@
+"""Differential harness: orbax as the resharding oracle.
+
+The reference validates its resharding against torch DCP — save with DCP,
+reshard-load through both DCP and torchstore, assert equality
+(/root/reference/tests/test_state_dict.py:82-265). Here orbax plays DCP's
+role: the same sharded state dict goes through (a) an orbax checkpoint
+save/restore with a different target sharding and (b) a store put/get with
+that target sharding; both must produce identical arrays.
+"""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+
+jax = pytest.importorskip("jax")
+ocp = pytest.importorskip("orbax.checkpoint")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def sharded(arr, shape, names, spec):
+    mesh = Mesh(np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape), names)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+@pytest.mark.parametrize(
+    "src,dst",
+    [
+        (((8,), ("x",), P("x")), ((4, 2), ("a", "b"), P("a", "b"))),
+        (((2, 4), ("x", "y"), P("y", "x")), ((8,), ("z",), P(None, "z"))),
+        (((4,), ("f",), P("f")), ((2, 2), ("d", "t"), P(None, "t"))),
+    ],
+)
+async def test_reshard_matches_orbax(tmp_path, src, dst):
+    g = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+    b = np.random.rand(16).astype(np.float32)
+    sd = {
+        "w": sharded(g, *src),
+        "b": sharded(b, (2,), ("r",), P()),
+    }
+
+    # --- oracle: orbax save + restore under the target sharding ------------
+    ckpt_dir = tmp_path / "ckpt"
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(ckpt_dir / "st", sd)
+    checkpointer.wait_until_finished()
+    target_w = sharded(np.zeros_like(g), *dst)
+    target_b = sharded(np.zeros_like(b), (2,), ("r",), P())
+    restored = checkpointer.restore(
+        ckpt_dir / "st",
+        target={
+            "w": jax.ShapeDtypeStruct(g.shape, g.dtype, sharding=target_w.sharding),
+            "b": jax.ShapeDtypeStruct(b.shape, b.dtype, sharding=target_b.sharding),
+        },
+    )
+
+    # --- store: put sharded, get under the same target sharding ------------
+    await ts.initialize(store_name="orbax")
+    try:
+        await ts.put_state_dict("sd", sd, store_name="orbax")
+        out = await ts.get_state_dict(
+            "sd",
+            user_state_dict={"w": target_w, "b": target_b},
+            store_name="orbax",
+        )
+    finally:
+        await ts.shutdown("orbax")
+
+    for key in ("w", "b"):
+        np.testing.assert_array_equal(
+            np.asarray(out[key]), np.asarray(restored[key])
+        )
+        assert out[key].sharding == restored[key].sharding

@@ -394,15 +394,30 @@ class DirectWeightSyncDest:
             self._plan_sig = sig
         tracker.track_step("plan")
 
-        # Host landing buffers per (flat_key, target slice).
+        # Host landing buffers per (flat_key, target slice). A numpy target
+        # with one full-array slice IS its own landing buffer — ops write
+        # straight into destination memory (the reference's exact-match
+        # zero-extra-copy path, direct_weight_sync.py:221-247).
         landings: dict[str, list[tuple[TensorSlice, np.ndarray]]] = {}
+        inplace_targets: set[str] = set()
         for flat_key, target in dest_flat.items():
             if not _is_tensor_like(target):
                 continue
-            landings[flat_key] = [
-                (want, np.empty(want.local_shape, _np_dtype_of(target)))
-                for want in _target_slices(target)
-            ]
+            wants = _target_slices(target)
+            if (
+                isinstance(target, np.ndarray)
+                and len(wants) == 1
+                and wants[0].is_full()
+                and target.flags["C_CONTIGUOUS"]
+                and target.flags["WRITEABLE"]
+            ):
+                landings[flat_key] = [(wants[0], target)]
+                inplace_targets.add(flat_key)
+            else:
+                landings[flat_key] = [
+                    (want, np.empty(want.local_shape, _np_dtype_of(target)))
+                    for want in wants
+                ]
 
         # Each source shard is read ONCE per pull, however many dest regions
         # overlap it — K overlapping ops must not multiply wire traffic.
@@ -424,7 +439,10 @@ class DirectWeightSyncDest:
 
         out_flat = dict(dest_flat)
         for flat_key, parts in landings.items():
-            out_flat[flat_key] = _rebuild(dest_flat[flat_key], parts)
+            if flat_key in inplace_targets:
+                out_flat[flat_key] = parts[0][1]  # already the target array
+            else:
+                out_flat[flat_key] = _rebuild(dest_flat[flat_key], parts)
         tracker.track_step("rebuild")
         tracker.log_summary(level=20)
         from torchstore_tpu.state_dict_utils import unflatten_state_dict
