@@ -140,6 +140,48 @@ async def test_dead_buffer_raises(pair):
         await source_c.close()
 
 
+async def test_spec_target_direct_pull(pair):
+    # ShapeDtypeStruct targets work on the direct path too (not silently
+    # returned as metadata stubs).
+    source, dest = pair
+    w = np.random.rand(8, 8).astype(np.float32)
+    src = make_sharded(w, (4,), ("x",), P("x"))
+    handles = await source.register({"w": src})
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("a", "b"))
+    spec = jax.ShapeDtypeStruct(
+        w.shape, w.dtype, sharding=NamedSharding(mesh, P(None, "b"))
+    )
+    out = await dest.pull(handles, {"w": spec})
+    assert shd_is_array(out["w"])
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+
+
+def shd_is_array(x):
+    import jax as _jax
+
+    return isinstance(x, _jax.Array)
+
+
+async def test_spec_dtype_honored_buffered():
+    import ml_dtypes
+
+    await ts.initialize(store_name="specdt")
+    try:
+        w = np.random.rand(8, 128).astype(np.float32)
+        await ts.put("w", w, store_name="specdt")
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+        spec = jax.ShapeDtypeStruct(
+            w.shape, ml_dtypes.bfloat16, sharding=NamedSharding(mesh, P("x"))
+        )
+        out = await ts.get("w", like=spec, store_name="specdt")
+        assert str(out.dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), w, atol=1e-2
+        )
+    finally:
+        await ts.shutdown("specdt")
+
+
 async def test_store_integrated_direct_sync():
     await ts.initialize(store_name="dws")
     try:

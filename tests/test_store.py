@@ -156,6 +156,38 @@ async def test_cross_actor_exchange(store):
         await actors.stop()
 
 
+async def test_get_with_shape_dtype_struct_target(store):
+    jax = pytest.importorskip("jax")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    g = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    await ts.put("w", g, store_name=store)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    spec = jax.ShapeDtypeStruct(
+        g.shape, g.dtype, sharding=NamedSharding(mesh, P("x", "y"))
+    )
+    out = await ts.get("w", like=spec, store_name=store)
+    assert out.sharding == spec.sharding
+    np.testing.assert_array_equal(np.asarray(out), g)
+
+
+async def test_volume_get_meta_endpoint(store):
+    # Parity with the reference's get_meta used by allocation-driven
+    # transports (/root/reference/torchstore/storage_volume.py:361-394).
+    await ts.put("t", np.ones((3, 4), np.float32), store_name=store)
+    await ts.put("o", {"x": 1}, store_name=store)
+    client = ts.client(store)
+    await client._ensure_setup()
+    volume = next(iter(client._volume_refs.values()))
+    from torchstore_tpu.transport.types import Request
+
+    metas = await volume.actor.get_meta.call_one(
+        [Request.meta_request("t"), Request.from_objects("o", None).meta_only()]
+    )
+    assert metas[0].shape == (3, 4) and metas[0].dtype == "float32"
+    assert metas[1] == "obj"
+
+
 async def test_concurrent_puts_and_gets(store):
     async def one(i):
         await ts.put(f"c/{i}", np.full((8,), float(i)), store_name=store)

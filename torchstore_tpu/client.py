@@ -24,7 +24,7 @@ from torchstore_tpu.runtime import ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
 from torchstore_tpu.transport.factory import create_transport_buffer
-from torchstore_tpu.transport.types import Request, TensorSlice
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 from torchstore_tpu.utils import (
     Box,
     assemble_tensor,
@@ -158,7 +158,9 @@ class LocalClient:
             elif isinstance(like, TensorSlice):
                 requests.append(Request.from_tensor_slice(key, like))
                 plan.append((key, requests[-1], like))
-            elif shd.is_jax_array(like):
+            elif shd.is_jax_array(like) or shd.is_sharded_spec(like):
+                # target_slices/build_array only need .shape/.sharding, so a
+                # ShapeDtypeStruct works as a no-allocation restore target.
                 targets = shd.target_slices(like)
                 jax_targets[len(plan)] = targets
                 sub_reqs = [Request.from_tensor_slice(key, ts) for _, ts in targets]
@@ -178,10 +180,19 @@ class LocalClient:
         for idx, (key, req_or_list, like) in enumerate(plan):
             if isinstance(req_or_list, list):  # jax target
                 targets = jax_targets[idx]
-                parts = [
-                    (dev, np.asarray(by_request[id(r)]))
-                    for (dev, _), r in zip(targets, req_or_list)
-                ]
+                # Honor the target's dtype (the orbax restore idiom: a
+                # bf16 spec over fp32-stored weights converts on fetch).
+                want_dtype = (
+                    TensorMeta(shape=(), dtype=str(like.dtype)).np_dtype
+                    if hasattr(like, "dtype")
+                    else None
+                )
+                parts = []
+                for (dev, _), r in zip(targets, req_or_list):
+                    arr = np.asarray(by_request[id(r)])
+                    if want_dtype is not None and arr.dtype != want_dtype:
+                        arr = arr.astype(want_dtype)
+                    parts.append((dev, arr))
                 out[key] = shd.build_array(like, parts)
             else:
                 out[key] = by_request[id(req_or_list)]

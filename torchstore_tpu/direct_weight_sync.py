@@ -385,10 +385,21 @@ class DirectWeightSyncDest:
                 if _is_tensor_like(v)
             )
         )
-        sig = (
-            tuple(sorted((k, len(v)) for k, v in all_handles.items())),
-            target_sig,
+        handle_sig = tuple(
+            sorted(
+                (
+                    k,
+                    tuple(
+                        sorted(
+                            (h.tensor_slice.offsets, h.tensor_slice.local_shape)
+                            for h in v
+                        )
+                    ),
+                )
+                for k, v in all_handles.items()
+            )
         )
+        sig = (handle_sig, target_sig)
         if self._plan is None or self._plan_sig != sig:
             self._plan = self._build_plan(all_handles, dest_flat)
             self._plan_sig = sig
@@ -529,7 +540,11 @@ class DirectWeightSyncDest:
 
 
 def _is_tensor_like(value) -> bool:
-    return isinstance(value, np.ndarray) or shd.is_jax_array(value)
+    return (
+        isinstance(value, np.ndarray)
+        or shd.is_jax_array(value)
+        or shd.is_sharded_spec(value)
+    )
 
 
 def _np_dtype_of(value) -> np.dtype:
@@ -538,13 +553,13 @@ def _np_dtype_of(value) -> np.dtype:
 
 
 def _target_slices(value) -> list[TensorSlice]:
-    if shd.is_jax_array(value):
+    if shd.is_jax_array(value) or shd.is_sharded_spec(value):
         return [ts for _, ts in shd.target_slices(value)]
     return [_full_slice(value.shape)]
 
 
 def _rebuild(target, parts: list[tuple[TensorSlice, np.ndarray]]):
-    if shd.is_jax_array(target):
+    if shd.is_jax_array(target) or shd.is_sharded_spec(target):
         devs = [dev for dev, _ in shd.target_slices(target)]
         return shd.build_array(target, [(d, arr) for d, (_, arr) in zip(devs, parts)])
     # numpy target: single full slice, filled in place.

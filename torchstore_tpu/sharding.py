@@ -27,6 +27,19 @@ def is_jax_array(value: Any) -> bool:
     return isinstance(value, jax.Array)
 
 
+def is_sharded_spec(value: Any) -> bool:
+    """A jax.ShapeDtypeStruct carrying a sharding: a fetch target that needs
+    no prefilled array (orbax-style restore targets)."""
+    try:
+        import jax
+    except ImportError:
+        return False
+    return (
+        isinstance(value, jax.ShapeDtypeStruct)
+        and getattr(value, "sharding", None) is not None
+    )
+
+
 def _mesh_coords_map(mesh) -> dict:
     """device -> coordinates in the mesh array."""
     coords = {}

@@ -258,7 +258,10 @@ async def _get_state_dict_direct(
     dest, all_handles = entry
     try:
         return await dest.pull(all_handles, user_state_dict)
-    except (ConnectionError, OSError, KeyError):
+    except (ConnectionError, OSError, KeyError, ValueError):
+        # ValueError covers stale-plan shape mismatches after a source
+        # republish; a successful retry fully overwrites any partial
+        # in-place landings.
         if not _retry:
             raise
         # The source may have restarted and re-published fresh handles under
@@ -376,4 +379,8 @@ def _leaf_keys(mapping: dict) -> set[str]:
 
 
 def _is_fetch_target(value: Any) -> bool:
-    return isinstance(value, np.ndarray) or shd.is_jax_array(value)
+    return (
+        isinstance(value, np.ndarray)
+        or shd.is_jax_array(value)
+        or shd.is_sharded_spec(value)
+    )
