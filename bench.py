@@ -102,6 +102,20 @@ async def run() -> dict:
             "bench/direct", user_state_dict=user, direct=True, store_name="bench"
         ),
     )
+    # p50 small-op latency (the BASELINE.json metric's latency half).
+    lat_put, lat_get = [], []
+    small = np.random.rand(256).astype(np.float32)
+    for i in range(40):
+        t0 = time.perf_counter()
+        await ts.put(f"lat/{i % 4}", small, store_name="bench")
+        lat_put.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        await ts.get(f"lat/{i % 4}", store_name="bench")
+        lat_get.append(time.perf_counter() - t0)
+    p50p = sorted(lat_put)[len(lat_put) // 2] * 1e3
+    p50g = sorted(lat_get)[len(lat_get) // 2] * 1e3
+    print(f"# p50 latency (1KB): put {p50p:.2f} ms, get {p50g:.2f} ms", file=sys.stderr)
+
     await ts.shutdown("bench")
     best = max(best_buffered, best_direct)
     print(

@@ -195,3 +195,12 @@ async def test_concurrent_puts_and_gets(store):
         assert out[0] == float(i)
 
     await asyncio.gather(*(one(i) for i in range(16)))
+
+
+async def test_controller_stats(store):
+    await ts.put("s1", np.ones((4, 4), np.float32), store_name=store)
+    await ts.get("s1", store_name=store)
+    stats = await ts.client(store).controller.stats.call_one()
+    assert stats["puts"] >= 1 and stats["put_bytes"] >= 64
+    assert stats["locates"] >= 1 and stats["num_keys"] >= 1
+    assert stats["num_volumes"] == 1

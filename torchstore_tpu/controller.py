@@ -80,6 +80,14 @@ class Controller(Actor):
         self.strategy = None
         self.volume_refs: dict[str, ActorRef] = {}
         self.volume_hostnames: dict[str, str] = {}
+        # Observability counters (the reference has none — SURVEY §5 "no
+        # counters/prometheus"); cheap to keep, exposed via stats().
+        self.counters = {
+            "puts": 0,
+            "put_bytes": 0,
+            "locates": 0,
+            "deletes": 0,
+        }
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -144,6 +152,7 @@ class Controller(Actor):
         missing_ok: bool = False,
         require_fully_committed: bool = True,
     ) -> dict[str, dict[str, StorageInfo]]:
+        self.counters["locates"] += len(keys)
         out: dict[str, dict[str, StorageInfo]] = {}
         for key in keys:
             infos = self.index.get(key)
@@ -168,6 +177,8 @@ class Controller(Actor):
 
     @endpoint
     async def notify_put_batch(self, metas: list[Request], volume_id: str) -> None:
+        accepted = 0
+        accepted_bytes = 0
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
                 raise ValueError(
@@ -198,12 +209,20 @@ class Controller(Actor):
                 infos[volume_id] = StorageInfo.from_meta(meta)
             else:
                 info.merge(meta)
+            accepted += 1
+            if meta.tensor_meta is not None:
+                accepted_bytes += meta.tensor_meta.nbytes
+        # Counters reflect only entries that actually indexed (a rejected
+        # batch raises before reaching here for the failing entry).
+        self.counters["puts"] += accepted
+        self.counters["put_bytes"] += accepted_bytes
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:
         """Remove keys from the index FIRST (notify-before-delete ordering,
         /root/reference/torchstore/client.py:408-411) and return which
         volumes held each key so the client can clear the data plane."""
+        self.counters["deletes"] += len(keys)
         by_volume: dict[str, list[str]] = {}
         for key in keys:
             infos = self.index.pop(key, None)
@@ -218,6 +237,36 @@ class Controller(Actor):
         if prefix is None:
             return sorted(self.index)
         return sorted(self.index.keys().filter_by_prefix(prefix))
+
+    @endpoint
+    async def stats(self) -> dict:
+        """Store-level observability: counters + index summary."""
+        indexed_bytes = 0
+        sharded_keys = 0
+        for infos in self.index.values():
+            key_is_sharded = False
+            for info in infos.values():
+                if info.object_type == ObjectType.TENSOR_SLICE:
+                    key_is_sharded = True
+                    itemsize = (
+                        info.tensor_meta.np_dtype.itemsize
+                        if info.tensor_meta is not None
+                        else 4
+                    )
+                    indexed_bytes += sum(
+                        ts.nelements * itemsize
+                        for ts in info.tensor_slices.values()
+                    )
+                elif info.tensor_meta is not None:
+                    indexed_bytes += info.tensor_meta.nbytes
+            sharded_keys += int(key_is_sharded)
+        return {
+            **self.counters,
+            "num_keys": len(self.index),
+            "sharded_keys": sharded_keys,
+            "num_volumes": len(self.volume_refs),
+            "indexed_bytes_approx": indexed_bytes,
+        }
 
     @endpoint
     async def teardown(self) -> None:

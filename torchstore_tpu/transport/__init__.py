@@ -1,0 +1,18 @@
+from torchstore_tpu.transport.buffers import (
+    TransportBuffer,
+    TransportCache,
+    TransportContext,
+)
+from torchstore_tpu.transport.factory import TransportType, create_transport_buffer
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+
+__all__ = [
+    "Request",
+    "TensorMeta",
+    "TensorSlice",
+    "TransportBuffer",
+    "TransportCache",
+    "TransportContext",
+    "TransportType",
+    "create_transport_buffer",
+]
