@@ -177,8 +177,6 @@ class Controller(Actor):
 
     @endpoint
     async def notify_put_batch(self, metas: list[Request], volume_id: str) -> None:
-        accepted = 0
-        accepted_bytes = 0
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
                 raise ValueError(
@@ -209,13 +207,11 @@ class Controller(Actor):
                 infos[volume_id] = StorageInfo.from_meta(meta)
             else:
                 info.merge(meta)
-            accepted += 1
+            # Count as each entry indexes, so a mid-batch rejection leaves
+            # counters consistent with what actually landed in the index.
+            self.counters["puts"] += 1
             if meta.tensor_meta is not None:
-                accepted_bytes += meta.tensor_meta.nbytes
-        # Counters reflect only entries that actually indexed (a rejected
-        # batch raises before reaching here for the failing entry).
-        self.counters["puts"] += accepted
-        self.counters["put_bytes"] += accepted_bytes
+                self.counters["put_bytes"] += meta.tensor_meta.nbytes
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:

@@ -1,3 +1,9 @@
+from torchstore_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
 from torchstore_tpu.ops.staging import device_cast, pallas_cast
 
-__all__ = ["device_cast", "pallas_cast"]
+__all__ = [
+    "device_cast",
+    "pallas_cast",
+    "ring_attention",
+    "ring_attention_sharded",
+]
