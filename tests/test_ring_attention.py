@@ -73,3 +73,44 @@ def test_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+    @pytest.mark.parametrize("ring", [2, 4])
+    def test_matches_dense(self, causal, ring):
+        from torchstore_tpu.ops import ulysses_attention_sharded
+
+        q, k, v = make_qkv()
+        mesh = parallel.make_mesh({"sp": ring})
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        out = ulysses_attention_sharded(qs, ks, vs, mesh, "sp", causal=causal)
+        ref = dense_reference(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        assert out.sharding.spec == P(None, "sp", None, None)
+
+    def test_indivisible_heads_rejected(self):
+        from torchstore_tpu.ops import ulysses_attention_sharded
+
+        q, k, v = make_qkv(h=3)
+        mesh = parallel.make_mesh({"sp": 2})
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention_sharded(qs, ks, vs, mesh, "sp")
+
+    def test_agrees_with_ring(self):
+        from torchstore_tpu.ops import ulysses_attention_sharded
+
+        q, k, v = make_qkv(s=128)
+        mesh = parallel.make_mesh({"sp": 4})
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        ring = ring_attention_sharded(qs, ks, vs, mesh, "sp", causal=True)
+        uly = ulysses_attention_sharded(qs, ks, vs, mesh, "sp", causal=True)
+        np.testing.assert_allclose(
+            np.asarray(ring), np.asarray(uly), atol=3e-5, rtol=3e-5
+        )

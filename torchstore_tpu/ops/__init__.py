@@ -1,9 +1,15 @@
 from torchstore_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
 from torchstore_tpu.ops.staging import device_cast, pallas_cast
+from torchstore_tpu.ops.ulysses_attention import (
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "device_cast",
     "pallas_cast",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -1,0 +1,28 @@
+"""Shared shard_map wrapper for the sequence-parallel attention ops."""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.cache
+def make_sharded_attention(body, mesh, axis_name: str, causal: bool):
+    """jit(shard_map(body)) over (q, k, v) sequence-sharded on
+    ``axis_name``. Cached per (body, mesh, axis, causal) so repeat calls
+    reuse the compiled executable."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(body, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return jax.jit(fn)

@@ -91,27 +91,9 @@ def _mark_varying(lax, x, axis_name: str):
     return x  # older jax: no varying-type tracking
 
 
-@functools.cache
-def _sharded_fn(mesh, axis_name: str, causal: bool):
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
-    spec = P(None, axis_name, None, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
-    return jax.jit(fn)
-
-
 def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp", causal: bool = False):
     """jit-compiled ring attention over ``mesh``'s ``axis_name`` ring: global
     (batch, seq, heads, head_dim) arrays sequence-sharded on entry/exit."""
-    return _sharded_fn(mesh, axis_name, causal)(q, k, v)
+    from torchstore_tpu.ops._sharded import make_sharded_attention
+
+    return make_sharded_attention(ring_attention, mesh, axis_name, causal)(q, k, v)

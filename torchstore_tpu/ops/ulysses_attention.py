@@ -1,0 +1,57 @@
+"""Ulysses-style (all-to-all) sequence-parallel attention.
+
+The complementary long-context pattern to ring attention: instead of
+rotating k/v blocks around a ring, an ``all_to_all`` re-partitions the
+activations from sequence-sharded to head-sharded, each device runs dense
+(flash) attention over the FULL sequence for its subset of heads, and a
+second ``all_to_all`` restores sequence sharding. Two collectives per call
+(vs n-1 ring hops) at the cost of O(seq) k/v memory per device — the right
+trade when heads >= ring size and sequence blocks are small.
+
+Requires num_heads % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Inside shard_map: q/k/v (batch, seq_local, heads, head_dim) sequence-
+    sharded -> same shape, exact attention over the full sequence."""
+    import jax
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    # seq-sharded -> head-sharded: split heads across the axis, gather seq.
+    # all_to_all(x, axis, split_axis=heads, concat_axis=seq).
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = jax.nn.dot_product_attention(qh, kh, vh, is_causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(
+    q, k, v, mesh, axis_name: str = "sp", causal: bool = False
+):
+    """jit-compiled all-to-all attention over ``mesh``'s ``axis_name``:
+    global (batch, seq, heads, head_dim) arrays sequence-sharded on entry
+    and exit. Every head axis (q AND k/v — GQA included) must be divisible
+    by the axis size; repeat kv heads or use ring attention otherwise."""
+    from torchstore_tpu.ops._sharded import make_sharded_attention
+
+    axis_size = mesh.shape[axis_name]
+    for name, arr in (("q", q), ("k", k), ("v", v)):
+        if arr.shape[2] % axis_size != 0:
+            raise ValueError(
+                f"ulysses attention needs {name} heads ({arr.shape[2]}) "
+                f"divisible by the {axis_name!r} axis size ({axis_size}); "
+                "repeat kv heads for GQA, or use ring attention for head "
+                "counts below the ring size"
+            )
+    return make_sharded_attention(ulysses_attention, mesh, axis_name, causal)(q, k, v)
