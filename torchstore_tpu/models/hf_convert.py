@@ -1,0 +1,102 @@
+"""HuggingFace Llama checkpoint -> torchstore_tpu flax params.
+
+The reference's end-to-end model test loads an HF model and pushes its
+state dict through the store (/root/reference/tests/test_models.py:33-136).
+This converter provides the same interop for the jax model family: map a
+``transformers`` Llama/Mixtral-style state dict (torch CPU tensors or numpy)
+onto ``torchstore_tpu.models.llama.Llama`` params, so HF checkpoints can be
+published through the store and served by the flax model. Logits parity with
+the HF implementation is covered by tests/test_hf_convert.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from torchstore_tpu.models.llama import LlamaConfig
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    try:
+        return t.detach().cpu().numpy()  # torch tensor
+    except AttributeError:
+        return np.asarray(t)
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig-like object."""
+    head_dim = getattr(hf_config, "head_dim", None) or (
+        hf_config.hidden_size // hf_config.num_attention_heads
+    )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        head_dim=head_dim,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+    )
+
+
+def convert_hf_llama(
+    hf_state_dict: Mapping[str, Any], cfg: LlamaConfig
+) -> dict:
+    """Map an HF ``LlamaForCausalLM.state_dict()`` onto our param tree.
+
+    Weight layout notes: HF linear weights are (out, in) — ours are flax
+    DenseGeneral kernels (in, ...out); attention projections reshape the
+    flat head dim into (heads, head_dim). HF's rotate-half RoPE convention
+    matches ``models.llama.rope`` (verified by logits parity)."""
+    sd = {k: _to_np(v) for k, v in hf_state_dict.items()}
+    h, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def w(name: str) -> np.ndarray:
+        return sd[name]
+
+    params: dict = {
+        "embed": {"embedding": w("model.embed_tokens.weight")},
+        "final_norm": {"scale": w("model.norm.weight")},
+        "lm_head": {
+            "kernel": (
+                w("lm_head.weight")
+                if "lm_head.weight" in sd
+                else w("model.embed_tokens.weight")  # tied embeddings
+            ).T
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        layer = {
+            "attn_norm": {"scale": w(pre + "input_layernorm.weight")},
+            "mlp_norm": {"scale": w(pre + "post_attention_layernorm.weight")},
+            "attn": {
+                "q_proj": {
+                    "kernel": w(pre + "self_attn.q_proj.weight").T.reshape(h, nh, hd)
+                },
+                "k_proj": {
+                    "kernel": w(pre + "self_attn.k_proj.weight").T.reshape(h, nkv, hd)
+                },
+                "v_proj": {
+                    "kernel": w(pre + "self_attn.v_proj.weight").T.reshape(h, nkv, hd)
+                },
+                "o_proj": {
+                    "kernel": w(pre + "self_attn.o_proj.weight").T.reshape(nh, hd, h)
+                },
+            },
+            "mlp": {
+                "gate_proj": {"kernel": w(pre + "mlp.gate_proj.weight").T},
+                "up_proj": {"kernel": w(pre + "mlp.up_proj.weight").T},
+                "down_proj": {"kernel": w(pre + "mlp.down_proj.weight").T},
+            },
+        }
+        params[f"layer_{i}"] = layer
+    return {"params": params}

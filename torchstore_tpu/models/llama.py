@@ -38,6 +38,11 @@ class LlamaConfig:
     # MoE (Mixtral-style): 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Long-context attention: "dense" | "ring" | "ulysses". The sharded
+    # impls engage when ``mesh`` has an sp axis of size > 1 (sequence
+    # parallelism); otherwise dense is used.
+    attn_impl: str = "dense"
+    mesh: Any = None
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -134,8 +139,7 @@ class Attention(nn.Module):
         k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj", ("embed", "kv_heads", None))(x)
         v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj", ("embed", "kv_heads", None))(x)
         q, k = rope(q, k, positions, cfg.rope_theta)
-        # Flash-attention kernel on TPU; GQA handled natively.
-        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        out = _attend(cfg, q, k, v)
         out = nn.DenseGeneral(
             cfg.hidden_size,
             axis=(-2, -1),
@@ -148,6 +152,45 @@ class Attention(nn.Module):
             name="o_proj",
         )(out)
         return out
+
+
+def _attend(cfg: LlamaConfig, q, k, v):
+    """Causal attention dispatch: dense flash kernel, or sequence-parallel
+    ring / Ulysses over the mesh's sp axis for long contexts."""
+    use_sp = (
+        cfg.attn_impl in ("ring", "ulysses")
+        and cfg.mesh is not None
+        and "sp" in cfg.mesh.axis_names
+        and cfg.mesh.shape["sp"] > 1
+    )
+    if not use_sp:
+        # Flash-attention kernel on TPU; GQA handled natively.
+        return jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    from torchstore_tpu.ops._sharded import make_sharded_attention
+    from torchstore_tpu.ops.ring_attention import ring_attention
+    from torchstore_tpu.ops.ulysses_attention import ulysses_attention
+
+    sp_size = cfg.mesh.shape["sp"]
+    if cfg.attn_impl == "ulysses" and cfg.num_heads % sp_size != 0:
+        raise ValueError(
+            f"ulysses attention needs num_heads ({cfg.num_heads}) divisible "
+            f"by the sp axis size ({sp_size}); use attn_impl='ring' for "
+            "smaller head counts"
+        )
+    rep = cfg.num_heads // cfg.num_kv_heads
+    if rep > 1:  # the sharded bodies need equal head counts
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # Keep heads tensor-parallel inside the shard_map (the bodies only
+    # collective over sp) instead of redundantly all-gathering over tp.
+    head_axis = None
+    if "tp" in cfg.mesh.axis_names:
+        tp_size = cfg.mesh.shape["tp"]
+        if tp_size > 1 and cfg.num_heads % tp_size == 0:
+            head_axis = "tp"
+    body = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+    fn = make_sharded_attention(body, cfg.mesh, "sp", True, head_axis)
+    return fn(q, k, v)
 
 
 class MLP(nn.Module):

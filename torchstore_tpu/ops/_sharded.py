@@ -6,10 +6,13 @@ import functools
 
 
 @functools.cache
-def make_sharded_attention(body, mesh, axis_name: str, causal: bool):
-    """jit(shard_map(body)) over (q, k, v) sequence-sharded on
-    ``axis_name``. Cached per (body, mesh, axis, causal) so repeat calls
-    reuse the compiled executable."""
+def make_sharded_attention(
+    body, mesh, axis_name: str, causal: bool, head_axis: str | None = None
+):
+    """jit(shard_map(body)) over (q, k, v) sequence-sharded on ``axis_name``
+    (and optionally head-sharded on ``head_axis`` — tensor-parallel heads
+    compose with both bodies since they only collective over the sequence
+    axis). Cached so repeat calls reuse the compiled executable."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -18,7 +21,7 @@ def make_sharded_attention(body, mesh, axis_name: str, causal: bool):
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    spec = P(None, axis_name, None, None)
+    spec = P(None, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(body, axis_name=axis_name, causal=causal),
         mesh=mesh,

@@ -16,7 +16,6 @@ tests/test_ring_attention.py differential tests).
 
 from __future__ import annotations
 
-import functools
 import math
 
 
