@@ -1,0 +1,97 @@
+"""Large-tensor transport sweep: put/get latency + GB/s across sizes and
+transports, CSV output — the reference's benchmark machinery
+(/root/reference/tests/test_large_tensors.py:38-104 generate_benchmark)
+as a standalone harness. Run:
+
+    python benchmarks/sweep.py [--sizes-mb 4,64,256] [--out sweep.csv]
+"""
+
+import argparse
+import asyncio
+import csv
+import sys
+import time
+
+import numpy as np
+
+
+async def run(sizes_mb: list[int], out_path: str) -> None:
+    import torchstore_tpu as ts
+
+    rows = []
+    for transport in ("shm", "bulk", "rpc"):
+        await ts.initialize(
+            store_name="sweep",
+            strategy=ts.SingletonStrategy(default_transport_type=transport),
+        )
+        try:
+            for size_mb in sizes_mb:
+                n = size_mb * 1024 * 1024 // 4
+                x = np.random.rand(n).astype(np.float32)
+                dest = np.zeros_like(x)
+                # warm (allocations, segment creation, connections)
+                await ts.put("k", x, store_name="sweep")
+                await ts.get("k", like=dest, store_name="sweep")
+                t0 = time.perf_counter()
+                await ts.put("k", x, store_name="sweep")
+                t1 = time.perf_counter()
+                await ts.get("k", like=dest, store_name="sweep")
+                t2 = time.perf_counter()
+                assert dest[0] == x[0]
+                rows.append(
+                    {
+                        "transport": transport,
+                        "size_mb": size_mb,
+                        "put_s": round(t1 - t0, 5),
+                        "get_s": round(t2 - t1, 5),
+                        "put_gbps": round(x.nbytes / 1e9 / (t1 - t0), 3),
+                        "get_gbps": round(x.nbytes / 1e9 / (t2 - t1), 3),
+                    }
+                )
+                print(f"# {rows[-1]}", file=sys.stderr)
+                await ts.delete("k", store_name="sweep")
+        finally:
+            await ts.shutdown("sweep")
+
+    # Direct one-hop steady state for the largest size.
+    size_mb = sizes_mb[-1]
+    n = size_mb * 1024 * 1024 // 4
+    sd = {"w": np.random.rand(n).astype(np.float32)}
+    user = {"w": np.zeros(n, np.float32)}
+    await ts.initialize(store_name="sweep")
+    try:
+        await ts.put_state_dict("d", sd, direct=True, store_name="sweep")
+        await ts.get_state_dict("d", user_state_dict=user, direct=True, store_name="sweep")
+        t0 = time.perf_counter()
+        await ts.put_state_dict("d", sd, direct=True, store_name="sweep")
+        t1 = time.perf_counter()
+        await ts.get_state_dict("d", user_state_dict=user, direct=True, store_name="sweep")
+        t2 = time.perf_counter()
+        rows.append(
+            {
+                "transport": "direct",
+                "size_mb": size_mb,
+                "put_s": round(t1 - t0, 5),
+                "get_s": round(t2 - t1, 5),
+                "put_gbps": round(sd["w"].nbytes / 1e9 / (t1 - t0), 3),
+                "get_gbps": round(sd["w"].nbytes / 1e9 / (t2 - t1), 3),
+            }
+        )
+        print(f"# {rows[-1]}", file=sys.stderr)
+    finally:
+        await ts.shutdown("sweep")
+
+    with open(out_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    print(f"wrote {len(rows)} rows to {out_path}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes-mb", default="4,64,256")
+    parser.add_argument("--out", default="benchmarks/sweep.csv")
+    args = parser.parse_args()
+    sizes = [int(s) for s in args.sizes_mb.split(",")]
+    asyncio.run(run(sizes, args.out))

@@ -78,6 +78,37 @@ def test_roundtrip_through_store(hf_model):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+def test_mixtral_logits_parity():
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    model.eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.num_experts == 4 and cfg.num_experts_per_tok == 2
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    tokens = np.array([[2, 7, 1, 8, 2, 8, 1, 8]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = Llama(cfg).apply(params, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=5e-4, rtol=5e-4)
+
+
 def test_tied_embeddings_fallback():
     hf_cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,

@@ -135,6 +135,30 @@ async def test_failed_put_leaves_store_consistent():
         await ts.shutdown("consist")
 
 
+async def test_dcn_bind_and_advertise_env():
+    # Cross-host wiring on one machine: volumes bind 0.0.0.0 and must
+    # advertise a reachable address; the full data path still works.
+    import os
+
+    os.environ["TORCHSTORE_TPU_BIND_HOST"] = "0.0.0.0"
+    os.environ["TORCHSTORE_TPU_ADVERTISE_HOST"] = "127.0.0.1"
+    try:
+        await ts.initialize(store_name="dcn")
+        try:
+            client = ts.client("dcn")
+            await client._ensure_setup()
+            ref = next(iter(client._volume_refs.values()))
+            assert ref.actor.host == "127.0.0.1"  # advertised, not 0.0.0.0
+            x = np.random.rand(1024, 256).astype(np.float32)  # 1 MB
+            await ts.put("w", x, store_name="dcn")
+            np.testing.assert_array_equal(await ts.get("w", store_name="dcn"), x)
+        finally:
+            await ts.shutdown("dcn")
+    finally:
+        del os.environ["TORCHSTORE_TPU_BIND_HOST"]
+        del os.environ["TORCHSTORE_TPU_ADVERTISE_HOST"]
+
+
 async def test_partial_commit_counts_as_exists_but_not_readable():
     # Fault-injection analog of the reference's ranks_to_skip_put helper:
     # one missing shard keeps the key readable=False, exists=True.

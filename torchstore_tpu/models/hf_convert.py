@@ -28,7 +28,18 @@ def _to_np(t) -> np.ndarray:
 
 
 def config_from_hf(hf_config) -> LlamaConfig:
-    """LlamaConfig from a transformers LlamaConfig-like object."""
+    """LlamaConfig from a transformers Llama/Mixtral config object."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not implemented by models.llama.rope "
+            "— converting this checkpoint would produce silently wrong logits"
+        )
+    if getattr(hf_config, "attention_bias", False):
+        raise NotImplementedError(
+            "attention_bias=True checkpoints (Qwen2-style) are not "
+            "representable by this model family (attention is bias-free)"
+        )
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
@@ -44,6 +55,8 @@ def config_from_hf(hf_config) -> LlamaConfig:
         head_dim=head_dim,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+        num_experts=getattr(hf_config, "num_local_experts", 0),
+        num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
     )
 
 
@@ -58,8 +71,10 @@ def convert_hf_llama(
     matches ``models.llama.rope`` (verified by logits parity)."""
     sd = {k: _to_np(v) for k, v in hf_state_dict.items()}
     h, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    consumed: set = set()
 
     def w(name: str) -> np.ndarray:
+        consumed.add(name)
         return sd[name]
 
     params: dict = {
@@ -92,11 +107,43 @@ def convert_hf_llama(
                     "kernel": w(pre + "self_attn.o_proj.weight").T.reshape(nh, hd, h)
                 },
             },
-            "mlp": {
+        }
+        if cfg.num_experts:
+            # Mixtral: per-expert w1/w3/w2 linears stack into our
+            # (expert, in, out) kernels; the router gate transposes.
+            moe = pre + "block_sparse_moe."
+            layer["mlp"] = {
+                "router": {"kernel": w(moe + "gate.weight").T},
+                "gate_proj": np.stack(
+                    [w(f"{moe}experts.{e}.w1.weight").T for e in range(cfg.num_experts)]
+                ),
+                "up_proj": np.stack(
+                    [w(f"{moe}experts.{e}.w3.weight").T for e in range(cfg.num_experts)]
+                ),
+                "down_proj": np.stack(
+                    [w(f"{moe}experts.{e}.w2.weight").T for e in range(cfg.num_experts)]
+                ),
+            }
+        else:
+            layer["mlp"] = {
                 "gate_proj": {"kernel": w(pre + "mlp.gate_proj.weight").T},
                 "up_proj": {"kernel": w(pre + "mlp.up_proj.weight").T},
                 "down_proj": {"kernel": w(pre + "mlp.down_proj.weight").T},
-            },
-        }
+            }
         params[f"layer_{i}"] = layer
+
+    # Any unmapped weight means the checkpoint has structure this model
+    # cannot represent — fail loudly instead of converting to silently
+    # wrong params (rotary inv_freq buffers are derived, safe to drop).
+    leftover = {
+        k
+        for k in sd
+        if k not in consumed and not k.endswith("rotary_emb.inv_freq")
+    }
+    if leftover:
+        raise ValueError(
+            f"{len(leftover)} checkpoint tensors have no mapping onto this "
+            f"model (first few: {sorted(leftover)[:4]}); the architectures "
+            "do not match"
+        )
     return {"params": params}

@@ -171,23 +171,32 @@ def _attend(cfg: LlamaConfig, q, k, v):
     from torchstore_tpu.ops.ulysses_attention import ulysses_attention
 
     sp_size = cfg.mesh.shape["sp"]
-    if cfg.attn_impl == "ulysses" and cfg.num_heads % sp_size != 0:
-        raise ValueError(
-            f"ulysses attention needs num_heads ({cfg.num_heads}) divisible "
-            f"by the sp axis size ({sp_size}); use attn_impl='ring' for "
-            "smaller head counts"
-        )
-    rep = cfg.num_heads // cfg.num_kv_heads
-    if rep > 1:  # the sharded bodies need equal head counts
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     # Keep heads tensor-parallel inside the shard_map (the bodies only
     # collective over sp) instead of redundantly all-gathering over tp.
+    # Both q and kv head counts must divide tp for that.
     head_axis = None
+    tp_size = 1
     if "tp" in cfg.mesh.axis_names:
-        tp_size = cfg.mesh.shape["tp"]
-        if tp_size > 1 and cfg.num_heads % tp_size == 0:
+        size = cfg.mesh.shape["tp"]
+        if (
+            size > 1
+            and cfg.num_heads % size == 0
+            and cfg.num_kv_heads % size == 0
+        ):
             head_axis = "tp"
+            tp_size = size
+    if cfg.attn_impl == "ulysses":
+        # Divisibility applies to the SHARD-LOCAL head counts (after any tp
+        # split); kv heads pass through unrepeated (GQA-native).
+        local_heads = cfg.num_heads // tp_size
+        local_kv = cfg.num_kv_heads // tp_size
+        if local_heads % sp_size != 0 or local_kv % sp_size != 0:
+            raise ValueError(
+                f"ulysses attention needs per-shard head counts "
+                f"(q={local_heads}, kv={local_kv}) divisible by the sp axis "
+                f"size ({sp_size}); use attn_impl='ring' for smaller head "
+                "counts"
+            )
     body = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
     fn = make_sharded_attention(body, cfg.mesh, "sp", True, head_axis)
     return fn(q, k, v)

@@ -21,9 +21,11 @@ import math
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Per-shard attention bodies. Shapes (inside shard_map, per device):
-    q, k, v: (batch, seq_local, heads, head_dim) -> (batch, seq_local,
-    heads, head_dim). GQA (fewer kv heads) is supported by repeating kv
-    heads before the call."""
+    q: (batch, seq_local, heads, head_dim), k/v: (batch, seq_local,
+    kv_heads, head_dim) -> (batch, seq_local, heads, head_dim). GQA is
+    handled natively via grouped einsums — the ring rotates the UNREPEATED
+    kv blocks, so GQA's bandwidth/memory saving survives sequence
+    parallelism."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -31,32 +33,36 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    if h % hk != 0:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({hk})")
+    g = h // hk
     scale = 1.0 / math.sqrt(d)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    q32 = q.astype(jnp.float32)
+    # Grouped layout: (b, sq, hk, g, d) so kv heads broadcast per group.
+    q32 = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
     NEG = jnp.float32(-1e30)
 
     q_pos = my_idx * sq + jnp.arange(sq)  # global query positions
 
     def accumulate(carry, k_cur, v_cur, i):
-        o, m, l = carry
+        o, m, l = carry  # o: (b,hk,g,sq,d); m,l: (b,hk,g,sq)
         # k_cur originated on device (my_idx - i) mod n.
         src = (my_idx - i) % n
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)
+            "bqhgd,bkhd->bhgqk", q32, k_cur.astype(jnp.float32)
         ) * scale
         if causal:
             k_pos = src * sk + jnp.arange(sk)
             mask = q_pos[:, None] >= k_pos[None, :]  # (sq, sk)
-            s = jnp.where(mask[None, None], s, NEG)
+            s = jnp.where(mask[None, None, None], s, NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+            "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32)
         )
         return o, m_new, l
 
@@ -69,15 +75,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         o, m, l = accumulate((o, m, l), k_cur, v_cur, i)
         return o, m, l, k_cur, v_cur
 
-    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    m0 = jnp.full((b, h, sq), NEG)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, hk, g, sq, d), jnp.float32)
+    m0 = jnp.full((b, hk, g, sq), NEG)
+    l0 = jnp.zeros((b, hk, g, sq), jnp.float32)
     o0, m0, l0 = (_mark_varying(lax, x, axis_name) for x in (o0, m0, l0))
     # Step 0: own (unrotated) block, outside the loop.
     o0, m0, l0 = accumulate((o0, m0, l0), k, v, 0)
     o, m, l, _, _ = lax.fori_loop(1, n, step, (o0, m0, l0, k, v))
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # (b,hk,g,sq,d)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, sq, h, d)
+    return out.astype(q.dtype)
 
 
 def _mark_varying(lax, x, axis_name: str):

@@ -17,8 +17,10 @@ import functools
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
-    """Inside shard_map: q/k/v (batch, seq_local, heads, head_dim) sequence-
-    sharded -> same shape, exact attention over the full sequence."""
+    """Inside shard_map: q (batch, seq_local, heads, head_dim) and k/v
+    (batch, seq_local, kv_heads, head_dim) sequence-sharded -> q-shaped
+    output. GQA passes through natively (kv heads split over the axis like
+    q heads; the inner dense attention handles the grouping)."""
     import jax
     from jax import lax
 
@@ -51,7 +53,6 @@ def ulysses_attention_sharded(
             raise ValueError(
                 f"ulysses attention needs {name} heads ({arr.shape[2]}) "
                 f"divisible by the {axis_name!r} axis size ({axis_size}); "
-                "repeat kv heads for GQA, or use ring attention for head "
-                "counts below the ring size"
+                "use ring attention for head counts below the ring size"
             )
     return make_sharded_attention(ulysses_attention, mesh, axis_name, causal)(q, k, v)
