@@ -88,15 +88,22 @@ async def test_rendezvous_kv():
         await server.stop()
 
 
-def _spmd_worker(rank: int, world: int, port: int, result_dir: str) -> None:
+def _spmd_worker(
+    rank: int, world: int, port: int, result_dir: str, local_world: int = 0
+) -> None:
+    local_world = local_world or world
     env = {
         "RANK": str(rank),
-        "LOCAL_RANK": str(rank),
+        "LOCAL_RANK": str(rank % local_world),
         "WORLD_SIZE": str(world),
-        "LOCAL_WORLD_SIZE": str(world),
+        "LOCAL_WORLD_SIZE": str(local_world),
         "MASTER_ADDR": "127.0.0.1",
         "MASTER_PORT": str(port),
     }
+    if local_world != world:
+        # Emulated multi-host on one machine: volumes bind 0.0.0.0; the
+        # advertised address must still be reachable.
+        env["TORCHSTORE_TPU_ADVERTISE_HOST"] = "127.0.0.1"
     os.environ.update(env)
     result = {"rank": rank, "ok": False}
     try:
@@ -132,14 +139,20 @@ async def _spmd_scenario(rank: int, world: int, result: dict) -> None:
     result["ok"] = True
 
 
-@pytest.mark.parametrize("world", [2, 4])
-def test_spmd_full_lifecycle(tmp_path, world):
+@pytest.mark.parametrize(
+    "world,local_world",
+    [(2, 2), (4, 4), (4, 2)],
+    ids=["1host-2rank", "1host-4rank", "2hosts-2ranks"],
+)
+def test_spmd_full_lifecycle(tmp_path, world, local_world):
     port = get_free_port()
     ctx = mp.get_context("spawn")
     # Not daemonic: workers spawn their own volume actor children.
     procs = [
         ctx.Process(
-            target=_spmd_worker, args=(r, world, port, str(tmp_path)), daemon=False
+            target=_spmd_worker,
+            args=(r, world, port, str(tmp_path), local_world),
+            daemon=False,
         )
         for r in range(world)
     ]
