@@ -1,0 +1,174 @@
+"""Pallas flash attention for TPU.
+
+Blockwise causal/full attention as an explicit Pallas kernel: q/k/v stream
+through VMEM in (block_q x d) / (block_k x d) tiles, scores hit the MXU via
+``dot_general`` in fp32, and the online-softmax state (running max, running
+denominator, fp32 accumulator) lives in VMEM scratch that persists across
+the innermost k-block grid dimension (TPU grids execute sequentially, so
+the scratch carries between j-steps of the same q block). Causal q-blocks
+skip k-blocks entirely above the diagonal and mask only the diagonal block.
+
+This is the single-device inner kernel of the attention stack: the
+sequence-parallel layers (``ring_attention`` / ``ulysses_attention``) handle
+cross-device movement, and their per-device block math is exactly what this
+kernel computes. Off-TPU it runs in interpret mode (tested against dense
+attention); on TPU it compiles to a fused VMEM-resident loop.
+
+Layout: (batch, seq, heads, head_dim) in, same out. GQA maps kv heads via
+the BlockSpec index maps (no repetition). Block sizes must divide the
+sequence lengths (and causal needs sq <= sk); the public wrapper falls back
+to dense attention otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (innermost: scratch carries across j)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    should_run = True if not causal else (j <= i)
+
+    @pl.when(should_run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (block_q, block_k)
+        if causal:
+            # Only the diagonal block needs masking: for j < i every q
+            # position is strictly after every k position (block_q ==
+            # block_k is enforced by the wrapper).
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+            s_eff = jnp.where((j < i) | (rows >= cols), s, NEG_INF)
+        else:
+            s_eff = s
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_eff, axis=1, keepdims=True))
+        p = jnp.exp(s_eff - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, 0:1] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:, 0:1] = m_new
+
+    last_j = i if causal else pl.num_programs(2) - 1
+
+    @pl.when(j == last_j)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.cache
+def _jitted(causal: bool, block_q: int, block_k: int, interpret: bool):
+    import jax
+
+    return jax.jit(
+        functools.partial(
+            _flash,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+        )
+    )
+
+
+def _flash(q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    g = h // hk
+    scale = 1.0 / math.sqrt(d)
+
+    # (b, s, h, d) -> (b*h, s, d) flattened per-head programs.
+    qf = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, sq, d)
+    kf = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * hk, sk, d)
+    vf = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * hk, sk, d)
+
+    def kv_index(bh, i, j):
+        # GQA: q program bh = batch*h + head; its kv row is batch*hk + head//g.
+        return (bh // h) * hk + (bh % h) // g, j, 0
+
+    grid = (b * h, sq // block_q, sk // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # fp32 accumulator
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Pallas flash attention; falls back to ``jax.nn.dot_product_attention``
+    when shapes don't tile (seq not divisible by blocks, tiny head_dim)."""
+    import jax
+
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if (
+        sq % block_q != 0
+        or sk % block_k != 0
+        or block_q != block_k
+        or h % hk != 0
+        or d % 8 != 0
+        # Causal with sq > sk would leave q-blocks past the last k-block
+        # unwritten (their diagonal lies outside the j grid).
+        or (causal and sq > sk)
+    ):
+        return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _jitted(causal, block_q, block_k, interpret)(q, k, v)
