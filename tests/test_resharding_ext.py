@@ -1,0 +1,68 @@
+"""Extended resharding matrix: all shard-dim permutations across mesh
+shapes (reference tests/test_resharding_ext.py:19-133); the full cross
+product is gated by TORCHSTORE_TPU_ENABLE_SLOW_TESTS like the reference's
+slow-test env gate."""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+
+jax = pytest.importorskip("jax")
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+GLOBAL = np.arange(16 * 16 * 8, dtype=np.float32).reshape(16, 16, 8)
+
+MESHES = [((8,), ("x",)), ((2, 4), ("x", "y")), ((4, 2), ("x", "y"))]
+# Specs shard dims 0/1 over available axes in every permutation.
+SPECS_1D = [P("x"), P(None, "x"), P()]
+SPECS_2D = [P("x", "y"), P("y", "x"), P("x"), P(None, "y"), P()]
+
+
+def cases():
+    out = []
+    for (sshape, snames), (dshape, dnames) in itertools.product(MESHES, MESHES):
+        sspecs = SPECS_1D if len(sshape) == 1 else SPECS_2D
+        dspecs = SPECS_1D if len(dshape) == 1 else SPECS_2D
+        for sspec, dspec in itertools.product(sspecs, dspecs):
+            out.append((sshape, snames, sspec, dshape, dnames, dspec))
+    return out
+
+
+ALL_CASES = cases()
+if not os.environ.get("TORCHSTORE_TPU_ENABLE_SLOW_TESTS"):
+    # Representative subset for CI; full matrix under the slow gate.
+    ALL_CASES = ALL_CASES[:: max(1, len(ALL_CASES) // 12)]
+
+
+@pytest.fixture(scope="module")
+def anyio_backend():
+    # Module-scoped override so the module-scoped store fixture can be async.
+    return "asyncio"
+
+
+@pytest.fixture(scope="module")
+async def store(anyio_backend):
+    await ts.initialize(store_name="rext")
+    yield "rext"
+    await ts.shutdown("rext")
+
+
+def _sharded(value, shape, names, spec):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return jax.device_put(value, NamedSharding(Mesh(devs, names), spec))
+
+
+@pytest.mark.parametrize("case", ALL_CASES, ids=lambda c: f"{c[0]}{c[2]}->{c[3]}{c[5]}")
+async def test_permutation(store, case):
+    sshape, snames, sspec, dshape, dnames, dspec = case
+    src = _sharded(GLOBAL, sshape, snames, sspec)
+    await ts.put("w", src, store_name=store)
+    like = _sharded(np.zeros_like(GLOBAL), dshape, dnames, dspec)
+    out = await ts.get("w", like=like, store_name=store)
+    np.testing.assert_array_equal(np.asarray(out), GLOBAL)
+    assert out.sharding == like.sharding
+    await ts.delete("w", store_name=store)

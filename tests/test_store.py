@@ -197,6 +197,38 @@ async def test_concurrent_puts_and_gets(store):
     await asyncio.gather(*(one(i) for i in range(16)))
 
 
+class _KeysActor(Actor):
+    def __init__(self):
+        import os
+
+        self.rank = int(os.environ["RANK"])
+
+    @endpoint
+    async def put_keys(self):
+        await ts.put(f"ns/rank{self.rank}/a", np.ones(1), store_name="t")
+        await ts.put(f"ns/rank{self.rank}/b", np.ones(1), store_name="t")
+
+    @endpoint
+    async def list_prefix(self, prefix):
+        return await ts.keys(prefix, store_name="t")
+
+
+async def test_keys_multi_process(store):
+    # Prefix listing across writer processes (reference tests/test_keys.py).
+    actors = await spawn_actors(2, _KeysActor, "keysactors")
+    try:
+        await actors.put_keys.call()
+        listed = await actors[0].list_prefix.call_one("ns")
+        assert listed == [
+            "ns/rank0/a", "ns/rank0/b", "ns/rank1/a", "ns/rank1/b",
+        ]
+        assert await ts.keys("ns/rank1", store_name=store) == [
+            "ns/rank1/a", "ns/rank1/b",
+        ]
+    finally:
+        await actors.stop()
+
+
 async def test_controller_stats(store):
     await ts.put("s1", np.ones((4, 4), np.float32), store_name=store)
     await ts.get("s1", store_name=store)
