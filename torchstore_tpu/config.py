@@ -49,12 +49,6 @@ class StoreConfig:
     mutable_shm: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_MUTABLE_SHM", False)
     )
-    # Chunk size for bulk socket transfers (bytes).
-    bulk_chunk_bytes: int = field(
-        default_factory=lambda: _env_int(
-            "TORCHSTORE_TPU_BULK_CHUNK_BYTES", 8 * 1024 * 1024
-        )
-    )
     # Use the native C++ data-path library when built.
     use_native: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_USE_NATIVE", True)

@@ -17,17 +17,20 @@ codec. It reproduces uniflow's hard-won semantics:
   with weakref eviction (torchcomms/cache.py:150-186); the native backend
   pins pages here.
 
-Wire: every frame is ``<session u64><idx u32><nbytes u64>`` + payload,
-chunked at ``config.bulk_chunk_bytes`` with eager drain so large tensors
-pipeline. PUT payloads are pushed before the RPC lands (the volume awaits
-their arrival); GET payloads are streamed by a background task after the RPC
-response so neither side blocks the other (deadlock-free for arbitrarily
-large transfers).
+IO rides RAW non-blocking sockets via ``loop.sock_sendall`` /
+``sock_recv_into`` — payload bytes go kernel<->array with no user-space
+staging copies (asyncio streams would add a transport-buffer copy per
+direction, which measurably halves loopback throughput). Wire format:
+``<session u64><idx u32><nbytes u64>`` + payload. PUT payloads are pushed
+before the RPC lands (the volume awaits their arrival); GET payloads are
+streamed by a background task after the RPC response so neither side blocks
+the other (deadlock-free for arbitrarily large transfers).
 """
 
 from __future__ import annotations
 
 import asyncio
+import socket
 import struct
 import time
 import uuid
@@ -77,22 +80,55 @@ def _now() -> float:
     return time.monotonic()
 
 
+# --------------------------------------------------------------------------
+# raw-socket IO helpers
+# --------------------------------------------------------------------------
+
+
+async def _recv_exact(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket (kernel -> destination, no staging)."""
+    loop = asyncio.get_running_loop()
+    pos = 0
+    total = view.nbytes
+    while pos < total:
+        n = await loop.sock_recv_into(sock, view[pos:])
+        if n == 0:
+            raise ConnectionError("bulk peer closed mid-frame")
+        pos += n
+
+
 async def _send_frame(
-    writer: asyncio.StreamWriter,
+    sock: socket.socket,
     lock: asyncio.Lock,
     session: int,
     idx: int,
     payload: Optional[memoryview],
-    chunk: int,
 ) -> None:
+    loop = asyncio.get_running_loop()
     async with lock:
         nbytes = payload.nbytes if payload is not None else 0
-        writer.write(_FRAME.pack(session, idx, nbytes))
+        await loop.sock_sendall(sock, _FRAME.pack(session, idx, nbytes))
         if payload is not None:
-            for off in range(0, nbytes, chunk):
-                writer.write(payload[off : off + chunk])
-                await writer.drain()
-        await writer.drain()
+            await loop.sock_sendall(sock, payload)
+
+
+def _close_sock(sock: Optional[socket.socket]) -> None:
+    if sock is not None:
+        try:
+            # shutdown() first: it wakes any coroutine parked in
+            # sock_sendall/sock_recv_into on this fd with an error, where a
+            # bare close() would leave it stranded (epoll drops closed fds).
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _family_for(host: str) -> int:
+    return socket.AF_INET6 if ":" in host else socket.AF_INET
 
 
 # --------------------------------------------------------------------------
@@ -105,7 +141,8 @@ class BulkServer:
     streams get payloads back over the client's registered connection."""
 
     def __init__(self) -> None:
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_task: Optional[asyncio.Task] = None
         self.port: Optional[int] = None
         self.host: str = "127.0.0.1"
         # (session, idx) -> bytearray of landed payload
@@ -113,31 +150,36 @@ class BulkServer:
         self.aborted: set[int] = set()
         self._session_ts: dict[int, float] = {}  # last activity per session
         self._arrival = asyncio.Condition()
-        # client_id -> (writer, write_lock) for outgoing get payloads
-        self.client_conns: dict[int, tuple[asyncio.StreamWriter, asyncio.Lock]] = {}
-        # session -> (writer, write_lock): exact routing for get sessions
-        self.session_conns: dict[int, tuple[asyncio.StreamWriter, asyncio.Lock]] = {}
+        # client_id -> (sock, write_lock) for outgoing get payloads
+        self.client_conns: dict[int, tuple[socket.socket, asyncio.Lock]] = {}
+        # session -> (sock, write_lock): exact routing for get sessions
+        self.session_conns: dict[int, tuple[socket.socket, asyncio.Lock]] = {}
+        self._conn_tasks: set[asyncio.Task] = set()
         self._send_tasks: set[asyncio.Task] = set()
 
     async def ensure_started(self, bind_host: str) -> tuple[str, int]:
-        if self._server is None:
+        if self._listen_sock is None:
             import os
-            import socket as _socket
 
-            self._server = await asyncio.start_server(
-                self._handle_conn, bind_host, 0, limit=2**20
-            )
+            sock = socket.socket(_family_for(bind_host), socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((bind_host, 0))
+            sock.listen(64)
+            sock.setblocking(False)
+            self._listen_sock = sock
+            self.port = sock.getsockname()[1]
             # Advertise a REACHABLE address, not the bind address: a volume
             # bound to 0.0.0.0 (cross-host DCN) must hand clients its real
             # hostname/IP (TORCHSTORE_TPU_ADVERTISE_HOST overrides).
             advertise = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST")
             if advertise is None:
-                if bind_host in ("0.0.0.0", "::"):
-                    advertise = _socket.gethostname()
-                else:
-                    advertise = bind_host
+                advertise = (
+                    socket.gethostname()
+                    if bind_host in ("0.0.0.0", "::")
+                    else bind_host
+                )
             self.host = advertise
-            self.port = self._server.sockets[0].getsockname()[1]
+            self._accept_task = asyncio.ensure_future(self._accept_loop())
             logger.info(
                 "bulk server bound %s:%s (advertised as %s)",
                 bind_host,
@@ -146,28 +188,48 @@ class BulkServer:
             )
         return self.host, self.port
 
-    async def _handle_conn(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
+    async def _accept_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                conn, _ = await loop.sock_accept(self._listen_sock)
+            except asyncio.CancelledError:
+                return
+            except OSError as exc:
+                # Transient accept failures (EMFILE/ECONNABORTED/...): log,
+                # back off, keep accepting — the old asyncio.Server did the
+                # same; dying here would strand every future client.
+                if self._listen_sock is None or self._listen_sock.fileno() < 0:
+                    return  # listener closed: normal shutdown
+                logger.warning("bulk accept failed (%s); retrying in 1s", exc)
+                await asyncio.sleep(1.0)
+                continue
+            conn.setblocking(False)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            task = asyncio.ensure_future(self._handle_conn(conn))
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+
+    async def _handle_conn(self, sock: socket.socket) -> None:
         client_id = None
-        conn_lock = asyncio.Lock()  # serializes all outgoing writes on writer
+        conn_lock = asyncio.Lock()  # serializes all outgoing writes
+        header = bytearray(_FRAME.size)
+        header_view = memoryview(header)
         try:
             while True:
-                header = await reader.readexactly(_FRAME.size)
+                await _recv_exact(sock, header_view)
                 session, idx, nbytes = _FRAME.unpack(header)
                 if idx == IDX_HELLO:
                     client_id = session
-                    self.client_conns[client_id] = (writer, conn_lock)
+                    self.client_conns[client_id] = (sock, conn_lock)
                     continue
                 if idx == IDX_SESSION_OPEN:
                     # Route this session's get payloads back on THIS exact
                     # connection (a client may hold several), then ack so the
                     # client knows routing is in place before it RPCs.
-                    self.session_conns[session] = (writer, conn_lock)
+                    self.session_conns[session] = (sock, conn_lock)
                     self._session_ts[session] = _now()
-                    await _send_frame(
-                        writer, conn_lock, session, IDX_SESSION_OPEN, None, 1
-                    )
+                    await _send_frame(sock, conn_lock, session, IDX_SESSION_OPEN, None)
                     continue
                 if idx == IDX_ABORT:
                     async with self._arrival:
@@ -178,34 +240,25 @@ class BulkServer:
                         self._arrival.notify_all()
                     continue
                 buf = bytearray(nbytes)
-                view = memoryview(buf)
-                pos = 0
-                while pos < nbytes:
-                    chunk = await reader.read(min(nbytes - pos, 4 * 1024 * 1024))
-                    if not chunk:
-                        raise asyncio.IncompleteReadError(b"", nbytes - pos)
-                    view[pos : pos + len(chunk)] = chunk
-                    pos += len(chunk)
+                await _recv_exact(sock, memoryview(buf))
                 async with self._arrival:
                     self.incoming[(session, idx)] = buf
                     self._session_ts[session] = _now()
                     self._purge_stale()
                     self._arrival.notify_all()
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        except (ConnectionError, OSError):
             pass
         finally:
-            if client_id is not None and self.client_conns.get(client_id, (None,))[
-                0
-            ] is writer:
+            if (
+                client_id is not None
+                and self.client_conns.get(client_id, (None,))[0] is sock
+            ):
                 self.client_conns.pop(client_id, None)
             for sess in [
-                s for s, (w, _) in self.session_conns.items() if w is writer
+                s for s, (c, _) in self.session_conns.items() if c is sock
             ]:
                 self.session_conns.pop(sess, None)
-            try:
-                writer.close()
-            except Exception:
-                pass
+            _close_sock(sock)
 
     def _purge_stale(self) -> None:
         """Drop per-session state older than SESSION_TTL_S (client crashed
@@ -240,7 +293,7 @@ class BulkServer:
                 self._session_ts.pop(session, None)
 
     def send_background(
-        self, client_id: int, session: int, payloads: dict[int, np.ndarray], chunk: int
+        self, client_id: int, session: int, payloads: dict[int, np.ndarray]
     ) -> None:
         """Stream get payloads without blocking the RPC response (avoiding
         the write-write deadlock for payloads larger than socket buffers)."""
@@ -251,13 +304,16 @@ class BulkServer:
             raise ConnectionError(
                 f"no bulk connection registered for client {client_id}"
             )
-        writer, lock = conn
+        sock, lock = conn
 
         async def _send() -> None:
             try:
-                for idx, arr in payloads.items():
-                    view = memoryview(np.ascontiguousarray(arr)).cast("B")
-                    await _send_frame(writer, lock, session, idx, view, chunk)
+                # Bounded: a peer that stops reading must not pin this task
+                # (and its payload memory) forever.
+                async with asyncio.timeout(SESSION_TTL_S):
+                    for idx, arr in payloads.items():
+                        view = memoryview(np.ascontiguousarray(arr)).cast("B")
+                        await _send_frame(sock, lock, session, idx, view)
             except Exception:
                 logger.exception("bulk get send failed (session=%s)", session)
 
@@ -280,9 +336,8 @@ class BulkServerCache(TransportCache):
 
 
 class BulkClientConn:
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        self.reader = reader
-        self.writer = writer
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
         self.write_lock = asyncio.Lock()
         self.closed = False
         # session -> Queue[(idx, bytearray)] for demuxed get payloads
@@ -290,23 +345,21 @@ class BulkClientConn:
         self._reader_task = asyncio.ensure_future(self._demux())
 
     async def _demux(self) -> None:
+        header = bytearray(_FRAME.size)
+        header_view = memoryview(header)
         try:
             while True:
-                header = await self.reader.readexactly(_FRAME.size)
+                await _recv_exact(self.sock, header_view)
                 session, idx, nbytes = _FRAME.unpack(header)
                 buf = bytearray(nbytes)
-                view = memoryview(buf)
-                pos = 0
-                while pos < nbytes:
-                    chunk = await self.reader.read(min(nbytes - pos, 4 * 1024 * 1024))
-                    if not chunk:
-                        raise asyncio.IncompleteReadError(b"", nbytes - pos)
-                    view[pos : pos + len(chunk)] = chunk
-                    pos += len(chunk)
+                if nbytes:
+                    await _recv_exact(self.sock, memoryview(buf))
                 queue = self.sessions.get(session)
                 if queue is not None:
-                    queue.put_nowait((idx, buf if idx not in _CONTROL_IDXS else None))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                    queue.put_nowait(
+                        (idx, buf if idx not in _CONTROL_IDXS else None)
+                    )
+        except (ConnectionError, OSError):
             self.closed = True
             for queue in self.sessions.values():
                 queue.put_nowait((None, None))
@@ -322,14 +375,26 @@ class BulkClientConn:
     def release_session(self, session: int) -> None:
         self.sessions.pop(session, None)
 
-    async def close(self) -> None:
+    def close_now(self) -> None:
         self.closed = True
         self._reader_task.cancel()
-        try:
-            self.writer.close()
-            await self.writer.wait_closed()
-        except Exception:
-            pass
+        _close_sock(self.sock)
+
+
+async def _dial(host: str, port: int, timeout: float) -> socket.socket:
+    loop = asyncio.get_running_loop()
+    # Resolve first so IPv6-only hosts work (AF from the resolved address).
+    infos = await loop.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+    family, _, _, _, sockaddr = infos[0]
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    sock.setblocking(False)
+    try:
+        await asyncio.wait_for(loop.sock_connect(sock, sockaddr), timeout)
+    except BaseException:
+        _close_sock(sock)
+        raise
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
 
 
 class BulkClientCache(TransportCache):
@@ -349,12 +414,7 @@ class BulkClientCache(TransportCache):
 
     def clear(self) -> None:
         for conn in self.connections.values():
-            conn.closed = True
-            conn._reader_task.cancel()
-            try:
-                conn.writer.close()
-            except Exception:
-                pass
+            conn.close_now()
         self.connections.clear()
 
 
@@ -383,7 +443,7 @@ class BulkTransportBuffer(TransportBuffer):
 
     def __getstate__(self):
         # config (a plain dataclass) travels with the buffer: the server-side
-        # hooks read timeouts/chunk sizes from it.
+        # hooks read timeouts from it.
         state = self.__dict__.copy()
         for field in ("_conn", "_queue"):
             state[field] = None
@@ -403,11 +463,9 @@ class BulkTransportBuffer(TransportBuffer):
         # Two-phase: RPC handshake learns the endpoint, then we dial it.
         endpoint = await volume.actor.handshake.call_one(self, [], "bulk_connect")
         host, port = endpoint
-        reader, writer = await asyncio.open_connection(host, port, limit=2**20)
-        conn = BulkClientConn(reader, writer)
-        await _send_frame(
-            writer, conn.write_lock, cache.client_id, IDX_HELLO, None, 1
-        )
+        sock = await _dial(host, port, self.config.handshake_timeout)
+        conn = BulkClientConn(sock)
+        await _send_frame(sock, conn.write_lock, cache.client_id, IDX_HELLO, None)
         self._conn = conn
         self._promoted = False  # handshake-scoped until success
         return conn
@@ -435,12 +493,7 @@ class BulkTransportBuffer(TransportBuffer):
         await self._ensure_conn(volume)
         self._queue = self._conn.register_session(self.session)
         await _send_frame(
-            self._conn.writer,
-            self._conn.write_lock,
-            self.session,
-            IDX_SESSION_OPEN,
-            None,
-            1,
+            self._conn.sock, self._conn.write_lock, self.session, IDX_SESSION_OPEN, None
         )
         # Await the server's ack: the get RPC rides a different TCP stream,
         # so without this the volume could serve the get before routing for
@@ -468,7 +521,6 @@ class BulkTransportBuffer(TransportBuffer):
         regs: ArrayRegistrationCache = volume.transport_context.get_cache(
             ArrayRegistrationCache
         )
-        chunk = self.config.bulk_chunk_bytes
         for idx, req in enumerate(requests):
             if req.is_object:
                 self.objects[idx] = req.objects
@@ -477,20 +529,17 @@ class BulkTransportBuffer(TransportBuffer):
             regs.register(arr)
             self.manifest[idx] = TensorMeta.of(arr)
             await _send_frame(
-                self._conn.writer,
+                self._conn.sock,
                 self._conn.write_lock,
                 self.session,
                 idx,
                 memoryview(arr).cast("B"),
-                chunk,
             )
         self._sent_put = True
 
     # ---- server hooks ----------------------------------------------------
 
-    async def recv_handshake(
-        self, ctx: TransportContext, metas, existing, op: str
-    ):
+    async def recv_handshake(self, ctx: TransportContext, metas, existing, op: str):
         import os
 
         server: BulkServer = ctx.get_cache(BulkServerCache).server
@@ -530,9 +579,7 @@ class BulkTransportBuffer(TransportBuffer):
             self.descriptors[idx] = TensorMeta.of(arr)
             payloads[idx] = arr
         if payloads:
-            server.send_background(
-                self.client_id, self.session, payloads, 4 * 1024 * 1024
-            )
+            server.send_background(self.client_id, self.session, payloads)
 
     # ---- client: get landing --------------------------------------------
 
@@ -556,7 +603,7 @@ class BulkTransportBuffer(TransportBuffer):
             meta = remote.descriptors[idx]
             arr = np.frombuffer(received[idx], dtype=meta.np_dtype).reshape(meta.shape)
             if req.destination_view is not None:
-                np.copyto(req.destination_view, arr)
+                fast_copy(req.destination_view, arr)
                 results.append(req.destination_view)
             else:
                 results.append(arr)
@@ -579,27 +626,20 @@ class BulkTransportBuffer(TransportBuffer):
                     # on a shared promoted connection.
                     try:
                         await _send_frame(
-                            conn.writer, conn.write_lock, session, IDX_ABORT, None, 1
+                            conn.sock, conn.write_lock, session, IDX_ABORT, None
                         )
                     except Exception:
                         pass
                 if not promoted:
                     # Handshake-scoped connection never gets published after
                     # a failure — close it (never poison the cache).
-                    conn._reader_task.cancel()
-                    try:
-                        conn.writer.close()
-                    except Exception:
-                        pass
+                    conn.close_now()
 
             try:
                 asyncio.ensure_future(_cleanup())
             except RuntimeError:  # no running loop (interpreter teardown)
                 if not promoted:
-                    try:
-                        conn.writer.close()
-                    except Exception:
-                        pass
+                    _close_sock(conn.sock)
         self._conn = None
         self.manifest = {}
         self.objects = {}
