@@ -70,12 +70,19 @@ async def initialize(
     strategy: Optional[StoreStrategy] = None,
     store_name: str = DEFAULT_STORE,
     config: Optional[StoreConfig] = None,
+    storage_dir: Optional[str] = None,
+    recover: bool = False,
 ) -> ActorRef:
     """Boot a store: spawn volume actors, the singleton controller, wire them
-    (/root/reference/torchstore/api.py:33-81)."""
+    (/root/reference/torchstore/api.py:33-81). With ``storage_dir`` the
+    volumes persist entries to disk; ``recover=True`` additionally rebuilds
+    the metadata index from what the directory already holds (crash/restart
+    recovery — beyond the reference, whose store is memory-only)."""
     if store_name in _stores:
         raise RuntimeError(f"store {store_name!r} already initialized")
     config = config or default_config()
+    if recover and not storage_dir:
+        raise ValueError("recover=True requires storage_dir")
     set_log_level(config.log_level)
     if config.use_native:
         from torchstore_tpu import native
@@ -85,17 +92,28 @@ async def initialize(
         strategy = (
             SingletonStrategy() if num_storage_volumes == 1 else LocalRankStrategy()
         )
+    # Per-spawn env (NOT process-global os.environ: a failure mid-initialize
+    # or a concurrent initialize must not leak the dir into other stores).
+    volume_env = (
+        {"TORCHSTORE_TPU_STORAGE_DIR": storage_dir} if storage_dir else {}
+    )
     volume_mesh = await spawn_actors(
         num_storage_volumes,
         StorageVolume,
         f"ts_{store_name}_volume",
         strategy,
+        env_fn=lambda rank: volume_env,
     )
     try:
         controller = await get_or_spawn_singleton(
             f"ts_{store_name}_controller", Controller
         )
         await controller.init.call_one(strategy, volume_mesh.refs)
+        if recover:
+            recovered = await controller.rebuild_index.call_one()
+            logger.info(
+                "recovered %d entries from %s", recovered, storage_dir
+            )
     except BaseException:
         # Failed bootstrap must not leak volume processes.
         await volume_mesh.stop()

@@ -235,6 +235,31 @@ class Controller(Actor):
         return sorted(self.index.keys().filter_by_prefix(prefix))
 
     @endpoint
+    async def rebuild_index(self) -> int:
+        """Recover the metadata index from volume manifests (durable
+        backends). Returns the number of entries indexed — the recovery
+        path the reference lacks (its store is memory-only, SURVEY §5)."""
+        import asyncio
+
+        manifests = await asyncio.gather(
+            *(ref.manifest.call_one() for ref in self.volume_refs.values())
+        )
+        count = 0
+        for vid, metas in zip(self.volume_refs.keys(), manifests):
+            for meta in metas:
+                infos = self.index.get(meta.key)
+                if infos is None:
+                    infos = {}
+                    self.index[meta.key] = infos
+                info = infos.get(vid)
+                if info is None:
+                    infos[vid] = StorageInfo.from_meta(meta)
+                else:
+                    info.merge(meta)
+                count += 1
+        return count
+
+    @endpoint
     async def stats(self) -> dict:
         """Store-level observability: counters + index summary."""
         indexed_bytes = 0

@@ -1,0 +1,282 @@
+"""Durable storage backend: a file-backed ``StorageImpl``.
+
+The reference is strictly in-memory (/root/reference/torchstore/
+storage_volume.py:146-151) — volume death loses everything. This backend
+persists entries under a directory and serves tensors as writable
+``np.memmap`` views, so:
+
+- gets read through the page cache (no explicit load step);
+- in-place overwrites (invariant 6) write straight through to disk;
+- a restarted volume pointed at the same directory recovers every entry,
+  and the controller rebuilds its index from volume manifests
+  (``Controller.rebuild_index``) — crash recovery the reference lacks.
+
+Layout: ``<root>/<urlsafe(key)>/meta.pkl`` + ``data.bin`` (tensor) or
+``shard_<i>.bin`` (sharded, coords in meta) or inline object in meta.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.storage_volume import (
+    KeyNotFoundError,
+    StorageImpl,
+)
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+
+_META = "meta.pkl"
+
+
+def _keydir(root: str, key: str) -> str:
+    return os.path.join(
+        root, base64.urlsafe_b64encode(key.encode()).decode().rstrip("=")
+    )
+
+
+def _dir_key(name: str) -> str:
+    pad = "=" * (-len(name) % 4)
+    return base64.urlsafe_b64decode(name + pad).decode()
+
+
+def _shard_file(coords: tuple) -> str:
+    return "shard_" + "_".join(str(c) for c in coords) + ".bin"
+
+
+def _map_file(path: str, dtype, shape, mode: str) -> np.ndarray:
+    """np.memmap that tolerates zero-size arrays (mmap refuses empty files;
+    empty tensors live as meta + plain array)."""
+    import math as _math
+
+    if _math.prod(shape) == 0:
+        return np.empty(shape, dtype=dtype)
+    return np.memmap(path, dtype=dtype, mode=mode, shape=tuple(shape))
+
+
+def _same_memory(a: np.ndarray, b: np.ndarray) -> bool:
+    """True when both arrays cover the same buffer (np.asarray of a memmap
+    returns a plain-ndarray VIEW, so object identity is not enough — and
+    re-persisting would truncate the very file the source view maps)."""
+    return (
+        a.__array_interface__["data"][0] == b.__array_interface__["data"][0]
+        and a.nbytes == b.nbytes
+    )
+
+
+class FileBackedStore(StorageImpl):
+    """Same contract as InMemoryStore, with a directory as truth. Arrays in
+    ``self.kv`` are np.memmap views over the entry files."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # key -> entry dicts shaped exactly like InMemoryStore's.
+        self.kv: dict[str, dict] = {}
+        self._load_all()
+
+    # ---- persistence -----------------------------------------------------
+
+    def _load_all(self) -> None:
+        for name in os.listdir(self.root):
+            path = os.path.join(self.root, name)
+            meta_path = os.path.join(path, _META)
+            if not os.path.isfile(meta_path):
+                continue
+            try:
+                with open(meta_path, "rb") as f:
+                    meta = pickle.load(f)
+                key = _dir_key(name)
+                self.kv[key] = self._open_entry(path, meta)
+            except Exception:  # pragma: no cover - corrupt entry
+                from torchstore_tpu.logging import get_logger
+
+                get_logger("torchstore_tpu.file_store").warning(
+                    "skipping corrupt entry %s", path
+                )
+
+    def _open_entry(self, path: str, meta: dict) -> dict:
+        if meta["type"] == "object":
+            return {"type": "object", "obj": meta["obj"]}
+        if meta["type"] == "tensor":
+            tm: TensorMeta = meta["meta"]
+            arr = _map_file(
+                os.path.join(path, "data.bin"), tm.np_dtype, tm.shape, "r+"
+            )
+            return {"type": "tensor", "tensor": arr}
+        shards = {}
+        for coords, ts in meta["slices"].items():
+            arr = _map_file(
+                os.path.join(path, _shard_file(coords)),
+                TensorMeta(shape=(), dtype=meta["dtype"]).np_dtype,
+                ts.local_shape,
+                "r+",
+            )
+            shards[coords] = {"slice": ts, "tensor": arr}
+        return {"type": "sharded", "shards": shards}
+
+    def _write_meta(self, path: str, meta: dict) -> None:
+        tmp = os.path.join(path, _META + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(meta, f)
+        os.replace(tmp, os.path.join(path, _META))  # atomic commit
+
+    def _persist_tensor(self, key: str, arr: np.ndarray) -> np.ndarray:
+        path = _keydir(self.root, key)
+        os.makedirs(path, exist_ok=True)
+        mm = _map_file(os.path.join(path, "data.bin"), arr.dtype, arr.shape, "w+")
+        from torchstore_tpu.native import fast_copy
+
+        if arr.size:
+            fast_copy(mm, np.ascontiguousarray(arr))
+        self._write_meta(
+            path, {"type": "tensor", "meta": TensorMeta.of(arr)}
+        )
+        return mm
+
+    def _persist_shard(
+        self, key: str, ts: TensorSlice, arr: np.ndarray, slices: dict
+    ) -> np.ndarray:
+        path = _keydir(self.root, key)
+        os.makedirs(path, exist_ok=True)
+        mm = _map_file(
+            os.path.join(path, _shard_file(ts.coordinates)),
+            arr.dtype,
+            arr.shape,
+            "w+",
+        )
+        from torchstore_tpu.native import fast_copy
+
+        if arr.size:
+            fast_copy(mm, np.ascontiguousarray(arr))
+        self._write_meta(
+            path,
+            {"type": "sharded", "slices": slices, "dtype": str(arr.dtype)},
+        )
+        return mm
+
+    # ---- StorageImpl contract -------------------------------------------
+
+    def extract_existing(self, metas: list[Request]) -> dict[int, np.ndarray]:
+        from torchstore_tpu.storage_volume import InMemoryStore
+
+        return InMemoryStore.extract_existing(self, metas)  # same kv shape
+
+    def _check_type(self, key: str, entry: dict, incoming: str) -> None:
+        from torchstore_tpu.storage_volume import InMemoryStore
+
+        InMemoryStore._check_type(self, key, entry, incoming)
+
+    def store(self, metas: list[Request], values: dict[int, Any]) -> None:
+        for idx, meta in enumerate(metas):
+            if idx not in values:
+                raise ValueError(f"transport produced no value for {meta.key!r}")
+            value = values[idx]
+            entry = self.kv.get(meta.key)
+            if meta.is_object:
+                if entry is not None:
+                    self._check_type(meta.key, entry, "object")
+                path = _keydir(self.root, meta.key)
+                os.makedirs(path, exist_ok=True)
+                self._write_meta(path, {"type": "object", "obj": value})
+                self.kv[meta.key] = {"type": "object", "obj": value}
+            elif meta.tensor_slice is not None:
+                ts = meta.tensor_slice
+                if entry is None:
+                    entry = {"type": "sharded", "shards": {}}
+                    self.kv[meta.key] = entry
+                self._check_type(meta.key, entry, "sharded")
+                value_np = np.asarray(value)
+                existing = entry["shards"].get(ts.coordinates)
+                if existing is not None and _same_memory(
+                    existing["tensor"], value_np
+                ):
+                    # Transport wrote into the memmap: data already on disk.
+                    # The slice metadata may still have changed (same coords
+                    # + local shape but different offsets) — keep meta.pkl
+                    # authoritative or recovery restores a stale placement.
+                    if existing["slice"] != ts:
+                        slices = {
+                            c: s["slice"] for c, s in entry["shards"].items()
+                        }
+                        slices[ts.coordinates] = ts
+                        self._write_meta(
+                            _keydir(self.root, meta.key),
+                            {
+                                "type": "sharded",
+                                "slices": slices,
+                                "dtype": str(value_np.dtype),
+                            },
+                        )
+                    entry["shards"][ts.coordinates]["slice"] = ts
+                else:
+                    slices = {
+                        c: s["slice"] for c, s in entry["shards"].items()
+                    }
+                    slices[ts.coordinates] = ts
+                    mm = self._persist_shard(meta.key, ts, value_np, slices)
+                    entry["shards"][ts.coordinates] = {"slice": ts, "tensor": mm}
+            else:
+                if entry is not None:
+                    self._check_type(meta.key, entry, "tensor")
+                value_np = np.asarray(value)
+                if entry is not None and _same_memory(entry["tensor"], value_np):
+                    pass  # in-place overwrite already wrote through the memmap
+                else:
+                    mm = self._persist_tensor(meta.key, value_np)
+                    self.kv[meta.key] = {"type": "tensor", "tensor": mm}
+
+    def get_data(self, meta: Request) -> Any:
+        from torchstore_tpu.storage_volume import InMemoryStore
+
+        return InMemoryStore.get_data(self, meta)
+
+    def get_meta(self, meta: Request) -> Any:
+        from torchstore_tpu.storage_volume import InMemoryStore
+
+        return InMemoryStore.get_meta(self, meta)
+
+    def _entry(self, key: str) -> dict:
+        entry = self.kv.get(key)
+        if entry is None:
+            raise KeyNotFoundError(f"Key {key!r} not found in storage volume")
+        return entry
+
+    def delete(self, key: str) -> bool:
+        existed = self.kv.pop(key, None) is not None
+        shutil.rmtree(_keydir(self.root, key), ignore_errors=True)
+        return existed
+
+    def reset(self) -> None:
+        self.kv.clear()
+        shutil.rmtree(self.root, ignore_errors=True)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ---- recovery --------------------------------------------------------
+
+    def manifest(self) -> list[Request]:
+        """Meta-only requests describing every persisted entry, for
+        controller index rebuilds after a restart."""
+        out: list[Request] = []
+        for key, entry in self.kv.items():
+            if entry["type"] == "object":
+                out.append(Request(key=key, is_object=True))
+            elif entry["type"] == "tensor":
+                out.append(
+                    Request(key=key, tensor_meta=TensorMeta.of(entry["tensor"]))
+                )
+            else:
+                for shard in entry["shards"].values():
+                    out.append(
+                        Request(
+                            key=key,
+                            tensor_slice=shard["slice"],
+                            tensor_meta=TensorMeta.of(shard["tensor"]),
+                        )
+                    )
+        return out

@@ -205,7 +205,19 @@ class StorageVolume(Actor):
             self.volume_id = strategy.get_volume_id()
         else:
             self.volume_id = os.environ.get("RANK", "0")
-        self.store: StorageImpl = storage or InMemoryStore()
+        if storage is None:
+            storage_dir = os.environ.get("TORCHSTORE_TPU_STORAGE_DIR")
+            if storage_dir:
+                # Durable backend: entries persist under
+                # <dir>/<volume_id> and survive volume restarts.
+                from torchstore_tpu.storage_utils.file_store import FileBackedStore
+
+                storage = FileBackedStore(
+                    os.path.join(storage_dir, str(self.volume_id))
+                )
+            else:
+                storage = InMemoryStore()
+        self.store = storage
         self.ctx = TransportContext()
         from torchstore_tpu import native
 
@@ -256,6 +268,15 @@ class StorageVolume(Actor):
                 self.ctx.delete_key(key)
                 deleted += 1
         return deleted
+
+    @endpoint
+    async def manifest(self) -> list[Request]:
+        """Meta-only descriptions of every stored entry (durable backends
+        only) — feeds controller index rebuilds after restarts."""
+        fn = getattr(self.store, "manifest", None)
+        if fn is None:
+            return []
+        return fn()
 
     @endpoint
     async def reset(self) -> None:

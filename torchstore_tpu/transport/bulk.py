@@ -113,11 +113,10 @@ async def _send_frame(
 
 
 def _close_sock(sock: Optional[socket.socket]) -> None:
+    """Immediate close — ONLY safe when no loop.sock_* op can be pending on
+    this socket (dial failures, teardown without a loop)."""
     if sock is not None:
         try:
-            # shutdown() first: it wakes any coroutine parked in
-            # sock_sendall/sock_recv_into on this fd with an error, where a
-            # bare close() would leave it stranded (epoll drops closed fds).
             sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
@@ -125,6 +124,24 @@ def _close_sock(sock: Optional[socket.socket]) -> None:
             sock.close()
         except OSError:
             pass
+
+
+async def _graceful_close(sock: socket.socket) -> None:
+    """Close a socket that may have in-flight loop.sock_* operations:
+    shutdown() wakes them with an error (a bare close would strand them —
+    epoll drops closed fds), one tick lets their completion callbacks
+    unregister the fd, THEN close. Closing first risks the fd being reused
+    by a new socket while the loop still holds the old registration
+    (observed as selector FileNotFoundError under concurrent churn)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    await asyncio.sleep(0.05)
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def _family_for(host: str) -> int:
@@ -258,7 +275,8 @@ class BulkServer:
                 s for s, (c, _) in self.session_conns.items() if c is sock
             ]:
                 self.session_conns.pop(sess, None)
-            _close_sock(sock)
+            # A send_background task may still be parked on this fd.
+            asyncio.ensure_future(_graceful_close(sock))
 
     def _purge_stale(self) -> None:
         """Drop per-session state older than SESSION_TTL_S (client crashed
@@ -314,6 +332,15 @@ class BulkServer:
                     for idx, arr in payloads.items():
                         view = memoryview(np.ascontiguousarray(arr)).cast("B")
                         await _send_frame(sock, lock, session, idx, view)
+            except TimeoutError:
+                # The cancelled sendall may have left a PARTIAL frame on the
+                # wire — the connection's framing is unrecoverable; kill it
+                # (the reader task then purges its registrations).
+                logger.warning(
+                    "bulk get send timed out (session=%s); closing connection",
+                    session,
+                )
+                await _graceful_close(sock)
             except Exception:
                 logger.exception("bulk get send failed (session=%s)", session)
 
@@ -360,12 +387,16 @@ class BulkClientConn:
                         (idx, buf if idx not in _CONTROL_IDXS else None)
                     )
         except (ConnectionError, OSError):
-            self.closed = True
             for queue in self.sessions.values():
                 queue.put_nowait((None, None))
-        except asyncio.CancelledError:
+        finally:
+            # The recv op just completed/failed, so the fd is unregistered:
+            # safe to close here (and only here) in the reader's own task.
             self.closed = True
-            raise
+            try:
+                self.sock.close()
+            except OSError:
+                pass
 
     def register_session(self, session: int) -> asyncio.Queue:
         queue: asyncio.Queue = asyncio.Queue()
@@ -376,9 +407,14 @@ class BulkClientConn:
         self.sessions.pop(session, None)
 
     def close_now(self) -> None:
+        """Mark closed and wake the reader (which owns the actual close).
+        Never closes the fd directly — in-flight loop.sock_* ops on a
+        closed-and-reused fd corrupt the selector state."""
         self.closed = True
-        self._reader_task.cancel()
-        _close_sock(self.sock)
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
 
 async def _dial(host: str, port: int, timeout: float) -> socket.socket:
