@@ -182,6 +182,104 @@ async def test_spec_dtype_honored_buffered():
         await ts.shutdown("specdt")
 
 
+async def test_ranged_tcp_reads_with_shard_target():
+    # Shard targets pull only their region; over TCP the read is RANGED
+    # (fewer bytes on the wire) and lands in the provided buffer.
+    source = DirectWeightSyncSource(use_shm=False)
+    dest = DirectWeightSyncDest()
+    try:
+        w = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+        handles = await source.register({"w": w})
+        sl = ts.TensorSlice(
+            offsets=(16, 0), local_shape=(8, 8), global_shape=(64, 8),
+            coordinates=(0,), mesh_shape=(1,),
+        )
+        target = np.zeros((8, 8), np.float32)
+        out = await dest.pull(handles, {"w": ts.Shard(target, sl)})
+        assert out["w"] is target  # wrote straight into the provided buffer
+        np.testing.assert_array_equal(target, w[16:24])
+        # The planned read range really was partial.
+        from torchstore_tpu.direct_weight_sync import _row_range
+
+        (handle,) = handles["w"]
+        assert _row_range(handle, dest._plan) == (16, 24)
+    finally:
+        await dest.close()
+        await source.close()
+
+
+async def test_bufferless_shard_target():
+    source = DirectWeightSyncSource()
+    dest = DirectWeightSyncDest()
+    try:
+        w = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+        handles = await source.register({"w": w})
+        sl = ts.TensorSlice(
+            offsets=(2, 0), local_shape=(4, 4), global_shape=(8, 4),
+            coordinates=(0,), mesh_shape=(1,),
+        )
+        out = await dest.pull(handles, {"w": ts.Shard(None, sl)})
+        np.testing.assert_array_equal(out["w"], w[2:6])
+    finally:
+        await dest.close()
+        await source.close()
+
+
+async def test_multi_rank_buffer_id_collision():
+    # Two sources number their buffers from 0: the dest must key reads by
+    # (host, port, id), never bare id, or ranks' shards collapse.
+    s0 = DirectWeightSyncSource(use_shm=False)
+    s1 = DirectWeightSyncSource(use_shm=False)
+    dest = DirectWeightSyncDest()
+    try:
+        w = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+        # Emulate rank-local registration: each source holds one shard.
+        h0 = await s0.register({"w": w[:4].copy()})
+        h1 = await s1.register({"w": w[4:].copy()})
+        # Rewrite slices so each covers its half of the global space.
+        h0["w"][0].tensor_slice = ts.TensorSlice(
+            (0, 0), (4, 8), (8, 8), (0,), (2,)
+        )
+        h1["w"][0].tensor_slice = ts.TensorSlice(
+            (4, 0), (4, 8), (8, 8), (1,), (2,)
+        )
+        assert h0["w"][0].buffer_id == h1["w"][0].buffer_id  # the collision
+        merged = {"w": [h0["w"][0], h1["w"][0]]}
+        out = await dest.pull(merged, {"w": np.zeros_like(w)})
+        np.testing.assert_array_equal(out["w"], w)
+    finally:
+        await dest.close()
+        await s0.close()
+        await s1.close()
+
+
+async def test_volume_health_check():
+    await ts.initialize(store_name="hc", num_storage_volumes=2,
+                        strategy=ts.LocalRankStrategy())
+    try:
+        controller = ts.client("hc").controller
+        health = await controller.check_volumes.call_one()
+        assert health == {"0": "ok", "1": "ok"}
+        from torchstore_tpu import api
+
+        handle = api._stores["hc"]
+        handle.volume_mesh._processes[1].terminate()
+        handle.volume_mesh._processes[1].join(5)
+        health = await controller.check_volumes.call_one(timeout=3.0)
+        assert health["0"] == "ok" and health["1"].startswith("dead")
+    finally:
+        from torchstore_tpu import api
+        from torchstore_tpu.runtime import stop_singleton
+
+        handle = api._stores.pop("hc", None)
+        if handle is not None:
+            for proc in handle.volume_mesh._processes:
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(5)
+        await stop_singleton("ts_hc_controller")
+
+
 async def test_store_integrated_direct_sync():
     await ts.initialize(store_name="dws")
     try:

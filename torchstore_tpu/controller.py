@@ -235,6 +235,25 @@ class Controller(Actor):
         return sorted(self.index.keys().filter_by_prefix(prefix))
 
     @endpoint
+    async def check_volumes(self, timeout: float = 5.0) -> dict[str, str]:
+        """Health-check every volume (failure detection — SURVEY §5 notes
+        the reference has no heartbeats at all). Returns volume_id ->
+        'ok' | 'dead: <error>'."""
+        import asyncio
+
+        async def ping(vid: str, ref: ActorRef) -> tuple[str, str]:
+            try:
+                await asyncio.wait_for(ref.ping(), timeout=timeout)
+                return vid, "ok"
+            except Exception as exc:  # noqa: BLE001 - reported, not raised
+                return vid, f"dead: {type(exc).__name__}"
+
+        results = await asyncio.gather(
+            *(ping(vid, ref) for vid, ref in self.volume_refs.items())
+        )
+        return dict(results)
+
+    @endpoint
     async def rebuild_index(self) -> int:
         """Recover the metadata index from volume manifests (durable
         backends). Returns the number of entries indexed — the recovery

@@ -411,41 +411,59 @@ class DirectWeightSyncDest:
         # zero-extra-copy path, direct_weight_sync.py:221-247).
         landings: dict[str, list[tuple[TensorSlice, np.ndarray]]] = {}
         inplace_targets: set[str] = set()
+        from torchstore_tpu.client import Shard as _Shard
+
         for flat_key, target in dest_flat.items():
             if not _is_tensor_like(target):
                 continue
             wants = _target_slices(target)
+            # Shard targets land into their provided buffer; plain ndarray
+            # targets into themselves (both in place, no extra copy).
+            buf = target.data if isinstance(target, _Shard) else target
             if (
-                isinstance(target, np.ndarray)
+                isinstance(buf, np.ndarray)
                 and len(wants) == 1
-                and wants[0].is_full()
-                and target.flags["C_CONTIGUOUS"]
-                and target.flags["WRITEABLE"]
+                and tuple(buf.shape) == wants[0].local_shape
+                and buf.flags["C_CONTIGUOUS"]
+                and buf.flags["WRITEABLE"]
             ):
-                landings[flat_key] = [(wants[0], target)]
+                landings[flat_key] = [(wants[0], buf)]
                 inplace_targets.add(flat_key)
             else:
+                if isinstance(target, _Shard) and target.data is None:
+                    # Buffer-less region pull: dtype comes from the source.
+                    dtype = all_handles[flat_key][0].meta.np_dtype
+                else:
+                    dtype = _np_dtype_of(target)
                 landings[flat_key] = [
-                    (want, np.empty(want.local_shape, _np_dtype_of(target)))
-                    for want in wants
+                    (want, np.empty(want.local_shape, dtype)) for want in wants
                 ]
 
         # Each source shard is read ONCE per pull, however many dest regions
-        # overlap it — K overlapping ops must not multiply wire traffic.
-        unique: dict[int, WeightHandle] = {}
+        # overlap it — and only the row range its ops actually need (ranged
+        # reads cut DCN bytes when a pull touches part of a shard). Keyed by
+        # (host, port, buffer_id): buffer ids are per-SOURCE counters, so two
+        # ranks' shards share ids and a bare-id key would collapse them.
+        by_handle: dict[tuple, tuple[WeightHandle, list[_TransferOp]]] = {}
         for op in self._plan:
-            unique.setdefault(op.handle.buffer_id, op.handle)
-        shard_raws = dict(
-            zip(
-                unique.keys(),
-                await asyncio.gather(
-                    *(self._read_shard(h) for h in unique.values())
-                ),
+            hkey = (op.handle.hostname, op.handle.port, op.handle.buffer_id)
+            by_handle.setdefault(hkey, (op.handle, []))[1].append(op)
+        row_ranges = {
+            hkey: _row_range(handle, ops)
+            for hkey, (handle, ops) in by_handle.items()
+        }
+        reads = await asyncio.gather(
+            *(
+                self._read_shard(handle, row_ranges[hkey])
+                for hkey, (handle, _) in by_handle.items()
             )
         )
-        for op in self._plan:
-            self._apply_op(op, shard_raws[op.handle.buffer_id], landings)
-        ops_bytes = sum(h.meta.nbytes for h in unique.values())
+        shard_raws = dict(zip(by_handle.keys(), reads))
+        ops_bytes = 0
+        for hkey, (arr, row0) in shard_raws.items():
+            ops_bytes += arr.nbytes
+            for op in by_handle[hkey][1]:
+                self._apply_op(op, arr, row0, landings)
         tracker.track_step("reads", ops_bytes)
 
         out_flat = dict(dest_flat)
@@ -460,16 +478,23 @@ class DirectWeightSyncDest:
 
         return unflatten_state_dict(out_flat, mapping)
 
-    def _apply_op(self, op: _TransferOp, src: np.ndarray, landings) -> None:
-        shard_arr = src.reshape(op.handle.meta.shape)
+    def _apply_op(
+        self, op: _TransferOp, shard_arr: np.ndarray, row0: int, landings
+    ) -> None:
+        """``shard_arr`` covers shard rows [row0, row0+len) of the handle's
+        slice (row0 > 0 for ranged reads)."""
         for want, buf in landings[op.flat_key]:
             inter = intersect_boxes(op.region, want.box)
             if inter is None:
                 continue
+            shard_offsets = op.handle.tensor_slice.offsets
             rel_src = tuple(
-                slice(o - so, o - so + s)
-                for o, so, s in zip(
-                    inter.offsets, op.handle.tensor_slice.offsets, inter.shape
+                slice(
+                    o - so - (row0 if d == 0 else 0),
+                    o - so - (row0 if d == 0 else 0) + s,
+                )
+                for d, (o, so, s) in enumerate(
+                    zip(inter.offsets, shard_offsets, inter.shape)
                 )
             )
             view = get_destination_view(
@@ -477,15 +502,20 @@ class DirectWeightSyncDest:
             )
             copy_into(view, shard_arr[rel_src])
 
-    async def _read_shard(self, handle: WeightHandle) -> np.ndarray:
+    async def _read_shard(
+        self, handle: WeightHandle, row_range: Optional[tuple[int, int]] = None
+    ) -> tuple[np.ndarray, int]:
         """One-hop read of a source buffer: SHM attach on the same host, TCP
-        ranged read across hosts. Connections/attachments are cached."""
+        (ranged when ``row_range`` is set) across hosts. Returns
+        ``(shard-shaped array rows, first_row)``."""
+        shape = handle.meta.shape
         if handle.shm_name is not None and handle.hostname == get_hostname():
+            # Attach is free — no transfer to range.
             seg = self._segments.get(handle.shm_name)
             if seg is None:
                 seg = shm.ShmSegment.attach(handle.shm_name, max(handle.meta.nbytes, 1))
                 self._segments[handle.shm_name] = seg
-            return np.asarray(seg.view(handle.meta)).reshape(-1)
+            return np.asarray(seg.view(handle.meta)).reshape(shape), 0
         # Same-host TCP reads dial loopback (the container hostname may not
         # route back to this process); cross-host uses the advertised name.
         host = (
@@ -509,8 +539,18 @@ class DirectWeightSyncDest:
                 conn = pool["conns"][pool["rr"] % len(pool["conns"])]
                 pool["rr"] += 1
         reader, writer, lock = conn
+        row_bytes = (
+            handle.meta.nbytes // shape[0] if shape and shape[0] else handle.meta.nbytes
+        )
+        if row_range is not None and shape:
+            r0, r1 = row_range
+            offset, want_len = r0 * row_bytes, (r1 - r0) * row_bytes
+            out_shape = (r1 - r0,) + tuple(shape[1:])
+        else:
+            r0, offset, want_len = 0, 0, handle.meta.nbytes
+            out_shape = tuple(shape)
         async with lock:
-            writer.write(_READ_REQ.pack(handle.buffer_id, 0, handle.meta.nbytes))
+            writer.write(_READ_REQ.pack(handle.buffer_id, offset, want_len))
             await writer.drain()
             (length,) = _READ_RESP.unpack(await reader.readexactly(_READ_RESP.size))
             if length == _ERR:
@@ -519,7 +559,8 @@ class DirectWeightSyncDest:
                     f"(rank {handle.source_rank})"
                 )
             raw = await reader.readexactly(length)
-        return np.frombuffer(bytearray(raw), dtype=handle.meta.np_dtype)
+        arr = np.frombuffer(bytearray(raw), dtype=handle.meta.np_dtype)
+        return arr.reshape(out_shape), r0
 
     async def close(self) -> None:
         for pool in self._conns.values():
@@ -539,26 +580,73 @@ class DirectWeightSyncDest:
 # --------------------------------------------------------------------------
 
 
+def _row_range(
+    handle: WeightHandle, ops: list[_TransferOp]
+) -> Optional[tuple[int, int]]:
+    """Shard-local dim-0 row range covering every op, or None for a full
+    read. Ranging applies only when each op's region spans the shard's full
+    extent in every trailing dim (then rows are a contiguous byte range —
+    the protocol's offset/length supports it directly)."""
+    ts = handle.tensor_slice
+    if not ts.local_shape:
+        return None
+    lo, hi = None, None
+    for op in ops:
+        for d in range(1, len(ts.local_shape)):
+            if (
+                op.region.offsets[d] != ts.offsets[d]
+                or op.region.shape[d] != ts.local_shape[d]
+            ):
+                return None
+        r0 = op.region.offsets[0] - ts.offsets[0]
+        r1 = r0 + op.region.shape[0]
+        lo = r0 if lo is None else min(lo, r0)
+        hi = r1 if hi is None else max(hi, r1)
+    if lo == 0 and hi == ts.local_shape[0]:
+        return None  # full shard anyway
+    return lo, hi
+
+
 def _is_tensor_like(value) -> bool:
+    from torchstore_tpu.client import Shard
+
     return (
-        isinstance(value, np.ndarray)
+        isinstance(value, (np.ndarray, Shard))
         or shd.is_jax_array(value)
         or shd.is_sharded_spec(value)
     )
 
 
 def _np_dtype_of(value) -> np.dtype:
+    from torchstore_tpu.client import Shard
+
+    if isinstance(value, Shard):
+        value = value.data
     # Avoids materializing jax arrays on host just to learn their dtype.
     return TensorMeta(shape=(), dtype=str(value.dtype)).np_dtype
 
 
 def _target_slices(value) -> list[TensorSlice]:
+    from torchstore_tpu.client import Shard
+
+    if isinstance(value, Shard):
+        # Explicit region target: pull only this slice of the global space
+        # (SPMD ranks syncing their own shard).
+        return [value.tensor_slice]
     if shd.is_jax_array(value) or shd.is_sharded_spec(value):
         return [ts for _, ts in shd.target_slices(value)]
     return [_full_slice(value.shape)]
 
 
 def _rebuild(target, parts: list[tuple[TensorSlice, np.ndarray]]):
+    from torchstore_tpu.client import Shard
+
+    if isinstance(target, Shard):
+        ((_, arr),) = parts
+        if target.data is not None:
+            np.copyto(target.data, arr)
+            return target.data
+        return arr
     if shd.is_jax_array(target) or shd.is_sharded_spec(target):
         devs = [dev for dev, _ in shd.target_slices(target)]
         return shd.build_array(target, [(d, arr) for d, (_, arr) in zip(devs, parts)])
