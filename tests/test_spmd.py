@@ -61,6 +61,92 @@ class TestSPMDEnv:
             SPMDEnv.from_env()
 
 
+def _durable_worker(rank: int, world: int, port: int, result_dir: str, phase: str) -> None:
+    os.environ.update(
+        {
+            "RANK": str(rank),
+            "LOCAL_RANK": str(rank),
+            "WORLD_SIZE": str(world),
+            "LOCAL_WORLD_SIZE": str(world),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        }
+    )
+    result = {"rank": rank, "ok": False}
+    try:
+        asyncio.run(_durable_scenario(rank, world, result_dir, phase, result))
+    except Exception as exc:  # noqa: BLE001
+        import traceback
+
+        result["error"] = f"{exc!r}\n{traceback.format_exc()}"
+    with open(os.path.join(result_dir, f"{phase}_rank_{rank}.json"), "w") as f:
+        json.dump(result, f)
+
+
+async def _durable_scenario(rank, world, result_dir, phase, result):
+    import torchstore_tpu as ts
+
+    storage = os.path.join(result_dir, "storage")
+    if phase == "write":
+        await ts.initialize_spmd(store_name="dspmd", storage_dir=storage)
+        await ts.put(f"r{rank}", np.full(4, float(rank)), store_name="dspmd")
+        await ts.barrier("puts", store_name="dspmd")
+        from torchstore_tpu.spmd import _spmd_sessions
+
+        session = _spmd_sessions["dspmd"]
+        # Drain ack: non-zero ranks confirm they have no in-flight
+        # rendezvous requests before rank 0 (which HOSTS the rendezvous)
+        # simulates its crash — otherwise killing the server races their
+        # barrier replies.
+        if rank != 0:
+            await session.client.add("drained", 1)
+        else:
+            await session.client.wait_counter("drained", world - 1)
+        # SIMULATED CRASH: exit without collective shutdown (volumes are
+        # children and die with us; data must persist on disk).
+        if session.volume_mesh is not None:
+            for proc in session.volume_mesh._processes:
+                proc.terminate()
+        result["ok"] = True
+        return
+    # phase == "recover": fresh world over the same storage dir.
+    await ts.initialize_spmd(store_name="dspmd", storage_dir=storage, recover=True)
+    for other in range(world):
+        out = await ts.get(f"r{other}", store_name="dspmd")
+        assert out[0] == float(other), (other, out)
+    await ts.barrier("reads", store_name="dspmd")
+    await ts.shutdown("dspmd")
+    result["ok"] = True
+
+
+def test_spmd_durable_recovery(tmp_path):
+    world = 2
+    for phase in ("write", "recover"):
+        port = get_free_port()
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(
+                target=_durable_worker,
+                args=(r, world, port, str(tmp_path), phase),
+                daemon=False,
+            )
+            for r in range(world)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            for p in procs:
+                p.join(timeout=180)
+                assert not p.is_alive(), f"{phase} worker hung"
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+        for r in range(world):
+            result = json.loads((tmp_path / f"{phase}_rank_{r}.json").read_text())
+            assert result["ok"], f"{phase} rank {r}: {result.get('error')}"
+
+
 async def test_rendezvous_kv():
     from torchstore_tpu.runtime.rendezvous import RendezvousClient, RendezvousServer
 

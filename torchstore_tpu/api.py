@@ -134,12 +134,21 @@ async def initialize_spmd(
     strategy: Optional[StoreStrategy] = None,
     store_name: str = DEFAULT_STORE,
     config: Optional[StoreConfig] = None,
+    storage_dir: Optional[str] = None,
+    recover: bool = False,
 ) -> None:
     """Collective bootstrap from torchrun-style env — call on every rank
-    (/root/reference/torchstore/spmd.py:246-362)."""
+    (/root/reference/torchstore/spmd.py:246-362). ``storage_dir``/``recover``
+    enable durable volumes + index recovery, as in ``initialize``."""
     from torchstore_tpu import spmd as spmd_mod
 
-    await spmd_mod.initialize(strategy=strategy, store_name=store_name, config=config)
+    await spmd_mod.initialize(
+        strategy=strategy,
+        store_name=store_name,
+        config=config,
+        storage_dir=storage_dir,
+        recover=recover,
+    )
 
 
 def client(store_name: str = DEFAULT_STORE) -> LocalClient:

@@ -536,6 +536,11 @@ async def spawn_actors(
         for proc in procs:
             if proc.is_alive():
                 proc.terminate()
+        for proc in procs:
+            await loop.run_in_executor(None, proc.join, 5.0)
+            if proc.is_alive():  # SIGTERM ignored mid-start: escalate
+                proc.kill()
+                await loop.run_in_executor(None, proc.join, 2.0)
         raise
     return ActorMesh(refs, procs)
 

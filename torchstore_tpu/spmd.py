@@ -162,9 +162,16 @@ async def initialize(
     strategy: Optional[StoreStrategy] = None,
     store_name: str = api.DEFAULT_STORE,
     config: Optional[StoreConfig] = None,
+    storage_dir: Optional[str] = None,
+    recover: bool = False,
 ) -> None:
-    """Collective store bootstrap — call from every rank of the world."""
+    """Collective store bootstrap — call from every rank of the world. With
+    ``storage_dir`` each host's volumes persist under
+    ``<dir>/<volume_id>`` (a shared filesystem or per-host path);
+    ``recover=True`` rebuilds the index from disk on rank 0."""
     env = SPMDEnv.from_env()
+    if recover and not storage_dir:
+        raise ValueError("recover=True requires storage_dir")
     config = config or default_config()
     if strategy is None:
         strategy = LocalRankStrategy()
@@ -189,9 +196,9 @@ async def initialize(
     ns = f"spmd/{store_name}"
 
     multi_host = env.num_hosts > 1
-    # --- per-host volume spawn -------------------------------------------
     volume_mesh: Optional[ActorMesh] = None
-    if env.local_rank == 0:
+
+    async def _spawn_local_volumes() -> ActorMesh:
         if isinstance(strategy, LocalRankStrategy):
             num_local = env.local_world_size
             base_rank = env.host_rank * env.local_world_size
@@ -205,6 +212,8 @@ async def initialize(
                 }
                 if multi_host:
                     extra["TORCHSTORE_TPU_BIND_HOST"] = "0.0.0.0"
+                if storage_dir:
+                    extra["TORCHSTORE_TPU_STORAGE_DIR"] = storage_dir
                 return extra
 
         else:  # HostStrategy: one volume per host
@@ -214,32 +223,72 @@ async def initialize(
                 extra = {}
                 if multi_host:
                     extra["TORCHSTORE_TPU_BIND_HOST"] = "0.0.0.0"
+                if storage_dir:
+                    extra["TORCHSTORE_TPU_STORAGE_DIR"] = storage_dir
                 return extra
 
-        volume_mesh = await spawn_actors(
+        return await spawn_actors(
             num_local,
             StorageVolume,
             f"ts_{store_name}_volume_h{env.host_rank}",
             strategy,
             env_fn=env_fn,
         )
-        await client.set(
-            f"{ns}/volumes/{env.host_rank}", pickle_handle(volume_mesh.refs)
-        )
 
-    # --- controller on rank 0 --------------------------------------------
-    if env.rank == 0:
-        all_refs = []
-        for host in range(env.num_hosts):
-            raw = await client.get(f"{ns}/volumes/{host}")
-            all_refs.extend(unpickle_handle(raw))
-        controller = await get_or_spawn_singleton(
-            f"ts_{store_name}_controller", Controller
-        )
-        await controller.init.call_one(strategy, all_refs)
-        await client.set(f"{ns}/controller", pickle_handle(controller))
-    raw = await client.get(f"{ns}/controller")
-    controller = unpickle_handle(raw)
+    # --- volumes + controller, failure-broadcasting -----------------------
+    # Rank 0 ALWAYS publishes a status (ok + handle, or error) covering the
+    # WHOLE bootstrap from volume spawn onward: a rank-0 failure must fail
+    # every rank promptly, not leave them blocked on a never-set key with
+    # spawned volume processes leaked.
+    try:
+        if env.rank == 0:
+            try:
+                volume_mesh = await _spawn_local_volumes()
+                await client.set(
+                    f"{ns}/volumes/{env.host_rank}",
+                    pickle_handle(volume_mesh.refs),
+                )
+                all_refs = []
+                for host in range(env.num_hosts):
+                    raw = await client.get(f"{ns}/volumes/{host}")
+                    all_refs.extend(unpickle_handle(raw))
+                controller = await get_or_spawn_singleton(
+                    f"ts_{store_name}_controller", Controller
+                )
+                await controller.init.call_one(strategy, all_refs)
+                if recover:
+                    recovered = await controller.rebuild_index.call_one()
+                    logger.info(
+                        "spmd recovered %d entries from %s", recovered, storage_dir
+                    )
+            except BaseException as exc:
+                await client.set(
+                    f"{ns}/controller_status", ("error", repr(exc))
+                )
+                raise
+            await client.set(
+                f"{ns}/controller_status", ("ok", pickle_handle(controller))
+            )
+        elif env.local_rank == 0:
+            volume_mesh = await _spawn_local_volumes()
+            await client.set(
+                f"{ns}/volumes/{env.host_rank}", pickle_handle(volume_mesh.refs)
+            )
+        status, payload = await client.get(f"{ns}/controller_status")
+        if status != "ok":
+            raise RuntimeError(f"SPMD bootstrap failed on rank 0: {payload}")
+        controller = unpickle_handle(payload)
+    except BaseException:
+        # Local cleanup on any bootstrap failure: spawned volumes must not
+        # outlive a failed initialize (parity with api.initialize).
+        if volume_mesh is not None:
+            await volume_mesh.stop()
+        if env.rank == 0:
+            await stop_singleton(f"ts_{store_name}_controller")
+        await client.close()
+        if server is not None:
+            await server.stop()
+        raise
 
     api._publish_handle(store_name, controller)
     api._stores[store_name] = api._StoreHandle(
