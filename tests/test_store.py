@@ -236,3 +236,14 @@ async def test_controller_stats(store):
     assert stats["puts"] >= 1 and stats["put_bytes"] >= 64
     assert stats["locates"] >= 1 and stats["num_keys"] >= 1
     assert stats["num_volumes"] == 1
+
+
+async def test_delete_prefix(store):
+    for v in ("v0", "v1"):
+        for k in ("a", "b"):
+            await ts.put(f"ckpt/{v}/{k}", np.ones(2), store_name=store)
+    removed = await ts.delete_prefix("ckpt/v0", store_name=store)
+    assert removed == 2
+    assert await ts.keys("ckpt", store_name=store) == ["ckpt/v1/a", "ckpt/v1/b"]
+    # Idempotent on an empty prefix.
+    assert await ts.delete_prefix("ckpt/v0", store_name=store) == 0

@@ -15,6 +15,7 @@ from torchstore_tpu.api import (
     client,
     delete,
     delete_batch,
+    delete_prefix,
     exists,
     get,
     get_batch,
@@ -61,6 +62,7 @@ __all__ = [
     "client",
     "delete",
     "delete_batch",
+    "delete_prefix",
     "exists",
     "get",
     "get_batch",

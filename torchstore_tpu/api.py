@@ -207,6 +207,10 @@ async def delete_batch(keys: list[str], store_name: str = DEFAULT_STORE) -> None
     await client(store_name).delete_batch(keys)
 
 
+async def delete_prefix(prefix: str, store_name: str = DEFAULT_STORE) -> int:
+    return await client(store_name).delete_prefix(prefix)
+
+
 async def keys(
     prefix: Optional[str] = None, store_name: str = DEFAULT_STORE
 ) -> list[str]:
@@ -303,6 +307,7 @@ __all__ = [
     "client",
     "delete",
     "delete_batch",
+    "delete_prefix",
     "exists",
     "get",
     "get_batch",

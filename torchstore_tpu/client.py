@@ -398,6 +398,15 @@ class LocalClient:
         for key in keys:
             self._ctx.delete_key(key)
 
+    async def delete_prefix(self, prefix: str) -> int:
+        """Delete every key under a prefix (e.g. an old checkpoint version:
+        ``delete_prefix("policy/v41")``). Returns the number of keys
+        removed. Idempotent like delete_batch."""
+        keys = await self._controller.keys.call_one(prefix)
+        if keys:
+            await self.delete_batch(keys)
+        return len(keys)
+
     async def keys(self, prefix: Optional[str] = None) -> list[str]:
         return await self._controller.keys.call_one(prefix)
 
