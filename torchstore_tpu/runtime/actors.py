@@ -136,20 +136,40 @@ def _rebuild_remote_error(msg: dict) -> Exception:
     return remote
 
 
-# Pools are per (event loop, address): tests run many asyncio.run loops.
-_conn_pools: dict[tuple[int, str, int], _Connection] = {}
+# Pools are per (event loop, address): tests run many asyncio.run loops;
+# entries of closed loops are pruned so they never accumulate.
+_conn_pools: dict[
+    tuple[int, str, int], tuple[asyncio.AbstractEventLoop, _Connection]
+] = {}
 
 
 async def get_connection(host: str, port: int) -> _Connection:
     loop = asyncio.get_running_loop()
+    # Prune entries whose loop is closed. writer.close() would no-op on a
+    # dead loop (transport.close() needs call_soon), and asyncio's
+    # TransportSocket forbids close(); shutdown() is allowed and tears the
+    # TCP connection down immediately (the server reaps its handler) — the
+    # local fd itself is freed when GC collects the orphaned transport.
+    for k, (pool_loop, conn) in list(_conn_pools.items()):
+        if pool_loop.is_closed():
+            conn.closed = True
+            sock = conn.writer.get_extra_info("socket")
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            _conn_pools.pop(k, None)
     key = (id(loop), host, port)
-    conn = _conn_pools.get(key)
-    if conn is not None and not conn.closed:
-        return conn
+    entry = _conn_pools.get(key)
+    if entry is not None:
+        _, conn = entry
+        if not conn.closed:
+            return conn
     reader, writer = await asyncio.open_connection(host, port, limit=2**20)
     _set_sock_opts(writer)
     conn = _Connection(reader, writer)
-    _conn_pools[key] = conn
+    _conn_pools[key] = (loop, conn)
     return conn
 
 
@@ -580,6 +600,6 @@ async def stop_singleton(name: str) -> None:
 
 
 async def close_all_connections() -> None:
-    for conn in list(_conn_pools.values()):
+    for _, conn in list(_conn_pools.values()):
         await conn.close()
     _conn_pools.clear()
