@@ -173,3 +173,44 @@ async def test_shm_no_segment_leak_after_shutdown():
     await ts.shutdown("shmleak")
     after = {n for n in os.listdir(shm.SHM_DIR) if n.startswith("ts_shm_")}
     assert after <= before, f"leaked: {after - before}"
+
+
+def test_reap_orphaned_segments():
+    # A segment named with a genuinely dead pid gets reaped; a live-pid
+    # segment stays. Use a real exited child's pid (no magic numbers —
+    # pid_max can exceed any constant).
+    import multiprocessing as mp
+    import uuid as _uuid
+
+    proc = mp.get_context("spawn").Process(target=int)
+    proc.start()
+    proc.join()
+    dead_pid = proc.pid
+    dead = ShmSegment.create(
+        8, name=f"ts_shm_{dead_pid}_{_uuid.uuid4().hex[:8]}"
+    )
+    alive = ShmSegment.create(8)  # our own pid
+    try:
+        reaped = shm.reap_orphaned_segments()
+        assert reaped >= 1
+        assert not os.path.exists(os.path.join(shm.SHM_DIR, dead.name))
+        assert os.path.exists(os.path.join(shm.SHM_DIR, alive.name))
+    finally:
+        dead.unlink()
+        alive.unlink()
+
+
+async def test_adopted_segment_survives_client_death(store):
+    # The put's client-created segment is renamed to the VOLUME's pid on
+    # adoption, so the reaper can never unlink live volume storage after
+    # the creating client exits.
+    x = np.random.rand(16, 16).astype(np.float32)
+    await ts.put("adopt", x, store_name=store)
+    # Reap with this client still alive: nothing of ours may vanish, and a
+    # subsequent get served from volume-owned segments must work.
+    shm.reap_orphaned_segments()
+    np.testing.assert_array_equal(await ts.get("adopt", store_name=store), x)
+    # Overwrite still reuses (descriptor now carries the volume-pid name).
+    y = np.random.rand(16, 16).astype(np.float32)
+    await ts.put("adopt", y, store_name=store)
+    np.testing.assert_array_equal(await ts.get("adopt", store_name=store), y)

@@ -96,11 +96,17 @@ async def test_inplace_user_dict(store):
 
 
 async def test_structure_mismatch_strict(store):
-    await ts.put_state_dict("v3", {"a": np.ones(2)}, store_name=store)
-    with pytest.raises(ValueError, match="structure mismatch"):
+    await ts.put_state_dict("v3", {"a": np.ones(2), "b": np.ones(2)}, store_name=store)
+    # Unknown keys always rejected.
+    with pytest.raises(ValueError, match="not present in push"):
         await ts.get_state_dict(
             "v3", user_state_dict={"a": np.zeros(2), "extra": np.zeros(1)},
             store_name=store,
+        )
+    # Missing keys rejected only in strict mode.
+    with pytest.raises(ValueError, match="structure mismatch"):
+        await ts.get_state_dict(
+            "v3", user_state_dict={"a": np.zeros(2)}, store_name=store
         )
 
 
@@ -169,3 +175,22 @@ async def test_versioned_checkpoints_coexist(store):
     out1 = await ts.get_state_dict("v1", store_name=store)
     np.testing.assert_array_equal(out0["w"], np.zeros(2))
     np.testing.assert_array_equal(out1["w"], np.ones(2))
+
+
+async def test_partial_pull_with_strict_false(store):
+    sd = {"lm_head": np.random.rand(8, 4).astype(np.float32),
+          "layers": {"0": np.ones(4), "1": np.ones(4)}}
+    await ts.put_state_dict("big", sd, store_name=store)
+    # Pull just the head.
+    out = await ts.get_state_dict(
+        "big", user_state_dict={"lm_head": np.zeros((8, 4), np.float32)},
+        strict=False, store_name=store,
+    )
+    np.testing.assert_array_equal(out["lm_head"], sd["lm_head"])
+    assert "layers" not in out
+    # Unknown keys still rejected even when non-strict.
+    with pytest.raises(ValueError, match="not present in push"):
+        await ts.get_state_dict(
+            "big", user_state_dict={"typo": np.zeros(2)}, strict=False,
+            store_name=store,
+        )

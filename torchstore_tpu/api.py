@@ -247,12 +247,13 @@ async def get_state_dict(
     key: str,
     user_state_dict: Any = None,
     direct: bool = False,
+    strict: bool = True,
     store_name: str = DEFAULT_STORE,
 ) -> Any:
     from torchstore_tpu import state_dict_utils
 
     return await state_dict_utils.get_state_dict(
-        client(store_name), key, user_state_dict, direct=direct
+        client(store_name), key, user_state_dict, direct=direct, strict=strict
     )
 
 

@@ -310,6 +310,7 @@ async def get_state_dict(
     key: str,
     user_state_dict: Any = None,
     direct: bool = False,
+    strict: bool = True,
 ) -> Any:
     """Fetch a complete state dict. With ``user_state_dict``, its leaves act
     as fetch targets (sharded jax.Arrays reshard on the fly; numpy arrays are
@@ -317,7 +318,23 @@ async def get_state_dict(
     exactly (strict=True parity,
     /root/reference/torchstore/state_dict_utils.py:146-174)."""
     if direct:
-        return await _get_state_dict_direct(client, key, user_state_dict)
+        # The direct path naturally pulls exactly the user dict's keys
+        # (handles are matched per key), i.e. subset pulls just work —
+        # strict=True additionally verifies full coverage below.
+        result = await _get_state_dict_direct(client, key, user_state_dict)
+        if strict:
+            cache = _direct_cache(client)
+            entry = cache.dests.get(key)
+            if entry is not None:
+                user_flat, _ = flatten_state_dict(user_state_dict)
+                missing = set(entry[1]) - set(user_flat)
+                if missing:
+                    raise ValueError(
+                        f"state dict structure mismatch for {key!r}: missing "
+                        f"in user dict: {sorted(missing)[:5]} (pass "
+                        "strict=False to pull a subset)"
+                    )
+        return result
     tracker = LatencyTracker(f"get_state_dict[{key}]")
     try:
         marker = await client.get(_store_key(key, MAPPING_KEY))
@@ -332,13 +349,19 @@ async def get_state_dict(
     if user_state_dict is not None:
         user_flat, user_mapping = flatten_state_dict(user_state_dict)
         stored_keys = _leaf_keys(mapping)
-        if set(user_flat.keys()) != stored_keys:
-            missing = stored_keys - set(user_flat)
-            extra = set(user_flat) - stored_keys
+        # Unknown keys always fail; missing keys fail only in strict mode
+        # (strict=False pulls a subset, e.g. just the lm_head).
+        extra = set(user_flat) - stored_keys
+        if extra:
             raise ValueError(
-                f"state dict structure mismatch for {key!r}: "
-                f"missing in user dict: {sorted(missing)[:5]}, "
-                f"extra in user dict: {sorted(extra)[:5]}"
+                f"user dict keys not present in push {key!r}: {sorted(extra)[:5]}"
+            )
+        missing = stored_keys - set(user_flat)
+        if strict and missing:
+            raise ValueError(
+                f"state dict structure mismatch for {key!r}: missing in "
+                f"user dict: {sorted(missing)[:5]} (pass strict=False to "
+                "pull a subset)"
             )
         targets = {
             _store_key(key, k): (v if _is_fetch_target(v) else None)

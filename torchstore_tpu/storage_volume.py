@@ -220,8 +220,13 @@ class StorageVolume(Actor):
         self.store = storage
         self.ctx = TransportContext()
         from torchstore_tpu import native
+        from torchstore_tpu.transport import shared_memory
 
         native.get_lib()  # load (or wait for) the native data path at startup
+        if shared_memory.is_available():
+            # Crashed processes leave /dev/shm segments behind; sweep any
+            # whose creator pid is gone before this volume starts serving.
+            shared_memory.reap_orphaned_segments()
 
     @endpoint
     async def get_id(self) -> dict:

@@ -47,6 +47,45 @@ def is_available() -> bool:
     return os.path.isdir(SHM_DIR) and os.access(SHM_DIR, os.W_OK)
 
 
+def reap_orphaned_segments() -> int:
+    """Unlink ts_shm_* segments whose creating process is gone (crashed
+    volumes/clients leave them behind; nothing else ever cleans /dev/shm).
+    Safe: segment names embed the creator pid, and a dead pid's segments
+    can have no owner left. Called at volume startup."""
+    reaped = 0
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.startswith("ts_shm_"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[2])
+        except (IndexError, ValueError):
+            continue
+        if not _pid_alive(pid):
+            try:
+                os.unlink(os.path.join(SHM_DIR, name))
+                reaped += 1
+            except OSError:
+                pass
+    if reaped:
+        logger.info("reaped %d orphaned shm segments", reaped)
+    return reaped
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else — leave it alone
+
+
 # --------------------------------------------------------------------------
 # segments
 # --------------------------------------------------------------------------
@@ -90,6 +129,15 @@ class ShmSegment:
         return np.frombuffer(
             self.mmap, dtype=meta.np_dtype, count=int(np.prod(meta.shape) or 1), offset=offset
         ).reshape(meta.shape)
+
+    def rename_to_owner(self) -> None:
+        """Rename the segment so its name embeds THIS process's pid. Volumes
+        call this when adopting a client-created segment: the pid in a
+        segment name must always be its current owner, or the orphan reaper
+        could unlink live volume storage after the creating client exits."""
+        new_name = f"ts_shm_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        os.rename(self._path(self.name), self._path(new_name))
+        self.name = new_name
 
     def unlink(self) -> None:
         try:
@@ -296,6 +344,9 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             else:
                 seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
                 seg.owner = True  # volume takes ownership of the lifetime
+                # The name's pid must track ownership (see rename_to_owner);
+                # future handshakes/gets serve the new name from the cache.
+                seg.rename_to_owner()
             cache.put(meta.key, coords, seg, desc.meta)
             out[idx] = seg.view(desc.meta, desc.offset)
         return out
