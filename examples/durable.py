@@ -1,0 +1,48 @@
+"""Durable store + crash recovery: put a checkpoint, hard-kill the volume
+processes (no teardown), restart over the same directory, read everything
+back. Run:
+
+    python examples/durable.py
+"""
+
+import asyncio
+import tempfile
+
+import numpy as np
+
+import torchstore_tpu as ts
+
+
+async def main():
+    storage = tempfile.mkdtemp(prefix="ts_durable_demo_")
+    await ts.initialize(store_name="durable", storage_dir=storage)
+    weights = np.random.rand(512, 256).astype(np.float32)
+    await ts.put_state_dict(
+        "ckpt/step100", {"weights": weights, "meta": {"step": 100}},
+        store_name="durable",
+    )
+    print(f"wrote checkpoint to disk-backed store at {storage}")
+
+    # --- simulate a crash: kill volumes, drop all local state -------------
+    from torchstore_tpu import api
+    from torchstore_tpu.runtime import stop_singleton
+
+    handle = api._stores.pop("durable")
+    for proc in handle.volume_mesh._processes:
+        proc.terminate()
+        proc.join(5)
+    await stop_singleton("ts_durable_controller")
+    print("volumes killed without teardown (simulated crash)")
+
+    # --- recover ----------------------------------------------------------
+    await ts.initialize(store_name="durable", storage_dir=storage, recover=True)
+    restored = await ts.get_state_dict("ckpt/step100", store_name="durable")
+    np.testing.assert_array_equal(restored["weights"], weights)
+    assert restored["meta"]["step"] == 100
+    print("recovered checkpoint after restart:", list(restored))
+    await ts.shutdown("durable")
+    print("durable example OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
