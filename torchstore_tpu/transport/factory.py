@@ -72,11 +72,16 @@ def create_transport_buffer(
         chosen = TransportType(forced)
     global _logged_resolution
     if not _logged_resolution:
+        # One line listing every rung's availability (reference behavior,
+        # /root/reference/torchstore/transport/__init__.py:70-81).
         logger.info(
-            "transport resolution: volume=%s same_host=%s -> %s",
+            "transport resolution: volume=%s same_host=%s -> %s "
+            "[shm=%s bulk=%s rpc=True]",
             volume.volume_id,
             volume.is_same_host(),
             chosen.value,
+            shm_available(volume, config),
+            bulk_available(volume, config),
         )
         _logged_resolution = True
     try:
