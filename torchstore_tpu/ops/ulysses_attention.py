@@ -13,8 +13,6 @@ Requires num_heads % axis_size == 0.
 
 from __future__ import annotations
 
-import functools
-
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     """Inside shard_map: q (batch, seq_local, heads, head_dim) and k/v

@@ -11,7 +11,7 @@ never pay for it.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 

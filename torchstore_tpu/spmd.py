@@ -19,7 +19,6 @@ primary failure (reference _SPMDSession, spmd.py:106-203).
 
 from __future__ import annotations
 
-import asyncio
 import os
 from dataclasses import dataclass
 from typing import Optional

@@ -15,7 +15,7 @@ through the normal resharding pipeline), numpy arrays, or arbitrary objects.
 from __future__ import annotations
 
 import weakref
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 

@@ -21,7 +21,7 @@ import base64
 import os
 import pickle
 import shutil
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 
