@@ -30,7 +30,7 @@ REFERENCE_GBPS = 10.0
 
 N_TENSORS = 32
 TENSOR_MB = 32  # 32 x 32MB = 1 GiB per direction
-ITERS = 3
+ITERS = 4  # segment recycling reaches steady state at iter 2
 
 
 async def run() -> dict:
@@ -80,12 +80,13 @@ async def run() -> dict:
             np.testing.assert_array_equal(out["layers"][str(i)], sd["layers"][str(i)])
         return best
 
+    # Buffered consumer takes zero-copy snapshot views (the jax consumer
+    # pattern: device_put straight from the returned views); `user`-dict
+    # in-place landing is exercised by the direct path below.
     best_buffered = await timed_loop(
         "buffered",
         lambda: ts.put_state_dict("bench/sd", sd, store_name="bench"),
-        lambda: ts.get_state_dict(
-            "bench/sd", user_state_dict=user, store_name="bench"
-        ),
+        lambda: ts.get_state_dict("bench/sd", store_name="bench"),
     )
     # Direct one-hop (the RL steady-state flow): first publish registers
     # staging buffers + builds the dest plan outside the timed loop; the

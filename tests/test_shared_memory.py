@@ -1,6 +1,7 @@
 """SHM transport tests: segment round-trips, descriptor-reuse handshake,
-staged-get ownership transfer, cache invalidation, mutable mode, cross-
-process zero-copy semantics (reference tests/test_shared_memory.py)."""
+staged-get ownership transfer, cache invalidation, lease/retire/free pool
+bookkeeping (reference tests/test_shared_memory.py; end-to-end zero-copy
+semantics live in test_zero_copy.py)."""
 
 import os
 
@@ -48,17 +49,43 @@ class TestSegment:
 
 
 class TestServerCache:
-    def test_put_replaces_and_unlinks(self):
+    def test_put_replaces_and_pools(self):
         cache = ShmServerCache()
         a = ShmSegment.create(16)
         b = ShmSegment.create(16)
         meta = TensorMeta(shape=(4,), dtype="float32")
         cache.put("k", None, a, meta)
         cache.put("k", None, b, meta)
-        assert not os.path.exists(os.path.join(shm.SHM_DIR, a.name))
-        assert os.path.exists(os.path.join(shm.SHM_DIR, b.name))
+        # Replaced (unleased) segments are recycled, not unlinked: the next
+        # put of this size reuses the warm segment.
+        assert os.path.exists(os.path.join(shm.SHM_DIR, a.name))
         cache.delete_key("k")
         assert not os.path.exists(os.path.join(shm.SHM_DIR, b.name))
+        # take_free transfers ownership to the caller (the put adopting it)
+        assert cache.take_free(16) is a
+        assert cache.take_free(16) is None
+        a.unlink()
+        cache.clear()
+
+    def test_retired_until_released(self):
+        cache = ShmServerCache()
+        a = ShmSegment.create(16)
+        b = ShmSegment.create(16)
+        meta = TensorMeta(shape=(4,), dtype="float32")
+        cache.put("k", None, a, meta)
+        cache.grant(a.name)  # an outstanding zero-copy view lease
+        cache.put("k", None, b, meta)
+        # Leased segment is retired (still linked, never recycled) until the
+        # client reports the view released.
+        assert a.name in cache.retired
+        assert cache.take_free(16) is None
+        assert os.path.exists(os.path.join(shm.SHM_DIR, a.name))
+        cache.apply_releases({"client": "c1", "batches": [(1, {a.name: 1})]})
+        # Retransmission of the same batch must be a no-op (exactly-once).
+        cache.apply_releases({"client": "c1", "batches": [(1, {a.name: 1})]})
+        assert a.name not in cache.retired
+        assert cache.take_free(16) is a
+        cache.clear()
 
     def test_shard_coords_tracked_separately(self):
         cache = ShmServerCache()
@@ -66,7 +93,7 @@ class TestServerCache:
         s0, s1 = ShmSegment.create(16), ShmSegment.create(16)
         cache.put("k", (0,), s0, meta)
         cache.put("k", (1,), s1, meta)
-        assert cache.lookup("k", (0,))[0] is s0
+        assert cache.lookup("k", (0,)).seg is s0
         assert len(cache.segments_for("k")) == 2
         cache.clear()
         assert not os.path.exists(os.path.join(shm.SHM_DIR, s0.name))
@@ -80,7 +107,9 @@ class TestBufferUnit:
         buf._client_segments[0] = "not-picklable-marker"
         buf.descriptors[0] = ShmDescriptor("n", 8, TensorMeta((2,), "float32"))
         b2 = pickle.loads(pickle.dumps(buf))
-        assert b2._client_segments == {} and b2.config is None
+        # config travels (the volume side reads pool-cap overrides from it);
+        # only live client-process state is stripped.
+        assert b2._client_segments == {} and b2.config is not None
         assert b2.descriptors[0].segment_name == "n"
 
     def test_handshake_offers_reuse_only_on_meta_match(self):

@@ -1,0 +1,144 @@
+"""Zero-copy SHM read semantics, end to end: snapshot isolation of served
+views, lease/release segment recycling, slice descriptor views, and the
+adopted-segment rename protocol (VERDICT r1 items 1a/1c; replaces the old
+opt-in mutable_shm behavior with safe-by-default zero-copy)."""
+
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.client import Shard
+from torchstore_tpu.transport import shared_memory as shm
+from torchstore_tpu.transport.shared_memory import ShmClientCache
+from torchstore_tpu.transport.types import TensorSlice
+
+pytestmark = pytest.mark.skipif(
+    not shm.is_available(), reason="/dev/shm not available"
+)
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(
+        store_name="zc", strategy=ts.SingletonStrategy(default_transport_type="shm")
+    )
+    yield "zc"
+    await ts.shutdown("zc")
+
+
+def _client_shm_cache(store_name: str) -> ShmClientCache:
+    return ts.client(store_name)._ctx.get_cache(ShmClientCache)
+
+
+async def test_get_returns_readonly_view(store):
+    x = np.arange(64.0, dtype=np.float32)
+    await ts.put("k", x, store_name=store)
+    out = await ts.get("k", store_name=store)
+    np.testing.assert_array_equal(out, x)
+    assert not out.flags.writeable  # snapshot views are immutable
+    with pytest.raises(ValueError):
+        out[0] = 99.0
+
+
+async def test_snapshot_isolation_across_puts(store):
+    """A held view must keep showing the value it was fetched at, even after
+    later puts of the same key (the volume retires, never overwrites, leased
+    segments)."""
+    a = np.full(1024, 1.0, dtype=np.float32)
+    b = np.full(1024, 2.0, dtype=np.float32)
+    await ts.put("k", a, store_name=store)
+    snap_a = await ts.get("k", store_name=store)
+    await ts.put("k", b, store_name=store)
+    snap_b = await ts.get("k", store_name=store)
+    await ts.put("k", a, store_name=store)  # and once more
+    np.testing.assert_array_equal(snap_a, a)  # still the old snapshot
+    np.testing.assert_array_equal(snap_b, b)
+
+
+async def test_segment_recycling_after_release(store):
+    """Dropping views lets the volume recycle segments: /dev/shm segment
+    count stays bounded over many put/get iterations (no per-iteration
+    allocation in steady state)."""
+
+    def n_segments() -> int:
+        return len([n for n in os.listdir(shm.SHM_DIR) if n.startswith("ts_shm_")])
+
+    x = np.random.rand(1 << 16)
+    out = None
+    counts = []
+    for it in range(8):
+        x[0] = float(it)
+        await ts.put("k", x, store_name=store)
+        out = await ts.get("k", store_name=store)
+        assert out[0] == float(it)
+        gc.collect()  # make dropped-view weakrefs deterministic
+        counts.append(n_segments())
+    # Steady state is double-buffer rotation: the count must stop growing.
+    assert counts[-1] <= counts[2], f"segment growth: {counts}"
+
+
+async def test_slice_get_serves_descriptor_view(store):
+    """A sub-slice fetch of a stored shard returns correct data without a
+    destination (served as an offset/strides descriptor view)."""
+    full = np.arange(8 * 8, dtype=np.float32).reshape(8, 8)
+    await ts.put("w", full, store_name=store)
+    want = TensorSlice(
+        offsets=(2, 2),
+        local_shape=(4, 4),
+        global_shape=(8, 8),
+        coordinates=(),
+        mesh_shape=(),
+    )
+    out = await ts.get("w", like=want, store_name=store)
+    np.testing.assert_array_equal(out, full[2:6, 2:6])
+
+
+async def test_slice_get_lands_in_destination(store):
+    full = np.arange(6 * 4, dtype=np.float32).reshape(6, 4)
+    await ts.put("w", full, store_name=store)
+    dest = np.zeros((3, 4), dtype=np.float32)
+    want = TensorSlice(
+        offsets=(1, 0),
+        local_shape=(3, 4),
+        global_shape=(6, 4),
+        coordinates=(),
+        mesh_shape=(),
+    )
+    out = await ts.get("w", like=Shard(data=dest, tensor_slice=want), store_name=store)
+    np.testing.assert_array_equal(dest, full[1:4])
+    assert out is dest
+    assert dest.flags.writeable  # in-place destinations stay writable
+
+
+async def test_client_cache_follows_renames(store):
+    """After puts, every attachment in the client cache must reference a
+    live segment name (the volume's adopt-rename is reported back via
+    put_reply — no stale pre-rename entries may linger)."""
+    cache = _client_shm_cache(store)
+    for it in range(4):
+        await ts.put("k", np.random.rand(2048), store_name=store)
+        await ts.put("j", np.random.rand(1024), store_name=store)
+    for name in cache.segments:
+        assert os.path.exists(os.path.join(shm.SHM_DIR, name)), name
+    # Bounded: repeated puts of the same keys must not accumulate entries.
+    assert len(cache.segments) <= 8
+
+
+async def test_sharded_put_zero_copy_reassembly(store):
+    """Sharded puts + whole-tensor get without destination: parts are served
+    as views and assembled; content must match exactly."""
+    full = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    for i in range(4):
+        sl = TensorSlice(
+            offsets=(i * 4, 0),
+            local_shape=(4, 4),
+            global_shape=(16, 4),
+            coordinates=(i,),
+            mesh_shape=(4,),
+        )
+        await ts.put("sh", Shard(data=full[i * 4 : (i + 1) * 4], tensor_slice=sl), store_name=store)
+    out = await ts.get("sh", store_name=store)
+    np.testing.assert_array_equal(out, full)

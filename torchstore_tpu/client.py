@@ -213,7 +213,14 @@ class LocalClient:
             for vid, sub in subs:
                 by_volume.setdefault(vid, []).append((idx, sub))
 
-        async def fetch_volume(vid: str, entries: list[tuple[int, Request]]):
+        # Results are collected by SIDE EFFECT (tasks return None): a finished
+        # asyncio Task retains its result until garbage collection, so
+        # returning fetched arrays through gather() would keep zero-copy
+        # views alive indefinitely — the volume would never see their
+        # releases and every put would retire-and-reallocate segments.
+        parts_by_request: dict[int, list[tuple[Request, Any]]] = {}
+
+        async def fetch_volume(vid: str, entries: list[tuple[int, Request]]) -> None:
             volume = self._volume_refs[vid]
             buffer = create_transport_buffer(volume, self._config)
             subs = [sub for _, sub in entries]
@@ -224,19 +231,17 @@ class LocalClient:
                 for sub in subs:
                     b = create_transport_buffer(volume, self._config)
                     results.extend(await b.get_from_storage_volume(volume, [sub]))
-            return [(idx, sub, res) for (idx, sub), res in zip(entries, results)]
+            for (idx, sub), res in zip(entries, results):
+                parts_by_request.setdefault(idx, []).append((sub, res))
 
-        volume_results = await asyncio.gather(
+        await asyncio.gather(
             *(fetch_volume(vid, entries) for vid, entries in by_volume.items())
         )
-        parts_by_request: dict[int, list[tuple[Request, Any]]] = {}
-        for chunk in volume_results:
-            for idx, sub, res in chunk:
-                parts_by_request.setdefault(idx, []).append((sub, res))
-        return [
-            self._assemble_result(req, parts_by_request.get(idx, []))
+        out = [
+            self._assemble_result(req, parts_by_request.pop(idx, []))
             for idx, req in enumerate(requests)
         ]
+        return out
 
     def _transports_support_inplace(self, located) -> tuple[bool, bool]:
         """(supports_inplace, requires_contiguous) across every transport that

@@ -46,8 +46,20 @@ class StoreConfig:
     ici_enabled: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_ICI_ENABLED", True)
     )
-    mutable_shm: bool = field(
-        default_factory=lambda: _env_bool("TORCHSTORE_TPU_MUTABLE_SHM", False)
+    # Zero-copy SHM gets: same-host fetches without an in-place destination
+    # return read-only snapshot views of the volume's segments instead of
+    # copies. Safe by default: the volume lease-counts served views and
+    # retires (never overwrites) a viewed segment on the next put, so a held
+    # view is always an immutable snapshot.
+    zero_copy_get: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_ZERO_COPY_GET", True)
+    )
+    # Cap on the volume-side pool of recycled SHM segments (bytes). Released
+    # segments beyond the cap are unlinked oldest-first.
+    shm_pool_max_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_SHM_POOL_MAX_BYTES", 4 << 30
+        )
     )
     # Use the native C++ data-path library when built.
     use_native: bool = field(

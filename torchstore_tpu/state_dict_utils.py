@@ -51,34 +51,40 @@ def flatten_state_dict(sd: Any) -> tuple[dict[str, Any], dict]:
     import path) for exact reconstruction — the role DCP's
     ``flatten_state_dict`` plays in the reference."""
     flat: dict[str, Any] = {}
-
-    def rec(value: Any, path: list[str]) -> dict:
-        if isinstance(value, dict):
-            return {
-                "kind": "dict",
-                "items": {
-                    str(k): rec(v, path + [str(k)]) for k, v in value.items()
-                },
-                "key_types": {str(k): _key_type(k) for k in value},
-            }
-        if isinstance(value, (list, tuple)):
-            kind = "list" if isinstance(value, list) else "tuple"
-            entry: dict = {
-                "kind": kind,
-                "items": [rec(v, path + [str(i)]) for i, v in enumerate(value)],
-            }
-            if isinstance(value, tuple) and hasattr(value, "_fields"):
-                entry["kind"] = "namedtuple"
-                entry["cls"] = f"{type(value).__module__}:{type(value).__qualname__}"
-            return entry
-        flat_key = _SEP.join(path)
-        if flat_key in flat:
-            raise ValueError(f"duplicate flattened key {flat_key!r}")
-        flat[flat_key] = value
-        return {"kind": "leaf", "key": flat_key}
-
-    mapping = rec(sd, [])
+    mapping = _flatten_rec(sd, [], flat)
     return flat, mapping
+
+
+def _flatten_rec(value: Any, path: list[str], flat: dict[str, Any]) -> dict:
+    # Module-level recursion for the same reason as _unflatten_rec: an inner
+    # closure would be a cycle pinning every leaf array until cyclic GC.
+    if isinstance(value, dict):
+        return {
+            "kind": "dict",
+            "items": {
+                str(k): _flatten_rec(v, path + [str(k)], flat)
+                for k, v in value.items()
+            },
+            "key_types": {str(k): _key_type(k) for k in value},
+        }
+    if isinstance(value, (list, tuple)):
+        kind = "list" if isinstance(value, list) else "tuple"
+        entry: dict = {
+            "kind": kind,
+            "items": [
+                _flatten_rec(v, path + [str(i)], flat)
+                for i, v in enumerate(value)
+            ],
+        }
+        if isinstance(value, tuple) and hasattr(value, "_fields"):
+            entry["kind"] = "namedtuple"
+            entry["cls"] = f"{type(value).__module__}:{type(value).__qualname__}"
+        return entry
+    flat_key = _SEP.join(path)
+    if flat_key in flat:
+        raise ValueError(f"duplicate flattened key {flat_key!r}")
+    flat[flat_key] = value
+    return {"kind": "leaf", "key": flat_key}
 
 
 def _key_type(key: Any) -> str:
@@ -88,29 +94,34 @@ def _key_type(key: Any) -> str:
 
 
 def unflatten_state_dict(flat: dict[str, Any], mapping: dict) -> Any:
-    def rec(entry: dict) -> Any:
-        kind = entry["kind"]
-        if kind == "leaf":
-            return flat[entry["key"]]
-        if kind == "dict":
-            key_types = entry.get("key_types", {})
-            return {
-                (int(k) if key_types.get(k) == "int" else k): rec(v)
-                for k, v in entry["items"].items()
-            }
-        children = [rec(v) for v in entry["items"]]
-        if kind == "list":
-            return children
-        if kind == "tuple":
-            return tuple(children)
-        if kind == "namedtuple":
-            cls = _resolve_class(entry["cls"])
-            if cls is None:
-                return tuple(children)
-            return cls(*children)
-        raise ValueError(f"corrupt mapping entry {entry!r}")
+    # Module-level recursion (not an inner closure): a self-referencing
+    # closure is a reference cycle that pins ``flat``'s arrays — including
+    # zero-copy SHM views — until the next cyclic GC pass, which defers
+    # their release back to the storage volume.
+    return _unflatten_rec(mapping, flat)
 
-    return rec(mapping)
+
+def _unflatten_rec(entry: dict, flat: dict[str, Any]) -> Any:
+    kind = entry["kind"]
+    if kind == "leaf":
+        return flat[entry["key"]]
+    if kind == "dict":
+        key_types = entry.get("key_types", {})
+        return {
+            (int(k) if key_types.get(k) == "int" else k): _unflatten_rec(v, flat)
+            for k, v in entry["items"].items()
+        }
+    children = [_unflatten_rec(v, flat) for v in entry["items"]]
+    if kind == "list":
+        return children
+    if kind == "tuple":
+        return tuple(children)
+    if kind == "namedtuple":
+        cls = _resolve_class(entry["cls"])
+        if cls is None:
+            return tuple(children)
+        return cls(*children)
+    raise ValueError(f"corrupt mapping entry {entry!r}")
 
 
 def _resolve_class(spec: str):

@@ -244,12 +244,13 @@ class StorageVolume(Actor):
         return await maybe_await(buffer.recv_handshake(self.ctx, metas, existing, op))
 
     @endpoint
-    async def put(self, buffer: TransportBuffer, metas: list[Request]) -> None:
+    async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
         existing = self.store.extract_existing(metas)
         values = await maybe_await(
             buffer.handle_put_request(self.ctx, metas, existing)
         )
         self.store.store(metas, values)
+        return buffer.put_reply()
 
     @endpoint
     async def get(

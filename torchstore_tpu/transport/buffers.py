@@ -81,6 +81,10 @@ class TransportBuffer(ABC):
     """
 
     requires_handshake: bool = False
+    # Which ops actually need the handshake RPC; transports whose gets are
+    # self-describing (SHM descriptors ride the get response) skip the extra
+    # round trip by narrowing this to ("put",).
+    handshake_ops: tuple = ("put", "get")
     supports_inplace: bool = True
     requires_contiguous_inplace: bool = False
     supports_batch_puts: bool = True
@@ -98,11 +102,12 @@ class TransportBuffer(ABC):
                     "(Shard.data must not be None on puts)"
                 )
         try:
-            if self.requires_handshake:
+            if self.requires_handshake and "put" in self.handshake_ops:
                 await self._perform_handshake(volume, requests, op="put")
             await self._pre_put_hook(volume, requests)
             metas = [r.meta_only() for r in requests]
-            await volume.actor.put.call_one(self, metas)
+            reply = await volume.actor.put.call_one(self, metas)
+            self._handle_put_reply(volume, reply, requests)
             self._post_request_success(volume)
         finally:
             self.drop()
@@ -111,7 +116,7 @@ class TransportBuffer(ABC):
         self, volume: "StorageVolumeRef", requests: list[Request]
     ) -> list[np.ndarray]:
         try:
-            if self.requires_handshake:
+            if self.requires_handshake and "get" in self.handshake_ops:
                 await self._perform_handshake(volume, requests, op="get")
             await self._pre_get_hook(volume, requests)
             metas = [r.meta_only() for r in requests]
@@ -153,6 +158,11 @@ class TransportBuffer(ABC):
         """Land fetched data: into destination views when attached, else
         return fresh arrays, in request order."""
 
+    def _handle_put_reply(self, volume, reply, requests) -> None:  # noqa: B027
+        """Process the server's (small, picklable) put reply — e.g. segment
+        renames a client cache must adopt. ``reply`` is ``put_reply()``'s
+        return value from the server-side buffer instance."""
+
     def _post_request_success(self, volume) -> None:  # noqa: B027
         """Promote any handshake-scoped resources to the reusable cache —
         only reached on success, so failed requests cannot poison caches
@@ -179,6 +189,11 @@ class TransportBuffer(ABC):
         host array} for the store to keep (may be a coroutine). ``existing``
         maps request index -> previously stored array for in-place reuse
         (invariant 6)."""
+
+    def put_reply(self):
+        """Small picklable reply returned to the client after a put lands
+        (rides the put RPC response; must never carry tensor bytes)."""
+        return None
 
     @abstractmethod
     def handle_get_request(

@@ -7,28 +7,43 @@ ABI the native C++ backend accelerates); clients copy directly into/out of
 those segments, so a put is exactly one memcpy client-side and zero copies
 server-side (the volume's stored array IS a view of the segment).
 
-PUT:  handshake returns existing descriptors for reuse -> client allocates or
-      attaches + copies -> volume attaches and stores the view.
-GET:  volume returns a descriptor — zero-copy when the entry already lives in
-      one of its segments, else a staged copy whose ownership transfers to
-      the client (client unlinks after landing it).
+PUT:  handshake returns existing/pooled descriptors for reuse -> client
+      allocates or attaches + copies -> volume attaches and stores the view.
+GET:  the volume serves an (offset, strides) descriptor into its own segment
+      whenever the requested data is segment-backed — including arbitrary
+      sub-slices of stored shards (the reference's descriptor-view serve,
+      shared_memory.py:133-198) — so the server side is always zero-copy.
+      A client with an in-place destination copies once; a client without one
+      KEEPS the view: gets are zero-copy by default.
 
-Caches: ``ShmServerCache`` (volume side: key -> owned segment),
-``ShmClientCache`` (client side: segment name -> attachment), both invalidated
-per-key on delete (reference cache semantics, shared_memory.py:56-131).
+Safety of zero-copy reads (replaces an earlier opt-in ``mutable_shm`` flag):
+the volume lease-counts every view it serves. A put may overwrite a segment
+in place only while its lease count is zero; otherwise the put lands in a
+fresh (or pooled) segment and the old one is *retired* — the data a reader
+views is immutable for the life of the view. Clients track served views with
+weakrefs and piggyback release notices on their next RPC; released segments
+return to a volume-side free pool, so the steady state of a put/get loop
+recycles warm segments instead of allocating (double-buffer rotation).
+
+Caches: ``ShmServerCache`` (volume side: entries, leases, retired/free
+pools, staged-get TTLs), ``ShmClientCache`` (client side: attachments +
+view weakrefs), both invalidated per-key on delete (reference cache
+semantics, shared_memory.py:56-131).
 """
 
 from __future__ import annotations
 
 import mmap
 import os
+import time
 import uuid
-from dataclasses import dataclass
+import weakref
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
 import numpy as np
 
-from torchstore_tpu.config import StoreConfig
+from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
 from torchstore_tpu.transport.buffers import (
@@ -41,6 +56,10 @@ from torchstore_tpu.transport.types import Request, TensorMeta
 logger = get_logger("torchstore_tpu.transport.shm")
 
 SHM_DIR = "/dev/shm"
+
+STAGED_TTL_S = 120.0  # staged-get segments a crashed client never unlinked
+RETIRED_TTL_S = 600.0  # viewed-then-replaced segments never released
+RESERVED_TTL_S = 60.0  # handshake offers whose put never arrived
 
 
 def is_available() -> bool:
@@ -100,6 +119,7 @@ class ShmSegment:
         self.mmap = mm
         self.owner = owner
         self._closed = False
+        self._base_addr: Optional[int] = None
 
     @staticmethod
     def _path(name: str) -> str:
@@ -125,10 +145,36 @@ class ShmSegment:
             os.close(fd)
         return cls(name, size, mm, owner=False)
 
+    def base_addr(self) -> Optional[int]:
+        """Address of the mapping's first byte in THIS process (used to test
+        whether a stored array aliases this segment)."""
+        if self._base_addr is None:
+            if self.size == 0:
+                return None
+            self._base_addr = np.frombuffer(
+                self.mmap, dtype=np.uint8, count=1
+            ).__array_interface__["data"][0]
+        return self._base_addr
+
     def view(self, meta: TensorMeta, offset: int = 0) -> np.ndarray:
         return np.frombuffer(
             self.mmap, dtype=meta.np_dtype, count=int(np.prod(meta.shape) or 1), offset=offset
         ).reshape(meta.shape)
+
+    def strided_view(
+        self, meta: TensorMeta, offset: int, strides: Optional[tuple[int, ...]]
+    ) -> np.ndarray:
+        """View with explicit byte strides — serves sub-slices of stored
+        shards without staging (descriptor-view serve)."""
+        if strides is None:
+            return self.view(meta, offset)
+        return np.ndarray(
+            meta.shape,
+            dtype=meta.np_dtype,
+            buffer=self.mmap,
+            offset=offset,
+            strides=strides,
+        )
 
     def rename_to_owner(self) -> None:
         """Rename the segment so its name embeds THIS process's pid. Volumes
@@ -159,9 +205,20 @@ class ShmDescriptor:
     segment_size: int
     meta: TensorMeta
     offset: int = 0
+    # Byte strides for non-contiguous views (sub-slices of stored shards);
+    # None means C-contiguous at ``offset``.
+    strides: Optional[tuple[int, ...]] = None
     # 'volume' -> long-lived, volume owns; 'client' -> staged for one get,
     # the client unlinks after landing the data.
     owner: str = "volume"
+
+
+@dataclass
+class _Entry:
+    """One stored (key, coords) tensor backed by a volume-owned segment."""
+
+    seg: ShmSegment
+    meta: TensorMeta
 
 
 # --------------------------------------------------------------------------
@@ -169,30 +226,132 @@ class ShmDescriptor:
 # --------------------------------------------------------------------------
 
 
-STAGED_TTL_S = 120.0
-
-
 class ShmServerCache(TransportCache):
-    """Volume-side: (key, shard coords|None) -> (segment, meta) for segments
-    that back stored tensors/shards, plus staged-get segments awaiting client
-    pickup (normally unlinked by the client; reaped here after a TTL so a
-    crashed client cannot fill /dev/shm)."""
+    """Volume-side segment bookkeeping: live entries, view leases, retired
+    (viewed-then-replaced) segments awaiting release, a free pool of
+    recyclable segments, handshake reservations, and staged-get TTLs."""
 
     def __init__(self) -> None:
-        self.by_key: dict[str, dict[Optional[tuple], tuple[ShmSegment, TensorMeta]]] = {}
+        self.by_key: dict[str, dict[Optional[tuple], _Entry]] = {}
         self.staged: dict[str, tuple[ShmSegment, float]] = {}
+        # name -> outstanding read leases across all clients (zero-copy
+        # views AND in-flight destination copies)
+        self.grants: dict[str, int] = {}
+        # client_id -> highest applied release-batch seq (exactly-once
+        # application of retransmitted release batches)
+        self.last_applied: dict[str, int] = {}
+        # name -> (seg, ts): replaced while leased; released -> free pool
+        self.retired: dict[str, tuple[ShmSegment, float]] = {}
+        # exact-size free pool of volume-owned, still-linked segments
+        self.free_by_size: dict[int, list[ShmSegment]] = {}
+        self.free_order: list[tuple[str, float]] = []  # (name, ts) oldest-first
+        self.free_bytes = 0
+        # Env-seeded default; overridden per-request from the StoreConfig the
+        # client buffer carries (see adopt_config) so programmatic
+        # initialize(config=...) settings reach the volume side.
+        self.pool_cap = default_config().shm_pool_max_bytes
+        # pooled segments offered in a put handshake, awaiting the put RPC
+        self.reserved: dict[str, tuple[ShmSegment, float]] = {}
+        # entry segments offered for in-place overwrite: gets must not serve
+        # zero-copy views of them until the put lands (snapshot safety)
+        self.write_pending: dict[str, float] = {}
+
+    def adopt_config(self, config: Optional[StoreConfig]) -> None:
+        if config is not None:
+            self.pool_cap = config.shm_pool_max_bytes
+
+    # ---- sweeping --------------------------------------------------------
+
+    def sweep(self) -> None:
+        now = time.monotonic()
+        for name, (seg, ts) in list(self.staged.items()):
+            if now - ts > STAGED_TTL_S:
+                seg.unlink()  # no-op if the client already unlinked it
+                del self.staged[name]
+        for name, (seg, ts) in list(self.retired.items()):
+            if now - ts > RETIRED_TTL_S:
+                # Client never released (likely crashed). Live readers keep
+                # their mapping after the unlink; the name is done either way.
+                seg.unlink()
+                del self.retired[name]
+                self.grants.pop(name, None)
+        for name, (seg, ts) in list(self.reserved.items()):
+            if now - ts > RESERVED_TTL_S:
+                # The reserving put never arrived (client crashed or is
+                # extremely slow). Unlink rather than re-pool: re-pooling
+                # could hand the segment to a second writer while the
+                # original put is still copying into it — a very late put
+                # then fails cleanly on attach instead of corrupting data.
+                del self.reserved[name]
+                seg.unlink()
+        for name, ts in list(self.write_pending.items()):
+            if now - ts > RESERVED_TTL_S:
+                del self.write_pending[name]
+
+    # ---- leases ----------------------------------------------------------
+
+    def grant(self, name: str) -> None:
+        self.grants[name] = self.grants.get(name, 0) + 1
+
+    def apply_releases(self, payload: Optional[dict]) -> None:
+        """Apply a client's release batches. Batches are (seq, counts) pairs
+        retransmitted until acked; ``last_applied`` makes application
+        exactly-once, so neither a lost response nor a retransmission can
+        over- or under-decrement a lease (an over-decrement would recycle a
+        segment under a still-live reader)."""
+        if not payload:
+            return
+        client_id = payload["client"]
+        last = self.last_applied.get(client_id, 0)
+        for seq, counts in sorted(payload["batches"]):
+            if seq <= last:
+                continue
+            last = seq
+            for name, n in counts.items():
+                have = self.grants.get(name)
+                if have is None:
+                    continue
+                have -= n
+                if have > 0:
+                    self.grants[name] = have
+                    continue
+                del self.grants[name]
+                entry = self.retired.pop(name, None)
+                if entry is not None:
+                    self._add_free(entry[0])
+        self.last_applied[client_id] = last
+
+    # ---- free pool -------------------------------------------------------
+
+    def _add_free(self, seg: ShmSegment) -> None:
+        self.free_by_size.setdefault(seg.size, []).append(seg)
+        self.free_order.append((seg.name, time.monotonic()))
+        self.free_bytes += seg.size
+        while self.free_bytes > self.pool_cap and self.free_order:
+            old_name, _ = self.free_order.pop(0)
+            for size, segs in self.free_by_size.items():
+                victim = next((s for s in segs if s.name == old_name), None)
+                if victim is not None:
+                    segs.remove(victim)
+                    self.free_bytes -= victim.size
+                    victim.unlink()
+                    break
+
+    def take_free(self, size: int) -> Optional[ShmSegment]:
+        segs = self.free_by_size.get(size)
+        if not segs:
+            return None
+        seg = segs.pop()
+        self.free_bytes -= seg.size
+        self.free_order = [(n, t) for n, t in self.free_order if n != seg.name]
+        return seg
+
+    # ---- entries ---------------------------------------------------------
 
     def track_staged(self, seg: ShmSegment) -> None:
-        import time
+        self.staged[seg.name] = (seg, time.monotonic())
 
-        now = time.monotonic()
-        self.staged[seg.name] = (seg, now)
-        for name, (old, ts) in list(self.staged.items()):
-            if now - ts > STAGED_TTL_S:
-                old.unlink()  # no-op if the client already unlinked it
-                del self.staged[name]
-
-    def lookup(self, key: str, coords: Optional[tuple]):
+    def lookup(self, key: str, coords: Optional[tuple]) -> Optional[_Entry]:
         return self.by_key.get(key, {}).get(coords)
 
     def put(
@@ -200,54 +359,163 @@ class ShmServerCache(TransportCache):
     ) -> None:
         entries = self.by_key.setdefault(key, {})
         prev = entries.get(coords)
-        if prev is not None and prev[0].name != seg.name:
-            prev[0].unlink()
-        entries[coords] = (seg, meta)
+        if prev is not None and prev.seg.name != seg.name:
+            self._retire_or_free(prev.seg)
+        entries[coords] = _Entry(seg, meta)
 
-    def segments_for(self, key: str):
-        return [seg for seg, _ in self.by_key.get(key, {}).values()]
+    def _retire_or_free(self, seg: ShmSegment) -> None:
+        if self.grants.get(seg.name):
+            self.retired[seg.name] = (seg, time.monotonic())
+        else:
+            self._add_free(seg)
+
+    def segments_for(self, key: str) -> list[ShmSegment]:
+        return [e.seg for e in self.by_key.get(key, {}).values()]
+
+    def locate(self, key: str, arr: np.ndarray) -> Optional[tuple[ShmSegment, int]]:
+        """Find the entry segment ``arr``'s memory lives in (anywhere within
+        it — sub-slice views included), or None."""
+        if arr.nbytes == 0:
+            return None
+        ptr = arr.__array_interface__["data"][0]
+        for seg in self.segments_for(key):
+            base = seg.base_addr()
+            if base is not None and base <= ptr < base + seg.size:
+                return seg, ptr - base
+        return None
 
     def delete_key(self, key: str) -> None:
-        for seg, _ in self.by_key.pop(key, {}).values():
-            seg.unlink()
+        for entry in self.by_key.pop(key, {}).values():
+            entry.seg.unlink()
+            self.grants.pop(entry.seg.name, None)
+            self.write_pending.pop(entry.seg.name, None)
 
     def clear(self) -> None:
         for entries in self.by_key.values():
-            for seg, _ in entries.values():
-                seg.unlink()
+            for entry in entries.values():
+                entry.seg.unlink()
         self.by_key.clear()
         for seg, _ in self.staged.values():
             seg.unlink()
         self.staged.clear()
+        for seg, _ in self.retired.values():
+            seg.unlink()
+        self.retired.clear()
+        for segs in self.free_by_size.values():
+            for seg in segs:
+                seg.unlink()
+        self.free_by_size.clear()
+        self.free_order.clear()
+        self.free_bytes = 0
+        for seg, _ in self.reserved.values():
+            seg.unlink()
+        self.reserved.clear()
+        self.grants.clear()
+        self.write_pending.clear()
 
 
 class ShmClientCache(TransportCache):
     """Client-side: segment name -> attachment, so repeat transfers skip the
-    open+mmap syscalls. Keyed back to store keys for invalidation."""
+    open+mmap syscalls; plus weakref tracking of zero-copy views handed to
+    the caller. Releases are routed per VOLUME (one client talks to many
+    volumes) as sequence-numbered batches retransmitted until acked, so a
+    failed RPC can neither lose a release (leaking the server lease) nor
+    double-apply one (recycling a segment under a live reader)."""
 
     def __init__(self) -> None:
+        self.client_id = uuid.uuid4().hex
         self.segments: dict[str, ShmSegment] = {}
         self.key_to_segments: dict[str, set[str]] = {}
+        self.seg_volume: dict[str, str] = {}  # name -> volume_id
+        self.view_refs: dict[str, list] = {}  # name -> [weakref.ref, ...]
+        # volume_id -> {name: count} not yet assigned to a batch
+        self.pending: dict[str, dict[str, int]] = {}
+        # volume_id -> {seq: counts} sent but not yet acked
+        self.unacked: dict[str, dict[int, dict[str, int]]] = {}
+        self.seq: dict[str, int] = {}
 
-    def attach(self, desc: ShmDescriptor, key: str) -> ShmSegment:
+    def attach(self, desc: ShmDescriptor, key: str, volume_id: str) -> ShmSegment:
         seg = self.segments.get(desc.segment_name)
         if seg is None:
             seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
             self.segments[desc.segment_name] = seg
         self.key_to_segments.setdefault(key, set()).add(desc.segment_name)
+        self.seg_volume[desc.segment_name] = volume_id
         return seg
+
+    def rekey(self, old_name: str, new_name: str) -> None:
+        """The volume adopted + renamed a segment this client created: track
+        the attachment under the new name (the mapping itself is unchanged —
+        rename does not invalidate mmaps), so later handshake offers of the
+        renamed segment hit the cache instead of leaking a stale entry."""
+        seg = self.segments.pop(old_name, None)
+        if seg is not None:
+            seg.name = new_name
+            self.segments[new_name] = seg
+        for names in self.key_to_segments.values():
+            if old_name in names:
+                names.discard(old_name)
+                names.add(new_name)
+        vid = self.seg_volume.pop(old_name, None)
+        if vid is not None:
+            self.seg_volume[new_name] = vid
+
+    def track_view(self, name: str, arr: np.ndarray) -> None:
+        self.view_refs.setdefault(name, []).append(weakref.ref(arr))
+
+    def count_release(self, name: str, n: int = 1) -> None:
+        vid = self.seg_volume.get(name)
+        if vid is None:
+            return
+        counts = self.pending.setdefault(vid, {})
+        counts[name] = counts.get(name, 0) + n
+
+    def collect_released(self, volume_id: str) -> Optional[dict]:
+        """Release payload for ``volume_id``: all unacked batches (including
+        a fresh one from views dropped since the last RPC), or None."""
+        for name, refs in list(self.view_refs.items()):
+            live = [r for r in refs if r() is not None]
+            dead = len(refs) - len(live)
+            if dead:
+                self.count_release(name, dead)
+            if live:
+                self.view_refs[name] = live
+            else:
+                del self.view_refs[name]
+        fresh = self.pending.pop(volume_id, None)
+        if fresh:
+            s = self.seq[volume_id] = self.seq.get(volume_id, 0) + 1
+            self.unacked.setdefault(volume_id, {})[s] = fresh
+        batches = self.unacked.get(volume_id)
+        if not batches:
+            return None
+        return {"client": self.client_id, "batches": sorted(batches.items())}
+
+    def ack_released(self, volume_id: str, payload: Optional[dict]) -> None:
+        if not payload:
+            return
+        batches = self.unacked.get(volume_id)
+        if batches:
+            for seq, _ in payload["batches"]:
+                batches.pop(seq, None)
 
     def delete_key(self, key: str) -> None:
         for name in self.key_to_segments.pop(key, ()):  # drop attachments
             seg = self.segments.pop(name, None)
             if seg is not None:
                 seg.close()
+            self.seg_volume.pop(name, None)
 
     def clear(self) -> None:
         for seg in self.segments.values():
             seg.close()
         self.segments.clear()
         self.key_to_segments.clear()
+        self.seg_volume.clear()
+        self.view_refs.clear()
+        self.pending.clear()
+        self.unacked.clear()
+        self.seq.clear()
 
 
 # --------------------------------------------------------------------------
@@ -257,32 +525,48 @@ class ShmClientCache(TransportCache):
 
 class SharedMemoryTransportBuffer(TransportBuffer):
     requires_handshake = True
+    # Gets are self-describing (descriptors ride the get response) — no
+    # handshake round trip on the read path.
+    handshake_ops = ("put",)
     supports_inplace = True
     requires_contiguous_inplace = False
     supports_batch_puts = True
     supports_batch_gets = True
 
     def __init__(self, config: Optional[StoreConfig] = None):
+        # config TRAVELS with the buffer (like the bulk transport's) so the
+        # volume side honors programmatic initialize(config=...) overrides.
         self.config = config
         self.descriptors: dict[int, ShmDescriptor] = {}
         self.objects: dict[int, Any] = {}
+        # client -> server piggyback: sequenced view-release batches
+        self.released: Optional[dict] = None
+        # server -> client (via put_reply): adopted-segment renames
+        self.renames: dict[str, str] = {}
         # Client-only staging state (never pickled).
         self._client_segments: dict[int, ShmSegment] = {}
-        self._reuse: dict[int, ShmDescriptor] = {}
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_client_segments"] = {}
-        state["_reuse"] = {}
-        state["config"] = None
         return state
 
     # ---- client: put -----------------------------------------------------
+
+    def _pre_handshake(self, volume, requests, op) -> None:
+        if op != "put":
+            return
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        self.released = cache.collect_released(volume.volume_id)
 
     def _post_handshake(self, volume, requests, reply, op) -> None:
         if op != "put":
             return
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        # The handshake RPC delivered the release batches; ack them (a failed
+        # RPC leaves them unacked for retransmission instead).
+        cache.ack_released(volume.volume_id, self.released)
+        self.released = None
         offered: dict[int, ShmDescriptor] = reply or {}
         for idx, req in enumerate(requests):
             if req.is_object:
@@ -292,17 +576,25 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             meta = TensorMeta.of(arr)
             desc = offered.get(idx)
             if desc is not None and desc.meta == meta:
-                seg = cache.attach(desc, req.key)
+                seg = cache.attach(desc, req.key, volume.volume_id)
             else:
                 seg = ShmSegment.create(max(arr.nbytes, 1))
                 desc = ShmDescriptor(seg.name, seg.size, meta)
                 cache.segments[seg.name] = seg
                 cache.key_to_segments.setdefault(req.key, set()).add(seg.name)
+                cache.seg_volume[seg.name] = volume.volume_id
             # THE hot memcpy: client array -> shared segment (native
             # multi-threaded path on multi-core hosts).
             fast_copy(seg.view(meta, desc.offset), arr)
             self.descriptors[idx] = desc
             self._client_segments[idx] = seg
+
+    def _handle_put_reply(self, volume, reply, requests) -> None:
+        if not reply:
+            return
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        for old_name, new_name in reply.get("renames", {}).items():
+            cache.rekey(old_name, new_name)
 
     # ---- server: put -----------------------------------------------------
 
@@ -312,26 +604,49 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         if op != "put":
             return None
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        cache.adopt_config(self.config)
+        cache.apply_releases(self.released)
+        cache.sweep()
         offered: dict[int, ShmDescriptor] = {}
         for idx, meta in enumerate(metas):
             if meta.tensor_meta is None:
                 continue
             coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
             entry = cache.lookup(meta.key, coords)
-            if entry is None:
+            if (
+                entry is not None
+                and entry.meta == meta.tensor_meta
+                and not cache.grants.get(entry.seg.name)
+                # Another put's in-place overwrite of this segment may be in
+                # flight — offering it twice would interleave two writers.
+                and entry.seg.name not in cache.write_pending
+            ):
+                # No outstanding views: offer the existing segment for
+                # in-place overwrite (descriptor-reuse handshake, reference
+                # shared_memory.py:340-360). Gets serve staged copies of it
+                # until the put lands (snapshot safety).
+                cache.write_pending[entry.seg.name] = time.monotonic()
+                offered[idx] = ShmDescriptor(
+                    entry.seg.name, entry.seg.size, entry.meta
+                )
                 continue
-            seg, stored_meta = entry
-            if stored_meta == meta.tensor_meta:
-                # Same shape/dtype: offer the existing segment for in-place
-                # reuse (descriptor-reuse handshake, reference
-                # shared_memory.py:340-360).
-                offered[idx] = ShmDescriptor(seg.name, seg.size, stored_meta)
+            # Entry is leased (or absent/shape-changed): offer a warm pooled
+            # segment so steady-state put/get loops rotate buffers instead of
+            # allocating cold ones.
+            pooled = cache.take_free(max(meta.tensor_meta.nbytes, 1))
+            if pooled is not None:
+                cache.reserved[pooled.name] = (pooled, time.monotonic())
+                offered[idx] = ShmDescriptor(
+                    pooled.name, pooled.size, meta.tensor_meta
+                )
         return offered
 
     def handle_put_request(
         self, ctx: TransportContext, metas: list[Request], existing: dict
     ) -> dict[int, Any]:
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        cache.adopt_config(self.config)
+        cache.apply_releases(self.released)
         out: dict[int, Any] = {}
         for idx, obj in self.objects.items():
             out[idx] = obj
@@ -339,17 +654,28 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             meta = metas[idx]
             coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
             current = cache.lookup(meta.key, coords)
-            if current is not None and current[0].name == desc.segment_name:
-                seg = current[0]
+            cache.write_pending.pop(desc.segment_name, None)
+            reserved = cache.reserved.pop(desc.segment_name, None)
+            if current is not None and current.seg.name == desc.segment_name:
+                seg = current.seg  # in-place overwrite of the live segment
+            elif reserved is not None:
+                seg = reserved[0]  # pooled segment, already volume-owned
             else:
                 seg = ShmSegment.attach(desc.segment_name, desc.segment_size)
                 seg.owner = True  # volume takes ownership of the lifetime
                 # The name's pid must track ownership (see rename_to_owner);
-                # future handshakes/gets serve the new name from the cache.
+                # future handshakes/gets serve the new name from the cache —
+                # and the client is told via put_reply so its attachment
+                # cache follows the rename instead of leaking.
+                old_name = seg.name
                 seg.rename_to_owner()
+                self.renames[old_name] = seg.name
             cache.put(meta.key, coords, seg, desc.meta)
             out[idx] = seg.view(desc.meta, desc.offset)
         return out
+
+    def put_reply(self):
+        return {"renames": self.renames} if self.renames else None
 
     # ---- server: get -----------------------------------------------------
 
@@ -357,42 +683,76 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         self, ctx: TransportContext, metas: list[Request], entries: list[Any]
     ) -> None:
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
+        cache.adopt_config(self.config)
+        cache.apply_releases(self.released)
+        cache.sweep()
         for idx, (meta, entry) in enumerate(zip(metas, entries)):
             if meta.is_object:
                 self.objects[idx] = entry
                 continue
             entry = np.asarray(entry)
-            served = next(
-                (
-                    seg
-                    for seg in cache.segments_for(meta.key)
-                    if _aliases_whole(entry, seg)
-                ),
-                None,
-            )
-            if served is not None:
-                self.descriptors[idx] = ShmDescriptor(
-                    served.name, served.size, TensorMeta.of(entry)
-                )
+            desc = self._serve_descriptor(cache, meta, entry)
+            if desc is not None:
+                self.descriptors[idx] = desc
                 continue
-            contig = np.ascontiguousarray(entry)
-            seg = ShmSegment.create(max(contig.nbytes, 1))
-            tmeta = TensorMeta.of(contig)
-            fast_copy(seg.view(tmeta), contig)
-            # Ownership transfers to the client, which unlinks after landing;
-            # the server reaps it after a TTL if the client never does.
+            # Not segment-backed (or write-pending): stage a copy whose
+            # ownership transfers to the client (client unlinks after
+            # landing; the server reaps it after a TTL otherwise).
+            tmeta = TensorMeta.of(entry)
+            seg = ShmSegment.create(max(tmeta.nbytes, 1))
+            fast_copy(seg.view(tmeta), entry)
             cache.track_staged(seg)
             self.descriptors[idx] = ShmDescriptor(
                 seg.name, seg.size, tmeta, owner="client"
             )
 
+    def _serve_descriptor(
+        self, cache: ShmServerCache, meta: Request, entry: np.ndarray
+    ) -> Optional[ShmDescriptor]:
+        """Zero-copy descriptor for ``entry`` if it aliases an entry segment
+        (whole tensors AND sub-slice views — any non-negative-stride view of
+        segment memory is expressible as offset+strides)."""
+        loc = cache.locate(meta.key, entry)
+        if loc is None:
+            return None
+        seg, offset = loc
+        if seg.name in cache.write_pending:
+            return None  # an in-place put was promised; serve a snapshot copy
+        strides = entry.strides
+        if any(s < 0 for s in strides):
+            return None
+        extent = entry.itemsize + sum(
+            (d - 1) * s for d, s in zip(entry.shape, strides) if d > 0
+        )
+        if offset + extent > seg.size:
+            return None
+        # Lease for EVERY volume-owned serve: zero-copy views hold it until
+        # GC'd; in-place destination copies hold it only until the client's
+        # copy lands (released on its next RPC). Either way a concurrent
+        # put can never be offered this segment mid-read.
+        cache.grant(seg.name)
+        return ShmDescriptor(
+            seg.name,
+            seg.size,
+            TensorMeta.of(entry),
+            offset=offset,
+            strides=None if entry.flags["C_CONTIGUOUS"] else tuple(strides),
+        )
+
     # ---- client: get -----------------------------------------------------
+
+    async def _pre_get_hook(self, volume, requests) -> None:
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        self.released = cache.collect_released(volume.volume_id)
 
     def _handle_storage_volume_response(
         self, volume, remote: "SharedMemoryTransportBuffer", requests
     ) -> list[Any]:
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
-        mutable = bool(self.config and self.config.mutable_shm)
+        # The get RPC (which carried self.released) succeeded: ack batches.
+        cache.ack_released(volume.volume_id, self.released)
+        self.released = None
+        zero_copy = self.config is None or self.config.zero_copy_get
         results: list[Any] = []
         for idx, req in enumerate(requests):
             if req.is_object or idx in remote.objects:
@@ -405,15 +765,26 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 landed = self._land(req, src)
                 seg.unlink()
                 results.append(landed)
+                continue
+            seg = cache.attach(desc, req.key, volume.volume_id)
+            src = seg.strided_view(desc.meta, desc.offset, desc.strides)
+            if req.destination_view is not None:
+                fast_copy(req.destination_view, src)
+                # The copy has landed; release the read lease the volume
+                # granted for the duration of this in-place read.
+                cache.count_release(desc.segment_name)
+                results.append(req.destination_view)
+            elif zero_copy:
+                # Zero-copy read: hand out a read-only snapshot view of the
+                # live segment (the volume retires, never overwrites, leased
+                # segments). Released automatically when the array is GC'd.
+                src.flags.writeable = False
+                cache.track_view(desc.segment_name, src)
+                results.append(src)
             else:
-                seg = cache.attach(desc, req.key)
-                src = seg.view(desc.meta, desc.offset)
-                if mutable and req.destination_view is None:
-                    # Zero-copy read: caller sees the live segment. Mutations
-                    # by later puts become visible — opt-in via config.
-                    results.append(src)
-                else:
-                    results.append(self._land(req, src))
+                # Copying instead of keeping the view: release immediately.
+                cache.count_release(desc.segment_name)
+                results.append(src.copy())
         return results
 
     @staticmethod
@@ -424,22 +795,10 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         return src.copy()
 
     def drop(self) -> None:
+        # self.released is NOT re-credited here: unacked batches persist in
+        # the client cache and retransmit on the next RPC to that volume.
         self.descriptors = {}
         self.objects = {}
+        self.released = None
+        self.renames = {}
         self._client_segments = {}
-        self._reuse = {}
-
-
-def _aliases_whole(entry: np.ndarray, seg: ShmSegment) -> bool:
-    """True when ``entry`` is exactly the array stored over ``seg``'s buffer
-    start (whole-tensor fetch of a SHM-backed entry -> zero-copy get)."""
-    if not entry.flags["C_CONTIGUOUS"]:
-        return False
-    try:
-        seg_start = np.frombuffer(seg.mmap, dtype=np.uint8, count=1).__array_interface__[
-            "data"
-        ][0]
-    except ValueError:
-        return False
-    start = entry.__array_interface__["data"][0]
-    return start == seg_start and entry.nbytes <= seg.size
