@@ -1,0 +1,137 @@
+"""Connection-auth tests: HMAC challenge-response on actor / rendezvous /
+bulk listeners (ADVICE r1: unauthenticated pickle-over-TCP), plus the
+end-to-end store path with a secret configured."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import config as config_mod
+from torchstore_tpu.config import StoreConfig
+from torchstore_tpu.runtime import auth
+
+
+@pytest.fixture
+def secret_env():
+    """Set a process-wide auth secret for the test and restore after."""
+    old = os.environ.get("TORCHSTORE_TPU_AUTH_SECRET")
+    os.environ["TORCHSTORE_TPU_AUTH_SECRET"] = "test-secret-123"
+    config_mod._default_config = None
+    yield "test-secret-123"
+    if old is None:
+        os.environ.pop("TORCHSTORE_TPU_AUTH_SECRET", None)
+    else:
+        os.environ["TORCHSTORE_TPU_AUTH_SECRET"] = old
+    config_mod._default_config = None
+
+
+class TestChallengeResponse:
+    async def _serve_once(self, secret):
+        accepted = asyncio.get_running_loop().create_future()
+
+        async def handle(reader, writer):
+            ok = await auth.server_authenticate(reader, writer, secret)
+            if not accepted.done():
+                accepted.set_result(ok)
+            if ok:
+                writer.write(b"WELCOME!")
+                await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        return server, port, accepted
+
+    async def test_right_secret_accepted(self):
+        server, port, accepted = await self._serve_once("s3cret")
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await auth.client_authenticate(reader, writer, "s3cret")
+        assert await reader.readexactly(8) == b"WELCOME!"
+        assert await accepted is True
+        writer.close()
+        server.close()
+
+    async def test_wrong_secret_rejected(self):
+        server, port, accepted = await self._serve_once("s3cret")
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await auth.client_authenticate(reader, writer, "WRONG")
+        assert await accepted is False
+        # Server closes without serving anything.
+        assert await reader.read(8) == b""
+        writer.close()
+        server.close()
+
+    async def test_no_auth_client_rejected(self):
+        server, port, accepted = await self._serve_once("s3cret")
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        # A client unaware of auth writes its normal first frame; the server
+        # reads it as a (wrong) MAC and closes without parsing anything.
+        writer.write(b"\x00" * 64)
+        await writer.drain()
+        assert await accepted is False
+        writer.close()
+        server.close()
+
+    async def test_secret_client_plain_server_fails_loudly(self):
+        async def handle(reader, writer):
+            await asyncio.sleep(0.2)
+            writer.write(b"\x01" * 20)  # some non-challenge response
+            await writer.drain()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        with pytest.raises(ConnectionError, match="did not issue a challenge"):
+            await auth.client_authenticate(reader, writer, "s3cret")
+        writer.close()
+        server.close()
+
+    async def test_disabled_is_zero_overhead(self):
+        server, port, accepted = await self._serve_once(None)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await auth.client_authenticate(reader, writer, None)
+        assert await reader.readexactly(8) == b"WELCOME!"
+        writer.close()
+        server.close()
+
+
+@pytest.mark.parametrize("transport", ["shm", "bulk", "rpc"])
+async def test_store_roundtrip_with_auth(secret_env, transport):
+    """Full store path (actor RPC + data transport) with auth enabled."""
+    await ts.initialize(
+        store_name="auth",
+        strategy=ts.SingletonStrategy(default_transport_type=transport),
+        config=StoreConfig(auth_secret=secret_env),
+    )
+    try:
+        x = np.random.rand(4096).astype(np.float32)
+        await ts.put("k", x, store_name="auth")
+        np.testing.assert_array_equal(await ts.get("k", store_name="auth"), x)
+    finally:
+        await ts.shutdown("auth")
+
+
+async def test_rogue_connection_to_actor_server_rejected(secret_env):
+    await ts.initialize(
+        store_name="auth2", config=StoreConfig(auth_secret=secret_env)
+    )
+    try:
+        from torchstore_tpu import api
+
+        ref = api._stores["auth2"].controller
+        reader, writer = await asyncio.open_connection(ref.host, ref.port)
+        # Rogue peer with the WRONG secret: completes the challenge with a
+        # bad MAC; the server must close without processing any frame.
+        await auth.client_authenticate(reader, writer, "wrong-secret")
+        assert await reader.read(16) == b""  # connection dropped
+        writer.close()
+        # The store itself still works for authenticated clients.
+        await ts.put("ok", np.ones(8), store_name="auth2")
+        np.testing.assert_array_equal(
+            await ts.get("ok", store_name="auth2"), np.ones(8)
+        )
+    finally:
+        await ts.shutdown("auth2")

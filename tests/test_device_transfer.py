@@ -1,0 +1,157 @@
+"""Device-path (ICI rung) weight sync tests: the jax.experimental.transfer
+engine wrapper, sharding descriptors, and direct state-dict sync riding the
+device path end to end on the virtual 8-device CPU mesh (VERDICT r1 item 3;
+reference analog: one-sided RDMA device reads, monarch_rdma.py:158-219)."""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.transport import device_transfer as dt
+
+jax = pytest.importorskip("jax")
+
+pytestmark = pytest.mark.skipif(
+    not dt.is_available(), reason="jax.experimental.transfer not in this build"
+)
+
+
+def _mesh(n=8):
+    devs = np.array(jax.devices()[:n], dtype=object)
+    return jax.sharding.Mesh(devs.reshape(n), ("x",))
+
+
+class TestShardingDescriptor:
+    def test_named_roundtrip(self):
+        mesh = _mesh()
+        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+        desc = dt.ShardingDescriptor.of(sh)
+        rebuilt = desc.build()
+        assert rebuilt == sh
+
+    def test_single_device_roundtrip(self):
+        sh = jax.sharding.SingleDeviceSharding(jax.devices()[2])
+        rebuilt = dt.ShardingDescriptor.of(sh).build()
+        assert rebuilt == sh
+
+    def test_2d_mesh_with_tuple_spec(self):
+        devs = np.array(jax.devices()[:8], dtype=object).reshape(2, 4)
+        mesh = jax.sharding.Mesh(devs, ("a", "b"))
+        sh = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("a", "b"), None)
+        )
+        rebuilt = dt.ShardingDescriptor.of(sh).build()
+        assert rebuilt == sh
+
+
+class TestEngine:
+    def test_stage_and_pull_roundtrip(self):
+        engine = dt.DeviceTransferEngine.get()
+        addr = engine.ensure_server()
+        mesh = _mesh()
+        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+        x = jax.device_put(jax.numpy.arange(64.0), sh)
+        uid = engine.stage([x])
+        out = engine.pull(addr, uid, [dt.DeviceSpec.of(x)])
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+
+    def test_each_stage_serves_one_pull(self):
+        engine = dt.DeviceTransferEngine.get()
+        addr = engine.ensure_server()
+        x = jax.numpy.arange(16.0)
+        uids = [engine.stage([x * k]) for k in (1, 2)]
+        spec = [dt.DeviceSpec.of(x)]
+        out2 = engine.pull(addr, uids[1], spec)
+        out1 = engine.pull(addr, uids[0], spec)
+        assert np.asarray(out1[0])[1] == 1.0
+        assert np.asarray(out2[0])[1] == 2.0
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="ici")
+    yield "ici"
+    await ts.shutdown("ici")
+
+
+async def test_direct_sync_rides_device_path(store):
+    """All-jax direct put/get: handles advertise the device path, the pull
+    lands device arrays, and refresh semantics (current weights per pull)
+    hold — all with zero host staging buffers."""
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    sd = {
+        "w": jax.device_put(jax.numpy.arange(64.0), sh),
+        "b": jax.numpy.ones((8,), jax.numpy.float32),
+    }
+    await ts.put_state_dict("m", sd, direct=True, store_name=store)
+    target = {
+        "w": jax.ShapeDtypeStruct((64,), jax.numpy.float32, sharding=sh),
+        "b": np.zeros(8, np.float32),  # mixed target kinds: host landing
+    }
+    out = await ts.get_state_dict(
+        "m", user_state_dict=target, direct=True, store_name=store
+    )
+    assert dt.is_available()
+    assert hasattr(out["w"], "sharding")  # device array, not host copy
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(64.0))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.ones(8))
+
+    # Refresh: a second direct put of NEW values must be what the next
+    # pull sees (staging happens per pull, so weights are always current).
+    sd2 = {"w": jax.device_put(sd["w"] * 2, sh), "b": sd["b"] * 3}
+    await ts.put_state_dict("m", sd2, direct=True, store_name=store)
+    out2 = await ts.get_state_dict(
+        "m", user_state_dict=target, direct=True, store_name=store
+    )
+    np.testing.assert_array_equal(np.asarray(out2["w"]), np.arange(64.0) * 2)
+    np.testing.assert_array_equal(np.asarray(out2["b"]), np.full(8, 3.0))
+
+
+async def test_device_path_reshards_to_target(store):
+    """Dest asks for a different sharding than the source published: the
+    pull lands source-layout arrays and reshards locally over the mesh."""
+    mesh = _mesh()
+    src_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    sd = {"w": jax.device_put(jax.numpy.arange(64.0).reshape(8, 8), src_sh)}
+    await ts.put_state_dict("r", sd, direct=True, store_name=store)
+    devs2 = np.array(jax.devices()[:8], dtype=object).reshape(4, 2)
+    mesh2 = jax.sharding.Mesh(devs2, ("p", "q"))
+    tgt_sh = jax.sharding.NamedSharding(
+        mesh2, jax.sharding.PartitionSpec(None, "p")
+    )
+    target = {"w": jax.ShapeDtypeStruct((8, 8), jax.numpy.float32, sharding=tgt_sh)}
+    out = await ts.get_state_dict(
+        "r", user_state_dict=target, direct=True, store_name=store
+    )
+    assert out["w"].sharding == tgt_sh
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]), np.arange(64.0).reshape(8, 8)
+    )
+
+
+async def test_numpy_dict_still_uses_host_path(store):
+    """Plain-numpy direct sync keeps the host (SHM/TCP) path."""
+    sd = {"w": np.random.rand(128).astype(np.float32)}
+    await ts.put_state_dict("h", sd, direct=True, store_name=store)
+    user = {"w": np.zeros(128, np.float32)}
+    out = await ts.get_state_dict(
+        "h", user_state_dict=user, direct=True, store_name=store
+    )
+    np.testing.assert_array_equal(out["w"], sd["w"])
+
+
+async def test_ici_disabled_falls_back(store, monkeypatch):
+    monkeypatch.setenv("TORCHSTORE_TPU_ICI_ENABLED", "0")
+    from torchstore_tpu import config as config_mod
+
+    monkeypatch.setattr(config_mod, "_default_config", None)
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    sd = {"w": jax.device_put(jax.numpy.arange(32.0), sh)}
+    await ts.put_state_dict("fb", sd, direct=True, store_name=store)
+    target = {"w": jax.ShapeDtypeStruct((32,), jax.numpy.float32, sharding=sh)}
+    out = await ts.get_state_dict(
+        "fb", user_state_dict=target, direct=True, store_name=store
+    )
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(32.0))

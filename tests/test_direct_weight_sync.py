@@ -22,7 +22,7 @@ def make_sharded(arr, mesh_shape, names, spec):
 
 @pytest.fixture
 async def pair():
-    source = DirectWeightSyncSource()
+    source = DirectWeightSyncSource(device=False)
     dest = DirectWeightSyncDest()
     yield source, dest
     await dest.close()
@@ -38,7 +38,7 @@ async def test_exact_match_numpy(pair):
 
 
 async def test_tcp_path(tmp_path):
-    source = DirectWeightSyncSource(use_shm=False)
+    source = DirectWeightSyncSource(use_shm=False, device=False)
     dest = DirectWeightSyncDest()
     try:
         w = np.random.rand(64).astype(np.float32)
@@ -121,11 +121,11 @@ async def test_non_tensor_leaves_skipped(pair):
 
 async def test_dead_buffer_raises(pair):
     source, dest = pair
-    source_b = DirectWeightSyncSource(use_shm=False)
+    source_b = DirectWeightSyncSource(use_shm=False, device=False)
     handles = await source_b.register({"w": np.ones(4)})
     await source_b.close()
     # Re-register on a fresh source -> old buffer ids are gone server-side.
-    source_c = DirectWeightSyncSource(use_shm=False)
+    source_c = DirectWeightSyncSource(use_shm=False, device=False)
     await source_c.register({"other": np.ones(2)})
     try:
         bad = {
@@ -185,7 +185,7 @@ async def test_spec_dtype_honored_buffered():
 async def test_ranged_tcp_reads_with_shard_target():
     # Shard targets pull only their region; over TCP the read is RANGED
     # (fewer bytes on the wire) and lands in the provided buffer.
-    source = DirectWeightSyncSource(use_shm=False)
+    source = DirectWeightSyncSource(use_shm=False, device=False)
     dest = DirectWeightSyncDest()
     try:
         w = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
@@ -209,7 +209,7 @@ async def test_ranged_tcp_reads_with_shard_target():
 
 
 async def test_bufferless_shard_target():
-    source = DirectWeightSyncSource()
+    source = DirectWeightSyncSource(device=False)
     dest = DirectWeightSyncDest()
     try:
         w = np.arange(32.0, dtype=np.float32).reshape(8, 4)
@@ -228,8 +228,8 @@ async def test_bufferless_shard_target():
 async def test_multi_rank_buffer_id_collision():
     # Two sources number their buffers from 0: the dest must key reads by
     # (host, port, id), never bare id, or ranks' shards collapse.
-    s0 = DirectWeightSyncSource(use_shm=False)
-    s1 = DirectWeightSyncSource(use_shm=False)
+    s0 = DirectWeightSyncSource(use_shm=False, device=False)
+    s1 = DirectWeightSyncSource(use_shm=False, device=False)
     dest = DirectWeightSyncDest()
     try:
         w = np.arange(64.0, dtype=np.float32).reshape(8, 8)

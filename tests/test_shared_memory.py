@@ -112,7 +112,10 @@ class TestBufferUnit:
         assert b2._client_segments == {} and b2.config is not None
         assert b2.descriptors[0].segment_name == "n"
 
-    def test_handshake_offers_reuse_only_on_meta_match(self):
+    def test_handshake_offers_pooled_never_live(self):
+        """Puts must never be offered the LIVE entry segment (a writer
+        would race concurrent reads of it); pooled segments of the right
+        size are offered instead (warm rotation)."""
         ctx = TransportContext()
         cache = ctx.get_cache(ShmServerCache)
         seg = ShmSegment.create(16)
@@ -120,9 +123,14 @@ class TestBufferUnit:
         cache.put("k", None, seg, meta)
         buf = SharedMemoryTransportBuffer()
         req = Request.from_tensor("k", np.zeros(4, np.float32)).meta_only()
+        # Live segment, empty pool -> nothing offered (client allocates).
+        assert buf.recv_handshake(ctx, [req], {}, "put") == {}
+        pooled = ShmSegment.create(16)
+        cache._add_free(pooled)
         offered = buf.recv_handshake(ctx, [req], {}, "put")
-        assert offered[0].segment_name == seg.name
-        # Different shape -> no offer.
+        assert offered[0].segment_name == pooled.name != seg.name
+        assert pooled.name in cache.reserved  # held until the put RPC
+        # Size-mismatched request -> no offer.
         req2 = Request.from_tensor("k", np.zeros(8, np.float32)).meta_only()
         assert buf.recv_handshake(ctx, [req2], {}, "put") == {}
         cache.clear()

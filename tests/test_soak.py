@@ -20,8 +20,10 @@ async def test_churn_leaves_no_residue():
     await ts.initialize(store_name="soak")
     try:
         x = np.random.rand(256, 256).astype(np.float32)
-        # Warm: caches, connections, segments reach steady state.
-        for i in range(5):
+        # Warm: caches, connections, segments reach steady state. Segment
+        # rotation (put -> retire -> release -> pool) is ~3 deep per key,
+        # so give each of the two keys enough iterations to converge.
+        for i in range(10):
             await ts.put(f"k{i % 2}", x, store_name="soak")
             await ts.get(f"k{i % 2}", store_name="soak")
         fds0, shm0 = _fd_count(), _shm_count()

@@ -97,6 +97,27 @@ async def initialize(
     volume_env = (
         {"TORCHSTORE_TPU_STORAGE_DIR": storage_dir} if storage_dir else {}
     )
+    if config.auth_secret:
+        # Volume processes must present/verify the same secret. A
+        # programmatically-set secret is also exported to this process's env
+        # (and the cached default config refreshed) so module-level client
+        # paths — connection pool, rendezvous — see it too. Auth is
+        # process-global: one secret per process, so a second store with a
+        # DIFFERENT secret would silently break the first one's connections
+        # — reject that instead.
+        existing = os.environ.get("TORCHSTORE_TPU_AUTH_SECRET")
+        if existing and existing != config.auth_secret:
+            raise ValueError(
+                "a different TORCHSTORE_TPU_AUTH_SECRET is already active "
+                "in this process; auth secrets are per-process, not "
+                "per-store"
+            )
+        volume_env["TORCHSTORE_TPU_AUTH_SECRET"] = config.auth_secret
+        if existing != config.auth_secret:
+            os.environ["TORCHSTORE_TPU_AUTH_SECRET"] = config.auth_secret
+            from torchstore_tpu import config as config_mod
+
+            config_mod._default_config = None
     volume_mesh = await spawn_actors(
         num_storage_volumes,
         StorageVolume,

@@ -20,7 +20,7 @@ from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
 from torchstore_tpu.native import copy_into
-from torchstore_tpu.runtime import ActorRef
+from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
 from torchstore_tpu.transport.factory import create_transport_buffer
@@ -64,11 +64,17 @@ class LocalClient:
     async def _ensure_setup(self) -> None:
         if self._volume_refs is not None:
             return
+        self._controller.rpc_timeout = self._config.rpc_timeout
         self._strategy = await self._controller.get_strategy.call_one()
         vmap = await self._controller.get_volume_map.call_one()
         forced = (
             self._strategy.default_transport_type if self._strategy else None
         )
+        for info in vmap.values():
+            # Every endpoint call on these refs inherits the configured RPC
+            # deadline (a wedged-but-alive volume must never hang a client
+            # forever — the supervision Monarch provides the reference).
+            info["ref"].rpc_timeout = self._config.rpc_timeout
         self._volume_refs = {
             vid: StorageVolumeRef(
                 actor=info["ref"],
@@ -115,13 +121,16 @@ class LocalClient:
         volume = self._own_volume()
         buffer = create_transport_buffer(volume, self._config)
         nbytes = sum(r.nbytes for r in requests)
-        if buffer.supports_batch_puts:
-            await buffer.put_to_storage_volume(volume, requests)
-        else:
-            await buffer.put_to_storage_volume(volume, requests[:1])
-            for req in requests[1:]:
-                b = create_transport_buffer(volume, self._config)
-                await b.put_to_storage_volume(volume, [req])
+        try:
+            if buffer.supports_batch_puts:
+                await buffer.put_to_storage_volume(volume, requests)
+            else:
+                await buffer.put_to_storage_volume(volume, requests[:1])
+                for req in requests[1:]:
+                    b = create_transport_buffer(volume, self._config)
+                    await b.put_to_storage_volume(volume, [req])
+        except ActorDiedError as exc:
+            await self._raise_with_diagnosis(volume.volume_id, exc)
         tracker.track_step("data_plane", nbytes)
         # Two-plane invariant: metadata notify happens only after the data
         # landed (/root/reference/torchstore/client.py:86-90).
@@ -224,13 +233,18 @@ class LocalClient:
             volume = self._volume_refs[vid]
             buffer = create_transport_buffer(volume, self._config)
             subs = [sub for _, sub in entries]
-            if buffer.supports_batch_gets or len(subs) == 1:
-                results = await buffer.get_from_storage_volume(volume, subs)
-            else:
-                results = []
-                for sub in subs:
-                    b = create_transport_buffer(volume, self._config)
-                    results.extend(await b.get_from_storage_volume(volume, [sub]))
+            try:
+                if buffer.supports_batch_gets or len(subs) == 1:
+                    results = await buffer.get_from_storage_volume(volume, subs)
+                else:
+                    results = []
+                    for sub in subs:
+                        b = create_transport_buffer(volume, self._config)
+                        results.extend(
+                            await b.get_from_storage_volume(volume, [sub])
+                        )
+            except ActorDiedError as exc:
+                await self._raise_with_diagnosis(vid, exc)
             for (idx, sub), res in zip(entries, results):
                 parts_by_request.setdefault(idx, []).append((sub, res))
 
@@ -242,6 +256,23 @@ class LocalClient:
             for idx, req in enumerate(requests)
         ]
         return out
+
+    async def _raise_with_diagnosis(self, vid: str, exc: Exception) -> None:
+        """A volume RPC failed or timed out: ask the controller to
+        health-check the fleet and re-raise with the diagnosis attached
+        (dead vs wedged vs healthy-but-slow is actionable for operators)."""
+        diagnosis = "controller unreachable"
+        try:
+            statuses = await self._controller.check_volumes.with_timeout(
+                15.0
+            ).call_one(timeout=5.0)
+            diagnosis = statuses.get(vid, "unknown volume")
+        except Exception:  # noqa: BLE001 - diagnosis is best-effort
+            pass
+        raise ActorDiedError(
+            f"storage volume {vid!r} RPC failed: {exc} "
+            f"[controller diagnosis: {diagnosis}]"
+        ) from exc
 
     def _transports_support_inplace(self, located) -> tuple[bool, bool]:
         """(supports_inplace, requires_contiguous) across every transport that

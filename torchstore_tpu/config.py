@@ -66,6 +66,15 @@ class StoreConfig:
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_USE_NATIVE", True)
     )
 
+    # --- security -----------------------------------------------------------
+    # Shared secret for connection auth (HMAC challenge-response on every
+    # actor/rendezvous/bulk/peer-read listener). Empty = auth disabled; set
+    # it (same value on every host) for any non-loopback deployment — these
+    # protocols unpickle peer payloads and must not accept strangers.
+    auth_secret: str = field(
+        default_factory=lambda: _env_str("TORCHSTORE_TPU_AUTH_SECRET", "")
+    )
+
     # --- timeouts (seconds) -------------------------------------------------
     rpc_timeout: float = field(
         default_factory=lambda: float(_env_str("TORCHSTORE_TPU_RPC_TIMEOUT", "120"))

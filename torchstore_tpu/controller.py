@@ -74,6 +74,48 @@ class StorageInfo:
         return info
 
 
+def resolve_manifests(
+    per_volume: list[tuple[str, list]],
+) -> tuple[list[tuple[str, Request]], int]:
+    """Resolve volume manifests into (volume_id, meta) entries to index,
+    keeping only the NEWEST shard layout (by file mtime) when a key carries
+    mixed mesh/global shapes — see ``Controller.rebuild_index``. Returns
+    (survivors, dropped_count). Accepts bare ``Request`` items from backends
+    without mtimes (treated as mtime 0)."""
+    entries: list[tuple[str, Request, Optional[tuple]]] = []
+    layouts: dict[str, dict[tuple, float]] = {}  # key -> sig -> max mtime
+    for vid, manifest in per_volume:
+        for item in manifest:
+            if isinstance(item, dict):
+                meta, mtime = item["meta"], item.get("mtime", 0.0)
+            else:
+                meta, mtime = item, 0.0
+            sig = None
+            if meta.tensor_slice is not None:
+                ts = meta.tensor_slice
+                sig = (
+                    ts.mesh_shape,
+                    ts.global_shape,
+                    meta.tensor_meta.dtype if meta.tensor_meta else None,
+                )
+                sigs = layouts.setdefault(meta.key, {})
+                sigs[sig] = max(sigs.get(sig, 0.0), mtime)
+            entries.append((vid, meta, sig))
+    winners = {
+        key: max(sigs, key=sigs.get)
+        for key, sigs in layouts.items()
+        if len(sigs) > 1
+    }
+    survivors: list[tuple[str, Request]] = []
+    dropped = 0
+    for vid, meta, sig in entries:
+        if sig is not None and meta.key in winners and sig != winners[meta.key]:
+            dropped += 1
+            continue
+        survivors.append((vid, meta))
+    return survivors, dropped
+
+
 class Controller(Actor):
     def __init__(self) -> None:
         self.index = Trie()  # key -> {volume_id: StorageInfo}
@@ -238,13 +280,21 @@ class Controller(Actor):
     async def check_volumes(self, timeout: float = 5.0) -> dict[str, str]:
         """Health-check every volume (failure detection — SURVEY §5 notes
         the reference has no heartbeats at all). Returns volume_id ->
-        'ok' | 'dead: <error>'."""
+        'ok' | 'wedged: ...' (alive but unresponsive — e.g. stopped or
+        overloaded; may recover) | 'dead: ...' (unreachable)."""
         import asyncio
 
         async def ping(vid: str, ref: ActorRef) -> tuple[str, str]:
             try:
                 await asyncio.wait_for(ref.ping(), timeout=timeout)
                 return vid, "ok"
+            except asyncio.TimeoutError:
+                return (
+                    vid,
+                    f"wedged: no ping response within {timeout:.0f}s "
+                    "(process alive but stuck — SIGSTOP'd, deadlocked, or "
+                    "overloaded; may recover)",
+                )
             except Exception as exc:  # noqa: BLE001 - reported, not raised
                 return vid, f"dead: {type(exc).__name__}"
 
@@ -257,25 +307,41 @@ class Controller(Actor):
     async def rebuild_index(self) -> int:
         """Recover the metadata index from volume manifests (durable
         backends). Returns the number of entries indexed — the recovery
-        path the reference lacks (its store is memory-only, SURVEY §5)."""
+        path the reference lacks (its store is memory-only, SURVEY §5).
+
+        Mixed shard layouts for one key (a crash mid re-shard: one volume
+        already on the new mesh/global shape, another still holding old
+        shards) are resolved by keeping only the NEWEST layout (max file
+        mtime). Indexing both would pass the commit check on a mixed coords
+        set and serve overlapping stale+fresh slices; preferring a complete
+        old layout would silently serve stale weights. The newest layout
+        stays partial until re-pushed — gets fail loudly instead."""
         import asyncio
 
         manifests = await asyncio.gather(
             *(ref.manifest.call_one() for ref in self.volume_refs.values())
         )
+        survivors, dropped = resolve_manifests(
+            list(zip(self.volume_refs.keys(), manifests))
+        )
         count = 0
-        for vid, metas in zip(self.volume_refs.keys(), manifests):
-            for meta in metas:
-                infos = self.index.get(meta.key)
-                if infos is None:
-                    infos = {}
-                    self.index[meta.key] = infos
-                info = infos.get(vid)
-                if info is None:
-                    infos[vid] = StorageInfo.from_meta(meta)
-                else:
-                    info.merge(meta)
-                count += 1
+        for vid, meta in survivors:
+            infos = self.index.get(meta.key)
+            if infos is None:
+                infos = {}
+                self.index[meta.key] = infos
+            info = infos.get(vid)
+            if info is None:
+                infos[vid] = StorageInfo.from_meta(meta)
+            else:
+                info.merge(meta)
+            count += 1
+        if dropped:
+            logger.warning(
+                "rebuild_index dropped %d superseded-layout shard(s); the "
+                "surviving layout may be partially committed until re-pushed",
+                dropped,
+            )
         return count
 
     @endpoint

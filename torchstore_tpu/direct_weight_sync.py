@@ -45,6 +45,11 @@ logger = get_logger("torchstore_tpu.direct")
 _READ_REQ = struct.Struct("<QQQ")  # buffer_id, offset, length
 _READ_RESP = struct.Struct("<Q")  # length (0xFFFF.. = error)
 _ERR = (1 << 64) - 1
+# buffer_id sentinel: "stage the registered device arrays for one pull and
+# reply with the transfer uuid" (the ICI rung's control op — each staging
+# serves exactly one jax.experimental.transfer pull).
+_STAGE_DEVICE = (1 << 64) - 2
+_U64 = struct.Struct("<Q")
 
 
 # --------------------------------------------------------------------------
@@ -72,10 +77,13 @@ class WeightHandle:
 
 
 class _PeerReadServer:
-    """Serves ranged reads of registered buffers over TCP (cross-host path)."""
+    """Serves ranged reads of registered buffers over TCP (cross-host path)
+    and the device-staging control op (ICI rung)."""
 
     def __init__(self) -> None:
         self.buffers: dict[int, np.ndarray] = {}
+        # Set by the source when device mode is on: () -> transfer uuid.
+        self.stage_device_fn = None
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._writers: set = set()
@@ -92,11 +100,27 @@ class _PeerReadServer:
         return self.port
 
     async def _handle(self, reader, writer) -> None:
+        from torchstore_tpu.runtime.auth import server_authenticate
+
+        if not await server_authenticate(reader, writer):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         self._writers.add(writer)
         try:
             while True:
                 req = await reader.readexactly(_READ_REQ.size)
                 buffer_id, offset, length = _READ_REQ.unpack(req)
+                if buffer_id == _STAGE_DEVICE:
+                    if self.stage_device_fn is None:
+                        writer.write(_READ_RESP.pack(_ERR))
+                    else:
+                        uid = self.stage_device_fn()
+                        writer.write(_READ_RESP.pack(_U64.size) + _U64.pack(uid))
+                    await writer.drain()
+                    continue
                 arr = self.buffers.get(buffer_id)
                 if arr is None:
                     writer.write(_READ_RESP.pack(_ERR))
@@ -142,8 +166,13 @@ class DirectWeightSyncSource:
     handles stay valid across training steps (direct_weight_sync.py:158-169).
     """
 
-    def __init__(self, use_shm: bool = True):
+    def __init__(self, use_shm: bool = True, config=None, device: Optional[bool] = None):
+        from torchstore_tpu.config import default_config
+
         self.use_shm = use_shm and shm.is_available()
+        self.config = config or default_config()
+        # None = auto (device path when eligible); False pins the host path.
+        self.device = device
         self.server = _PeerReadServer()
         self.segments: dict[int, shm.ShmSegment] = {}
         self.handles: dict[str, list[WeightHandle]] = {}
@@ -151,9 +180,33 @@ class DirectWeightSyncSource:
         self._transfer_dtype = None
         self._next_id = 0
         self._registered = False
+        # Device (ICI) mode state: ordered flat keys + current jax arrays.
+        self.device_info: Optional[dict] = None
+        self._device_keys: list[str] = []
+        self._device_arrays: dict[str, Any] = {}
+
+    def _device_mode_eligible(self, flat: dict, rank: int, num_ranks: int) -> bool:
+        """Device path engages for single-controller sources whose tensor
+        leaves are ALL jax arrays (the trainer owns its device mesh). Multi
+        -rank SPMD sources keep the host path — combining per-rank device
+        shards source-side would need a cross-rank transfer plan."""
+        if self.device is False:
+            return False
+        if not self.config.ici_enabled or num_ranks != 1 or rank != 0:
+            return False
+        from torchstore_tpu.transport import device_transfer as dt
+
+        if not dt.is_available():
+            return False
+        tensorish = [v for v in flat.values() if _is_tensor_leaf(v)]
+        return bool(tensorish) and all(shd.is_jax_array(v) for v in tensorish)
 
     async def register(
-        self, state_dict: Any, rank: int = 0, transfer_dtype=None
+        self,
+        state_dict: Any,
+        rank: int = 0,
+        transfer_dtype=None,
+        num_ranks: int = 1,
     ) -> dict[str, list[WeightHandle]]:
         import os
 
@@ -162,6 +215,8 @@ class DirectWeightSyncSource:
         flat, _ = flatten_state_dict(state_dict)
         # Advertise the same reachable name the actor runtime uses.
         hostname = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST", get_hostname())
+        if self._device_mode_eligible(flat, rank, num_ranks):
+            return self._register_device(flat, hostname, port, transfer_dtype)
         for flat_key, value in flat.items():
             if (
                 transfer_dtype is not None
@@ -213,6 +268,60 @@ class DirectWeightSyncSource:
         self._registered = True
         return self.handles
 
+    def _register_device(
+        self, flat: dict, hostname: str, port: int, transfer_dtype
+    ) -> dict:
+        """ICI rung registration: no host staging at all. Arrays stay on
+        device; every dest pull stages the CURRENT arrays through the XLA
+        transfer server (device-to-device over ICI/DCN — the reference's
+        one-sided GPU read, monarch_rdma.py:158-219, without host bounce)."""
+        from torchstore_tpu.transport import device_transfer as dt
+
+        engine = dt.DeviceTransferEngine.get()
+        self._device_keys = []
+        self._device_arrays = {}
+        specs = {}
+        for flat_key, value in flat.items():
+            if not _is_tensor_leaf(value):
+                continue
+            self._device_keys.append(flat_key)
+            self._device_arrays[flat_key] = value  # uncast; cast at stage time
+            if transfer_dtype is not None and _is_floating(value):
+                from torchstore_tpu.ops import device_cast
+
+                value = device_cast(value, transfer_dtype)
+            specs[flat_key] = dt.DeviceSpec.of(value)
+        address = engine.ensure_server()
+        self.server.stage_device_fn = self._stage_current
+        self.device_info = {
+            "address": address,
+            "hostname": hostname,
+            "control_port": port,
+            "keys": list(self._device_keys),
+            "specs": specs,
+        }
+        self._registered = True
+        self.handles = {}
+        logger.info(
+            "direct sync registered %d tensors on the device (ICI) path",
+            len(self._device_keys),
+        )
+        return self.handles
+
+    def _stage_current(self) -> int:
+        from torchstore_tpu.transport import device_transfer as dt
+
+        engine = dt.DeviceTransferEngine.get()
+        arrays = [self._device_arrays[k] for k in self._device_keys]
+        if self._transfer_dtype is not None:
+            from torchstore_tpu.ops import device_cast
+
+            arrays = [
+                device_cast(a, self._transfer_dtype) if _is_floating(a) else a
+                for a in arrays
+            ]
+        return engine.stage(arrays)
+
     @staticmethod
     def _shards_of(value) -> Optional[list[tuple[TensorSlice, np.ndarray]]]:
         if shd.is_jax_array(value):
@@ -230,9 +339,14 @@ class DirectWeightSyncSource:
         return None
 
     async def refresh(self) -> None:
-        """Re-stage current param values into the registered buffers."""
+        """Re-stage current param values into the registered buffers.
+
+        Device (ICI) mode needs no work here: staging happens per pull, so
+        dests always read the arrays ``update_sources`` last installed."""
         if not self._registered:
             raise RuntimeError("register() must run before refresh()")
+        if self.device_info is not None:
+            return
         for flat_key, value in self._sources.items():
             if (
                 self._transfer_dtype is not None
@@ -270,6 +384,8 @@ class DirectWeightSyncSource:
         flat, _ = flatten_state_dict(state_dict)
         for key in self._sources:
             self._sources[key] = flat[key]
+        for key in self._device_keys:
+            self._device_arrays[key] = flat[key]
 
     async def close(self) -> None:
         await self.server.stop()
@@ -502,6 +618,87 @@ class DirectWeightSyncDest:
             )
             copy_into(view, shard_arr[rel_src])
 
+    async def _get_conn(self, host: str, port: int):
+        """A pooled (reader, writer, lock) to a source's peer server — a
+        small pool per source so concurrent reads overlap on the wire
+        instead of serializing behind one connection."""
+        key = (host, port)
+        async with self._lock:
+            pool = self._conns.get(key)
+            if pool is None:
+                pool = {"conns": [], "rr": 0}
+                self._conns[key] = pool
+            if len(pool["conns"]) < self.pool_size:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), timeout=30
+                )
+                from torchstore_tpu.runtime.auth import client_authenticate
+
+                await client_authenticate(reader, writer)
+                conn = (reader, writer, asyncio.Lock())
+                pool["conns"].append(conn)
+            else:
+                conn = pool["conns"][pool["rr"] % len(pool["conns"])]
+                pool["rr"] += 1
+        return conn
+
+    # ---- device (ICI) path ------------------------------------------------
+
+    async def pull_device(self, device_info: dict, dest_state_dict: Any) -> Any:
+        """One-hop device pull: ask the source to stage its current arrays,
+        pull them device-to-device through the transfer engine, then land
+        into the dest targets (resharding locally where the target sharding
+        differs — XLA moves the shards over ICI)."""
+        from torchstore_tpu.transport import device_transfer as dt
+
+        tracker = LatencyTracker("direct_pull_device")
+        dest_flat, mapping = flatten_state_dict(dest_state_dict)
+        host = (
+            "127.0.0.1"
+            if device_info["hostname"] == get_hostname()
+            else device_info["hostname"]
+        )
+        reader, writer, lock = await self._get_conn(
+            host, device_info["control_port"]
+        )
+        async with lock:
+            writer.write(_READ_REQ.pack(_STAGE_DEVICE, 0, 0))
+            await writer.drain()
+            (length,) = _READ_RESP.unpack(await reader.readexactly(_READ_RESP.size))
+            if length == _ERR:
+                raise KeyError("source has no device-mode registration")
+            (uid,) = _U64.unpack(await reader.readexactly(_U64.size))
+        tracker.track_step("stage")
+        keys = device_info["keys"]
+        specs = [device_info["specs"][k] for k in keys]
+        engine = dt.DeviceTransferEngine.get()
+        arrays = engine.pull(device_info["address"], uid, specs)
+        by_key = dict(zip(keys, arrays))
+        tracker.track_step(
+            "pull",
+            sum(
+                int(np.prod(s.shape))
+                * TensorMeta(shape=(), dtype=s.dtype).np_dtype.itemsize
+                for s in specs
+            ),
+        )
+        out_flat = dict(dest_flat)
+        for flat_key, target in dest_flat.items():
+            if not _is_tensor_like(target):
+                continue
+            arr = by_key.get(flat_key)
+            if arr is None:
+                raise KeyError(
+                    f"dest state dict expects {flat_key!r} but the source "
+                    "published no device entry for it"
+                )
+            out_flat[flat_key] = _land_device(target, arr)
+        tracker.track_step("land")
+        tracker.log_summary(level=20)
+        from torchstore_tpu.state_dict_utils import unflatten_state_dict
+
+        return unflatten_state_dict(out_flat, mapping)
+
     async def _read_shard(
         self, handle: WeightHandle, row_range: Optional[tuple[int, int]] = None
     ) -> tuple[np.ndarray, int]:
@@ -521,24 +718,7 @@ class DirectWeightSyncDest:
         host = (
             "127.0.0.1" if handle.hostname == get_hostname() else handle.hostname
         )
-        key = (host, handle.port)
-        # A small pool per source so concurrent shard reads overlap on the
-        # wire instead of serializing behind one connection.
-        async with self._lock:
-            pool = self._conns.get(key)
-            if pool is None:
-                pool = {"conns": [], "rr": 0}
-                self._conns[key] = pool
-            if len(pool["conns"]) < self.pool_size:
-                reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(host, handle.port), timeout=30
-                )
-                conn = (reader, writer, asyncio.Lock())
-                pool["conns"].append(conn)
-            else:
-                conn = pool["conns"][pool["rr"] % len(pool["conns"])]
-                pool["rr"] += 1
-        reader, writer, lock = conn
+        reader, writer, lock = await self._get_conn(host, handle.port)
         row_bytes = (
             handle.meta.nbytes // shape[0] if shape and shape[0] else handle.meta.nbytes
         )
@@ -615,6 +795,42 @@ def _is_tensor_like(value) -> bool:
         or shd.is_jax_array(value)
         or shd.is_sharded_spec(value)
     )
+
+
+def _is_tensor_leaf(value) -> bool:
+    """Source-side leaf classification (register): array-valued leaves."""
+    return isinstance(value, np.ndarray) or shd.is_jax_array(value)
+
+
+def _land_device(target, arr):
+    """Land a pulled device array into a dest target: reshard on device for
+    jax targets (device_put compiles to ICI collectives), copy to host
+    memory for numpy/Shard targets."""
+    import jax
+
+    from torchstore_tpu.client import Shard as _Shard
+
+    if shd.is_jax_array(target) or shd.is_sharded_spec(target):
+        want_dtype = getattr(target, "dtype", None)
+        if want_dtype is not None and arr.dtype != want_dtype:
+            arr = arr.astype(want_dtype)
+        sharding = getattr(target, "sharding", None)
+        if sharding is not None and sharding != arr.sharding:
+            arr = jax.device_put(arr, sharding)
+        return arr
+    if isinstance(target, _Shard):
+        region = tuple(
+            slice(o, o + s)
+            for o, s in zip(target.tensor_slice.offsets, target.tensor_slice.local_shape)
+        )
+        part = np.asarray(arr[region])
+        if target.data is not None:
+            np.copyto(target.data, part)
+            return target.data
+        return part
+    # numpy target: full copy in place.
+    np.copyto(target, np.asarray(arr))
+    return target
 
 
 def _np_dtype_of(value) -> np.dtype:

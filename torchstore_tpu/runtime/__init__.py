@@ -4,6 +4,7 @@ from torchstore_tpu.runtime.actors import (
     ActorMesh,
     ActorMeshRef,
     ActorRef,
+    ActorTimeoutError,
     RemoteActorError,
     close_all_connections,
     endpoint,
@@ -18,6 +19,7 @@ __all__ = [
     "ActorMesh",
     "ActorMeshRef",
     "ActorRef",
+    "ActorTimeoutError",
     "RemoteActorError",
     "close_all_connections",
     "endpoint",

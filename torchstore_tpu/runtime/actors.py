@@ -60,6 +60,13 @@ class ActorDiedError(RuntimeError):
     pass
 
 
+class ActorTimeoutError(ActorDiedError):
+    """An RPC exceeded its deadline: the actor is alive-but-unresponsive
+    (wedged) or the transfer outlasted the configured timeout. Subclasses
+    ActorDiedError so existing died-handling paths also cover wedged actors
+    (the supervision role Monarch plays for the reference, SURVEY §2.3)."""
+
+
 # --------------------------------------------------------------------------
 # Client side: connections + refs
 # --------------------------------------------------------------------------
@@ -103,7 +110,9 @@ class _Connection:
                 fut.set_exception(exc)
         self.pending.clear()
 
-    async def request(self, kind: int, body: dict) -> Any:
+    async def request(
+        self, kind: int, body: dict, timeout: Optional[float] = None
+    ) -> Any:
         if self.closed:
             raise ActorDiedError("connection already closed")
         req_id = self.next_id
@@ -113,7 +122,19 @@ class _Connection:
         self.pending[req_id] = fut
         async with self.write_lock:
             await write_message(self.writer, kind, body)
-        return await fut
+        if timeout is None or timeout <= 0:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            # A late response finds no pending future and is dropped; the
+            # connection itself stays usable (requests are multiplexed).
+            self.pending.pop(req_id, None)
+            raise ActorTimeoutError(
+                f"RPC {body.get('method', body.get('op'))!r} to "
+                f"{body.get('actor')!r} timed out after {timeout:.0f}s "
+                "(actor wedged, or transfer larger than the timeout allows)"
+            ) from None
 
     async def close(self) -> None:
         self.closed = True
@@ -168,6 +189,9 @@ async def get_connection(host: str, port: int) -> _Connection:
             return conn
     reader, writer = await asyncio.open_connection(host, port, limit=2**20)
     _set_sock_opts(writer)
+    from torchstore_tpu.runtime.auth import client_authenticate
+
+    await client_authenticate(reader, writer)
     conn = _Connection(reader, writer)
     _conn_pools[key] = (loop, conn)
     return conn
@@ -183,12 +207,39 @@ def _set_sock_opts(writer: asyncio.StreamWriter) -> None:
 
 
 class ActorEndpointRef:
-    def __init__(self, ref: "ActorRef", method: str):
+    def __init__(
+        self, ref: "ActorRef", method: str, timeout: Optional[float] = None
+    ):
         self._ref = ref
         self._method = method
+        self._timeout = timeout
+
+    def with_timeout(self, timeout: Optional[float]) -> "ActorEndpointRef":
+        """Copy with an explicit deadline override (<=0 disables). Used for
+        size-scaled data-plane timeouts; control RPCs use the ref/config
+        default."""
+        return ActorEndpointRef(self._ref, self._method, timeout)
+
+    def _effective_timeout(self) -> Optional[float]:
+        if self._timeout is not None:
+            return self._timeout
+        # isinstance guard: a ref unpickled from an older build lacks the
+        # attribute and __getattr__ would hand back an endpoint ref instead.
+        ref_timeout = self._ref.__dict__.get("rpc_timeout")
+        if isinstance(ref_timeout, (int, float)):
+            return ref_timeout
+        from torchstore_tpu.config import default_config
+
+        return default_config().rpc_timeout
 
     async def call_one(self, *args, **kwargs) -> Any:
-        conn = await get_connection(self._ref.host, self._ref.port)
+        try:
+            conn = await get_connection(self._ref.host, self._ref.port)
+        except OSError as exc:
+            raise ActorDiedError(
+                f"cannot connect to actor {self._ref.name!r} at "
+                f"{self._ref.host}:{self._ref.port}: {exc!r}"
+            ) from exc
         return await conn.request(
             KIND_REQUEST,
             {
@@ -197,6 +248,7 @@ class ActorEndpointRef:
                 "args": args,
                 "kwargs": kwargs,
             },
+            timeout=self._effective_timeout(),
         )
 
     # On a single ref, call == call_one (parity with Monarch's call on a
@@ -213,6 +265,9 @@ class ActorRef:
         self.host = host
         self.port = port
         self.rank = rank
+        # Per-ref RPC deadline override; None defers to config.rpc_timeout.
+        # Clients stamp this from their StoreConfig (see LocalClient).
+        self.rpc_timeout: Optional[float] = None
 
     def __getattr__(self, method: str) -> ActorEndpointRef:
         if method.startswith("_"):
@@ -333,6 +388,16 @@ class ActorServer:
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        from torchstore_tpu.runtime.auth import server_authenticate
+
+        # No frame is parsed (= nothing unpickled) before the peer proves
+        # knowledge of the shared secret.
+        if not await server_authenticate(reader, writer):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         _set_sock_opts(writer)
         self._client_writers.add(writer)
         write_lock = asyncio.Lock()
@@ -445,7 +510,18 @@ class ActorServer:
 
 
 def _child_main(pipe, actor_cls, name: str, args: tuple, kwargs: dict, env: dict) -> None:
+    # ``env`` is the COMPLETE framework environment for this child. The
+    # forkserver parent snapshots os.environ at ITS start, so children can
+    # inherit stale TORCHSTORE_TPU_* values from whatever test/store first
+    # spawned an actor (e.g. an auth secret that was since unset) — remove
+    # anything the spawner did not explicitly pass, then apply.
+    for key in list(os.environ):
+        if key.startswith("TORCHSTORE_TPU_") and key not in env:
+            del os.environ[key]
     os.environ.update(env)
+    from torchstore_tpu import config as _config_mod
+
+    _config_mod._default_config = None  # re-seed from the corrected env
     try:
         asyncio.run(_child_async(pipe, actor_cls, name, args, kwargs))
     except KeyboardInterrupt:

@@ -1,0 +1,151 @@
+"""Connection authentication: HMAC challenge-response on every listener.
+
+The actor RPC, rendezvous, bulk-data and peer-read servers all speak
+pickle-or-raw protocols that must never process bytes from an unauthorized
+peer (server-side ``pickle.loads`` is arbitrary code execution — the
+reference delegates this surface to torch TCPStore/Monarch, which at least
+do not unpickle client payloads). When ``TORCHSTORE_TPU_AUTH_SECRET`` (or
+``StoreConfig.auth_secret``) is set, every accepted connection must complete
+a challenge-response BEFORE its first protocol frame is parsed:
+
+    server -> client:  b"TSAU" + 16-byte random nonce      (plain bytes)
+    client -> server:  HMAC-SHA256(secret, nonce)           (32 bytes)
+
+No pickling happens pre-auth; a wrong or missing MAC closes the connection.
+The nonce makes the exchange non-replayable. With no secret configured the
+exchange is skipped entirely (zero overhead, wire-compatible with older
+peers) — multi-host deployments without a secret get a prominent warning
+from ``spmd.initialize``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import os
+import socket
+from typing import Optional
+
+from torchstore_tpu.logging import get_logger
+
+logger = get_logger("torchstore_tpu.auth")
+
+AUTH_MAGIC = b"TSAU"
+NONCE_LEN = 16
+MAC_LEN = 32  # sha256
+AUTH_TIMEOUT_S = 10.0
+
+
+def get_secret() -> Optional[str]:
+    from torchstore_tpu.config import default_config
+
+    return default_config().auth_secret or None
+
+
+def compute_mac(secret: str, nonce: bytes) -> bytes:
+    return hmac.new(secret.encode(), nonce, hashlib.sha256).digest()
+
+
+# ---- asyncio-streams variants (actor RPC, rendezvous) ---------------------
+
+
+async def server_authenticate(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    secret: Optional[str] = None,
+) -> bool:
+    """Run the server side of the challenge. True = proceed; False = the
+    peer failed (connection should be closed without parsing anything)."""
+    secret = secret if secret is not None else get_secret()
+    if not secret:
+        return True
+    nonce = os.urandom(NONCE_LEN)
+    writer.write(AUTH_MAGIC + nonce)
+    await writer.drain()
+    try:
+        mac = await asyncio.wait_for(
+            reader.readexactly(MAC_LEN), timeout=AUTH_TIMEOUT_S
+        )
+    except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
+        logger.warning("peer closed or stalled during auth challenge")
+        return False
+    if not hmac.compare_digest(mac, compute_mac(secret, nonce)):
+        peer = writer.get_extra_info("peername")
+        logger.warning("rejecting connection from %s: bad auth MAC", peer)
+        return False
+    return True
+
+
+async def client_authenticate(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    secret: Optional[str] = None,
+) -> None:
+    secret = secret if secret is not None else get_secret()
+    if not secret:
+        return
+    hello = await asyncio.wait_for(
+        reader.readexactly(AUTH_MAGIC.__len__() + NONCE_LEN),
+        timeout=AUTH_TIMEOUT_S,
+    )
+    if hello[: len(AUTH_MAGIC)] != AUTH_MAGIC:
+        raise ConnectionError(
+            "auth secret is configured but the server did not issue a "
+            "challenge — peer is running without TORCHSTORE_TPU_AUTH_SECRET"
+        )
+    writer.write(compute_mac(secret, hello[len(AUTH_MAGIC) :]))
+    await writer.drain()
+
+
+# ---- raw-socket variants (bulk transport, peer-read server) ---------------
+
+
+async def server_authenticate_sock(
+    sock: socket.socket, secret: Optional[str] = None
+) -> bool:
+    secret = secret if secret is not None else get_secret()
+    if not secret:
+        return True
+    loop = asyncio.get_running_loop()
+    nonce = os.urandom(NONCE_LEN)
+    try:
+        await loop.sock_sendall(sock, AUTH_MAGIC + nonce)
+        mac = await asyncio.wait_for(
+            _recv_exactly(sock, MAC_LEN), timeout=AUTH_TIMEOUT_S
+        )
+    except (ConnectionError, asyncio.TimeoutError, OSError):
+        return False
+    if not hmac.compare_digest(mac, compute_mac(secret, nonce)):
+        logger.warning("rejecting bulk connection: bad auth MAC")
+        return False
+    return True
+
+
+async def client_authenticate_sock(
+    sock: socket.socket, secret: Optional[str] = None
+) -> None:
+    secret = secret if secret is not None else get_secret()
+    if not secret:
+        return
+    loop = asyncio.get_running_loop()
+    hello = await asyncio.wait_for(
+        _recv_exactly(sock, len(AUTH_MAGIC) + NONCE_LEN), timeout=AUTH_TIMEOUT_S
+    )
+    if hello[: len(AUTH_MAGIC)] != AUTH_MAGIC:
+        raise ConnectionError(
+            "auth secret is configured but the server did not issue a "
+            "challenge — peer is running without TORCHSTORE_TPU_AUTH_SECRET"
+        )
+    await loop.sock_sendall(sock, compute_mac(secret, hello[len(AUTH_MAGIC) :]))
+
+
+async def _recv_exactly(sock: socket.socket, n: int) -> bytes:
+    loop = asyncio.get_running_loop()
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = await loop.sock_recv(sock, n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during auth")
+        buf += chunk
+    return bytes(buf)

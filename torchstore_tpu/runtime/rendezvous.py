@@ -45,6 +45,14 @@ class RendezvousServer:
         return self.port
 
     async def _handle(self, reader, writer) -> None:
+        from torchstore_tpu.runtime.auth import server_authenticate
+
+        if not await server_authenticate(reader, writer):
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         self._writers.add(writer)
         write_lock = asyncio.Lock()
         tasks: set[asyncio.Task] = set()
@@ -144,6 +152,9 @@ class RendezvousClient:
                 if asyncio.get_running_loop().time() > deadline:
                     raise
                 await asyncio.sleep(0.2)  # rank 0 may not be up yet
+        from torchstore_tpu.runtime.auth import client_authenticate
+
+        await client_authenticate(self._reader, self._writer)
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     async def _read_loop(self) -> None:

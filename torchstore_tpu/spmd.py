@@ -183,13 +183,41 @@ async def initialize(
         raise RuntimeError(f"SPMD store {store_name!r} already initialized")
 
     # --- rendezvous -------------------------------------------------------
+    def _is_loopback(addr: str) -> bool:
+        import socket as _socket
+
+        if addr in ("localhost", "127.0.0.1", "::1"):
+            return True
+        try:
+            infos = _socket.getaddrinfo(addr, None)
+        except OSError:
+            return False
+        return all(
+            info[4][0].startswith("127.") or info[4][0] == "::1"
+            for info in infos
+        )
+
     server = None
     if env.rank == 0:
         server = RendezvousServer()
-        # Bind all interfaces unconditionally: launchers often export
-        # MASTER_ADDR=$(hostname) even single-host, and LOCAL_WORLD_SIZE may
-        # be absent, making host-count detection unreliable.
-        await server.start("0.0.0.0", env.master_port)
+        # Loopback MASTER_ADDR means every rank is local: bind loopback so
+        # the (pickle-speaking) rendezvous port stays private. Any other
+        # address binds all interfaces — binding MASTER_ADDR itself is a
+        # trap: Debian-style /etc/hosts maps $(hostname) to 127.0.1.1,
+        # which binds fine but is unreachable from peer hosts.
+        if _is_loopback(env.master_addr):
+            await server.start("127.0.0.1", env.master_port)
+        else:
+            await server.start("0.0.0.0", env.master_port)
+        from torchstore_tpu.runtime.auth import get_secret
+
+        if env.num_hosts > 1 and not get_secret():
+            logger.warning(
+                "multi-host SPMD without TORCHSTORE_TPU_AUTH_SECRET: the "
+                "rendezvous/actor/bulk listeners accept any host that can "
+                "reach them (and unpickle peer payloads). Set the same "
+                "secret on every host to enable connection auth."
+            )
     client = RendezvousClient(env.master_addr, env.master_port)
     await client.connect()
     ns = f"spmd/{store_name}"

@@ -182,13 +182,14 @@ class _DirectSyncCache:
 
     def __init__(self) -> None:
         self.sources: dict[str, Any] = {}
-        self.dests: dict[str, tuple[Any, dict]] = {}
+        # key -> (dest, all_handles, device_info)
+        self.dests: dict[str, tuple[Any, dict, Any]] = {}
 
     async def close(self) -> None:
         for source in self.sources.values():
             await source.close()
-        for dest, _ in self.dests.values():
-            await dest.close()
+        for entry in self.dests.values():
+            await entry[0].close()
         self.sources.clear()
         self.dests.clear()
 
@@ -222,10 +223,17 @@ async def _put_state_dict_direct(
     cache = _direct_cache(client)
     source = cache.sources.get(key)
     if source is None:
-        source = DirectWeightSyncSource()
-        handles = await source.register(state_dict, rank, transfer_dtype)
+        source = DirectWeightSyncSource(config=getattr(client, "_config", None))
+        handles = await source.register(
+            state_dict, rank, transfer_dtype, num_ranks=num_ranks
+        )
         cache.sources[key] = source
-        await client.put(f"{key}{_SEP}rank_{rank}", {"handles": handles})
+        published = {"handles": handles}
+        if source.device_info is not None:
+            # ICI rung: handles advertise the device transfer server; dests
+            # pull device-to-device with zero host staging.
+            published["device"] = source.device_info
+        await client.put(f"{key}{_SEP}rank_{rank}", published)
         if rank == 0:
             # num_ranks is the direct-mode commit marker: written by rank 0,
             # readers fetch it first (reference :241-247).
@@ -252,6 +260,7 @@ async def _get_state_dict_direct(
                 f"no matching direct push for state dict key {key!r}"
             ) from exc
         all_handles: dict[str, list] = {}
+        device_info = None
         for rank in range(num_ranks):
             try:
                 published = await client.get(f"{key}{_SEP}rank_{rank}")
@@ -264,10 +273,23 @@ async def _get_state_dict_direct(
                 ) from exc
             for flat_key, handle_list in published["handles"].items():
                 all_handles.setdefault(flat_key, []).extend(handle_list)
-        entry = (DirectWeightSyncDest(), all_handles)
+            if num_ranks == 1:
+                device_info = published.get("device")
+        entry = (DirectWeightSyncDest(), all_handles, device_info)
         cache.dests[key] = entry
-    dest, all_handles = entry
+    dest, all_handles, device_info = entry
     try:
+        if device_info is not None:
+            from torchstore_tpu.transport import device_transfer as _dt
+
+            if not _dt.is_available():
+                raise RuntimeError(
+                    f"direct push {key!r} rides the device (ICI) path but "
+                    "this process's jax build lacks the transfer engine; "
+                    "set TORCHSTORE_TPU_ICI_ENABLED=0 on the source to use "
+                    "the host path"
+                )
+            return await dest.pull_device(device_info, user_state_dict)
         return await dest.pull(all_handles, user_state_dict)
     except (ConnectionError, OSError, KeyError, ValueError):
         # ValueError covers stale-plan shape mismatches after a source
@@ -338,7 +360,10 @@ async def get_state_dict(
             entry = cache.dests.get(key)
             if entry is not None:
                 user_flat, _ = flatten_state_dict(user_state_dict)
-                missing = set(entry[1]) - set(user_flat)
+                published_keys = (
+                    set(entry[2]["keys"]) if entry[2] is not None else set(entry[1])
+                )
+                missing = published_keys - set(user_flat)
                 if missing:
                     raise ValueError(
                         f"state dict structure mismatch for {key!r}: missing "

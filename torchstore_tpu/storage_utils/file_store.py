@@ -192,6 +192,30 @@ class FileBackedStore(StorageImpl):
                     self.kv[meta.key] = entry
                 self._check_type(meta.key, entry, "sharded")
                 value_np = np.asarray(value)
+                # Layout-changing re-publish: delete superseded shard FILES
+                # (not just kv entries), or a crash+recover would manifest a
+                # mix of old- and new-layout slices for this key.
+                from torchstore_tpu.storage_volume import (
+                    _prune_superseded_shards,
+                )
+
+                stale = _prune_superseded_shards(entry["shards"], ts)
+                # meta.pkl records ONE dtype for all of a key's shard files:
+                # a dtype-changing re-publish must drop old-dtype files too,
+                # or recovery maps them with the new dtype (garbage reads).
+                for coords, shard in list(entry["shards"].items()):
+                    if shard["tensor"].dtype != value_np.dtype:
+                        del entry["shards"][coords]
+                        stale.append(coords)
+                for coords in stale:
+                    try:
+                        os.unlink(
+                            os.path.join(
+                                _keydir(self.root, meta.key), _shard_file(coords)
+                            )
+                        )
+                    except OSError:
+                        pass
                 existing = entry["shards"].get(ts.coordinates)
                 if existing is not None and _same_memory(
                     existing["tensor"], value_np
@@ -259,24 +283,47 @@ class FileBackedStore(StorageImpl):
 
     # ---- recovery --------------------------------------------------------
 
-    def manifest(self) -> list[Request]:
-        """Meta-only requests describing every persisted entry, for
-        controller index rebuilds after a restart."""
-        out: list[Request] = []
+    def manifest(self) -> list[dict]:
+        """``{"meta": Request, "mtime": float}`` for every persisted entry,
+        for controller index rebuilds after a restart. File mtimes let the
+        rebuild resolve mixed-layout states (a crash mid re-shard leaves one
+        volume on the new layout while another still holds old shards) by
+        keeping only the newest layout per key."""
+        out: list[dict] = []
+
+        def _mtime(*names: str) -> float:
+            try:
+                return max(
+                    os.path.getmtime(os.path.join(path, n)) for n in names
+                )
+            except OSError:
+                return 0.0
+
         for key, entry in self.kv.items():
+            path = _keydir(self.root, key)
             if entry["type"] == "object":
-                out.append(Request(key=key, is_object=True))
+                out.append(
+                    {"meta": Request(key=key, is_object=True), "mtime": _mtime(_META)}
+                )
             elif entry["type"] == "tensor":
                 out.append(
-                    Request(key=key, tensor_meta=TensorMeta.of(entry["tensor"]))
+                    {
+                        "meta": Request(
+                            key=key, tensor_meta=TensorMeta.of(entry["tensor"])
+                        ),
+                        "mtime": _mtime("data.bin"),
+                    }
                 )
             else:
-                for shard in entry["shards"].values():
+                for coords, shard in entry["shards"].items():
                     out.append(
-                        Request(
-                            key=key,
-                            tensor_slice=shard["slice"],
-                            tensor_meta=TensorMeta.of(shard["tensor"]),
-                        )
+                        {
+                            "meta": Request(
+                                key=key,
+                                tensor_slice=shard["slice"],
+                                tensor_meta=TensorMeta.of(shard["tensor"]),
+                            ),
+                            "mtime": _mtime(_shard_file(coords)),
+                        }
                     )
         return out

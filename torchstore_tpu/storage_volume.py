@@ -111,6 +111,7 @@ class InMemoryStore(StorageImpl):
                 entry = self.kv.setdefault(meta.key, {"type": "sharded", "shards": {}})
                 self._check_type(meta.key, entry, "sharded")
                 ts = meta.tensor_slice
+                _prune_superseded_shards(entry["shards"], ts)
                 entry["shards"][ts.coordinates] = {
                     "slice": ts,
                     "tensor": np.asarray(value),
@@ -197,6 +198,24 @@ class InMemoryStore(StorageImpl):
         self.kv.clear()
 
 
+def _prune_superseded_shards(shards: dict, incoming: TensorSlice) -> list[tuple]:
+    """Drop shards whose layout (mesh shape / global shape) differs from an
+    incoming re-publish. Without this, a key re-published under a new
+    sharding keeps old-layout shards alongside new ones: the commit check
+    then passes on a mixed coords set and gets assemble overlapping
+    stale+fresh slices — silent weight corruption (mirrors the controller's
+    stale-layout invalidation, controller.py notify_put_batch)."""
+    stale = [
+        coords
+        for coords, shard in shards.items()
+        if shard["slice"].mesh_shape != incoming.mesh_shape
+        or shard["slice"].global_shape != incoming.global_shape
+    ]
+    for coords in stale:
+        del shards[coords]
+    return stale
+
+
 class StorageVolume(Actor):
     """Data-plane actor (/root/reference/torchstore/storage_volume.py:27-99)."""
 
@@ -276,9 +295,10 @@ class StorageVolume(Actor):
         return deleted
 
     @endpoint
-    async def manifest(self) -> list[Request]:
-        """Meta-only descriptions of every stored entry (durable backends
-        only) — feeds controller index rebuilds after restarts."""
+    async def manifest(self) -> list:
+        """Meta-only descriptions (``{"meta": Request, "mtime": float}``) of
+        every stored entry (durable backends only) — feeds controller index
+        rebuilds after restarts."""
         fn = getattr(self.store, "manifest", None)
         if fn is None:
             return []

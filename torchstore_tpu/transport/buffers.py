@@ -21,7 +21,7 @@ implementation.
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING, Any, Optional
 
 import numpy as np
 
@@ -33,6 +33,17 @@ if TYPE_CHECKING:
     from torchstore_tpu.strategy import StorageVolumeRef
 
 logger = get_logger("torchstore_tpu.transport")
+
+# Data-plane RPCs carry (or wait on) tensor bytes: their deadline must scale
+# with payload size or a transfer slower than config.rpc_timeout spuriously
+# fails. 50 MB/s is a conservative DCN floor.
+MIN_TRANSFER_RATE_BPS = 50e6
+
+
+def transfer_timeout(base: Optional[float], nbytes: int) -> Optional[float]:
+    if base is None or base <= 0:
+        return base  # timeouts disabled
+    return base + nbytes / MIN_TRANSFER_RATE_BPS
 
 
 class TransportCache:
@@ -106,7 +117,11 @@ class TransportBuffer(ABC):
                 await self._perform_handshake(volume, requests, op="put")
             await self._pre_put_hook(volume, requests)
             metas = [r.meta_only() for r in requests]
-            reply = await volume.actor.put.call_one(self, metas)
+            nbytes = sum(r.nbytes for r in requests)
+            put = volume.actor.put
+            reply = await put.with_timeout(
+                transfer_timeout(put._effective_timeout(), nbytes)
+            ).call_one(self, metas)
             self._handle_put_reply(volume, reply, requests)
             self._post_request_success(volume)
         finally:
@@ -120,7 +135,13 @@ class TransportBuffer(ABC):
                 await self._perform_handshake(volume, requests, op="get")
             await self._pre_get_hook(volume, requests)
             metas = [r.meta_only() for r in requests]
-            remote = await volume.actor.get.call_one(self, metas)
+            nbytes = sum(
+                m.tensor_meta.nbytes for m in metas if m.tensor_meta is not None
+            )
+            get = volume.actor.get
+            remote = await get.with_timeout(
+                transfer_timeout(get._effective_timeout(), nbytes)
+            ).call_one(self, metas)
             results = await maybe_await(
                 self._handle_storage_volume_response(volume, remote, requests)
             )

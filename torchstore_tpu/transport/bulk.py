@@ -228,6 +228,11 @@ class BulkServer:
             task.add_done_callback(self._conn_tasks.discard)
 
     async def _handle_conn(self, sock: socket.socket) -> None:
+        from torchstore_tpu.runtime.auth import server_authenticate_sock
+
+        if not await server_authenticate_sock(sock):
+            await _graceful_close(sock)
+            return
         client_id = None
         conn_lock = asyncio.Lock()  # serializes all outgoing writes
         header = bytearray(_FRAME.size)
@@ -430,6 +435,13 @@ async def _dial(host: str, port: int, timeout: float) -> socket.socket:
         _close_sock(sock)
         raise
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    from torchstore_tpu.runtime.auth import client_authenticate_sock
+
+    try:
+        await client_authenticate_sock(sock)
+    except BaseException:
+        _close_sock(sock)
+        raise
     return sock
 
 
@@ -587,9 +599,14 @@ class BulkTransportBuffer(TransportBuffer):
     ) -> dict[int, Any]:
         server: BulkServer = ctx.get_cache(BulkServerCache).server
         out: dict[int, Any] = dict(self.objects)
+        from torchstore_tpu.transport.buffers import transfer_timeout
+
+        # Size-scaled: a multi-GB DCN transfer slower than the flat
+        # handshake timeout must not spuriously fail the put.
+        total = sum(m.nbytes for m in self.manifest.values())
         payloads = await asyncio.wait_for(
             server.collect(self.session, sorted(self.manifest)),
-            timeout=self.config.handshake_timeout,
+            timeout=transfer_timeout(self.config.handshake_timeout, total),
         )
         for idx, raw in payloads.items():
             meta = self.manifest[idx]
@@ -622,11 +639,17 @@ class BulkTransportBuffer(TransportBuffer):
     async def _handle_storage_volume_response(
         self, volume, remote: "BulkTransportBuffer", requests: list[Request]
     ) -> list[Any]:
+        from torchstore_tpu.transport.buffers import transfer_timeout
+
+        frame_timeout = transfer_timeout(
+            self.config.rpc_timeout,
+            sum(m.nbytes for m in remote.descriptors.values()),
+        )
         expected = set(remote.descriptors)
         received: dict[int, bytearray] = {}
         while expected - set(received):
             idx, raw = await asyncio.wait_for(
-                self._queue.get(), timeout=self.config.rpc_timeout
+                self._queue.get(), timeout=frame_timeout
             )
             if idx is None:
                 raise ConnectionError("bulk connection lost during get")

@@ -1,0 +1,207 @@
+"""Device-path weight sync: the ICI rung.
+
+TPU-native answer to the reference's one-sided RDMA device reads
+(/root/reference/torchstore/transport/monarch_rdma.py:158-219, ibverbs reads
+of source GPU memory). TPUs expose no raw one-sided read primitive to user
+code, but the XLA runtime does: ``jax.experimental.transfer`` starts a
+per-process *transfer server* attached to the local backend, and a remote
+process can pull staged device arrays directly — device-to-device over the
+accelerator fabric (ICI within a pod, DCN across), never touching host
+staging buffers. This module wraps that engine as the store's device
+transport rung, gated by ``StoreConfig.ici_enabled``.
+
+Protocol (one-shot staging is the engine's contract — each ``await_pull``
+uuid serves exactly ONE ``pull``):
+
+    source: engine.ensure_server() -> address; publish handles via the store
+    dest:   asks the source to stage a fresh generation (tiny TCP control
+            op, see direct_weight_sync) -> uuid
+    dest:   conn.pull(uuid, specs_with_source_sharding) -> device arrays
+    dest:   reshards locally (jax.device_put) — XLA moves shards over ICI
+
+Because staging happens per pull request, a dest always receives the
+source's CURRENT weights with zero host copies on either side.
+
+Shardings cannot be pickled across processes (they hold live Device
+objects); ``ShardingDescriptor`` round-trips NamedSharding /
+SingleDeviceSharding by mesh shape + axis names + device ids, reconstructed
+over the destination process's view of the same global device set.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid as uuid_mod
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+
+logger = get_logger("torchstore_tpu.transport.ici")
+
+
+def is_available() -> bool:
+    """True when this jax build ships the transfer engine."""
+    try:
+        from jax.experimental import transfer  # noqa: F401
+
+        return hasattr(transfer, "start_transfer_server")
+    except Exception:  # pragma: no cover - jax without the extension
+        return False
+
+
+# --------------------------------------------------------------------------
+# sharding descriptors (picklable)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShardingDescriptor:
+    """Picklable description of a NamedSharding/SingleDeviceSharding."""
+
+    kind: str  # "named" | "single"
+    mesh_shape: tuple[int, ...] = ()
+    axis_names: tuple[str, ...] = ()
+    device_ids: tuple[int, ...] = ()  # mesh devices flattened, or [device]
+    spec: tuple = ()  # PartitionSpec entries (None | str | tuple[str, ...])
+    memory_kind: Optional[str] = None
+
+    @classmethod
+    def of(cls, sharding) -> "ShardingDescriptor":
+        import jax
+
+        if isinstance(sharding, jax.sharding.SingleDeviceSharding):
+            (dev,) = sharding.device_set
+            return cls(kind="single", device_ids=(dev.id,))
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            mesh = sharding.mesh
+            spec = tuple(
+                tuple(p) if isinstance(p, (list, tuple)) else p
+                for p in sharding.spec
+            )
+            return cls(
+                kind="named",
+                mesh_shape=tuple(mesh.devices.shape),
+                axis_names=tuple(mesh.axis_names),
+                device_ids=tuple(d.id for d in mesh.devices.flat),
+                spec=spec,
+                memory_kind=sharding.memory_kind,
+            )
+        raise TypeError(f"unsupported sharding type {type(sharding).__name__}")
+
+    def build(self):
+        """Reconstruct the sharding over THIS process's devices."""
+        import numpy as np
+
+        import jax
+
+        by_id = {d.id: d for d in jax.devices()}
+        try:
+            devices = [by_id[i] for i in self.device_ids]
+        except KeyError as exc:
+            raise ValueError(
+                f"device id {exc} in sharding descriptor is not visible in "
+                "this process (device-path sync requires a shared jax world)"
+            ) from None
+        if self.kind == "single":
+            return jax.sharding.SingleDeviceSharding(devices[0])
+        mesh = jax.sharding.Mesh(
+            np.array(devices, dtype=object).reshape(self.mesh_shape),
+            self.axis_names,
+        )
+        spec = jax.sharding.PartitionSpec(*self.spec)
+        if self.memory_kind is not None:
+            return jax.sharding.NamedSharding(
+                mesh, spec, memory_kind=self.memory_kind
+            )
+        return jax.sharding.NamedSharding(mesh, spec)
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Shape/dtype/placement of one staged array (pull-spec ingredients)."""
+
+    shape: tuple[int, ...]
+    dtype: str
+    sharding: ShardingDescriptor
+
+    @classmethod
+    def of(cls, arr) -> "DeviceSpec":
+        return cls(
+            shape=tuple(arr.shape),
+            dtype=str(arr.dtype),
+            sharding=ShardingDescriptor.of(arr.sharding),
+        )
+
+    def to_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.ShapeDtypeStruct(
+            self.shape, jnp.dtype(self.dtype), sharding=self.sharding.build()
+        )
+
+
+# --------------------------------------------------------------------------
+# the engine (per-process singleton)
+# --------------------------------------------------------------------------
+
+
+class DeviceTransferEngine:
+    """Owns this process's transfer server + cached peer connections."""
+
+    _instance: Optional["DeviceTransferEngine"] = None
+
+    def __init__(self) -> None:
+        self._server = None
+        self._conns: dict[str, Any] = {}
+        # uuids must be unique per (source process, staging); random base +
+        # counter keeps restarted sources from colliding with stale pulls.
+        self._next_uuid = uuid_mod.uuid4().int & ((1 << 62) - 1)
+
+    @classmethod
+    def get(cls) -> "DeviceTransferEngine":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def ensure_server(self, client=None) -> str:
+        """Start (once) the transfer server on the local backend; returns its
+        reachable address."""
+        if self._server is None:
+            import jax
+            from jax.experimental import transfer
+
+            if client is None:
+                client = jax.devices()[0].client
+            bind = os.environ.get("TORCHSTORE_TPU_BIND_HOST", "127.0.0.1")
+            if bind in ("0.0.0.0", "::"):
+                bind = "[::]" if bind == "::" else "0.0.0.0"
+            self._server = transfer.start_transfer_server(
+                client, f"{bind}:0", [f"{bind}:0"]
+            )
+            logger.info("device transfer server at %s", self._server.address())
+        return self._server.address()
+
+    def stage(self, arrays: list) -> int:
+        """Schedule ``arrays`` (device jax.Arrays) for ONE remote pull;
+        returns the uuid the peer must pull with."""
+        self.ensure_server()
+        self._next_uuid += 1
+        uid = self._next_uuid
+        self._server.await_pull(uid, list(arrays))
+        return uid
+
+    def pull(self, address: str, uid: int, specs: list[DeviceSpec]) -> list:
+        """Pull staged arrays from a peer server, landing them with the
+        source's sharding (reshard afterwards with jax.device_put)."""
+        self.ensure_server()
+        conn = self._conns.get(address)
+        if conn is None:
+            conn = self._server.connect(address)
+            self._conns[address] = conn
+        return conn.pull(uid, [s.to_jax() for s in specs])
+
+    def reset(self) -> None:
+        """Drop connections (tests); the server itself is process-lifetime."""
+        self._conns.clear()

@@ -4,9 +4,16 @@ Equivalent of /root/reference/torchstore/transport/__init__.py:38-108. The
 reference ladder (SHM -> uniflow RDMA/NVLink -> legacy RDMA -> ibverbs ->
 Gloo -> RPC) maps to TPU rungs:
 
+    ici   device-to-device via the XLA transfer engine
+          (``transport/device_transfer.py``, gated by ``ici_enabled``) —
+          the direct weight-sync path rides it for all-jax state dicts;
+          volume-backed store entries are host memory, so this rung serves
+          the direct path, not the volume ladder (the reference's device
+          rung, monarch_rdma.py, likewise serves weight sync)
     shm   same-host POSIX shared memory between client and volume
-    bulk  dedicated-socket bulk transfer (ICI-adjacent within a pod via
-          host staging; DCN across pods)
+          (zero-copy snapshot reads)
+    bulk  dedicated-socket bulk transfer (host staging within a pod;
+          DCN across pods)
     rpc   payload rides the actor-RPC frames (always available)
 
 Selection is per-volume at request time: forced type on the
@@ -74,12 +81,15 @@ def create_transport_buffer(
     if not _logged_resolution:
         # One line listing every rung's availability (reference behavior,
         # /root/reference/torchstore/transport/__init__.py:70-81).
+        from torchstore_tpu.transport import device_transfer
+
         logger.info(
             "transport resolution: volume=%s same_host=%s -> %s "
-            "[shm=%s bulk=%s rpc=True]",
+            "[ici(direct)=%s shm=%s bulk=%s rpc=True]",
             volume.volume_id,
             volume.is_same_host(),
             chosen.value,
+            config.ici_enabled and device_transfer.is_available(),
             shm_available(volume, config),
             bulk_available(volume, config),
         )

@@ -17,10 +17,11 @@ GET:  the volume serves an (offset, strides) descriptor into its own segment
       KEEPS the view: gets are zero-copy by default.
 
 Safety of zero-copy reads (replaces an earlier opt-in ``mutable_shm`` flag):
-the volume lease-counts every view it serves. A put may overwrite a segment
-in place only while its lease count is zero; otherwise the put lands in a
-fresh (or pooled) segment and the old one is *retired* — the data a reader
-views is immutable for the life of the view. Clients track served views with
+the volume lease-counts every descriptor it serves, and a put NEVER writes
+into a live entry segment — each put lands in a pooled (or fresh) segment
+and the previous one is *retired* until every lease is released, then
+recycled. Data a reader views — or is mid-copy out of — is therefore
+immutable for the life of the read. Clients track served views with
 weakrefs and piggyback release notices on their next RPC; released segments
 return to a volume-side free pool, so the steady state of a put/get loop
 recycles warm segments instead of allocating (double-buffer rotation).
@@ -252,9 +253,6 @@ class ShmServerCache(TransportCache):
         self.pool_cap = default_config().shm_pool_max_bytes
         # pooled segments offered in a put handshake, awaiting the put RPC
         self.reserved: dict[str, tuple[ShmSegment, float]] = {}
-        # entry segments offered for in-place overwrite: gets must not serve
-        # zero-copy views of them until the put lands (snapshot safety)
-        self.write_pending: dict[str, float] = {}
 
     def adopt_config(self, config: Optional[StoreConfig]) -> None:
         if config is not None:
@@ -284,9 +282,6 @@ class ShmServerCache(TransportCache):
                 # then fails cleanly on attach instead of corrupting data.
                 del self.reserved[name]
                 seg.unlink()
-        for name, ts in list(self.write_pending.items()):
-            if now - ts > RESERVED_TTL_S:
-                del self.write_pending[name]
 
     # ---- leases ----------------------------------------------------------
 
@@ -388,7 +383,6 @@ class ShmServerCache(TransportCache):
         for entry in self.by_key.pop(key, {}).values():
             entry.seg.unlink()
             self.grants.pop(entry.seg.name, None)
-            self.write_pending.pop(entry.seg.name, None)
 
     def clear(self) -> None:
         for entries in self.by_key.values():
@@ -411,7 +405,6 @@ class ShmServerCache(TransportCache):
             seg.unlink()
         self.reserved.clear()
         self.grants.clear()
-        self.write_pending.clear()
 
 
 class ShmClientCache(TransportCache):
@@ -504,7 +497,9 @@ class ShmClientCache(TransportCache):
             seg = self.segments.pop(name, None)
             if seg is not None:
                 seg.close()
-            self.seg_volume.pop(name, None)
+            # seg_volume is kept: views handed out for this key may still
+            # be alive, and their eventual release must still route to the
+            # owning volume (or its retired segment waits out the full TTL).
 
     def clear(self) -> None:
         for seg in self.segments.values():
@@ -611,28 +606,16 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         for idx, meta in enumerate(metas):
             if meta.tensor_meta is None:
                 continue
-            coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
-            entry = cache.lookup(meta.key, coords)
-            if (
-                entry is not None
-                and entry.meta == meta.tensor_meta
-                and not cache.grants.get(entry.seg.name)
-                # Another put's in-place overwrite of this segment may be in
-                # flight — offering it twice would interleave two writers.
-                and entry.seg.name not in cache.write_pending
-            ):
-                # No outstanding views: offer the existing segment for
-                # in-place overwrite (descriptor-reuse handshake, reference
-                # shared_memory.py:340-360). Gets serve staged copies of it
-                # until the put lands (snapshot safety).
-                cache.write_pending[entry.seg.name] = time.monotonic()
-                offered[idx] = ShmDescriptor(
-                    entry.seg.name, entry.seg.size, entry.meta
-                )
-                continue
-            # Entry is leased (or absent/shape-changed): offer a warm pooled
-            # segment so steady-state put/get loops rotate buffers instead of
-            # allocating cold ones.
+            # Puts NEVER overwrite a live entry segment — between this
+            # handshake and the put RPC a concurrent get could be serving
+            # (or staging a copy of) the current content, and a cross-
+            # process writer would tear it. Instead, offer a warm segment
+            # from the free pool (retired segments return there once every
+            # view lease is released), so steady-state put/get loops rotate
+            # buffers instead of allocating cold ones; the old segment is
+            # retired or pooled when the put lands (descriptor-reuse
+            # handshake role, reference shared_memory.py:340-360, with
+            # rotation instead of in-place overwrite).
             pooled = cache.take_free(max(meta.tensor_meta.nbytes, 1))
             if pooled is not None:
                 cache.reserved[pooled.name] = (pooled, time.monotonic())
@@ -654,7 +637,6 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             meta = metas[idx]
             coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
             current = cache.lookup(meta.key, coords)
-            cache.write_pending.pop(desc.segment_name, None)
             reserved = cache.reserved.pop(desc.segment_name, None)
             if current is not None and current.seg.name == desc.segment_name:
                 seg = current.seg  # in-place overwrite of the live segment
@@ -716,8 +698,6 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         if loc is None:
             return None
         seg, offset = loc
-        if seg.name in cache.write_pending:
-            return None  # an in-place put was promised; serve a snapshot copy
         strides = entry.strides
         if any(s < 0 for s in strides):
             return None

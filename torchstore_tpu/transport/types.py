@@ -115,10 +115,6 @@ class Request:
     objects: Any = None
     is_object: bool = False
     tensor_meta: Optional[TensorMeta] = None
-    # True on meta-only GET requests when the client has no in-place
-    # destination and may keep a zero-copy view of served data (transports
-    # that can serve views use this to track outstanding read leases).
-    wants_view: bool = False
     # Attached by the client when an in-place destination view exists for this
     # (sub-)request; never serialized to the server (stripped by meta_only).
     destination_view: Optional[np.ndarray] = field(default=None, repr=False)
@@ -160,7 +156,6 @@ class Request:
             objects=None,
             is_object=self.is_object,
             tensor_meta=meta,
-            wants_view=(self.destination_view is None and not self.is_object),
         )
 
     @property

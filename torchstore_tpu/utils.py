@@ -185,6 +185,25 @@ def assemble_tensor(
             f"assembled parts cover {covered} elements but bounding box has "
             f"{bbox.size}; parts do not tile the requested region"
         )
+    # A plain size sum double-counts OVERLAPPING parts and can mask an
+    # uncovered hole (np.empty garbage served as tensor data). Overlaps only
+    # occur in anomalous states (e.g. mixed-layout crash recovery), so the
+    # exact check — painting a coverage byte per cell — runs only then.
+    if any(
+        intersect_boxes(a, b) is not None
+        for i, a in enumerate(boxes)
+        for b in boxes[i + 1 :]
+    ):
+        painted = np.zeros(bbox.shape, dtype=np.uint8)
+        for (p, off), box in zip(parts, boxes):
+            rel = tuple(o - bo for o, bo in zip(off, bbox.offsets))
+            painted[tuple(slice(r, r + s) for r, s in zip(rel, p.shape))] = 1
+        holes = int(painted.size - int(painted.sum()))
+        if holes:
+            raise ValueError(
+                f"assembled parts overlap yet leave {holes} of {bbox.size} "
+                "elements uncovered; parts do not tile the requested region"
+            )
     return out, bbox.offsets
 
 
