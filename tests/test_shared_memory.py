@@ -99,6 +99,47 @@ class TestServerCache:
         assert not os.path.exists(os.path.join(shm.SHM_DIR, s0.name))
 
 
+class TestPoolWarmer:
+    async def test_warms_in_idle_window(self):
+        import asyncio
+        import time as _time
+
+        cache = ShmServerCache()
+        cache.last_activity = _time.monotonic() - 5.0  # store is idle
+        cache.schedule_warm([4096, 4096])
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            if len(cache.free_by_size.get(4096, ())) == 2:
+                break
+        assert len(cache.free_by_size.get(4096, ())) == 2
+        a = cache.take_free(4096)
+        assert a is not None and a.size == 4096
+        a.unlink()
+        cache.clear()
+
+    async def test_defers_under_load(self):
+        import asyncio
+        import time as _time
+
+        cache = ShmServerCache()
+        cache.last_activity = _time.monotonic()  # live traffic
+        cache.schedule_warm([4096])
+        await asyncio.sleep(0.3)
+        assert cache.take_free(4096) is None  # not warmed yet
+        cache.last_activity = _time.monotonic() - 5.0
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            if cache.free_by_size.get(4096):
+                break
+        assert cache.free_by_size.get(4096)  # warmed once idle
+        cache.clear()
+
+    def test_no_loop_is_noop(self):
+        cache = ShmServerCache()
+        cache.schedule_warm([4096])  # no running loop: silently skipped
+        assert cache.take_free(4096) is None
+
+
 class TestBufferUnit:
     def test_pickle_strips_client_state(self):
         import pickle

@@ -23,6 +23,29 @@ async def store(request):
     await ts.shutdown("t")
 
 
+async def test_location_cache_survives_cross_client_changes(store):
+    """Client A's cached key location must not serve stale results after
+    client B deletes or re-publishes the key (stale fetches retry once
+    against a fresh locate)."""
+    from torchstore_tpu.client import LocalClient
+
+    a = ts.client(store)
+    b = LocalClient(a.controller, a._config)
+    x = np.arange(16.0, dtype=np.float32)
+    await a.put("k", x)
+    np.testing.assert_array_equal(await a.get("k"), x)  # location now cached
+    assert "k" in a._loc_cache
+    # B re-publishes with a DIFFERENT shape; A must see the new value.
+    y = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    await b.put("k", y)
+    out = await a.get("k")
+    np.testing.assert_array_equal(out, y)
+    # B deletes; A must raise, not serve stale bytes.
+    await b.delete("k")
+    with pytest.raises(KeyError):
+        await a.get("k")
+
+
 async def test_tensor_roundtrip(store):
     x = np.arange(24.0, dtype=np.float32).reshape(4, 6)
     await ts.put("x", x, store_name=store)

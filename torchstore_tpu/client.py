@@ -46,6 +46,10 @@ class Shard:
 
 
 class LocalClient:
+    # Bound on the per-client location cache; overflow clears wholesale
+    # (cheap, and a warm working set re-fills in one locate round).
+    LOC_CACHE_MAX = 65536
+
     def __init__(
         self,
         controller: ActorRef,
@@ -56,6 +60,12 @@ class LocalClient:
         self._strategy = None
         self._volume_refs: Optional[dict[str, StorageVolumeRef]] = None
         self._ctx = TransportContext()
+        # key -> {volume_id: StorageInfo}: saves the locate RPC on repeat
+        # gets (the small-op fast path — reference clients locate on every
+        # get, /root/reference/torchstore/client.py:204-237). Invalidated
+        # on local deletes; cross-client relocations/deletes are discovered
+        # by the fetch failing and retried once with a fresh locate.
+        self._loc_cache: dict[str, dict[str, StorageInfo]] = {}
 
     @property
     def controller(self) -> ActorRef:
@@ -115,6 +125,13 @@ class LocalClient:
     async def put_batch(self, items: dict[str, Any]) -> None:
         await self._ensure_setup()
         tracker = LatencyTracker("put_batch")
+        # Issue every device->host copy for the WHOLE batch up front so
+        # transfers overlap across arrays too, not just across one array's
+        # shards (shd.put_requests overlaps within an array).
+        for value in items.values():
+            if shd.is_jax_array(value):
+                for shard in value.addressable_shards:
+                    shd._start_d2h(shard.data)
         requests: list[Request] = []
         for key, value in items.items():
             requests.extend(self._value_to_requests(key, value))
@@ -212,8 +229,45 @@ class LocalClient:
     # ------------------------------------------------------------------
 
     async def _fetch(self, requests: list[Request]) -> list[Any]:
+        try:
+            return await self._fetch_once(requests, use_cache=True)
+        except (KeyError, ValueError, ActorDiedError) as exc:
+            # Stale location cache (another client deleted/re-published a
+            # key, or its volume died and the key lives elsewhere now):
+            # drop the batch's entries and retry once with a fresh locate.
+            # KeyError covers missing keys/shards; ValueError covers layout
+            # mismatches surfacing as shape errors; ActorDiedError covers
+            # cached locations pointing at dead/restarted volumes.
+            stale = [r.key for r in requests if r.key in self._loc_cache]
+            if not stale:
+                raise
+            for key in stale:
+                self._loc_cache.pop(key, None)
+            logger.info(
+                "location cache stale for %d key(s) (%s); re-locating",
+                len(stale),
+                exc,
+            )
+            return await self._fetch_once(requests, use_cache=False)
+
+    async def _fetch_once(
+        self, requests: list[Request], use_cache: bool
+    ) -> list[Any]:
         keys = list({r.key for r in requests})
-        located = await self._controller.locate_volumes.call_one(keys)
+        located: dict[str, dict[str, StorageInfo]] = {}
+        missing = []
+        for key in keys:
+            cached = self._loc_cache.get(key) if use_cache else None
+            if cached is not None:
+                located[key] = cached
+            else:
+                missing.append(key)
+        if missing:
+            fresh = await self._controller.locate_volumes.call_one(missing)
+            if len(self._loc_cache) + len(fresh) > self.LOC_CACHE_MAX:
+                self._loc_cache.clear()
+            self._loc_cache.update(fresh)
+            located.update(fresh)
         # volume_id -> list of (request_index, sub_request)
         by_volume: dict[str, list[tuple[int, Request]]] = {}
         inplace_ok = self._transports_support_inplace(located)
@@ -433,6 +487,7 @@ class LocalClient:
         )
         for key in keys:
             self._ctx.delete_key(key)
+            self._loc_cache.pop(key, None)
 
     async def delete_prefix(self, prefix: str) -> int:
         """Delete every key under a prefix (e.g. an old checkpoint version:

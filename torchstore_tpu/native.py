@@ -102,7 +102,14 @@ def _addr(arr: np.ndarray) -> int:
 
 def fast_copy(dst: np.ndarray, src: np.ndarray) -> None:
     """np.copyto with a multi-threaded native path for large contiguous
-    same-dtype copies (the store's hot memcpy)."""
+    same-dtype copies (the store's hot memcpy). Shapes must match exactly:
+    landing copies never broadcast — a silent broadcast would paper over a
+    stale-metadata fetch (e.g. a location cache that missed a same-key
+    shape change) with wrong data."""
+    if dst.shape != src.shape:
+        raise ValueError(
+            f"landing-copy shape mismatch: dst {dst.shape} vs src {src.shape}"
+        )
     lib = get_lib()
     if (
         lib is not None
@@ -119,7 +126,12 @@ def fast_copy(dst: np.ndarray, src: np.ndarray) -> None:
 
 def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
     """Best copy path for a landing: contiguous native memcpy, then the
-    native strided row-block path, then numpy."""
+    native strided row-block path, then numpy. Never broadcasts (see
+    fast_copy)."""
+    if dst.shape != src.shape:
+        raise ValueError(
+            f"landing-copy shape mismatch: dst {dst.shape} vs src {src.shape}"
+        )
     if (
         dst.flags["C_CONTIGUOUS"]
         and src.flags["C_CONTIGUOUS"]

@@ -183,32 +183,32 @@ async def initialize(
         raise RuntimeError(f"SPMD store {store_name!r} already initialized")
 
     # --- rendezvous -------------------------------------------------------
-    def _is_loopback(addr: str) -> bool:
+    def _loopback_bind_addr(addr: str) -> Optional[str]:
+        """The RESOLVED loopback IP when ``addr`` is loopback-only, else
+        None. Binding the resolved IP (not a hardcoded 127.0.0.1) matters:
+        Debian-style /etc/hosts maps $(hostname) to 127.0.1.1 — clients
+        connect to whatever MASTER_ADDR resolves to, so the listener must
+        bind exactly that."""
         import socket as _socket
 
-        if addr in ("localhost", "127.0.0.1", "::1"):
-            return True
         try:
-            infos = _socket.getaddrinfo(addr, None)
+            ips = {info[4][0] for info in _socket.getaddrinfo(addr, None)}
         except OSError:
-            return False
-        return all(
-            info[4][0].startswith("127.") or info[4][0] == "::1"
-            for info in infos
-        )
+            return None
+        if ips and all(ip.startswith("127.") or ip == "::1" for ip in ips):
+            return next(iter(ips))
+        return None
 
     server = None
     if env.rank == 0:
         server = RendezvousServer()
-        # Loopback MASTER_ADDR means every rank is local: bind loopback so
-        # the (pickle-speaking) rendezvous port stays private. Any other
-        # address binds all interfaces — binding MASTER_ADDR itself is a
-        # trap: Debian-style /etc/hosts maps $(hostname) to 127.0.1.1,
-        # which binds fine but is unreachable from peer hosts.
-        if _is_loopback(env.master_addr):
-            await server.start("127.0.0.1", env.master_port)
-        else:
-            await server.start("0.0.0.0", env.master_port)
+        # Loopback-resolved MASTER_ADDR means every rank is local: bind that
+        # exact loopback IP so the (pickle-speaking) rendezvous port stays
+        # private. Anything else binds all interfaces — binding a
+        # non-loopback MASTER_ADDR itself can pick an interface peers
+        # cannot actually route to (container NAT).
+        loop_ip = _loopback_bind_addr(env.master_addr)
+        await server.start(loop_ip or "0.0.0.0", env.master_port)
         from torchstore_tpu.runtime.auth import get_secret
 
         if env.num_hosts > 1 and not get_secret():

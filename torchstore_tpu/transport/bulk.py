@@ -60,7 +60,17 @@ IDX_ABORT = 0xFFFFFFFE
 # it (same idx back) so the client can order the frame ahead of the get RPC,
 # which travels on an independent TCP connection.
 IDX_SESSION_OPEN = 0xFFFFFFFD
-_CONTROL_IDXS = frozenset({IDX_HELLO, IDX_ABORT, IDX_SESSION_OPEN})
+# Striped payload chunk: the frame body starts with a _STRIPE subheader
+# (real_idx, byte offset, total bytes) followed by the chunk. Large
+# transfers split into stripes ridden over several connections in parallel
+# (the uniflow multi-QP striping role, uniflow_buffer.py:400-497).
+IDX_STRIPED = 0xFFFFFFFC
+_CONTROL_IDXS = frozenset({IDX_HELLO, IDX_ABORT, IDX_SESSION_OPEN, IDX_STRIPED})
+
+_STRIPE = struct.Struct("<IQQ")  # real_idx, offset, total_nbytes
+# Payloads above this are striped across STRIPE_CONNS connections.
+STRIPE_THRESHOLD = 64 * 1024 * 1024
+STRIPE_CONNS = 4
 
 # Volume-side session state (landed put bytes, abort markers) is purged after
 # this long without the matching RPC arriving — a crashed client must not
@@ -97,6 +107,20 @@ async def _recv_exact(sock: socket.socket, view: memoryview) -> None:
         pos += n
 
 
+async def _discard(sock: socket.socket, nbytes: int) -> None:
+    """Consume and drop payload bytes addressed to an unknown session."""
+    if nbytes <= 0:
+        return
+    scratch = memoryview(bytearray(min(nbytes, 1 << 16)))
+    loop = asyncio.get_running_loop()
+    left = nbytes
+    while left:
+        n = await loop.sock_recv_into(sock, scratch[: min(left, len(scratch))])
+        if n == 0:
+            raise ConnectionError("bulk peer closed mid-frame")
+        left -= n
+
+
 async def _send_frame(
     sock: socket.socket,
     lock: asyncio.Lock,
@@ -112,6 +136,31 @@ async def _send_frame(
             await loop.sock_sendall(sock, payload)
 
 
+async def _send_frame_raw(
+    sock: socket.socket,
+    session: int,
+    idx: int,
+    subheader: bytes,
+    payload: memoryview,
+) -> None:
+    """Frame with a stripe subheader; CALLER holds the write lock."""
+    loop = asyncio.get_running_loop()
+    await loop.sock_sendall(
+        sock, _FRAME.pack(session, idx, len(subheader) + payload.nbytes)
+    )
+    await loop.sock_sendall(sock, subheader)
+    await loop.sock_sendall(sock, payload)
+
+
+def _shutdown_sock(sock: socket.socket) -> None:
+    """Wake the connection's reader with an error; the READER then joins
+    in-flight sends and closes the fd (single deterministic owner)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+
+
 def _close_sock(sock: Optional[socket.socket]) -> None:
     """Immediate close — ONLY safe when no loop.sock_* op can be pending on
     this socket (dial failures, teardown without a loop)."""
@@ -124,24 +173,6 @@ def _close_sock(sock: Optional[socket.socket]) -> None:
             sock.close()
         except OSError:
             pass
-
-
-async def _graceful_close(sock: socket.socket) -> None:
-    """Close a socket that may have in-flight loop.sock_* operations:
-    shutdown() wakes them with an error (a bare close would strand them —
-    epoll drops closed fds), one tick lets their completion callbacks
-    unregister the fd, THEN close. Closing first risks the fd being reused
-    by a new socket while the loop still holds the old registration
-    (observed as selector FileNotFoundError under concurrent churn)."""
-    try:
-        sock.shutdown(socket.SHUT_RDWR)
-    except OSError:
-        pass
-    await asyncio.sleep(0.05)
-    try:
-        sock.close()
-    except OSError:
-        pass
 
 
 def _family_for(host: str) -> int:
@@ -164,15 +195,22 @@ class BulkServer:
         self.host: str = "127.0.0.1"
         # (session, idx) -> bytearray of landed payload
         self.incoming: dict[tuple[int, int], bytearray] = {}
+        # (session, idx) -> [bytearray(total), bytes_received] while striped
+        # chunks are still arriving (possibly over several connections)
+        self._stripe_asm: dict[tuple[int, int], list] = {}
         self.aborted: set[int] = set()
         self._session_ts: dict[int, float] = {}  # last activity per session
         self._arrival = asyncio.Condition()
         # client_id -> (sock, write_lock) for outgoing get payloads
         self.client_conns: dict[int, tuple[socket.socket, asyncio.Lock]] = {}
-        # session -> (sock, write_lock): exact routing for get sessions
-        self.session_conns: dict[int, tuple[socket.socket, asyncio.Lock]] = {}
+        # session -> [(sock, write_lock), ...]: every connection the client
+        # opened for this get session; >1 means striped responses.
+        self.session_conns: dict[int, list[tuple[socket.socket, asyncio.Lock]]] = {}
         self._conn_tasks: set[asyncio.Task] = set()
-        self._send_tasks: set[asyncio.Task] = set()
+        # sock -> set[Task]: in-flight sends per connection, awaited before
+        # that connection's fd is closed (deterministic teardown — no
+        # sleep-based grace period).
+        self._send_tasks: dict[socket.socket, set[asyncio.Task]] = {}
 
     async def ensure_started(self, bind_host: str) -> tuple[str, int]:
         if self._listen_sock is None:
@@ -231,12 +269,15 @@ class BulkServer:
         from torchstore_tpu.runtime.auth import server_authenticate_sock
 
         if not await server_authenticate_sock(sock):
-            await _graceful_close(sock)
+            # No sends can be in flight yet and the auth recv just
+            # completed — immediate close is safe.
+            _close_sock(sock)
             return
         client_id = None
         conn_lock = asyncio.Lock()  # serializes all outgoing writes
         header = bytearray(_FRAME.size)
         header_view = memoryview(header)
+        sub = bytearray(_STRIPE.size)
         try:
             while True:
                 await _recv_exact(sock, header_view)
@@ -247,9 +288,12 @@ class BulkServer:
                     continue
                 if idx == IDX_SESSION_OPEN:
                     # Route this session's get payloads back on THIS exact
-                    # connection (a client may hold several), then ack so the
+                    # connection (a client may hold several; several for ONE
+                    # session means striped responses), then ack so the
                     # client knows routing is in place before it RPCs.
-                    self.session_conns[session] = (sock, conn_lock)
+                    conns = self.session_conns.setdefault(session, [])
+                    if all(c is not sock for c, _ in conns):
+                        conns.append((sock, conn_lock))
                     self._session_ts[session] = _now()
                     await _send_frame(sock, conn_lock, session, IDX_SESSION_OPEN, None)
                     continue
@@ -259,7 +303,33 @@ class BulkServer:
                         self._session_ts[session] = _now()
                         for key in [k for k in self.incoming if k[0] == session]:
                             del self.incoming[key]
+                        for key in [k for k in self._stripe_asm if k[0] == session]:
+                            del self._stripe_asm[key]
                         self._arrival.notify_all()
+                    continue
+                if idx == IDX_STRIPED:
+                    await _recv_exact(sock, memoryview(sub))
+                    real_idx, offset, total = _STRIPE.unpack(sub)
+                    chunk_len = nbytes - _STRIPE.size
+                    key = (session, real_idx)
+                    asm = self._stripe_asm.get(key)
+                    if asm is None:
+                        asm = self._stripe_asm[key] = [bytearray(total), 0]
+                    await _recv_exact(
+                        sock, memoryview(asm[0])[offset : offset + chunk_len]
+                    )
+                    asm[1] += chunk_len
+                    if asm[1] >= total:
+                        async with self._arrival:
+                            # pop, not del: an abort on another connection
+                            # may have purged this assembly mid-chunk.
+                            if self._stripe_asm.pop(key, None) is not None:
+                                self.incoming[key] = asm[0]
+                            self._session_ts[session] = _now()
+                            self._purge_stale()
+                            self._arrival.notify_all()
+                    else:
+                        self._session_ts[session] = _now()
                     continue
                 buf = bytearray(nbytes)
                 await _recv_exact(sock, memoryview(buf))
@@ -276,12 +346,21 @@ class BulkServer:
                 and self.client_conns.get(client_id, (None,))[0] is sock
             ):
                 self.client_conns.pop(client_id, None)
-            for sess in [
-                s for s, (c, _) in self.session_conns.items() if c is sock
-            ]:
-                self.session_conns.pop(sess, None)
-            # A send_background task may still be parked on this fd.
-            asyncio.ensure_future(_graceful_close(sock))
+            for sess, conns in list(self.session_conns.items()):
+                conns[:] = [(c, l) for c, l in conns if c is not sock]
+                if not conns:
+                    self.session_conns.pop(sess, None)
+            # Deterministic teardown: cancel + await this connection's
+            # in-flight sends, then close. The reader's own recv just
+            # returned, so after the sends are joined no loop.sock_* op can
+            # reference the fd.
+            for task in list(self._send_tasks.pop(sock, ())):
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            _close_sock(sock)
 
     def _purge_stale(self) -> None:
         """Drop per-session state older than SESSION_TTL_S (client crashed
@@ -295,6 +374,8 @@ class BulkServer:
             self.session_conns.pop(session, None)
             for key in [k for k in self.incoming if k[0] == session]:
                 del self.incoming[key]
+            for key in [k for k in self._stripe_asm if k[0] == session]:
+                del self._stripe_asm[key]
 
     async def collect(self, session: int, indices: list[int]) -> dict[int, bytearray]:
         """Await all payloads of a put session (bytes may arrive before or
@@ -319,39 +400,88 @@ class BulkServer:
         self, client_id: int, session: int, payloads: dict[int, np.ndarray]
     ) -> None:
         """Stream get payloads without blocking the RPC response (avoiding
-        the write-write deadlock for payloads larger than socket buffers)."""
-        conn = self.session_conns.pop(session, None) or self.client_conns.get(
-            client_id
-        )
-        if conn is None:
-            raise ConnectionError(
-                f"no bulk connection registered for client {client_id}"
-            )
-        sock, lock = conn
+        the write-write deadlock for payloads larger than socket buffers).
+        With several session connections, large payloads are STRIPED across
+        them (one in-flight chunk per connection — parallel TCP streams for
+        DCN throughput)."""
+        conns = self.session_conns.pop(session, None)
+        if not conns:
+            fallback = self.client_conns.get(client_id)
+            if fallback is None:
+                raise ConnectionError(
+                    f"no bulk connection registered for client {client_id}"
+                )
+            conns = [fallback]
 
-        async def _send() -> None:
+        def _track(sock: socket.socket, coro) -> asyncio.Task:
+            task = asyncio.ensure_future(coro)
+            bucket = self._send_tasks.setdefault(sock, set())
+            bucket.add(task)
+            task.add_done_callback(bucket.discard)
+            return task
+
+        async def _send_plain(sock, lock, frames: list[tuple[int, np.ndarray]]):
             try:
-                # Bounded: a peer that stops reading must not pin this task
-                # (and its payload memory) forever.
                 async with asyncio.timeout(SESSION_TTL_S):
-                    for idx, arr in payloads.items():
+                    for idx, arr in frames:
                         view = memoryview(np.ascontiguousarray(arr)).cast("B")
                         await _send_frame(sock, lock, session, idx, view)
             except TimeoutError:
                 # The cancelled sendall may have left a PARTIAL frame on the
                 # wire — the connection's framing is unrecoverable; kill it
-                # (the reader task then purges its registrations).
+                # (the reader task then joins sends and closes).
                 logger.warning(
                     "bulk get send timed out (session=%s); closing connection",
                     session,
                 )
-                await _graceful_close(sock)
+                _shutdown_sock(sock)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception("bulk get send failed (session=%s)", session)
 
-        task = asyncio.ensure_future(_send())
-        self._send_tasks.add(task)
-        task.add_done_callback(self._send_tasks.discard)
+        async def _send_stripes(sock, lock, idx, view, ranges, total):
+            try:
+                async with asyncio.timeout(SESSION_TTL_S):
+                    for off, end in ranges:
+                        sub = _STRIPE.pack(idx, off, total)
+                        async with lock:
+                            await _send_frame_raw(
+                                sock, session, IDX_STRIPED, sub, view[off:end]
+                            )
+            except TimeoutError:
+                logger.warning(
+                    "bulk striped send timed out (session=%s); closing",
+                    session,
+                )
+                _shutdown_sock(sock)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("bulk striped send failed (session=%s)", session)
+
+        plain: list[tuple[int, np.ndarray]] = []
+        for idx, arr in payloads.items():
+            nbytes = arr.nbytes
+            if len(conns) > 1 and nbytes > STRIPE_THRESHOLD:
+                view = memoryview(np.ascontiguousarray(arr)).cast("B")
+                n = len(conns)
+                chunk = -(-nbytes // n)
+                for k, (sock, lock) in enumerate(conns):
+                    ranges = [
+                        (off, min(off + chunk, nbytes))
+                        for off in range(k * chunk, nbytes, chunk * n)
+                    ]
+                    if ranges:
+                        _track(
+                            sock,
+                            _send_stripes(sock, lock, idx, view, ranges, nbytes),
+                        )
+            else:
+                plain.append((idx, arr))
+        if plain:
+            sock, lock = conns[0]
+            _track(sock, _send_plain(sock, lock, plain))
 
 
 class BulkServerCache(TransportCache):
@@ -367,33 +497,88 @@ class BulkServerCache(TransportCache):
 # --------------------------------------------------------------------------
 
 
+# Queue marker: the payload was received straight into the registered
+# destination view (no staging buffer to hand back).
+LANDED = object()
+
+
+class _SessionEntry:
+    """Per-get-session client state, SHARED by every connection carrying
+    the session (main + stripe connections land into the same
+    destinations/assembly buffers)."""
+
+    __slots__ = ("queue", "dests", "stripes")
+
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue()
+        # idx -> contiguous destination memoryview (recv lands in place —
+        # kernel -> destination, zero staging copies; VERDICT r1 item 3)
+        self.dests: dict[int, memoryview] = {}
+        # idx -> [target_view, received, total] while stripes arrive
+        self.stripes: dict[int, list] = {}
+
+
 class BulkClientConn:
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.write_lock = asyncio.Lock()
         self.closed = False
-        # session -> Queue[(idx, bytearray)] for demuxed get payloads
-        self.sessions: dict[int, asyncio.Queue] = {}
+        self.sessions: dict[int, _SessionEntry] = {}
         self._reader_task = asyncio.ensure_future(self._demux())
 
     async def _demux(self) -> None:
         header = bytearray(_FRAME.size)
         header_view = memoryview(header)
+        sub = bytearray(_STRIPE.size)
         try:
             while True:
                 await _recv_exact(self.sock, header_view)
                 session, idx, nbytes = _FRAME.unpack(header)
+                entry = self.sessions.get(session)
+                if idx == IDX_STRIPED:
+                    await _recv_exact(self.sock, memoryview(sub))
+                    real_idx, offset, total = _STRIPE.unpack(sub)
+                    chunk_len = nbytes - _STRIPE.size
+                    if entry is None:
+                        await _discard(self.sock, chunk_len)
+                        continue
+                    st = entry.stripes.get(real_idx)
+                    if st is None:
+                        dest = entry.dests.get(real_idx)
+                        if dest is not None and dest.nbytes == total:
+                            st = [dest, 0, total, True]
+                        else:
+                            st = [memoryview(bytearray(total)), 0, total, False]
+                        entry.stripes[real_idx] = st
+                    await _recv_exact(
+                        self.sock, st[0][offset : offset + chunk_len]
+                    )
+                    st[1] += chunk_len
+                    if st[1] >= total:
+                        del entry.stripes[real_idx]
+                        entry.queue.put_nowait(
+                            (real_idx, LANDED if st[3] else st[0].obj)
+                        )
+                    continue
+                if idx in _CONTROL_IDXS:
+                    if nbytes:
+                        await _discard(self.sock, nbytes)
+                    if entry is not None:
+                        entry.queue.put_nowait((idx, None))
+                    continue
+                dest = entry.dests.get(idx) if entry is not None else None
+                if dest is not None and dest.nbytes == nbytes:
+                    await _recv_exact(self.sock, dest)
+                    entry.queue.put_nowait((idx, LANDED))
+                    continue
                 buf = bytearray(nbytes)
                 if nbytes:
                     await _recv_exact(self.sock, memoryview(buf))
-                queue = self.sessions.get(session)
-                if queue is not None:
-                    queue.put_nowait(
-                        (idx, buf if idx not in _CONTROL_IDXS else None)
-                    )
+                if entry is not None:
+                    entry.queue.put_nowait((idx, buf))
         except (ConnectionError, OSError):
-            for queue in self.sessions.values():
-                queue.put_nowait((None, None))
+            for entry in self.sessions.values():
+                entry.queue.put_nowait((None, None))
         finally:
             # The recv op just completed/failed, so the fd is unregistered:
             # safe to close here (and only here) in the reader's own task.
@@ -403,10 +588,14 @@ class BulkClientConn:
             except OSError:
                 pass
 
-    def register_session(self, session: int) -> asyncio.Queue:
-        queue: asyncio.Queue = asyncio.Queue()
-        self.sessions[session] = queue
-        return queue
+    def register_session(self, session: int) -> _SessionEntry:
+        entry = _SessionEntry()
+        self.sessions[session] = entry
+        return entry
+
+    def adopt_session(self, session: int, entry: _SessionEntry) -> None:
+        """Carry an existing session on THIS connection too (striping)."""
+        self.sessions[session] = entry
 
     def release_session(self, session: int) -> None:
         self.sessions.pop(session, None)
@@ -447,11 +636,14 @@ async def _dial(host: str, port: int, timeout: float) -> socket.socket:
 
 class BulkClientCache(TransportCache):
     """Promoted, reusable per-volume connections (uniflow's connected-
-    transport bucket)."""
+    transport bucket), plus extra per-volume connections used to stripe
+    large transfers."""
 
     def __init__(self) -> None:
         self.client_id = _new_id()
         self.connections: dict[str, BulkClientConn] = {}
+        self.stripe_conns: dict[str, list[BulkClientConn]] = {}
+        self.endpoints: dict[str, tuple[str, int]] = {}
 
     def get_alive(self, volume_id: str) -> Optional[BulkClientConn]:
         conn = self.connections.get(volume_id)
@@ -460,10 +652,38 @@ class BulkClientCache(TransportCache):
             return None
         return conn
 
+    async def get_stripe_conns(
+        self, volume_id: str, n: int, timeout: float
+    ) -> list[BulkClientConn]:
+        """Up to ``n`` extra live connections for striping (dialed lazily,
+        reused forever). Returns [] when the endpoint is unknown."""
+        endpoint = self.endpoints.get(volume_id)
+        if endpoint is None:
+            return []
+        conns = [
+            c for c in self.stripe_conns.get(volume_id, []) if not c.closed
+        ]
+        self.stripe_conns[volume_id] = conns  # keep even on partial dials
+        try:
+            while len(conns) < n:
+                sock = await _dial(endpoint[0], endpoint[1], timeout)
+                conns.append(BulkClientConn(sock))
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            # Striping is an optimization: degrade to however many
+            # connections dialed (possibly none) instead of failing the
+            # transfer that the main connection can still carry.
+            pass
+        return conns
+
     def clear(self) -> None:
         for conn in self.connections.values():
             conn.close_now()
         self.connections.clear()
+        for conns in self.stripe_conns.values():
+            for conn in conns:
+                conn.close_now()
+        self.stripe_conns.clear()
+        self.endpoints.clear()
 
 
 class BulkTransportBuffer(TransportBuffer):
@@ -485,7 +705,8 @@ class BulkTransportBuffer(TransportBuffer):
         self._conn: Optional[BulkClientConn] = None
         self._promoted = False
         self._volume_id: Optional[str] = None
-        self._queue: Optional[asyncio.Queue] = None
+        self._entry: Optional[_SessionEntry] = None
+        self._session_carriers: list[BulkClientConn] = []
         self._sent_put = False
         self._succeeded = False
 
@@ -493,8 +714,8 @@ class BulkTransportBuffer(TransportBuffer):
         # config (a plain dataclass) travels with the buffer: the server-side
         # hooks read timeouts from it.
         state = self.__dict__.copy()
-        for field in ("_conn", "_queue"):
-            state[field] = None
+        for field in ("_conn", "_entry", "_session_carriers"):
+            state[field] = None if field != "_session_carriers" else []
         return state
 
     # ---- connection management ------------------------------------------
@@ -511,6 +732,7 @@ class BulkTransportBuffer(TransportBuffer):
         # Two-phase: RPC handshake learns the endpoint, then we dial it.
         endpoint = await volume.actor.handshake.call_one(self, [], "bulk_connect")
         host, port = endpoint
+        cache.endpoints[volume.volume_id] = (host, port)  # for stripe dials
         sock = await _dial(host, port, self.config.handshake_timeout)
         conn = BulkClientConn(sock)
         await _send_frame(sock, conn.write_lock, cache.client_id, IDX_HELLO, None)
@@ -539,26 +761,80 @@ class BulkTransportBuffer(TransportBuffer):
 
     async def get_from_storage_volume(self, volume, requests: list[Request]):
         await self._ensure_conn(volume)
-        self._queue = self._conn.register_session(self.session)
-        await _send_frame(
-            self._conn.sock, self._conn.write_lock, self.session, IDX_SESSION_OPEN, None
-        )
-        # Await the server's ack: the get RPC rides a different TCP stream,
-        # so without this the volume could serve the get before routing for
-        # this session exists (misdelivered or dropped payloads).
-        ack_idx, _ = await asyncio.wait_for(
-            self._queue.get(), timeout=self.config.handshake_timeout
-        )
-        if ack_idx != IDX_SESSION_OPEN:
-            raise ConnectionError(
-                f"bulk session-open handshake failed (got frame {ack_idx})"
-            )
         try:
-            return await super().get_from_storage_volume(volume, requests)
+            return await self._get_with_session(volume, requests)
         finally:
-            if self._conn is not None:
-                self._conn.release_session(self.session)
-            self._queue = None
+            # Release on EVERY exit path — including session-open/ack
+            # failures — or pooled connections accumulate dead session
+            # entries pinning destination views forever.
+            for carrier in self._session_carriers:
+                carrier.release_session(self.session)
+            self._session_carriers = []
+            self._entry = None
+
+    async def _get_with_session(self, volume, requests: list[Request]):
+        self._entry = self._conn.register_session(self.session)
+        self._session_carriers = [self._conn]
+        # In-place destinations land straight from the kernel: register
+        # contiguous destination views so the demux loop recv()s into them
+        # (no intermediate buffer + copy).
+        for idx, req in enumerate(requests):
+            dest = req.destination_view
+            if dest is None or not dest.flags["C_CONTIGUOUS"]:
+                continue
+            # Raw bytes land as-is: dtype AND shape must equal what the
+            # volume will serve (the slice's local shape for sub-slice
+            # requests, the stored shape otherwise) — a mismatch
+            # (dtype-converting get, or stale location metadata after a
+            # same-size re-publish) must take the copy-landing path, where
+            # fast_copy's shape guard raises and triggers the fresh-locate
+            # retry.
+            if req.tensor_meta is None or req.tensor_meta.np_dtype != dest.dtype:
+                continue
+            served_shape = (
+                req.tensor_slice.local_shape
+                if req.tensor_slice is not None
+                else req.tensor_meta.shape
+            )
+            if served_shape == tuple(dest.shape):
+                self._entry.dests[idx] = memoryview(dest).cast("B")
+        # Striping: when a single expected payload is large, carry this
+        # session over extra connections; the server stripes across them.
+        expect_large = any(
+            m.tensor_meta is not None
+            and m.tensor_meta.nbytes > STRIPE_THRESHOLD
+            for m in (r.meta_only() for r in requests)
+        )
+        if expect_large:
+            cache: BulkClientCache = volume.transport_context.get_cache(
+                BulkClientCache
+            )
+            for extra in await cache.get_stripe_conns(
+                volume.volume_id, STRIPE_CONNS - 1, self.config.handshake_timeout
+            ):
+                extra.adopt_session(self.session, self._entry)
+                self._session_carriers.append(extra)
+        acks_needed = len(self._session_carriers)
+        for carrier in self._session_carriers:
+            await _send_frame(
+                carrier.sock,
+                carrier.write_lock,
+                self.session,
+                IDX_SESSION_OPEN,
+                None,
+            )
+        # Await every carrier's ack: the get RPC rides a different TCP
+        # stream, so without this the volume could serve the get before
+        # routing for this session exists (misdelivered/dropped payloads).
+        for _ in range(acks_needed):
+            ack_idx, _ = await asyncio.wait_for(
+                self._entry.queue.get(), timeout=self.config.handshake_timeout
+            )
+            if ack_idx != IDX_SESSION_OPEN:
+                raise ConnectionError(
+                    f"bulk session-open handshake failed (got frame {ack_idx})"
+                )
+        return await super().get_from_storage_volume(volume, requests)
 
     async def _perform_handshake(self, volume, requests, op) -> None:
         # The real handshake (endpoint exchange + dial) happened in
@@ -569,6 +845,9 @@ class BulkTransportBuffer(TransportBuffer):
         regs: ArrayRegistrationCache = volume.transport_context.get_cache(
             ArrayRegistrationCache
         )
+        cache: BulkClientCache = volume.transport_context.get_cache(
+            BulkClientCache
+        )
         for idx, req in enumerate(requests):
             if req.is_object:
                 self.objects[idx] = req.objects
@@ -576,14 +855,52 @@ class BulkTransportBuffer(TransportBuffer):
             arr = np.ascontiguousarray(req.tensor_val)
             regs.register(arr)
             self.manifest[idx] = TensorMeta.of(arr)
+            view = memoryview(arr).cast("B")
+            if arr.nbytes > STRIPE_THRESHOLD:
+                extras = await cache.get_stripe_conns(
+                    volume.volume_id,
+                    STRIPE_CONNS - 1,
+                    self.config.handshake_timeout,
+                )
+                if extras:
+                    await self._send_striped(
+                        idx, view, [self._conn, *extras]
+                    )
+                    continue
             await _send_frame(
                 self._conn.sock,
                 self._conn.write_lock,
                 self.session,
                 idx,
-                memoryview(arr).cast("B"),
+                view,
             )
         self._sent_put = True
+
+    async def _send_striped(
+        self, idx: int, view: memoryview, conns: list[BulkClientConn]
+    ) -> None:
+        """Split one payload into contiguous chunks round-robined over the
+        connections; each chunk frame carries (idx, offset, total) so the
+        volume reassembles order-independently."""
+        total = view.nbytes
+        n = len(conns)
+        chunk = -(-total // n)
+
+        async def send_on(k: int, conn: BulkClientConn) -> None:
+            for off in range(k * chunk, total, chunk * n):
+                end = min(off + chunk, total)
+                async with conn.write_lock:
+                    await _send_frame_raw(
+                        conn.sock,
+                        self.session,
+                        IDX_STRIPED,
+                        _STRIPE.pack(idx, off, total),
+                        view[off:end],
+                    )
+
+        await asyncio.gather(
+            *(send_on(k, conn) for k, conn in enumerate(conns))
+        )
 
     # ---- server hooks ----------------------------------------------------
 
@@ -646,10 +963,10 @@ class BulkTransportBuffer(TransportBuffer):
             sum(m.nbytes for m in remote.descriptors.values()),
         )
         expected = set(remote.descriptors)
-        received: dict[int, bytearray] = {}
+        received: dict[int, Any] = {}
         while expected - set(received):
             idx, raw = await asyncio.wait_for(
-                self._queue.get(), timeout=frame_timeout
+                self._entry.queue.get(), timeout=frame_timeout
             )
             if idx is None:
                 raise ConnectionError("bulk connection lost during get")
@@ -660,8 +977,14 @@ class BulkTransportBuffer(TransportBuffer):
                 results.append(remote.objects[idx])
                 continue
             meta = remote.descriptors[idx]
-            arr = np.frombuffer(received[idx], dtype=meta.np_dtype).reshape(meta.shape)
+            raw = received[idx]
+            if raw is LANDED:
+                # Payload was recv()'d straight into the destination view.
+                results.append(req.destination_view)
+                continue
+            arr = np.frombuffer(raw, dtype=meta.np_dtype).reshape(meta.shape)
             if req.destination_view is not None:
+                # Fallback landing (non-contiguous dest or size mismatch).
                 fast_copy(req.destination_view, arr)
                 results.append(req.destination_view)
             else:

@@ -62,6 +62,12 @@ STAGED_TTL_S = 120.0  # staged-get segments a crashed client never unlinked
 RETIRED_TTL_S = 600.0  # viewed-then-replaced segments never released
 RESERVED_TTL_S = 60.0  # handshake offers whose put never arrived
 
+# Puts at or under this ride INLINE in the put RPC (pickle-5 out-of-band
+# frames) instead of negotiating a segment handshake first: one RPC instead
+# of two — the small-op fast path. The volume still lands them in (pooled)
+# segments, so zero-copy gets work identically.
+SMALL_INLINE_BYTES = 64 * 1024
+
 
 def is_available() -> bool:
     return os.path.isdir(SHM_DIR) and os.access(SHM_DIR, os.W_OK)
@@ -253,6 +259,11 @@ class ShmServerCache(TransportCache):
         self.pool_cap = default_config().shm_pool_max_bytes
         # pooled segments offered in a put handshake, awaiting the put RPC
         self.reserved: dict[str, tuple[ShmSegment, float]] = {}
+        # size -> number of background warm-up tasks in flight
+        self._warming: dict[int, int] = {}
+        # last time a client RPC touched this cache (warm-up tasks only
+        # burn CPU in idle windows, never against live traffic)
+        self.last_activity = 0.0
 
     def adopt_config(self, config: Optional[StoreConfig]) -> None:
         if config is not None:
@@ -331,6 +342,64 @@ class ShmServerCache(TransportCache):
                     self.free_bytes -= victim.size
                     victim.unlink()
                     break
+
+    def schedule_warm(self, sizes: list[int]) -> None:
+        """A put just allocated COLD segments (pool miss): pre-create and
+        prefault same-sized spares in the background, so the NEXT push of
+        this working set draws warm segments from the pool instead of
+        paying first-touch page faults (the cold-start cost an RL loop's
+        first weight sync pays; VERDICT r1 item 10)."""
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        wanted: dict[int, int] = {}
+        for size in sizes:
+            wanted[size] = wanted.get(size, 0) + 1
+        budget = self.pool_cap - self.free_bytes
+        for size, count in wanted.items():
+            have = len(self.free_by_size.get(size, ())) + self._warming.get(
+                size, 0
+            )
+            for _ in range(max(0, count - have)):
+                if budget < size:
+                    break
+                budget -= size
+                self._warming[size] = self._warming.get(size, 0) + 1
+                loop.create_task(self._warm_one(size))
+
+    async def _warm_one(self, size: int) -> None:
+        import asyncio
+
+        try:
+            seg = ShmSegment.create(size)
+            view = np.frombuffer(seg.mmap, dtype=np.uint8) if size else None
+            step = 1 << 20
+            off = 0
+            while off < size:
+                # Prefault only in LONG idle windows (>=1s since the last
+                # RPC): page-zeroing steals CPU from in-flight transfers
+                # (brutal on few-core hosts), and a volume-side gate cannot
+                # see the client's own copy work between RPCs — so only a
+                # clearly-idle store warms. An RL loop's multi-second
+                # training step provides exactly these gaps.
+                if time.monotonic() - self.last_activity < 1.0:
+                    await asyncio.sleep(0.25)
+                    continue
+                view[off : min(off + step, size) : 4096] = 0
+                off += step
+                await asyncio.sleep(0)
+            self._add_free(seg)
+        except OSError:
+            pass
+        finally:
+            left = self._warming.get(size, 1) - 1
+            if left > 0:
+                self._warming[size] = left
+            else:
+                self._warming.pop(size, None)
 
     def take_free(self, size: int) -> Optional[ShmSegment]:
         segs = self.free_by_size.get(size)
@@ -534,6 +603,9 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         self.config = config
         self.descriptors: dict[int, ShmDescriptor] = {}
         self.objects: dict[int, Any] = {}
+        # Small-put fast path: payload arrays riding the put RPC itself
+        # (zero-copy pickle-5 frames), landed server-side into segments.
+        self.inline: dict[int, np.ndarray] = {}
         # client -> server piggyback: sequenced view-release batches
         self.released: Optional[dict] = None
         # server -> client (via put_reply): adopted-segment renames
@@ -547,6 +619,24 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         return state
 
     # ---- client: put -----------------------------------------------------
+
+    async def put_to_storage_volume(self, volume, requests) -> None:
+        total = sum(r.nbytes for r in requests)
+        if 0 < total <= SMALL_INLINE_BYTES:
+            # One-RPC small put: skip the segment handshake entirely.
+            self.handshake_ops = ()
+        return await super().put_to_storage_volume(volume, requests)
+
+    async def _pre_put_hook(self, volume, requests) -> None:
+        if self.handshake_ops:
+            return  # handshake path already staged into segments
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        self.released = cache.collect_released(volume.volume_id)
+        for idx, req in enumerate(requests):
+            if req.is_object:
+                self.objects[idx] = req.objects
+            else:
+                self.inline[idx] = np.ascontiguousarray(req.tensor_val)
 
     def _pre_handshake(self, volume, requests, op) -> None:
         if op != "put":
@@ -585,9 +675,14 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             self._client_segments[idx] = seg
 
     def _handle_put_reply(self, volume, reply, requests) -> None:
+        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+        if self.released:
+            # Inline (no-handshake) puts deliver releases with the put RPC
+            # itself; the RPC succeeded, so ack the batches now.
+            cache.ack_released(volume.volume_id, self.released)
+            self.released = None
         if not reply:
             return
-        cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
         for old_name, new_name in reply.get("renames", {}).items():
             cache.rekey(old_name, new_name)
 
@@ -600,6 +695,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             return None
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
         cache.adopt_config(self.config)
+        cache.last_activity = time.monotonic()
         cache.apply_releases(self.released)
         cache.sweep()
         offered: dict[int, ShmDescriptor] = {}
@@ -629,10 +725,27 @@ class SharedMemoryTransportBuffer(TransportBuffer):
     ) -> dict[int, Any]:
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
         cache.adopt_config(self.config)
+        cache.last_activity = time.monotonic()
         cache.apply_releases(self.released)
         out: dict[int, Any] = {}
         for idx, obj in self.objects.items():
             out[idx] = obj
+        for idx, arr in self.inline.items():
+            # Small inline put: the VOLUME lands the payload into a (pooled)
+            # segment, so these entries get the same zero-copy get serving
+            # as handshake puts. Volume-created segments already carry the
+            # volume's pid — no rename round trip needed.
+            meta = metas[idx]
+            coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
+            tmeta = TensorMeta.of(arr)
+            seg = cache.take_free(max(arr.nbytes, 1))
+            if seg is None:
+                seg = ShmSegment.create(max(arr.nbytes, 1))
+            view = seg.view(tmeta)
+            np.copyto(view, arr)
+            cache.put(meta.key, coords, seg, tmeta)
+            out[idx] = view
+        cold_sizes: list[int] = []
         for idx, desc in self.descriptors.items():
             meta = metas[idx]
             coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
@@ -652,8 +765,13 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 old_name = seg.name
                 seg.rename_to_owner()
                 self.renames[old_name] = seg.name
+                cold_sizes.append(seg.size)
             cache.put(meta.key, coords, seg, desc.meta)
             out[idx] = seg.view(desc.meta, desc.offset)
+        if cold_sizes:
+            # Pool misses: warm same-sized spares in the background so the
+            # next push of this working set starts warm.
+            cache.schedule_warm(cold_sizes)
         return out
 
     def put_reply(self):
@@ -666,6 +784,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
     ) -> None:
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
         cache.adopt_config(self.config)
+        cache.last_activity = time.monotonic()
         cache.apply_releases(self.released)
         cache.sweep()
         for idx, (meta, entry) in enumerate(zip(metas, entries)):
@@ -779,6 +898,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         # the client cache and retransmit on the next RPC to that volume.
         self.descriptors = {}
         self.objects = {}
+        self.inline = {}
         self.released = None
         self.renames = {}
         self._client_segments = {}
