@@ -335,3 +335,36 @@ async def test_sharded_source_to_sharded_dest_e2e():
         np.testing.assert_array_equal(np.asarray(out["w"]), w)
     finally:
         await ts.shutdown("dws3")
+
+
+async def test_registered_staging_buffers_publish_in_place():
+    """ts.direct_staging_buffers: a trainer that adopts the registered
+    buffers makes later direct puts pure publishes — the refresh copy is
+    skipped (alias detection) yet pulls see the freshly written weights."""
+    await ts.initialize(store_name="stag")
+    try:
+        sd = {"layer": {"w": np.random.rand(512).astype(np.float32)}}
+        user = {"layer": {"w": np.zeros(512, np.float32)}}
+        await ts.put_state_dict("m", sd, direct=True, store_name="stag")
+        staging = ts.direct_staging_buffers("m", store_name="stag")
+        assert staging is not None
+        # Buffers already hold the registered values; no re-seeding needed.
+        np.testing.assert_array_equal(staging["layer"]["w"], sd["layer"]["w"])
+        # Trainer writes a new step's weights straight into the buffers.
+        staging["layer"]["w"][:] = 41.5
+        await ts.put_state_dict("m", staging, direct=True, store_name="stag")
+        out = await ts.get_state_dict(
+            "m", user_state_dict=user, direct=True, store_name="stag"
+        )
+        np.testing.assert_array_equal(out["layer"]["w"], np.full(512, 41.5))
+    finally:
+        await ts.shutdown("stag")
+
+
+async def test_staging_buffers_none_for_sharded_sources():
+    source = DirectWeightSyncSource(use_shm=False, device=False)
+    w = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    src = make_sharded(w, (4,), ("x",), P("x"))
+    await source.register({"w": src})
+    assert source.staging_state_dict() is None
+    await source.close()

@@ -19,6 +19,7 @@ from torchstore_tpu.api import (
     exists,
     get,
     get_batch,
+    direct_staging_buffers,
     get_state_dict,
     initialize,
     initialize_spmd,
@@ -72,6 +73,7 @@ __all__ = [
     "keys",
     "put",
     "put_batch",
+    "direct_staging_buffers",
     "put_state_dict",
     "reset_client",
     "shutdown",

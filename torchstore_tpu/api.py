@@ -264,6 +264,15 @@ async def put_state_dict(
     )
 
 
+def direct_staging_buffers(key: str, store_name: str = DEFAULT_STORE) -> Any:
+    """Registered staging buffers for a direct-pushed state dict (write
+    weights straight into them to make later direct puts copy-free); None
+    when unavailable. See state_dict_utils.direct_staging_buffers."""
+    from torchstore_tpu import state_dict_utils
+
+    return state_dict_utils.direct_staging_buffers(client(store_name), key)
+
+
 async def get_state_dict(
     key: str,
     user_state_dict: Any = None,
@@ -339,6 +348,7 @@ __all__ = [
     "keys",
     "put",
     "put_batch",
+    "direct_staging_buffers",
     "put_state_dict",
     "reset_client",
     "shutdown",

@@ -180,6 +180,8 @@ class DirectWeightSyncSource:
         self._transfer_dtype = None
         self._next_id = 0
         self._registered = False
+        self._mapping: Optional[dict] = None
+        self._flat_template: dict[str, Any] = {}
         # Device (ICI) mode state: ordered flat keys + current jax arrays.
         self.device_info: Optional[dict] = None
         self._device_keys: list[str] = []
@@ -212,7 +214,14 @@ class DirectWeightSyncSource:
 
         port = await self.server.ensure_started()
         self._transfer_dtype = transfer_dtype
-        flat, _ = flatten_state_dict(state_dict)
+        flat, mapping = flatten_state_dict(state_dict)
+        self._mapping = mapping
+        # Only NON-tensor leaves are kept (staging_state_dict fills tensor
+        # keys from the registered buffers); keeping tensor leaves would pin
+        # a full copy of the registration-time weights forever.
+        self._flat_template = {
+            k: v for k, v in flat.items() if not _is_tensor_leaf(v)
+        }
         # Advertise the same reachable name the actor runtime uses.
         hostname = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST", get_hostname())
         if self._device_mode_eligible(flat, rank, num_ranks):
@@ -372,10 +381,39 @@ class DirectWeightSyncSource:
                     and host_arr.dtype != np.dtype(self._transfer_dtype)
                 ):
                     host_arr = host_arr.astype(self._transfer_dtype)
-                np.copyto(
-                    self.server.buffers[handle.buffer_id],
-                    np.ascontiguousarray(host_arr),
-                )
+                staged = self.server.buffers[handle.buffer_id]
+                if _aliases(staged, host_arr):
+                    # Registered-buffer sources (staging_state_dict) write
+                    # weights straight into the published buffers — the
+                    # refresh copy vanishes, matching RDMA's register-once
+                    # read-live semantics.
+                    continue
+                np.copyto(staged, np.ascontiguousarray(host_arr))
+
+    def staging_state_dict(self) -> Optional[Any]:
+        """The registered staging buffers in the ORIGINAL state-dict
+        structure (host path, unsharded sources only). A trainer that
+        writes its weights directly into these arrays makes every
+        subsequent direct put a pure metadata publish — zero source-side
+        copies, the host analog of RDMA registered memory
+        (/root/reference/torchstore/direct_weight_sync.py:99-156 registers
+        buffers once; here the caller may adopt them as its own weight
+        storage). Returns None when any source is sharded/device-resident
+        (device sources already sync copy-free via the ICI path)."""
+        if (
+            not self._registered
+            or self.device_info is not None
+            or self._mapping is None
+        ):
+            return None
+        from torchstore_tpu.state_dict_utils import unflatten_state_dict
+
+        flat = dict(self._flat_template)  # non-tensor leaves as registered
+        for flat_key, handles in self.handles.items():
+            if len(handles) != 1 or not handles[0].tensor_slice.is_full():
+                return None
+            flat[flat_key] = self.server.buffers[handles[0].buffer_id]
+        return unflatten_state_dict(flat, self._mapping)
 
     def update_sources(self, state_dict: Any) -> None:
         """Point refresh() at new param objects (jax arrays are immutable, so
@@ -403,6 +441,22 @@ def _full_slice(shape) -> TensorSlice:
         coordinates=(),
         mesh_shape=(),
     )
+
+
+def _aliases(a: np.ndarray, b: np.ndarray) -> bool:
+    """Same memory AND same interpretation. Layout must match too: a
+    transposed/reinterpreted view of the staging buffer is a real publish
+    request (the transform must be materialized), not an alias to skip."""
+    try:
+        return (
+            a.__array_interface__["data"][0] == b.__array_interface__["data"][0]
+            and a.nbytes == b.nbytes
+            and a.shape == b.shape
+            and a.dtype == b.dtype
+            and a.strides == b.strides
+        )
+    except (AttributeError, TypeError):
+        return False
 
 
 def _is_floating(arr) -> bool:

@@ -338,6 +338,20 @@ async def put_state_dict(
     tracker.log_summary(level=20)  # INFO: weight-sync phases are user-facing
 
 
+def direct_staging_buffers(client, key: str) -> Any:
+    """After a direct push of ``key``: the registered staging buffers in the
+    original state-dict structure, or None when not applicable (sharded or
+    device sources). A trainer that adopts these arrays as its weight
+    storage makes every later direct put a pure metadata publish — zero
+    source-side copies (registered-memory semantics; the device/ICI path is
+    already copy-free)."""
+    cache = _direct_cache(client)
+    source = cache.sources.get(key)
+    if source is None:
+        return None
+    return source.staging_state_dict()
+
+
 async def get_state_dict(
     client,
     key: str,
