@@ -123,3 +123,36 @@ def test_tied_embeddings_fallback():
         params["params"]["lm_head"]["kernel"],
         params["params"]["embed"]["embedding"].T,
     )
+
+
+def test_qwen2_logits_parity():
+    """Qwen2-style checkpoints (attention biases on q/k/v) convert with
+    logits parity — widens the HF family beyond Llama/Mixtral."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        rope_theta=1e6,
+        attn_implementation="eager",
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    cfg = config_from_hf(model.config)
+    assert cfg.attention_bias
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    params = convert_hf_llama(model.state_dict(), cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    tokens = np.array([[3, 14, 15, 92, 65, 35, 89, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens).long()).logits.numpy()
+    ours = Llama(cfg).apply(params, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, atol=3e-4, rtol=3e-4)

@@ -179,3 +179,38 @@ class TestByteView:
         x = np.arange(16, dtype=np.float32).reshape(4, 4)
         with pytest.raises(ValueError):
             to_byte_view(x[:, 1:3])
+
+
+class TestTraceExport:
+    def test_chrome_trace_events_written(self, tmp_path):
+        import json
+
+        from torchstore_tpu import logging as tslog
+
+        trace_path = str(tmp_path / "trace.json")
+        old = tslog._trace.path
+        tslog._trace.path = trace_path
+        try:
+            tracker = tslog.LatencyTracker("unit_op")
+            tracker.track_step("phase_a", nbytes=1000)
+            tracker.track_step("phase_b")
+            tslog._trace.flush()
+        finally:
+            tslog._trace.path = old
+        with open(trace_path) as f:
+            content = f.read()
+        # JSON *array* trace format: the closing bracket is optional (the
+        # file remains loadable after a crash mid-run).
+        data = json.loads(
+            content if content.rstrip().endswith("]") else content + "\n]"
+        )
+        names = [e["name"] for e in data]
+        assert "unit_op/phase_a" in names and "unit_op/phase_b" in names
+        ev = next(e for e in data if e["name"] == "unit_op/phase_a")
+        assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["args"]["bytes"] == 1000
+
+    def test_disabled_is_noop(self):
+        from torchstore_tpu import logging as tslog
+
+        tracker = tslog.LatencyTracker("noop")
+        tracker.track_step("s")  # no env -> no events collected

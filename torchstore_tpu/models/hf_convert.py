@@ -35,15 +35,12 @@ def config_from_hf(hf_config) -> LlamaConfig:
             f"rope_scaling={scaling!r} is not implemented by models.llama.rope "
             "— converting this checkpoint would produce silently wrong logits"
         )
-    if getattr(hf_config, "attention_bias", False):
-        raise NotImplementedError(
-            "attention_bias=True checkpoints (Qwen2-style) are not "
-            "representable by this model family (attention is bias-free)"
-        )
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
     return LlamaConfig(
+        attention_bias=bool(getattr(hf_config, "attention_bias", False))
+        or hf_config.__class__.__name__.startswith("Qwen2"),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         intermediate_size=hf_config.intermediate_size,
@@ -108,6 +105,17 @@ def convert_hf_llama(
                 },
             },
         }
+        if cfg.attention_bias:
+            # Qwen2-style: q/k/v carry biases, o_proj does not.
+            layer["attn"]["q_proj"]["bias"] = w(
+                pre + "self_attn.q_proj.bias"
+            ).reshape(nh, hd)
+            layer["attn"]["k_proj"]["bias"] = w(
+                pre + "self_attn.k_proj.bias"
+            ).reshape(nkv, hd)
+            layer["attn"]["v_proj"]["bias"] = w(
+                pre + "self_attn.v_proj.bias"
+            ).reshape(nkv, hd)
         if cfg.num_experts:
             # Mixtral: per-expert w1/w3/w2 linears stack into our
             # (expert, in, out) kernels; the router gate transposes.

@@ -38,6 +38,8 @@ class LlamaConfig:
     # MoE (Mixtral-style): 0 experts = dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Qwen2-style: biases on the q/k/v projections only.
+    attention_bias: bool = False
     # Long-context attention: "dense" | "ring" | "ulysses". The sharded
     # impls engage when ``mesh`` has an sp axis of size > 1 (sequence
     # parallelism); otherwise dense is used.
@@ -64,6 +66,14 @@ class LlamaConfig:
             vocab_size=32000, hidden_size=4096, intermediate_size=14336,
             num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
             rope_theta=1e6, num_experts=8, num_experts_per_tok=2,
+        )
+
+    @classmethod
+    def qwen2_7b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+            rope_theta=1e6, rms_eps=1e-6, attention_bias=True,
         )
 
     @classmethod
@@ -127,11 +137,16 @@ class Attention(nn.Module):
         dense = lambda feats, name, axes: nn.DenseGeneral(  # noqa: E731
             feats,
             axis=-1,
-            use_bias=False,
+            # Qwen2-style checkpoints carry q/k/v biases (sharded over the
+            # same head axis as the kernel's output dims).
+            use_bias=cfg.attention_bias,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), axes
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), axes[1:]
             ),
             name=name,
         )
