@@ -194,3 +194,39 @@ async def test_partial_pull_with_strict_false(store):
             "big", user_state_dict={"typo": np.zeros(2)}, strict=False,
             store_name=store,
         )
+
+
+async def test_plain_shape_dtype_struct_targets():
+    """Sharding-less ShapeDtypeStructs are first-class fetch targets on both
+    the buffered and direct paths (default-placed device arrays out)."""
+    import jax
+    import jax.numpy as jnp
+
+    await ts.initialize(store_name="sds")
+    try:
+        sd = {"w": np.arange(32.0, dtype=np.float32)}
+        await ts.put_state_dict("m", sd, store_name="sds")
+        target = {"w": jax.ShapeDtypeStruct((32,), jnp.bfloat16)}
+        out = await ts.get_state_dict("m", user_state_dict=target, store_name="sds")
+        assert hasattr(out["w"], "sharding")  # a device array
+        assert out["w"].dtype == jnp.bfloat16  # spec dtype honored
+        np.testing.assert_allclose(
+            np.asarray(out["w"], dtype=np.float32), sd["w"], rtol=1e-2
+        )
+        # direct path (host sources -> host pull -> device placement)
+        await ts.put_state_dict("d", sd, direct=True, store_name="sds")
+        out2 = await ts.get_state_dict(
+            "d", user_state_dict={"w": jax.ShapeDtypeStruct((32,), jnp.float32)},
+            direct=True, store_name="sds",
+        )
+        assert hasattr(out2["w"], "sharding")
+        np.testing.assert_array_equal(np.asarray(out2["w"]), sd["w"])
+        # bare ts.get with a plain spec
+        await ts.put("solo", sd["w"], store_name="sds")
+        out3 = await ts.get(
+            "solo", like=jax.ShapeDtypeStruct((32,), jnp.float32), store_name="sds"
+        )
+        assert hasattr(out3, "sharding")
+        np.testing.assert_array_equal(np.asarray(out3), sd["w"])
+    finally:
+        await ts.shutdown("sds")

@@ -270,3 +270,12 @@ async def test_delete_prefix(store):
     assert await ts.keys("ckpt", store_name=store) == ["ckpt/v1/a", "ckpt/v1/b"]
     # Idempotent on an empty prefix.
     assert await ts.delete_prefix("ckpt/v0", store_name=store) == 0
+
+
+async def test_get_batch_accepts_key_list(store):
+    """Reference signature parity: get_batch takes a plain list of keys."""
+    a, b = np.arange(8.0), np.arange(4.0)
+    await ts.put_batch({"a": a, "b": b}, store_name=store)
+    out = await ts.get_batch(["a", "b"], store_name=store)
+    np.testing.assert_array_equal(out["a"], a)
+    np.testing.assert_array_equal(out["b"], b)

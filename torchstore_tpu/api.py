@@ -215,8 +215,9 @@ async def get(key: str, like: Any = None, store_name: str = DEFAULT_STORE) -> An
 
 
 async def get_batch(
-    items: dict[str, Any], store_name: str = DEFAULT_STORE
+    items, store_name: str = DEFAULT_STORE
 ) -> dict[str, Any]:
+    """Batched get: ``items`` is a list of keys or {key: target_or_None}."""
     return await client(store_name).get_batch(items)
 
 

@@ -165,9 +165,18 @@ class LocalClient:
         results = await self.get_batch({key: like})
         return results[key]
 
-    async def get_batch(self, items: dict[str, Any]) -> dict[str, Any]:
+    async def get_batch(self, items) -> dict[str, Any]:
         """All-or-nothing batched get (invariant 8): any missing key fails the
-        whole batch before data moves (locate happens up front)."""
+        whole batch before data moves (locate happens up front). ``items``
+        is either a list of keys or {key: fetch_target_or_None} (reference
+        signature parity, /root/reference/torchstore/api.py:242-279)."""
+        if isinstance(items, str):
+            raise TypeError(
+                "get_batch takes a list of keys or a {key: target} dict, "
+                f"not a bare string ({items!r}); use get() for one key"
+            )
+        if not isinstance(items, dict):
+            items = {key: None for key in items}
         await self._ensure_setup()
         plan: list[tuple[str, Request, Any]] = []  # (key, request, like)
         jax_targets: dict[int, list] = {}
@@ -192,6 +201,11 @@ class LocalClient:
                 sub_reqs = [Request.from_tensor_slice(key, ts) for _, ts in targets]
                 requests.extend(sub_reqs)
                 plan.append((key, sub_reqs, like))
+            elif shd.is_plain_spec(like):
+                # Sharding-less ShapeDtypeStruct: fetch the whole tensor and
+                # return a default-placed device array of the spec's dtype.
+                requests.append(Request.meta_request(key))
+                plan.append((key, requests[-1], like))
             elif isinstance(like, np.ndarray):
                 req = Request(key=key, tensor_val=like)
                 requests.append(req)
@@ -220,6 +234,16 @@ class LocalClient:
                         arr = arr.astype(want_dtype)
                     parts.append((dev, arr))
                 out[key] = shd.build_array(like, parts)
+            elif shd.is_plain_spec(like):
+                import jax.numpy as jnp
+
+                arr = np.asarray(by_request[id(req_or_list)])
+                if tuple(arr.shape) != tuple(like.shape):
+                    raise ValueError(
+                        f"stored shape {tuple(arr.shape)} != spec shape "
+                        f"{tuple(like.shape)} for key {key!r}"
+                    )
+                out[key] = jnp.asarray(arr, dtype=like.dtype)
             else:
                 out[key] = by_request[id(req_or_list)]
         return out

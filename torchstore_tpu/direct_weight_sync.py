@@ -848,6 +848,7 @@ def _is_tensor_like(value) -> bool:
         isinstance(value, (np.ndarray, Shard))
         or shd.is_jax_array(value)
         or shd.is_sharded_spec(value)
+        or shd.is_plain_spec(value)
     )
 
 
@@ -864,7 +865,17 @@ def _land_device(target, arr):
 
     from torchstore_tpu.client import Shard as _Shard
 
-    if shd.is_jax_array(target) or shd.is_sharded_spec(target):
+    if (
+        shd.is_jax_array(target)
+        or shd.is_sharded_spec(target)
+        or shd.is_plain_spec(target)
+    ):
+        if tuple(arr.shape) != tuple(target.shape):
+            raise ValueError(
+                f"pulled shape {tuple(arr.shape)} != target shape "
+                f"{tuple(target.shape)} (source re-published under a "
+                "different shape?)"
+            )
         want_dtype = getattr(target, "dtype", None)
         if want_dtype is not None and arr.dtype != want_dtype:
             arr = arr.astype(want_dtype)
@@ -905,6 +916,7 @@ def _target_slices(value) -> list[TensorSlice]:
         return [value.tensor_slice]
     if shd.is_jax_array(value) or shd.is_sharded_spec(value):
         return [ts for _, ts in shd.target_slices(value)]
+    # numpy arrays and sharding-less ShapeDtypeStructs: one full slice.
     return [_full_slice(value.shape)]
 
 
@@ -920,6 +932,11 @@ def _rebuild(target, parts: list[tuple[TensorSlice, np.ndarray]]):
     if shd.is_jax_array(target) or shd.is_sharded_spec(target):
         devs = [dev for dev, _ in shd.target_slices(target)]
         return shd.build_array(target, [(d, arr) for d, (_, arr) in zip(devs, parts)])
+    if shd.is_plain_spec(target):
+        import jax.numpy as jnp
+
+        ((_, arr),) = parts
+        return jnp.asarray(arr, dtype=target.dtype)
     # numpy target: single full slice, filled in place.
     ((_, arr),) = parts
     np.copyto(target, arr)

@@ -44,6 +44,7 @@ class _TraceCollector:
         self._lock = threading.Lock()
         self._registered = False
         self._resolved_path: Optional[str] = None
+        self._resolved_for: Optional[str] = None
         self._wrote_header = False
 
     @property
@@ -77,14 +78,29 @@ class _TraceCollector:
                 self._flush_locked()
 
     def _resolve_path(self) -> str:
-        if self._resolved_path is None:
-            path = self.path
-            if os.path.exists(path):
-                # One file per process: volume actors and the client all
-                # trace; suffix with the pid instead of clobbering.
-                root, ext = os.path.splitext(path)
-                path = f"{root}.{os.getpid()}{ext or '.json'}"
-            self._resolved_path = path
+        # Re-resolve if the target changed (tests swap it) — and CLAIM the
+        # file with O_EXCL: volume actors and the client all trace, and two
+        # processes exists()-checking concurrently would interleave appends
+        # into one corrupt file. The loser takes a pid-suffixed name.
+        if self._resolved_path is None or self._resolved_for != self.path:
+            base = self.path
+            root, ext = os.path.splitext(base)
+            pid_path = f"{root}.{os.getpid()}{ext or '.json'}"
+            chosen = pid_path
+            for cand in (base, pid_path):
+                try:
+                    os.close(
+                        os.open(cand, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+                    )
+                    chosen = cand
+                    break
+                except FileExistsError:
+                    continue
+                except OSError:
+                    break
+            self._resolved_path = chosen
+            self._resolved_for = self.path
+            self._wrote_header = False
         return self._resolved_path
 
     def _flush_locked(self) -> None:

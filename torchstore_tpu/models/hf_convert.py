@@ -35,12 +35,20 @@ def config_from_hf(hf_config) -> LlamaConfig:
             f"rope_scaling={scaling!r} is not implemented by models.llama.rope "
             "— converting this checkpoint would produce silently wrong logits"
         )
+    if getattr(hf_config, "use_sliding_window", False):
+        raise NotImplementedError(
+            "use_sliding_window=True checkpoints are not representable "
+            "(attention here is full-causal) — converting would produce "
+            "silently wrong logits beyond the window"
+        )
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
     return LlamaConfig(
+        # Qwen2Config (exactly — Qwen2Moe etc. have different structure and
+        # fail the unmapped-tensor check) carries q/k/v biases implicitly.
         attention_bias=bool(getattr(hf_config, "attention_bias", False))
-        or hf_config.__class__.__name__.startswith("Qwen2"),
+        or hf_config.__class__.__name__ == "Qwen2Config",
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         intermediate_size=hf_config.intermediate_size,
@@ -106,6 +114,13 @@ def convert_hf_llama(
             },
         }
         if cfg.attention_bias:
+            if pre + "self_attn.o_proj.bias" in sd:
+                # transformers-Llama applies attention_bias to o_proj too;
+                # this model family (like Qwen2) has a bias-free o_proj.
+                raise NotImplementedError(
+                    "checkpoint carries an o_proj bias; only q/k/v biases "
+                    "(Qwen2-style) are representable"
+                )
             # Qwen2-style: q/k/v carry biases, o_proj does not.
             layer["attn"]["q_proj"]["bias"] = w(
                 pre + "self_attn.q_proj.bias"

@@ -40,6 +40,19 @@ def is_sharded_spec(value: Any) -> bool:
     )
 
 
+def is_plain_spec(value: Any) -> bool:
+    """A jax.ShapeDtypeStruct WITHOUT a sharding: fetch target producing a
+    default-placed device array of the spec's shape/dtype."""
+    try:
+        import jax
+    except ImportError:
+        return False
+    return (
+        isinstance(value, jax.ShapeDtypeStruct)
+        and getattr(value, "sharding", None) is None
+    )
+
+
 def _mesh_coords_map(mesh) -> dict:
     """device -> coordinates in the mesh array."""
     coords = {}

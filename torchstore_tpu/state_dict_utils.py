@@ -456,4 +456,5 @@ def _is_fetch_target(value: Any) -> bool:
         isinstance(value, np.ndarray)
         or shd.is_jax_array(value)
         or shd.is_sharded_spec(value)
+        or shd.is_plain_spec(value)
     )
