@@ -30,6 +30,21 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _default_shm_pool_cap() -> int:
+    """Quarter of /dev/shm's AVAILABLE space at startup, clamped to
+    [4 GB, 64 GB]. Available (not total) leaves room for live + retired
+    segments and other tenants; the 64 GB ceiling bounds how many written
+    tmpfs pages recycled segments may pin on huge hosts. Model-scale syncs
+    (16 GB for Llama-3-8B bf16) need the pool to hold roughly one working
+    set or puts fall back to cold tmpfs allocation."""
+    try:
+        stat = os.statvfs("/dev/shm")
+        avail = stat.f_frsize * stat.f_bavail
+    except OSError:
+        return 4 << 30
+    return max(4 << 30, min(avail // 4, 64 << 30))
+
+
 @dataclass
 class StoreConfig:
     """All tunables for one store instance. Field defaults come from env vars
@@ -55,10 +70,13 @@ class StoreConfig:
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_ZERO_COPY_GET", True)
     )
     # Cap on the volume-side pool of recycled SHM segments (bytes). Released
-    # segments beyond the cap are unlinked oldest-first.
+    # segments beyond the cap are unlinked oldest-first. Default: half of
+    # /dev/shm's capacity — the steady-state rotation needs ~2x the live
+    # working set pooled, and a model-scale sync (16 GB for Llama-3-8B
+    # bf16) collapses to cold tmpfs allocation if the pool can't hold it.
     shm_pool_max_bytes: int = field(
         default_factory=lambda: _env_int(
-            "TORCHSTORE_TPU_SHM_POOL_MAX_BYTES", 4 << 30
+            "TORCHSTORE_TPU_SHM_POOL_MAX_BYTES", _default_shm_pool_cap()
         )
     )
     # Use the native C++ data-path library when built.

@@ -22,6 +22,13 @@ uuid serves exactly ONE ``pull``):
 Because staging happens per pull request, a dest always receives the
 source's CURRENT weights with zero host copies on either side.
 
+Scope: single-controller sources (one process owning the source mesh —
+the standard JAX setup for a pod slice). Sharding descriptors reconstruct
+by GLOBAL device id, so source and dest must share a jax world
+(jax.distributed) or have coinciding device ids (same-topology slices).
+Multi-controller SPMD sources (per-rank processes) fall back to the host
+path, which handles arbitrary cross-rank reshards.
+
 Shardings cannot be pickled across processes (they hold live Device
 objects); ``ShardingDescriptor`` round-trips NamedSharding /
 SingleDeviceSharding by mesh shape + axis names + device ids, reconstructed
