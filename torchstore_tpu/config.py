@@ -70,10 +70,11 @@ class StoreConfig:
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_ZERO_COPY_GET", True)
     )
     # Cap on the volume-side pool of recycled SHM segments (bytes). Released
-    # segments beyond the cap are unlinked oldest-first. Default: half of
-    # /dev/shm's capacity — the steady-state rotation needs ~2x the live
-    # working set pooled, and a model-scale sync (16 GB for Llama-3-8B
-    # bf16) collapses to cold tmpfs allocation if the pool can't hold it.
+    # segments beyond the cap are unlinked oldest-first. Default: a quarter
+    # of /dev/shm's AVAILABLE space at startup, clamped to [4 GB, 64 GB]
+    # (see _default_shm_pool_cap). Size it to hold at least one working set
+    # — a model-scale sync (16 GB for Llama-3-8B bf16) collapses to cold
+    # tmpfs allocation if the pool can't retain it.
     shm_pool_max_bytes: int = field(
         default_factory=lambda: _env_int(
             "TORCHSTORE_TPU_SHM_POOL_MAX_BYTES", _default_shm_pool_cap()

@@ -157,6 +157,22 @@ def _rebuild_remote_error(msg: dict) -> Exception:
     return remote
 
 
+# Actors HOSTED IN THIS PROCESS, keyed exactly as their published ActorRefs
+# (host, port, name): endpoint calls on such refs bypass the RPC stack
+# entirely — direct async method invocation, zero serialization (the
+# colocated-volume fast path; remote processes still reach the same actor
+# over its real server).
+_inproc_actors: dict[tuple[str, int, str], Actor] = {}
+
+
+def register_inproc(host: str, port: int, name: str, actor: Actor) -> None:
+    _inproc_actors[(host, port, name)] = actor
+
+
+def unregister_inproc(host: str, port: int, name: str) -> None:
+    _inproc_actors.pop((host, port, name), None)
+
+
 # Pools are per (event loop, address): tests run many asyncio.run loops;
 # entries of closed loops are pruned so they never accumulate.
 _conn_pools: dict[
@@ -233,6 +249,15 @@ class ActorEndpointRef:
         return default_config().rpc_timeout
 
     async def call_one(self, *args, **kwargs) -> Any:
+        inproc = _inproc_actors.get(
+            (self._ref.host, self._ref.port, self._ref.name)
+        )
+        if inproc is not None:
+            # Same-process actor: direct invocation, no serialization. Note
+            # that arguments pass BY REFERENCE — transports relying on this
+            # path must copy data they store (the SHM transport does: puts
+            # land in segments, never keep caller arrays).
+            return await getattr(inproc, self._method)(*args, **kwargs)
         try:
             conn = await get_connection(self._ref.host, self._ref.port)
         except OSError as exc:
@@ -488,6 +513,9 @@ class ActorServer:
 
     async def serve_until_stopped(self) -> None:
         await self.stop_event.wait()
+        await self.close()
+
+    async def close(self) -> None:
         if self._server is not None:
             self._server.close()
         # Drop live client connections: py3.12's Server.wait_closed() waits

@@ -35,6 +35,18 @@ class StorageVolumeRef:
     def is_same_host(self) -> bool:
         return self.hostname == get_hostname()
 
+    def is_inproc(self) -> bool:
+        """True when the volume actor lives in THIS process (colocated
+        mode): endpoint calls are direct method invocations — transports
+        must copy stored/served arrays since nothing is serialized."""
+        from torchstore_tpu.runtime.actors import _inproc_actors
+
+        return (
+            self.actor.host,
+            self.actor.port,
+            self.actor.name,
+        ) in _inproc_actors
+
 
 class StoreStrategy(ABC):
     """Base strategy. ``default_transport_type`` forces one transport for

@@ -693,8 +693,14 @@ class BulkTransportBuffer(TransportBuffer):
     supports_batch_puts = True
     supports_batch_gets = True
 
-    def __init__(self, config: Optional[StoreConfig] = None):
+    def __init__(
+        self, config: Optional[StoreConfig] = None, inproc_copy: bool = False
+    ):
         self.config = config or default_config()
+        # Colocated dispatch: object payloads ride the buffer by reference;
+        # deep-copy on store/serve preserves value semantics (tensor bytes
+        # always cross the socket and are safe).
+        self.inproc_copy = inproc_copy
         self.session = _new_id()
         self.client_id: Optional[int] = None
         # RPC-carried metadata
@@ -915,6 +921,10 @@ class BulkTransportBuffer(TransportBuffer):
         self, ctx: TransportContext, metas: list[Request], existing: dict
     ) -> dict[int, Any]:
         server: BulkServer = ctx.get_cache(BulkServerCache).server
+        if self.inproc_copy and self.objects:
+            import copy
+
+            self.objects = {k: copy.deepcopy(v) for k, v in self.objects.items()}
         out: dict[int, Any] = dict(self.objects)
         from torchstore_tpu.transport.buffers import transfer_timeout
 
@@ -943,6 +953,10 @@ class BulkTransportBuffer(TransportBuffer):
         payloads: dict[int, np.ndarray] = {}
         for idx, (meta, entry) in enumerate(zip(metas, entries)):
             if meta.is_object:
+                if self.inproc_copy:
+                    import copy
+
+                    entry = copy.deepcopy(entry)
                 self.objects[idx] = entry
                 continue
             arr = np.ascontiguousarray(entry)

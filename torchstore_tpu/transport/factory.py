@@ -100,17 +100,21 @@ def create_transport_buffer(
                 SharedMemoryTransportBuffer,
             )
 
-            return SharedMemoryTransportBuffer(config)
+            return SharedMemoryTransportBuffer(
+                config, inproc_copy=volume.is_inproc()
+            )
         if chosen == TransportType.BULK:
             from torchstore_tpu.transport.bulk import BulkTransportBuffer
 
-            return BulkTransportBuffer(config)
+            return BulkTransportBuffer(
+                config, inproc_copy=volume.is_inproc()
+            )
     except ImportError as exc:
         raise RuntimeError(
             f"transport {chosen.value!r} was forced but is not available "
             f"in this build: {exc}"
         ) from exc
-    return RPCTransportBuffer()
+    return RPCTransportBuffer(inproc_copy=volume.is_inproc())
 
 
 def _auto_select(volume: "StorageVolumeRef", config: StoreConfig) -> TransportType:

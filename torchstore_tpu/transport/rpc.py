@@ -28,11 +28,15 @@ class RPCTransportBuffer(TransportBuffer):
     supports_batch_puts = True
     supports_batch_gets = True
 
-    def __init__(self) -> None:
+    def __init__(self, inproc_copy: bool = False) -> None:
         # index -> payload. On put: filled client-side (pre_put) and read
         # server-side. On get: filled server-side and read client-side.
         self.tensors: dict[int, np.ndarray] = {}
         self.objects: dict[int, Any] = {}
+        # Colocated volumes dispatch endpoints WITHOUT serialization, so the
+        # "remote" side would receive the caller's arrays by reference;
+        # explicit copies restore the value semantics pickling provides.
+        self.inproc_copy = inproc_copy
 
     # ---- client ----------------------------------------------------------
 
@@ -71,6 +75,10 @@ class RPCTransportBuffer(TransportBuffer):
     ) -> dict[int, np.ndarray]:
         out: dict[int, Any] = {}
         for idx, obj in self.objects.items():
+            if self.inproc_copy:
+                import copy
+
+                obj = copy.deepcopy(obj)
             out[idx] = obj
         for idx in self.tensors:
             arr = self.tensors[idx]
@@ -85,7 +93,7 @@ class RPCTransportBuffer(TransportBuffer):
                 fast_copy(prev, arr)
                 out[idx] = prev
             else:
-                out[idx] = arr
+                out[idx] = arr.copy() if self.inproc_copy else arr
         return out
 
     def handle_get_request(
@@ -93,6 +101,12 @@ class RPCTransportBuffer(TransportBuffer):
     ) -> None:
         for idx, (meta, entry) in enumerate(zip(metas, entries)):
             if meta.is_object:
+                if self.inproc_copy:
+                    import copy
+
+                    entry = copy.deepcopy(entry)
                 self.objects[idx] = entry
+            elif self.inproc_copy:
+                self.tensors[idx] = np.array(entry)  # never hand out storage
             else:
                 self.tensors[idx] = np.ascontiguousarray(entry)

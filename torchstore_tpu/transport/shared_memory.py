@@ -102,6 +102,13 @@ def reap_orphaned_segments() -> int:
     return reaped
 
 
+def _copy_obj(obj: Any) -> Any:
+    """Value-semantics copy for object payloads on in-process dispatch."""
+    import copy
+
+    return copy.deepcopy(obj)
+
+
 def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
@@ -261,6 +268,11 @@ class ShmServerCache(TransportCache):
         self.reserved: dict[str, tuple[ShmSegment, float]] = {}
         # size -> number of background warm-up tasks in flight
         self._warming: dict[int, int] = {}
+        # segments being prefaulted (not yet pooled): clear() must unlink
+        # these too, or an interrupted warm-up leaks the file for the
+        # process lifetime (colocated volumes never exit to be reaped)
+        self._warm_inflight: set[ShmSegment] = set()
+        self._closed = False
         # last time a client RPC touched this cache (warm-up tasks only
         # burn CPU in idle windows, never against live traffic)
         self.last_activity = 0.0
@@ -373,12 +385,17 @@ class ShmServerCache(TransportCache):
     async def _warm_one(self, size: int) -> None:
         import asyncio
 
+        seg = None
         try:
             seg = ShmSegment.create(size)
+            self._warm_inflight.add(seg)
             view = np.frombuffer(seg.mmap, dtype=np.uint8) if size else None
             step = 1 << 20
             off = 0
             while off < size:
+                if self._closed:
+                    seg.unlink()
+                    return
                 # Prefault only in LONG idle windows (>=1s since the last
                 # RPC): page-zeroing steals CPU from in-flight transfers
                 # (brutal on few-core hosts), and a volume-side gate cannot
@@ -391,10 +408,15 @@ class ShmServerCache(TransportCache):
                 view[off : min(off + step, size) : 4096] = 0
                 off += step
                 await asyncio.sleep(0)
-            self._add_free(seg)
+            if self._closed:
+                seg.unlink()
+            else:
+                self._add_free(seg)
         except OSError:
             pass
         finally:
+            if seg is not None:
+                self._warm_inflight.discard(seg)
             left = self._warming.get(size, 1) - 1
             if left > 0:
                 self._warming[size] = left
@@ -473,6 +495,10 @@ class ShmServerCache(TransportCache):
         for seg, _ in self.reserved.values():
             seg.unlink()
         self.reserved.clear()
+        self._closed = True  # interrupt in-flight warm-ups
+        for seg in list(self._warm_inflight):
+            seg.unlink()
+        self._warm_inflight.clear()
         self.grants.clear()
 
 
@@ -597,10 +623,17 @@ class SharedMemoryTransportBuffer(TransportBuffer):
     supports_batch_puts = True
     supports_batch_gets = True
 
-    def __init__(self, config: Optional[StoreConfig] = None):
+    def __init__(
+        self, config: Optional[StoreConfig] = None, inproc_copy: bool = False
+    ):
         # config TRAVELS with the buffer (like the bulk transport's) so the
         # volume side honors programmatic initialize(config=...) overrides.
         self.config = config
+        # Colocated volumes dispatch endpoints without serialization:
+        # OBJECT payloads would be stored/served by reference (tensors are
+        # safe — they always live in segments). Deep-copy restores the
+        # value semantics pickling provides.
+        self.inproc_copy = inproc_copy
         self.descriptors: dict[int, ShmDescriptor] = {}
         self.objects: dict[int, Any] = {}
         # Small-put fast path: payload arrays riding the put RPC itself
@@ -729,7 +762,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache.apply_releases(self.released)
         out: dict[int, Any] = {}
         for idx, obj in self.objects.items():
-            out[idx] = obj
+            out[idx] = _copy_obj(obj) if self.inproc_copy else obj
         for idx, arr in self.inline.items():
             # Small inline put: the VOLUME lands the payload into a (pooled)
             # segment, so these entries get the same zero-copy get serving
@@ -789,7 +822,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache.sweep()
         for idx, (meta, entry) in enumerate(zip(metas, entries)):
             if meta.is_object:
-                self.objects[idx] = entry
+                self.objects[idx] = _copy_obj(entry) if self.inproc_copy else entry
                 continue
             entry = np.asarray(entry)
             desc = self._serve_descriptor(cache, meta, entry)
