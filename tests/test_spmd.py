@@ -175,7 +175,12 @@ async def test_rendezvous_kv():
 
 
 def _spmd_worker(
-    rank: int, world: int, port: int, result_dir: str, local_world: int = 0
+    rank: int,
+    world: int,
+    port: int,
+    result_dir: str,
+    local_world: int = 0,
+    secret: "str | None" = None,
 ) -> None:
     local_world = local_world or world
     env = {
@@ -190,6 +195,8 @@ def _spmd_worker(
         # Emulated multi-host on one machine: volumes bind 0.0.0.0; the
         # advertised address must still be reachable.
         env["TORCHSTORE_TPU_ADVERTISE_HOST"] = "127.0.0.1"
+    if secret:
+        env["TORCHSTORE_TPU_AUTH_SECRET"] = secret
     os.environ.update(env)
     result = {"rank": rank, "ok": False}
     try:
@@ -226,18 +233,25 @@ async def _spmd_scenario(rank: int, world: int, result: dict) -> None:
 
 
 @pytest.mark.parametrize(
-    "world,local_world",
-    [(2, 2), (4, 4), (4, 2)],
-    ids=["1host-2rank", "1host-4rank", "2hosts-2ranks"],
+    "world,local_world,secret",
+    [
+        (2, 2, None),
+        (4, 4, None),
+        (4, 2, None),
+        # Multi-host WITH connection auth: every listener (rendezvous,
+        # actors, bulk) requires the HMAC challenge end to end.
+        (4, 2, "spmd-secret"),
+    ],
+    ids=["1host-2rank", "1host-4rank", "2hosts-2ranks", "2hosts-auth"],
 )
-def test_spmd_full_lifecycle(tmp_path, world, local_world):
+def test_spmd_full_lifecycle(tmp_path, world, local_world, secret):
     port = get_free_port()
     ctx = mp.get_context("spawn")
     # Not daemonic: workers spawn their own volume actor children.
     procs = [
         ctx.Process(
             target=_spmd_worker,
-            args=(r, world, port, str(tmp_path), local_world),
+            args=(r, world, port, str(tmp_path), local_world, secret),
             daemon=False,
         )
         for r in range(world)
