@@ -1,0 +1,242 @@
+"""torch.Tensor interop: reference users hold torch state dicts everywhere
+(/root/reference/torchstore APIs take/return torch.Tensor); this build must
+accept them transparently with zero-copy views and in-place get semantics.
+Covers put/get round trips, bf16 reinterpretation, in-place targets
+returning the caller's tensor objects, state-dict sync (buffered + direct),
+transfer_dtype casting, and sharded Shard data."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import ml_dtypes  # noqa: E402
+
+import torchstore_tpu as ts  # noqa: E402
+from torchstore_tpu import torch_interop  # noqa: E402
+from torchstore_tpu.client import Shard  # noqa: E402
+from torchstore_tpu.transport.types import TensorSlice  # noqa: E402
+
+
+class TestViews:
+    def test_zero_copy_fp32(self):
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        view = torch_interop.to_numpy_view(t)
+        assert view.dtype == np.float32
+        view[0, 0] = 42.0
+        assert t[0, 0].item() == 42.0  # shared memory
+
+    def test_bf16_reinterpret(self):
+        t = torch.tensor([1.5, -2.25, 3.0], dtype=torch.bfloat16)
+        view = torch_interop.to_numpy_view(t)
+        assert view.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            view.astype(np.float32), np.array([1.5, -2.25, 3.0], np.float32)
+        )
+        # Shared memory: writes through the view surface in the tensor.
+        view[1] = ml_dtypes.bfloat16(7.0)
+        assert t[1].item() == 7.0
+
+    def test_noncontiguous_strided_view_shares_memory(self):
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4).t()
+        view = torch_interop.to_numpy_view(t)
+        view[0, 0] = -1.0
+        assert t[0, 0].item() == -1.0
+
+    def test_noncontiguous_bf16_inplace_target_rejected(self):
+        t = torch.zeros(3, 4, dtype=torch.bfloat16).t()
+        with pytest.raises(TypeError, match="contiguous"):
+            torch_interop.to_numpy_view(t, allow_copy=False)
+
+    def test_requires_grad_detached(self):
+        t = torch.ones(3, requires_grad=True)
+        view = torch_interop.to_numpy_view(t)
+        np.testing.assert_array_equal(view, np.ones(3, np.float32))
+
+    def test_convert_tree_identity_without_torch_leaves(self):
+        sd = {"a": np.ones(2), "b": [1, 2]}
+        assert torch_interop.convert_tree(sd) is sd
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="tint")
+    yield "tint"
+    await ts.shutdown("tint")
+
+
+async def test_put_get_roundtrip(store):
+    t = torch.randn(64, 32)
+    await ts.put("w", t, store_name=store)
+    out = await ts.get("w", store_name=store)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, t.numpy())
+
+
+async def test_put_bf16_roundtrip(store):
+    t = torch.randn(16, 8).to(torch.bfloat16)
+    await ts.put("wb", t, store_name=store)
+    out = await ts.get("wb", store_name=store)
+    assert out.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        out.astype(np.float32),
+        t.float().numpy(),
+    )
+
+
+async def test_inplace_get_returns_same_tensor(store):
+    src = torch.randn(8, 8)
+    await ts.put("x", src, store_name=store)
+    dest = torch.zeros(8, 8)
+    out = await ts.get("x", like=dest, store_name=store)
+    assert out is dest  # caller's tensor object, filled in place
+    torch.testing.assert_close(dest, src)
+
+
+async def test_shard_put_and_sliced_get(store):
+    full = torch.arange(16, dtype=torch.float32).reshape(4, 4)
+    for row in range(2):
+        sl = TensorSlice(
+            offsets=(row * 2, 0),
+            local_shape=(2, 4),
+            global_shape=(4, 4),
+            coordinates=(row,),
+            mesh_shape=(2,),
+        )
+        await ts.put("sh", Shard(full[row * 2 : row * 2 + 2], sl), store_name=store)
+    out = await ts.get("sh", store_name=store)
+    np.testing.assert_array_equal(out, full.numpy())
+    # In-place sliced get into a torch buffer.
+    dest = torch.zeros(2, 4)
+    want = TensorSlice(
+        offsets=(1, 0),
+        local_shape=(2, 4),
+        global_shape=(4, 4),
+        coordinates=(0,),
+        mesh_shape=(1,),
+    )
+    got = await ts.get("sh", like=Shard(dest, want), store_name=store)
+    assert got is dest
+    torch.testing.assert_close(dest, full[1:3])
+
+
+async def test_state_dict_roundtrip(store):
+    sd = {
+        "model": {"w": torch.randn(32, 16), "b": torch.zeros(16)},
+        "step": 3,
+    }
+    await ts.put_state_dict("ckpt", sd, store_name=store)
+    out = await ts.get_state_dict("ckpt", store_name=store)
+    np.testing.assert_array_equal(out["model"]["w"], sd["model"]["w"].numpy())
+    assert out["step"] == 3
+
+
+async def test_state_dict_inplace_user_dict(store):
+    sd = {"w": torch.randn(16, 16), "b": torch.randn(16)}
+    await ts.put_state_dict("m", sd, store_name=store)
+    user = {"w": torch.zeros(16, 16), "b": torch.zeros(16)}
+    out = await ts.get_state_dict("m", user_state_dict=user, store_name=store)
+    # The user's tensor objects come back, filled.
+    assert out["w"] is user["w"] and out["b"] is user["b"]
+    torch.testing.assert_close(user["w"], sd["w"])
+    torch.testing.assert_close(user["b"], sd["b"])
+
+
+async def test_state_dict_transfer_dtype(store):
+    sd = {"w": torch.ones(8, dtype=torch.float32), "n": torch.arange(4)}
+    await ts.put_state_dict(
+        "cast", sd, transfer_dtype=ml_dtypes.bfloat16, store_name=store
+    )
+    out = await ts.get_state_dict("cast", store_name=store)
+    assert out["w"].dtype == ml_dtypes.bfloat16
+    assert out["n"].dtype == np.int64  # non-floating leaves uncast
+
+
+async def test_direct_sync_torch_leaves(store):
+    sd = {"w": torch.randn(64, 64), "b": torch.randn(64)}
+    await ts.put_state_dict("dsync", sd, direct=True, store_name=store)
+    user = {"w": torch.zeros(64, 64), "b": torch.zeros(64)}
+    out = await ts.get_state_dict(
+        "dsync", user_state_dict=user, direct=True, store_name=store
+    )
+    assert out["w"] is user["w"]
+    torch.testing.assert_close(user["w"], sd["w"])
+    torch.testing.assert_close(user["b"], sd["b"])
+    # Refresh: trainer mutates weights in place, republish, re-pull.
+    with torch.no_grad():
+        sd["w"].add_(1.0)
+    await ts.put_state_dict("dsync", sd, direct=True, store_name=store)
+    out = await ts.get_state_dict(
+        "dsync", user_state_dict=user, direct=True, store_name=store
+    )
+    torch.testing.assert_close(user["w"], sd["w"])
+
+
+async def test_direct_get_noncontiguous_bf16_target_rejected(store):
+    # A non-contiguous bf16 in-place target cannot be viewed zero-copy; the
+    # direct path must refuse loudly rather than fill a silent copy.
+    sd = {"w": torch.randn(8, 8).to(torch.bfloat16)}
+    await ts.put_state_dict("ncbf", sd, direct=True, store_name=store)
+    user = {"w": torch.zeros(8, 8, dtype=torch.bfloat16).t()}
+    with pytest.raises(TypeError, match="contiguous"):
+        await ts.get_state_dict(
+            "ncbf", user_state_dict=user, direct=True, store_name=store
+        )
+
+
+async def test_direct_shard_torch_targets(store):
+    # Shard(torch_tensor, slice) leaves must work on the direct path too
+    # (MIGRATION.md promises Shard.data takes torch tensors everywhere).
+    sd = {"w": torch.randn(8, 4)}
+    await ts.put_state_dict("dshard", sd, direct=True, store_name=store)
+    dest = torch.zeros(8, 4)
+    sl = TensorSlice(
+        offsets=(0, 0),
+        local_shape=(8, 4),
+        global_shape=(8, 4),
+        coordinates=(0,),
+        mesh_shape=(1,),
+    )
+    user = {"w": Shard(dest, sl)}
+    out = await ts.get_state_dict(
+        "dshard", user_state_dict=user, direct=True, store_name=store, strict=False
+    )
+    assert out["w"] is user["w"]  # the caller's Shard, its tensor filled
+    torch.testing.assert_close(dest, sd["w"])
+
+
+async def test_object_key_with_torch_target_returns_object(store):
+    # A key stored as a plain object must come back as the object, never as
+    # a silently unfilled tensor (parity with numpy like targets).
+    await ts.put("obj", {"a": 1}, store_name=store)
+    out = await ts.get("obj", like=torch.zeros(3), store_name=store)
+    assert out == {"a": 1}
+
+
+async def test_inplace_get_noncontiguous_fp32_target(store):
+    # Non-bf16 strided tensors view zero-copy; in-place get works.
+    src = torch.randn(4, 6)
+    await ts.put("strided", src, store_name=store)
+    dest = torch.zeros(6, 4).t()  # non-contiguous (4, 6) view
+    out = await ts.get("strided", like=dest, store_name=store)
+    assert out is dest
+    torch.testing.assert_close(dest, src)
+
+
+async def test_optimizer_style_nested_dict(store):
+    # Mirrors reference test_state_dict model+optimizer round trips.
+    sd = {
+        "model": {"layers": [torch.randn(4, 4) for _ in range(3)]},
+        "optim": {
+            "state": {0: {"exp_avg": torch.randn(4, 4), "step": torch.tensor(9)}},
+            "param_groups": [{"lr": 0.1}],
+        },
+    }
+    await ts.put_state_dict("full", sd, store_name=store)
+    out = await ts.get_state_dict("full", store_name=store)
+    np.testing.assert_array_equal(
+        out["model"]["layers"][1], sd["model"]["layers"][1].numpy()
+    )
+    np.testing.assert_array_equal(
+        out["optim"]["state"][0]["exp_avg"], sd["optim"]["state"][0]["exp_avg"].numpy()
+    )
+    assert out["optim"]["param_groups"][0]["lr"] == 0.1

@@ -16,6 +16,7 @@ from typing import Any, Optional
 import numpy as np
 
 from torchstore_tpu import sharding as shd
+from torchstore_tpu import torch_interop
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
@@ -108,11 +109,19 @@ class LocalClient:
     @staticmethod
     def _value_to_requests(key: str, value: Any) -> list[Request]:
         if isinstance(value, Shard):
-            return [Request.from_tensor_slice(key, value.tensor_slice, value.data)]
+            data = value.data
+            if torch_interop.is_torch_tensor(data):
+                data = torch_interop.to_numpy_view(data)
+            return [Request.from_tensor_slice(key, value.tensor_slice, data)]
         if shd.is_jax_array(value):
             return shd.put_requests(key, value)
         if isinstance(value, np.ndarray):
             return [Request.from_tensor(key, value)]
+        if torch_interop.is_torch_tensor(value):
+            # Zero-copy view: the transport reads straight out of the torch
+            # storage (migration parity — reference callers hold torch
+            # tensors everywhere).
+            return [Request.from_tensor(key, torch_interop.to_numpy_view(value))]
         if isinstance(value, (int, float, complex)) or np.isscalar(value):
             return [Request.from_objects(key, value)]
         if hasattr(value, "__array_interface__"):
@@ -180,12 +189,24 @@ class LocalClient:
         await self._ensure_setup()
         plan: list[tuple[str, Request, Any]] = []  # (key, request, like)
         jax_targets: dict[int, list] = {}
+        # plan index -> (original torch tensor, its numpy view): the original
+        # is handed back only when the fetch actually landed in the view.
+        torch_returns: dict[int, tuple[Any, np.ndarray]] = {}
         requests: list[Request] = []
         for key, like in items.items():
+            if torch_interop.is_torch_tensor(like):
+                view = torch_interop.to_numpy_view(like, allow_copy=False)
+                torch_returns[len(plan)] = (like, view)
+                like = view
             if like is None:
                 requests.append(Request.meta_request(key))
                 plan.append((key, requests[-1], None))
             elif isinstance(like, Shard):
+                data = like.data
+                if torch_interop.is_torch_tensor(data):
+                    view = torch_interop.to_numpy_view(data, allow_copy=False)
+                    torch_returns[len(plan)] = (data, view)
+                    like = Shard(data=view, tensor_slice=like.tensor_slice)
                 req = Request.from_tensor_slice(key, like.tensor_slice)
                 req.tensor_val = like.data
                 requests.append(req)
@@ -246,6 +267,14 @@ class LocalClient:
                 out[key] = jnp.asarray(arr, dtype=like.dtype)
             else:
                 out[key] = by_request[id(req_or_list)]
+            if idx in torch_returns:
+                tensor, view = torch_returns[idx]
+                # Hand the caller their tensor object back ONLY if the fetch
+                # landed in its storage (assemble returns the dest view). A
+                # key stored as a plain object comes back as that object —
+                # never a silently unfilled tensor.
+                if out[key] is view:
+                    out[key] = tensor
         return out
 
     # ------------------------------------------------------------------

@@ -20,6 +20,7 @@ from typing import Any
 import numpy as np
 
 from torchstore_tpu import sharding as shd
+from torchstore_tpu import torch_interop
 from torchstore_tpu.logging import LatencyTracker, get_logger
 
 logger = get_logger("torchstore_tpu.state_dict")
@@ -159,10 +160,12 @@ def cast_floating_tensors(flat: dict[str, Any], transfer_dtype) -> dict[str, Any
     on-device (one fused XLA op per leaf); numpy casts on host."""
     out = {}
     for key, value in flat.items():
-        if _is_floating(value):
-            out[key] = value.astype(transfer_dtype)
-        else:
+        if not _is_floating(value):
             out[key] = value
+        elif torch_interop.is_torch_tensor(value):
+            out[key] = torch_interop.astype_numpy(value, transfer_dtype)
+        else:
+            out[key] = value.astype(transfer_dtype)
     return out
 
 
@@ -220,6 +223,9 @@ async def _put_state_dict_direct(
 ) -> None:
     from torchstore_tpu.direct_weight_sync import DirectWeightSyncSource
 
+    # torch-tensor leaves become zero-copy numpy views, so registration and
+    # every later refresh read straight out of the trainer's torch storage.
+    state_dict = torch_interop.convert_tree(state_dict)
     cache = _direct_cache(client)
     source = cache.sources.get(key)
     if source is None:
@@ -368,7 +374,14 @@ async def get_state_dict(
         # The direct path naturally pulls exactly the user dict's keys
         # (handles are matched per key), i.e. subset pulls just work —
         # strict=True additionally verifies full coverage below.
-        result = await _get_state_dict_direct(client, key, user_state_dict)
+        # allow_copy=False: an in-place target whose numpy view would need a
+        # copy must fail loudly, not silently fill the copy.
+        converted = torch_interop.convert_tree(user_state_dict, allow_copy=False)
+        result = await _get_state_dict_direct(client, key, converted)
+        if converted is not user_state_dict:
+            result = torch_interop.restore_torch_results(
+                user_state_dict, converted, result
+            )
         if strict:
             cache = _direct_cache(client)
             entry = cache.dests.get(key)
@@ -454,6 +467,7 @@ def _leaf_keys(mapping: dict) -> set[str]:
 def _is_fetch_target(value: Any) -> bool:
     return (
         isinstance(value, np.ndarray)
+        or torch_interop.is_torch_tensor(value)
         or shd.is_jax_array(value)
         or shd.is_sharded_spec(value)
         or shd.is_plain_spec(value)

@@ -1,0 +1,164 @@
+"""Transparent torch.Tensor interop.
+
+Reference users hold ``torch.Tensor`` state dicts (every API in
+/root/reference/torchstore takes/returns them). This build's data plane is
+numpy/jax, but a migrating user should not have to hand-convert: any CPU
+torch tensor is accepted wherever an array is (put/put_batch/put_state_dict
+leaves, get ``like=`` targets, ``user_state_dict`` leaves, direct-sync
+sources/destinations) and conversion is ZERO-COPY — the numpy view shares
+the tensor's memory, so in-place gets land bytes directly in the caller's
+torch storage and the original tensor objects are returned.
+
+torch is never imported by this module: if the user has not imported torch,
+no value can be a torch tensor and every check short-circuits via
+``sys.modules``. bfloat16 (no numpy native dtype) round-trips through a
+uint16 view reinterpreted as ``ml_dtypes.bfloat16``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+import numpy as np
+
+
+def is_torch_tensor(value: Any) -> bool:
+    torch = sys.modules.get("torch")
+    return torch is not None and isinstance(value, torch.Tensor)
+
+
+def to_numpy_view(tensor: Any, allow_copy: bool = True) -> np.ndarray:
+    """Zero-copy numpy view of a CPU torch tensor (shares memory; writes to
+    the view are visible through the tensor). Raises for non-CPU tensors —
+    this image's torch is CPU-only, and device arrays belong on the jax
+    path. Non-contiguous tensors stay zero-copy (strided view); autograd
+    leaves are detached (the store moves bytes, not graphs).
+
+    ``allow_copy=False`` (in-place get targets): raises instead of falling
+    back to a copy in the one case a copy is unavoidable (non-contiguous
+    bfloat16, whose uint16 reinterpretation needs a contiguous layout) —
+    a silent copy there would fill the copy, not the caller's tensor."""
+    import torch
+
+    if tensor.device.type != "cpu":
+        raise TypeError(
+            f"torch tensor on device {tensor.device} is not supported; "
+            "move it to CPU (.cpu()) or use a jax.Array for device-resident "
+            "values"
+        )
+    t = tensor.detach()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        if not t.is_contiguous():
+            if not allow_copy:
+                raise TypeError(
+                    "non-contiguous bfloat16 torch tensors cannot be viewed "
+                    "zero-copy; pass a .contiguous() tensor as the in-place "
+                    "target"
+                )
+            t = t.contiguous()
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def astype_numpy(tensor: Any, dtype: Any) -> np.ndarray:
+    """Cast a torch tensor to a numpy array of ``dtype`` (always copies —
+    used by transfer_dtype casting where a copy is inherent)."""
+    return to_numpy_view(tensor).astype(dtype)
+
+
+def _shard_cls():
+    # Lazy: client.py imports this module at load time; the reverse import
+    # must happen at call time.
+    from torchstore_tpu.client import Shard
+
+    return Shard
+
+
+def convert_tree(value: Any, allow_copy: bool = True) -> Any:
+    """Recursively replace torch-tensor leaves (bare or inside ``Shard``)
+    with zero-copy numpy views (dict/list/tuple/NamedTuple containers
+    preserved; everything else untouched). Returns the input object itself
+    when no torch leaf exists, so non-torch callers pay one isinstance walk
+    and zero allocation. ``allow_copy=False`` for in-place get targets: a
+    leaf whose view would require a copy (non-contiguous bf16) raises
+    instead of silently filling the copy."""
+    if not has_torch_leaves(value):
+        return value
+    return _convert_rec(value, allow_copy)
+
+
+def _convert_rec(value: Any, allow_copy: bool) -> Any:
+    if is_torch_tensor(value):
+        return to_numpy_view(value, allow_copy)
+    Shard = _shard_cls()
+    if isinstance(value, Shard) and is_torch_tensor(value.data):
+        return Shard(
+            data=to_numpy_view(value.data, allow_copy),
+            tensor_slice=value.tensor_slice,
+        )
+    if isinstance(value, dict):
+        return {k: _convert_rec(v, allow_copy) for k, v in value.items()}
+    if isinstance(value, tuple) and hasattr(value, "_fields"):
+        return type(value)(*(_convert_rec(v, allow_copy) for v in value))
+    if isinstance(value, (list, tuple)):
+        converted = [_convert_rec(v, allow_copy) for v in value]
+        return converted if isinstance(value, list) else tuple(converted)
+    return value
+
+
+def restore_torch_results(original: Any, converted: Any, result: Any) -> Any:
+    """After a pull into ``converted`` (the numpy-view image of ``original``
+    produced by :func:`convert_tree`): make every torch leaf of ``original``
+    hold the pulled bytes and return ``original``'s structure with the torch
+    tensors back in leaf position. A pull that landed in the shared view
+    needs nothing; one that produced a fresh array (non-contiguous target,
+    assembled region) is copied into the view — which IS the tensor's
+    storage. ``result`` must be structure-congruent with ``converted`` (it
+    is: both come from the same flatten mapping)."""
+    if is_torch_tensor(original):
+        if result is not converted:
+            np.copyto(converted, result)
+        return original
+    Shard = _shard_cls()
+    if isinstance(original, Shard) and is_torch_tensor(original.data):
+        res_data = result.data if isinstance(result, Shard) else result
+        if res_data is not converted.data and isinstance(res_data, np.ndarray):
+            np.copyto(converted.data, res_data)
+        return original
+    if isinstance(original, dict):
+        return {
+            k: restore_torch_results(original[k], converted[k], result[k])
+            for k in original
+        }
+    if isinstance(original, (list, tuple)):
+        out = [
+            restore_torch_results(o, c, r)
+            for o, c, r in zip(original, converted, result)
+        ]
+        if isinstance(original, tuple):
+            if hasattr(original, "_fields"):
+                return type(original)(*out)
+            return tuple(out)
+        return out
+    return result
+
+
+def has_torch_leaves(value: Any) -> bool:
+    if sys.modules.get("torch") is None:
+        return False
+    return _has_torch_rec(value)
+
+
+def _has_torch_rec(value: Any) -> bool:
+    if is_torch_tensor(value):
+        return True
+    if isinstance(value, _shard_cls()):
+        return is_torch_tensor(value.data)
+    if isinstance(value, dict):
+        return any(_has_torch_rec(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return any(_has_torch_rec(v) for v in value)
+    return False
