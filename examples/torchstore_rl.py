@@ -3,7 +3,10 @@ generator actors pull them (resharded) and run inference.
 
 Equivalent of the reference's example/torchstore_rl.py, TPU-first: the
 learner trains fsdp-sharded on its mesh, generators pull tensor-parallel on
-theirs — the store reshards automatically. Run:
+theirs — the store reshards automatically. Publishing rides the versioned
+weight channel (WeightPublisher/WeightSubscriber): the learner publishes,
+generators BLOCK until a newer version commits (no version bookkeeping, no
+polling), and old versions are garbage-collected automatically. Run:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/torchstore_rl.py
 """
@@ -45,18 +48,18 @@ class Learner(Actor):
         self.opt_state = self.optimizer.init(self.params)
         self.step_fn = parallel.make_train_step(self.model, self.optimizer)
         self.vocab = cfg.vocab_size
+        self.publisher = ts.WeightPublisher("policy", store_name=STORE)
 
     @endpoint
-    async def train_and_publish(self, version: int) -> float:
+    async def train_and_publish(self, step: int) -> float:
         jax = self.jax
         tokens = jax.random.randint(
-            jax.random.key(version), (4, 16), 0, self.vocab
+            jax.random.key(step), (4, 16), 0, self.vocab
         )
         self.params, self.opt_state, loss = self.step_fn(
             self.params, self.opt_state, tokens
         )
-        await ts.put_state_dict(f"policy/v{version}", {"params": self.params},
-                                store_name=STORE)
+        await self.publisher.publish({"params": self.params})
         return float(loss)
 
 
@@ -74,14 +77,16 @@ class Generator(Actor):
         self.mesh = parallel.make_mesh({"tp": 8})
         boxed = self.model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
         self.template = parallel.unbox(parallel.shard_params(boxed, self.mesh))
+        self.subscriber = ts.WeightSubscriber("policy", store_name=STORE)
 
     @endpoint
-    async def sync_and_generate(self, version: int) -> list[int]:
+    async def sync_and_generate(self) -> list[int]:
         import jax.numpy as jnp
 
-        synced = await ts.get_state_dict(
-            f"policy/v{version}", user_state_dict={"params": self.template},
-            store_name=STORE,
+        # Blocks until a version NEWER than the last acquired one commits;
+        # the fsdp-sharded push reshards into this mesh's tp layout on pull.
+        synced, _version = await self.subscriber.acquire(
+            user_state_dict={"params": self.template}, timeout=60.0
         )
         self.template = synced["params"]
         prompt = jnp.zeros((1, 4), jnp.int32)
@@ -94,10 +99,10 @@ async def main():
     learner = await spawn_actors(1, Learner, "learner")
     generators = await spawn_actors(2, Generator, "generator")
     try:
-        for version in range(STEPS):
-            loss = await learner.train_and_publish.call_one(version)
-            outs = await generators.sync_and_generate.call(version)
-            print(f"step {version}: loss={loss:.4f} generator_tokens={outs}")
+        for step in range(STEPS):
+            loss = await learner.train_and_publish.call_one(step)
+            outs = await generators.sync_and_generate.call()
+            print(f"step {step}: loss={loss:.4f} generator_tokens={outs}")
             assert outs[0] == outs[1], "generators must agree after sync"
     finally:
         await generators.stop()

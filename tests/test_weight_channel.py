@@ -1,0 +1,236 @@
+"""Blocking waits + versioned weight channel.
+
+The reference's consumers poll get_state_dict in try/except loops
+(reference example/torchstore_rl.py); this build replaces the poll with
+controller-pushed wakeups (`ts.wait_for`, `wait_for_change`) and packages
+the RL publish/consume pattern as WeightPublisher/WeightSubscriber with
+bounded-memory version GC."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.client import Shard
+from torchstore_tpu.transport.types import TensorSlice
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="wc")
+    yield "wc"
+    await ts.shutdown("wc")
+
+
+class TestWaitFor:
+    async def test_returns_when_key_lands(self, store):
+        async def delayed_put():
+            await asyncio.sleep(0.15)
+            await ts.put("late", np.ones(4), store_name=store)
+
+        task = asyncio.create_task(delayed_put())
+        await ts.wait_for("late", timeout=10.0, store_name=store)
+        assert await ts.exists("late", store_name=store)
+        await task
+
+    async def test_already_committed_returns_immediately(self, store):
+        await ts.put("now", np.ones(2), store_name=store)
+        await asyncio.wait_for(
+            ts.wait_for("now", timeout=5.0, store_name=store), timeout=1.0
+        )
+
+    async def test_timeout_names_missing_keys(self, store):
+        with pytest.raises(TimeoutError, match="never-written"):
+            await ts.wait_for("never-written", timeout=0.2, store_name=store)
+
+    async def test_partial_commit_keeps_blocking(self, store):
+        # One of two mesh coordinates landed: the key is partial and
+        # wait_for must NOT wake for it.
+        sl = TensorSlice(
+            offsets=(0,),
+            local_shape=(2,),
+            global_shape=(4,),
+            coordinates=(0,),
+            mesh_shape=(2,),
+        )
+        await ts.put("part", Shard(np.ones(2, np.float32), sl), store_name=store)
+        with pytest.raises(TimeoutError):
+            await ts.wait_for("part", timeout=0.3, store_name=store)
+        # Landing the second shard completes the commit and wakes the wait.
+        sl2 = TensorSlice(
+            offsets=(2,),
+            local_shape=(2,),
+            global_shape=(4,),
+            coordinates=(1,),
+            mesh_shape=(2,),
+        )
+
+        async def finish():
+            await asyncio.sleep(0.1)
+            await ts.put(
+                "part", Shard(np.ones(2, np.float32), sl2), store_name=store
+            )
+
+        task = asyncio.create_task(finish())
+        await ts.wait_for("part", timeout=10.0, store_name=store)
+        await task
+
+    async def test_multiple_keys(self, store):
+        async def puts():
+            await asyncio.sleep(0.05)
+            await ts.put("k1", np.ones(1), store_name=store)
+            await asyncio.sleep(0.05)
+            await ts.put("k2", np.ones(1), store_name=store)
+
+        task = asyncio.create_task(puts())
+        await ts.wait_for(["k1", "k2"], timeout=10.0, store_name=store)
+        await task
+
+
+class TestWeightChannel:
+    async def test_publish_acquire_sequence(self, store):
+        pub = ts.WeightPublisher("policy", store_name=store)
+        sub = ts.WeightSubscriber("policy", store_name=store)
+        v0 = await pub.publish({"w": np.full(8, 0.0, np.float32)})
+        assert v0 == 0
+        sd, v = await sub.acquire(timeout=10.0)
+        assert v == 0
+        np.testing.assert_array_equal(sd["w"], np.zeros(8, np.float32))
+        # Next acquire blocks until a NEWER version publishes.
+        async def later():
+            await asyncio.sleep(0.1)
+            await pub.publish({"w": np.full(8, 1.0, np.float32)})
+
+        task = asyncio.create_task(later())
+        sd, v = await sub.acquire(timeout=10.0)
+        assert v == 1
+        np.testing.assert_array_equal(sd["w"], np.ones(8, np.float32))
+        await task
+
+    async def test_acquire_timeout_when_no_new_version(self, store):
+        pub = ts.WeightPublisher("p2", store_name=store)
+        sub = ts.WeightSubscriber("p2", store_name=store)
+        await pub.publish({"w": np.ones(2)})
+        await sub.acquire(timeout=5.0)
+        with pytest.raises(TimeoutError):
+            await sub.acquire(timeout=0.25)
+
+    async def test_gc_keeps_last_n_versions(self, store):
+        pub = ts.WeightPublisher("p3", store_name=store, keep=2)
+        for i in range(4):
+            await pub.publish({"w": np.full(4, float(i))})
+        keys = await ts.keys("p3", store_name=store)
+        assert not any(k.startswith("p3/v0/") for k in keys)
+        assert not any(k.startswith("p3/v1/") for k in keys)
+        assert any(k.startswith("p3/v2/") for k in keys)
+        assert any(k.startswith("p3/v3/") for k in keys)
+
+    async def test_publisher_resumes_numbering(self, store):
+        pub = ts.WeightPublisher("p4", store_name=store)
+        await pub.publish({"w": np.ones(2)})
+        await pub.publish({"w": np.ones(2)})
+        # A restarted publisher (fresh object) continues after LATEST.
+        pub2 = ts.WeightPublisher("p4", store_name=store)
+        v = await pub2.publish({"w": np.ones(2)})
+        assert v == 2
+
+    async def test_subscriber_skips_to_newest(self, store):
+        pub = ts.WeightPublisher("p5", store_name=store)
+        for i in range(3):
+            await pub.publish({"w": np.full(2, float(i))})
+        sub = ts.WeightSubscriber("p5", store_name=store)
+        sd, v = await sub.acquire(timeout=5.0)
+        assert v == 2  # latest, not v0
+        np.testing.assert_array_equal(sd["w"], np.full(2, 2.0))
+
+    async def test_inplace_acquire(self, store):
+        pub = ts.WeightPublisher("p6", store_name=store)
+        src = {"w": np.arange(16, dtype=np.float32).reshape(4, 4)}
+        await pub.publish(src)
+        sub = ts.WeightSubscriber("p6", store_name=store)
+        user = {"w": np.zeros((4, 4), np.float32)}
+        sd, v = await sub.acquire(user_state_dict=user, timeout=5.0)
+        np.testing.assert_array_equal(user["w"], src["w"])
+
+    async def test_concurrent_producer_consumer_loop(self, store):
+        """The RL steady state: trainer publishes steps, generator acquires
+        every version in order (keep large enough to never lag out)."""
+        pub = ts.WeightPublisher("loop", store_name=store, keep=8)
+        sub = ts.WeightSubscriber("loop", store_name=store)
+        seen: list[int] = []
+
+        async def producer():
+            for i in range(5):
+                await pub.publish({"w": np.full(4, float(i))})
+                await asyncio.sleep(0.02)
+
+        async def consumer():
+            while len(seen) == 0 or seen[-1] < 4:
+                sd, v = await sub.acquire(timeout=10.0)
+                assert sd["w"][0] == float(v)
+                seen.append(v)
+
+        await asyncio.gather(producer(), consumer())
+        assert seen[-1] == 4
+        assert seen == sorted(seen)  # versions arrive in order
+
+    async def test_gc_reclaims_orphans(self, store):
+        # Versions orphaned by a crash-between-pointer-and-GC or a smaller
+        # restart keep are swept by the NEXT publish, not leaked forever.
+        pub = ts.WeightPublisher("p8", store_name=store, keep=8)
+        for i in range(4):
+            await pub.publish({"w": np.full(2, float(i))})  # v0..v3 all kept
+        pub2 = ts.WeightPublisher("p8", store_name=store, keep=1)
+        v = await pub2.publish({"w": np.ones(2)})  # v4; cutoff = 3
+        assert v == 4
+        keys = await ts.keys("p8", store_name=store)
+        versions = {k.split("/")[1] for k in keys if k.split("/")[1].startswith("v")}
+        assert versions == {"v4"}
+
+    async def test_direct_channel_stable_key(self, store):
+        # direct=True publishes under ONE stable key with refresh semantics:
+        # no per-version staging registrations to leak, versions still
+        # order the wakeups.
+        pub = ts.WeightPublisher("pd", store_name=store)
+        sub = ts.WeightSubscriber("pd", store_name=store)
+        src = {"w": np.full(16, 1.0, np.float32)}
+        assert await pub.publish(src, direct=True) == 0
+        user = {"w": np.zeros(16, np.float32)}
+        sd, v = await sub.acquire(user_state_dict=user, direct=True, timeout=5.0)
+        assert v == 0
+        np.testing.assert_array_equal(user["w"], np.full(16, 1.0))
+        src["w"][:] = 2.0  # trainer mutates in place; publish = refresh
+        assert await pub.publish(src, direct=True) == 1
+        sd, v = await sub.acquire(user_state_dict=user, direct=True, timeout=5.0)
+        assert v == 1
+        np.testing.assert_array_equal(user["w"], np.full(16, 2.0))
+        # Single stable data key, no version keys accumulating.
+        keys = await ts.keys("pd", store_name=store)
+        assert not any(k.split("/")[1].startswith("v") for k in keys)
+
+    async def test_acquire_survives_concurrent_channel_delete(self, store):
+        pub = ts.WeightPublisher("p9", store_name=store)
+        sub = ts.WeightSubscriber("p9", store_name=store)
+        await pub.publish({"w": np.ones(2)})
+        await sub.acquire(timeout=5.0)
+
+        async def delete_then_republish():
+            await asyncio.sleep(0.05)
+            await pub.close(delete=True)
+            await asyncio.sleep(0.1)
+            pub2 = ts.WeightPublisher("p9", store_name=store)
+            await pub2.publish({"w": np.full(2, 7.0)})
+
+        task = asyncio.create_task(delete_then_republish())
+        # The delete bumps the pointer generation; acquire must ride through
+        # the missing-pointer window and return the republished version.
+        sd, v = await sub.acquire(timeout=10.0)
+        np.testing.assert_array_equal(sd["w"], np.full(2, 7.0))
+        await task
+
+    async def test_close_deletes_channel(self, store):
+        pub = ts.WeightPublisher("p7", store_name=store)
+        await pub.publish({"w": np.ones(2)})
+        await pub.close(delete=True)
+        assert await ts.keys("p7", store_name=store) == []

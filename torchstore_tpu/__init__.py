@@ -29,8 +29,10 @@ from torchstore_tpu.api import (
     put_state_dict,
     reset_client,
     shutdown,
+    wait_for,
 )
 from torchstore_tpu.client import LocalClient
+from torchstore_tpu.weight_channel import WeightPublisher, WeightSubscriber
 from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import init_logging
 from torchstore_tpu.strategy import (
@@ -59,6 +61,8 @@ __all__ = [
     "TensorMeta",
     "TensorSlice",
     "TransportType",
+    "WeightPublisher",
+    "WeightSubscriber",
     "barrier",
     "client",
     "delete",
@@ -77,4 +81,5 @@ __all__ = [
     "put_state_dict",
     "reset_client",
     "shutdown",
+    "wait_for",
 ]

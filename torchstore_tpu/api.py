@@ -315,6 +315,16 @@ async def exists(key: str, store_name: str = DEFAULT_STORE) -> bool:
     return await client(store_name).exists(key)
 
 
+async def wait_for(
+    keys, timeout: Optional[float] = None, store_name: str = DEFAULT_STORE
+) -> None:
+    """Block until every key (str or list of str) exists and is fully
+    committed (sharded keys: all mesh coordinates landed). Raises
+    TimeoutError on expiry. Replaces the reference's poll-in-try/except
+    consumer idiom with a push notification from the controller."""
+    await client(store_name).wait_for(keys, timeout=timeout)
+
+
 async def put_state_dict(
     key: str,
     state_dict: Any,
@@ -427,4 +437,5 @@ __all__ = [
     "put_state_dict",
     "reset_client",
     "shutdown",
+    "wait_for",
 ]

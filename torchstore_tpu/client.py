@@ -556,3 +556,37 @@ class LocalClient:
 
     async def exists(self, key: str) -> bool:
         return await self._controller.contains.call_one(key) != "missing"
+
+    # ------------------------------------------------------------------
+    # blocking waits
+    # ------------------------------------------------------------------
+
+    def _wait_rpc_timeout(self, timeout: Optional[float]) -> float:
+        # The RPC deadline must outlive the server-side wait so the server's
+        # precise TimeoutError (naming the missing keys) beats the generic
+        # client-side one; 0 disables the client deadline for timeout=None.
+        return 0 if timeout is None else timeout + 10.0
+
+    async def wait_for(
+        self, keys, timeout: Optional[float] = None
+    ) -> None:
+        """Block until every key exists and is fully committed. Replaces the
+        reference pattern of polling get/get_state_dict in a try/except
+        loop; raises TimeoutError on expiry."""
+        if isinstance(keys, str):
+            keys = [keys]
+        await self._ensure_setup()
+        await self._controller.wait_for_committed.with_timeout(
+            self._wait_rpc_timeout(timeout)
+        ).call_one(list(keys), timeout)
+
+    async def wait_for_change(
+        self, key: str, last_gen: int = 0, timeout: Optional[float] = None
+    ) -> dict:
+        """Block until ``key``'s update generation exceeds ``last_gen``;
+        returns {"gen", "state"} (state: missing|partial|committed). The
+        substrate for version subscriptions (see weight_channel)."""
+        await self._ensure_setup()
+        return await self._controller.wait_for_change.with_timeout(
+            self._wait_rpc_timeout(timeout)
+        ).call_one(key, last_gen, timeout)

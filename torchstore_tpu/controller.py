@@ -130,6 +130,26 @@ class Controller(Actor):
             "locates": 0,
             "deletes": 0,
         }
+        # Per-key update generation + a condition notified on every index
+        # change: the substrate for wait_for_committed / wait_for_change
+        # (blocking weight-sync subscriptions — the reference leaves
+        # consumers to poll get_state_dict in a try/except loop).
+        self._key_gens: dict[str, int] = {}
+        self._update_cond: Optional[Any] = None  # lazily created on its loop
+
+    def _cond(self):
+        import asyncio
+
+        if self._update_cond is None:
+            self._update_cond = asyncio.Condition()
+        return self._update_cond
+
+    async def _bump(self, keys) -> None:
+        cond = self._cond()
+        async with cond:
+            for key in keys:
+                self._key_gens[key] = self._key_gens.get(key, 0) + 1
+            cond.notify_all()
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -254,6 +274,7 @@ class Controller(Actor):
             self.counters["puts"] += 1
             if meta.tensor_meta is not None:
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
+        await self._bump({meta.key for meta in metas})
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:
@@ -268,6 +289,11 @@ class Controller(Actor):
                 continue  # idempotent delete
             for vid in infos:
                 by_volume.setdefault(vid, []).append(key)
+        # A delete is an observable change: wake wait_for_change waiters
+        # (they re-check state and see 'missing').
+        deleted = {k for vkeys in by_volume.values() for k in vkeys}
+        if deleted:
+            await self._bump(deleted)
         return by_volume
 
     @endpoint
@@ -275,6 +301,75 @@ class Controller(Actor):
         if prefix is None:
             return sorted(self.index)
         return sorted(self.index.keys().filter_by_prefix(prefix))
+
+    # ---- blocking waits --------------------------------------------------
+
+    @endpoint
+    async def wait_for_committed(
+        self, keys: list[str], timeout: Optional[float] = None
+    ) -> None:
+        """Block until every key exists and is fully committed (sharded keys:
+        all mesh coordinates landed). Raises TimeoutError on expiry. The
+        reference has no wait primitive — consumers poll get_state_dict in
+        try/except loops; this replaces the poll with a single blocking RPC
+        woken by the notify that commits the key."""
+        import asyncio
+
+        cond = self._cond()
+
+        def ready() -> bool:
+            for key in keys:
+                infos = self.index.get(key)
+                if infos is None or self._committed_state(infos) == "partial":
+                    return False
+            return True
+
+        async with cond:
+            try:
+                await asyncio.wait_for(cond.wait_for(ready), timeout)
+            except asyncio.TimeoutError:
+                missing = [
+                    k
+                    for k in keys
+                    if self.index.get(k) is None
+                    or self._committed_state(self.index.get(k)) == "partial"
+                ]
+                raise TimeoutError(
+                    f"wait_for_committed timed out after {timeout}s; still "
+                    f"missing/partial: {missing[:5]}"
+                ) from None
+
+    @endpoint
+    async def wait_for_change(
+        self, key: str, last_gen: int = 0, timeout: Optional[float] = None
+    ) -> dict[str, Any]:
+        """Block until ``key``'s update generation exceeds ``last_gen`` (every
+        indexed put or delete of the key bumps it), then return
+        ``{"gen", "state"}`` with state ∈ missing|partial|committed.
+        ``last_gen=0`` returns immediately for any key that has ever been
+        written — so a new subscriber picks up the current version without
+        racing the next publish."""
+        import asyncio
+
+        cond = self._cond()
+        async with cond:
+            try:
+                await asyncio.wait_for(
+                    cond.wait_for(
+                        lambda: self._key_gens.get(key, 0) > last_gen
+                    ),
+                    timeout,
+                )
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"wait_for_change({key!r}) timed out after {timeout}s at "
+                    f"generation {self._key_gens.get(key, 0)}"
+                ) from None
+            infos = self.index.get(key)
+            state = (
+                "missing" if infos is None else self._committed_state(infos)
+            )
+            return {"gen": self._key_gens.get(key, 0), "state": state}
 
     @endpoint
     async def check_volumes(self, timeout: float = 5.0) -> dict[str, str]:

@@ -127,6 +127,13 @@ class _Connection:
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
+            # asyncio.TimeoutError IS builtin TimeoutError (3.11+), so this
+            # clause also catches a REMOTE endpoint's TimeoutError arriving
+            # through the future (e.g. wait_for_committed expiry). A done,
+            # uncancelled future means the response arrived — propagate the
+            # remote exception; only a cancelled future is a local deadline.
+            if fut.done() and not fut.cancelled():
+                raise
             # A late response finds no pending future and is dropped; the
             # connection itself stays usable (requests are multiplexed).
             self.pending.pop(req_id, None)

@@ -1,0 +1,214 @@
+"""Versioned weight channel: the RL weight-sync steady state as one object.
+
+The reference leaves the publish/consume loop to users: trainers invent
+version-numbered keys ("v0", "v1", ...) and generators poll
+``get_state_dict`` in try/except loops (reference example/torchstore_rl.py).
+This layer packages the whole pattern:
+
+- ``WeightPublisher.publish(sd)`` writes the state dict under
+  ``name/v{n}``, atomically advances the ``name/LATEST`` pointer, and
+  garbage-collects versions older than ``keep`` — unbounded-memory-free by
+  construction.
+- ``WeightSubscriber.acquire()`` BLOCKS until a version newer than the last
+  one it returned is committed (woken by the controller's update
+  notification, no polling), pulls it — optionally in place into
+  ``user_state_dict`` targets, resharding as usual — and returns
+  ``(state_dict, version)``.
+
+Ordering guarantee: ``LATEST`` is written only after the version's commit
+marker, so a subscriber woken by the pointer update always finds a complete
+state dict. GC trails ``keep`` versions behind, so a subscriber mid-pull on
+version n is safe while n+1 publishes (keep >= 2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.state_dict_utils import NoMatchingPush
+
+logger = get_logger("torchstore_tpu.weight_channel")
+
+_LATEST = "LATEST"
+
+
+def _version_key(name: str, version: int) -> str:
+    return f"{name}/v{version}"
+
+
+class WeightPublisher:
+    """Trainer side of a versioned weight channel."""
+
+    def __init__(
+        self,
+        name: str,
+        store_name: str = "default",
+        keep: int = 2,
+        client: Any = None,
+    ) -> None:
+        if keep < 1:
+            raise ValueError("keep must be >= 1 (the latest version must live)")
+        self.name = name
+        self.keep = keep
+        self._store_name = store_name
+        self._client = client
+        self._next_version: Optional[int] = None
+
+    def _resolve_client(self):
+        if self._client is None:
+            from torchstore_tpu import api
+
+            self._client = api.client(self._store_name)
+        return self._client
+
+    async def publish(
+        self, state_dict: Any, transfer_dtype=None, direct: bool = False
+    ) -> int:
+        """Write the next version, advance LATEST, GC old versions. Returns
+        the published version number. A restarted publisher resumes after
+        the channel's existing LATEST instead of clobbering live versions.
+
+        ``direct=True`` publishes through the one-hop path under a single
+        STABLE key (``name/direct``): the first publish registers staging
+        buffers, later ones are refreshes — no per-version registrations to
+        leak, and the version number is purely the subscriber wakeup
+        ordinal. As with direct sync generally, a pull concurrent with a
+        refresh may observe the newer bytes."""
+        from torchstore_tpu import state_dict_utils
+
+        client = self._resolve_client()
+        if self._next_version is None:
+            try:
+                self._next_version = int(
+                    await client.get(f"{self.name}/{_LATEST}")
+                ) + 1
+            except KeyError:
+                self._next_version = 0
+        version = self._next_version
+        data_key = (
+            f"{self.name}/direct" if direct else _version_key(self.name, version)
+        )
+        await state_dict_utils.put_state_dict(
+            client,
+            data_key,
+            state_dict,
+            transfer_dtype=transfer_dtype,
+            direct=direct,
+        )
+        # Pointer write LAST: subscribers woken by it see a committed dict.
+        await client.put(f"{self.name}/{_LATEST}", version)
+        self._next_version = version + 1
+        if not direct:
+            await self._gc(client, version)
+        return version
+
+    async def _gc(self, client, version: int) -> None:
+        """Delete EVERY version <= version-keep still present — not just the
+        one this publish expires — so versions orphaned by a crash between
+        pointer write and GC, or by restarting with a smaller ``keep``, are
+        reclaimed on the next publish rather than leaking forever."""
+        cutoff = version - self.keep
+        if cutoff < 0:
+            return
+        stale: set[int] = set()
+        for key in await client.keys(self.name):
+            # Keys look like "{name}/v{n}/..." — prefix filtering is
+            # segment-bounded, so list the channel root and parse.
+            seg = key[len(self.name) + 1 :].split("/", 1)[0]
+            if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) <= cutoff:
+                stale.add(int(seg[1:]))
+        for v in sorted(stale):
+            removed = await client.delete_prefix(_version_key(self.name, v))
+            if removed:
+                logger.debug("channel %s: GC'd v%d (%d keys)", self.name, v, removed)
+
+    async def close(self, delete: bool = False) -> None:
+        """Optionally remove every key the channel owns."""
+        if delete:
+            client = self._resolve_client()
+            await client.delete_prefix(self.name)
+
+
+class WeightSubscriber:
+    """Consumer side: blocks for fresh versions instead of polling."""
+
+    def __init__(
+        self, name: str, store_name: str = "default", client: Any = None
+    ) -> None:
+        self.name = name
+        self._store_name = store_name
+        self._client = client
+        self._last_gen = 0
+        self.last_version: Optional[int] = None
+
+    def _resolve_client(self):
+        if self._client is None:
+            from torchstore_tpu import api
+
+            self._client = api.client(self._store_name)
+        return self._client
+
+    async def acquire(
+        self,
+        user_state_dict: Any = None,
+        timeout: Optional[float] = None,
+        direct: bool = False,
+        strict: bool = True,
+    ) -> tuple[Any, int]:
+        """Block until a version is published that this subscriber has not
+        yet acquired, pull it, and return (state_dict, version). The first
+        call returns the channel's current version immediately when one
+        exists; each publish is delivered at most once (a deleted-then-
+        recreated channel restarts numbering and delivers its v0). Raises
+        TimeoutError if nothing new arrives in ``timeout`` seconds."""
+        import time
+
+        from torchstore_tpu import state_dict_utils
+
+        client = self._resolve_client()
+        pointer = f"{self.name}/{_LATEST}"
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            change = await client.wait_for_change(
+                pointer, self._last_gen, timeout=remaining
+            )
+            self._last_gen = change["gen"]
+            if change["state"] != "committed":
+                continue  # deleted channel or mid-rewrite; wait for the next
+            data_key = None
+            try:
+                # No version-ordering guard needed: the pointer's update
+                # generation is strictly monotonic and bumps exactly once
+                # per publish (gets never bump it), so each committed wake
+                # is a distinct publish — including a deleted-then-recreated
+                # channel whose numbering restarted at 0.
+                version = int(await client.get(pointer))
+                data_key = (
+                    f"{self.name}/direct"
+                    if direct
+                    else _version_key(self.name, version)
+                )
+                sd = await state_dict_utils.get_state_dict(
+                    client,
+                    data_key,
+                    user_state_dict=user_state_dict,
+                    direct=direct,
+                    strict=strict,
+                )
+            except (NoMatchingPush, KeyError):
+                # The pointer or version vanished between wakeup and pull
+                # (channel deleted, or we lagged > keep versions behind);
+                # wait for the next publish.
+                logger.info(
+                    "channel %s: %s vanished before pull (deleted channel "
+                    "or lagging subscriber); waiting for next version",
+                    self.name,
+                    data_key or pointer,
+                )
+                continue
+            self.last_version = version
+            return sd, version
