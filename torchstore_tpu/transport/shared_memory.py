@@ -407,7 +407,12 @@ class ShmServerCache(TransportCache):
                     continue
                 view[off : min(off + step, size) : 4096] = 0
                 off += step
-                await asyncio.sleep(0)
+                # ~10% duty cycle: the idle gate cannot see DIRECT-mode
+                # traffic (peer reads never touch the volume), so full-tilt
+                # faulting here starves concurrent client copies on
+                # few-core hosts. A trickle keeps warm-up invisible; RL
+                # gaps are seconds long, so spares still arrive in time.
+                await asyncio.sleep(0.005)
             if self._closed:
                 seg.unlink()
             else:
