@@ -44,7 +44,35 @@ def config_from_hf(hf_config) -> LlamaConfig:
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
+    # Gemma-1: tanh-gelu MLP, (1+w) RMSNorm offsets, sqrt(hidden)-scaled
+    # embeddings, tied lm_head. Gemma2+ adds softcapping/pre-post norms not
+    # representable here — the unmapped-tensor check rejects those.
+    is_gemma = hf_config.__class__.__name__ == "GemmaConfig"
+    hidden_act = getattr(hf_config, "hidden_act", None) or getattr(
+        hf_config, "hidden_activation", None
+    )
+    if is_gemma:
+        # HF's GemmaMLP runs gelu_pytorch_tanh regardless of a legacy
+        # hidden_act value (the original release's config said "gelu" but
+        # ran tanh-gelu; transformers warns and overrides the same way).
+        mlp_act = "gelu_tanh"
+    elif hidden_act in (None, "silu"):
+        mlp_act = "silu"
+    elif hidden_act == "gelu_pytorch_tanh":
+        mlp_act = "gelu_tanh"
+    else:
+        # Exact-erf "gelu", "gelu_new", "relu", ... have no representation
+        # here — converting would produce silently diverging logits, the
+        # outcome every other guard in this function exists to prevent.
+        raise NotImplementedError(
+            f"hidden_act={hidden_act!r} is not representable "
+            "(supported: silu, gelu_pytorch_tanh)"
+        )
     return LlamaConfig(
+        mlp_act=mlp_act,
+        rms_offset=is_gemma,
+        scale_embeddings=is_gemma,
+        tie_embeddings=is_gemma,
         # Qwen2Config (exactly — Qwen2Moe etc. have different structure and
         # fail the unmapped-tensor check) carries q/k/v biases implicitly.
         attention_bias=bool(getattr(hf_config, "attention_bias", False))
@@ -85,14 +113,21 @@ def convert_hf_llama(
     params: dict = {
         "embed": {"embedding": w("model.embed_tokens.weight")},
         "final_norm": {"scale": w("model.norm.weight")},
-        "lm_head": {
+    }
+    if cfg.tie_embeddings:
+        # The model attends through the embedding table; there is no
+        # lm_head param (HF Gemma checkpoints carry none either, but a
+        # materialized tied copy is consumed if present).
+        if "lm_head.weight" in sd:
+            consumed.add("lm_head.weight")
+    else:
+        params["lm_head"] = {
             "kernel": (
                 w("lm_head.weight")
                 if "lm_head.weight" in sd
                 else w("model.embed_tokens.weight")  # tied embeddings
             ).T
-        },
-    }
+        }
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
         layer = {

@@ -40,6 +40,13 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     # Qwen2-style: biases on the q/k/v projections only.
     attention_bias: bool = False
+    # Gemma-style knobs: tanh-gelu MLP ("silu" | "gelu_tanh"), RMSNorm
+    # scale stored as an offset applied as (1 + w), embeddings scaled by
+    # sqrt(hidden) after lookup, and the lm_head tied to the embedding.
+    mlp_act: str = "silu"
+    rms_offset: bool = False
+    scale_embeddings: bool = False
+    tie_embeddings: bool = False
     # Long-context attention: "dense" | "ring" | "ulysses". The sharded
     # impls engage when ``mesh`` has an sp axis of size > 1 (sequence
     # parallelism); otherwise dense is used.
@@ -77,6 +84,24 @@ class LlamaConfig:
         )
 
     @classmethod
+    def gemma_7b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+            num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+            rope_theta=10000.0, rms_eps=1e-6, mlp_act="gelu_tanh",
+            rms_offset=True, scale_embeddings=True, tie_embeddings=True,
+        )
+
+    @classmethod
+    def tiny_gemma(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=8, head_dim=8,
+            rms_eps=1e-6, mlp_act="gelu_tanh", rms_offset=True,
+            scale_embeddings=True, tie_embeddings=True,
+        )
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256) -> "LlamaConfig":
         # Head/mlp/vocab dims all divide 8 so the config shards on any
         # tp<=8 mesh in tests and dry runs.
@@ -97,18 +122,35 @@ class LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
+    # Gemma convention: the stored param is an OFFSET applied as (1 + w),
+    # zero-initialized (HF Gemma checkpoints carry the same layout).
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x):
         scale = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones, (None,)),
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init() if self.offset
+                else nn.initializers.ones,
+                (None,),
+            ),
             (x.shape[-1],),
             jnp.float32,
         )
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         out = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        if self.offset:
+            scale = 1.0 + scale
         return (out * scale).astype(self.dtype)
+
+
+def _mlp_act(cfg: LlamaConfig):
+    if cfg.mlp_act == "silu":
+        return nn.silu
+    if cfg.mlp_act == "gelu_tanh":
+        return lambda x: nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown mlp_act {cfg.mlp_act!r}")
 
 
 def rope(q, k, positions, theta: float):
@@ -239,7 +281,7 @@ class MLP(nn.Module):
         gate = dense(cfg.intermediate_size, "gate_proj", ("embed", "mlp"))(x)
         up = dense(cfg.intermediate_size, "up_proj", ("embed", "mlp"))(x)
         return dense(cfg.hidden_size, "down_proj", ("mlp", "embed"))(
-            nn.silu(gate) * up
+            _mlp_act(cfg)(gate) * up
         )
 
 
@@ -289,7 +331,7 @@ class MoE(nn.Module):
         one_hot = jax.nn.one_hot(selected, cfg.num_experts, dtype=cfg.dtype)
         gates = jnp.einsum("bske,bsk->bse", one_hot, weights.astype(cfg.dtype))
         xe = x.astype(cfg.dtype)
-        hidden = nn.silu(
+        hidden = _mlp_act(cfg)(
             jnp.einsum("bsh,ehm->besm", xe, w_gate.astype(cfg.dtype))
         ) * jnp.einsum("bsh,ehm->besm", xe, w_up.astype(cfg.dtype))
         out = jnp.einsum("besm,emh->besh", hidden, w_down.astype(cfg.dtype))
@@ -311,11 +353,12 @@ class Block(nn.Module):
         cfg = self.cfg
         x = _constrain(x, ("batch", "seq", "embed"))
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.rms_eps, cfg.dtype, name="attn_norm")(x), positions
+            RMSNorm(cfg.rms_eps, cfg.dtype, cfg.rms_offset, name="attn_norm")(x),
+            positions,
         )
         mlp_cls = MoE if cfg.num_experts else MLP
         x = x + mlp_cls(cfg, name="mlp")(
-            RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
+            RMSNorm(cfg.rms_eps, cfg.dtype, cfg.rms_offset, name="mlp_norm")(x)
         )
         return _constrain(x, ("batch", "seq", "embed"))
 
@@ -326,7 +369,7 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         cfg = self.cfg
-        x = nn.Embed(
+        embed = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,
             dtype=cfg.dtype,
@@ -335,13 +378,29 @@ class Llama(nn.Module):
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")
             ),
             name="embed",
-        )(tokens)
+        )
+        x = embed(tokens)
+        if cfg.scale_embeddings:
+            # Gemma normalizer: sqrt(hidden) in the embedding dtype (HF
+            # casts the normalizer to the activation dtype before scaling).
+            x = x * jnp.asarray(
+                jnp.sqrt(jnp.float32(cfg.hidden_size)), x.dtype
+            )
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[-1]), tokens.shape
         )
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"layer_{i}")(x, positions)
-        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.rms_offset, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            # Gemma ties the output head to the embedding table. Compute in
+            # f32 like the untied lm_head Dense below — Embed.attend would
+            # round the big vocab matmul to cfg.dtype (bf16) first.
+            return jnp.einsum(
+                "bsh,vh->bsv",
+                x.astype(jnp.float32),
+                embed.embedding.astype(jnp.float32),
+            )
         logits = nn.Dense(
             cfg.vocab_size,
             use_bias=False,
