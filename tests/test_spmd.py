@@ -232,6 +232,83 @@ async def _spmd_scenario(rank: int, world: int, result: dict) -> None:
     result["ok"] = True
 
 
+def _channel_worker(rank: int, world: int, port: int, result_dir: str) -> None:
+    os.environ.update(
+        {
+            "RANK": str(rank),
+            "LOCAL_RANK": str(rank),
+            "WORLD_SIZE": str(world),
+            "LOCAL_WORLD_SIZE": str(world),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        }
+    )
+    result = {"rank": rank, "ok": False}
+    try:
+        asyncio.run(_channel_scenario(rank, world, result))
+    except Exception as exc:  # noqa: BLE001 - reported to parent
+        import traceback
+
+        result["error"] = f"{exc!r}\n{traceback.format_exc()}"
+    with open(os.path.join(result_dir, f"rank_{rank}.json"), "w") as f:
+        json.dump(result, f)
+
+
+async def _channel_scenario(rank: int, world: int, result: dict) -> None:
+    """Versioned weight channel across SPMD ranks: rank 0 publishes, every
+    other rank block-acquires each version (wait_for_change over real RPC,
+    no polling) — the RL trainer/generator topology under torchrun."""
+    import torchstore_tpu as ts
+
+    await ts.initialize_spmd(store_name="chspmd")
+    versions = 3
+    if rank == 0:
+        pub = ts.WeightPublisher("policy", store_name="chspmd", keep=versions)
+        for v in range(versions):
+            await pub.publish({"w": np.full(8, float(v), np.float32)})
+            await asyncio.sleep(0.05)
+    else:
+        sub = ts.WeightSubscriber("policy", store_name="chspmd")
+        got = []
+        while len(got) < 1 or got[-1] < versions - 1:
+            sd, v = await sub.acquire(timeout=60.0)
+            assert sd["w"][0] == float(v), (v, sd["w"][0])
+            got.append(v)
+        assert got == sorted(got), got
+    await ts.barrier("channel_done", store_name="chspmd")
+    await ts.shutdown("chspmd")
+    result["ok"] = True
+
+
+def test_spmd_weight_channel(tmp_path):
+    world = 3
+    port = get_free_port()
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=_channel_worker,
+            args=(r, world, port, str(tmp_path)),
+            daemon=False,
+        )
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        for p in procs:
+            p.join(timeout=180)
+            assert not p.is_alive(), "channel worker hung"
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    for r in range(world):
+        path = tmp_path / f"rank_{r}.json"
+        assert path.exists(), f"rank {r} produced no result"
+        result = json.loads(path.read_text())
+        assert result["ok"], f"rank {r} failed: {result.get('error')}"
+
+
 @pytest.mark.parametrize(
     "world,local_world,secret",
     [
