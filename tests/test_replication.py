@@ -1,0 +1,193 @@
+"""k-way replication: puts land on the primary plus ring-successor
+replicas, gets fail over transparently when a replica dies, deletes clean
+every copy. Beyond the reference (which stores each key exactly once and
+loses it with its volume)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.client import Shard
+from torchstore_tpu.runtime import ActorDiedError
+from torchstore_tpu.strategy import LocalRankStrategy
+from torchstore_tpu.transport.types import TensorSlice
+
+
+async def _kill_volume(store_name: str, volume_id: str) -> None:
+    """Kill the process hosting ``volume_id`` (match refs by identity
+    triple — pickled ActorRefs don't compare equal to the mesh's)."""
+    from torchstore_tpu import api
+
+    client = ts.client(store_name)
+    vmap = await client.controller.get_volume_map.call_one()
+    target = vmap[volume_id]["ref"]
+    handle = api._stores[store_name]
+    for idx, ref in enumerate(handle.volume_mesh.refs):
+        if (ref.host, ref.port, ref.name) == (
+            target.host,
+            target.port,
+            target.name,
+        ):
+            proc = handle.volume_mesh._processes[idx]
+            proc.kill()
+            proc.join(5)
+            return
+    raise AssertionError(f"no process found for volume {volume_id!r}")
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="repl",
+    )
+    yield "repl"
+    await ts.shutdown("repl")
+
+
+async def test_put_indexes_on_two_volumes(store):
+    await ts.put("k", np.arange(8.0, dtype=np.float32), store_name=store)
+    client = ts.client(store)
+    located = await client.controller.locate_volumes.call_one(["k"])
+    assert len(located["k"]) == 2  # primary + 1 replica
+    out = await ts.get("k", store_name=store)
+    np.testing.assert_array_equal(out, np.arange(8.0, dtype=np.float32))
+
+
+async def test_ring_selection_is_deterministic():
+    s = LocalRankStrategy(replication=2)
+    vols = ["0", "1", "2"]
+    assert s.select_put_volume_ids("1", vols) == ["1", "2"]
+    assert s.select_put_volume_ids("2", vols) == ["2", "0"]  # wraps
+    with pytest.raises(ValueError, match="replication=4"):
+        LocalRankStrategy(replication=4).select_put_volume_ids("0", vols)
+
+
+async def test_replication_exceeding_volumes_rejected():
+    with pytest.raises(ValueError, match="replication=3"):
+        await ts.initialize(
+            num_storage_volumes=2,
+            strategy=LocalRankStrategy(replication=3),
+            store_name="repl_bad",
+        )
+
+
+async def test_get_survives_volume_death(store):
+    src = np.random.rand(64, 64).astype(np.float32)
+    await ts.put("w", src, store_name=store)
+    client = ts.client(store)
+    located = await client.controller.locate_volumes.call_one(["w"])
+    primary = sorted(located["w"])[0]
+    await _kill_volume(store, primary)
+    # First get may pay a diagnosis round trip; it must SUCCEED from the
+    # surviving replica, not raise.
+    out = await ts.get("w", store_name=store)
+    np.testing.assert_array_equal(out, src)
+    # And keep succeeding (dead volume now deprioritized).
+    out = await ts.get("w", store_name=store)
+    np.testing.assert_array_equal(out, src)
+
+
+async def test_unreplicated_key_on_dead_volume_still_fails():
+    # replication=1 control: a volume death LOSES its keys; the error must
+    # surface rather than silently serving stale/empty data.
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(replication=1),
+        store_name="repl1",
+    )
+    try:
+        await ts.put("only", np.ones(4), store_name="repl1")
+        client = ts.client("repl1")
+        located = await client.controller.locate_volumes.call_one(["only"])
+        (vid,) = located["only"]
+        await _kill_volume("repl1", vid)
+        with pytest.raises((ActorDiedError, ConnectionError, OSError)):
+            await ts.get("only", store_name="repl1")
+    finally:
+        await ts.shutdown("repl1")
+
+
+async def test_sharded_replicated_roundtrip(store):
+    # Each shard of a sharded key replicates; a resharded read assembles
+    # from whichever replicas answer.
+    full = np.arange(32.0, dtype=np.float32).reshape(4, 8)
+    for row in range(4):
+        sl = TensorSlice(
+            offsets=(row, 0),
+            local_shape=(1, 8),
+            global_shape=(4, 8),
+            coordinates=(row,),
+            mesh_shape=(4,),
+        )
+        await ts.put("sh", Shard(full[row : row + 1], sl), store_name=store)
+    out = await ts.get("sh", store_name=store)
+    np.testing.assert_array_equal(out, full)
+
+
+async def test_state_dict_replicated_with_failover(store):
+    sd = {"a": np.random.rand(32).astype(np.float32), "b": np.arange(4)}
+    await ts.put_state_dict("ck", sd, store_name=store)
+    # Kill the primary (client id "0" -> volume "0" under LocalRank).
+    await _kill_volume(store, "0")
+    out = await ts.get_state_dict("ck", store_name=store)
+    np.testing.assert_array_equal(out["a"], sd["a"])
+    np.testing.assert_array_equal(out["b"], sd["b"])
+
+
+async def test_bulk_transport_failover():
+    # Volume death on the bulk transport surfaces as ConnectionError, not
+    # ActorDiedError — failover must normalize and still serve from the
+    # surviving replica.
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2, default_transport_type="bulk"),
+        store_name="replb",
+    )
+    try:
+        src = np.random.rand(1024).astype(np.float32)
+        await ts.put("w", src, store_name="replb")
+        client = ts.client("replb")
+        located = await client.controller.locate_volumes.call_one(["w"])
+        await _kill_volume("replb", sorted(located["w"])[0])
+        out = await ts.get("w", store_name="replb")
+        np.testing.assert_array_equal(out, src)
+    finally:
+        await ts.shutdown("replb")
+
+
+async def test_degraded_overwrite_stays_consistent(store):
+    """An overwrite that lands on only SOME replicas must not leave the
+    failed replica serving the old value under committed metadata: the put
+    succeeds at degraded redundancy and the stale copy is detached."""
+    v1 = np.full(16, 1.0, np.float32)
+    v2 = np.full(16, 2.0, np.float32)
+    await ts.put("k", v1, store_name=store)
+    client = ts.client(store)
+    located = await client.controller.locate_volumes.call_one(["k"])
+    replicas = sorted(located["k"])
+    assert len(replicas) == 2
+    await _kill_volume(store, replicas[1])
+    # Overwrite: one replica is dead — the put succeeds (degraded) and the
+    # dead replica's stale entry is detached from the index.
+    await ts.put("k", v2, store_name=store)
+    located = await client.controller.locate_volumes.call_one(["k"])
+    assert replicas[1] not in located["k"]
+    # Every read sees v2 — no divergence window.
+    for _ in range(4):
+        out = await ts.get("k", store_name=store)
+        np.testing.assert_array_equal(out, v2)
+
+
+async def test_delete_cleans_every_replica(store):
+    await ts.put("gone", np.ones(4), store_name=store)
+    await ts.delete("gone", store_name=store)
+    assert not await ts.exists("gone", store_name=store)
+    client = ts.client(store)
+    located = await client.controller.locate_volumes.call_one(
+        ["gone"], missing_ok=True
+    )
+    assert located == {}

@@ -103,6 +103,11 @@ async def initialize(
         strategy = (
             SingletonStrategy() if num_storage_volumes == 1 else LocalRankStrategy()
         )
+    if getattr(strategy, "replication", 1) > num_storage_volumes:
+        raise ValueError(
+            f"replication={strategy.replication} needs at least that many "
+            f"storage volumes (have {num_storage_volumes})"
+        )
     # Per-spawn env (NOT process-global os.environ: a failure mid-initialize
     # or a concurrent initialize must not leak the dir into other stores).
     volume_env = (

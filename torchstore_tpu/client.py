@@ -67,6 +67,10 @@ class LocalClient:
         # on local deletes; cross-client relocations/deletes are discovered
         # by the fetch failing and retried once with a fresh locate.
         self._loc_cache: dict[str, dict[str, StorageInfo]] = {}
+        # Volumes observed dead/wedged by THIS client: get ordering prefers
+        # healthy replicas, so a replicated key survives a volume death
+        # transparently (cleared when a later health check reports ok).
+        self._dead_volumes: set[str] = set()
 
     @property
     def controller(self) -> ActorRef:
@@ -101,6 +105,16 @@ class LocalClient:
         client_id = self._strategy.get_client_id()
         vid = self._strategy.select_volume_id(client_id, list(self._volume_refs))
         return self._volume_refs[vid]
+
+    def _put_volumes(self) -> list[StorageVolumeRef]:
+        """Every volume a put writes to (primary + replicas)."""
+        client_id = self._strategy.get_client_id()
+        return [
+            self._volume_refs[vid]
+            for vid in self._strategy.select_put_volume_ids(
+                client_id, list(self._volume_refs)
+            )
+        ]
 
     # ------------------------------------------------------------------
     # put
@@ -144,25 +158,66 @@ class LocalClient:
         requests: list[Request] = []
         for key, value in items.items():
             requests.extend(self._value_to_requests(key, value))
-        volume = self._own_volume()
-        buffer = create_transport_buffer(volume, self._config)
+        volumes = self._put_volumes()
         nbytes = sum(r.nbytes for r in requests)
-        try:
-            if buffer.supports_batch_puts:
-                await buffer.put_to_storage_volume(volume, requests)
-            else:
-                await buffer.put_to_storage_volume(volume, requests[:1])
-                for req in requests[1:]:
-                    b = create_transport_buffer(volume, self._config)
-                    await b.put_to_storage_volume(volume, [req])
-        except ActorDiedError as exc:
-            await self._raise_with_diagnosis(volume.volume_id, exc)
+
+        async def put_to(volume: StorageVolumeRef) -> None:
+            buffer = create_transport_buffer(volume, self._config)
+            try:
+                if buffer.supports_batch_puts:
+                    await buffer.put_to_storage_volume(volume, requests)
+                else:
+                    await buffer.put_to_storage_volume(volume, requests[:1])
+                    for req in requests[1:]:
+                        b = create_transport_buffer(volume, self._config)
+                        await b.put_to_storage_volume(volume, [req])
+            except (ActorDiedError, ConnectionError, OSError) as exc:
+                # Bulk/peer transports surface volume death as
+                # ConnectionError — normalize so callers and the failover
+                # machinery see one exception family.
+                await self._raise_with_diagnosis(volume.volume_id, exc)
+
+        # Replicated puts hit every target volume concurrently.
+        # return_exceptions: every write FINISHES before we decide (no
+        # detached sibling tasks racing a caller's retry, no unretrieved
+        # exceptions).
+        results = await asyncio.gather(
+            *(put_to(v) for v in volumes), return_exceptions=True
+        )
+        landed = [v for v, r in zip(volumes, results) if not isinstance(r, BaseException)]
+        failed = [
+            (v, r)
+            for v, r in zip(volumes, results)
+            if isinstance(r, BaseException)
+        ]
+        if not landed:
+            raise failed[0][1]
         tracker.track_step("data_plane", nbytes)
         # Two-plane invariant: metadata notify happens only after the data
-        # landed (/root/reference/torchstore/client.py:86-90).
+        # landed (/root/reference/torchstore/client.py:86-90). One RPC
+        # carries every replica id.
+        metas = [r.meta_only() for r in requests]
         await self._controller.notify_put_batch.call_one(
-            [r.meta_only() for r in requests], volume.volume_id
+            metas, [v.volume_id for v in landed]
         )
+        if failed:
+            # Partial replication failure on an OVERWRITE would leave the
+            # failed replica serving the previous value under still-
+            # committed metadata — detach its entries so reads only ever
+            # see the volumes holding the new bytes. The put succeeds at
+            # degraded redundancy; the next successful put re-replicates.
+            keys = list({r.key for r in requests})
+            for volume, exc in failed:
+                logger.warning(
+                    "replicated put degraded: volume %s failed (%s); "
+                    "detaching its copies of %d key(s)",
+                    volume.volume_id,
+                    exc,
+                    len(keys),
+                )
+                await self._controller.notify_detach_batch.call_one(
+                    keys, volume.volume_id
+                )
         tracker.track_step("notify")
         tracker.log_summary()
 
@@ -350,7 +405,10 @@ class LocalClient:
                         results.extend(
                             await b.get_from_storage_volume(volume, [sub])
                         )
-            except ActorDiedError as exc:
+            except (ActorDiedError, ConnectionError, OSError) as exc:
+                # Bulk/peer transports report volume death as
+                # ConnectionError; normalizing through the diagnosis path
+                # marks the volume dead so the retry prefers replicas.
                 await self._raise_with_diagnosis(vid, exc)
             for (idx, sub), res in zip(entries, results):
                 parts_by_request.setdefault(idx, []).append((sub, res))
@@ -367,13 +425,21 @@ class LocalClient:
     async def _raise_with_diagnosis(self, vid: str, exc: Exception) -> None:
         """A volume RPC failed or timed out: ask the controller to
         health-check the fleet and re-raise with the diagnosis attached
-        (dead vs wedged vs healthy-but-slow is actionable for operators)."""
+        (dead vs wedged vs healthy-but-slow is actionable for operators).
+        The failed volume is remembered so retried gets prefer healthy
+        replicas; volumes the health check clears are forgiven."""
+        self._dead_volumes.add(vid)
         diagnosis = "controller unreachable"
         try:
             statuses = await self._controller.check_volumes.with_timeout(
                 15.0
             ).call_one(timeout=5.0)
             diagnosis = statuses.get(vid, "unknown volume")
+            for v, status in statuses.items():
+                if status == "ok":
+                    self._dead_volumes.discard(v)
+                else:
+                    self._dead_volumes.add(v)
         except Exception:  # noqa: BLE001 - diagnosis is best-effort
             pass
         raise ActorDiedError(
@@ -408,8 +474,14 @@ class LocalClient:
             own_id = self._strategy.get_client_id()
         except Exception:
             pass
-        # Prefer this client's own volume, then stable order (locality).
-        ordered = sorted(infos, key=lambda v: (v != own_id, v))
+        # Prefer healthy volumes first (replica failover), then this
+        # client's own volume, then stable order (locality). Known-dead
+        # volumes stay as a last resort: if they hold the only copy the
+        # fetch still tries them and surfaces the real error.
+        ordered = sorted(
+            infos,
+            key=lambda v: (v in self._dead_volumes, v != own_id, v),
+        )
 
         if any_info.object_type == ObjectType.OBJECT:
             sub = Request(key=req.key, is_object=True)

@@ -238,7 +238,13 @@ class Controller(Actor):
         return self._committed_state(infos)
 
     @endpoint
-    async def notify_put_batch(self, metas: list[Request], volume_id: str) -> None:
+    async def notify_put_batch(
+        self, metas: list[Request], volume_id: "str | list[str]"
+    ) -> None:
+        """Index ``metas`` as stored on ``volume_id`` — a single id, or a
+        LIST of ids for replicated puts (one RPC, one generation bump, and
+        counters measuring LOGICAL puts regardless of replication)."""
+        volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
                 raise ValueError(
@@ -264,17 +270,38 @@ class Controller(Actor):
             if infos is None:
                 infos = {}
                 self.index[meta.key] = infos
-            info = infos.get(volume_id)
-            if info is None:
-                infos[volume_id] = StorageInfo.from_meta(meta)
-            else:
-                info.merge(meta)
+            for vid in volume_ids:
+                info = infos.get(vid)
+                if info is None:
+                    infos[vid] = StorageInfo.from_meta(meta)
+                else:
+                    info.merge(meta)
             # Count as each entry indexes, so a mid-batch rejection leaves
             # counters consistent with what actually landed in the index.
             self.counters["puts"] += 1
             if meta.tensor_meta is not None:
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
         await self._bump({meta.key for meta in metas})
+
+    @endpoint
+    async def notify_detach_batch(
+        self, keys: list[str], volume_id: str
+    ) -> None:
+        """Drop ``volume_id``'s entries for ``keys`` from the index (the
+        volume's copies are stale/unreachable — e.g. a replica that missed
+        an overwrite). A key with no volumes left disappears; a sharded key
+        missing coords becomes partial and reads fail loudly."""
+        changed = set()
+        for key in keys:
+            infos = self.index.get(key)
+            if infos is None or volume_id not in infos:
+                continue
+            del infos[volume_id]
+            changed.add(key)
+            if not infos:
+                self.index.pop(key, None)
+        if changed:
+            await self._bump(changed)
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:

@@ -179,6 +179,17 @@ async def initialize(
             "SPMD initialization supports LocalRankStrategy and HostStrategy "
             f"only (got {type(strategy).__name__})"
         )
+    total_volumes = (
+        env.world_size
+        if isinstance(strategy, LocalRankStrategy)
+        else env.num_hosts
+    )
+    if strategy.replication > total_volumes:
+        # Fail at bootstrap on every rank, not at the first put mid-training.
+        raise ValueError(
+            f"replication={strategy.replication} needs at least that many "
+            f"storage volumes (this SPMD world provides {total_volumes})"
+        )
     if store_name in _spmd_sessions:
         raise RuntimeError(f"SPMD store {store_name!r} already initialized")
 

@@ -51,10 +51,22 @@ class StorageVolumeRef:
 class StoreStrategy(ABC):
     """Base strategy. ``default_transport_type`` forces one transport for
     every volume mapped by this strategy (reference
-    /root/reference/torchstore/strategy.py:65-66)."""
+    /root/reference/torchstore/strategy.py:65-66). ``replication`` > 1
+    makes every put land on that many volumes (the primary plus its ring
+    successors in sorted-id order): a volume death loses no data — gets
+    transparently fail over to a surviving replica — and read load spreads
+    across copies. Beyond the reference, which stores every key exactly
+    once."""
 
-    def __init__(self, default_transport_type: Optional[str] = None) -> None:
+    def __init__(
+        self,
+        default_transport_type: Optional[str] = None,
+        replication: int = 1,
+    ) -> None:
+        if replication < 1:
+            raise ValueError("replication must be >= 1")
         self.default_transport_type = default_transport_type
+        self.replication = replication
 
     @abstractmethod
     def get_volume_id(self) -> str:
@@ -73,6 +85,23 @@ class StoreStrategy(ABC):
             f"no storage volume for client id {client_id!r}; "
             f"volumes: {sorted(volume_ids)}"
         )
+
+    def select_put_volume_ids(
+        self, client_id: str, volume_ids: list[str]
+    ) -> list[str]:
+        """Every volume a put writes to: the primary plus replication-1
+        ring successors (deterministic for a given volume set)."""
+        primary = self.select_volume_id(client_id, volume_ids)
+        if self.replication == 1:
+            return [primary]
+        if self.replication > len(volume_ids):
+            raise ValueError(
+                f"replication={self.replication} exceeds the "
+                f"{len(volume_ids)} available volumes"
+            )
+        ring = sorted(volume_ids)
+        start = ring.index(primary)
+        return [ring[(start + i) % len(ring)] for i in range(self.replication)]
 
     def num_volumes(self, num_clients: int) -> int:
         return num_clients
