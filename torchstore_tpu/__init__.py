@@ -27,6 +27,7 @@ from torchstore_tpu.api import (
     put,
     put_batch,
     put_state_dict,
+    repair,
     reset_client,
     shutdown,
     wait_for,
@@ -79,6 +80,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "repair",
     "reset_client",
     "shutdown",
     "wait_for",

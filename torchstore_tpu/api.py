@@ -49,6 +49,8 @@ class _StoreHandle:
     config: StoreConfig
     owner: bool
     inproc_volume: Any = None  # (server, ref) when colocated
+    volume_env: dict = None  # env the volumes were spawned with (repair)
+    repair_meshes: list = None  # replacement volumes spawned by repair()
 
 
 _stores: dict[str, _StoreHandle] = {}
@@ -173,6 +175,8 @@ async def initialize(
         config=config,
         owner=True,
         inproc_volume=inproc_volume,
+        volume_env=dict(volume_env),
+        repair_meshes=[],
     )
     return controller
 
@@ -375,6 +379,108 @@ async def get_state_dict(
     )
 
 
+async def repair(store_name: str = DEFAULT_STORE) -> dict:
+    """Elastic recovery: replace dead storage volumes with fresh actors and
+    re-replicate every key a surviving replica still holds (the recovery
+    story the reference lacks entirely — SURVEY §5 "no elasticity").
+
+    Must run in the process that initialized the store. Returns
+    ``{"replaced": [vids], "rereplicated": n_keys, "lost": [keys],
+    "failed": [keys], "wedged": [vids]}``. Keys with no surviving copy are
+    reported lost and dropped from the index (reads fail loudly with
+    missing); keys whose re-replication read failed are reported in
+    ``failed`` (their surviving copies stay indexed — run repair again).
+    All dead volumes are REPLACED FIRST, then re-replication runs, so a
+    multi-volume failure repairs whatever any survivor holds. Wedged
+    (alive-but-stuck) volumes are NOT replaced — they may recover; kill
+    the process first if replacement is wanted. Durable stores
+    (``storage_dir``) can instead restart the volume and use
+    ``recover=True`` to reload from disk."""
+    from torchstore_tpu.runtime import spawn_actors as _spawn
+    from torchstore_tpu.transport.types import Request
+
+    handle = _stores.get(store_name)
+    if handle is None or not handle.owner or handle.volume_mesh is None:
+        raise RuntimeError(
+            "repair must run in the process that initialized the store "
+            "(with process-backed volumes)"
+        )
+    c = client(store_name)
+    statuses = await handle.controller.check_volumes.call_one()
+    dead = sorted(v for v, s in statuses.items() if s.startswith("dead"))
+    wedged = sorted(v for v, s in statuses.items() if s.startswith("wedged"))
+    report = {
+        "replaced": [],
+        "rereplicated": 0,
+        "lost": [],
+        "failed": [],
+        "wedged": wedged,
+    }
+    strategy = await handle.controller.get_strategy.call_one()
+    # Phase 1: replace EVERY dead volume before any re-replication read —
+    # a key whose listed survivor is another dead volume would otherwise
+    # abort the whole repair mid-way.
+    recoverable_by_vid: dict[str, dict] = {}
+    for vid in dead:
+        gen = len(handle.repair_meshes)
+        mesh = await _spawn(
+            1,
+            StorageVolume,
+            f"ts_{store_name}_volume_repair{gen}",
+            strategy,
+            env_fn=lambda rank, _vid=vid: {
+                **handle.volume_env,
+                "TORCHSTORE_TPU_VOLUME_ID": _vid,
+            },
+        )
+        handle.repair_meshes.append(mesh)
+        new_ref = mesh.refs[0]
+        info = await new_ref.get_id.call_one()
+        result = await handle.controller.replace_volume.call_one(
+            vid, new_ref, info["hostname"]
+        )
+        report["replaced"].append(vid)
+        report["lost"].extend(result["lost"])
+        recoverable_by_vid[vid] = result["recoverable"]
+    await c.refresh_volumes()
+    # Phase 2: re-replicate; a key whose read fails (e.g. its survivor was
+    # itself among the dead) is reported, never aborts the others.
+    for vid, recoverable in recoverable_by_vid.items():
+        for key, slices in recoverable.items():
+            if key in report["lost"]:
+                continue  # its last copy died in a later replacement
+            try:
+                if slices is None:
+                    value = await c.get(key)
+                    requests = LocalClient._value_to_requests(key, value)
+                else:
+                    requests = []
+                    for ts in slices:
+                        arr = await c.get(key, like=ts)
+                        requests.append(
+                            Request.from_tensor_slice(key, ts, arr)
+                        )
+                await c.replicate_to(vid, requests)
+                report["rereplicated"] += 1
+            except Exception as exc:  # noqa: BLE001 - reported, not fatal
+                logger.warning(
+                    "repair: re-replicating %r onto %s failed: %s",
+                    key,
+                    vid,
+                    exc,
+                )
+                report["failed"].append(key)
+    if dead:
+        logger.info(
+            "repair(%s): replaced %s, re-replicated %d key(s), lost %s",
+            store_name,
+            report["replaced"],
+            report["rereplicated"],
+            report["lost"] or "none",
+        )
+    return report
+
+
 async def barrier(
     name: str, store_name: str = DEFAULT_STORE, timeout: float = 300.0
 ) -> None:
@@ -415,6 +521,8 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
             logger.exception("controller teardown failed")
         if handle.volume_mesh is not None:
             await handle.volume_mesh.stop()
+        for mesh in handle.repair_meshes or []:
+            await mesh.stop()
         if handle.inproc_volume is not None:
             await _stop_colocated_volume(handle.inproc_volume)
         await stop_singleton(f"ts_{store_name}_controller")
@@ -440,6 +548,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "repair",
     "reset_client",
     "shutdown",
     "wait_for",

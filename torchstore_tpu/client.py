@@ -71,6 +71,9 @@ class LocalClient:
         # healthy replicas, so a replicated key survives a volume death
         # transparently (cleared when a later health check reports ok).
         self._dead_volumes: set[str] = set()
+        # Bumped whenever the volume map is dropped as stale (repair
+        # replaced actors); _fetch retries once after any bump.
+        self._refresh_epoch = 0
 
     @property
     def controller(self) -> ActorRef:
@@ -100,11 +103,6 @@ class LocalClient:
             )
             for vid, info in vmap.items()
         }
-
-    def _own_volume(self) -> StorageVolumeRef:
-        client_id = self._strategy.get_client_id()
-        vid = self._strategy.select_volume_id(client_id, list(self._volume_refs))
-        return self._volume_refs[vid]
 
     def _put_volumes(self) -> list[StorageVolumeRef]:
         """Every volume a put writes to (primary + replicas)."""
@@ -193,31 +191,28 @@ class LocalClient:
         if not landed:
             raise failed[0][1]
         tracker.track_step("data_plane", nbytes)
-        # Two-plane invariant: metadata notify happens only after the data
-        # landed (/root/reference/torchstore/client.py:86-90). One RPC
-        # carries every replica id.
-        metas = [r.meta_only() for r in requests]
-        await self._controller.notify_put_batch.call_one(
-            metas, [v.volume_id for v in landed]
-        )
-        if failed:
+        for volume, exc in failed:
             # Partial replication failure on an OVERWRITE would leave the
             # failed replica serving the previous value under still-
-            # committed metadata — detach its entries so reads only ever
-            # see the volumes holding the new bytes. The put succeeds at
-            # degraded redundancy; the next successful put re-replicates.
-            keys = list({r.key for r in requests})
-            for volume, exc in failed:
-                logger.warning(
-                    "replicated put degraded: volume %s failed (%s); "
-                    "detaching its copies of %d key(s)",
-                    volume.volume_id,
-                    exc,
-                    len(keys),
-                )
-                await self._controller.notify_detach_batch.call_one(
-                    keys, volume.volume_id
-                )
+            # committed metadata — the notify below atomically detaches
+            # its copies of exactly these metas, so readers only ever see
+            # volumes holding the new bytes. The put succeeds at degraded
+            # redundancy; the next successful put re-replicates.
+            logger.warning(
+                "replicated put degraded: volume %s failed (%s); detaching "
+                "its stale copies",
+                volume.volume_id,
+                exc,
+            )
+        # Two-plane invariant: metadata notify happens only after the data
+        # landed (/root/reference/torchstore/client.py:86-90). ONE RPC
+        # indexes every landed replica and detaches every failed one — no
+        # window where new metadata coexists with a stale replica location.
+        await self._controller.notify_put_batch.call_one(
+            [r.meta_only() for r in requests],
+            [v.volume_id for v in landed],
+            detach_volume_ids=[v.volume_id for v, _ in failed] or None,
+        )
         tracker.track_step("notify")
         tracker.log_summary()
 
@@ -337,22 +332,25 @@ class LocalClient:
     # ------------------------------------------------------------------
 
     async def _fetch(self, requests: list[Request]) -> list[Any]:
+        epoch = self._refresh_epoch
         try:
             return await self._fetch_once(requests, use_cache=True)
         except (KeyError, ValueError, ActorDiedError) as exc:
-            # Stale location cache (another client deleted/re-published a
-            # key, or its volume died and the key lives elsewhere now):
-            # drop the batch's entries and retry once with a fresh locate.
-            # KeyError covers missing keys/shards; ValueError covers layout
-            # mismatches surfacing as shape errors; ActorDiedError covers
-            # cached locations pointing at dead/restarted volumes.
+            # Stale state (another client deleted/re-published a key, a
+            # volume died and the key lives elsewhere, or repair replaced
+            # an actor our refs predate): drop the batch's cached
+            # locations and retry once fresh. KeyError covers missing
+            # keys/shards; ValueError covers layout mismatches surfacing
+            # as shape errors; ActorDiedError covers dead/stale refs; an
+            # epoch bump means the diagnosis already refreshed the volume
+            # map for us.
             stale = [r.key for r in requests if r.key in self._loc_cache]
-            if not stale:
+            if not stale and self._refresh_epoch == epoch:
                 raise
             for key in stale:
                 self._loc_cache.pop(key, None)
             logger.info(
-                "location cache stale for %d key(s) (%s); re-locating",
+                "stale location/refs for %d key(s) (%s); re-locating",
                 len(stale),
                 exc,
             )
@@ -361,6 +359,9 @@ class LocalClient:
     async def _fetch_once(
         self, requests: list[Request], use_cache: bool
     ) -> list[Any]:
+        # Refs may have been dropped by a stale-ref diagnosis between the
+        # first attempt and this retry; rebuild them from the controller.
+        await self._ensure_setup()
         keys = list({r.key for r in requests})
         located: dict[str, dict[str, StorageInfo]] = {}
         missing = []
@@ -435,11 +436,18 @@ class LocalClient:
                 15.0
             ).call_one(timeout=5.0)
             diagnosis = statuses.get(vid, "unknown volume")
-            for v, status in statuses.items():
-                if status == "ok":
-                    self._dead_volumes.discard(v)
-                else:
-                    self._dead_volumes.add(v)
+            self._dead_volumes = {
+                v for v, status in statuses.items() if status != "ok"
+            }
+            if statuses.get(vid) == "ok":
+                # Our RPC to vid failed but the controller reaches it: OUR
+                # ref is stale (repair swapped in a replacement actor).
+                # Drop cached refs/locations so the retry reconnects to
+                # the fresh fleet instead of re-selecting a dead ref.
+                diagnosis += " (ref was stale; volume map refreshed)"
+                self._volume_refs = None
+                self._loc_cache.clear()
+                self._refresh_epoch += 1
         except Exception:  # noqa: BLE001 - diagnosis is best-effort
             pass
         raise ActorDiedError(
@@ -628,6 +636,36 @@ class LocalClient:
 
     async def exists(self, key: str) -> bool:
         return await self._controller.contains.call_one(key) != "missing"
+
+    # ------------------------------------------------------------------
+    # repair support
+    # ------------------------------------------------------------------
+
+    async def refresh_volumes(self) -> None:
+        """Re-fetch the volume map (repair swapped in replacement actors);
+        drops cached locations and dead-volume marks so retries see the
+        fresh fleet."""
+        self._volume_refs = None
+        self._loc_cache.clear()
+        self._dead_volumes.clear()
+        await self._ensure_setup()
+
+    async def replicate_to(self, volume_id: str, requests: list[Request]) -> None:
+        """Targeted put: land ``requests`` on ONE specific volume and index
+        them there (bypasses strategy placement — the re-replication path
+        of ``ts.repair``)."""
+        await self._ensure_setup()
+        volume = self._volume_refs[volume_id]
+        buffer = create_transport_buffer(volume, self._config)
+        if buffer.supports_batch_puts:
+            await buffer.put_to_storage_volume(volume, requests)
+        else:
+            for req in requests:
+                b = create_transport_buffer(volume, self._config)
+                await b.put_to_storage_volume(volume, [req])
+        await self._controller.notify_put_batch.call_one(
+            [r.meta_only() for r in requests], volume_id
+        )
 
     # ------------------------------------------------------------------
     # blocking waits

@@ -239,11 +239,21 @@ class Controller(Actor):
 
     @endpoint
     async def notify_put_batch(
-        self, metas: list[Request], volume_id: "str | list[str]"
+        self,
+        metas: list[Request],
+        volume_id: "str | list[str]",
+        detach_volume_ids: Optional[list[str]] = None,
     ) -> None:
         """Index ``metas`` as stored on ``volume_id`` — a single id, or a
         LIST of ids for replicated puts (one RPC, one generation bump, and
-        counters measuring LOGICAL puts regardless of replication)."""
+        counters measuring LOGICAL puts regardless of replication).
+
+        ``detach_volume_ids``: replicas whose data-plane write FAILED for
+        exactly these metas — their stale copies are detached in the same
+        indexing step (no await between index and detach), so no reader
+        ever sees new metadata alongside a stale-replica location. Detach
+        is meta-granular: for sharded keys only the failed shard's coords
+        are removed; sibling ranks' shards on the same volume survive."""
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
@@ -281,27 +291,29 @@ class Controller(Actor):
             self.counters["puts"] += 1
             if meta.tensor_meta is not None:
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
+            for vid in detach_volume_ids or ():
+                self._detach_meta(meta, vid)
         await self._bump({meta.key for meta in metas})
 
-    @endpoint
-    async def notify_detach_batch(
-        self, keys: list[str], volume_id: str
-    ) -> None:
-        """Drop ``volume_id``'s entries for ``keys`` from the index (the
-        volume's copies are stale/unreachable — e.g. a replica that missed
-        an overwrite). A key with no volumes left disappears; a sharded key
-        missing coords becomes partial and reads fail loudly."""
-        changed = set()
-        for key in keys:
-            infos = self.index.get(key)
-            if infos is None or volume_id not in infos:
-                continue
-            del infos[volume_id]
-            changed.add(key)
-            if not infos:
-                self.index.pop(key, None)
-        if changed:
-            await self._bump(changed)
+    def _detach_meta(self, meta: Request, volume_id: str) -> None:
+        """Remove ONE meta's footprint on ``volume_id``: the exact shard
+        coords for sharded keys (sibling shards on the volume survive), the
+        whole entry for tensors/objects. A key with no volumes left
+        disappears; a sharded key missing coords reads as partial (loud)."""
+        infos = self.index.get(meta.key)
+        if infos is None or volume_id not in infos:
+            return
+        info = infos[volume_id]
+        if (
+            meta.tensor_slice is not None
+            and info.object_type == ObjectType.TENSOR_SLICE
+        ):
+            info.tensor_slices.pop(meta.tensor_slice.coordinates, None)
+            if info.tensor_slices:
+                return
+        del infos[volume_id]
+        if not infos:
+            self.index.pop(meta.key, None)
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:
@@ -424,6 +436,47 @@ class Controller(Actor):
             *(ping(vid, ref) for vid, ref in self.volume_refs.items())
         )
         return dict(results)
+
+    @endpoint
+    async def replace_volume(
+        self, volume_id: str, new_ref: ActorRef, hostname: str
+    ) -> dict[str, Any]:
+        """Swap in a replacement actor for a dead volume (elastic repair —
+        the recovery story SURVEY §5 notes the reference lacks). The dead
+        volume's index entries are detached (the replacement starts empty);
+        returns what it held so the repairer can re-replicate:
+
+        - ``recoverable``: {key: [TensorSlice, ...] | None} — entries another
+          volume still serves (None = whole tensor/object, else the shard
+          slices this volume held).
+        - ``lost``: keys with NO surviving copy (now absent from the index —
+          reads fail loudly with missing instead of hanging on a dead ref).
+        """
+        if volume_id not in self.volume_refs:
+            raise ValueError(f"unknown volume {volume_id!r}")
+        self.volume_refs[volume_id] = new_ref
+        self.volume_hostnames[volume_id] = hostname
+        recoverable: dict[str, Any] = {}
+        lost: list[str] = []
+        changed = set()
+        for key in list(self.index):
+            infos = self.index[key]
+            info = infos.pop(volume_id, None)
+            if info is None:
+                continue
+            changed.add(key)
+            if infos:
+                recoverable[key] = (
+                    list(info.tensor_slices.values())
+                    if info.object_type == ObjectType.TENSOR_SLICE
+                    else None
+                )
+            else:
+                lost.append(key)
+                self.index.pop(key, None)
+        if changed:
+            await self._bump(changed)
+        return {"recoverable": recoverable, "lost": lost}
 
     @endpoint
     async def rebuild_index(self) -> int:

@@ -220,7 +220,12 @@ class StorageVolume(Actor):
     """Data-plane actor (/root/reference/torchstore/storage_volume.py:27-99)."""
 
     def __init__(self, strategy=None, storage: Optional[StorageImpl] = None):
-        if strategy is not None:
+        # Explicit id override: repair spawns a REPLACEMENT volume that must
+        # adopt the dead volume's id regardless of strategy env derivation.
+        forced_id = os.environ.get("TORCHSTORE_TPU_VOLUME_ID")
+        if forced_id:
+            self.volume_id = forced_id
+        elif strategy is not None:
             self.volume_id = strategy.get_volume_id()
         else:
             self.volume_id = os.environ.get("RANK", "0")
