@@ -259,6 +259,21 @@ async def test_controller_stats(store):
     assert stats["puts"] >= 1 and stats["put_bytes"] >= 64
     assert stats["locates"] >= 1 and stats["num_keys"] >= 1
     assert stats["num_volumes"] == 1
+    assert "volumes" not in stats  # per-volume fan-out is opt-in
+
+
+async def test_volume_stats_fanout(store):
+    await ts.put("sv", np.ones((8, 8), np.float32), store_name=store)
+    stats = await ts.client(store).controller.stats.call_one(
+        include_volumes=True
+    )
+    (vstats,) = stats["volumes"].values()
+    assert vstats["entries"] >= 1
+    assert vstats["stored_bytes"] >= 256
+    # SHM segment economics appear once the SHM transport served traffic.
+    if "shm" in vstats:
+        assert vstats["shm"]["live_segments"] >= 1
+        assert vstats["shm"]["pool_bytes"] >= 0
 
 
 async def test_delete_prefix(store):

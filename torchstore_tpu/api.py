@@ -443,33 +443,52 @@ async def repair(store_name: str = DEFAULT_STORE) -> dict:
         report["lost"].extend(result["lost"])
         recoverable_by_vid[vid] = result["recoverable"]
     await c.refresh_volumes()
-    # Phase 2: re-replicate; a key whose read fails (e.g. its survivor was
-    # itself among the dead) is reported, never aborts the others.
+    # Phase 2: re-replicate, grouped by KEY ("rereplicated" counts keys,
+    # matching the report's documentation) with each payload fetched ONCE
+    # however many replacements need it — but replicated per volume with
+    # the exact slices THAT volume held (different dead volumes may have
+    # held different shards of one key). A key whose read fails (e.g. its
+    # survivor was itself among the dead) is reported, never aborts the
+    # others.
+    plan: dict[str, dict[str, Any]] = {}  # key -> {vid: slices | None}
     for vid, recoverable in recoverable_by_vid.items():
         for key, slices in recoverable.items():
             if key in report["lost"]:
                 continue  # its last copy died in a later replacement
-            try:
+            plan.setdefault(key, {})[vid] = slices
+    for key, by_vid in plan.items():
+        try:
+            whole_requests = None
+            slice_cache: dict = {}
+            for vid, slices in by_vid.items():
                 if slices is None:
-                    value = await c.get(key)
-                    requests = LocalClient._value_to_requests(key, value)
+                    if whole_requests is None:
+                        value = await c.get(key)
+                        whole_requests = LocalClient._value_to_requests(
+                            key, value
+                        )
+                    requests = whole_requests
                 else:
                     requests = []
                     for ts in slices:
-                        arr = await c.get(key, like=ts)
+                        ckey = (ts.offsets, ts.local_shape)
+                        arr = slice_cache.get(ckey)
+                        if arr is None:
+                            arr = await c.get(key, like=ts)
+                            slice_cache[ckey] = arr
                         requests.append(
                             Request.from_tensor_slice(key, ts, arr)
                         )
                 await c.replicate_to(vid, requests)
-                report["rereplicated"] += 1
-            except Exception as exc:  # noqa: BLE001 - reported, not fatal
-                logger.warning(
-                    "repair: re-replicating %r onto %s failed: %s",
-                    key,
-                    vid,
-                    exc,
-                )
-                report["failed"].append(key)
+            report["rereplicated"] += 1
+        except Exception as exc:  # noqa: BLE001 - reported, not fatal
+            logger.warning(
+                "repair: re-replicating %r onto %s failed: %s",
+                key,
+                sorted(by_vid),
+                exc,
+            )
+            report["failed"].append(key)
     if dead:
         logger.info(
             "repair(%s): replaced %s, re-replicated %d key(s), lost %s",

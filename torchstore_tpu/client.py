@@ -82,17 +82,23 @@ class LocalClient:
     async def _ensure_setup(self) -> None:
         if self._volume_refs is not None:
             return
+        await self._load_volumes()
+
+    async def _load_volumes(self) -> None:
+        """(Re)fetch strategy + volume map. The swap at the end is a single
+        atomic assignment: concurrent operations keep using the previous
+        (possibly stale but structurally valid) map mid-await — they fail
+        and retry rather than crash on a half-built state."""
         self._controller.rpc_timeout = self._config.rpc_timeout
-        self._strategy = await self._controller.get_strategy.call_one()
+        strategy = await self._controller.get_strategy.call_one()
         vmap = await self._controller.get_volume_map.call_one()
-        forced = (
-            self._strategy.default_transport_type if self._strategy else None
-        )
+        forced = strategy.default_transport_type if strategy else None
         for info in vmap.values():
             # Every endpoint call on these refs inherits the configured RPC
             # deadline (a wedged-but-alive volume must never hang a client
             # forever — the supervision Monarch provides the reference).
             info["ref"].rpc_timeout = self._config.rpc_timeout
+        self._strategy = strategy
         self._volume_refs = {
             vid: StorageVolumeRef(
                 actor=info["ref"],
@@ -103,6 +109,20 @@ class LocalClient:
             )
             for vid, info in vmap.items()
         }
+
+    async def _land_requests(
+        self, volume: StorageVolumeRef, requests: list[Request]
+    ) -> None:
+        """Data-plane landing of ``requests`` on one volume (batched where
+        the transport supports it) — shared by put_batch and replicate_to."""
+        buffer = create_transport_buffer(volume, self._config)
+        if buffer.supports_batch_puts:
+            await buffer.put_to_storage_volume(volume, requests)
+            return
+        await buffer.put_to_storage_volume(volume, requests[:1])
+        for req in requests[1:]:
+            b = create_transport_buffer(volume, self._config)
+            await b.put_to_storage_volume(volume, [req])
 
     def _put_volumes(self) -> list[StorageVolumeRef]:
         """Every volume a put writes to (primary + replicas)."""
@@ -160,15 +180,8 @@ class LocalClient:
         nbytes = sum(r.nbytes for r in requests)
 
         async def put_to(volume: StorageVolumeRef) -> None:
-            buffer = create_transport_buffer(volume, self._config)
             try:
-                if buffer.supports_batch_puts:
-                    await buffer.put_to_storage_volume(volume, requests)
-                else:
-                    await buffer.put_to_storage_volume(volume, requests[:1])
-                    for req in requests[1:]:
-                        b = create_transport_buffer(volume, self._config)
-                        await b.put_to_storage_volume(volume, [req])
+                await self._land_requests(volume, requests)
             except (ActorDiedError, ConnectionError, OSError) as exc:
                 # Bulk/peer transports surface volume death as
                 # ConnectionError — normalize so callers and the failover
@@ -445,9 +458,12 @@ class LocalClient:
                 # Drop cached refs/locations so the retry reconnects to
                 # the fresh fleet instead of re-selecting a dead ref.
                 diagnosis += " (ref was stale; volume map refreshed)"
-                self._volume_refs = None
                 self._loc_cache.clear()
                 self._refresh_epoch += 1
+                try:
+                    await self._load_volumes()
+                except Exception:  # noqa: BLE001 - retry will re-attempt
+                    pass
         except Exception:  # noqa: BLE001 - diagnosis is best-effort
             pass
         raise ActorDiedError(
@@ -645,24 +661,17 @@ class LocalClient:
         """Re-fetch the volume map (repair swapped in replacement actors);
         drops cached locations and dead-volume marks so retries see the
         fresh fleet."""
-        self._volume_refs = None
         self._loc_cache.clear()
         self._dead_volumes.clear()
-        await self._ensure_setup()
+        self._refresh_epoch += 1
+        await self._load_volumes()
 
     async def replicate_to(self, volume_id: str, requests: list[Request]) -> None:
         """Targeted put: land ``requests`` on ONE specific volume and index
         them there (bypasses strategy placement — the re-replication path
         of ``ts.repair``)."""
         await self._ensure_setup()
-        volume = self._volume_refs[volume_id]
-        buffer = create_transport_buffer(volume, self._config)
-        if buffer.supports_batch_puts:
-            await buffer.put_to_storage_volume(volume, requests)
-        else:
-            for req in requests:
-                b = create_transport_buffer(volume, self._config)
-                await b.put_to_storage_volume(volume, [req])
+        await self._land_requests(self._volume_refs[volume_id], requests)
         await self._controller.notify_put_batch.call_one(
             [r.meta_only() for r in requests], volume_id
         )

@@ -520,8 +520,11 @@ class Controller(Actor):
         return count
 
     @endpoint
-    async def stats(self) -> dict:
-        """Store-level observability: counters + index summary."""
+    async def stats(self, include_volumes: bool = False) -> dict:
+        """Store-level observability: counters + index summary.
+        ``include_volumes=True`` additionally fans out to every volume for
+        its data-plane view (entries, stored bytes, SHM segment economics);
+        unreachable volumes report an ``error`` string instead."""
         indexed_bytes = 0
         sharded_keys = 0
         for infos in self.index.values():
@@ -541,13 +544,29 @@ class Controller(Actor):
                 elif info.tensor_meta is not None:
                     indexed_bytes += info.tensor_meta.nbytes
             sharded_keys += int(key_is_sharded)
-        return {
+        out = {
             **self.counters,
             "num_keys": len(self.index),
             "sharded_keys": sharded_keys,
             "num_volumes": len(self.volume_refs),
             "indexed_bytes_approx": indexed_bytes,
         }
+        if include_volumes:
+            import asyncio
+
+            async def one(vid: str, ref: ActorRef):
+                try:
+                    return vid, await asyncio.wait_for(
+                        ref.stats.call_one(), timeout=10.0
+                    )
+                except Exception as exc:  # noqa: BLE001 - reported inline
+                    return vid, {"error": f"{type(exc).__name__}: {exc}"}
+
+            results = await asyncio.gather(
+                *(one(vid, ref) for vid, ref in self.volume_refs.items())
+            )
+            out["volumes"] = dict(results)
+        return out
 
     @endpoint
     async def teardown(self) -> None:

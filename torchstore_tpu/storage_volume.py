@@ -310,6 +310,47 @@ class StorageVolume(Actor):
         return fn()
 
     @endpoint
+    async def stats(self) -> dict:
+        """Data-plane observability: stored entry/byte counts plus SHM
+        segment economics (live/retired/pooled bytes, outstanding read
+        leases) — the per-volume view controller.stats() aggregates."""
+        entries = 0
+        stored_bytes = 0
+        kv = getattr(self.store, "kv", {})
+        for entry in kv.values():
+            entries += 1
+            if entry.get("type") == "tensor":
+                arr = entry.get("tensor")
+                stored_bytes += int(getattr(arr, "nbytes", 0))
+            elif entry.get("type") == "sharded":
+                for shard in entry.get("shards", {}).values():
+                    stored_bytes += int(
+                        getattr(shard.get("tensor"), "nbytes", 0)
+                    )
+        out = {
+            "volume_id": self.volume_id,
+            "entries": entries,
+            "stored_bytes": stored_bytes,
+        }
+        from torchstore_tpu.transport.shared_memory import ShmServerCache
+
+        cache = self.ctx.peek(ShmServerCache)
+        if cache is not None:
+            out["shm"] = {
+                "live_segments": sum(
+                    len(by_coords) for by_coords in cache.by_key.values()
+                ),
+                "retired_segments": len(cache.retired),
+                "pool_segments": sum(
+                    len(s) for s in cache.free_by_size.values()
+                ),
+                "pool_bytes": cache.free_bytes,
+                "read_leases": sum(cache.grants.values()),
+                "staged": len(cache.staged),
+            }
+        return out
+
+    @endpoint
     async def reset(self) -> None:
         self.store.reset()
         self.ctx.clear()

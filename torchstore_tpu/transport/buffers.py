@@ -72,6 +72,11 @@ class TransportContext:
             self._caches[cache_cls] = cache
         return cache
 
+    def peek(self, cache_cls: type) -> Any:
+        """The cache of this type if one was ever created, else None (stats
+        paths must not instantiate caches as a side effect)."""
+        return self._caches.get(cache_cls)
+
     def delete_key(self, key: str) -> None:
         for cache in self._caches.values():
             cache.delete_key(key)
