@@ -1,0 +1,67 @@
+"""Replication + elastic repair walkthrough.
+
+A 3-volume store with 2-way replicated puts: a volume process is killed
+mid-run, reads keep serving from the surviving replica, and ts.repair()
+replaces the dead volume and re-replicates its keys. Run:
+
+    python examples/fault_tolerance.py
+"""
+
+import asyncio
+
+import numpy as np
+
+import torchstore_tpu as ts
+
+STORE = "ft_example"
+
+
+async def main() -> None:
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=ts.LocalRankStrategy(replication=2),
+        store_name=STORE,
+    )
+    try:
+        weights = {f"layer{i}": np.random.rand(256).astype(np.float32) for i in range(4)}
+        await ts.put_state_dict("model", weights, store_name=STORE)
+
+        client = ts.client(STORE)
+        located = await client.controller.locate_volumes.call_one(["model/layer0"])
+        print(f"each key lives on {len(located['model/layer0'])} volumes")
+
+        # Kill one replica's process out from under the store.
+        victim = sorted(located["model/layer0"])[0]
+        vmap = await client.controller.get_volume_map.call_one()
+        target = vmap[victim]["ref"]
+        from torchstore_tpu import api
+
+        handle = api._stores[STORE]
+        for ref, proc in zip(handle.volume_mesh.refs, handle.volume_mesh._processes):
+            if (ref.host, ref.port, ref.name) == (target.host, target.port, target.name):
+                proc.kill()
+                proc.join(5)
+        print(f"killed volume {victim!r}")
+
+        # Reads fail over to the surviving replica.
+        out = await ts.get_state_dict("model", store_name=STORE)
+        np.testing.assert_array_equal(out["layer0"], weights["layer0"])
+        print("reads keep serving from the surviving replica")
+
+        # Heal the fleet: replacement volume + re-replication.
+        report = await ts.repair(store_name=STORE)
+        print(f"repair: {report}")
+        assert report["replaced"] == [victim] and not report["lost"]
+
+        statuses = await client.controller.check_volumes.call_one()
+        assert all(s == "ok" for s in statuses.values())
+        out = await ts.get_state_dict("model", store_name=STORE)
+        np.testing.assert_array_equal(out["layer3"], weights["layer3"])
+        print("fleet healthy; replication restored")
+    finally:
+        await ts.shutdown(STORE)
+    print("fault-tolerance example OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -24,16 +24,18 @@ async def _kill_volume(store_name: str, volume_id: str) -> None:
     vmap = await client.controller.get_volume_map.call_one()
     target = vmap[volume_id]["ref"]
     handle = api._stores[store_name]
-    for idx, ref in enumerate(handle.volume_mesh.refs):
-        if (ref.host, ref.port, ref.name) == (
-            target.host,
-            target.port,
-            target.name,
-        ):
-            proc = handle.volume_mesh._processes[idx]
-            proc.kill()
-            proc.join(5)
-            return
+    meshes = [handle.volume_mesh, *(handle.repair_meshes or [])]
+    for mesh in meshes:
+        for idx, ref in enumerate(mesh.refs):
+            if (ref.host, ref.port, ref.name) == (
+                target.host,
+                target.port,
+                target.name,
+            ):
+                proc = mesh._processes[idx]
+                proc.kill()
+                proc.join(5)
+                return
     raise AssertionError(f"no process found for volume {volume_id!r}")
 
 
