@@ -338,6 +338,7 @@ async def put_state_dict(
     key: str,
     state_dict: Any,
     transfer_dtype=None,
+    transfer_quant: Optional[str] = None,
     direct: bool = False,
     rank: int = 0,
     num_ranks: int = 1,
@@ -350,6 +351,7 @@ async def put_state_dict(
         key,
         state_dict,
         transfer_dtype=transfer_dtype,
+        transfer_quant=transfer_quant,
         direct=direct,
         rank=rank,
         num_ranks=num_ranks,

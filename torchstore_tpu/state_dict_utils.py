@@ -15,13 +15,14 @@ through the normal resharding pipeline), numpy arrays, or arbitrary objects.
 from __future__ import annotations
 
 import weakref
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
 from torchstore_tpu import sharding as shd
 from torchstore_tpu import torch_interop
 from torchstore_tpu.logging import LatencyTracker, get_logger
+from torchstore_tpu.transport.types import _np_dtype  # bf16-aware name->dtype
 
 logger = get_logger("torchstore_tpu.state_dict")
 
@@ -167,6 +168,117 @@ def cast_floating_tensors(flat: dict[str, Any], transfer_dtype) -> dict[str, Any
         else:
             out[key] = value.astype(transfer_dtype)
     return out
+
+
+# --------------------------------------------------------------------------
+# int8 transfer quantization
+# --------------------------------------------------------------------------
+
+
+
+
+def quantize_int8(flat: dict[str, Any]) -> tuple[dict[str, Any], dict]:
+    """Symmetric per-tensor int8 quantization of floating leaves: each
+    becomes round(x/scale) int8 with scale = max|x|/127. Returns
+    (quantized_flat, {"fmt", "scales", "dtypes"}) — the metadata rides the
+    MAPPING commit marker so readers always find scales alongside a
+    complete push. jax leaves quantize on-device (sharding preserved);
+    torch leaves through their zero-copy views. 4x fewer wire/store bytes
+    than f32, 2x fewer than bf16 — the cross-slice (DCN) weight-sync
+    bandwidth optimization."""
+    out: dict[str, Any] = {}
+    scales: dict[str, float] = {}
+    dtypes: dict[str, str] = {}
+    for key, value in flat.items():
+        if torch_interop.is_torch_tensor(value):
+            value = torch_interop.to_numpy_view(value)
+        if not _is_floating(value):
+            out[key] = value
+            continue
+        dtypes[key] = str(value.dtype)
+        if shd.is_jax_array(value):
+            import jax.numpy as jnp
+
+            if not value.is_fully_addressable:
+                # The scale must be GLOBAL and identical on every rank; an
+                # eager max over a multi-controller array can't compute it
+                # (and per-rank scales would decode inconsistently).
+                raise NotImplementedError(
+                    f"transfer_quant on non-fully-addressable array "
+                    f"{key!r}: compute the quantized int8 array + scale "
+                    "inside your jitted step (global max via a collective) "
+                    "and push those, or use transfer_dtype instead"
+                )
+            amax = (
+                float(jnp.max(jnp.abs(value.astype(jnp.float32))))
+                if value.size
+                else 0.0
+            )
+            scale = amax / 127.0 if amax > 0 else 1.0
+            out[key] = jnp.round(
+                value.astype(jnp.float32) / scale
+            ).astype(jnp.int8)
+        else:
+            arr = np.asarray(value).astype(np.float32, copy=False)
+            amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+            scale = amax / 127.0 if amax > 0 else 1.0
+            out[key] = np.round(arr / scale).astype(np.int8)
+        scales[key] = scale
+    return out, {"fmt": "int8", "scales": scales, "dtypes": dtypes}
+
+
+def _dequantize(q: Any, scale: float, dtype_name: str, target: Any = None):
+    """int8 -> original dtype. ``target`` (numpy view of user memory) gets
+    the result in place; jax arrays dequantize on-device (elementwise, so a
+    resharded fetch keeps its sharding)."""
+    if shd.is_jax_array(q):
+        import jax.numpy as jnp
+
+        return (q.astype(jnp.float32) * scale).astype(_np_dtype(dtype_name))
+    dequant = q.astype(np.float32) * np.float32(scale)
+    if target is not None:
+        np.copyto(target, dequant.astype(target.dtype))
+        return target
+    return dequant.astype(_np_dtype(dtype_name))
+
+
+def _quant_fetch_target(user_leaf: Any) -> Any:
+    """Fetch target for a quantized entry: the stored bytes are int8, so
+    user arrays can't land in place — jax targets fetch an int8 spec WITH
+    their sharding (reshard happens on the quantized bytes, 4x cheaper;
+    dequant runs on-device afterwards); everything else fetches plain."""
+    if shd.is_jax_array(user_leaf) or shd.is_sharded_spec(user_leaf):
+        import jax
+
+        return jax.ShapeDtypeStruct(
+            user_leaf.shape, np.int8, sharding=user_leaf.sharding
+        )
+    return None
+
+
+def _dequant_result(got: Any, scale: float, dtype_name: str, user_leaf: Any):
+    """Dequantize a fetched int8 payload toward the user's leaf: in place
+    for numpy/torch targets (their objects are returned), on-device for jax
+    targets, plain conversion otherwise."""
+    if torch_interop.is_torch_tensor(user_leaf):
+        view = torch_interop.to_numpy_view(user_leaf, allow_copy=False)
+        _dequantize(np.asarray(got), scale, dtype_name, target=view)
+        return user_leaf
+    if isinstance(user_leaf, np.ndarray):
+        return _dequantize(np.asarray(got), scale, dtype_name, target=user_leaf)
+    if shd.is_jax_array(got):
+        # Honor the TARGET's dtype like every other branch (a f32 spec over
+        # a bf16-sourced push yields f32, the orbax restore idiom).
+        want = (
+            str(user_leaf.dtype) if hasattr(user_leaf, "dtype") else dtype_name
+        )
+        return _dequantize(got, scale, want)
+    result = _dequantize(np.asarray(got), scale, dtype_name)
+    if shd.is_plain_spec(user_leaf):
+        import jax.numpy as jnp
+
+        return jnp.asarray(result, dtype=user_leaf.dtype)
+    return result
 
 
 # --------------------------------------------------------------------------
@@ -317,10 +429,26 @@ async def put_state_dict(
     key: str,
     state_dict: Any,
     transfer_dtype=None,
+    transfer_quant: Optional[str] = None,
     direct: bool = False,
     rank: int = 0,
     num_ranks: int = 1,
 ) -> None:
+    if transfer_quant is not None:
+        if transfer_quant != "int8":
+            raise ValueError(
+                f"unsupported transfer_quant {transfer_quant!r} (only 'int8')"
+            )
+        if transfer_dtype is not None:
+            raise ValueError(
+                "transfer_quant and transfer_dtype are mutually exclusive "
+                "(int8 defines the wire format)"
+            )
+        if direct:
+            raise ValueError(
+                "transfer_quant is a buffered-path feature (the direct path "
+                "serves live staging buffers, not encoded copies)"
+            )
     if direct:
         return await _put_state_dict_direct(
             client, key, state_dict, transfer_dtype, rank, num_ranks
@@ -332,14 +460,20 @@ async def put_state_dict(
             f"{MAPPING_KEY!r} is a reserved top-level state-dict key (it is "
             "the commit marker); rename that entry"
         )
+    marker: dict = {"mapping": mapping}
     if transfer_dtype is not None:
         flat = cast_floating_tensors(flat, transfer_dtype)
+    if transfer_quant is not None:
+        flat, quant_meta = quantize_int8(flat)
+        marker["quant"] = quant_meta
     tracker.track_step("flatten")
     await client.put_batch({_store_key(key, k): v for k, v in flat.items()})
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("put_batch", nbytes)
-    # Commit marker LAST: its presence implies every entry above landed.
-    await client.put(_store_key(key, MAPPING_KEY), {"mapping": mapping})
+    # Commit marker LAST: its presence implies every entry above landed
+    # (and carries the quantization scales, so readers always see them
+    # together with a complete push).
+    await client.put(_store_key(key, MAPPING_KEY), marker)
     tracker.track_step("commit_marker")
     tracker.log_summary(level=20)  # INFO: weight-sync phases are user-facing
 
@@ -407,6 +541,8 @@ async def get_state_dict(
             "absent: either never pushed or push still in flight)"
         ) from exc
     mapping = marker["mapping"]
+    quant = marker.get("quant")
+    scales = quant["scales"] if quant else {}
     tracker.track_step("mapping")
 
     if user_state_dict is not None:
@@ -426,19 +562,33 @@ async def get_state_dict(
                 f"user dict: {sorted(missing)[:5]} (pass strict=False to "
                 "pull a subset)"
             )
-        targets = {
-            _store_key(key, k): (v if _is_fetch_target(v) else None)
-            for k, v in user_flat.items()
-        }
+        targets = {}
+        for k, v in user_flat.items():
+            if k in scales:
+                targets[_store_key(key, k)] = _quant_fetch_target(v)
+            else:
+                targets[_store_key(key, k)] = v if _is_fetch_target(v) else None
         fetched = await client.get_batch(targets)
-        flat = {k: fetched[_store_key(key, k)] for k in user_flat}
+        flat = {}
+        for k, v in user_flat.items():
+            got = fetched[_store_key(key, k)]
+            if k in scales:
+                got = _dequant_result(got, scales[k], quant["dtypes"][k], v)
+            flat[k] = got
         mapping = user_mapping
     else:
         leaf_keys = sorted(_leaf_keys(mapping))
         fetched = await client.get_batch(
             {_store_key(key, k): None for k in leaf_keys}
         )
-        flat = {k: fetched[_store_key(key, k)] for k in leaf_keys}
+        flat = {}
+        for k in leaf_keys:
+            got = fetched[_store_key(key, k)]
+            if k in scales:
+                got = _dequantize(
+                    np.asarray(got), scales[k], quant["dtypes"][k]
+                )
+            flat[k] = got
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("get_batch", nbytes)
     result = unflatten_state_dict(flat, mapping)

@@ -171,8 +171,14 @@ class ShmSegment:
         return self._base_addr
 
     def view(self, meta: TensorMeta, offset: int = 0) -> np.ndarray:
+        count = int(np.prod(meta.shape))
+        if count == 0:
+            # Zero-size tensors carry no bytes; an empty array of the right
+            # shape/dtype IS the value (np.frombuffer(count=0) would also
+            # work but the reshape from the `or 1` minimum-map hack can't).
+            return np.empty(meta.shape, meta.np_dtype)
         return np.frombuffer(
-            self.mmap, dtype=meta.np_dtype, count=int(np.prod(meta.shape) or 1), offset=offset
+            self.mmap, dtype=meta.np_dtype, count=count, offset=offset
         ).reshape(meta.shape)
 
     def strided_view(

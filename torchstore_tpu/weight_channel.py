@@ -63,7 +63,11 @@ class WeightPublisher:
         return self._client
 
     async def publish(
-        self, state_dict: Any, transfer_dtype=None, direct: bool = False
+        self,
+        state_dict: Any,
+        transfer_dtype=None,
+        transfer_quant: Optional[str] = None,
+        direct: bool = False,
     ) -> int:
         """Write the next version, advance LATEST, GC old versions. Returns
         the published version number. A restarted publisher resumes after
@@ -94,6 +98,7 @@ class WeightPublisher:
             data_key,
             state_dict,
             transfer_dtype=transfer_dtype,
+            transfer_quant=transfer_quant,
             direct=direct,
         )
         # Pointer write LAST: subscribers woken by it see a committed dict.
