@@ -158,6 +158,22 @@ async def test_empty_and_nonaddressable_leaves(store):
     assert out["e_np"].shape == (0, 8) and np.asarray(out["e_jx"]).shape == (0, 8)
 
 
+async def test_nonfinite_weights_rejected(store):
+    # NaN would silently zero sub-unit weights (scale falls back to 1);
+    # Inf would dequantize to all-NaN. Both must fail loudly.
+    bad = np.random.randn(8).astype(np.float32)
+    bad[3] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        await ts.put_state_dict(
+            "nf", {"w": bad}, transfer_quant="int8", store_name="q8"
+        )
+    bad[3] = np.inf
+    with pytest.raises(ValueError, match="non-finite"):
+        await ts.put_state_dict(
+            "nf", {"w": bad}, transfer_quant="int8", store_name="q8"
+        )
+
+
 async def test_zero_tensor_quantizes(store):
     sd = {"w": np.zeros(16, np.float32)}
     await ts.put_state_dict("mz", sd, transfer_quant="int8", store_name="q8")

@@ -52,6 +52,11 @@ class LlamaConfig:
     # parallelism); otherwise dense is used.
     attn_impl: str = "dense"
     mesh: Any = None
+    # Autoregressive decoding: when True, attention maintains a per-layer
+    # k/v cache (flax 'cache' collection, created lazily under
+    # mutable=["cache"]) of length max_cache_len. See models/generate.py.
+    decode: bool = False
+    max_cache_len: int = 0
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -195,8 +200,11 @@ class Attention(nn.Module):
         q = dense((cfg.num_heads, cfg.head_dim), "q_proj", ("embed", "heads", None))(x)
         k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj", ("embed", "kv_heads", None))(x)
         v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj", ("embed", "kv_heads", None))(x)
-        q, k = rope(q, k, positions, cfg.rope_theta)
-        out = _attend(cfg, q, k, v)
+        if cfg.decode:
+            out = self._cached_attention(q, k, v)
+        else:
+            q, k = rope(q, k, positions, cfg.rope_theta)
+            out = _attend(cfg, q, k, v)
         out = nn.DenseGeneral(
             cfg.hidden_size,
             axis=(-2, -1),
@@ -209,6 +217,54 @@ class Attention(nn.Module):
             name="o_proj",
         )(out)
         return out
+
+    def _cached_attention(self, q, k, v):
+        """Decode-mode attention: roll q/k/v into a static-shape k/v cache
+        (``lax.dynamic_update_slice`` at the running index — XLA-friendly,
+        no growing shapes) and attend over the written prefix. Handles both
+        the prefill call (q_len > 1, writes [0, L)) and single-token steps
+        (q_len == 1, writes at idx). Cache variables are created lazily on
+        the first ``mutable=["cache"]`` apply."""
+        cfg = self.cfg
+        if cfg.max_cache_len <= 0:
+            raise ValueError("decode=True requires max_cache_len > 0")
+        b, q_len = q.shape[0], q.shape[1]
+        cached_k = self.variable(
+            "cache",
+            "k",
+            jnp.zeros,
+            (b, cfg.max_cache_len, cfg.num_kv_heads, cfg.head_dim),
+            cfg.dtype,
+        )
+        cached_v = self.variable(
+            "cache",
+            "v",
+            jnp.zeros,
+            (b, cfg.max_cache_len, cfg.num_kv_heads, cfg.head_dim),
+            cfg.dtype,
+        )
+        idx_var = self.variable(
+            "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = idx_var.value
+        positions = jnp.broadcast_to(
+            idx + jnp.arange(q_len)[None, :], (b, q_len)
+        )
+        q, k = rope(q, k, positions, cfg.rope_theta)
+        new_k = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        cached_k.value, cached_v.value = new_k, new_v
+        idx_var.value = idx + q_len
+        # Causal over the WRITTEN prefix: kv position j participates for
+        # query position p iff j <= p (unwritten tail is masked out too).
+        q_pos = idx + jnp.arange(q_len)
+        kv_pos = jnp.arange(cfg.max_cache_len)
+        mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        return jax.nn.dot_product_attention(q, new_k, new_v, mask=mask)
 
 
 def _attend(cfg: LlamaConfig, q, k, v):

@@ -189,16 +189,19 @@ def quantize_int8(flat: dict[str, Any]) -> tuple[dict[str, Any], dict]:
     out: dict[str, Any] = {}
     scales: dict[str, float] = {}
     dtypes: dict[str, str] = {}
-    for key, value in flat.items():
-        if torch_interop.is_torch_tensor(value):
-            value = torch_interop.to_numpy_view(value)
-        if not _is_floating(value):
-            out[key] = value
-            continue
-        dtypes[key] = str(value.dtype)
-        if shd.is_jax_array(value):
-            import jax.numpy as jnp
-
+    converted = {
+        key: (
+            torch_interop.to_numpy_view(value)
+            if torch_interop.is_torch_tensor(value)
+            else value
+        )
+        for key, value in flat.items()
+    }
+    # Pass 1: ENQUEUE every jax reduction before syncing any (one overlapped
+    # dispatch wave instead of a blocking device round trip per leaf).
+    device_amax: dict[str, Any] = {}
+    for key, value in converted.items():
+        if _is_floating(value) and shd.is_jax_array(value):
             if not value.is_fully_addressable:
                 # The scale must be GLOBAL and identical on every rank; an
                 # eager max over a multi-controller array can't compute it
@@ -209,22 +212,46 @@ def quantize_int8(flat: dict[str, Any]) -> tuple[dict[str, Any], dict]:
                     "inside your jitted step (global max via a collective) "
                     "and push those, or use transfer_dtype instead"
                 )
-            amax = (
-                float(jnp.max(jnp.abs(value.astype(jnp.float32))))
-                if value.size
-                else 0.0
-            )
-            scale = amax / 127.0 if amax > 0 else 1.0
+            if value.size:
+                import jax.numpy as jnp
+
+                device_amax[key] = jnp.max(
+                    jnp.abs(value.astype(jnp.float32))
+                )
+    # Pass 2: quantize with the (now mostly ready) scales.
+    for key, value in converted.items():
+        if not _is_floating(value):
+            out[key] = value
+            continue
+        dtypes[key] = str(value.dtype)
+        if shd.is_jax_array(value):
+            import jax.numpy as jnp
+
+            amax = float(device_amax[key]) if key in device_amax else 0.0
+            scale = _checked_scale(key, amax)
             out[key] = jnp.round(
                 value.astype(jnp.float32) / scale
             ).astype(jnp.int8)
         else:
             arr = np.asarray(value).astype(np.float32, copy=False)
             amax = float(np.max(np.abs(arr))) if arr.size else 0.0
-            scale = amax / 127.0 if amax > 0 else 1.0
+            scale = _checked_scale(key, amax)
             out[key] = np.round(arr / scale).astype(np.int8)
         scales[key] = scale
     return out, {"fmt": "int8", "scales": scales, "dtypes": dtypes}
+
+
+def _checked_scale(key: str, amax: float) -> float:
+    """max|x|/127 with non-finite inputs rejected LOUDLY: a NaN amax would
+    silently fall back to scale=1 (zeroing typical sub-unit weights) and an
+    Inf scale would dequantize to all-NaN — exactly the silent corruption a
+    weight-sync layer must never pass along."""
+    if not np.isfinite(amax):
+        raise ValueError(
+            f"cannot quantize {key!r}: contains non-finite values "
+            f"(max|x| = {amax}); publish unquantized or clean the weights"
+        )
+    return amax / 127.0 if amax > 0 else 1.0
 
 
 def _dequantize(q: Any, scale: float, dtype_name: str, target: Any = None):
