@@ -130,6 +130,143 @@ async def test_device_path_reshards_to_target(store):
     )
 
 
+async def test_multi_rank_device_path_in_process(store):
+    """Two SPMD source ranks, each owning a DISJOINT 4-device subset,
+    publish their halves of a global tensor direct=True (Shard-wrapped jax
+    arrays); the consumer pulls the MERGED dict over the device path —
+    no host staging buffers exist on either source (VERDICT r2 item 1)."""
+    devs = jax.devices()
+    w = np.arange(128.0, dtype=np.float32).reshape(16, 8)
+    for r in (0, 1):
+        sub = np.array(devs[4 * r : 4 * r + 4], dtype=object)
+        mesh = jax.sharding.Mesh(sub.reshape(4), ("x",))
+        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+        local = jax.device_put(jax.numpy.asarray(w[8 * r : 8 * r + 8]), sh)
+        sl = ts.TensorSlice(
+            offsets=(8 * r, 0), local_shape=(8, 8), global_shape=(16, 8),
+            coordinates=(r,), mesh_shape=(2,),
+        )
+        await ts.put_state_dict(
+            "mr", {"w": ts.Shard(local, sl)}, direct=True,
+            rank=r, num_ranks=2, store_name=store,
+        )
+    # Both ranks rode the device path: no host handles at all.
+    for r in (0, 1):
+        published = await ts.get(f"mr/rank_{r}", store_name=store)
+        assert published["handles"] == {}
+        assert published["device"] is not None
+        assert published["device"]["source_rank"] == r
+    mesh8 = _mesh()
+    tgt = jax.sharding.NamedSharding(mesh8, jax.sharding.PartitionSpec("x"))
+    out = await ts.get_state_dict(
+        "mr",
+        user_state_dict={
+            "w": jax.ShapeDtypeStruct((16, 8), jax.numpy.float32, sharding=tgt)
+        },
+        direct=True,
+        store_name=store,
+    )
+    assert out["w"].sharding == tgt
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    # Refresh semantics across ranks: republished values are what the next
+    # pull sees (per-pull staging on every rank).
+    for r in (0, 1):
+        sub = np.array(devs[4 * r : 4 * r + 4], dtype=object)
+        mesh = jax.sharding.Mesh(sub.reshape(4), ("x",))
+        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+        local = jax.device_put(jax.numpy.asarray(w[8 * r : 8 * r + 8] * 3), sh)
+        sl = ts.TensorSlice(
+            offsets=(8 * r, 0), local_shape=(8, 8), global_shape=(16, 8),
+            coordinates=(r,), mesh_shape=(2,),
+        )
+        await ts.put_state_dict(
+            "mr", {"w": ts.Shard(local, sl)}, direct=True,
+            rank=r, num_ranks=2, store_name=store,
+        )
+    out2 = await ts.get_state_dict(
+        "mr",
+        user_state_dict={
+            "w": jax.ShapeDtypeStruct((16, 8), jax.numpy.float32, sharding=tgt)
+        },
+        direct=True,
+        store_name=store,
+    )
+    np.testing.assert_array_equal(np.asarray(out2["w"]), w * 3)
+
+
+async def test_multi_rank_device_pull_to_host_target(store):
+    """A numpy consumer of a multi-rank device publish: parts land into the
+    destination array region-wise (consumer-local copies only)."""
+    devs = jax.devices()
+    w = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+    for r in (0, 1):
+        sh = jax.sharding.SingleDeviceSharding(devs[4 * r])
+        local = jax.device_put(jax.numpy.asarray(w[4 * r : 4 * r + 4]), sh)
+        sl = ts.TensorSlice(
+            offsets=(4 * r, 0), local_shape=(4, 8), global_shape=(8, 8),
+            coordinates=(r,), mesh_shape=(2,),
+        )
+        await ts.put_state_dict(
+            "mrh", {"w": ts.Shard(local, sl)}, direct=True,
+            rank=r, num_ranks=2, store_name=store,
+        )
+    target = np.zeros((8, 8), np.float32)
+    out = await ts.get_state_dict(
+        "mrh", user_state_dict={"w": target}, direct=True, store_name=store
+    )
+    assert out["w"] is target  # in-place landing
+    np.testing.assert_array_equal(target, w)
+
+
+async def test_device_id_mismatch_falls_back_to_host_staging(store):
+    """A dest whose jax world lacks the source's device ids degrades to the
+    source-side host-staging control op (_STAGE_HOST) and still gets
+    correct, CURRENT bytes over TCP."""
+    import dataclasses
+
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    sd = {"w": jax.device_put(jax.numpy.arange(64.0), sh)}
+    await ts.put_state_dict("fbk", sd, direct=True, store_name=store)
+    # Tamper the published descriptor so its device ids are unknown here —
+    # exactly what a dest in a different jax world would observe.
+    published = await ts.get("fbk/rank_0", store_name=store)
+    for entry in published["device"]["entries"]:
+        bogus = dataclasses.replace(
+            entry.spec.sharding,
+            device_ids=tuple(i + 1000 for i in entry.spec.sharding.device_ids),
+        )
+        entry.spec = dataclasses.replace(entry.spec, sharding=bogus)
+    from torchstore_tpu.direct_weight_sync import DirectWeightSyncDest
+
+    dest = DirectWeightSyncDest()
+    try:
+        out = await dest.pull_device(
+            [published["device"]], {"w": np.zeros(64, np.float32)}
+        )
+        np.testing.assert_array_equal(out["w"], np.arange(64.0))
+    finally:
+        await dest.close()
+
+
+async def test_device_refresh_rejects_resharded_republish(store):
+    """A republish whose value keeps the part COUNT but changes placement
+    must fail loudly at stage time — staging it against the stale published
+    entries would land shards at wrong offsets (silent corruption)."""
+    sh0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    sd = {"w": jax.device_put(jax.numpy.arange(32.0), sh0)}
+    await ts.put_state_dict("rr", sd, direct=True, store_name=store)
+    # Same shape/count, different device placement.
+    sh1 = jax.sharding.SingleDeviceSharding(jax.devices()[3])
+    sd2 = {"w": jax.device_put(jax.numpy.arange(32.0) * 2, sh1)}
+    await ts.put_state_dict("rr", sd2, direct=True, store_name=store)
+    target = {"w": jax.ShapeDtypeStruct((32,), jax.numpy.float32, sharding=sh0)}
+    with pytest.raises(Exception, match="re-register|no device-mode|stage"):
+        await ts.get_state_dict(
+            "rr", user_state_dict=target, direct=True, store_name=store
+        )
+
+
 async def test_numpy_dict_still_uses_host_path(store):
     """Plain-numpy direct sync keeps the host (SHM/TCP) path."""
     sd = {"w": np.random.rand(128).astype(np.float32)}

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import torchstore_tpu as ts
+from torchstore_tpu import sharding as shd
 from torchstore_tpu.state_dict_utils import (
     NoMatchingPush,
     cast_floating_tensors,
@@ -230,3 +231,85 @@ async def test_plain_shape_dtype_struct_targets():
         np.testing.assert_array_equal(np.asarray(out3), sd["w"])
     finally:
         await ts.shutdown("sds")
+
+
+class TestBoxedParamTrees:
+    """Trees straight out of model.init with nn.with_logical_partitioning
+    carry flax AxisMetadata boxes; flatten must unbox (arrays take the
+    tensor path) and unflatten must restore the exact boxed structure.
+    Regression: boxed leaves used to ride the object path whole — pickled
+    device arrays materialized inside storage volumes (which on a TPU host
+    initializes the backend there and wedges the volume)."""
+
+    def test_flatten_unboxes_and_restores(self):
+        jax = pytest.importorskip("jax")
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        boxed = nn.with_logical_partitioning(
+            lambda: jnp.arange(8.0), ("embed",)
+        )()
+        sd = {"layer": {"w": boxed, "plain": np.ones(3, np.float32)}}
+        flat, mapping = flatten_state_dict(sd)
+        assert shd.is_jax_array(flat["layer/w"])  # unboxed to the array
+        rebuilt = unflatten_state_dict(flat, mapping)
+        from flax.core import meta as flax_meta
+
+        out = rebuilt["layer"]["w"]
+        assert isinstance(out, flax_meta.AxisMetadata)
+        assert out.names == boxed.names
+        np.testing.assert_array_equal(np.asarray(out.unbox()), np.arange(8.0))
+
+    async def test_boxed_tree_store_roundtrip(self):
+        jax = pytest.importorskip("jax")
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        import torchstore_tpu as ts
+
+        await ts.initialize(store_name="boxed")
+        try:
+            boxed = nn.with_logical_partitioning(
+                lambda: jnp.arange(16.0).reshape(4, 4), ("a", "b")
+            )()
+            sd = {"params": {"w": boxed}}
+            await ts.put_state_dict("m", sd, store_name="boxed")
+            out = await ts.get_state_dict("m", store_name="boxed")
+            got = out["params"]["w"]
+            from flax.core import meta as flax_meta
+
+            assert isinstance(got, flax_meta.AxisMetadata)
+            assert got.names == ("a", "b")
+            np.testing.assert_array_equal(
+                np.asarray(got.unbox()), np.arange(16.0).reshape(4, 4)
+            )
+        finally:
+            await ts.shutdown("boxed")
+
+
+class TestOpaqueObjectEnvelope:
+    """Object values are pickled in the CLIENT and carried opaque: volumes
+    never materialize user types (no foreign imports / backend init in
+    storage processes)."""
+
+    def test_client_wraps_objects(self):
+        from torchstore_tpu.client import LocalClient
+        from torchstore_tpu.transport.types import OpaqueBlob
+
+        (req,) = LocalClient._value_to_requests("k", {"arbitrary": "dict"})
+        assert req.is_object and isinstance(req.objects, OpaqueBlob)
+        assert req.objects.unwrap() == {"arbitrary": "dict"}
+        (req2,) = LocalClient._value_to_requests("k", 7)
+        assert isinstance(req2.objects, OpaqueBlob) and req2.objects.unwrap() == 7
+
+    async def test_object_roundtrip_through_store(self):
+        import torchstore_tpu as ts
+
+        await ts.initialize(store_name="opq")
+        try:
+            payload = {"nested": [1, 2, {"x": "y"}], "t": (3, 4)}
+            await ts.put("obj", payload, store_name="opq")
+            out = await ts.get("obj", store_name="opq")
+            assert out == payload
+        finally:
+            await ts.shutdown("opq")

@@ -214,3 +214,55 @@ class TestTraceExport:
 
         tracker = tslog.LatencyTracker("noop")
         tracker.track_step("s")  # no env -> no events collected
+
+
+class TestBoxSubtraction:
+    """subtract_box / boxes_cover: the exact-coverage primitives the direct
+    device pull uses to reject publications with holes (overlap-safe)."""
+
+    def test_subtract_disjoint(self):
+        from torchstore_tpu.utils import Box, subtract_box
+
+        base = Box((0, 0), (4, 4))
+        assert subtract_box(base, Box((10, 10), (2, 2))) == [base]
+
+    def test_subtract_full_cover(self):
+        from torchstore_tpu.utils import Box, subtract_box
+
+        assert subtract_box(Box((1, 1), (2, 2)), Box((0, 0), (8, 8))) == []
+
+    def test_subtract_partial_preserves_elements(self):
+        import numpy as np
+
+        from torchstore_tpu.utils import Box, subtract_box
+
+        base = Box((0, 0), (6, 6))
+        cut = Box((2, 2), (2, 3))
+        pieces = subtract_box(base, cut)
+        # Pieces are disjoint and tile base minus cut exactly.
+        grid = np.zeros((6, 6), int)
+        for p in pieces:
+            region = tuple(slice(o, o + s) for o, s in zip(p.offsets, p.shape))
+            grid[region] += 1
+        cut_region = tuple(slice(o, o + s) for o, s in zip(cut.offsets, cut.shape))
+        assert grid[cut_region].sum() == 0
+        grid[cut_region] += 1
+        np.testing.assert_array_equal(grid, np.ones((6, 6), int))
+
+    def test_boxes_cover_with_overlaps(self):
+        from torchstore_tpu.utils import Box, boxes_cover
+
+        region = Box((0,), (10,))
+        assert boxes_cover(region, [Box((0,), (6,)), Box((4,), (6,))])
+        # Duplicated cover of one half must NOT mask the missing half.
+        assert not boxes_cover(
+            region, [Box((0,), (5,)), Box((0,), (5,)), Box((0,), (5,))]
+        )
+
+    def test_boxes_cover_exact_tiling(self):
+        from torchstore_tpu.utils import Box, boxes_cover
+
+        region = Box((0, 0), (4, 4))
+        tiles = [Box((i, j), (2, 2)) for i in (0, 2) for j in (0, 2)]
+        assert boxes_cover(region, tiles)
+        assert not boxes_cover(region, tiles[:3])

@@ -265,3 +265,56 @@ class TestWeightChannel:
         await pub.publish({"w": np.ones(2)})
         await pub.close(delete=True)
         assert await ts.keys("p7", store_name=store) == []
+
+
+class TestAtMostOnceDelivery:
+    async def test_duplicate_wakeup_not_redelivered(self, store):
+        """A wake whose publish was already returned (pointer read in a
+        later RPC than the gen — a publish landing in between makes the
+        next wake see the same version) must NOT deliver twice (ADVICE r2)."""
+        pub = ts.WeightPublisher("dup", store_name=store)
+        sub = ts.WeightSubscriber("dup", store_name=store)
+        await pub.publish({"w": np.zeros(4, np.float32)})
+        _, v0 = await sub.acquire(timeout=10.0)
+        assert v0 == 0
+        # Emulate the race: roll the subscriber's gen back one step, as if
+        # it had woken for a publish whose successor it already returned.
+        sub._last_gen -= 1
+        with pytest.raises(TimeoutError):
+            await sub.acquire(timeout=0.4)
+        # A real new publish still arrives.
+        await pub.publish({"w": np.ones(4, np.float32)})
+        sd, v1 = await sub.acquire(timeout=10.0)
+        assert v1 == 1 and sd["w"][0] == 1.0
+
+    async def test_recreated_channel_redelivers_same_version_number(self, store):
+        """Delete + recreate restarts numbering; the fresh epoch means the
+        recreated channel's versions deliver even when the NUMBERS repeat."""
+        pub = ts.WeightPublisher("rc", store_name=store)
+        sub = ts.WeightSubscriber("rc", store_name=store)
+        await pub.publish({"w": np.full(2, 1.0, np.float32)})
+        await pub.publish({"w": np.full(2, 2.0, np.float32)})
+        sd, v = await sub.acquire(timeout=10.0)
+        assert v == 1 and sd["w"][0] == 2.0
+        await pub.close(delete=True)
+        pub2 = ts.WeightPublisher("rc", store_name=store)
+        await pub2.publish({"w": np.full(2, 5.0, np.float32)})
+        await pub2.publish({"w": np.full(2, 6.0, np.float32)})
+        sd2, v2 = await sub.acquire(timeout=10.0)
+        assert v2 == 1 and sd2["w"][0] == 6.0  # same number, new channel
+
+
+class TestGenRestartResilience:
+    async def test_stale_large_gen_wakes_immediately(self, store):
+        """A subscriber holding a pre-restart gen LARGER than the
+        controller's current gen must wake immediately and resync, not
+        block through every later publish (ADVICE r2: _key_gens is
+        in-memory and restarts from scratch)."""
+        await ts.put("g", np.ones(2), store_name=store)
+        controller = ts.client(store).controller
+        change = await asyncio.wait_for(
+            controller.wait_for_change.call_one("g", 10_000_000, timeout=5.0),
+            timeout=2.0,
+        )
+        assert change["state"] == "committed"
+        assert change["gen"] < 10_000_000

@@ -25,7 +25,12 @@ from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
 from torchstore_tpu.transport.factory import create_transport_buffer
-from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+from torchstore_tpu.transport.types import (
+    OpaqueBlob,
+    Request,
+    TensorMeta,
+    TensorSlice,
+)
 from torchstore_tpu.utils import (
     Box,
     assemble_tensor,
@@ -155,10 +160,14 @@ class LocalClient:
             # tensors everywhere).
             return [Request.from_tensor(key, torch_interop.to_numpy_view(value))]
         if isinstance(value, (int, float, complex)) or np.isscalar(value):
-            return [Request.from_objects(key, value)]
+            return [Request.from_objects(key, OpaqueBlob.wrap(value))]
         if hasattr(value, "__array_interface__"):
             return [Request.from_tensor(key, np.asarray(value))]
-        return [Request.from_objects(key, value)]
+        # Arbitrary objects are pickled HERE, in the client: volumes and
+        # transports carry opaque bytes and never materialize user types
+        # (materializing a jax-bearing leaf inside a volume process would
+        # initialize an accelerator backend there).
+        return [Request.from_objects(key, OpaqueBlob.wrap(value))]
 
     async def put(self, key: str, value: Any) -> None:
         await self.put_batch({key: value})
@@ -579,7 +588,9 @@ class LocalClient:
             raise KeyError(f"fetch produced no data for key {req.key!r}")
         first_sub, first_res = parts[0]
         if first_sub.is_object:
-            return first_res
+            if isinstance(first_res, OpaqueBlob):
+                return first_res.unwrap()
+            return first_res  # pre-envelope durable entries read as-is
         dest = req.tensor_val
         arrays = [
             (np.asarray(res), sub.tensor_slice.offsets if sub.tensor_slice else None)

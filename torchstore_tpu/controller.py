@@ -382,12 +382,16 @@ class Controller(Actor):
     async def wait_for_change(
         self, key: str, last_gen: int = 0, timeout: Optional[float] = None
     ) -> dict[str, Any]:
-        """Block until ``key``'s update generation exceeds ``last_gen`` (every
-        indexed put or delete of the key bumps it), then return
+        """Block until ``key``'s update generation DIFFERS from ``last_gen``
+        (every indexed put or delete of the key bumps it), then return
         ``{"gen", "state"}`` with state ∈ missing|partial|committed.
         ``last_gen=0`` returns immediately for any key that has ever been
         written — so a new subscriber picks up the current version without
-        racing the next publish."""
+        racing the next publish. Inequality, not ``>``: a controller
+        restarted over a durable store re-seeds generations from scratch
+        (rebuild_index), so a subscriber holding a larger pre-restart gen
+        must wake immediately and resync rather than block through every
+        subsequent publish (ADVICE r2)."""
         import asyncio
 
         cond = self._cond()
@@ -395,7 +399,7 @@ class Controller(Actor):
             try:
                 await asyncio.wait_for(
                     cond.wait_for(
-                        lambda: self._key_gens.get(key, 0) > last_gen
+                        lambda: self._key_gens.get(key, 0) != last_gen
                     ),
                     timeout,
                 )
@@ -517,6 +521,21 @@ class Controller(Actor):
                 "surviving layout may be partially committed until re-pushed",
                 dropped,
             )
+        # Seed update generations for every recovered key: a subscriber
+        # calling wait_for_change(key, 0) on a freshly-recovered store must
+        # see the existing version immediately, exactly as on a live store.
+        # Seeded at a RANDOM epoch offset, not 1: a surviving subscriber
+        # holds a pre-restart gen, and wait_for_change wakes on gen !=
+        # last_gen — seeding at small integers could collide with exactly
+        # the gen it last saw and block it through recovered versions.
+        import secrets
+
+        offset = secrets.randbits(46) | (1 << 45)
+        cond = self._cond()
+        async with cond:
+            for key in self.index:
+                self._key_gens[key] = offset
+            cond.notify_all()
         return count
 
     @endpoint

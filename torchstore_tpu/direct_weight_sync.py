@@ -35,6 +35,7 @@ from torchstore_tpu.transport import shared_memory as shm
 from torchstore_tpu.transport.types import TensorMeta, TensorSlice
 from torchstore_tpu.utils import (
     Box,
+    boxes_cover,
     get_destination_view,
     get_hostname,
     intersect_boxes,
@@ -49,6 +50,11 @@ _ERR = (1 << 64) - 1
 # reply with the transfer uuid" (the ICI rung's control op — each staging
 # serves exactly one jax.experimental.transfer pull).
 _STAGE_DEVICE = (1 << 64) - 2
+# buffer_id sentinel: "materialize the current device arrays into host
+# buffers and reply with pickled WeightHandles" — the graceful-degradation
+# rung for dests that cannot reconstruct our device shardings (disjoint jax
+# worlds / non-coinciding device ids).
+_STAGE_HOST = (1 << 64) - 3
 _U64 = struct.Struct("<Q")
 
 
@@ -71,6 +77,19 @@ class WeightHandle:
     source_rank: int
 
 
+@dataclass
+class DeviceEntry:
+    """One staged device array in a rank's device-mode publication: where it
+    sits in the global tensor (``tensor_slice``) plus how to pull it
+    (``spec``). The per-rank analog of the reference's per-rank RDMA handle
+    list (/root/reference/torchstore/state_dict_utils.py:217-275) with the
+    handle re-based on the XLA transfer engine."""
+
+    flat_key: str
+    spec: Any  # transport.device_transfer.DeviceSpec
+    tensor_slice: TensorSlice
+
+
 # --------------------------------------------------------------------------
 # source side
 # --------------------------------------------------------------------------
@@ -84,6 +103,10 @@ class _PeerReadServer:
         self.buffers: dict[int, np.ndarray] = {}
         # Set by the source when device mode is on: () -> transfer uuid.
         self.stage_device_fn = None
+        # Set alongside: () -> pickled {flat_key: [WeightHandle]} after
+        # materializing current device arrays into host buffers (fallback
+        # for dests outside this source's jax world).
+        self.stage_host_fn = None
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._writers: set = set()
@@ -117,8 +140,36 @@ class _PeerReadServer:
                     if self.stage_device_fn is None:
                         writer.write(_READ_RESP.pack(_ERR))
                     else:
-                        uid = self.stage_device_fn()
-                        writer.write(_READ_RESP.pack(_U64.size) + _U64.pack(uid))
+                        try:
+                            uid = self.stage_device_fn()
+                        except Exception:
+                            # Stage-time failures (e.g. resharded republish
+                            # guard) must reach the dest as a refusal, not
+                            # a dropped connection.
+                            logger.exception("device staging failed")
+                            writer.write(_READ_RESP.pack(_ERR))
+                        else:
+                            writer.write(
+                                _READ_RESP.pack(_U64.size) + _U64.pack(uid)
+                            )
+                    await writer.drain()
+                    continue
+                if buffer_id == _STAGE_HOST:
+                    if self.stage_host_fn is None:
+                        writer.write(_READ_RESP.pack(_ERR))
+                    else:
+                        try:
+                            # D2H of a whole model: off the event loop, or
+                            # it would stall every concurrent read/stage op.
+                            payload = await asyncio.get_running_loop().run_in_executor(
+                                None, self.stage_host_fn
+                            )
+                        except Exception:
+                            logger.exception("host-fallback staging failed")
+                            writer.write(_READ_RESP.pack(_ERR))
+                        else:
+                            writer.write(_READ_RESP.pack(len(payload)))
+                            writer.write(payload)
                     await writer.drain()
                     continue
                 arr = self.buffers.get(buffer_id)
@@ -186,22 +237,35 @@ class DirectWeightSyncSource:
         self.device_info: Optional[dict] = None
         self._device_keys: list[str] = []
         self._device_arrays: dict[str, Any] = {}
+        self._device_counts: dict[str, int] = {}
+        # entry index -> reusable host-fallback buffer id (_stage_host_handles).
+        self._host_fallback_ids: dict[int, int] = {}
+        self._advertise: tuple[str, int] = ("", 0)
+        # _stage_host_handles runs in the server's executor (off the event
+        # loop); concurrent fallback pulls must not race id allocation or
+        # buffer refreshes.
+        import threading
+
+        self._host_fallback_lock = threading.Lock()
 
     def _device_mode_eligible(self, flat: dict, rank: int, num_ranks: int) -> bool:
-        """Device path engages for single-controller sources whose tensor
-        leaves are ALL jax arrays (the trainer owns its device mesh). Multi
-        -rank SPMD sources keep the host path — combining per-rank device
-        shards source-side would need a cross-rank transfer plan."""
+        """Device path engages when every tensor leaf lives on device: plain
+        jax arrays, or rank-local ``Shard`` wrappers whose data is a jax
+        array (multi-rank SPMD sources — each rank publishes its own
+        per-shard device entries, the reference's per-rank handle publication
+        pattern, state_dict_utils.py:217-275)."""
         if self.device is False:
             return False
-        if not self.config.ici_enabled or num_ranks != 1 or rank != 0:
+        if not self.config.ici_enabled:
             return False
         from torchstore_tpu.transport import device_transfer as dt
 
         if not dt.is_available():
             return False
         tensorish = [v for v in flat.values() if _is_tensor_leaf(v)]
-        return bool(tensorish) and all(shd.is_jax_array(v) for v in tensorish)
+        return bool(tensorish) and all(
+            shd.is_jax_array(_unwrap_shard(v)) for v in tensorish
+        )
 
     async def register(
         self,
@@ -225,7 +289,7 @@ class DirectWeightSyncSource:
         # Advertise the same reachable name the actor runtime uses.
         hostname = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST", get_hostname())
         if self._device_mode_eligible(flat, rank, num_ranks):
-            return self._register_device(flat, hostname, port, transfer_dtype)
+            return self._register_device(flat, hostname, port, transfer_dtype, rank)
         for flat_key, value in flat.items():
             if (
                 transfer_dtype is not None
@@ -278,61 +342,160 @@ class DirectWeightSyncSource:
         return self.handles
 
     def _register_device(
-        self, flat: dict, hostname: str, port: int, transfer_dtype
+        self, flat: dict, hostname: str, port: int, transfer_dtype, rank: int
     ) -> dict:
         """ICI rung registration: no host staging at all. Arrays stay on
         device; every dest pull stages the CURRENT arrays through the XLA
         transfer server (device-to-device over ICI/DCN — the reference's
-        one-sided GPU read, monarch_rdma.py:158-219, without host bounce)."""
+        one-sided GPU read, monarch_rdma.py:158-219, without host bounce).
+        Each rank of a multi-rank SPMD source registers independently and
+        publishes its own entries under ``key/rank_{r}``; the dest's plan
+        merges all ranks' parts."""
         from torchstore_tpu.transport import device_transfer as dt
 
         engine = dt.DeviceTransferEngine.get()
         self._device_keys = []
         self._device_arrays = {}
-        specs = {}
+        self._device_counts = {}
+        entries: list[DeviceEntry] = []
         for flat_key, value in flat.items():
             if not _is_tensor_leaf(value):
                 continue
             self._device_keys.append(flat_key)
             self._device_arrays[flat_key] = value  # uncast; cast at stage time
-            if transfer_dtype is not None and _is_floating(value):
-                from torchstore_tpu.ops import device_cast
-
-                value = device_cast(value, transfer_dtype)
-            specs[flat_key] = dt.DeviceSpec.of(value)
+            parts = _device_parts(_cast_device_value(value, transfer_dtype))
+            self._device_counts[flat_key] = len(parts)
+            for ts_slice, arr in parts:
+                entries.append(
+                    DeviceEntry(
+                        flat_key=flat_key,
+                        spec=dt.DeviceSpec.of(arr),
+                        tensor_slice=ts_slice,
+                    )
+                )
         address = engine.ensure_server()
         self.server.stage_device_fn = self._stage_current
+        self.server.stage_host_fn = self._stage_host_handles
+        self._advertise = (hostname, port)
         self.device_info = {
             "address": address,
             "hostname": hostname,
             "control_port": port,
             "keys": list(self._device_keys),
-            "specs": specs,
+            "entries": entries,
+            "source_rank": rank,
         }
         self._registered = True
         self.handles = {}
         logger.info(
-            "direct sync registered %d tensors on the device (ICI) path",
+            "direct sync rank %d registered %d tensors (%d device entries) "
+            "on the device (ICI) path",
+            rank,
             len(self._device_keys),
+            len(entries),
         )
         return self.handles
+
+    def _current_device_parts(self) -> list[tuple[str, TensorSlice, Any]]:
+        """(flat_key, global slice, device array) for the CURRENT values, in
+        registration order — validated one-to-one against the PUBLISHED
+        entries (spec AND placement, not just count): a republish that
+        reshards a param without re-registering would otherwise stage
+        arrays the dest lands at stale offsets — silent corruption."""
+        from torchstore_tpu.transport import device_transfer as dt
+
+        out: list[tuple[str, TensorSlice, Any]] = []
+        entries = self.device_info["entries"]
+        idx = 0
+        # Local ref: update_sources swaps the dict atomically; holding one
+        # reference keeps this pass consistent even from an executor thread.
+        arrays = self._device_arrays
+        for key in self._device_keys:
+            parts = _device_parts(
+                _cast_device_value(arrays[key], self._transfer_dtype)
+            )
+            if len(parts) != self._device_counts[key]:
+                raise ValueError(
+                    f"device refresh of {key!r}: value now decomposes into "
+                    f"{len(parts)} parts but {self._device_counts[key]} were "
+                    "registered — re-register after changing a param's "
+                    "sharding"
+                )
+            for ts_slice, arr in parts:
+                reg = entries[idx]
+                idx += 1
+                if (
+                    reg.tensor_slice != ts_slice
+                    or reg.spec != dt.DeviceSpec.of(arr)
+                ):
+                    raise ValueError(
+                        f"device refresh of {key!r}: current value's "
+                        "sharding/placement differs from the published "
+                        "entries — re-register (publish under a fresh key "
+                        "or restart the source) after changing a param's "
+                        "sharding"
+                    )
+                out.append((key, ts_slice, arr))
+        return out
 
     def _stage_current(self) -> int:
         from torchstore_tpu.transport import device_transfer as dt
 
         engine = dt.DeviceTransferEngine.get()
-        arrays = [self._device_arrays[k] for k in self._device_keys]
-        if self._transfer_dtype is not None:
-            from torchstore_tpu.ops import device_cast
+        return engine.stage([arr for _, _, arr in self._current_device_parts()])
 
-            arrays = [
-                device_cast(a, self._transfer_dtype) if _is_floating(a) else a
-                for a in arrays
-            ]
-        return engine.stage(arrays)
+    def _stage_host_handles(self) -> bytes:
+        """Materialize the current device arrays into host buffers and return
+        pickled ``{flat_key: [WeightHandle]}`` — serves dests whose jax world
+        does not contain our device ids (they then read over the normal host
+        TCP path). Buffers are reused across calls, so repeated fallback
+        pulls refresh in place. Runs in the server's executor; the lock
+        serializes concurrent fallback pulls (unlocked, two threads could
+        allocate the same buffer id for different tensors — silent weight
+        swaps for same-shape params)."""
+        import pickle
+
+        with self._host_fallback_lock:
+            hostname, port = self._advertise
+            handles: dict[str, list[WeightHandle]] = {}
+            for idx, (flat_key, ts_slice, arr) in enumerate(
+                self._current_device_parts()
+            ):
+                host_arr = np.ascontiguousarray(np.asarray(arr))
+                buffer_id = self._host_fallback_ids.get(idx)
+                if buffer_id is None:
+                    buffer_id = self._next_id
+                    self._next_id += 1
+                    self._host_fallback_ids[idx] = buffer_id
+                self.server.buffers[buffer_id] = host_arr
+                handles.setdefault(flat_key, []).append(
+                    WeightHandle(
+                        buffer_id=buffer_id,
+                        hostname=hostname,
+                        port=port,
+                        shm_name=None,
+                        meta=TensorMeta.of(host_arr),
+                        tensor_slice=ts_slice,
+                        source_rank=self.device_info["source_rank"],
+                    )
+                )
+            return pickle.dumps(handles)
 
     @staticmethod
     def _shards_of(value) -> Optional[list[tuple[TensorSlice, np.ndarray]]]:
+        from torchstore_tpu.client import Shard as _Shard
+
+        if isinstance(value, _Shard):
+            # Rank-local shard with explicit global placement (SPMD sources):
+            # decompose the data, then re-base its slices into the global
+            # space the wrapper describes.
+            inner = DirectWeightSyncSource._shards_of(value.data)
+            if inner is None:
+                return None
+            return [
+                (_rebase_slice(ts_slice, value.tensor_slice), arr)
+                for ts_slice, arr in inner
+            ]
         if shd.is_jax_array(value):
             reqs = shd.put_requests("_", value)
             out = []
@@ -422,8 +585,13 @@ class DirectWeightSyncSource:
         flat, _ = flatten_state_dict(state_dict)
         for key in self._sources:
             self._sources[key] = flat[key]
-        for key in self._device_keys:
-            self._device_arrays[key] = flat[key]
+        if self._device_keys:
+            # Atomic whole-dict swap: _stage_host_handles reads this from an
+            # executor thread; per-key mutation could hand it a torn
+            # old/new mix across keys.
+            self._device_arrays = {
+                key: flat[key] for key in self._device_keys
+            }
 
     async def close(self) -> None:
         await self.server.stop()
@@ -441,6 +609,85 @@ def _full_slice(shape) -> TensorSlice:
         coordinates=(),
         mesh_shape=(),
     )
+
+
+def _rebase_slice(inner: TensorSlice, base: TensorSlice) -> TensorSlice:
+    """``inner`` (a slice of the rank-local data) re-based into the global
+    space ``base`` places that data in."""
+    return TensorSlice(
+        offsets=tuple(o + bo for o, bo in zip(inner.offsets, base.offsets)),
+        local_shape=inner.local_shape,
+        global_shape=base.global_shape,
+        coordinates=inner.coordinates,
+        mesh_shape=inner.mesh_shape,
+    )
+
+
+def _unwrap_shard(value):
+    from torchstore_tpu.client import Shard as _Shard
+
+    return value.data if isinstance(value, _Shard) else value
+
+
+def _cast_device_value(value, transfer_dtype):
+    """On-device cast of a device-mode leaf (or its Shard data) to the
+    transfer dtype; identity when no cast applies."""
+    if transfer_dtype is None:
+        return value
+    from torchstore_tpu.client import Shard as _Shard
+
+    if isinstance(value, _Shard):
+        data = _cast_device_value(value.data, transfer_dtype)
+        return value if data is value else _Shard(data, value.tensor_slice)
+    if shd.is_jax_array(value) and _is_floating(value):
+        from torchstore_tpu.ops import device_cast
+
+        return device_cast(value, transfer_dtype)
+    return value
+
+
+def _device_parts(value) -> list[tuple[TensorSlice, Any]]:
+    """Decompose one device-mode leaf into (global TensorSlice, device
+    array) staging parts:
+
+    - fully-addressable jax array: ONE part, the array itself (whole-array
+      staging keeps its mesh sharding — the single-controller fast shape);
+    - non-fully-addressable (true multi-controller SPMD): one part per
+      addressable shard, each a committed single-device array placed by its
+      shard index in the global space;
+    - ``Shard`` wrapper: the data's parts re-based into the wrapper's global
+      space (mp.spawn-style SPMD where each rank owns a disjoint device
+      subset)."""
+    from torchstore_tpu.client import Shard as _Shard
+
+    if isinstance(value, _Shard):
+        return [
+            (_rebase_slice(ts_slice, value.tensor_slice), arr)
+            for ts_slice, arr in _device_parts(value.data)
+        ]
+    if value.is_fully_addressable:
+        return [(_full_slice(value.shape), value)]
+    global_shape = tuple(int(s) for s in value.shape)
+    out = []
+    seen: set[tuple[int, ...]] = set()
+    for shard in value.addressable_shards:
+        offsets = tuple(int(sl.start or 0) for sl in shard.index)
+        if offsets in seen:
+            continue  # replicated-across-local-devices: stage one copy
+        seen.add(offsets)
+        out.append(
+            (
+                TensorSlice(
+                    offsets=offsets,
+                    local_shape=tuple(int(s) for s in shard.data.shape),
+                    global_shape=global_shape,
+                    coordinates=(),
+                    mesh_shape=(),
+                ),
+                shard.data,
+            )
+        )
+    return out
 
 
 def _aliases(a: np.ndarray, b: np.ndarray) -> bool:
@@ -698,15 +945,88 @@ class DirectWeightSyncDest:
 
     # ---- device (ICI) path ------------------------------------------------
 
-    async def pull_device(self, device_info: dict, dest_state_dict: Any) -> Any:
-        """One-hop device pull: ask the source to stage its current arrays,
-        pull them device-to-device through the transfer engine, then land
-        into the dest targets (resharding locally where the target sharding
-        differs — XLA moves the shards over ICI)."""
+    async def pull_device(
+        self, device_infos: list[dict], dest_state_dict: Any
+    ) -> Any:
+        """One-hop device pull across every source rank: ask each rank to
+        stage its current arrays, pull them device-to-device through the
+        transfer engine, merge the per-rank parts, then land into the dest
+        targets (resharding locally where the target sharding differs — XLA
+        moves the shards over ICI). Falls back to each rank's host-staging
+        control op when the published device shardings reference device ids
+        this process cannot see (disjoint jax worlds)."""
         from torchstore_tpu.transport import device_transfer as dt
 
         tracker = LatencyTracker("direct_pull_device")
         dest_flat, mapping = flatten_state_dict(dest_state_dict)
+        # Build every rank's pull specs BEFORE staging anything: a
+        # staged-but-never-pulled uuid would pin source arrays in its
+        # transfer server. The built shardings are reused for the pull
+        # itself (one Mesh construction per entry, not two).
+        try:
+            built_specs = [
+                [e.spec.to_jax() for e in info["entries"]]
+                for info in device_infos
+            ]
+        except ValueError as exc:
+            logger.warning(
+                "device path unavailable (%s); falling back to source-side "
+                "host staging",
+                exc,
+            )
+            all_handles: dict[str, list[WeightHandle]] = {}
+            # Ranks materialize independently — fetch concurrently (each
+            # rank's D2H staging overlaps instead of serializing).
+            fetched = await asyncio.gather(
+                *(self._fetch_host_handles(info) for info in device_infos)
+            )
+            for rank_handles in fetched:
+                for flat_key, hl in rank_handles.items():
+                    all_handles.setdefault(flat_key, []).extend(hl)
+            return await self.pull(all_handles, dest_state_dict)
+
+        engine = dt.DeviceTransferEngine.get()
+        parts_by_key: dict[str, list[tuple[TensorSlice, Any]]] = {}
+        pulled_bytes = 0
+        # Stage each rank immediately before pulling it: on a mid-sequence
+        # failure at most ONE staged uuid is left un-pulled (the engine has
+        # no un-stage op), instead of one per remaining rank.
+        for info, specs in zip(device_infos, built_specs):
+            uid = await self._stage_remote(info)
+            entries = info["entries"]
+            arrays = engine.pull_built(info["address"], uid, specs)
+            for entry, arr in zip(entries, arrays):
+                parts_by_key.setdefault(entry.flat_key, []).append(
+                    (entry.tensor_slice, arr)
+                )
+                pulled_bytes += int(np.prod(entry.spec.shape)) * TensorMeta(
+                    shape=(), dtype=entry.spec.dtype
+                ).np_dtype.itemsize
+        tracker.track_step("pull", pulled_bytes)
+        out_flat = dict(dest_flat)
+        for flat_key, target in dest_flat.items():
+            if not _is_tensor_like(target):
+                continue
+            parts = parts_by_key.get(flat_key)
+            if parts is None:
+                raise KeyError(
+                    f"dest state dict expects {flat_key!r} but no source "
+                    "rank published a device entry for it"
+                )
+            if len(parts) == 1 and parts[0][0].is_full():
+                out_flat[flat_key] = _land_device(target, parts[0][1])
+            else:
+                out_flat[flat_key] = _assemble_device(flat_key, target, parts)
+        tracker.track_step("land")
+        tracker.log_summary(level=20)
+        from torchstore_tpu.state_dict_utils import unflatten_state_dict
+
+        return unflatten_state_dict(out_flat, mapping)
+
+    async def _control_request(self, device_info: dict, opcode: int) -> bytes:
+        """One control op against a source rank's peer server: send the
+        sentinel ``opcode``, return the response payload (both staging ops
+        share the length-prefixed reply shape)."""
         host = (
             "127.0.0.1"
             if device_info["hostname"] == get_hostname()
@@ -716,42 +1036,33 @@ class DirectWeightSyncDest:
             host, device_info["control_port"]
         )
         async with lock:
-            writer.write(_READ_REQ.pack(_STAGE_DEVICE, 0, 0))
+            writer.write(_READ_REQ.pack(opcode, 0, 0))
             await writer.drain()
             (length,) = _READ_RESP.unpack(await reader.readexactly(_READ_RESP.size))
             if length == _ERR:
-                raise KeyError("source has no device-mode registration")
-            (uid,) = _U64.unpack(await reader.readexactly(_U64.size))
-        tracker.track_step("stage")
-        keys = device_info["keys"]
-        specs = [device_info["specs"][k] for k in keys]
-        engine = dt.DeviceTransferEngine.get()
-        arrays = engine.pull(device_info["address"], uid, specs)
-        by_key = dict(zip(keys, arrays))
-        tracker.track_step(
-            "pull",
-            sum(
-                int(np.prod(s.shape))
-                * TensorMeta(shape=(), dtype=s.dtype).np_dtype.itemsize
-                for s in specs
-            ),
-        )
-        out_flat = dict(dest_flat)
-        for flat_key, target in dest_flat.items():
-            if not _is_tensor_like(target):
-                continue
-            arr = by_key.get(flat_key)
-            if arr is None:
                 raise KeyError(
-                    f"dest state dict expects {flat_key!r} but the source "
-                    "published no device entry for it"
+                    "source refused to stage: no device-mode "
+                    "registration, or stage-time validation failed "
+                    "(check source logs)"
                 )
-            out_flat[flat_key] = _land_device(target, arr)
-        tracker.track_step("land")
-        tracker.log_summary(level=20)
-        from torchstore_tpu.state_dict_utils import unflatten_state_dict
+            return await reader.readexactly(length)
 
-        return unflatten_state_dict(out_flat, mapping)
+    async def _stage_remote(self, device_info: dict) -> int:
+        """Ask one source rank to stage its current arrays; returns the
+        transfer uuid serving exactly this pull."""
+        (uid,) = _U64.unpack(await self._control_request(device_info, _STAGE_DEVICE))
+        return uid
+
+    async def _fetch_host_handles(
+        self, device_info: dict
+    ) -> dict[str, list[WeightHandle]]:
+        """Ask one source rank to materialize its device arrays into host
+        buffers; returns the WeightHandles serving them over TCP."""
+        import pickle
+
+        return pickle.loads(
+            await self._control_request(device_info, _STAGE_HOST)
+        )
 
     async def _read_shard(
         self, handle: WeightHandle, row_range: Optional[tuple[int, int]] = None
@@ -853,8 +1164,127 @@ def _is_tensor_like(value) -> bool:
 
 
 def _is_tensor_leaf(value) -> bool:
-    """Source-side leaf classification (register): array-valued leaves."""
-    return isinstance(value, np.ndarray) or shd.is_jax_array(value)
+    """Source-side leaf classification (register): array-valued leaves,
+    including rank-local Shard wrappers (SPMD sources)."""
+    from torchstore_tpu.client import Shard as _Shard
+
+    return (
+        isinstance(value, (np.ndarray, _Shard)) or shd.is_jax_array(value)
+    )
+
+
+def _assemble_region_on_device(want, parts, dtype, device):
+    """Assemble global region ``want`` from overlapping ``parts`` as a
+    single-device array on ``device``: each overlap is sliced out of its
+    part ON the part's devices (lax.slice), moved with device_put (ICI on
+    real hardware), and placed with dynamic_update_slice — peak memory is
+    one region plus one overlap piece, never the dense global tensor."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.device_put(jnp.zeros(want.local_shape, dtype), device)
+    for ts_slice, arr in parts:
+        inter = intersect_boxes(ts_slice.box, want.box)
+        if inter is None:
+            continue
+        starts = [o - so for o, so in zip(inter.offsets, ts_slice.offsets)]
+        piece = jax.lax.slice(
+            arr, starts, [s + sz for s, sz in zip(starts, inter.shape)]
+        )
+        piece = jax.device_put(piece, device)
+        if piece.dtype != dtype:
+            piece = piece.astype(dtype)
+        out = jax.lax.dynamic_update_slice(
+            out,
+            piece,
+            tuple(o - wo for o, wo in zip(inter.offsets, want.offsets)),
+        )
+    return out
+
+
+def _assemble_device(flat_key: str, target, parts):
+    """Assemble a multi-part device pull (per-rank / per-shard entries) into
+    one dest target. jax-ish targets assemble ON DEVICE, one target shard
+    at a time (no dense single-device copy of the global tensor is ever
+    materialized); host targets land each part into its destination
+    region. Coverage is validated by exact box union — overlapping or
+    replicated parts cannot mask a hole."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchstore_tpu.client import Shard as _Shard
+
+    # Replicated source shards publish identical regions; pull cost was
+    # already paid upstream (dedup at publication), this guards merged
+    # multi-rank duplicates.
+    seen: set[tuple] = set()
+    deduped = []
+    for ts_slice, arr in parts:
+        sig = (ts_slice.offsets, ts_slice.local_shape)
+        if sig in seen:
+            continue
+        seen.add(sig)
+        deduped.append((ts_slice, arr))
+    parts = deduped
+    global_shape = tuple(parts[0][0].global_shape)
+    global_box = Box((0,) * len(global_shape), global_shape)
+    if not boxes_cover(global_box, [ts_slice.box for ts_slice, _ in parts]):
+        raise ValueError(
+            f"source ranks do not cover all of {flat_key!r} "
+            f"{global_shape} — missing regions would silently read as zeros"
+        )
+    if (
+        shd.is_jax_array(target)
+        or shd.is_sharded_spec(target)
+        or shd.is_plain_spec(target)
+    ):
+        if tuple(target.shape) != global_shape:
+            raise ValueError(
+                f"pulled global shape {global_shape} != target shape "
+                f"{tuple(target.shape)} for {flat_key!r}"
+            )
+        dtype = jnp.dtype(str(target.dtype))
+        sharding = getattr(target, "sharding", None)
+        if sharding is not None and not shd._is_demotable(sharding):
+            # Shard-wise assembly straight into the target layout.
+            shard_list = shd.target_slices(target)
+            locals_ = [
+                _assemble_region_on_device(want, parts, dtype, dev)
+                for dev, want in shard_list
+            ]
+            return jax.make_array_from_single_device_arrays(
+                global_shape, sharding, locals_
+            )
+        full = _full_slice(global_shape)
+        out = _assemble_region_on_device(full, parts, dtype, jax.devices()[0])
+        if sharding is not None:
+            out = jax.device_put(out, sharding)
+        return out
+    # Host targets: one want region (Shard → its slice, numpy → full);
+    # copy every overlapping part into the destination view.
+    (want,) = _target_slices(target)
+    buf = target.data if isinstance(target, _Shard) else target
+    if buf is None:
+        dtype = TensorMeta(shape=(), dtype=parts[0][1].dtype.name).np_dtype
+        buf = np.empty(want.local_shape, dtype)
+    touched = []
+    for ts_slice, arr in parts:
+        inter = intersect_boxes(ts_slice.box, want.box)
+        if inter is None:
+            continue
+        host = np.asarray(arr)
+        rel_src = tuple(
+            slice(o - so, o - so + s)
+            for o, so, s in zip(inter.offsets, ts_slice.offsets, inter.shape)
+        )
+        view = get_destination_view(buf, want.box, inter, require_contiguous=False)
+        copy_into(view, host[rel_src])
+        touched.append(inter)
+    if not boxes_cover(want.box, touched):
+        raise ValueError(
+            f"source ranks do not cover region {want.box} of {flat_key!r}"
+        )
+    return buf
 
 
 def _land_device(target, arr):

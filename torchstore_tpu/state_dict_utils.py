@@ -47,6 +47,22 @@ def _is_leaf(value: Any) -> bool:
     return True
 
 
+def _axis_metadata_box(value: Any):
+    """The flax AxisMetadata box wrapping ``value``, or None. Trees straight
+    out of ``model.init`` with ``nn.with_logical_partitioning`` carry
+    LogicallyPartitioned/Partitioned leaves; stored boxed, their jax arrays
+    would ride the opaque object path (no resharding, full-serialize puts).
+    Flatten unboxes them — the array takes the tensor path — and records the
+    empty box in the mapping so unflatten restores the exact structure."""
+    try:
+        from flax.core import meta as flax_meta
+    except ImportError:  # pragma: no cover - flax is in this image
+        return None
+    if isinstance(value, flax_meta.AxisMetadata):
+        return value.replace_boxed(None)
+    return None
+
+
 def flatten_state_dict(sd: Any) -> tuple[dict[str, Any], dict]:
     """Returns ({flat_path: leaf}, mapping). ``mapping`` is a picklable
     template that records the container structure (incl. NamedTuple types by
@@ -85,6 +101,10 @@ def _flatten_rec(value: Any, path: list[str], flat: dict[str, Any]) -> dict:
     flat_key = _SEP.join(path)
     if flat_key in flat:
         raise ValueError(f"duplicate flattened key {flat_key!r}")
+    box = _axis_metadata_box(value)
+    if box is not None:
+        flat[flat_key] = value.unbox()
+        return {"kind": "boxed", "key": flat_key, "box": box}
     flat[flat_key] = value
     return {"kind": "leaf", "key": flat_key}
 
@@ -107,6 +127,8 @@ def _unflatten_rec(entry: dict, flat: dict[str, Any]) -> Any:
     kind = entry["kind"]
     if kind == "leaf":
         return flat[entry["key"]]
+    if kind == "boxed":
+        return entry["box"].replace_boxed(flat[entry["key"]])
     if kind == "dict":
         key_types = entry.get("key_types", {})
         return {
@@ -366,13 +388,15 @@ async def _put_state_dict_direct(
     # every later refresh read straight out of the trainer's torch storage.
     state_dict = torch_interop.convert_tree(state_dict)
     cache = _direct_cache(client)
-    source = cache.sources.get(key)
+    # Keyed by (key, rank): one client may publish as several ranks (tests /
+    # colocated trainers); each rank owns its own registration + buffers.
+    source = cache.sources.get((key, rank))
     if source is None:
         source = DirectWeightSyncSource(config=getattr(client, "_config", None))
         handles = await source.register(
             state_dict, rank, transfer_dtype, num_ranks=num_ranks
         )
-        cache.sources[key] = source
+        cache.sources[(key, rank)] = source
         published = {"handles": handles}
         if source.device_info is not None:
             # ICI rung: handles advertise the device transfer server; dests
@@ -405,7 +429,7 @@ async def _get_state_dict_direct(
                 f"no matching direct push for state dict key {key!r}"
             ) from exc
         all_handles: dict[str, list] = {}
-        device_info = None
+        device_infos: list = []
         for rank in range(num_ranks):
             try:
                 published = await client.get(f"{key}{_SEP}rank_{rank}")
@@ -418,13 +442,20 @@ async def _get_state_dict_direct(
                 ) from exc
             for flat_key, handle_list in published["handles"].items():
                 all_handles.setdefault(flat_key, []).extend(handle_list)
-            if num_ranks == 1:
-                device_info = published.get("device")
-        entry = (DirectWeightSyncDest(), all_handles, device_info)
+            if published.get("device") is not None:
+                device_infos.append(published["device"])
+        if device_infos and len(device_infos) != num_ranks:
+            raise RuntimeError(
+                f"direct push {key!r}: {len(device_infos)} of {num_ranks} "
+                "ranks published device-path entries — mixed device/host "
+                "publication cannot be merged (check ici_enabled agrees "
+                "across ranks)"
+            )
+        entry = (DirectWeightSyncDest(), all_handles, device_infos or None)
         cache.dests[key] = entry
-    dest, all_handles, device_info = entry
+    dest, all_handles, device_infos = entry
     try:
-        if device_info is not None:
+        if device_infos is not None:
             from torchstore_tpu.transport import device_transfer as _dt
 
             if not _dt.is_available():
@@ -434,7 +465,7 @@ async def _get_state_dict_direct(
                     "set TORCHSTORE_TPU_ICI_ENABLED=0 on the source to use "
                     "the host path"
                 )
-            return await dest.pull_device(device_info, user_state_dict)
+            return await dest.pull_device(device_infos, user_state_dict)
         return await dest.pull(all_handles, user_state_dict)
     except (ConnectionError, OSError, KeyError, ValueError):
         # ValueError covers stale-plan shape mismatches after a source
@@ -505,7 +536,7 @@ async def put_state_dict(
     tracker.log_summary(level=20)  # INFO: weight-sync phases are user-facing
 
 
-def direct_staging_buffers(client, key: str) -> Any:
+def direct_staging_buffers(client, key: str, rank: int = 0) -> Any:
     """After a direct push of ``key``: the registered staging buffers in the
     original state-dict structure, or None when not applicable (sharded or
     device sources). A trainer that adopts these arrays as its weight
@@ -513,7 +544,7 @@ def direct_staging_buffers(client, key: str) -> Any:
     source-side copies (registered-memory semantics; the device/ICI path is
     already copy-free)."""
     cache = _direct_cache(client)
-    source = cache.sources.get(key)
+    source = cache.sources.get((key, rank))
     if source is None:
         return None
     return source.staging_state_dict()
@@ -548,9 +579,12 @@ async def get_state_dict(
             entry = cache.dests.get(key)
             if entry is not None:
                 user_flat, _ = flatten_state_dict(user_state_dict)
-                published_keys = (
-                    set(entry[2]["keys"]) if entry[2] is not None else set(entry[1])
-                )
+                if entry[2] is not None:
+                    published_keys = set()
+                    for info in entry[2]:
+                        published_keys |= set(info["keys"])
+                else:
+                    published_keys = set(entry[1])
                 missing = published_keys - set(user_flat)
                 if missing:
                     raise ValueError(
@@ -628,7 +662,7 @@ def _leaf_keys(mapping: dict) -> set[str]:
     out: set[str] = set()
 
     def rec(entry: dict) -> None:
-        if entry["kind"] == "leaf":
+        if entry["kind"] in ("leaf", "boxed"):
             out.add(entry["key"])
         elif entry["kind"] == "dict":
             for v in entry["items"].values():

@@ -22,12 +22,13 @@ uuid serves exactly ONE ``pull``):
 Because staging happens per pull request, a dest always receives the
 source's CURRENT weights with zero host copies on either side.
 
-Scope: single-controller sources (one process owning the source mesh —
-the standard JAX setup for a pod slice). Sharding descriptors reconstruct
-by GLOBAL device id, so source and dest must share a jax world
-(jax.distributed) or have coinciding device ids (same-topology slices).
-Multi-controller SPMD sources (per-rank processes) fall back to the host
-path, which handles arbitrary cross-rank reshards.
+Scope: single-controller sources stage whole (mesh-sharded) arrays;
+multi-rank SPMD sources each run their own transfer server and publish
+per-shard entries the dest merges (direct_weight_sync._device_parts).
+Sharding descriptors reconstruct by GLOBAL device id, so source and dest
+must share a jax world (jax.distributed) or have coinciding device ids
+(same-topology slices). When they don't, the dest falls back to the
+source-side host-staging control op (_STAGE_HOST) and reads over TCP.
 
 Shardings cannot be pickled across processes (they hold live Device
 objects); ``ShardingDescriptor`` round-trips NamedSharding /
@@ -202,12 +203,17 @@ class DeviceTransferEngine:
     def pull(self, address: str, uid: int, specs: list[DeviceSpec]) -> list:
         """Pull staged arrays from a peer server, landing them with the
         source's sharding (reshard afterwards with jax.device_put)."""
+        return self.pull_built(address, uid, [s.to_jax() for s in specs])
+
+    def pull_built(self, address: str, uid: int, jax_specs: list) -> list:
+        """Pull with pre-built jax ShapeDtypeStructs (callers that validate
+        sharding reconstruction up front reuse the same objects here)."""
         self.ensure_server()
         conn = self._conns.get(address)
         if conn is None:
             conn = self._server.connect(address)
             self._conns[address] = conn
-        return conn.pull(uid, [s.to_jax() for s in specs])
+        return conn.pull(uid, jax_specs)
 
     def reset(self) -> None:
         """Drop connections (tests); the server itself is process-lifetime."""

@@ -97,6 +97,36 @@ class TensorSlice:
         return replace(self, offsets=box.offsets, local_shape=box.shape)
 
 
+class OpaqueBlob:
+    """Client-side pickled envelope for arbitrary object values.
+
+    Storage volumes and transports carry these as opaque bytes: the user's
+    types are pickled/unpickled ONLY in client processes, so a storage
+    process never imports the libraries a value drags in (a flax/jax leaf
+    unpickled inside a volume would initialize an accelerator backend
+    there — on a TPU host that grabs the chip lock and wedges the volume)
+    and never executes foreign __reduce__ payloads beyond bytes."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+
+    @classmethod
+    def wrap(cls, obj: Any) -> "OpaqueBlob":
+        import pickle
+
+        return cls(pickle.dumps(obj, protocol=5))
+
+    def unwrap(self) -> Any:
+        import pickle
+
+        return pickle.loads(self.data)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"OpaqueBlob({len(self.data)} bytes)"
+
+
 @dataclass
 class Request:
     """One logical store operation on one key.

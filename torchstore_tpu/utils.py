@@ -74,6 +74,47 @@ def intersect_boxes(a: Box, b: Box) -> Optional[Box]:
     return Box(tuple(offsets), tuple(shape))
 
 
+def subtract_box(base: Box, cut: Box) -> list[Box]:
+    """``base`` minus ``cut``: up to 2*ndim disjoint boxes covering every
+    element of ``base`` outside ``cut``. Returns ``[base]`` when disjoint,
+    ``[]`` when fully covered — the exact-coverage primitive (overlap-safe,
+    unlike element-count sums)."""
+    inter = intersect_boxes(base, cut)
+    if inter is None:
+        return [base]
+    out: list[Box] = []
+    cur_off = list(base.offsets)
+    cur_shape = list(base.shape)
+    for d in range(base.ndim):
+        lo, hi = cur_off[d], cur_off[d] + cur_shape[d]
+        ilo = inter.offsets[d]
+        ihi = ilo + inter.shape[d]
+        if ilo > lo:
+            off = list(cur_off)
+            shp = list(cur_shape)
+            shp[d] = ilo - lo
+            out.append(Box(tuple(off), tuple(shp)))
+        if ihi < hi:
+            off = list(cur_off)
+            shp = list(cur_shape)
+            off[d] = ihi
+            shp[d] = hi - ihi
+            out.append(Box(tuple(off), tuple(shp)))
+        cur_off[d], cur_shape[d] = ilo, ihi - ilo
+    return out
+
+
+def boxes_cover(region: Box, covers: list[Box]) -> bool:
+    """True iff the union of ``covers`` contains every element of
+    ``region`` (overlaps and duplicates are fine)."""
+    remaining = [region]
+    for cut in covers:
+        if not remaining:
+            return True
+        remaining = [r for base in remaining for r in subtract_box(base, cut)]
+    return not remaining
+
+
 def to_byte_view(arr: np.ndarray) -> np.ndarray:
     """Flat uint8 view over a contiguous array (for bulk/byte transports).
 

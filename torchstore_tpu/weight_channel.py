@@ -37,6 +37,14 @@ def _version_key(name: str, version: int) -> str:
     return f"{name}/v{version}"
 
 
+def _parse_pointer(value) -> tuple[int, int]:
+    """(version, epoch) from a LATEST pointer. Plain ints (pre-epoch
+    pointers recovered from a durable store) read as epoch 0."""
+    if isinstance(value, (tuple, list)):
+        return int(value[0]), int(value[1])
+    return int(value), 0
+
+
 class WeightPublisher:
     """Trainer side of a versioned weight channel."""
 
@@ -54,6 +62,11 @@ class WeightPublisher:
         self._store_name = store_name
         self._client = client
         self._next_version: Optional[int] = None
+        # Channel epoch: minted when this publisher CREATES the channel,
+        # inherited when it resumes one. Lets subscribers distinguish a
+        # deleted-then-recreated channel (fresh epoch, numbering restarts)
+        # from a duplicate wakeup of the same publish (ADVICE r2).
+        self._epoch: Optional[int] = None
 
     def _resolve_client(self):
         if self._client is None:
@@ -84,11 +97,16 @@ class WeightPublisher:
         client = self._resolve_client()
         if self._next_version is None:
             try:
-                self._next_version = int(
+                current, epoch = _parse_pointer(
                     await client.get(f"{self.name}/{_LATEST}")
-                ) + 1
+                )
+                self._next_version = current + 1
+                self._epoch = epoch
             except KeyError:
+                import secrets
+
                 self._next_version = 0
+                self._epoch = secrets.randbits(62) or 1
         version = self._next_version
         data_key = (
             f"{self.name}/direct" if direct else _version_key(self.name, version)
@@ -102,7 +120,7 @@ class WeightPublisher:
             direct=direct,
         )
         # Pointer write LAST: subscribers woken by it see a committed dict.
-        await client.put(f"{self.name}/{_LATEST}", version)
+        await client.put(f"{self.name}/{_LATEST}", (version, self._epoch))
         self._next_version = version + 1
         if not direct:
             await self._gc(client, version)
@@ -146,6 +164,7 @@ class WeightSubscriber:
         self._client = client
         self._last_gen = 0
         self.last_version: Optional[int] = None
+        self._last_epoch: Optional[int] = None
 
     def _resolve_client(self):
         if self._client is None:
@@ -186,12 +205,20 @@ class WeightSubscriber:
                 continue  # deleted channel or mid-rewrite; wait for the next
             data_key = None
             try:
-                # No version-ordering guard needed: the pointer's update
-                # generation is strictly monotonic and bumps exactly once
-                # per publish (gets never bump it), so each committed wake
-                # is a distinct publish — including a deleted-then-recreated
-                # channel whose numbering restarted at 0.
-                version = int(await client.get(pointer))
+                version, epoch = _parse_pointer(await client.get(pointer))
+                if (
+                    version == self.last_version
+                    and epoch == self._last_epoch
+                ):
+                    # Duplicate wakeup: the gen we woke for belongs to a
+                    # publish whose successor we ALREADY returned (the
+                    # pointer is read in a later RPC than the gen, so a
+                    # publish landing in between makes the next wake see
+                    # the same version again). Each publish is delivered
+                    # at most once — wait for a genuinely new one. A
+                    # deleted-then-recreated channel mints a fresh epoch,
+                    # so its restarted numbering still delivers (ADVICE r2).
+                    continue
                 data_key = (
                     f"{self.name}/direct"
                     if direct
@@ -216,4 +243,5 @@ class WeightSubscriber:
                 )
                 continue
             self.last_version = version
+            self._last_epoch = epoch
             return sd, version
