@@ -193,3 +193,83 @@ async def test_delete_cleans_every_replica(store):
         ["gone"], missing_ok=True
     )
     assert located == {}
+
+
+async def test_detached_stale_copy_reclaimed_and_not_served():
+    """ADVICE r2 (medium): after a degraded replicated overwrite, the
+    failed-but-ALIVE replica still holds the OLD bytes, and clients with
+    warm location caches would read them. The controller must best-effort
+    delete the stale copy once the replica recovers, so stale-cache reads
+    fail over to the fresh value instead of silently serving v1."""
+    import os
+    import signal
+
+    from torchstore_tpu.client import LocalClient
+    from torchstore_tpu.config import StoreConfig
+
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="reclaim",
+        config=StoreConfig(rpc_timeout=2.0),
+    )
+    stopped = []
+    try:
+        v1 = np.full(8, 1.0, np.float32)
+        v2 = np.full(8, 2.0, np.float32)
+        await ts.put("k", v1, store_name="reclaim")
+        client = ts.client("reclaim")
+        # A second client with a WARM location cache for k.
+        cli2 = LocalClient(client.controller, client._config)
+        out = await cli2.get("k")
+        np.testing.assert_array_equal(out, v1)
+        assert "k" in cli2._loc_cache and len(cli2._loc_cache["k"]) == 2
+
+        # Wedge volume "1" (alive but stuck) and overwrite at degraded
+        # redundancy.
+        from torchstore_tpu import api
+
+        handle = api._stores["reclaim"]
+        vmap = await client.controller.get_volume_map.call_one()
+        target = vmap["1"]["ref"]
+        proc = None
+        for idx, ref in enumerate(handle.volume_mesh.refs):
+            if (ref.host, ref.port, ref.name) == (
+                target.host, target.port, target.name,
+            ):
+                proc = handle.volume_mesh._processes[idx]
+        assert proc is not None
+        os.kill(proc.pid, signal.SIGSTOP)
+        stopped.append(proc.pid)
+        await ts.put("k", v2, store_name="reclaim")
+        located = await client.controller.locate_volumes.call_one(["k"])
+        assert set(located["k"]) == {"0"}  # detached from the index
+
+        # Recover the wedged replica; the controller's background reclaim
+        # deletes its stale copy (first retry fires ~1s after the detach).
+        os.kill(proc.pid, signal.SIGCONT)
+        stopped.clear()
+        deadline = asyncio.get_event_loop().time() + 30
+        while True:
+            stats = await target.stats.call_one()
+            if stats["entries"] == 0:
+                break
+            assert asyncio.get_event_loop().time() < deadline, (
+                f"stale copy never reclaimed: {stats}"
+            )
+            await asyncio.sleep(0.5)
+
+        # The warm-cached client must now see v2, never v1: its cached
+        # location for volume "1" finds no data and fails over.
+        cli2._loc_cache["k"] = {
+            "1": cli2._loc_cache["k"]["1"]
+        }  # pin the cache to the stale replica
+        out2 = await cli2.get("k")
+        np.testing.assert_array_equal(out2, v2)
+    finally:
+        for pid in stopped:
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+        await ts.shutdown("reclaim")

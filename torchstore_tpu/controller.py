@@ -136,6 +136,13 @@ class Controller(Actor):
         # consumers to poll get_state_dict in a try/except loop).
         self._key_gens: dict[str, int] = {}
         self._update_cond: Optional[Any] = None  # lazily created on its loop
+        # Best-effort reclaims of stale copies on detached replicas: keys
+        # pending per volume, ONE drainer task per volume (a publisher
+        # hammering a wedged replica must not spawn a task per put), all
+        # cancelled at teardown.
+        self._pending_reclaims: dict[str, set] = {}
+        self._reclaim_running: set = set()
+        self._reclaim_tasks: set = set()
 
     def _cond(self):
         import asyncio
@@ -293,7 +300,92 @@ class Controller(Actor):
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
             for vid in detach_volume_ids or ():
                 self._detach_meta(meta, vid)
+        if detach_volume_ids:
+            # The detached replica may be wedged-but-ALIVE and still holding
+            # the old bytes: clients with warm location caches would read
+            # the stale value from it, and delete_batch fans out by index
+            # (which no longer lists it) so the bytes would never be
+            # reclaimed. Best-effort background delete once it's reachable.
+            keys = [meta.key for meta in metas]
+            for vid in detach_volume_ids:
+                self._schedule_reclaim(vid, keys)
         await self._bump({meta.key for meta in metas})
+
+    def _schedule_reclaim(self, volume_id: str, keys: list[str]) -> None:
+        import asyncio
+
+        self._pending_reclaims.setdefault(volume_id, set()).update(keys)
+        if volume_id in self._reclaim_running:
+            return  # the volume's drainer picks the new keys up
+        self._reclaim_running.add(volume_id)
+        task = asyncio.create_task(self._reclaim_detached(volume_id))
+        self._reclaim_tasks.add(task)
+        task.add_done_callback(self._reclaim_tasks.discard)
+
+    async def _reclaim_detached(self, volume_id: str) -> None:
+        """Drain the volume's pending stale keys once it recovers (ADVICE
+        r2). Keys re-indexed on the volume in the meantime are skipped (a
+        later put/repair re-replicated fresh bytes there); a put landing
+        WHILE our delete is in flight is detected afterwards and the
+        volume's index entry detached — honest degraded redundancy instead
+        of an index claiming bytes the volume no longer holds."""
+        import asyncio
+
+        try:
+            for delay in (1.0, 5.0, 15.0, 60.0):
+                await asyncio.sleep(delay)
+                ref = self.volume_refs.get(volume_id)
+                pending = self._pending_reclaims.get(volume_id)
+                if ref is None or not pending:
+                    return
+                batch = {
+                    k for k in pending if volume_id not in self.index.get(k, {})
+                }
+                pending.intersection_update(batch)  # re-indexed keys: done
+                if not batch:
+                    return
+                try:
+                    removed = await ref.delete_batch.call_one(sorted(batch))
+                except Exception:  # noqa: BLE001 - still wedged/dead; retry
+                    continue
+                pending.difference_update(batch)
+                clobbered = [
+                    k for k in batch if volume_id in self.index.get(k, {})
+                ]
+                for key in clobbered:
+                    infos = self.index.get(key)
+                    if infos is not None:
+                        infos.pop(volume_id, None)
+                        if not infos:
+                            self.index.pop(key, None)
+                if clobbered:
+                    logger.warning(
+                        "reclaim raced a fresh put on volume %s: detached "
+                        "%d re-indexed key(s) it may have deleted (%s); "
+                        "redundancy degraded until the next publish",
+                        volume_id,
+                        len(clobbered),
+                        clobbered[:3],
+                    )
+                    await self._bump(set(clobbered))
+                logger.info(
+                    "reclaimed %d stale key(s) on detached volume %s",
+                    removed,
+                    volume_id,
+                )
+                if not pending:
+                    return
+            left = self._pending_reclaims.get(volume_id) or ()
+            if left:
+                logger.warning(
+                    "gave up reclaiming %d stale key(s) on volume %s "
+                    "(unreachable)",
+                    len(left),
+                    volume_id,
+                )
+        finally:
+            self._reclaim_running.discard(volume_id)
+            self._pending_reclaims.pop(volume_id, None)
 
     def _detach_meta(self, meta: Request, volume_id: str) -> None:
         """Remove ONE meta's footprint on ``volume_id``: the exact shard
@@ -591,6 +683,9 @@ class Controller(Actor):
     async def teardown(self) -> None:
         import asyncio
 
+        for task in list(self._reclaim_tasks):
+            task.cancel()
+        self._reclaim_tasks.clear()
         self.index = Trie()
         await asyncio.gather(
             *(ref.reset.call_one() for ref in self.volume_refs.values()),

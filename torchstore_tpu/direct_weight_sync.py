@@ -248,12 +248,13 @@ class DirectWeightSyncSource:
 
         self._host_fallback_lock = threading.Lock()
 
-    def _device_mode_eligible(self, flat: dict, rank: int, num_ranks: int) -> bool:
+    def _device_mode_eligible(self, flat: dict) -> bool:
         """Device path engages when every tensor leaf lives on device: plain
         jax arrays, or rank-local ``Shard`` wrappers whose data is a jax
-        array (multi-rank SPMD sources — each rank publishes its own
-        per-shard device entries, the reference's per-rank handle publication
-        pattern, state_dict_utils.py:217-275)."""
+        array. Rank-independent: each rank of a multi-rank SPMD source
+        registers its own per-shard device entries (``register``'s rank
+        param — the reference's per-rank handle publication pattern,
+        state_dict_utils.py:217-275)."""
         if self.device is False:
             return False
         if not self.config.ici_enabled:
@@ -288,7 +289,7 @@ class DirectWeightSyncSource:
         }
         # Advertise the same reachable name the actor runtime uses.
         hostname = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST", get_hostname())
-        if self._device_mode_eligible(flat, rank, num_ranks):
+        if self._device_mode_eligible(flat):
             return self._register_device(flat, hostname, port, transfer_dtype, rank)
         for flat_key, value in flat.items():
             if (
