@@ -38,7 +38,7 @@ REFERENCE_GBPS = 10.0
 
 N_TENSORS = 32
 TENSOR_MB = 32  # 32 x 32MB = 1 GiB per direction
-ITERS = 4  # segment recycling reaches steady state at iter 2
+ITERS = 6  # iter 0 is cold; iters 1+ are the warm set the headline reports
 
 
 async def device_section() -> None:
@@ -116,8 +116,10 @@ async def run() -> dict:
         stale bytes) and validates every tensor. ``byte_factor`` is how many
         times each byte crosses the data plane per iteration (2 for copy
         round trips, 1 when the publish direction is copy-free)."""
+        import statistics
+
         src = src if src is not None else sd
-        best = 0.0
+        rates: list[float] = []
         for it in range(ITERS):
             stamp = float(it + 1)
             for arr in src["layers"].values():
@@ -129,7 +131,7 @@ async def run() -> dict:
             t2 = time.perf_counter()
             gbps = byte_factor * total_bytes / 1e9 / (t2 - t0)
             kind = "delivered" if byte_factor == 2 else "one-way physical"
-            best = max(best, gbps)
+            rates.append(gbps)
             print(
                 f"# {label} iter {it}: put {total_bytes/1e9/(t1-t0):.2f} GB/s, "
                 f"get {total_bytes/1e9/(t2-t1):.2f} GB/s, "
@@ -142,12 +144,28 @@ async def run() -> dict:
             np.testing.assert_array_equal(
                 out["layers"][str(i)], src["layers"][str(i)]
             )
-        return best
+        # Iter 0 is the cold start (first-touch faults, plan building);
+        # iters 1+ are the warm steady state an RL loop actually lives in.
+        # The headline is the warm MEDIAN — best-of-N would hide warm-path
+        # collapses the consumer feels every step (VERDICT r2).
+        warm = rates[1:] or rates
+        best, median, worst = max(rates), statistics.median(warm), min(warm)
+        print(
+            f"# {label}: warm median {median:.2f}, best {best:.2f}, "
+            f"warm min {worst:.2f} GB/s"
+            + (
+                "  [WARN: warm min < 50% of best — warm-path collapse]"
+                if worst < 0.5 * best
+                else ""
+            ),
+            file=sys.stderr,
+        )
+        return median
 
     # Buffered consumer takes zero-copy snapshot views (the jax consumer
     # pattern: device_put straight from the returned views); `user`-dict
     # in-place landing is exercised by the direct path below.
-    best_buffered = await timed_loop(
+    med_buffered = await timed_loop(
         "buffered",
         lambda: ts.put_state_dict("bench/sd", sd, store_name="bench"),
         lambda: ts.get_state_dict("bench/sd", store_name="bench"),
@@ -160,7 +178,7 @@ async def run() -> dict:
     await ts.get_state_dict(
         "bench/direct", user_state_dict=user, direct=True, store_name="bench"
     )
-    best_direct = await timed_loop(
+    med_direct = await timed_loop(
         "direct",
         lambda: ts.put_state_dict("bench/direct", sd, direct=True, store_name="bench"),
         lambda: ts.get_state_dict(
@@ -204,17 +222,17 @@ async def run() -> dict:
     await device_section()
 
     await ts.shutdown("bench")
-    best = max(best_buffered, best_direct)
+    headline = max(med_buffered, med_direct)
     print(
-        f"# headline: buffered {best_buffered:.2f} GB/s, "
-        f"direct steady-state {best_direct:.2f} GB/s",
+        f"# headline (warm medians): buffered {med_buffered:.2f} GB/s, "
+        f"direct steady-state {med_direct:.2f} GB/s",
         file=sys.stderr,
     )
     return {
         "metric": "state_dict_weight_sync_round_trip",
-        "value": round(best, 3),
+        "value": round(headline, 3),
         "unit": "GB/s",
-        "vs_baseline": round(best / REFERENCE_GBPS, 3),
+        "vs_baseline": round(headline / REFERENCE_GBPS, 3),
     }
 
 

@@ -55,7 +55,14 @@ _STAGE_DEVICE = (1 << 64) - 2
 # rung for dests that cannot reconstruct our device shardings (disjoint jax
 # worlds / non-coinciding device ids).
 _STAGE_HOST = (1 << 64) - 3
+# buffer_id sentinel: "reply with the source's current weight generation"
+# (seqlock: ODD while a refresh is overwriting the staging buffers, even at
+# rest; bumped +2 per publish). Dests read it before and after a host-path
+# pull and retry once on change — tear detection for pulls concurrent with
+# refreshes (VERDICT r2 item 4).
+_GET_GEN = (1 << 64) - 4
 _U64 = struct.Struct("<Q")
+_2U64 = struct.Struct("<QQ")
 
 
 # --------------------------------------------------------------------------
@@ -107,6 +114,8 @@ class _PeerReadServer:
         # materializing current device arrays into host buffers (fallback
         # for dests outside this source's jax world).
         self.stage_host_fn = None
+        # () -> current weight generation (seqlock; see _GET_GEN).
+        self.gen_fn = lambda: 0
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._writers: set = set()
@@ -136,6 +145,12 @@ class _PeerReadServer:
             while True:
                 req = await reader.readexactly(_READ_REQ.size)
                 buffer_id, offset, length = _READ_REQ.unpack(req)
+                if buffer_id == _GET_GEN:
+                    writer.write(
+                        _READ_RESP.pack(_U64.size) + _U64.pack(self.gen_fn())
+                    )
+                    await writer.drain()
+                    continue
                 if buffer_id == _STAGE_DEVICE:
                     if self.stage_device_fn is None:
                         writer.write(_READ_RESP.pack(_ERR))
@@ -149,8 +164,11 @@ class _PeerReadServer:
                             logger.exception("device staging failed")
                             writer.write(_READ_RESP.pack(_ERR))
                         else:
+                            # uid + the generation the staged snapshot was
+                            # taken at (cross-rank consistency check).
                             writer.write(
-                                _READ_RESP.pack(_U64.size) + _U64.pack(uid)
+                                _READ_RESP.pack(_2U64.size)
+                                + _2U64.pack(uid, self.gen_fn())
                             )
                     await writer.drain()
                     continue
@@ -247,6 +265,11 @@ class DirectWeightSyncSource:
         import threading
 
         self._host_fallback_lock = threading.Lock()
+        # Weight generation (seqlock): even at rest, ODD while a refresh is
+        # overwriting staging buffers in place; +2 net per publish. Served
+        # to dests via the _GET_GEN control op for tear detection.
+        self._gen = 0
+        self.server.gen_fn = lambda: self._gen
 
     def _device_mode_eligible(self, flat: dict) -> bool:
         """Device path engages when every tensor leaf lives on device: plain
@@ -454,33 +477,40 @@ class DirectWeightSyncSource:
         serializes concurrent fallback pulls (unlocked, two threads could
         allocate the same buffer id for different tensors — silent weight
         swaps for same-shape params)."""
+        with self._host_fallback_lock:
+            self._gen += 1  # odd: fallback buffers being overwritten
+            try:
+                return self._materialize_host_handles()
+            finally:
+                self._gen += 1
+
+    def _materialize_host_handles(self) -> bytes:
         import pickle
 
-        with self._host_fallback_lock:
-            hostname, port = self._advertise
-            handles: dict[str, list[WeightHandle]] = {}
-            for idx, (flat_key, ts_slice, arr) in enumerate(
-                self._current_device_parts()
-            ):
-                host_arr = np.ascontiguousarray(np.asarray(arr))
-                buffer_id = self._host_fallback_ids.get(idx)
-                if buffer_id is None:
-                    buffer_id = self._next_id
-                    self._next_id += 1
-                    self._host_fallback_ids[idx] = buffer_id
-                self.server.buffers[buffer_id] = host_arr
-                handles.setdefault(flat_key, []).append(
-                    WeightHandle(
-                        buffer_id=buffer_id,
-                        hostname=hostname,
-                        port=port,
-                        shm_name=None,
-                        meta=TensorMeta.of(host_arr),
-                        tensor_slice=ts_slice,
-                        source_rank=self.device_info["source_rank"],
-                    )
+        hostname, port = self._advertise
+        handles: dict[str, list[WeightHandle]] = {}
+        for idx, (flat_key, ts_slice, arr) in enumerate(
+            self._current_device_parts()
+        ):
+            host_arr = np.ascontiguousarray(np.asarray(arr))
+            buffer_id = self._host_fallback_ids.get(idx)
+            if buffer_id is None:
+                buffer_id = self._next_id
+                self._next_id += 1
+                self._host_fallback_ids[idx] = buffer_id
+            self.server.buffers[buffer_id] = host_arr
+            handles.setdefault(flat_key, []).append(
+                WeightHandle(
+                    buffer_id=buffer_id,
+                    hostname=hostname,
+                    port=port,
+                    shm_name=None,
+                    meta=TensorMeta.of(host_arr),
+                    tensor_slice=ts_slice,
+                    source_rank=self.device_info["source_rank"],
                 )
-            return pickle.dumps(handles)
+            )
+        return pickle.dumps(handles)
 
     @staticmethod
     def _shards_of(value) -> Optional[list[tuple[TensorSlice, np.ndarray]]]:
@@ -519,7 +549,16 @@ class DirectWeightSyncSource:
         if not self._registered:
             raise RuntimeError("register() must run before refresh()")
         if self.device_info is not None:
+            # Device staging snapshots per pull; publish = one stable bump.
+            self._gen += 2
             return
+        self._gen += 1  # seqlock: odd while buffers are being overwritten
+        try:
+            await self._refresh_host()
+        finally:
+            self._gen += 1
+
+    async def _refresh_host(self) -> None:
         for flat_key, value in self._sources.items():
             if (
                 self._transfer_dtype is not None
@@ -782,9 +821,74 @@ class DirectWeightSyncDest:
         all_handles: dict[str, list[WeightHandle]],
         dest_state_dict: Any,
     ) -> Any:
-        """Concurrently pull every planned region and rebuild the dest dict.
-        The plan is cached and reused while the handle/dest signature is
-        unchanged (reference cached-plan invariant)."""
+        """Concurrently pull every planned region and rebuild the dest dict,
+        seqlock-validated against concurrent source refreshes: source
+        generations are read before and after the data moves, and the pull
+        retries ONCE when any source refreshed mid-flight (a retry fully
+        overwrites in-place landings). The plan is cached and reused while
+        the handle/dest signature is unchanged (reference cached-plan
+        invariant)."""
+        endpoints = sorted(
+            {
+                (h.hostname, h.port)
+                for handle_list in all_handles.values()
+                for h in handle_list
+            }
+        )
+        gens0 = None
+        for attempt in (0, 1):
+            try:
+                gens0 = await self._stable_gens(endpoints)
+            except KeyError:
+                # Pre-generation source (or server without the op): serve
+                # the pull unchecked rather than failing it.
+                return await self._pull_once(all_handles, dest_state_dict)
+            result = await self._pull_once(all_handles, dest_state_dict)
+            gens1 = list(
+                await asyncio.gather(
+                    *(self._read_gen(h, p) for h, p in endpoints)
+                )
+            )
+            if gens1 == gens0:
+                return result
+            logger.info(
+                "direct pull raced a source refresh (gens %s -> %s); "
+                "retrying once",
+                gens0,
+                gens1,
+            )
+        raise RuntimeError(
+            "direct pull torn twice by concurrent source refreshes — "
+            "throttle publishes or pull between refreshes"
+        )
+
+    async def _read_gen(self, hostname: str, port: int) -> int:
+        (gen,) = _U64.unpack(
+            await self._control_op(hostname, port, _GET_GEN)
+        )
+        return gen
+
+    async def _stable_gens(self, endpoints) -> list:
+        """Every source's generation once none is mid-refresh (odd)."""
+        for _ in range(100):
+            gens = list(
+                await asyncio.gather(
+                    *(self._read_gen(h, p) for h, p in endpoints)
+                )
+            )
+            if all(g % 2 == 0 for g in gens):
+                return gens
+            await asyncio.sleep(0.02)
+        raise RuntimeError(
+            "source refresh never settled (generation stayed odd) — "
+            "source wedged mid-refresh?"
+        )
+
+    async def _pull_once(
+        self,
+        all_handles: dict[str, list[WeightHandle]],
+        dest_state_dict: Any,
+    ) -> Any:
         tracker = LatencyTracker("direct_pull")
         dest_flat, mapping = flatten_state_dict(dest_state_dict)
         # The signature must cover the dest layouts, not just key names — a
@@ -989,20 +1093,40 @@ class DirectWeightSyncDest:
         engine = dt.DeviceTransferEngine.get()
         parts_by_key: dict[str, list[tuple[TensorSlice, Any]]] = {}
         pulled_bytes = 0
-        # Stage each rank immediately before pulling it: on a mid-sequence
-        # failure at most ONE staged uuid is left un-pulled (the engine has
-        # no un-stage op), instead of one per remaining rank.
-        for info, specs in zip(device_infos, built_specs):
-            uid = await self._stage_remote(info)
-            entries = info["entries"]
-            arrays = engine.pull_built(info["address"], uid, specs)
-            for entry, arr in zip(entries, arrays):
-                parts_by_key.setdefault(entry.flat_key, []).append(
-                    (entry.tensor_slice, arr)
-                )
-                pulled_bytes += int(np.prod(entry.spec.shape)) * TensorMeta(
-                    shape=(), dtype=entry.spec.dtype
-                ).np_dtype.itemsize
+        # Each staged snapshot is internally consistent (immutable arrays
+        # captured in one event-loop call), but ranks refresh independently
+        # — a pull mixing rank A at step N with rank B at N+1 is torn.
+        # Every rank's stage op reports its generation; mixed gens retry
+        # the whole pull once. Stage each rank immediately before pulling
+        # it: on a mid-sequence failure at most ONE staged uuid is left
+        # un-pulled (the engine has no un-stage op).
+        for attempt in (0, 1):
+            parts_by_key.clear()
+            pulled_bytes = 0
+            gens = []
+            for info, specs in zip(device_infos, built_specs):
+                uid, gen = await self._stage_remote(info)
+                gens.append(gen)
+                entries = info["entries"]
+                arrays = engine.pull_built(info["address"], uid, specs)
+                for entry, arr in zip(entries, arrays):
+                    parts_by_key.setdefault(entry.flat_key, []).append(
+                        (entry.tensor_slice, arr)
+                    )
+                    pulled_bytes += int(np.prod(entry.spec.shape)) * TensorMeta(
+                        shape=(), dtype=entry.spec.dtype
+                    ).np_dtype.itemsize
+            if len(set(gens)) <= 1:
+                break
+            logger.info(
+                "device pull mixed source generations %s; retrying once",
+                gens,
+            )
+        else:
+            raise RuntimeError(
+                f"device pull mixed source generations twice ({gens}) — "
+                "source ranks are publishing out of lockstep"
+            )
         tracker.track_step("pull", pulled_bytes)
         out_flat = dict(dest_flat)
         for flat_key, target in dest_flat.items():
@@ -1024,18 +1148,12 @@ class DirectWeightSyncDest:
 
         return unflatten_state_dict(out_flat, mapping)
 
-    async def _control_request(self, device_info: dict, opcode: int) -> bytes:
-        """One control op against a source rank's peer server: send the
-        sentinel ``opcode``, return the response payload (both staging ops
-        share the length-prefixed reply shape)."""
-        host = (
-            "127.0.0.1"
-            if device_info["hostname"] == get_hostname()
-            else device_info["hostname"]
-        )
-        reader, writer, lock = await self._get_conn(
-            host, device_info["control_port"]
-        )
+    async def _control_op(self, hostname: str, port: int, opcode: int) -> bytes:
+        """One control op against a source's peer server: send the sentinel
+        ``opcode``, return the response payload (all control ops share the
+        length-prefixed reply shape)."""
+        host = "127.0.0.1" if hostname == get_hostname() else hostname
+        reader, writer, lock = await self._get_conn(host, port)
         async with lock:
             writer.write(_READ_REQ.pack(opcode, 0, 0))
             await writer.drain()
@@ -1048,11 +1166,19 @@ class DirectWeightSyncDest:
                 )
             return await reader.readexactly(length)
 
-    async def _stage_remote(self, device_info: dict) -> int:
+    async def _control_request(self, device_info: dict, opcode: int) -> bytes:
+        return await self._control_op(
+            device_info["hostname"], device_info["control_port"], opcode
+        )
+
+    async def _stage_remote(self, device_info: dict) -> tuple[int, int]:
         """Ask one source rank to stage its current arrays; returns the
-        transfer uuid serving exactly this pull."""
-        (uid,) = _U64.unpack(await self._control_request(device_info, _STAGE_DEVICE))
-        return uid
+        transfer uuid serving exactly this pull plus the source's weight
+        generation at staging time (the snapshot's step identity)."""
+        uid, gen = _2U64.unpack(
+            await self._control_request(device_info, _STAGE_DEVICE)
+        )
+        return uid, gen
 
     async def _fetch_host_handles(
         self, device_info: dict

@@ -90,8 +90,9 @@ class WeightPublisher:
         STABLE key (``name/direct``): the first publish registers staging
         buffers, later ones are refreshes — no per-version registrations to
         leak, and the version number is purely the subscriber wakeup
-        ordinal. As with direct sync generally, a pull concurrent with a
-        refresh may observe the newer bytes."""
+        ordinal. A pull concurrent with a refresh is detected by the
+        source's seqlock generation and retried, so the returned dict is
+        always internally consistent (one step's weights, never a mix)."""
         from torchstore_tpu import state_dict_utils
 
         client = self._resolve_client()
