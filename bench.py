@@ -41,54 +41,149 @@ TENSOR_MB = 32  # 32 x 32MB = 1 GiB per direction
 ITERS = 6  # iter 0 is cold; iters 1+ are the warm set the headline reports
 
 
-async def device_section() -> None:
-    """Device-sourced sync with per-phase timing: separates the accelerator
-    D2H cost (tunnel/PCIe — environment-attributable) from the framework's
-    data-plane cost. Small payload: this image's TPU tunnel moves
-    device->host at ~0.01 GB/s, which would otherwise dominate the bench.
-    Best-effort: any device/runtime issue skips the section."""
+async def _device_section_child() -> int:
+    """Runs INSIDE the isolated subprocess (``bench.py --device-section``).
+
+    Benches the flagship device (ICI) rung: a jax state dict registered on
+    the real chip via the device-mode direct sync, pulled HBM->HBM through
+    the XLA transfer engine (the re-architecture of the reference's
+    one-sided RDMA reads, monarch_rdma.py:158-219). Also measures the
+    legacy host-staging comparison (bare D2H) so the tunnel/PCIe floor is
+    attributable. Exit codes: 0 = measured, 3 = no TPU in this jax world.
+    """
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform not in ("tpu", "axon"):
+        print(f"# device section: no TPU (platform={devs[0].platform})")
+        return 3
+    dev = devs[0]
+    from torchstore_tpu.direct_weight_sync import (
+        DirectWeightSyncDest,
+        DirectWeightSyncSource,
+    )
+
+    n_t, elems = 8, 8 * 1024 * 1024  # 8 x 32 MB fp32 = 256 MB on chip
+    host = [np.random.rand(elems).astype(np.float32) for _ in range(n_t)]
+    sd = {str(i): jax.device_put(h, dev) for i, h in enumerate(host)}
+    jax.block_until_ready(list(sd.values()))
+    total = sum(h.nbytes for h in host)
+
+    source = DirectWeightSyncSource()
+    dest = DirectWeightSyncDest()
+    try:
+        await source.register(sd)
+        if source.device_info is None:
+            print("# device section: device path did not engage")
+            return 3
+        target = {
+            str(i): jax.ShapeDtypeStruct(
+                (elems,),
+                np.float32,
+                sharding=jax.sharding.SingleDeviceSharding(dev),
+            )
+            for i in range(n_t)
+        }
+        rates = []
+        for it in range(4):
+            # Republish current weights (device mode: metadata-only bump;
+            # staging happens per pull, so every iter moves fresh bytes).
+            stamp = float(it + 1)
+            sd = {
+                k: v.at[0].set(stamp) for k, v in sd.items()
+            }
+            jax.block_until_ready(list(sd.values()))
+            source.update_sources(sd)
+            await source.refresh()
+            t0 = time.perf_counter()
+            out = await dest.pull_device([source.device_info], dict(target))
+            jax.block_until_ready(list(out.values()))
+            dt = time.perf_counter() - t0
+            gbps = total / 1e9 / dt
+            rates.append(gbps)
+            first = float(np.asarray(out["0"][0]))
+            assert first == stamp, f"stale device pull: {first} != {stamp}"
+            print(
+                f"# device-path iter {it}: pull {dt*1e3:.0f} ms "
+                f"({gbps:.2f} GB/s HBM->HBM via transfer engine)"
+            )
+        warm = rates[1:] or rates
+        import statistics
+
+        print(
+            f"# device-path direct sync ({total/1e6:.0f} MB on "
+            f"{dev.platform}): warm median {statistics.median(warm):.2f} "
+            f"GB/s, best {max(rates):.2f} GB/s  [delivered == physical: "
+            "each byte moves once, device to device]"
+        )
+        # Tunnel floor for context: bare serial D2H of one tensor.
+        t0 = time.perf_counter()
+        np.asarray(sd["0"])
+        d2h = time.perf_counter() - t0
+        print(
+            f"# context: bare D2H of one 32 MB tensor {d2h*1e3:.0f} ms "
+            f"({host[0].nbytes/1e9/d2h:.3f} GB/s tunnel/PCIe floor)"
+        )
+        return 0
+    finally:
+        await dest.close()
+        await source.close()
+
+
+def device_section_subprocess() -> None:
+    """Run the device bench in a FRESH subprocess with one retry (VERDICT
+    r3 item 1): a wedged or failing TPU backend (axon tunnel) can hang or
+    crash jax init, and in-process that erased the round's only hardware
+    evidence (BENCH_r03). The subprocess is killed on timeout and the
+    failure documented; the host sections above are never at risk."""
     import os
+    import subprocess
 
     if os.environ.get("TORCHSTORE_TPU_BENCH_DEVICE", "1") in ("0", "false"):
+        print("# device section disabled (TORCHSTORE_TPU_BENCH_DEVICE=0)", file=sys.stderr)
         return
-    try:
-        import jax
-
-        import torchstore_tpu as ts
-
-        dev = jax.devices()[0]
-        n_t, elems = 4, 512 * 1024  # 4 x 2 MB fp32 = 8 MB
-        host = [np.random.rand(elems).astype(np.float32) for _ in range(n_t)]
-        set_a = {str(i): jax.device_put(h, dev) for i, h in enumerate(host)}
-        set_b = {str(i): jax.device_put(h, dev) for i, h in enumerate(host)}
-        jax.block_until_ready(list(set_a.values()) + list(set_b.values()))
-        total = sum(h.nbytes for h in host)
-
-        # Phase 1: bare serial D2H (the environment's floor; jax caches the
-        # host copy, so set_a is consumed by this measurement only).
-        t0 = time.perf_counter()
-        for a in set_a.values():
-            np.asarray(a)
-        d2h_s = time.perf_counter() - t0
-        # Phase 2: store put of DEVICE arrays (includes overlapped D2H).
-        t0 = time.perf_counter()
-        await ts.put_state_dict("bench/dev", set_b, store_name="bench")
-        put_s = time.perf_counter() - t0
-        # Phase 3: host-side get (no device involvement).
-        t0 = time.perf_counter()
-        out = await ts.get_state_dict("bench/dev", store_name="bench")
-        get_s = time.perf_counter() - t0
-        np.testing.assert_array_equal(np.asarray(out["0"]), host[0])
-        print(
-            f"# device-sourced ({total/1e6:.0f} MB on {dev.platform}): "
-            f"bare D2H {d2h_s*1e3:.0f} ms ({total/1e9/d2h_s:.3f} GB/s), "
-            f"put incl overlapped D2H {put_s*1e3:.0f} ms, "
-            f"framework share {max(put_s-d2h_s,0)*1e3:.0f} ms, "
-            f"get {get_s*1e3:.0f} ms ({total/1e9/get_s:.2f} GB/s)",
-            file=sys.stderr,
-        )
-    except Exception as exc:  # pragma: no cover - device-env dependent
-        print(f"# device-sourced section skipped: {exc!r}", file=sys.stderr)
+    env = dict(os.environ)
+    # The child must see the REAL platform: undo any CPU forcing.
+    env.pop("JAX_PLATFORMS", None)
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--device-section"],
+                capture_output=True,
+                text=True,
+                timeout=180,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"# device section attempt {attempt}: TIMED OUT after 180s "
+                "(TPU backend hung — axon tunnel down?)",
+                file=sys.stderr,
+            )
+            continue
+        for line in (proc.stdout + proc.stderr).splitlines():
+            if line.startswith("#"):
+                print(line, file=sys.stderr)
+        if proc.returncode == 0:
+            return
+        if proc.returncode == 3:
+            print(
+                f"# device section attempt {attempt}: no usable TPU "
+                "(see lines above)",
+                file=sys.stderr,
+            )
+        else:
+            tail = "; ".join(proc.stderr.strip().splitlines()[-2:])
+            print(
+                f"# device section attempt {attempt} failed "
+                f"(exit {proc.returncode}): {tail}",
+                file=sys.stderr,
+            )
+    print(
+        "# device-path section SKIPPED after 2 attempts — no hardware "
+        "numbers this run (subprocess-isolated; host sections unaffected)",
+        file=sys.stderr,
+    )
 
 
 async def run() -> dict:
@@ -219,7 +314,7 @@ async def run() -> dict:
     p50g = sorted(lat_get)[len(lat_get) // 2] * 1e3
     print(f"# p50 latency (1KB): put {p50p:.2f} ms, get {p50g:.2f} ms", file=sys.stderr)
 
-    await device_section()
+    device_section_subprocess()
 
     await ts.shutdown("bench")
     headline = max(med_buffered, med_direct)
@@ -237,5 +332,7 @@ async def run() -> dict:
 
 
 if __name__ == "__main__":
+    if "--device-section" in sys.argv:
+        sys.exit(asyncio.run(_device_section_child()))
     result = asyncio.run(run())
     print(json.dumps(result))

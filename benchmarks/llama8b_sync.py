@@ -21,17 +21,26 @@ import time
 import numpy as np
 
 
-def llama8b_state_dict(dtype: str, scale: float) -> dict:
+def llama8b_state_dict(
+    dtype: str, scale: float, model: str = "8b", layers: "int | None" = None
+) -> dict:
     import ml_dtypes
 
     from torchstore_tpu.models.llama import LlamaConfig
 
-    cfg = LlamaConfig.llama3_8b()  # the canonical geometry, not a copy
+    # The canonical geometries, not copies. 70B shard shapes with a reduced
+    # layer count are the VERDICT r3 item 8 config (full 80 layers = 141 GB
+    # bf16, ~3x too big for host + staging + dest on this machine).
+    cfg = (
+        LlamaConfig.llama3_70b() if model == "70b" else LlamaConfig.llama3_8b()
+    )
     np_dtype = np.dtype(ml_dtypes.bfloat16 if dtype == "bfloat16" else dtype)
     h = max(64, int(cfg.hidden_size * scale) // 64 * 64)
     inter = max(128, int(cfg.intermediate_size * scale) // 64 * 64)
     vocab = max(256, int(cfg.vocab_size * scale) // 64 * 64)
     n_layers = cfg.num_layers if scale >= 1.0 else max(2, int(cfg.num_layers * scale))
+    if layers is not None:
+        n_layers = layers
     heads, kv_heads = cfg.num_heads, cfg.num_kv_heads
     head_dim = h // heads
 
@@ -76,14 +85,16 @@ def count(sd):
     return n, total
 
 
-async def run(dtype: str, scale: float) -> None:
+async def run(
+    dtype: str, scale: float, model: str = "8b", layers: "int | None" = None
+) -> None:
     import torchstore_tpu as ts
 
-    sd = llama8b_state_dict(dtype, scale)
+    sd = llama8b_state_dict(dtype, scale, model, layers)
     n_tensors, total = count(sd)
     print(
-        f"# llama8b-shaped state dict: {n_tensors} tensors, "
-        f"{total / 1e9:.2f} GB {dtype} (scale={scale})",
+        f"# llama{model}-shaped state dict: {n_tensors} tensors, "
+        f"{total / 1e9:.2f} GB {dtype} (scale={scale}, layers={layers})",
         file=sys.stderr,
     )
     await ts.initialize(
@@ -149,5 +160,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--model", choices=("8b", "70b"), default="8b")
+    ap.add_argument("--layers", type=int, default=None,
+                    help="override layer count (70b default run uses 8)")
     args = ap.parse_args()
-    asyncio.run(run(args.dtype, args.scale))
+    asyncio.run(run(args.dtype, args.scale, args.model, args.layers))

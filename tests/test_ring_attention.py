@@ -22,19 +22,100 @@ def make_qkv(b=2, s=64, h=4, d=16, seed=0):
     return tuple(jax.random.normal(key, shape, jnp.float32) for key in keys)
 
 
+@pytest.mark.parametrize("impl", ["fused", "einsum"])
 @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
 @pytest.mark.parametrize("ring", [2, 4, 8])
-def test_matches_dense(causal, ring):
+def test_matches_dense(causal, ring, impl):
+    """Both block bodies — the pallas fused kernel (per-hop
+    flash_attention_stats + online-softmax merge) and the einsum fallback —
+    are exact vs dense attention (VERDICT r3 item 5)."""
     q, k, v = make_qkv()
     mesh = parallel.make_mesh({"sp": ring})
     spec = NamedSharding(mesh, P(None, "sp", None, None))
     qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
-    out = ring_attention_sharded(qs, ks, vs, mesh, "sp", causal=causal)
+    out = ring_attention_sharded(qs, ks, vs, mesh, "sp", causal=causal, impl=impl)
     ref = dense_reference(q, k, v, causal)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
     assert out.sharding.spec == P(None, "sp", None, None)
+
+
+@pytest.mark.parametrize("impl", ["fused", "einsum"])
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_gqa_matches_dense(causal, impl):
+    """GQA through the ring: kv heads stay unrepeated on the wire in both
+    bodies (grouped einsum / in-kernel kv index map)."""
+    keys = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(keys[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(keys[1], (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(keys[2], (2, 64, 2, 16), jnp.float32)
+    mesh = parallel.make_mesh({"sp": 4})
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = ring_attention_sharded(qs, ks, vs, mesh, "sp", causal=causal, impl=impl)
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["fused", "einsum"])
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_gradients_match_dense(causal, impl):
+    """Training differentiates through ring attention; the fused body's
+    custom VJP (pallas forward, dense recompute backward) must produce the
+    same q/k/v gradients as differentiating dense attention."""
+    q, k, v = make_qkv(b=1, s=32, h=2, d=16, seed=11)
+    mesh = parallel.make_mesh({"sp": 4})
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+
+    def ring_loss(q, k, v):
+        out = ring_attention_sharded(q, k, v, mesh, "sp", causal=causal, impl=impl)
+        return jnp.sum(out * jnp.cos(jnp.arange(out.size).reshape(out.shape)))
+
+    def dense_loss(q, k, v):
+        out = dense_reference(q, k, v, causal)
+        return jnp.sum(out * jnp.cos(jnp.arange(out.size).reshape(out.shape)))
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(qs, ks, vs)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=3e-5, rtol=3e-5
+        )
+
+
+def test_auto_picks_fused_for_tileable_shapes():
+    from torchstore_tpu.ops.flash_attention import flash_stats_eligible
+
+    assert flash_stats_eligible((2, 8, 4, 16), (2, 8, 4, 16))
+    assert not flash_stats_eligible((2, 9, 4, 16), (2, 9, 4, 16))  # 9 untileable
+    assert not flash_stats_eligible((2, 8, 4, 10), (2, 8, 4, 10))  # d % 8
+
+
+def test_flash_stats_merge_identity():
+    """flash_attention_stats blocks merged with the flash rescale equal
+    whole-sequence dense attention — the invariant the ring's hop merge
+    relies on."""
+    from torchstore_tpu.ops.flash_attention import flash_attention_stats
+
+    q, k, v = make_qkv(b=1, s=64, h=2, d=16, seed=5)
+    k1, k2 = k[:, :32], k[:, 32:]
+    v1, v2 = v[:, :32], v[:, 32:]
+    a1, m1, l1 = flash_attention_stats(q, k1, v1)
+    a2, m2, l2 = flash_attention_stats(q, k2, v2)
+    m = jnp.maximum(m1, m2)
+    c1, c2 = jnp.exp(m1 - m), jnp.exp(m2 - m)
+    o = (a1 * c1[..., None] + a2 * c2[..., None]) / (
+        l1 * c1 + l2 * c2
+    )[..., None]
+    out = jnp.transpose(o, (0, 2, 1, 3))
+    ref = dense_reference(q, k, v, False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
 
 
 def test_single_device_ring_degenerates_to_dense():

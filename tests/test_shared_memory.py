@@ -117,21 +117,26 @@ class TestPoolWarmer:
         a.unlink()
         cache.clear()
 
-    async def test_defers_under_load(self):
+    async def test_warms_under_load(self):
+        """With MAP_POPULATE, warming is one batched kernel call on an
+        executor thread — it completes regardless of store activity (the
+        old trap-per-page prefault deferred under load; that slow path
+        survives only on platforms without MAP_POPULATE)."""
         import asyncio
         import time as _time
 
+        import pytest
+
+        if not ShmSegment._POPULATE:
+            pytest.skip("platform lacks MAP_POPULATE")
         cache = ShmServerCache()
         cache.last_activity = _time.monotonic()  # live traffic
         cache.schedule_warm([4096])
-        await asyncio.sleep(0.3)
-        assert cache.take_free(4096) is None  # not warmed yet
-        cache.last_activity = _time.monotonic() - 5.0
         for _ in range(50):
             await asyncio.sleep(0.05)
             if cache.free_by_size.get(4096):
                 break
-        assert cache.free_by_size.get(4096)  # warmed once idle
+        assert cache.free_by_size.get(4096)  # warmed despite activity
         cache.clear()
 
     def test_no_loop_is_noop(self):

@@ -117,17 +117,22 @@ class LocalClient:
 
     async def _land_requests(
         self, volume: StorageVolumeRef, requests: list[Request]
-    ) -> None:
+    ) -> dict[str, int]:
         """Data-plane landing of ``requests`` on one volume (batched where
-        the transport supports it) — shared by put_batch and replicate_to."""
+        the transport supports it) — shared by put_batch and replicate_to.
+        Returns the volume-assigned per-key write generations, forwarded to
+        the controller so stale-replica reclaims can delete conditionally."""
         buffer = create_transport_buffer(volume, self._config)
         if buffer.supports_batch_puts:
             await buffer.put_to_storage_volume(volume, requests)
-            return
+            return buffer.write_gens or {}
         await buffer.put_to_storage_volume(volume, requests[:1])
+        gens = dict(buffer.write_gens or {})
         for req in requests[1:]:
             b = create_transport_buffer(volume, self._config)
             await b.put_to_storage_volume(volume, [req])
+            gens.update(b.write_gens or {})
+        return gens
 
     def _put_volumes(self) -> list[StorageVolumeRef]:
         """Every volume a put writes to (primary + replicas)."""
@@ -188,9 +193,9 @@ class LocalClient:
         volumes = self._put_volumes()
         nbytes = sum(r.nbytes for r in requests)
 
-        async def put_to(volume: StorageVolumeRef) -> None:
+        async def put_to(volume: StorageVolumeRef) -> dict[str, int]:
             try:
-                await self._land_requests(volume, requests)
+                return await self._land_requests(volume, requests)
             except (ActorDiedError, ConnectionError, OSError) as exc:
                 # Bulk/peer transports surface volume death as
                 # ConnectionError — normalize so callers and the failover
@@ -204,7 +209,11 @@ class LocalClient:
         results = await asyncio.gather(
             *(put_to(v) for v in volumes), return_exceptions=True
         )
-        landed = [v for v, r in zip(volumes, results) if not isinstance(r, BaseException)]
+        landed = [
+            (v, r)
+            for v, r in zip(volumes, results)
+            if not isinstance(r, BaseException)
+        ]
         failed = [
             (v, r)
             for v, r in zip(volumes, results)
@@ -232,8 +241,9 @@ class LocalClient:
         # window where new metadata coexists with a stale replica location.
         await self._controller.notify_put_batch.call_one(
             [r.meta_only() for r in requests],
-            [v.volume_id for v in landed],
+            [v.volume_id for v, _ in landed],
             detach_volume_ids=[v.volume_id for v, _ in failed] or None,
+            write_gens={v.volume_id: gens for v, gens in landed},
         )
         tracker.track_step("notify")
         tracker.log_summary()
@@ -682,9 +692,11 @@ class LocalClient:
         them there (bypasses strategy placement — the re-replication path
         of ``ts.repair``)."""
         await self._ensure_setup()
-        await self._land_requests(self._volume_refs[volume_id], requests)
+        gens = await self._land_requests(self._volume_refs[volume_id], requests)
         await self._controller.notify_put_batch.call_one(
-            [r.meta_only() for r in requests], volume_id
+            [r.meta_only() for r in requests],
+            volume_id,
+            write_gens={volume_id: gens},
         )
 
     # ------------------------------------------------------------------

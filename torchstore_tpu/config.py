@@ -103,6 +103,14 @@ class StoreConfig:
             _env_str("TORCHSTORE_TPU_HANDSHAKE_TIMEOUT", "60")
         )
     )
+    # How long a direct pull waits for a source's seqlock generation to
+    # settle (even) before giving up. Model-scale refreshes / fallback
+    # stagings legitimately hold the generation odd for seconds.
+    direct_settle_timeout: float = field(
+        default_factory=lambda: float(
+            _env_str("TORCHSTORE_TPU_DIRECT_SETTLE_TIMEOUT", "30")
+        )
+    )
 
     # --- logging ------------------------------------------------------------
     log_level: str = field(

@@ -54,6 +54,12 @@ class StorageInfo:
     tensor_meta: Optional[TensorMeta] = None
     # coords -> TensorSlice, for TENSOR_SLICE keys.
     tensor_slices: dict[tuple, TensorSlice] = field(default_factory=dict)
+    # The volume-assigned write generation of the newest put indexed here
+    # (volume-local timestamp; see StorageVolume._bump_write_gens). When
+    # this replica is later detached, the reclaim deletes its copy only if
+    # the volume's generation hasn't moved past this — an acknowledged put
+    # racing the reclaim can never lose its bytes (ADVICE r3).
+    write_gen: int = 0
 
     def merge(self, meta: Request) -> None:
         incoming = _object_type(meta)
@@ -76,20 +82,21 @@ class StorageInfo:
 
 def resolve_manifests(
     per_volume: list[tuple[str, list]],
-) -> tuple[list[tuple[str, Request]], int]:
-    """Resolve volume manifests into (volume_id, meta) entries to index,
-    keeping only the NEWEST shard layout (by file mtime) when a key carries
-    mixed mesh/global shapes — see ``Controller.rebuild_index``. Returns
-    (survivors, dropped_count). Accepts bare ``Request`` items from backends
-    without mtimes (treated as mtime 0)."""
-    entries: list[tuple[str, Request, Optional[tuple]]] = []
+) -> tuple[list[tuple[str, Request, int]], int]:
+    """Resolve volume manifests into (volume_id, meta, write_gen) entries to
+    index, keeping only the NEWEST shard layout (by file mtime) when a key
+    carries mixed mesh/global shapes — see ``Controller.rebuild_index``.
+    Returns (survivors, dropped_count). Accepts bare ``Request`` items from
+    backends without mtimes (treated as mtime 0, write_gen 0)."""
+    entries: list[tuple[str, Request, Optional[tuple], int]] = []
     layouts: dict[str, dict[tuple, float]] = {}  # key -> sig -> max mtime
     for vid, manifest in per_volume:
         for item in manifest:
             if isinstance(item, dict):
                 meta, mtime = item["meta"], item.get("mtime", 0.0)
+                gen = item.get("write_gen", 0)
             else:
-                meta, mtime = item, 0.0
+                meta, mtime, gen = item, 0.0, 0
             sig = None
             if meta.tensor_slice is not None:
                 ts = meta.tensor_slice
@@ -100,19 +107,19 @@ def resolve_manifests(
                 )
                 sigs = layouts.setdefault(meta.key, {})
                 sigs[sig] = max(sigs.get(sig, 0.0), mtime)
-            entries.append((vid, meta, sig))
+            entries.append((vid, meta, sig, gen))
     winners = {
         key: max(sigs, key=sigs.get)
         for key, sigs in layouts.items()
         if len(sigs) > 1
     }
-    survivors: list[tuple[str, Request]] = []
+    survivors: list[tuple[str, Request, int]] = []
     dropped = 0
-    for vid, meta, sig in entries:
+    for vid, meta, sig, gen in entries:
         if sig is not None and meta.key in winners and sig != winners[meta.key]:
             dropped += 1
             continue
-        survivors.append((vid, meta))
+        survivors.append((vid, meta, gen))
     return survivors, dropped
 
 
@@ -136,11 +143,11 @@ class Controller(Actor):
         # consumers to poll get_state_dict in a try/except loop).
         self._key_gens: dict[str, int] = {}
         self._update_cond: Optional[Any] = None  # lazily created on its loop
-        # Best-effort reclaims of stale copies on detached replicas: keys
-        # pending per volume, ONE drainer task per volume (a publisher
-        # hammering a wedged replica must not spawn a task per put), all
-        # cancelled at teardown.
-        self._pending_reclaims: dict[str, set] = {}
+        # Best-effort reclaims of stale copies on detached replicas:
+        # {key: stale write gen} pending per volume, ONE drainer task per
+        # volume (a publisher hammering a wedged replica must not spawn a
+        # task per put), all cancelled at teardown.
+        self._pending_reclaims: dict[str, dict[str, int]] = {}
         self._reclaim_running: set = set()
         self._reclaim_tasks: set = set()
 
@@ -250,6 +257,7 @@ class Controller(Actor):
         metas: list[Request],
         volume_id: "str | list[str]",
         detach_volume_ids: Optional[list[str]] = None,
+        write_gens: Optional[dict[str, dict[str, int]]] = None,
     ) -> None:
         """Index ``metas`` as stored on ``volume_id`` — a single id, or a
         LIST of ids for replicated puts (one RPC, one generation bump, and
@@ -260,8 +268,13 @@ class Controller(Actor):
         indexing step (no await between index and detach), so no reader
         ever sees new metadata alongside a stale-replica location. Detach
         is meta-granular: for sharded keys only the failed shard's coords
-        are removed; sibling ranks' shards on the same volume survive."""
+        are removed; sibling ranks' shards on the same volume survive.
+
+        ``write_gens``: {volume_id: {key: gen}} — the volume-assigned write
+        generations from the data-plane acks; indexed per replica so later
+        reclaims of this copy can be made conditional."""
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
+        stale_gens: dict[str, dict[str, int]] = {}
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
                 raise ValueError(
@@ -269,6 +282,15 @@ class Controller(Actor):
                     "meta_only() requests"
                 )
             infos = self.index.get(meta.key)
+            # Generations of copies indexed BEFORE this notify — the
+            # layout-invalidation wipe below must not erase them, or a
+            # detached replica's reclaim would never be scheduled and its
+            # stale old-layout bytes would stay readable via warm caches.
+            pre_gens = (
+                {vid: info.write_gen for vid, info in infos.items()}
+                if infos is not None
+                else {}
+            )
             if infos is not None and meta.tensor_slice is not None:
                 # Re-publishing a key under a different layout (mesh shape or
                 # global shape changed) invalidates every previously indexed
@@ -290,31 +312,59 @@ class Controller(Actor):
             for vid in volume_ids:
                 info = infos.get(vid)
                 if info is None:
-                    infos[vid] = StorageInfo.from_meta(meta)
+                    info = infos[vid] = StorageInfo.from_meta(meta)
                 else:
                     info.merge(meta)
+                if write_gens:
+                    info.write_gen = max(
+                        info.write_gen,
+                        write_gens.get(vid, {}).get(meta.key, 0),
+                    )
             # Count as each entry indexes, so a mid-batch rejection leaves
             # counters consistent with what actually landed in the index.
             self.counters["puts"] += 1
             if meta.tensor_meta is not None:
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
             for vid in detach_volume_ids or ():
+                # Capture the generation of the copy being detached BEFORE
+                # removing it — the reclaim may delete the replica's bytes
+                # only while its generation hasn't moved past this.
+                # pre_gens covers entries the layout-invalidation wipe
+                # already dropped from `infos`. A volume with NO prior
+                # indexed copy may still hold bytes from a PARTIAL batch
+                # landing (some requests landed before one failed): -1
+                # marks "generation unknown — resolve volume-side" so the
+                # reclaim's two-phase delete can still collect them.
+                prev = infos.get(vid)
+                if prev is not None:
+                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
+                elif vid in pre_gens:
+                    stale_gens.setdefault(vid, {})[meta.key] = pre_gens[vid]
+                else:
+                    stale_gens.setdefault(vid, {}).setdefault(meta.key, -1)
                 self._detach_meta(meta, vid)
-        if detach_volume_ids:
+        if stale_gens:
             # The detached replica may be wedged-but-ALIVE and still holding
             # the old bytes: clients with warm location caches would read
             # the stale value from it, and delete_batch fans out by index
             # (which no longer lists it) so the bytes would never be
-            # reclaimed. Best-effort background delete once it's reachable.
-            keys = [meta.key for meta in metas]
-            for vid in detach_volume_ids:
+            # reclaimed. Best-effort background conditional delete once
+            # it's reachable.
+            for vid, keys in stale_gens.items():
                 self._schedule_reclaim(vid, keys)
         await self._bump({meta.key for meta in metas})
 
-    def _schedule_reclaim(self, volume_id: str, keys: list[str]) -> None:
+    def _schedule_reclaim(self, volume_id: str, keys: dict[str, int]) -> None:
+        """``keys``: {key: stale write generation} — the generation of the
+        copy that was just detached (the newest bytes the reclaim is
+        allowed to delete)."""
         import asyncio
 
-        self._pending_reclaims.setdefault(volume_id, set()).update(keys)
+        pending = self._pending_reclaims.setdefault(volume_id, {})
+        for key, gen in keys.items():
+            # -1 = unknown generation (resolved volume-side at drain time);
+            # a known generation always wins over unknown.
+            pending[key] = max(pending[key], gen) if key in pending else gen
         if volume_id in self._reclaim_running:
             return  # the volume's drainer picks the new keys up
         self._reclaim_running.add(volume_id)
@@ -325,10 +375,23 @@ class Controller(Actor):
     async def _reclaim_detached(self, volume_id: str) -> None:
         """Drain the volume's pending stale keys once it recovers (ADVICE
         r2). Keys re-indexed on the volume in the meantime are skipped (a
-        later put/repair re-replicated fresh bytes there); a put landing
-        WHILE our delete is in flight is detected afterwards and the
-        volume's index entry detached — honest degraded redundancy instead
-        of an index claiming bytes the volume no longer holds."""
+        later put/repair re-replicated fresh bytes there). The delete is
+        CONDITIONAL on the stale write generation (ADVICE r3): a put
+        landing any time after the detach bumped the volume's generation,
+        so the volume keeps its bytes and reports them fresh — an
+        acknowledged overwrite can never be destroyed by a racing reclaim,
+        even at replication factor 1.
+
+        Keys scheduled with generation -1 (partial batch landings the
+        controller never saw a generation for) resolve in two phases: the
+        volume reports its CURRENT generation first, then the conditional
+        delete targets exactly the observed bytes — anything fresher that
+        lands during the RPC is kept. As the safety net for the residual
+        race (a delete landing while the bytes' notify is still in
+        flight), every completed delete is reconciled against the index:
+        if the index meanwhile claims this volume holds a deleted key, the
+        entry is detached loudly (degraded redundancy, healed by the next
+        publish) instead of pointing readers at missing bytes."""
         import asyncio
 
         try:
@@ -339,38 +402,65 @@ class Controller(Actor):
                 if ref is None or not pending:
                     return
                 batch = {
-                    k for k in pending if volume_id not in self.index.get(k, {})
+                    k: g
+                    for k, g in pending.items()
+                    if volume_id not in self.index.get(k, {})
                 }
-                pending.intersection_update(batch)  # re-indexed keys: done
+                for key in list(pending):
+                    if key not in batch:
+                        del pending[key]  # re-indexed keys: done
                 if not batch:
                     return
+                unknown = sorted(k for k, g in batch.items() if g < 0)
                 try:
-                    removed = await ref.delete_batch.call_one(sorted(batch))
+                    if unknown:
+                        observed = await ref.write_gens.call_one(unknown)
+                        for key in unknown:
+                            if key in observed:
+                                batch[key] = observed[key]
+                            else:
+                                # No bytes, no generation: nothing to do.
+                                del batch[key]
+                                if pending.get(key, 0) < 0:
+                                    pending.pop(key, None)
+                        # Keys indexed on this volume while we fetched gens
+                        # are fresh again — drop them before deleting.
+                        for key in list(batch):
+                            if volume_id in self.index.get(key, {}):
+                                del batch[key]
+                        if not batch:
+                            continue
+                    result = await ref.delete_batch_if.call_one(
+                        sorted(batch.items())
+                    )
                 except Exception:  # noqa: BLE001 - still wedged/dead; retry
                     continue
-                pending.difference_update(batch)
-                clobbered = [
-                    k for k in batch if volume_id in self.index.get(k, {})
-                ]
-                for key in clobbered:
-                    infos = self.index.get(key)
-                    if infos is not None:
-                        infos.pop(volume_id, None)
-                        if not infos:
-                            self.index.pop(key, None)
-                if clobbered:
-                    logger.warning(
-                        "reclaim raced a fresh put on volume %s: detached "
-                        "%d re-indexed key(s) it may have deleted (%s); "
-                        "redundancy degraded until the next publish",
+                for key, sent_gen in batch.items():
+                    # A NEWER stale generation scheduled while the RPC was
+                    # in flight must survive for the next round — pop only
+                    # what this delete actually covered.
+                    if pending.get(key) in (sent_gen, -1):
+                        pending.pop(key, None)
+                for key, gen in result.get("kept_gens", {}).items():
+                    # Fresh bytes raced the reclaim. Normally the racing
+                    # put's notify (re)indexes this volume and the next
+                    # round filters the key out; if that notify never
+                    # arrives (client died between data-plane ack and
+                    # notify), the requeued generation reclaims the
+                    # orphaned bytes on a later round.
+                    pending[key] = max(pending.get(key, 0), gen)
+                if result["kept_fresh"]:
+                    logger.info(
+                        "reclaim on volume %s kept %d key(s) with fresh "
+                        "bytes (%s); re-verifying next round",
                         volume_id,
-                        len(clobbered),
-                        clobbered[:3],
+                        len(result["kept_fresh"]),
+                        result["kept_fresh"][:3],
                     )
-                    await self._bump(set(clobbered))
+                await self._reconcile_clobbered(volume_id, result["removed"])
                 logger.info(
                     "reclaimed %d stale key(s) on detached volume %s",
-                    removed,
+                    len(result["removed"]),
                     volume_id,
                 )
                 if not pending:
@@ -386,6 +476,34 @@ class Controller(Actor):
         finally:
             self._reclaim_running.discard(volume_id)
             self._pending_reclaims.pop(volume_id, None)
+
+    async def _reconcile_clobbered(
+        self, volume_id: str, removed_keys: list[str]
+    ) -> None:
+        """A reclaim delete whose key the index NOW claims this volume
+        holds means a racing put's bytes were destroyed before its notify
+        indexed them (the conditional delete narrows this to the
+        gen-read/delete window of two-phase unknown-generation reclaims).
+        Detach the entry so readers fail over / fail loudly instead of
+        routing to missing bytes; the next publish restores redundancy."""
+        clobbered = []
+        for key in removed_keys:
+            infos = self.index.get(key)
+            if infos is not None and volume_id in infos:
+                infos.pop(volume_id, None)
+                if not infos:
+                    self.index.pop(key, None)
+                clobbered.append(key)
+        if clobbered:
+            logger.warning(
+                "reclaim raced a fresh put on volume %s: detached %d "
+                "re-indexed key(s) it deleted (%s); redundancy degraded "
+                "until the next publish",
+                volume_id,
+                len(clobbered),
+                clobbered[:3],
+            )
+            await self._bump(set(clobbered))
 
     def _detach_meta(self, meta: Request, volume_id: str) -> None:
         """Remove ONE meta's footprint on ``volume_id``: the exact shard
@@ -596,16 +714,20 @@ class Controller(Actor):
             list(zip(self.volume_refs.keys(), manifests))
         )
         count = 0
-        for vid, meta in survivors:
+        for vid, meta, gen in survivors:
             infos = self.index.get(meta.key)
             if infos is None:
                 infos = {}
                 self.index[meta.key] = infos
             info = infos.get(vid)
             if info is None:
-                infos[vid] = StorageInfo.from_meta(meta)
+                info = infos[vid] = StorageInfo.from_meta(meta)
             else:
                 info.merge(meta)
+            # Live volumes report their in-memory write generation; keep it
+            # so conditional reclaims stay sound across controller
+            # restarts (a gen-0 entry could never be reclaimed).
+            info.write_gen = max(info.write_gen, gen)
             count += 1
         if dropped:
             logger.warning(
@@ -661,6 +783,11 @@ class Controller(Actor):
             "sharded_keys": sharded_keys,
             "num_volumes": len(self.volume_refs),
             "indexed_bytes_approx": indexed_bytes,
+            "pending_reclaims": {
+                vid: len(keys)
+                for vid, keys in self._pending_reclaims.items()
+                if keys
+            },
         }
         if include_volumes:
             import asyncio

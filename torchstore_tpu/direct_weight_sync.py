@@ -265,11 +265,39 @@ class DirectWeightSyncSource:
         import threading
 
         self._host_fallback_lock = threading.Lock()
-        # Weight generation (seqlock): even at rest, ODD while a refresh is
-        # overwriting staging buffers in place; +2 net per publish. Served
-        # to dests via the _GET_GEN control op for tear detection.
+        # Weight generation (seqlock). _gen is the CONTENT generation: even
+        # always, +2 per publish (refresh). _busy counts in-flight buffer
+        # overwrites (host refresh, fallback staging); gen_fn reports
+        # _gen+1 (odd) while any overwrite runs, so dests wait out
+        # overwrites and retry when content moved mid-pull. Fallback
+        # staging itself never advances _gen — N dests pulling the same
+        # content concurrently see one stable generation (no spurious
+        # retries / "torn twice"). Mutated from the event loop (refresh)
+        # AND the server executor (_stage_host_handles): every access goes
+        # through _gen_lock — an unsynchronized `_gen += n` can lose a
+        # bump and wedge the parity.
         self._gen = 0
-        self.server.gen_fn = lambda: self._gen
+        self._busy = 0
+        self._gen_lock = threading.Lock()
+        # Host-fallback staging cache: the pickled handle payload + the
+        # content generation it materialized. Re-materialization happens
+        # only when _gen advanced — concurrent cross-world dests share one
+        # D2H staging per publish instead of re-copying the model per pull.
+        self._staged_gen: Optional[int] = None
+        self._staged_payload: Optional[bytes] = None
+        self.server.gen_fn = self._read_gen_locked
+
+    def _read_gen_locked(self) -> int:
+        with self._gen_lock:
+            return self._gen + 1 if self._busy else self._gen
+
+    def _bump_gen(self, n: int = 2) -> None:
+        with self._gen_lock:
+            self._gen += n
+
+    def _set_busy(self, on: bool) -> None:
+        with self._gen_lock:
+            self._busy += 1 if on else -1
 
     def _device_mode_eligible(self, flat: dict) -> bool:
         """Device path engages when every tensor leaf lives on device: plain
@@ -472,17 +500,42 @@ class DirectWeightSyncSource:
         """Materialize the current device arrays into host buffers and return
         pickled ``{flat_key: [WeightHandle]}`` — serves dests whose jax world
         does not contain our device ids (they then read over the normal host
-        TCP path). Buffers are reused across calls, so repeated fallback
-        pulls refresh in place. Runs in the server's executor; the lock
+        TCP path). Runs in the server's executor; _host_fallback_lock
         serializes concurrent fallback pulls (unlocked, two threads could
         allocate the same buffer id for different tensors — silent weight
-        swaps for same-shape params)."""
+        swaps for same-shape params).
+
+        The staging is cached per content generation: concurrent dests at
+        the same generation share ONE D2H materialization and observe a
+        stable (even) generation throughout — staging never bumps _gen, so
+        N generators fanning out over one source cannot trip each other's
+        tear detection. Buffers are only overwritten after a publish
+        advanced _gen; a dest mid-read then sees the busy (odd) marker or
+        the new generation and retries, exactly as for a host-path
+        refresh."""
         with self._host_fallback_lock:
-            self._gen += 1  # odd: fallback buffers being overwritten
-            try:
-                return self._materialize_host_handles()
-            finally:
-                self._gen += 1
+            for _ in range(3):
+                with self._gen_lock:
+                    gen0 = self._gen
+                if self._staged_gen == gen0 and self._staged_payload is not None:
+                    return self._staged_payload
+                self._set_busy(True)
+                try:
+                    payload = self._materialize_host_handles()
+                finally:
+                    self._set_busy(False)
+                with self._gen_lock:
+                    settled = self._gen == gen0
+                self._staged_gen = gen0
+                self._staged_payload = payload
+                if settled:
+                    return payload
+                # A publish landed mid-materialization: the staged snapshot
+                # is a consistent view of SOME step but tagged stale — loop
+                # to restage the fresh content (bounded; a publisher hotter
+                # than the loop still gets a consistent, slightly stale
+                # payload, which the dest-side gen check resolves).
+            return payload
 
     def _materialize_host_handles(self) -> bytes:
         import pickle
@@ -549,14 +602,16 @@ class DirectWeightSyncSource:
         if not self._registered:
             raise RuntimeError("register() must run before refresh()")
         if self.device_info is not None:
-            # Device staging snapshots per pull; publish = one stable bump.
-            self._gen += 2
+            # Device staging snapshots per pull; publish = one stable bump
+            # (which also invalidates the host-fallback staging cache).
+            self._bump_gen(2)
             return
-        self._gen += 1  # seqlock: odd while buffers are being overwritten
+        self._set_busy(True)  # reported odd while buffers are overwritten
         try:
             await self._refresh_host()
         finally:
-            self._gen += 1
+            self._bump_gen(2)
+            self._set_busy(False)
 
     async def _refresh_host(self) -> None:
         for flat_key, value in self._sources.items():
@@ -869,8 +924,19 @@ class DirectWeightSyncDest:
         return gen
 
     async def _stable_gens(self, endpoints) -> list:
-        """Every source's generation once none is mid-refresh (odd)."""
-        for _ in range(100):
+        """Every source's generation once none is mid-overwrite (odd).
+
+        The wait scales to ``config.direct_settle_timeout`` (default 30 s,
+        env ``TORCHSTORE_TPU_DIRECT_SETTLE_TIMEOUT``): a model-scale host
+        refresh or another dest's fallback D2H staging legitimately holds
+        the generation odd for seconds."""
+        import time
+
+        from torchstore_tpu.config import default_config
+
+        deadline = time.monotonic() + default_config().direct_settle_timeout
+        delay = 0.02
+        while True:
             gens = list(
                 await asyncio.gather(
                     *(self._read_gen(h, p) for h, p in endpoints)
@@ -878,11 +944,14 @@ class DirectWeightSyncDest:
             )
             if all(g % 2 == 0 for g in gens):
                 return gens
-            await asyncio.sleep(0.02)
-        raise RuntimeError(
-            "source refresh never settled (generation stayed odd) — "
-            "source wedged mid-refresh?"
-        )
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "source refresh never settled (generation stayed odd "
+                    f"for {default_config().direct_settle_timeout:.0f}s) — "
+                    "source wedged mid-refresh?"
+                )
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 0.25)
 
     async def _pull_once(
         self,
@@ -1202,7 +1271,9 @@ class DirectWeightSyncDest:
             # Attach is free — no transfer to range.
             seg = self._segments.get(handle.shm_name)
             if seg is None:
-                seg = shm.ShmSegment.attach(handle.shm_name, max(handle.meta.nbytes, 1))
+                seg = shm.ShmSegment.attach(
+                    handle.shm_name, max(handle.meta.nbytes, 1), populate=True
+                )
                 self._segments[handle.shm_name] = seg
             return np.asarray(seg.view(handle.meta)).reshape(shape), 0
         # Same-host TCP reads dial loopback (the container hostname may not

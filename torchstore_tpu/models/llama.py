@@ -314,7 +314,11 @@ def _attend(cfg: LlamaConfig, q, k, v):
                 "counts"
             )
     body = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
-    fn = make_sharded_attention(body, cfg.mesh, "sp", True, head_axis)
+    fn = make_sharded_attention(
+        body, cfg.mesh, "sp", True, head_axis,
+        # Ring's default ("auto") body may run the fused pallas kernel.
+        relax_vma=cfg.attn_impl == "ring",
+    )
     return fn(q, k, v)
 
 

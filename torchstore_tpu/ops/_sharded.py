@@ -7,12 +7,24 @@ import functools
 
 @functools.cache
 def make_sharded_attention(
-    body, mesh, axis_name: str, causal: bool, head_axis: str | None = None
+    body,
+    mesh,
+    axis_name: str,
+    causal: bool,
+    head_axis: str | None = None,
+    impl: str | None = None,
+    relax_vma: bool = False,
 ):
     """jit(shard_map(body)) over (q, k, v) sequence-sharded on ``axis_name``
     (and optionally head-sharded on ``head_axis`` — tensor-parallel heads
     compose with both bodies since they only collective over the sequence
-    axis). Cached so repeat calls reuse the compiled executable."""
+    axis). ``impl`` forwards a block-body selector to bodies that take one
+    (ring attention). ``relax_vma``: set by callers whose body may run a
+    pallas kernel — pallas calls inside shard_map trip the vma type checker
+    in interpret mode (jax's own error suggests the flag); every other body
+    keeps shard_map's varying-type checking (it catches mis-specified
+    collectives loudly). Cached so repeat calls reuse the compiled
+    executable."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -21,11 +33,20 @@ def make_sharded_attention(
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    kwargs = {"axis_name": axis_name, "causal": causal}
+    if impl is not None:
+        kwargs["impl"] = impl
     spec = P(None, axis_name, head_axis, None)
-    fn = shard_map(
-        functools.partial(body, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    sm_kwargs = dict(
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
+    if relax_vma:
+        try:
+            fn = shard_map(
+                functools.partial(body, **kwargs), check_vma=False, **sm_kwargs
+            )
+        except TypeError:  # older jax: no check_vma kwarg
+            fn = shard_map(functools.partial(body, **kwargs), **sm_kwargs)
+    else:
+        fn = shard_map(functools.partial(body, **kwargs), **sm_kwargs)
     return jax.jit(fn)

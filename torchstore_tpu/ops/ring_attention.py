@@ -19,13 +19,111 @@ from __future__ import annotations
 import math
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, impl: str = "auto"):
     """Per-shard attention bodies. Shapes (inside shard_map, per device):
     q: (batch, seq_local, heads, head_dim), k/v: (batch, seq_local,
     kv_heads, head_dim) -> (batch, seq_local, heads, head_dim). GQA is
-    handled natively via grouped einsums — the ring rotates the UNREPEATED
-    kv blocks, so GQA's bandwidth/memory saving survives sequence
-    parallelism."""
+    handled natively — the ring rotates the UNREPEATED kv blocks, so GQA's
+    bandwidth/memory saving survives sequence parallelism.
+
+    ``impl`` selects the per-hop block body:
+
+    - ``"fused"``: the pallas flash kernel (``flash_attention_stats``) —
+      scores stream through VMEM tiles, never materializing the
+      (sq_local, sk_local) score tensor in HBM; hops merge via the
+      standard online-softmax rescale.
+    - ``"einsum"``: the reference-free dense block body (materializes
+      per-hop scores; any shape).
+    - ``"auto"`` (default): fused when the per-device shapes tile
+      (``flash_stats_eligible``), einsum otherwise.
+    """
+    from torchstore_tpu.ops.flash_attention import flash_stats_eligible
+
+    # The fused body's causal hop mask is all-or-nothing per hop, which is
+    # exact only when q and kv rings carry EQUAL per-device lengths (the
+    # self-attention shape); unequal lengths make some hops partially
+    # visible and need the einsum body's global-position mask.
+    fused_exact = not causal or q.shape[1] == k.shape[1]
+    if impl == "fused":
+        if not fused_exact:
+            raise ValueError(
+                "impl='fused' causal ring attention requires equal q/kv "
+                f"sequence lengths per device (got {q.shape[1]} vs "
+                f"{k.shape[1]}); use impl='auto' or 'einsum'"
+            )
+        return _ring_fused(q, k, v, axis_name, causal)
+    if (
+        impl == "auto"
+        and fused_exact
+        and flash_stats_eligible(q.shape, k.shape)
+    ):
+        return _ring_fused(q, k, v, axis_name, causal)
+    return _ring_einsum(q, k, v, axis_name, causal)
+
+
+def _ring_fused(q, k, v, axis_name: str, causal: bool):
+    """Ring body with the fused flash kernel per hop: each incoming kv
+    block runs ``flash_attention_stats`` (unnormalized accumulator +
+    online-softmax stats, computed blockwise in VMEM) and hops merge with
+    the flash rescale. Causal hops from ring positions AFTER this device
+    are fully masked (zero contribution); the diagonal (own) block applies
+    the in-kernel causal mask. Same O(seq/n) memory as the einsum body but
+    without ever materializing a (sq, sk) score tensor."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchstore_tpu.ops.flash_attention import flash_attention_stats
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    NEG = jnp.float32(-1e30)
+
+    def merge(carry, contrib):
+        o, m, l = carry
+        acc_j, m_j, l_j = contrib
+        m_new = jnp.maximum(m, m_j)
+        c1 = jnp.exp(m - m_new)
+        c2 = jnp.exp(m_j - m_new)
+        return (
+            o * c1[..., None] + acc_j * c2[..., None],
+            m_new,
+            l * c1 + l_j * c2,
+        )
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        acc_j, m_j, l_j = flash_attention_stats(
+            q, k_cur, v_cur, causal_diag=False
+        )
+        if causal:
+            # k_cur originated on ring position (my_idx - i) mod n; blocks
+            # from positions after ours are entirely in the future — mask
+            # the whole contribution (same cost profile as the einsum
+            # body, which also computes-then-masks; no data-dependent
+            # control flow inside the compiled loop).
+            valid = ((my_idx - i) % n) < my_idx
+            acc_j = jnp.where(valid, acc_j, 0.0)
+            m_j = jnp.where(valid, m_j, NEG)
+            l_j = jnp.where(valid, l_j, 0.0)
+        o, m, l = merge((o, m, l), (acc_j, m_j, l_j))
+        return o, m, l, k_cur, v_cur
+
+    # Step 0: the device's own block — in-kernel causal diagonal mask.
+    o0, m0, l0 = flash_attention_stats(q, k, v, causal_diag=causal)
+    o, m, l, _, _ = lax.fori_loop(1, n, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # (b, h, sq, d)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _ring_einsum(q, k, v, axis_name: str, causal: bool):
+    """Dense (einsum) block body: grouped-GQA online softmax materializing
+    one (sq, sk) score block per hop. Shape-agnostic fallback for sizes
+    the pallas kernel can't tile."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -97,9 +195,14 @@ def _mark_varying(lax, x, axis_name: str):
     return x  # older jax: no varying-type tracking
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp", causal: bool = False):
+def ring_attention_sharded(
+    q, k, v, mesh, axis_name: str = "sp", causal: bool = False, impl: str = "auto"
+):
     """jit-compiled ring attention over ``mesh``'s ``axis_name`` ring: global
     (batch, seq, heads, head_dim) arrays sequence-sharded on entry/exit."""
     from torchstore_tpu.ops._sharded import make_sharded_attention
 
-    return make_sharded_attention(ring_attention, mesh, axis_name, causal)(q, k, v)
+    return make_sharded_attention(
+        ring_attention, mesh, axis_name, causal, impl=impl,
+        relax_vma=impl != "einsum",
+    )(q, k, v)

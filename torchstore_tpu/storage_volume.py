@@ -243,6 +243,16 @@ class StorageVolume(Actor):
                 storage = InMemoryStore()
         self.store = storage
         self.ctx = TransportContext()
+        # Per-key write generation: microsecond timestamp (strictly
+        # monotonic per key via max(prev+1, now)). Assigned on every
+        # successful put, echoed to the client in the put reply, forwarded
+        # to the controller's index — the token that makes stale-replica
+        # reclaims conditional (delete_if_unchanged): a reclaim may only
+        # delete bytes whose generation is <= the generation the controller
+        # indexed, so a fresh put racing the reclaim always survives.
+        # Timestamps (not counters) stay comparable across volume restarts
+        # on durable backends.
+        self._write_gens: dict[str, int] = {}
         from torchstore_tpu import native
         from torchstore_tpu.transport import shared_memory
 
@@ -267,6 +277,18 @@ class StorageVolume(Actor):
         existing = self.store.extract_existing(metas) if op == "put" else {}
         return await maybe_await(buffer.recv_handshake(self.ctx, metas, existing, op))
 
+    def _bump_write_gens(self, metas: list[Request]) -> dict[str, int]:
+        import time
+
+        now = int(time.time() * 1e6)
+        gens: dict[str, int] = {}
+        for meta in metas:
+            prev = self._write_gens.get(meta.key, 0)
+            gen = max(prev + 1, now)
+            self._write_gens[meta.key] = gen
+            gens[meta.key] = gen
+        return gens
+
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
         existing = self.store.extract_existing(metas)
@@ -274,7 +296,10 @@ class StorageVolume(Actor):
             buffer.handle_put_request(self.ctx, metas, existing)
         )
         self.store.store(metas, values)
-        return buffer.put_reply()
+        return {
+            "reply": buffer.put_reply(),
+            "write_gens": self._bump_write_gens(metas),
+        }
 
     @endpoint
     async def get(
@@ -297,17 +322,77 @@ class StorageVolume(Actor):
             if self.store.delete(key):
                 self.ctx.delete_key(key)
                 deleted += 1
+            self._write_gens.pop(key, None)
         return deleted
+
+    @endpoint
+    async def delete_batch_if(
+        self, items: list[tuple[str, int]]
+    ) -> dict[str, Any]:
+        """Conditional delete for stale-replica reclaims (ADVICE r3): each
+        ``(key, stale_gen)`` is deleted only if the key's current write
+        generation is not NEWER than ``stale_gen`` — a fresh put that
+        landed after the controller detached this replica bumped the
+        generation and its bytes survive. Check-and-delete is atomic with
+        respect to puts (no await between them), closing the window where
+        an unconditional reclaim delete could destroy an acknowledged
+        overwrite. A key with no recorded generation is deleted: its bytes
+        predate this process's puts (volume restart), i.e. they are the
+        stale copy the reclaim targets."""
+        removed: list[str] = []
+        kept_fresh: list[str] = []
+        kept_gens: dict[str, int] = {}
+        for key, stale_gen in items:
+            current = self._write_gens.get(key)
+            if current is not None and current > stale_gen:
+                # ``kept_gens`` lets the controller re-verify later: if the
+                # fresh put's notify never arrives (client died between
+                # data-plane ack and notify), a follow-up conditional
+                # delete at THIS generation reclaims the orphaned bytes.
+                kept_fresh.append(key)
+                kept_gens[key] = current
+                continue
+            if self.store.delete(key):
+                self.ctx.delete_key(key)
+                removed.append(key)
+            self._write_gens.pop(key, None)
+        return {
+            "removed": removed,
+            "kept_fresh": kept_fresh,
+            "kept_gens": kept_gens,
+        }
+
+    @endpoint
+    async def write_gens(self, keys: list[str]) -> dict[str, int]:
+        """Current write generations for ``keys`` (missing keys omitted) —
+        phase one of the reclaim's two-phase delete for copies whose
+        indexed generation the controller never learned (partial batch
+        landings on a replica that was detached before its notify)."""
+        return {
+            key: self._write_gens[key]
+            for key in keys
+            if key in self._write_gens
+        }
 
     @endpoint
     async def manifest(self) -> list:
         """Meta-only descriptions (``{"meta": Request, "mtime": float}``) of
         every stored entry (durable backends only) — feeds controller index
-        rebuilds after restarts."""
+        rebuilds after restarts. Items are annotated with this process's
+        live ``write_gen`` for the key (absent after a volume restart) so a
+        rebuilt controller index keeps conditional reclaims sound: without
+        it every recovered copy would carry gen 0 and no reclaim could
+        ever fire (any real generation compares newer)."""
         fn = getattr(self.store, "manifest", None)
         if fn is None:
             return []
-        return fn()
+        items = fn()
+        for item in items:
+            if isinstance(item, dict):
+                gen = self._write_gens.get(item["meta"].key)
+                if gen is not None:
+                    item["write_gen"] = gen
+        return items
 
     @endpoint
     async def stats(self) -> dict:
@@ -354,3 +439,4 @@ class StorageVolume(Actor):
     async def reset(self) -> None:
         self.store.reset()
         self.ctx.clear()
+        self._write_gens.clear()

@@ -105,6 +105,10 @@ class TransportBuffer(ABC):
     requires_contiguous_inplace: bool = False
     supports_batch_puts: bool = True
     supports_batch_gets: bool = True
+    # Per-key write generations the volume assigned to the last put this
+    # buffer carried (set by put_to_storage_volume; forwarded by the client
+    # to the controller so stale-replica reclaims can delete conditionally).
+    write_gens: "Optional[dict[str, int]]" = None
 
     # ---- client-side lifecycle ------------------------------------------
 
@@ -127,6 +131,9 @@ class TransportBuffer(ABC):
             reply = await put.with_timeout(
                 transfer_timeout(put._effective_timeout(), nbytes)
             ).call_one(self, metas)
+            if isinstance(reply, dict) and "write_gens" in reply:
+                self.write_gens = reply["write_gens"]
+                reply = reply["reply"]
             self._handle_put_reply(volume, reply, requests)
             self._post_request_success(volume)
         finally:
