@@ -393,9 +393,23 @@ class Controller(Actor):
         entry is detached loudly (degraded redundancy, healed by the next
         publish) instead of pointing readers at missing bytes."""
         import asyncio
+        import os
 
         try:
-            for delay in (1.0, 5.0, 15.0, 60.0):
+            delays = (1.0, 5.0, 15.0, 60.0)
+            env = os.environ.get("TORCHSTORE_TPU_RECLAIM_DELAYS")
+            if env:
+                # Malformed values fall back to the defaults — a parse
+                # error must not kill the drainer (it would leave the
+                # volume's running-flag set and wedge reclaims forever).
+                try:
+                    delays = tuple(float(d) for d in env.split(","))
+                except ValueError:
+                    logger.warning(
+                        "ignoring malformed TORCHSTORE_TPU_RECLAIM_DELAYS=%r",
+                        env,
+                    )
+            for delay in delays:
                 await asyncio.sleep(delay)
                 ref = self.volume_refs.get(volume_id)
                 pending = self._pending_reclaims.get(volume_id)

@@ -578,6 +578,11 @@ class ShmClientCache(TransportCache):
         self.seq: dict[str, int] = {}
         # Strong refs to in-flight background pre-attaches (see pre_attach).
         self._pre_attach_tasks: set = set()
+        # name -> attach time for pre-attached spares not yet offered —
+        # evicted after the server's reserved TTL (the server has unlinked
+        # an unused spare by then; keeping the populated mapping would pin
+        # its tmpfs pages for the client's lifetime).
+        self._pre_attached: dict[str, float] = {}
 
     def attach(self, desc: ShmDescriptor, key: str, volume_id: str) -> ShmSegment:
         seg = self.segments.get(desc.segment_name)
@@ -587,6 +592,7 @@ class ShmClientCache(TransportCache):
                 desc.segment_name, desc.segment_size, populate=True
             )
             self.segments[desc.segment_name] = seg
+        self._pre_attached.pop(desc.segment_name, None)  # offered: in use now
         self.key_to_segments.setdefault(key, set()).add(desc.segment_name)
         self.seg_volume[desc.segment_name] = volume_id
         return seg
@@ -604,6 +610,17 @@ class ShmClientCache(TransportCache):
         except RuntimeError:
             return
 
+        # Evict pre-attached spares that were never offered within the
+        # server's reserved TTL: the server has unlinked them by now, and
+        # only this mapping keeps their tmpfs pages alive.
+        cutoff = time.monotonic() - RESERVED_TTL_S
+        for name, ts in list(self._pre_attached.items()):
+            if ts < cutoff:
+                del self._pre_attached[name]
+                seg = self.segments.pop(name, None)
+                if seg is not None:
+                    seg.close()
+
         async def one(name: str, size: int) -> None:
             if name in self.segments:
                 return
@@ -617,6 +634,7 @@ class ShmClientCache(TransportCache):
                 seg.close()  # a synchronous attach won the race
             else:
                 self.segments[name] = seg
+                self._pre_attached[name] = time.monotonic()
 
         for name, size in spares:
             # The loop holds tasks weakly — keep a strong ref until done or
