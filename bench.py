@@ -318,8 +318,6 @@ async def run() -> dict:
     p50g = sorted(lat_get)[len(lat_get) // 2] * 1e3
     print(f"# p50 latency (1KB): put {p50p:.2f} ms, get {p50g:.2f} ms", file=sys.stderr)
 
-    device_section_subprocess()
-
     await ts.shutdown("bench")
     headline = max(med_buffered, med_direct)
     print(
@@ -339,4 +337,9 @@ if __name__ == "__main__":
     if "--device-section" in sys.argv:
         sys.exit(asyncio.run(_device_section_child()))
     result = asyncio.run(run())
+    # The headline JSON lands BEFORE the device section: a wedged TPU
+    # backend can cost up to two subprocess timeouts, and a driver killing
+    # the bench mid-attempt must never lose the round's host numbers.
     print(json.dumps(result))
+    sys.stdout.flush()
+    device_section_subprocess()

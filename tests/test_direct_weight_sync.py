@@ -444,6 +444,42 @@ class TestGenerationStampedPulls:
         finally:
             await source.close()
 
+    async def test_state_dict_layer_retries_pull_race(self, monkeypatch):
+        """A PullRaceError (settle timeout / double tear under hot
+        publishes) must not reach the caller on the first bounce: the
+        state-dict layer drops its cached handles and retries once
+        (ADVICE r3 low)."""
+        import torchstore_tpu as ts
+        from torchstore_tpu.direct_weight_sync import (
+            DirectWeightSyncDest,
+            PullRaceError,
+        )
+
+        await ts.initialize(store_name="race")
+        try:
+            sd = {"w": np.arange(32.0, dtype=np.float32)}
+            await ts.put_state_dict("m", sd, direct=True, store_name="race")
+            real_pull = DirectWeightSyncDest.pull
+            calls = {"n": 0}
+
+            async def flaky_pull(self, handles, dest):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise PullRaceError("source refresh never settled")
+                return await real_pull(self, handles, dest)
+
+            monkeypatch.setattr(DirectWeightSyncDest, "pull", flaky_pull)
+            out = await ts.get_state_dict(
+                "m",
+                user_state_dict={"w": np.zeros(32, np.float32)},
+                direct=True,
+                store_name="race",
+            )
+            np.testing.assert_array_equal(out["w"], sd["w"])
+            assert calls["n"] == 2  # failed once, retried with fresh state
+        finally:
+            await ts.shutdown("race")
+
     async def test_pull_detects_and_retries_once(self, monkeypatch):
         """Force a gen change between the pre- and post-read: the pull must
         retry (and succeed when the second attempt is stable)."""

@@ -95,6 +95,47 @@ def test_auto_picks_fused_for_tileable_shapes():
     assert not flash_stats_eligible((2, 8, 4, 10), (2, 8, 4, 10))  # d % 8
 
 
+def test_flash_stats_merge_property():
+    """Property sweep of the merge invariant over GQA ratios, head dims,
+    asymmetric kv splits, and both mask modes: blocks merged with the
+    flash rescale equal whole-sequence attention (the exact algebra the
+    ring's hop merge relies on)."""
+    from hypothesis import given, settings, strategies as st
+
+    from torchstore_tpu.ops.flash_attention import flash_attention_stats
+
+    @settings(max_examples=15, deadline=None)
+    @given(
+        hk=st.sampled_from([1, 2, 4]),
+        g=st.sampled_from([1, 2, 4]),
+        d=st.sampled_from([8, 16, 24]),
+        sq=st.sampled_from([16, 32, 40]),
+        split=st.sampled_from([8, 16, 24]),
+        seed=st.integers(0, 2**16),
+    )
+    def check(hk, g, d, sq, split, seed):
+        h = hk * g
+        sk = 48
+        keys = jax.random.split(jax.random.key(seed), 3)
+        q = jax.random.normal(keys[0], (1, sq, h, d), jnp.float32)
+        k = jax.random.normal(keys[1], (1, sk, hk, d), jnp.float32)
+        v = jax.random.normal(keys[2], (1, sk, hk, d), jnp.float32)
+        a1, m1, l1 = flash_attention_stats(q, k[:, :split], v[:, :split])
+        a2, m2, l2 = flash_attention_stats(q, k[:, split:], v[:, split:])
+        m = jnp.maximum(m1, m2)
+        c1, c2 = jnp.exp(m1 - m), jnp.exp(m2 - m)
+        o = (a1 * c1[..., None] + a2 * c2[..., None]) / (
+            l1 * c1 + l2 * c2
+        )[..., None]
+        out = jnp.transpose(o, (0, 2, 1, 3))
+        ref = dense_reference(q, k, v, False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5
+        )
+
+    check()
+
+
 def test_flash_stats_merge_identity():
     """flash_attention_stats blocks merged with the flash rescale equal
     whole-sequence dense attention — the invariant the ring's hop merge

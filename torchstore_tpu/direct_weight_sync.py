@@ -43,6 +43,12 @@ from torchstore_tpu.utils import (
 
 logger = get_logger("torchstore_tpu.direct")
 
+
+class PullRaceError(RuntimeError):
+    """A direct pull lost its race with concurrent source activity (seqlock
+    generation never settled, or tore on both attempts). Transient by
+    nature — the state-dict layer retries once with fresh handles."""
+
 _READ_REQ = struct.Struct("<QQQ")  # buffer_id, offset, length
 _READ_RESP = struct.Struct("<Q")  # length (0xFFFF.. = error)
 _ERR = (1 << 64) - 1
@@ -912,7 +918,7 @@ class DirectWeightSyncDest:
                 gens0,
                 gens1,
             )
-        raise RuntimeError(
+        raise PullRaceError(
             "direct pull torn twice by concurrent source refreshes — "
             "throttle publishes or pull between refreshes"
         )
@@ -945,7 +951,7 @@ class DirectWeightSyncDest:
             if all(g % 2 == 0 for g in gens):
                 return gens
             if time.monotonic() >= deadline:
-                raise RuntimeError(
+                raise PullRaceError(
                     "source refresh never settled (generation stayed odd "
                     f"for {default_config().direct_settle_timeout:.0f}s) — "
                     "source wedged mid-refresh?"
@@ -1192,7 +1198,7 @@ class DirectWeightSyncDest:
                 gens,
             )
         else:
-            raise RuntimeError(
+            raise PullRaceError(
                 f"device pull mixed source generations twice ({gens}) — "
                 "source ranks are publishing out of lockstep"
             )

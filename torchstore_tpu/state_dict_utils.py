@@ -415,7 +415,10 @@ async def _put_state_dict_direct(
 async def _get_state_dict_direct(
     client, key: str, user_state_dict: Any, _retry: bool = True
 ) -> Any:
-    from torchstore_tpu.direct_weight_sync import DirectWeightSyncDest
+    from torchstore_tpu.direct_weight_sync import (
+        DirectWeightSyncDest,
+        PullRaceError,
+    )
 
     if user_state_dict is None:
         raise ValueError("direct get_state_dict requires user_state_dict targets")
@@ -467,10 +470,11 @@ async def _get_state_dict_direct(
                 )
             return await dest.pull_device(device_infos, user_state_dict)
         return await dest.pull(all_handles, user_state_dict)
-    except (ConnectionError, OSError, KeyError, ValueError):
+    except (ConnectionError, OSError, KeyError, ValueError, PullRaceError):
         # ValueError covers stale-plan shape mismatches after a source
-        # republish; a successful retry fully overwrites any partial
-        # in-place landings.
+        # republish; PullRaceError covers seqlock settle timeouts / double
+        # tears under hot concurrent publishes (ADVICE r3). A successful
+        # retry fully overwrites any partial in-place landings.
         if not _retry:
             raise
         # The source may have restarted and re-published fresh handles under
