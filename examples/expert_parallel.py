@@ -1,0 +1,98 @@
+"""Expert-parallel (MoE) weight exchange: per-expert keys + cross-layout
+re-acquisition — the reference's fully-local DTensor use case
+(/root/reference/torchstore/transport/types.py:58-85: expert weights are
+Replicate/mesh-1 DTensors that demote to plain tensors, one key per
+expert) expressed TPU-style.
+
+An 8-way expert-parallel trainer publishes each expert's FFN matrices
+under its own key plus 8-way shards of the shared attention weights; a
+4-way inference fleet pulls TWO whole experts per rank and a 4-way
+attention reshard (each dest slice spans two stored shards). Run:
+
+    python examples/expert_parallel.py
+"""
+
+import asyncio
+
+import numpy as np
+
+import torchstore_tpu as ts
+
+N_EXPERTS, EP_TRAIN, EP_INFER = 8, 8, 4
+HIDDEN, FFN = 256, 512
+
+
+async def main():
+    await ts.initialize(store_name="ep")
+    try:
+        client = ts.client("ep")
+        rng = np.random.default_rng(0)
+        experts = [
+            {
+                "w1": rng.standard_normal((HIDDEN, FFN), np.float32),
+                "w2": rng.standard_normal((FFN, HIDDEN), np.float32),
+            }
+            for _ in range(N_EXPERTS)
+        ]
+        attn_q = rng.standard_normal((HIDDEN, HIDDEN), np.float32)
+
+        # --- trainer side: each of 8 EP ranks publishes ITS expert (plain
+        # tensors under per-expert keys) + its attention shard.
+        async def publish(rank: int):
+            rows = HIDDEN // EP_TRAIN
+            sl = ts.TensorSlice(
+                offsets=(rank * rows, 0), local_shape=(rows, HIDDEN),
+                global_shape=(HIDDEN, HIDDEN), coordinates=(rank,),
+                mesh_shape=(EP_TRAIN,),
+            )
+            await client.put_batch({
+                f"moe/e{rank}/w1": experts[rank]["w1"],
+                f"moe/e{rank}/w2": experts[rank]["w2"],
+                "moe/attn/q": ts.Shard(
+                    np.ascontiguousarray(attn_q[rank * rows : (rank + 1) * rows]),
+                    sl,
+                ),
+            })
+
+        await asyncio.gather(*(publish(r) for r in range(EP_TRAIN)))
+        print(f"published {N_EXPERTS} experts (ep={EP_TRAIN}) + attention shards")
+
+        # --- inference side: 4 EP ranks, each acquiring TWO whole experts
+        # and its 4-way attention reshard (spans two stored shards).
+        async def acquire(rank: int):
+            per = N_EXPERTS // EP_INFER
+            rows = HIDDEN // EP_INFER
+            sl = ts.TensorSlice(
+                offsets=(rank * rows, 0), local_shape=(rows, HIDDEN),
+                global_shape=(HIDDEN, HIDDEN), coordinates=(rank,),
+                mesh_shape=(EP_INFER,),
+            )
+            wants = {"moe/attn/q": ts.Shard(None, sl)}
+            for e in range(rank * per, (rank + 1) * per):
+                wants[f"moe/e{e}/w1"] = None
+                wants[f"moe/e{e}/w2"] = None
+            return rank, await client.get_batch(wants)
+
+        results = dict(await asyncio.gather(*(acquire(r) for r in range(EP_INFER))))
+        for rank, got in sorted(results.items()):
+            per = N_EXPERTS // EP_INFER
+            for e in range(rank * per, (rank + 1) * per):
+                np.testing.assert_array_equal(
+                    got[f"moe/e{e}/w1"], experts[e]["w1"]
+                )
+            rows = HIDDEN // EP_INFER
+            np.testing.assert_array_equal(
+                got["moe/attn/q"], attn_q[rank * rows : (rank + 1) * rows]
+            )
+        print(
+            f"{EP_INFER} inference ranks each acquired "
+            f"{N_EXPERTS // EP_INFER} whole experts + a resharded "
+            "attention slice — exact"
+        )
+    finally:
+        await ts.shutdown("ep")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
+    print("expert-parallel example OK")
