@@ -55,8 +55,14 @@ async def _device_section_child() -> int:
 
     import jax
 
-    devs = jax.devices()
     allow_cpu = os.environ.get("TORCHSTORE_TPU_BENCH_DEVICE_ALLOW_CPU") == "1"
+    if allow_cpu:
+        # Validation mode: force the CPU backend BEFORE any device init —
+        # this image's sitecustomize routes jax at the TPU tunnel, which
+        # hangs indefinitely when the tunnel is down (the exact failure
+        # this child's subprocess isolation exists for).
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
     if devs[0].platform not in ("tpu", "axon") and not allow_cpu:
         print(f"# device section: no TPU (platform={devs[0].platform})")
         return 3
@@ -146,8 +152,11 @@ def device_section_subprocess() -> None:
         print("# device section disabled (TORCHSTORE_TPU_BENCH_DEVICE=0)", file=sys.stderr)
         return
     env = dict(os.environ)
-    # The child must see the REAL platform: undo any CPU forcing.
+    # The child must see the REAL platform: undo any CPU forcing —
+    # including a leftover ALLOW_CPU validation flag, which would silently
+    # bench the CPU backend on a TPU host.
     env.pop("JAX_PLATFORMS", None)
+    env.pop("TORCHSTORE_TPU_BENCH_DEVICE_ALLOW_CPU", None)
     for attempt in (1, 2):
         try:
             proc = subprocess.run(

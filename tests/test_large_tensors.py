@@ -53,3 +53,34 @@ async def test_large_sharded_reshard(store):
     )
     out = await ts.get("s", like=like, store_name=store)
     np.testing.assert_array_equal(np.asarray(out), g)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("TORCHSTORE_TPU_ENABLE_SLOW_TESTS"),
+    reason="slow tier: runs the full device-bench child on the CPU backend",
+)
+def test_device_bench_child_runs_on_cpu():
+    """The bench's device-section child (register -> per-pull stage ->
+    transfer-engine pull -> verify) must stay runnable end to end: the
+    TPU tunnel is only intermittently available, and the first live run
+    must not be the first execution of this code path. ALLOW_CPU forces
+    the child through the full flow on the CPU backend."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TORCHSTORE_TPU_BENCH_DEVICE_ALLOW_CPU="1",
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--device-section"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "device-path direct sync" in proc.stdout
