@@ -117,7 +117,12 @@ if __name__ == "__main__":
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--causal", action="store_true", default=True)
+    ap.add_argument(
+        "--causal",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="causal mask (default); --no-causal benches the full-matrix mode",
+    )
     ap.add_argument("--impl", default="auto", choices=("auto", "fused", "einsum"))
     args = ap.parse_args()
     run(args.per_device_seq, args.heads, args.head_dim, args.batch,

@@ -1,0 +1,35 @@
+#!/bin/bash
+# TPU tunnel watcher (BASELINE.md "Device (ICI) rung status"): the axon
+# backend fails or hangs for hours at a time, so instead of serializing the
+# session behind it, this probes every INTERVAL seconds and — the first time
+# jax init succeeds against a real device — captures every chip-blocked
+# benchmark into OUTDIR, then exits. Run it in the background at round
+# start; if the tunnel ever comes up, the hardware rows are waiting.
+#
+#   nohup scripts/tpu_watch.sh >/tmp/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+OUTDIR=${OUTDIR:-/tmp/tpu_capture}
+INTERVAL=${INTERVAL:-300}
+mkdir -p "$OUTDIR"
+
+while true; do
+    echo "[$(date +%H:%M:%S)] probing tpu tunnel..."
+    if timeout 90 python -c "import jax; d = jax.devices()[0]; assert d.platform in ('tpu', 'axon'), d.platform; print('platform', d.platform, d.device_kind)"; then
+        echo "[$(date +%H:%M:%S)] TUNNEL UP — capturing"
+        timeout 400 python bench.py --device-section \
+            >"$OUTDIR/device_section.out" 2>&1
+        echo "device section exit: $?"
+        timeout 600 python benchmarks/flash_kernel_bench.py \
+            >"$OUTDIR/flash_kernel.out" 2>&1
+        echo "flash kernel exit: $?"
+        timeout 600 python benchmarks/ring_attention_bench.py --per-device-seq 2048 \
+            >"$OUTDIR/ring_attention.out" 2>&1
+        echo "ring attention exit: $?"
+        touch "$OUTDIR/CAPTURED"
+        echo "[$(date +%H:%M:%S)] capture complete -> $OUTDIR"
+        exit 0
+    fi
+    echo "[$(date +%H:%M:%S)] tunnel down; sleeping ${INTERVAL}s"
+    sleep "$INTERVAL"
+done
