@@ -12,11 +12,18 @@ reached through a tunnel whose device->host path measures ~0.01 GB/s, which
 would benchmark the tunnel, not the framework. The store's TPU coupling
 (NamedSharding put/get) is exercised by the test suite and dryrun_multichip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is value / REFERENCE_GBPS where REFERENCE_GBPS approximates
-the reference's CUDA+RDMA same-host weight-sync path (no number is published
-by the reference — see BASELINE.md; 10 GB/s is the proxy the north star's
-">=80% of the CUDA+RDMA path" is scored against).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"host_memcpy_gbps", "calib_ratio", "sections", "p50_put_ms", "p50_get_ms",
+"metrics"}. ``vs_baseline`` is value / (REFERENCE_GBPS * calib_ratio):
+REFERENCE_GBPS approximates the reference's CUDA+RDMA same-host weight-sync
+path (no number is published by the reference — see BASELINE.md; 10 GB/s is
+the proxy the north star's ">=80% of the CUDA+RDMA path" is scored against),
+and calib_ratio scales it down on degraded hosts (a per-run single-thread
+memcpy calibration against CALIB_MEMCPY_ANCHOR_GBPS). ``sections`` carries
+each headline section's full stats (median/best/warm_min/warm_cv/warn/
+reruns — the bounded rerun-on-WARN policy); ``metrics`` is the process's
+observability-registry snapshot (per-transport byte counters, op latency
+histograms, SHM pool economics — see torchstore_tpu/observability/).
 
 Metric definition: DELIVERED bytes per second — each round trip hands N
 logical bytes to the store and N to the consumer (2N per iteration),
@@ -51,7 +58,7 @@ ITERS = 6  # iter 0 is cold; iters 1+ are the warm set the headline reports
 RERUNS_ON_WARN = 2  # bounded: headline sections rerun at most this many times
 
 
-def calibrate_memcpy_gbps(size_mb: int = 256, reps: int = 5) -> float:
+def calibrate_memcpy_gbps(size_mb: float = 256, reps: int = 5) -> float:
     """Best-of-N single-thread memcpy rate on THIS run's host.
 
     Best (not median) is deliberate: the calibration estimates the host's
@@ -59,7 +66,7 @@ def calibrate_memcpy_gbps(size_mb: int = 256, reps: int = 5) -> float:
     256 MB per rep is large enough to defeat caches and small enough to
     stay out of the bench's own tmpfs budget.
     """
-    src = np.random.rand(size_mb * 1024 * 1024 // 8)  # float64: 8 B/elem
+    src = np.random.rand(max(1, int(size_mb * 1024 * 1024 // 8)))  # f64: 8 B
     dst = np.empty_like(src)
     best = 0.0
     for _ in range(reps):
@@ -228,23 +235,46 @@ def device_section_subprocess() -> None:
     )
 
 
-async def run() -> dict:
+async def run(
+    n_tensors: int = N_TENSORS,
+    tensor_mb: float = TENSOR_MB,
+    iters: int = ITERS,
+    calib_mb: float = 256,
+    lat_iters: int = 40,
+) -> dict:
+    """Host benchmark sections. Parameters exist so the tier-1 smoke test
+    (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
+    tensors — a bench.py regression then fails tests instead of silently
+    zeroing a round's headline (VERDICT r5)."""
     import torchstore_tpu as ts
+
+    # Host-weather calibration (ADVICE r5): measure THIS host's memcpy
+    # ceiling and scale the 10 GB/s reference proxy down with it, so a
+    # degraded shared host is visible in the JSON instead of silently
+    # deflating vs_baseline.
+    host_memcpy = calibrate_memcpy_gbps(size_mb=calib_mb)
+    calib_ratio = min(1.0, host_memcpy / CALIB_MEMCPY_ANCHOR_GBPS)
+    print(
+        f"# host calibration: single-thread memcpy {host_memcpy:.2f} GB/s "
+        f"(anchor {CALIB_MEMCPY_ANCHOR_GBPS:.1f}; proxy scale "
+        f"{calib_ratio:.2f})",
+        file=sys.stderr,
+    )
 
     await ts.initialize(
         store_name="bench",
         strategy=ts.SingletonStrategy(default_transport_type="shm"),
     )
-    n_elem = TENSOR_MB * 1024 * 1024 // 4
+    n_elem = max(1, int(tensor_mb * 1024 * 1024 // 4))
     sd = {
         "layers": {
             str(i): np.random.rand(n_elem).astype(np.float32)
-            for i in range(N_TENSORS)
+            for i in range(n_tensors)
         }
     }
     total_bytes = sum(v.nbytes for v in sd["layers"].values())
     user = {
-        "layers": {str(i): np.zeros(n_elem, np.float32) for i in range(N_TENSORS)}
+        "layers": {str(i): np.zeros(n_elem, np.float32) for i in range(n_tensors)}
     }
 
     async def timed_loop(label: str, put_fn, get_fn, src=None, byte_factor=2) -> dict:
@@ -258,7 +288,7 @@ async def run() -> dict:
 
         src = src if src is not None else sd
         rates: list[float] = []
-        for it in range(ITERS):
+        for it in range(iters):
             stamp = float(it + 1)
             for arr in src["layers"].values():
                 arr[0] = stamp
@@ -284,9 +314,9 @@ async def run() -> dict:
                 f"{kind} {gbps:.2f} GB/s",
                 file=sys.stderr,
             )
-            for i in range(N_TENSORS):
+            for i in range(n_tensors):
                 assert out["layers"][str(i)][0] == stamp, f"{label} stale data"
-        for i in range(N_TENSORS):
+        for i in range(n_tensors):
             np.testing.assert_array_equal(
                 out["layers"][str(i)], src["layers"][str(i)]
             )
@@ -340,7 +370,7 @@ async def run() -> dict:
     # Buffered consumer takes zero-copy snapshot views (the jax consumer
     # pattern: device_put straight from the returned views); `user`-dict
     # in-place landing is exercised by the direct path below.
-    med_buffered = await timed_loop(
+    stats_buffered = await measured_section(
         "buffered",
         lambda: ts.put_state_dict("bench/sd", sd, store_name="bench"),
         lambda: ts.get_state_dict("bench/sd", store_name="bench"),
@@ -353,7 +383,7 @@ async def run() -> dict:
     await ts.get_state_dict(
         "bench/direct", user_state_dict=user, direct=True, store_name="bench"
     )
-    med_direct = await timed_loop(
+    stats_direct = await measured_section(
         "direct",
         lambda: ts.put_state_dict("bench/direct", sd, direct=True, store_name="bench"),
         lambda: ts.get_state_dict(
@@ -369,7 +399,7 @@ async def run() -> dict:
     # comparison with the reference metric.
     staging = ts.direct_staging_buffers("bench/direct", store_name="bench")
     assert staging is not None
-    await timed_loop(
+    stats_registered = await measured_section(
         "direct+registered",
         lambda: ts.put_state_dict(
             "bench/direct", staging, direct=True, store_name="bench"
@@ -383,7 +413,7 @@ async def run() -> dict:
     # p50 small-op latency (the BASELINE.json metric's latency half).
     lat_put, lat_get = [], []
     small = np.random.rand(256).astype(np.float32)
-    for i in range(40):
+    for i in range(lat_iters):
         t0 = time.perf_counter()
         await ts.put(f"lat/{i % 4}", small, store_name="bench")
         lat_put.append(time.perf_counter() - t0)
@@ -394,18 +424,38 @@ async def run() -> dict:
     p50g = sorted(lat_get)[len(lat_get) // 2] * 1e3
     print(f"# p50 latency (1KB): put {p50p:.2f} ms, get {p50g:.2f} ms", file=sys.stderr)
 
+    # The observability registry IS the bench's emission path now: grab the
+    # snapshot BEFORE shutdown (teardown resets volume gauges) so the
+    # machine-readable record carries the per-transport byte counters and
+    # op histograms of exactly this run.
+    metrics = ts.metrics_snapshot()
     await ts.shutdown("bench")
+    # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
+    # headline compares their median GB/s scalars, never the dicts.
+    med_buffered = stats_buffered["median"]
+    med_direct = stats_direct["median"]
     headline = max(med_buffered, med_direct)
     print(
         f"# headline (warm medians): buffered {med_buffered:.2f} GB/s, "
         f"direct steady-state {med_direct:.2f} GB/s",
         file=sys.stderr,
     )
+    effective_proxy = REFERENCE_GBPS * calib_ratio
     return {
         "metric": "state_dict_weight_sync_round_trip",
         "value": round(headline, 3),
         "unit": "GB/s",
-        "vs_baseline": round(headline / REFERENCE_GBPS, 3),
+        "vs_baseline": round(headline / effective_proxy, 3),
+        "host_memcpy_gbps": round(host_memcpy, 3),
+        "calib_ratio": round(calib_ratio, 3),
+        "sections": {
+            "buffered": stats_buffered,
+            "direct": stats_direct,
+            "direct_registered": stats_registered,
+        },
+        "p50_put_ms": round(p50p, 3),
+        "p50_get_ms": round(p50g, 3),
+        "metrics": metrics,
     }
 
 

@@ -17,7 +17,11 @@ while true; do
     echo "[$(date +%H:%M:%S)] probing tpu tunnel..."
     if timeout 90 python -c "import jax; d = jax.devices()[0]; assert d.platform in ('tpu', 'axon'), d.platform; print('platform', d.platform, d.device_kind)"; then
         echo "[$(date +%H:%M:%S)] TUNNEL UP — capturing"
-        timeout 400 python bench.py --device-section \
+        # Capture the observability registry alongside the bench output:
+        # every process in the run dumps its counters (per-transport bytes,
+        # ICI pull ops, ...) into OUTDIR as pid-claimed JSON files.
+        timeout 400 env TORCHSTORE_TPU_METRICS_DUMP="$OUTDIR/device_metrics.json" \
+            python bench.py --device-section \
             >"$OUTDIR/device_section.out" 2>&1
         echo "device section exit: $?"
         timeout 600 python benchmarks/flash_kernel_bench.py \

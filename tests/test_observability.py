@@ -1,0 +1,387 @@
+"""Observability subsystem: registry semantics, exporters, span tracing,
+end-to-end metric emission through a real store, and the regression tests
+for the carried ADVICE fixes that the new gauges made assertable."""
+
+import json
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import tracing
+
+
+# --------------------------------------------------------------------------
+# registry semantics
+# --------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_inc_and_labels(self):
+        r = obs_metrics.MetricsRegistry()
+        c = r.counter("ops_total", "ops")
+        c.inc()
+        c.inc(4, op="put")
+        c.inc(op="put")
+        assert c.value() == 1
+        assert c.value(op="put") == 5
+        assert c.total() == 6
+
+    def test_counter_rejects_decrease(self):
+        c = obs_metrics.MetricsRegistry().counter("c")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        g = obs_metrics.MetricsRegistry().gauge("g")
+        g.set(10, volume="0")
+        g.inc(5, volume="0")
+        g.dec(3, volume="0")
+        assert g.value(volume="0") == 12
+        assert g.value(volume="1") == 0
+
+    def test_histogram_buckets_cumulative(self):
+        h = obs_metrics.MetricsRegistry().histogram(
+            "h", buckets=(0.1, 1.0, 10.0)
+        )
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        val = h.value()
+        assert val["count"] == 5
+        assert val["sum"] == pytest.approx(56.05)
+        assert val["buckets"]["0.1"] == 1
+        assert val["buckets"]["1.0"] == 3
+        assert val["buckets"]["10.0"] == 4
+        assert val["buckets"]["+Inf"] == 5
+
+    def test_histogram_boundary_is_le(self):
+        # Prometheus semantics: an observation equal to a bound lands IN
+        # that bucket (le = less-than-or-equal).
+        h = obs_metrics.MetricsRegistry().histogram("h", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        assert h.value()["buckets"]["1.0"] == 1
+
+    def test_get_or_create_idempotent_and_type_checked(self):
+        r = obs_metrics.MetricsRegistry()
+        assert r.counter("x") is r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_reset_zeroes_but_keeps_instruments(self):
+        r = obs_metrics.MetricsRegistry()
+        c = r.counter("c")
+        c.inc(7)
+        r.reset()
+        assert c.value() == 0
+        c.inc()  # the cached instrument object still feeds the registry
+        assert r.snapshot()["c"]["series"][0]["value"] == 1
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+
+
+class TestExporters:
+    def _registry(self):
+        r = obs_metrics.MetricsRegistry()
+        r.counter("reqs_total", "requests").inc(3, op="put", transport="shm")
+        r.gauge("resident_bytes").set(4096, volume="0")
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        return r
+
+    def test_snapshot_is_json_serializable_and_shaped(self):
+        snap = self._registry().snapshot()
+        json.dumps(snap)  # fully serializable
+        assert snap["reqs_total"]["kind"] == "counter"
+        series = snap["reqs_total"]["series"][0]
+        assert series["labels"] == {"op": "put", "transport": "shm"}
+        assert series["value"] == 3
+        hist = snap["lat_seconds"]["series"][0]["value"]
+        assert hist["count"] == 2 and hist["buckets"]["+Inf"] == 2
+
+    def test_render_json_envelope(self):
+        doc = json.loads(self._registry().render_json())
+        assert {"ts", "pid", "metrics"} <= set(doc)
+        assert doc["metrics"]["resident_bytes"]["series"][0]["value"] == 4096
+
+    def test_render_prometheus_format(self):
+        text = self._registry().render_prometheus()
+        assert "# TYPE reqs_total counter" in text
+        assert 'reqs_total{op="put",transport="shm"} 3' in text
+        assert 'resident_bytes{volume="0"} 4096' in text
+        # Histogram: cumulative le buckets + sum + count.
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1.0"} 2' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "lat_seconds_count 2" in text
+
+    def test_prometheus_escapes_label_values(self):
+        r = obs_metrics.MetricsRegistry()
+        r.counter("c").inc(key='we"ird\nkey')
+        text = r.render_prometheus()
+        assert r'we\"ird\nkey' in text
+
+    def test_dump_metrics_writes_file(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        obs_metrics.counter("ts_dump_probe_total").inc()
+        written = obs_metrics.dump_metrics(path)
+        assert written == path
+        doc = json.loads(open(path).read())
+        assert "ts_dump_probe_total" in doc["metrics"]
+
+    def test_dump_metrics_prom_extension(self, tmp_path):
+        path = str(tmp_path / "m.prom")
+        obs_metrics.counter("ts_dump_probe_total").inc()
+        assert obs_metrics.dump_metrics(path) == path
+        assert "# TYPE ts_dump_probe_total counter" in open(path).read()
+
+
+# --------------------------------------------------------------------------
+# span tracing
+# --------------------------------------------------------------------------
+
+
+class TestSpans:
+    def _swap_path(self, collector, path):
+        old = collector.path
+        collector.path = path
+        return old
+
+    def test_span_nesting_and_flush(self, tmp_path):
+        collector = tracing.collector()
+        path = str(tmp_path / "trace.json")
+        old = self._swap_path(collector, path)
+        try:
+            with tracing.span("outer", key="k", nbytes=1000):
+                with tracing.span("inner", coords=(0, 1)):
+                    pass
+            collector.flush()
+        finally:
+            collector.path = old
+        content = open(path).read()
+        data = json.loads(
+            content if content.rstrip().endswith("]") else content + "\n]"
+        )
+        by_name = {e["name"]: e for e in data}
+        assert {"outer", "inner"} <= set(by_name)
+        outer, inner = by_name["outer"], by_name["inner"]
+        # Complete events with derived throughput + stringified attrs.
+        assert outer["ph"] == "X" and outer["args"]["bytes"] == 1000
+        assert "GBps" in outer["args"]
+        assert inner["args"]["coords"] == "(0, 1)"
+        # Nesting: inner is contained within outer on the same thread.
+        assert inner["tid"] == outer["tid"]
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    def test_span_records_error_class(self, tmp_path):
+        collector = tracing.collector()
+        path = str(tmp_path / "trace.json")
+        old = self._swap_path(collector, path)
+        try:
+            with pytest.raises(RuntimeError):
+                with tracing.span("boom"):
+                    raise RuntimeError("x")
+            collector.flush()
+        finally:
+            collector.path = old
+        content = open(path).read()
+        data = json.loads(content + "\n]")
+        assert data[0]["args"]["error"] == "RuntimeError"
+
+    def test_span_disabled_is_noop(self):
+        collector = tracing.collector()
+        old = self._swap_path(collector, None)
+        try:
+            with tracing.span("nothing", key="k"):
+                pass
+            assert collector.events == []
+        finally:
+            collector.path = old
+
+
+# --------------------------------------------------------------------------
+# end-to-end: a put/get round trip feeds the registry and the trace
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_round_trip_increments_expected_metrics(tmp_path):
+    import torchstore_tpu as ts
+
+    collector = tracing.collector()
+    trace_path = str(tmp_path / "trace.json")
+    old_path = collector.path
+    collector.path = trace_path
+
+    reg = obs_metrics.get_registry()
+    ops = reg.counter("ts_client_ops_total")
+    tbytes = reg.counter("ts_transport_bytes_total")
+    ops0_put = ops.value(op="put")
+    ops0_get = ops.value(op="get")
+    put_bytes0 = tbytes.value(transport="shm", op="put")
+    get_bytes0 = tbytes.value(transport="shm", op="get")
+    try:
+        await ts.initialize(
+            store_name="obs_e2e",
+            strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        )
+        try:
+            arr = np.arange(2048, dtype=np.float32)
+            await ts.put("obs/k", arr, store_name="obs_e2e")
+            out = await ts.get("obs/k", store_name="obs_e2e")
+            np.testing.assert_array_equal(np.asarray(out), arr)
+            del out  # release the zero-copy view before shutdown
+
+            snap = ts.metrics_snapshot()
+            # Logical client ops counted once per op.
+            assert ops.value(op="put") == ops0_put + 1
+            assert ops.value(op="get") == ops0_get + 1
+            # Nonzero per-transport byte counters, both directions.
+            assert (
+                tbytes.value(transport="shm", op="put") - put_bytes0
+                == arr.nbytes
+            )
+            assert (
+                tbytes.value(transport="shm", op="get") - get_bytes0
+                == arr.nbytes
+            )
+            # The snapshot is the same data, shaped for export.
+            assert "ts_client_op_seconds" in snap
+            put_hist = [
+                s["value"]
+                for s in snap["ts_client_op_seconds"]["series"]
+                if s["labels"] == {"op": "put"}
+            ]
+            assert put_hist and put_hist[0]["count"] >= 1
+        finally:
+            await ts.shutdown("obs_e2e")
+    finally:
+        collector.flush()
+        collector.path = old_path
+    content = open(trace_path).read()
+    data = json.loads(
+        content if content.rstrip().endswith("]") else content + "\n]"
+    )
+    names = {e["name"] for e in data}
+    # ≥1 span per layer: client op, transport transfer, per-volume fetch.
+    assert "put_batch" in names
+    assert "get_batch" in names
+    assert "transport.put" in names and "transport.get" in names
+    assert "fetch_volume" in names
+    tput = next(e for e in data if e["name"] == "transport.put")
+    assert tput["args"]["transport"] == "shm"
+    assert tput["args"]["bytes"] == 2048 * 4
+
+
+# --------------------------------------------------------------------------
+# regression: carried ADVICE fixes
+# --------------------------------------------------------------------------
+
+
+class TestShmSpareHygiene:
+    def test_sweep_purges_spare_by_size(self, monkeypatch):
+        """ADVICE r4: a TTL-reaped reserved spare must also leave
+        spare_by_size, or the per-size name lists grow without bound."""
+        from torchstore_tpu.transport import shared_memory as shm
+
+        if not shm.is_available():
+            pytest.skip("/dev/shm unavailable")
+        cache = shm.ShmServerCache()
+        seg = shm.ShmSegment.create(128)
+        try:
+            cache.reserved[seg.name] = (seg, 0.0)  # reserved long ago
+            cache.spare_by_size[128] = [seg.name]
+            monkeypatch.setattr(
+                shm.time, "monotonic", lambda: shm.RESERVED_TTL_S + 1.0
+            )
+            cache.sweep()
+            assert seg.name not in cache.reserved
+            assert cache.spare_by_size == {}
+        finally:
+            seg.unlink()
+
+    def test_collect_released_evicts_stale_pre_attached(self, monkeypatch):
+        """ADVICE carried: stale pre-attached spares must be evicted on the
+        per-RPC entry point (collect_released), not only when another
+        pre_attach call happens to arrive."""
+        from torchstore_tpu.transport import shared_memory as shm
+
+        if not shm.is_available():
+            pytest.skip("/dev/shm unavailable")
+        cache = shm.ShmClientCache()
+        seg = shm.ShmSegment.create(64)
+        try:
+            cache.segments[seg.name] = seg
+            cache._pre_attached[seg.name] = 0.0  # attached long ago
+            monkeypatch.setattr(
+                shm.time, "monotonic", lambda: shm.RESERVED_TTL_S + 1.0
+            )
+            cache.collect_released("v0")
+            assert seg.name not in cache.segments
+            assert cache._pre_attached == {}
+        finally:
+            seg.unlink()
+
+
+@pytest.mark.anyio
+async def test_reclaim_collects_generationless_durable_bytes():
+    """ADVICE r4 carried fix: keys ABSENT from the volume's write_gens
+    reply (durable bytes surviving a volume restart — no in-memory
+    generation) must stay in the reclaim batch and be deleted, not dropped.
+    Asserted through the real StorageVolume so the new resident-bytes gauge
+    is the witness: it returns to baseline after the reclaim's delete."""
+    from torchstore_tpu.controller import Controller
+    from torchstore_tpu.storage_volume import InMemoryStore, StorageVolume
+    from torchstore_tpu.transport.types import Request, TensorMeta
+
+    vol = StorageVolume(storage=InMemoryStore())
+    gauge = obs_metrics.get_registry().gauge("ts_volume_resident_bytes")
+    baseline = gauge.value(volume=vol.volume_id)
+
+    # Stale partial-landing bytes from BEFORE a volume restart: present in
+    # storage, absent from _write_gens (the restart cleared them).
+    arr = np.ones(256, np.float32)
+    vol.store.store([Request.from_tensor("k", arr).meta_only()], {0: arr})
+    vol._resident_bytes += arr.nbytes
+    vol._publish_residency()
+    assert gauge.value(volume=vol.volume_id) == baseline + arr.nbytes
+
+    class VolumeRef:
+        """Adapter exposing the real volume's endpoint coroutines the way
+        the reclaim drainer calls them."""
+
+        class _Ep:
+            def __init__(self, fn):
+                self.call_one = fn
+
+        def __getattr__(self, name):
+            # @endpoint methods are plain bound coroutines on the instance.
+            return self._Ep(getattr(vol, name))
+
+    c = Controller()
+    c.volume_refs = {"v0": VolumeRef()}
+
+    def meta():
+        req = Request.from_tensor("k", arr)
+        req.tensor_meta = TensorMeta(shape=(256,), dtype="float32")
+        return req.meta_only()
+
+    # First-ever put of k lands on v1 and FAILS on v0 -> v0 detached with
+    # unknown generation (-1): exactly the partial-landing shape, but the
+    # volume's write_gens reply is EMPTY (restart wiped it).
+    await c.notify_put_batch(
+        [meta()], "v1", detach_volume_ids=["v0"],
+        write_gens={"v1": {"k": 200}},
+    )
+    assert c._pending_reclaims["v0"] == {"k": -1}
+    for task in list(c._reclaim_tasks):
+        await task
+    # The generation-less durable bytes were reclaimed (not dropped) and
+    # the resident-bytes gauge is back at baseline.
+    assert "k" not in vol.store.kv
+    assert c._pending_reclaims == {}
+    assert gauge.value(volume=vol.volume_id) == baseline

@@ -24,6 +24,7 @@ from torchstore_tpu.api import (
     initialize,
     initialize_spmd,
     keys,
+    metrics_snapshot,
     put,
     put_batch,
     put_state_dict,
@@ -36,6 +37,7 @@ from torchstore_tpu.client import LocalClient
 from torchstore_tpu.weight_channel import WeightPublisher, WeightSubscriber
 from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import init_logging
+from torchstore_tpu.observability import maybe_start_dumper, span
 from torchstore_tpu.strategy import (
     HostStrategy,
     LocalRankStrategy,
@@ -46,6 +48,9 @@ from torchstore_tpu.transport.factory import TransportType
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 
 init_logging()
+# Every torchstore process (clients, volume actors, the controller) starts
+# its metrics dump thread here when TORCHSTORE_TPU_METRICS_DUMP is set.
+maybe_start_dumper()
 
 __version__ = "0.1.0"
 
@@ -76,6 +81,7 @@ __all__ = [
     "initialize",
     "initialize_spmd",
     "keys",
+    "metrics_snapshot",
     "put",
     "put_batch",
     "direct_staging_buffers",
@@ -83,5 +89,6 @@ __all__ = [
     "repair",
     "reset_client",
     "shutdown",
+    "span",
     "wait_for",
 ]

@@ -21,6 +21,7 @@ from torchstore_tpu.client import LocalClient, Shard
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import Controller
 from torchstore_tpu.logging import get_logger, set_log_level
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.runtime import (
     ActorMesh,
     ActorRef,
@@ -502,6 +503,19 @@ async def repair(store_name: str = DEFAULT_STORE) -> dict:
     return report
 
 
+def metrics_snapshot() -> dict:
+    """This process's observability registry: every counter/gauge/histogram
+    the store's layers emit (client ops, per-transport bytes, SHM pool
+    economics, ...), as ``{name: {"kind", "help", "series": [...]}}`` —
+    JSON-serializable. Metrics are PROCESS-LOCAL (Prometheus client-library
+    semantics): volume and controller processes expose their registries
+    through their ``stats()`` endpoints
+    (``controller.stats.call_one(include_volumes=True)`` collects the whole
+    fleet), and ``TORCHSTORE_TPU_METRICS_DUMP=/path`` makes every process
+    periodically write its own dump."""
+    return obs_metrics.metrics_snapshot()
+
+
 async def barrier(
     name: str, store_name: str = DEFAULT_STORE, timeout: float = 300.0
 ) -> None:
@@ -565,6 +579,7 @@ __all__ = [
     "initialize",
     "initialize_spmd",
     "keys",
+    "metrics_snapshot",
     "put",
     "put_batch",
     "direct_staging_buffers",

@@ -10,6 +10,7 @@ fast path that lands transport writes directly in destination memory.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -21,6 +22,8 @@ from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
 from torchstore_tpu.native import copy_into
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
@@ -40,6 +43,26 @@ from torchstore_tpu.utils import (
 )
 
 logger = get_logger("torchstore_tpu.client")
+
+# Client-side op instruments: logical store operations (one put_batch is one
+# op however many volumes/replicas it fans out to; transport-level counters
+# in transport/buffers.py count the physical transfers underneath).
+_OP_COUNT = obs_metrics.counter(
+    "ts_client_ops_total", "Logical client operations by op"
+)
+_OP_BYTES = obs_metrics.counter(
+    "ts_client_bytes_total", "Logical payload bytes by op (pre-replication)"
+)
+_OP_ERRORS = obs_metrics.counter(
+    "ts_client_errors_total", "Failed client operations by op"
+)
+_OP_SECONDS = obs_metrics.histogram(
+    "ts_client_op_seconds", "End-to-end wall time of one client op"
+)
+_FETCH_RETRIES = obs_metrics.counter(
+    "ts_client_fetch_retries_total",
+    "Batch fetches retried after a stale-location/ref failure",
+)
 
 
 @dataclass
@@ -178,6 +201,22 @@ class LocalClient:
         await self.put_batch({key: value})
 
     async def put_batch(self, items: dict[str, Any]) -> None:
+        t0 = time.perf_counter()
+        try:
+            with span(
+                "put_batch",
+                keys=len(items),
+                key=next(iter(items), None),
+            ) as sp:
+                nbytes = await self._put_batch(items, sp)
+        except BaseException:
+            _OP_ERRORS.inc(op="put")
+            raise
+        _OP_COUNT.inc(op="put")
+        _OP_BYTES.inc(nbytes, op="put")
+        _OP_SECONDS.observe(time.perf_counter() - t0, op="put")
+
+    async def _put_batch(self, items: dict[str, Any], sp) -> int:
         await self._ensure_setup()
         tracker = LatencyTracker("put_batch")
         # Issue every device->host copy for the WHOLE batch up front so
@@ -192,6 +231,7 @@ class LocalClient:
             requests.extend(self._value_to_requests(key, value))
         volumes = self._put_volumes()
         nbytes = sum(r.nbytes for r in requests)
+        sp.set(nbytes=nbytes, replicas=len(volumes))
 
         async def put_to(volume: StorageVolumeRef) -> dict[str, int]:
             try:
@@ -247,6 +287,7 @@ class LocalClient:
         )
         tracker.track_step("notify")
         tracker.log_summary()
+        return nbytes
 
     # ------------------------------------------------------------------
     # get
@@ -261,6 +302,27 @@ class LocalClient:
         whole batch before data moves (locate happens up front). ``items``
         is either a list of keys or {key: fetch_target_or_None} (reference
         signature parity, /root/reference/torchstore/api.py:242-279)."""
+        t0 = time.perf_counter()
+        try:
+            with span("get_batch", keys=len(items)) as sp:
+                out = await self._get_batch(items)
+                # Stored OBJECTS come back as arbitrary user types; only
+                # count an nbytes attribute that is actually a number.
+                nbytes = sum(
+                    n
+                    for v in out.values()
+                    if isinstance((n := getattr(v, "nbytes", 0)), int)
+                )
+                sp.set(nbytes=nbytes)
+        except BaseException:
+            _OP_ERRORS.inc(op="get")
+            raise
+        _OP_COUNT.inc(op="get")
+        _OP_BYTES.inc(nbytes, op="get")
+        _OP_SECONDS.observe(time.perf_counter() - t0, op="get")
+        return out
+
+    async def _get_batch(self, items) -> dict[str, Any]:
         if isinstance(items, str):
             raise TypeError(
                 "get_batch takes a list of keys or a {key: target} dict, "
@@ -381,6 +443,7 @@ class LocalClient:
                 raise
             for key in stale:
                 self._loc_cache.pop(key, None)
+            _FETCH_RETRIES.inc()
             logger.info(
                 "stale location/refs for %d key(s) (%s); re-locating",
                 len(stale),
@@ -428,21 +491,37 @@ class LocalClient:
             volume = self._volume_refs[vid]
             buffer = create_transport_buffer(volume, self._config)
             subs = [sub for _, sub in entries]
-            try:
-                if buffer.supports_batch_gets or len(subs) == 1:
-                    results = await buffer.get_from_storage_volume(volume, subs)
-                else:
-                    results = []
-                    for sub in subs:
-                        b = create_transport_buffer(volume, self._config)
-                        results.extend(
-                            await b.get_from_storage_volume(volume, [sub])
+            # Shard coordinates ride the span so a trace shows exactly which
+            # mesh coords each volume served (straggler attribution).
+            coords = [
+                sub.tensor_slice.coordinates
+                for sub in subs
+                if sub.tensor_slice is not None
+            ]
+            with span(
+                "fetch_volume",
+                volume=vid,
+                transport=buffer.transport_name,
+                keys=len(subs),
+                coords=coords if coords else None,
+            ):
+                try:
+                    if buffer.supports_batch_gets or len(subs) == 1:
+                        results = await buffer.get_from_storage_volume(
+                            volume, subs
                         )
-            except (ActorDiedError, ConnectionError, OSError) as exc:
-                # Bulk/peer transports report volume death as
-                # ConnectionError; normalizing through the diagnosis path
-                # marks the volume dead so the retry prefers replicas.
-                await self._raise_with_diagnosis(vid, exc)
+                    else:
+                        results = []
+                        for sub in subs:
+                            b = create_transport_buffer(volume, self._config)
+                            results.extend(
+                                await b.get_from_storage_volume(volume, [sub])
+                            )
+                except (ActorDiedError, ConnectionError, OSError) as exc:
+                    # Bulk/peer transports report volume death as
+                    # ConnectionError; normalizing through the diagnosis path
+                    # marks the volume dead so the retry prefers replicas.
+                    await self._raise_with_diagnosis(vid, exc)
             for (idx, sub), res in zip(entries, results):
                 parts_by_request.setdefault(idx, []).append((sub, res))
 
@@ -618,7 +697,13 @@ class LocalClient:
             dest, [a for a, _ in arrays]
         ):
             return dest  # in-place fast path: everything already landed
-        out, offsets = assemble_tensor([(a, off) for a, off in arrays])
+        with span(
+            "reshard",
+            key=req.key,
+            parts=len(arrays),
+            nbytes=sum(a.nbytes for a, _ in arrays),
+        ):
+            out, offsets = assemble_tensor([(a, off) for a, off in arrays])
         if dest is not None:
             dest_box = (
                 req.tensor_slice.box

@@ -16,11 +16,30 @@ from enum import Enum
 from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.runtime import Actor, ActorRef, endpoint
 from torchstore_tpu.storage_utils.trie import Trie
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 
 logger = get_logger("torchstore_tpu.controller")
+
+# Metadata-plane instruments (live in the controller's process; surfaced to
+# clients through the ``stats()`` endpoint).
+_PUTS = obs_metrics.counter("ts_controller_puts_total", "Logical puts indexed")
+_PUT_BYTES = obs_metrics.counter(
+    "ts_controller_put_bytes_total", "Logical bytes indexed by puts"
+)
+_LOCATES = obs_metrics.counter("ts_controller_locates_total", "Keys located")
+_DELETES = obs_metrics.counter("ts_controller_deletes_total", "Keys deleted")
+_KEYS = obs_metrics.gauge("ts_controller_keys", "Keys currently indexed")
+_PENDING_RECLAIMS = obs_metrics.gauge(
+    "ts_controller_pending_reclaims",
+    "Stale-replica reclaims not yet drained, per volume",
+)
+_RECLAIMED = obs_metrics.counter(
+    "ts_controller_reclaimed_keys_total",
+    "Stale copies deleted by the background reclaim",
+)
 
 
 class ObjectType(Enum):
@@ -164,6 +183,7 @@ class Controller(Actor):
             for key in keys:
                 self._key_gens[key] = self._key_gens.get(key, 0) + 1
             cond.notify_all()
+        _KEYS.set(len(self.index))
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -229,6 +249,7 @@ class Controller(Actor):
         require_fully_committed: bool = True,
     ) -> dict[str, dict[str, StorageInfo]]:
         self.counters["locates"] += len(keys)
+        _LOCATES.inc(len(keys))
         out: dict[str, dict[str, StorageInfo]] = {}
         for key in keys:
             infos = self.index.get(key)
@@ -323,8 +344,10 @@ class Controller(Actor):
             # Count as each entry indexes, so a mid-batch rejection leaves
             # counters consistent with what actually landed in the index.
             self.counters["puts"] += 1
+            _PUTS.inc()
             if meta.tensor_meta is not None:
                 self.counters["put_bytes"] += meta.tensor_meta.nbytes
+                _PUT_BYTES.inc(meta.tensor_meta.nbytes)
             for vid in detach_volume_ids or ():
                 # Capture the generation of the copy being detached BEFORE
                 # removing it — the reclaim may delete the replica's bytes
@@ -365,6 +388,7 @@ class Controller(Actor):
             # -1 = unknown generation (resolved volume-side at drain time);
             # a known generation always wins over unknown.
             pending[key] = max(pending[key], gen) if key in pending else gen
+        _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
         if volume_id in self._reclaim_running:
             return  # the volume's drainer picks the new keys up
         self._reclaim_running.add(volume_id)
@@ -432,11 +456,15 @@ class Controller(Actor):
                         for key in unknown:
                             if key in observed:
                                 batch[key] = observed[key]
-                            else:
-                                # No bytes, no generation: nothing to do.
-                                del batch[key]
-                                if pending.get(key, 0) < 0:
-                                    pending.pop(key, None)
+                            # Keys ABSENT from the reply stay in the batch at
+                            # gen -1: on a durable backend after a volume
+                            # restart, stale partial-landing bytes can exist
+                            # with no in-memory generation — dropping them
+                            # here would leave them readable via warm
+                            # location caches forever. delete_batch_if
+                            # deletes keys with no recorded generation, and
+                            # a put racing in records one and is kept
+                            # (ADVICE r4 carried fix).
                         # Keys indexed on this volume while we fetched gens
                         # are fresh again — drop them before deleting.
                         for key in list(batch):
@@ -472,6 +500,8 @@ class Controller(Actor):
                         result["kept_fresh"][:3],
                     )
                 await self._reconcile_clobbered(volume_id, result["removed"])
+                _RECLAIMED.inc(len(result["removed"]))
+                _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
                 logger.info(
                     "reclaimed %d stale key(s) on detached volume %s",
                     len(result["removed"]),
@@ -490,6 +520,7 @@ class Controller(Actor):
         finally:
             self._reclaim_running.discard(volume_id)
             self._pending_reclaims.pop(volume_id, None)
+            _PENDING_RECLAIMS.set(0, volume=volume_id)
 
     async def _reconcile_clobbered(
         self, volume_id: str, removed_keys: list[str]
@@ -545,6 +576,7 @@ class Controller(Actor):
         /root/reference/torchstore/client.py:408-411) and return which
         volumes held each key so the client can clear the data plane."""
         self.counters["deletes"] += len(keys)
+        _DELETES.inc(len(keys))
         by_volume: dict[str, list[str]] = {}
         for key in keys:
             infos = self.index.pop(key, None)
@@ -802,6 +834,9 @@ class Controller(Actor):
                 for vid, keys in self._pending_reclaims.items()
                 if keys
             },
+            # The controller process's own registry — metrics are
+            # process-local, so remote clients reach these through stats().
+            "metrics": obs_metrics.metrics_snapshot(),
         }
         if include_volumes:
             import asyncio

@@ -1,128 +1,32 @@
-"""Logging + lightweight latency/throughput tracking + trace export.
+"""Logging + lightweight latency/throughput tracking.
 
 Equivalent of /root/reference/torchstore/logging.py:13-66: root-level config
 from an env var, and a ``LatencyTracker`` that records named steps plus
 end-to-end wall time and formats GB/s when a byte count is supplied.
 
-Beyond the reference (SURVEY §5 notes it has "no integration with torch
-profiler/perfetto"): set ``TORCHSTORE_TPU_TRACE=/path/trace.json`` and every
-LatencyTracker phase is ALSO recorded as a Chrome-trace complete event;
-the file (written at process exit, one per process, pid-suffixed when
-needed) loads directly in Perfetto / chrome://tracing, aligning store
-phases (flatten, handshakes, data-plane copies, notify) on a timeline next
-to jax profiler traces.
+Trace export lives in ``torchstore_tpu.observability.tracing`` (this module
+once held a private ``_TraceCollector``; the public subsystem replaced it).
+``LatencyTracker`` phases still land in the same Chrome-trace file as
+``observability.span`` events when ``TORCHSTORE_TPU_TRACE`` is set.
 """
 
 from __future__ import annotations
 
-import atexit
-import json
 import logging
 import os
-import threading
 import time
 from typing import Optional
+
+from torchstore_tpu.observability import tracing
 
 _INITIALIZED = False
 
 ENV_LOG_LEVEL = "TORCHSTORE_TPU_LOG_LEVEL"
-ENV_TRACE = "TORCHSTORE_TPU_TRACE"
+ENV_TRACE = tracing.ENV_TRACE
 
-
-class _TraceCollector:
-    """Process-global Chrome-trace event buffer (enabled by env var).
-    Events stream to disk in the JSON *array* format, appending every
-    FLUSH_EVERY events — the format's closing ``]`` is optional, so the
-    file is loadable after a crash and memory stays bounded in
-    long-running loops."""
-
-    FLUSH_EVERY = 1000
-
-    def __init__(self) -> None:
-        self.path = os.environ.get(ENV_TRACE)
-        self.events: list[dict] = []
-        self._lock = threading.Lock()
-        self._registered = False
-        self._resolved_path: Optional[str] = None
-        self._resolved_for: Optional[str] = None
-        self._wrote_header = False
-
-    @property
-    def enabled(self) -> bool:
-        return bool(self.path)
-
-    def add(self, name: str, phase: str, start_s: float, dur_s: float,
-            nbytes: Optional[int]) -> None:
-        if not self.path:
-            return
-        event = {
-            "name": f"{name}/{phase}",
-            "cat": "torchstore",
-            "ph": "X",
-            "ts": start_s * 1e6,
-            "dur": dur_s * 1e6,
-            "pid": os.getpid(),
-            "tid": threading.get_ident() & 0xFFFF,
-        }
-        if nbytes is not None:
-            event["args"] = {
-                "bytes": nbytes,
-                "GBps": round(nbytes / dur_s / 1e9, 3) if dur_s > 0 else None,
-            }
-        with self._lock:
-            self.events.append(event)
-            if not self._registered:
-                self._registered = True
-                atexit.register(self.flush)
-            if len(self.events) >= self.FLUSH_EVERY:
-                self._flush_locked()
-
-    def _resolve_path(self) -> str:
-        # Re-resolve if the target changed (tests swap it) — and CLAIM the
-        # file with O_EXCL: volume actors and the client all trace, and two
-        # processes exists()-checking concurrently would interleave appends
-        # into one corrupt file. The loser takes a pid-suffixed name.
-        if self._resolved_path is None or self._resolved_for != self.path:
-            base = self.path
-            root, ext = os.path.splitext(base)
-            pid_path = f"{root}.{os.getpid()}{ext or '.json'}"
-            chosen = pid_path
-            for cand in (base, pid_path):
-                try:
-                    os.close(
-                        os.open(cand, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
-                    )
-                    chosen = cand
-                    break
-                except FileExistsError:
-                    continue
-                except OSError:
-                    break
-            self._resolved_path = chosen
-            self._resolved_for = self.path
-            self._wrote_header = False
-        return self._resolved_path
-
-    def _flush_locked(self) -> None:
-        if not self.path or not self.events:
-            return
-        chunk = self.events
-        self.events = []
-        try:
-            with open(self._resolve_path(), "a") as f:
-                for event in chunk:
-                    f.write("[\n" if not self._wrote_header else ",\n")
-                    self._wrote_header = True
-                    json.dump(event, f)
-        except OSError:
-            pass
-
-    def flush(self) -> None:
-        with self._lock:
-            self._flush_locked()
-
-
-_trace = _TraceCollector()
+# The process-global trace collector (compat alias — tests and older callers
+# reach the collector through ``logging._trace``).
+_trace = tracing.collector()
 
 
 def init_logging() -> None:

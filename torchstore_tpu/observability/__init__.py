@@ -1,0 +1,74 @@
+"""Store-wide observability: metrics registry + span tracing.
+
+Two independent, zero-dependency substrates every layer of the store emits
+into (the reference has neither — SURVEY §5 "no counters/prometheus, no
+profiler integration"):
+
+- **Metrics** (``observability.metrics``): process-local counters, gauges,
+  and fixed-bucket histograms with Prometheus-text and JSON exporters.
+  ``ts.metrics_snapshot()`` returns the calling process's registry; volume
+  and controller processes expose theirs through their ``stats()``
+  endpoints; ``TORCHSTORE_TPU_METRICS_DUMP=/path`` makes every process
+  periodically rewrite a machine-readable dump
+  (``TORCHSTORE_TPU_METRICS_INTERVAL_S``, default 60).
+
+- **Tracing** (``observability.tracing``): ``span(name, **attrs)`` context
+  manager emitting Chrome-trace complete events when
+  ``TORCHSTORE_TPU_TRACE=/path/trace.json`` is set — put/get/reshard/
+  publish spans carry key, nbytes, transport, and shard coordinates, and
+  the file loads directly in Perfetto next to jax profiler traces.
+
+Instrumented layers: ``client.py``/``api.py`` (per-op latency + bytes),
+``transport/*`` (per-transport bytes moved, buffer-pool hit/miss,
+registration counts), ``controller.py``/``storage_volume.py`` (keys,
+resident bytes, write generations, pending reclaims), and
+``weight_channel.py`` (publish/acquire versions and subscriber lag).
+"""
+
+from torchstore_tpu.observability.metrics import (
+    ENV_METRICS_DUMP,
+    ENV_METRICS_INTERVAL,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    dump_metrics,
+    gauge,
+    get_registry,
+    histogram,
+    maybe_start_dumper,
+    metrics_snapshot,
+    reset_metrics,
+)
+from torchstore_tpu.observability.tracing import (
+    ENV_TRACE,
+    TraceCollector,
+    collector,
+    flush_trace,
+    span,
+    trace_enabled,
+)
+
+__all__ = [
+    "ENV_METRICS_DUMP",
+    "ENV_METRICS_INTERVAL",
+    "ENV_TRACE",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TraceCollector",
+    "collector",
+    "counter",
+    "dump_metrics",
+    "flush_trace",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "maybe_start_dumper",
+    "metrics_snapshot",
+    "reset_metrics",
+    "span",
+    "trace_enabled",
+]

@@ -1,0 +1,204 @@
+"""Span tracing: public Chrome-trace emission for store operations.
+
+Generalizes the private ``_TraceCollector`` that used to live in
+``torchstore_tpu/logging.py`` into a public subsystem: set
+``TORCHSTORE_TPU_TRACE=/path/trace.json`` and every ``span(...)`` — put/get
+batches, per-volume fetches, transport transfers, resharding assembly,
+weight-channel publishes — lands as a Chrome-trace complete event. The file
+loads directly in Perfetto / chrome://tracing and aligns store phases with
+jax profiler traces on one timeline.
+
+Usage (sync context manager; works around ``await`` since it only brackets
+wall time):
+
+    from torchstore_tpu.observability import span
+
+    with span("put_batch", keys=3, nbytes=total, transport="shm") as sp:
+        ...
+        sp.set(volume=vid)          # attrs may be added mid-span
+
+Cost when disabled (no env var): one ``perf_counter`` call per span and an
+attribute check — nothing is buffered.
+
+Events stream to disk in the JSON *array* format, appending every
+``FLUSH_EVERY`` events — the format's closing ``]`` is optional, so the file
+is loadable after a crash and memory stays bounded in long-running loops.
+One file per process: the path is claimed with O_EXCL (volume actors and the
+client all trace) and losers take a pid-suffixed name.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+ENV_TRACE = "TORCHSTORE_TPU_TRACE"
+
+
+class TraceCollector:
+    """Process-global Chrome-trace event buffer (enabled by env var)."""
+
+    FLUSH_EVERY = 1000
+
+    def __init__(self) -> None:
+        self.path = os.environ.get(ENV_TRACE)
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+        self._registered = False
+        self._resolved_path: Optional[str] = None
+        self._resolved_for: Optional[str] = None
+        self._wrote_header = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def add_event(
+        self,
+        name: str,
+        start_s: float,
+        dur_s: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Record one complete ('X') event. ``args`` ride into the trace's
+        ``args`` pane; a ``bytes`` entry gets a derived GBps alongside."""
+        if not self.path:
+            return
+        event = {
+            "name": name,
+            "cat": "torchstore",
+            "ph": "X",
+            "ts": start_s * 1e6,
+            "dur": dur_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            args = dict(args)
+            nbytes = args.get("bytes")
+            if isinstance(nbytes, (int, float)) and "GBps" not in args:
+                args["GBps"] = (
+                    round(nbytes / dur_s / 1e9, 3) if dur_s > 0 else None
+                )
+            event["args"] = args
+        with self._lock:
+            self.events.append(event)
+            if not self._registered:
+                self._registered = True
+                atexit.register(self.flush)
+            if len(self.events) >= self.FLUSH_EVERY:
+                self._flush_locked()
+
+    def add(
+        self,
+        name: str,
+        phase: str,
+        start_s: float,
+        dur_s: float,
+        nbytes: Optional[int],
+    ) -> None:
+        """LatencyTracker-shaped entry point (``{name}/{phase}`` naming) —
+        kept so the tracker's phases land in the same trace as spans."""
+        args = {"bytes": nbytes} if nbytes is not None else None
+        self.add_event(f"{name}/{phase}", start_s, dur_s, args)
+
+    def _resolve_path(self) -> str:
+        # Re-resolve if the target changed (tests swap it) — and CLAIM the
+        # file with O_EXCL: two processes exists()-checking concurrently
+        # would interleave appends into one corrupt file. The loser takes a
+        # pid-suffixed name.
+        if self._resolved_path is None or self._resolved_for != self.path:
+            base = self.path
+            root, ext = os.path.splitext(base)
+            pid_path = f"{root}.{os.getpid()}{ext or '.json'}"
+            chosen = pid_path
+            for cand in (base, pid_path):
+                try:
+                    os.close(
+                        os.open(cand, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+                    )
+                    chosen = cand
+                    break
+                except FileExistsError:
+                    continue
+                except OSError:
+                    break
+            self._resolved_path = chosen
+            self._resolved_for = self.path
+            self._wrote_header = False
+        return self._resolved_path
+
+    def _flush_locked(self) -> None:
+        if not self.path or not self.events:
+            return
+        chunk = self.events
+        self.events = []
+        try:
+            with open(self._resolve_path(), "a") as f:
+                for event in chunk:
+                    f.write("[\n" if not self._wrote_header else ",\n")
+                    self._wrote_header = True
+                    json.dump(event, f)
+        except OSError:
+            pass
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+
+_collector = TraceCollector()
+
+
+def collector() -> TraceCollector:
+    return _collector
+
+
+def trace_enabled() -> bool:
+    return _collector.enabled
+
+
+def flush_trace() -> None:
+    _collector.flush()
+
+
+class span:
+    """Context manager recording one named span with attributes.
+
+    Attrs are arbitrary small values (key, nbytes, transport, volume, shard
+    coords); ``bytes``/``nbytes`` get a derived GBps in the trace. Nesting
+    works naturally — Chrome's 'X' events on one tid stack by containment.
+    """
+
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, **attrs) -> None:
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> "span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not _collector.enabled:
+            return
+        dur = time.perf_counter() - self._t0
+        args = {
+            k: (v if isinstance(v, (int, float, bool, type(None))) else str(v))
+            for k, v in self.attrs.items()
+        }
+        if "nbytes" in args and "bytes" not in args:
+            args["bytes"] = args.pop("nbytes")
+        if exc_type is not None:
+            args["error"] = exc_type.__name__
+        _collector.add_event(self.name, self._t0, dur, args or None)

@@ -22,12 +22,28 @@ from typing import Any, Optional
 import numpy as np
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.runtime import Actor, endpoint
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 from torchstore_tpu.utils import get_hostname, maybe_await
 
 logger = get_logger("torchstore_tpu.storage_volume")
+
+# Data-plane gauges (volume process; maintained incrementally per affected
+# key so the hot path never re-walks the whole store).
+_RESIDENT_BYTES = obs_metrics.gauge(
+    "ts_volume_resident_bytes", "Tensor bytes resident in this volume"
+)
+_ENTRIES = obs_metrics.gauge(
+    "ts_volume_entries", "Entries (keys) resident in this volume"
+)
+_PUT_OPS = obs_metrics.counter(
+    "ts_volume_put_ops_total", "Put RPCs served by this volume"
+)
+_GET_OPS = obs_metrics.counter(
+    "ts_volume_get_ops_total", "Get RPCs served by this volume"
+)
 
 
 class KeyNotFoundError(KeyError):
@@ -253,6 +269,13 @@ class StorageVolume(Actor):
         # Timestamps (not counters) stay comparable across volume restarts
         # on durable backends.
         self._write_gens: dict[str, int] = {}
+        # Incremental resident-bytes accounting: seeded from whatever the
+        # backend already holds (durable volumes recover entries at init),
+        # then adjusted by per-key deltas on every put/delete.
+        self._resident_bytes = sum(
+            self._entry_nbytes(key) for key in getattr(self.store, "kv", {})
+        )
+        self._publish_residency()
         from torchstore_tpu import native
         from torchstore_tpu.transport import shared_memory
 
@@ -277,6 +300,28 @@ class StorageVolume(Actor):
         existing = self.store.extract_existing(metas) if op == "put" else {}
         return await maybe_await(buffer.recv_handshake(self.ctx, metas, existing, op))
 
+    def _entry_nbytes(self, key: str) -> int:
+        entry = getattr(self.store, "kv", {}).get(key)
+        if entry is None:
+            return 0
+        if entry.get("type") == "tensor":
+            return int(getattr(entry.get("tensor"), "nbytes", 0))
+        if entry.get("type") == "sharded":
+            return sum(
+                int(getattr(shard.get("tensor"), "nbytes", 0))
+                for shard in entry.get("shards", {}).values()
+            )
+        return 0
+
+    def _publish_residency(self) -> None:
+        _RESIDENT_BYTES.set(self._resident_bytes, volume=self.volume_id)
+        _ENTRIES.set(len(getattr(self.store, "kv", {})), volume=self.volume_id)
+
+    def _apply_residency_delta(self, keys, before: int) -> None:
+        after = sum(self._entry_nbytes(k) for k in keys)
+        self._resident_bytes += after - before
+        self._publish_residency()
+
     def _bump_write_gens(self, metas: list[Request]) -> dict[str, int]:
         import time
 
@@ -295,7 +340,11 @@ class StorageVolume(Actor):
         values = await maybe_await(
             buffer.handle_put_request(self.ctx, metas, existing)
         )
+        affected = {meta.key for meta in metas}
+        before = sum(self._entry_nbytes(k) for k in affected)
         self.store.store(metas, values)
+        self._apply_residency_delta(affected, before)
+        _PUT_OPS.inc(volume=self.volume_id)
         return {
             "reply": buffer.put_reply(),
             "write_gens": self._bump_write_gens(metas),
@@ -307,6 +356,7 @@ class StorageVolume(Actor):
     ) -> TransportBuffer:
         entries = [self.store.get_data(meta) for meta in metas]
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
+        _GET_OPS.inc(volume=self.volume_id)
         return buffer
 
     @endpoint
@@ -318,11 +368,13 @@ class StorageVolume(Actor):
         # Idempotent: missing keys ignored so cleanup retries are safe
         # (/root/reference/torchstore/api.py:308).
         deleted = 0
+        before = sum(self._entry_nbytes(k) for k in keys)
         for key in keys:
             if self.store.delete(key):
                 self.ctx.delete_key(key)
                 deleted += 1
             self._write_gens.pop(key, None)
+        self._apply_residency_delta(keys, before)
         return deleted
 
     @endpoint
@@ -342,6 +394,8 @@ class StorageVolume(Actor):
         removed: list[str] = []
         kept_fresh: list[str] = []
         kept_gens: dict[str, int] = {}
+        affected = [key for key, _ in items]
+        before = sum(self._entry_nbytes(k) for k in affected)
         for key, stale_gen in items:
             current = self._write_gens.get(key)
             if current is not None and current > stale_gen:
@@ -356,6 +410,7 @@ class StorageVolume(Actor):
                 self.ctx.delete_key(key)
                 removed.append(key)
             self._write_gens.pop(key, None)
+        self._apply_residency_delta(affected, before)
         return {
             "removed": removed,
             "kept_fresh": kept_fresh,
@@ -416,6 +471,10 @@ class StorageVolume(Actor):
             "volume_id": self.volume_id,
             "entries": entries,
             "stored_bytes": stored_bytes,
+            "tracked_generations": len(self._write_gens),
+            # This volume process's registry (process-local; the controller's
+            # stats(include_volumes=True) aggregates the fleet).
+            "metrics": obs_metrics.metrics_snapshot(),
         }
         from torchstore_tpu.transport.shared_memory import ShmServerCache
 
@@ -440,3 +499,5 @@ class StorageVolume(Actor):
         self.store.reset()
         self.ctx.clear()
         self._write_gens.clear()
+        self._resident_bytes = 0
+        self._publish_residency()

@@ -20,12 +20,15 @@ implementation.
 
 from __future__ import annotations
 
+import time
 from abc import ABC, abstractmethod
 from typing import TYPE_CHECKING, Any, Optional
 
 import numpy as np
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import tracing
 from torchstore_tpu.transport.types import Request
 from torchstore_tpu.utils import maybe_await
 
@@ -33,6 +36,23 @@ if TYPE_CHECKING:
     from torchstore_tpu.strategy import StorageVolumeRef
 
 logger = get_logger("torchstore_tpu.transport")
+
+# Per-transport data-plane instruments (client side — where the bytes are
+# handed to / received from the wire). Labeled by transport rung + op so one
+# snapshot answers "where did the bytes go".
+_OPS = obs_metrics.counter(
+    "ts_transport_ops_total", "Data-plane transfers by transport and op"
+)
+_BYTES = obs_metrics.counter(
+    "ts_transport_bytes_total",
+    "Logical payload bytes handed to / received from each transport",
+)
+_ERRORS = obs_metrics.counter(
+    "ts_transport_errors_total", "Failed transfers by transport and op"
+)
+_OP_SECONDS = obs_metrics.histogram(
+    "ts_transport_op_seconds", "Wall time of one transfer by transport and op"
+)
 
 # Data-plane RPCs carry (or wait on) tensor bytes: their deadline must scale
 # with payload size or a transfer slower than config.rpc_timeout spuriously
@@ -97,6 +117,8 @@ class TransportBuffer(ABC):
     """
 
     requires_handshake: bool = False
+    # Rung label for metrics/spans ("shm" | "bulk" | "rpc" | ...).
+    transport_name: str = "unknown"
     # Which ops actually need the handshake RPC; transports whose gets are
     # self-describing (SHM descriptors ride the get response) skip the extra
     # round trip by narrowing this to ("put",).
@@ -121,44 +143,76 @@ class TransportBuffer(ABC):
                     f"put of key {req.key!r} carries no tensor data "
                     "(Shard.data must not be None on puts)"
                 )
+        nbytes = sum(r.nbytes for r in requests)
+        t0 = time.perf_counter()
         try:
-            if self.requires_handshake and "put" in self.handshake_ops:
-                await self._perform_handshake(volume, requests, op="put")
-            await self._pre_put_hook(volume, requests)
-            metas = [r.meta_only() for r in requests]
-            nbytes = sum(r.nbytes for r in requests)
-            put = volume.actor.put
-            reply = await put.with_timeout(
-                transfer_timeout(put._effective_timeout(), nbytes)
-            ).call_one(self, metas)
-            if isinstance(reply, dict) and "write_gens" in reply:
-                self.write_gens = reply["write_gens"]
-                reply = reply["reply"]
-            self._handle_put_reply(volume, reply, requests)
-            self._post_request_success(volume)
+            with tracing.span(
+                "transport.put",
+                transport=self.transport_name,
+                volume=volume.volume_id,
+                keys=len(requests),
+                nbytes=nbytes,
+            ):
+                if self.requires_handshake and "put" in self.handshake_ops:
+                    await self._perform_handshake(volume, requests, op="put")
+                await self._pre_put_hook(volume, requests)
+                metas = [r.meta_only() for r in requests]
+                put = volume.actor.put
+                reply = await put.with_timeout(
+                    transfer_timeout(put._effective_timeout(), nbytes)
+                ).call_one(self, metas)
+                if isinstance(reply, dict) and "write_gens" in reply:
+                    self.write_gens = reply["write_gens"]
+                    reply = reply["reply"]
+                self._handle_put_reply(volume, reply, requests)
+                self._post_request_success(volume)
+            _OPS.inc(transport=self.transport_name, op="put")
+            _BYTES.inc(nbytes, transport=self.transport_name, op="put")
+            _OP_SECONDS.observe(
+                time.perf_counter() - t0, transport=self.transport_name, op="put"
+            )
+        except BaseException:
+            _ERRORS.inc(transport=self.transport_name, op="put")
+            raise
         finally:
             self.drop()
 
     async def get_from_storage_volume(
         self, volume: "StorageVolumeRef", requests: list[Request]
     ) -> list[np.ndarray]:
+        t0 = time.perf_counter()
         try:
-            if self.requires_handshake and "get" in self.handshake_ops:
-                await self._perform_handshake(volume, requests, op="get")
-            await self._pre_get_hook(volume, requests)
-            metas = [r.meta_only() for r in requests]
-            nbytes = sum(
-                m.tensor_meta.nbytes for m in metas if m.tensor_meta is not None
+            with tracing.span(
+                "transport.get",
+                transport=self.transport_name,
+                volume=volume.volume_id,
+                keys=len(requests),
+            ) as sp:
+                if self.requires_handshake and "get" in self.handshake_ops:
+                    await self._perform_handshake(volume, requests, op="get")
+                await self._pre_get_hook(volume, requests)
+                metas = [r.meta_only() for r in requests]
+                nbytes = sum(
+                    m.tensor_meta.nbytes for m in metas if m.tensor_meta is not None
+                )
+                sp.set(nbytes=nbytes)
+                get = volume.actor.get
+                remote = await get.with_timeout(
+                    transfer_timeout(get._effective_timeout(), nbytes)
+                ).call_one(self, metas)
+                results = await maybe_await(
+                    self._handle_storage_volume_response(volume, remote, requests)
+                )
+                self._post_request_success(volume)
+            _OPS.inc(transport=self.transport_name, op="get")
+            _BYTES.inc(nbytes, transport=self.transport_name, op="get")
+            _OP_SECONDS.observe(
+                time.perf_counter() - t0, transport=self.transport_name, op="get"
             )
-            get = volume.actor.get
-            remote = await get.with_timeout(
-                transfer_timeout(get._effective_timeout(), nbytes)
-            ).call_one(self, metas)
-            results = await maybe_await(
-                self._handle_storage_volume_response(volume, remote, requests)
-            )
-            self._post_request_success(volume)
             return results
+        except BaseException:
+            _ERRORS.inc(transport=self.transport_name, op="get")
+            raise
         finally:
             self.drop()
 

@@ -41,6 +41,7 @@ import numpy as np
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -71,6 +72,14 @@ _STRIPE = struct.Struct("<IQQ")  # real_idx, offset, total_nbytes
 # Payloads above this are striped across STRIPE_CONNS connections.
 STRIPE_THRESHOLD = 64 * 1024 * 1024
 STRIPE_CONNS = 4
+
+_DIALS = obs_metrics.counter(
+    "ts_bulk_dials_total", "Bulk TCP connections dialed (main + stripe)"
+)
+_STRIPED = obs_metrics.counter(
+    "ts_bulk_striped_transfers_total",
+    "Payloads striped across parallel connections, by direction",
+)
 
 # Volume-side session state (landed put bytes, abort markers) is purged after
 # this long without the matching RPC arriving — a crashed client must not
@@ -421,12 +430,17 @@ class BulkServer:
             return task
 
         async def _send_plain(sock, lock, frames: list[tuple[int, np.ndarray]]):
+            async def _send_all() -> None:
+                for idx, arr in frames:
+                    view = memoryview(np.ascontiguousarray(arr)).cast("B")
+                    await _send_frame(sock, lock, session, idx, view)
+
             try:
-                async with asyncio.timeout(SESSION_TTL_S):
-                    for idx, arr in frames:
-                        view = memoryview(np.ascontiguousarray(arr)).cast("B")
-                        await _send_frame(sock, lock, session, idx, view)
-            except TimeoutError:
+                # asyncio.wait_for, not asyncio.timeout: this image runs
+                # Python 3.10 (asyncio.timeout landed in 3.11) and the
+                # AttributeError was killing every bulk get send.
+                await asyncio.wait_for(_send_all(), timeout=SESSION_TTL_S)
+            except (TimeoutError, asyncio.TimeoutError):
                 # The cancelled sendall may have left a PARTIAL frame on the
                 # wire — the connection's framing is unrecoverable; kill it
                 # (the reader task then joins sends and closes).
@@ -441,15 +455,17 @@ class BulkServer:
                 logger.exception("bulk get send failed (session=%s)", session)
 
         async def _send_stripes(sock, lock, idx, view, ranges, total):
+            async def _send_all() -> None:
+                for off, end in ranges:
+                    sub = _STRIPE.pack(idx, off, total)
+                    async with lock:
+                        await _send_frame_raw(
+                            sock, session, IDX_STRIPED, sub, view[off:end]
+                        )
+
             try:
-                async with asyncio.timeout(SESSION_TTL_S):
-                    for off, end in ranges:
-                        sub = _STRIPE.pack(idx, off, total)
-                        async with lock:
-                            await _send_frame_raw(
-                                sock, session, IDX_STRIPED, sub, view[off:end]
-                            )
-            except TimeoutError:
+                await asyncio.wait_for(_send_all(), timeout=SESSION_TTL_S)
+            except (TimeoutError, asyncio.TimeoutError):
                 logger.warning(
                     "bulk striped send timed out (session=%s); closing",
                     session,
@@ -631,6 +647,7 @@ async def _dial(host: str, port: int, timeout: float) -> socket.socket:
     except BaseException:
         _close_sock(sock)
         raise
+    _DIALS.inc()
     return sock
 
 
@@ -687,6 +704,7 @@ class BulkClientCache(TransportCache):
 
 
 class BulkTransportBuffer(TransportBuffer):
+    transport_name = "bulk"
     requires_handshake = True  # dynamically skipped when a promoted conn exists
     supports_inplace = True
     requires_contiguous_inplace = False
@@ -888,6 +906,7 @@ class BulkTransportBuffer(TransportBuffer):
         """Split one payload into contiguous chunks round-robined over the
         connections; each chunk frame carries (idx, offset, total) so the
         volume reassembles order-independently."""
+        _STRIPED.inc(direction="put")
         total = view.nbytes
         n = len(conns)
         chunk = -(-total // n)

@@ -14,7 +14,16 @@ from typing import Optional
 
 import numpy as np
 
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.transport.buffers import TransportCache
+
+_REGISTRATIONS = obs_metrics.counter(
+    "ts_buffer_registrations_total",
+    "Buffer registrations by outcome (new / cache_hit)",
+)
+_REGISTERED_LIVE = obs_metrics.gauge(
+    "ts_buffer_registrations_live", "Currently registered buffers"
+)
 
 
 class ArrayRegistration:
@@ -50,11 +59,14 @@ class ArrayRegistrationCache(TransportCache):
         key = (array.__array_interface__["data"][0], array.nbytes)
         entry = self._entries.get(key)
         if entry is not None:
+            _REGISTRATIONS.inc(outcome="cache_hit")
             return entry
         entry = ArrayRegistration(array)
         while len(self._entries) >= self.maxsize:
             self._evict(next(iter(self._entries)))
         self._entries[key] = entry
+        _REGISTRATIONS.inc(outcome="new")
+        _REGISTERED_LIVE.set(len(self._entries))
         owner = array.base if array.base is not None else array
         try:
             self._finalizers[key] = weakref.finalize(owner, self._evict, key)
@@ -71,6 +83,7 @@ class ArrayRegistrationCache(TransportCache):
         entry = self._entries.pop(key, None)
         if entry is not None:
             entry.release()
+            _REGISTERED_LIVE.set(len(self._entries))
         fin = self._finalizers.pop(key, None)
         if fin is not None:
             fin.detach()

@@ -44,8 +44,29 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import tracing
 
 logger = get_logger("torchstore_tpu.transport.ici")
+
+_STAGED = obs_metrics.counter(
+    "ts_device_staged_total", "Device arrays staged for one-shot remote pulls"
+)
+_PULL_OPS = obs_metrics.counter(
+    "ts_device_pull_ops_total", "Device-to-device pulls through the ICI rung"
+)
+# Same instruments the host transports feed (transport/buffers.py) — the
+# ICI rung reports under transport="ici" so one query covers every rung.
+_OPS = obs_metrics.counter(
+    "ts_transport_ops_total", "Data-plane transfers by transport and op"
+)
+_PULL_BYTES = obs_metrics.counter(
+    "ts_transport_bytes_total",
+    "Logical payload bytes handed to / received from each transport",
+)
+_OP_SECONDS = obs_metrics.histogram(
+    "ts_transport_op_seconds", "Wall time of one transfer by transport and op"
+)
 
 
 def is_available() -> bool:
@@ -198,6 +219,7 @@ class DeviceTransferEngine:
         self._next_uuid += 1
         uid = self._next_uuid
         self._server.await_pull(uid, list(arrays))
+        _STAGED.inc(len(arrays))
         return uid
 
     def pull(self, address: str, uid: int, specs: list[DeviceSpec]) -> list:
@@ -213,7 +235,27 @@ class DeviceTransferEngine:
         if conn is None:
             conn = self._server.connect(address)
             self._conns[address] = conn
-        return conn.pull(uid, jax_specs)
+        import time
+
+        import numpy as np
+
+        nbytes = sum(
+            int(np.prod(s.shape)) * s.dtype.itemsize for s in jax_specs
+        )
+        t0 = time.perf_counter()
+        with tracing.span(
+            "transport.pull_device",
+            transport="ici",
+            peer=address,
+            arrays=len(jax_specs),
+            nbytes=nbytes,
+        ):
+            out = conn.pull(uid, jax_specs)
+        _PULL_OPS.inc()
+        _OPS.inc(transport="ici", op="get")
+        _PULL_BYTES.inc(nbytes, transport="ici", op="get")
+        _OP_SECONDS.observe(time.perf_counter() - t0, transport="ici", op="get")
+        return out
 
     def reset(self) -> None:
         """Drop connections (tests); the server itself is process-lifetime."""

@@ -22,6 +22,7 @@ from torchstore_tpu.transport.types import Request
 
 
 class RPCTransportBuffer(TransportBuffer):
+    transport_name = "rpc"
     requires_handshake = False
     supports_inplace = True
     requires_contiguous_inplace = False

@@ -47,6 +47,7 @@ import numpy as np
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -55,6 +56,36 @@ from torchstore_tpu.transport.buffers import (
 from torchstore_tpu.transport.types import Request, TensorMeta
 
 logger = get_logger("torchstore_tpu.transport.shm")
+
+# Segment-pool economics. Offer hits/misses are counted where the decision
+# is made: the server's handshake (volume process) and the client's
+# post-handshake landing (client process) each see their own side.
+_POOL_OFFERS = obs_metrics.counter(
+    "ts_shm_pool_offers_total",
+    "Put-handshake segment offers by outcome (spare/pooled/miss)",
+)
+_SEGMENTS_CREATED = obs_metrics.counter(
+    "ts_shm_segments_created_total", "Fresh /dev/shm segments created"
+)
+_SEGMENTS_RECYCLED = obs_metrics.counter(
+    "ts_shm_segments_recycled_total", "Segments drawn from the warm free pool"
+)
+_SEGMENTS_REAPED = obs_metrics.counter(
+    "ts_shm_segments_reaped_total", "Segments unlinked by TTL sweep, by kind"
+)
+_CLIENT_ATTACH = obs_metrics.counter(
+    "ts_shm_client_attach_total",
+    "Client-side segment handling on put (offer_hit / cold_create)",
+)
+_POOL_BYTES = obs_metrics.gauge(
+    "ts_shm_pool_bytes", "Bytes held in the volume's warm free pool"
+)
+_RETIRED_SEGMENTS = obs_metrics.gauge(
+    "ts_shm_retired_segments", "Viewed-then-replaced segments awaiting release"
+)
+_RESERVED_SEGMENTS = obs_metrics.gauge(
+    "ts_shm_reserved_segments", "Handshake-offered segments awaiting their put"
+)
 
 SHM_DIR = "/dev/shm"
 
@@ -156,6 +187,7 @@ class ShmSegment:
             mm = mmap.mmap(fd, size, flags=mmap.MAP_SHARED | cls._POPULATE)
         finally:
             os.close(fd)
+        _SEGMENTS_CREATED.inc()
         return cls(name, size, mm, owner=True)
 
     @classmethod
@@ -314,6 +346,7 @@ class ShmServerCache(TransportCache):
             if now - ts > STAGED_TTL_S:
                 seg.unlink()  # no-op if the client already unlinked it
                 del self.staged[name]
+                _SEGMENTS_REAPED.inc(kind="staged")
         for name, (seg, ts) in list(self.retired.items()):
             if now - ts > RETIRED_TTL_S:
                 # Client never released (likely crashed). Live readers keep
@@ -321,6 +354,7 @@ class ShmServerCache(TransportCache):
                 seg.unlink()
                 del self.retired[name]
                 self.grants.pop(name, None)
+                _SEGMENTS_REAPED.inc(kind="retired")
         for name, (seg, ts) in list(self.reserved.items()):
             if now - ts > RESERVED_TTL_S:
                 # The reserving put never arrived (client crashed or is
@@ -330,6 +364,22 @@ class ShmServerCache(TransportCache):
                 # then fails cleanly on attach instead of corrupting data.
                 del self.reserved[name]
                 seg.unlink()
+                _SEGMENTS_REAPED.inc(kind="reserved")
+                # A reaped spare's name must leave spare_by_size too: the
+                # stale name was only discarded lazily when a handshake for
+                # that exact size popped it, so under many distinct sizes
+                # the lists grew without bound (ADVICE r4).
+                names = self.spare_by_size.get(seg.size)
+                if names is not None:
+                    try:
+                        names.remove(name)
+                    except ValueError:
+                        pass
+                    if not names:
+                        del self.spare_by_size[seg.size]
+        _POOL_BYTES.set(self.free_bytes)
+        _RETIRED_SEGMENTS.set(len(self.retired))
+        _RESERVED_SEGMENTS.set(len(self.reserved))
 
     # ---- leases ----------------------------------------------------------
 
@@ -484,6 +534,7 @@ class ShmServerCache(TransportCache):
         seg = segs.pop()
         self.free_bytes -= seg.size
         self.free_order = [(n, t) for n, t in self.free_order if n != seg.name]
+        _SEGMENTS_RECYCLED.inc()
         return seg
 
     # ---- entries ---------------------------------------------------------
@@ -597,6 +648,23 @@ class ShmClientCache(TransportCache):
         self.seg_volume[desc.segment_name] = volume_id
         return seg
 
+    def evict_stale_pre_attached(self) -> None:
+        """Evict pre-attached spares that were never offered within the
+        server's reserved TTL: the server has unlinked them by now, and only
+        this mapping keeps their tmpfs pages alive. Called from EVERY cache
+        entry point that observes traffic (pre_attach AND the per-RPC
+        collect_released), not just pre_attach — a client whose puts stop
+        missing the pool stops receiving spare announcements, and its stale
+        mappings would otherwise pin tmpfs pages for the process lifetime
+        (ADVICE carried fix)."""
+        cutoff = time.monotonic() - RESERVED_TTL_S
+        for name, ts in list(self._pre_attached.items()):
+            if ts < cutoff:
+                del self._pre_attached[name]
+                seg = self.segments.pop(name, None)
+                if seg is not None:
+                    seg.close()
+
     def pre_attach(self, spares: list[tuple[str, int]]) -> None:
         """Background-attach server-announced warm spares so the NEXT
         handshake's offers of these names hit the attachment cache — the
@@ -610,16 +678,7 @@ class ShmClientCache(TransportCache):
         except RuntimeError:
             return
 
-        # Evict pre-attached spares that were never offered within the
-        # server's reserved TTL: the server has unlinked them by now, and
-        # only this mapping keeps their tmpfs pages alive.
-        cutoff = time.monotonic() - RESERVED_TTL_S
-        for name, ts in list(self._pre_attached.items()):
-            if ts < cutoff:
-                del self._pre_attached[name]
-                seg = self.segments.pop(name, None)
-                if seg is not None:
-                    seg.close()
+        self.evict_stale_pre_attached()
 
         async def one(name: str, size: int) -> None:
             if name in self.segments:
@@ -673,6 +732,7 @@ class ShmClientCache(TransportCache):
     def collect_released(self, volume_id: str) -> Optional[dict]:
         """Release payload for ``volume_id``: all unacked batches (including
         a fresh one from views dropped since the last RPC), or None."""
+        self.evict_stale_pre_attached()
         for name, refs in list(self.view_refs.items()):
             live = [r for r in refs if r() is not None]
             dead = len(refs) - len(live)
@@ -726,6 +786,7 @@ class ShmClientCache(TransportCache):
 
 
 class SharedMemoryTransportBuffer(TransportBuffer):
+    transport_name = "shm"
     requires_handshake = True
     # Gets are self-describing (descriptors ride the get response) — no
     # handshake round trip on the read path.
@@ -810,7 +871,9 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             desc = offered.get(idx)
             if desc is not None and desc.meta == meta:
                 seg = cache.attach(desc, req.key, volume.volume_id)
+                _CLIENT_ATTACH.inc(outcome="offer_hit")
             else:
+                _CLIENT_ATTACH.inc(outcome="cold_create")
                 seg = ShmSegment.create(max(arr.nbytes, 1))
                 desc = ShmDescriptor(seg.name, seg.size, meta)
                 cache.segments[seg.name] = seg
@@ -880,16 +943,20 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                     spare = entry[0]
                     break
             if spare is not None:
+                _POOL_OFFERS.inc(outcome="spare")
                 offered[idx] = ShmDescriptor(
                     spare.name, spare.size, meta.tensor_meta
                 )
                 continue
             pooled = cache.take_free(size)
             if pooled is not None:
+                _POOL_OFFERS.inc(outcome="pooled")
                 cache.reserved[pooled.name] = (pooled, time.monotonic())
                 offered[idx] = ShmDescriptor(
                     pooled.name, pooled.size, meta.tensor_meta
                 )
+            else:
+                _POOL_OFFERS.inc(outcome="miss")
         misses = [
             max(meta.tensor_meta.nbytes, 1)
             for idx, meta in enumerate(metas)

@@ -26,9 +26,33 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.state_dict_utils import NoMatchingPush
 
 logger = get_logger("torchstore_tpu.weight_channel")
+
+# Publisher side and subscriber side each run in their own process; gauges
+# are labeled by channel so one scrape of both processes yields the
+# publish→subscribe version lag (published_version - acquired_version).
+_PUBLISHES = obs_metrics.counter(
+    "ts_weight_channel_publishes_total", "Versions published, per channel"
+)
+_PUBLISHED_VERSION = obs_metrics.gauge(
+    "ts_weight_channel_published_version", "Latest version published"
+)
+_ACQUIRED_VERSION = obs_metrics.gauge(
+    "ts_weight_channel_acquired_version", "Latest version a subscriber pulled"
+)
+_VERSION_LAG = obs_metrics.gauge(
+    "ts_weight_channel_version_lag",
+    "Versions between the channel pointer and what this subscriber last "
+    "acquired, measured at wakeup (0 = consuming every publish)",
+)
+_SKIPPED = obs_metrics.counter(
+    "ts_weight_channel_versions_skipped_total",
+    "Published versions a subscriber never pulled (lagged past)",
+)
 
 _LATEST = "LATEST"
 
@@ -112,17 +136,25 @@ class WeightPublisher:
         data_key = (
             f"{self.name}/direct" if direct else _version_key(self.name, version)
         )
-        await state_dict_utils.put_state_dict(
-            client,
-            data_key,
-            state_dict,
-            transfer_dtype=transfer_dtype,
-            transfer_quant=transfer_quant,
+        with span(
+            "weight_channel.publish",
+            channel=self.name,
+            version=version,
             direct=direct,
-        )
-        # Pointer write LAST: subscribers woken by it see a committed dict.
-        await client.put(f"{self.name}/{_LATEST}", (version, self._epoch))
+        ):
+            await state_dict_utils.put_state_dict(
+                client,
+                data_key,
+                state_dict,
+                transfer_dtype=transfer_dtype,
+                transfer_quant=transfer_quant,
+                direct=direct,
+            )
+            # Pointer write LAST: subscribers woken by it see a committed dict.
+            await client.put(f"{self.name}/{_LATEST}", (version, self._epoch))
         self._next_version = version + 1
+        _PUBLISHES.inc(channel=self.name)
+        _PUBLISHED_VERSION.set(version, channel=self.name)
         if not direct:
             await self._gc(client, version)
         return version
@@ -225,13 +257,31 @@ class WeightSubscriber:
                     if direct
                     else _version_key(self.name, version)
                 )
-                sd = await state_dict_utils.get_state_dict(
-                    client,
-                    data_key,
-                    user_state_dict=user_state_dict,
+                # Lag at wakeup: versions published since this subscriber's
+                # last acquire that it will never pull (same epoch only — a
+                # recreated channel restarts numbering). Consuming every
+                # publish means waking at last_version + 1, i.e. lag 0.
+                if (
+                    self.last_version is not None
+                    and epoch == self._last_epoch
+                ):
+                    skipped = version - self.last_version - 1
+                    _VERSION_LAG.set(max(0, skipped), channel=self.name)
+                    if skipped > 0:
+                        _SKIPPED.inc(skipped, channel=self.name)
+                with span(
+                    "weight_channel.acquire",
+                    channel=self.name,
+                    version=version,
                     direct=direct,
-                    strict=strict,
-                )
+                ):
+                    sd = await state_dict_utils.get_state_dict(
+                        client,
+                        data_key,
+                        user_state_dict=user_state_dict,
+                        direct=direct,
+                        strict=strict,
+                    )
             except (NoMatchingPush, KeyError):
                 # The pointer or version vanished between wakeup and pull
                 # (channel deleted, or we lagged > keep versions behind);
@@ -245,4 +295,5 @@ class WeightSubscriber:
                 continue
             self.last_version = version
             self._last_epoch = epoch
+            _ACQUIRED_VERSION.set(version, channel=self.name)
             return sd, version
