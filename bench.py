@@ -14,7 +14,9 @@ would benchmark the tunnel, not the framework. The store's TPU coupling
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "host_memcpy_gbps", "calib_ratio", "sections", "p50_put_ms", "p50_get_ms",
-"metrics"}. ``vs_baseline`` is value / (REFERENCE_GBPS * calib_ratio):
+"metrics", "fleet"}. ``fleet`` is the run's merged, process-labeled fleet
+registry (``ts.fleet_snapshot()``: client + controller + every volume
+process, plus per-process hot keys). ``vs_baseline`` is value / (REFERENCE_GBPS * calib_ratio):
 REFERENCE_GBPS approximates the reference's CUDA+RDMA same-host weight-sync
 path (no number is published by the reference — see BASELINE.md; 10 GB/s is
 the proxy the north star's ">=80% of the CUDA+RDMA path" is scored against),
@@ -98,8 +100,10 @@ async def _device_section_child() -> int:
         # hangs indefinitely when the tunnel is down (the exact failure
         # this child's subprocess isolation exists for).
         jax.config.update("jax_platforms", "cpu")
+    from torchstore_tpu.utils import is_device_platform
+
     devs = jax.devices()
-    if devs[0].platform not in ("tpu", "axon") and not allow_cpu:
+    if not is_device_platform(devs[0].platform) and not allow_cpu:
         print(f"# device section: no TPU (platform={devs[0].platform})")
         return 3
     dev = devs[0]
@@ -427,8 +431,12 @@ async def run(
     # The observability registry IS the bench's emission path now: grab the
     # snapshot BEFORE shutdown (teardown resets volume gauges) so the
     # machine-readable record carries the per-transport byte counters and
-    # op histograms of exactly this run.
+    # op histograms of exactly this run. The fleet snapshot additionally
+    # scrapes the controller's and every volume PROCESS's registry (merged,
+    # process-labeled — PR 2), so the record shows both sides of every
+    # transfer, not just the client's.
     metrics = ts.metrics_snapshot()
+    fleet = await ts.fleet_snapshot(store_name="bench")
     await ts.shutdown("bench")
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
@@ -456,6 +464,7 @@ async def run(
         "p50_put_ms": round(p50p, 3),
         "p50_get_ms": round(p50g, 3),
         "metrics": metrics,
+        "fleet": fleet,
     }
 
 

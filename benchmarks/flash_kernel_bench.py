@@ -14,9 +14,14 @@ FLOP accounting matches benchmarks/ring_attention_bench.py: 2 matmuls of
 Pass ``--peak-tflops`` (the chip's bf16 peak) to get an MFU%% column.
 
 Run on hardware:  python benchmarks/flash_kernel_bench.py
-CPU validation:   JAX_PLATFORMS=cpu python benchmarks/flash_kernel_bench.py --iters 2
+CPU validation:   JAX_PLATFORMS=cpu python benchmarks/flash_kernel_bench.py \
+                      --iters 2 --allow-interpret
 (interpret-mode pallas on CPU is orders of magnitude slower — validation
-checks the harness, not the numbers).
+checks the harness, not the numbers). Without a real device (platform
+'tpu'/'axon' — the shared ``torchstore_tpu.utils.is_device_platform``
+check, so the axon tunnel counts as hardware), the bench warns loudly and
+exits nonzero unless ``--allow-interpret`` is passed: interpret-mode
+TFLOP/s rows must never be mistaken for hardware numbers (ADVICE r5).
 """
 
 import argparse
@@ -41,6 +46,12 @@ def main() -> None:
         default=None,
         help="chip bf16 peak for an MFU%% column (e.g. 197 for v5e)",
     )
+    ap.add_argument(
+        "--allow-interpret",
+        action="store_true",
+        help="proceed on CPU (pallas interpret mode) instead of exiting "
+        "nonzero — harness validation only, the numbers are meaningless",
+    )
     args = ap.parse_args()
 
     import os
@@ -58,9 +69,30 @@ def main() -> None:
         flash_attention_stats,
     )
 
+    from torchstore_tpu.utils import is_device_platform
+
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    on_device = is_device_platform(dev.platform)
+    if not on_device:
+        print(
+            "#" * 72
+            + f"\n# WARNING: no TPU (platform={dev.platform!r}) — pallas "
+            "kernels would run\n# in INTERPRET mode; TFLOP/s rows would be "
+            "meaningless as hardware numbers."
+            + (
+                "\n# Proceeding because --allow-interpret was passed "
+                "(harness validation)."
+                if args.allow_interpret
+                else "\n# Refusing to emit them; pass --allow-interpret to "
+                "validate the harness."
+            )
+            + "\n"
+            + "#" * 72,
+            file=sys.stderr,
+        )
+        if not args.allow_interpret:
+            sys.exit(2)
+    dtype = jnp.bfloat16 if on_device else jnp.float32
     b, s, h, d = args.batch, args.seq, args.heads, args.head_dim
     keys = jax.random.split(jax.random.key(0), 3)
     q = jax.random.normal(keys[0], (b, s, h, d), dtype)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Merge per-process torchstore Chrome-trace files into one timeline.
+
+Every torchstore process writes its own trace file when
+``TORCHSTORE_TPU_TRACE=/path/trace.json`` is set (the base path, claimed by
+the first process to flush, plus ``trace.<pid>.json`` siblings). This tool
+stitches them into ONE Perfetto-loadable file with labeled process tracks;
+the cross-process ``trace_id`` args (propagated over the actor RPC layer)
+let you follow a single put from the client span through the controller
+notify to every volume write.
+
+Usage:
+    python scripts/merge_traces.py /tmp/run/trace.json
+    python scripts/merge_traces.py /tmp/run/trace.json -o merged.json
+    python scripts/merge_traces.py a.json b.json c.json -o merged.json
+
+With one argument the base path's whole sibling set is discovered; with
+several, exactly those files are merged. In-process equivalent:
+``ts.collect_trace()``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchstore_tpu.observability.tracing import merge_traces, trace_files
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process torchstore trace files"
+    )
+    ap.add_argument(
+        "paths",
+        nargs="+",
+        help="one TORCHSTORE_TPU_TRACE base path (siblings auto-discovered) "
+        "or an explicit list of trace files",
+    )
+    ap.add_argument(
+        "-o",
+        "--out",
+        default=None,
+        help="output path (default: <root>.merged<ext> of the first input)",
+    )
+    args = ap.parse_args()
+
+    if len(args.paths) == 1:
+        files = trace_files(args.paths[0])
+        if not files:
+            print(f"no trace files found for base {args.paths[0]!r}", file=sys.stderr)
+            return 1
+    else:
+        files = args.paths
+        missing = [p for p in files if not os.path.exists(p)]
+        if missing:
+            print(f"missing trace files: {missing}", file=sys.stderr)
+            return 1
+    out = args.out
+    if out is None:
+        root, ext = os.path.splitext(args.paths[0])
+        out = f"{root}.merged{ext or '.json'}"
+    result = merge_traces(files, out)
+    print(
+        f"merged {result['events']} events from {len(result['files'])} "
+        f"file(s) -> {result['path']} "
+        f"({len(result['trace_ids'])} distinct trace ids)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,19 +11,49 @@ set -u
 cd "$(dirname "$0")/.."
 OUTDIR=${OUTDIR:-/tmp/tpu_capture}
 INTERVAL=${INTERVAL:-300}
+METRICS_PORT=${METRICS_PORT:-8377}
 mkdir -p "$OUTDIR"
 
 while true; do
     echo "[$(date +%H:%M:%S)] probing tpu tunnel..."
-    if timeout 90 python -c "import jax; d = jax.devices()[0]; assert d.platform in ('tpu', 'axon'), d.platform; print('platform', d.platform, d.device_kind)"; then
+    # The probe shares torchstore_tpu.utils.is_device_platform with
+    # bench.py / flash_kernel_bench.py, so 'tpu' and tunneled 'axon'
+    # devices pass and nothing else does.
+    if timeout 90 python -c "import jax; from torchstore_tpu.utils import is_device_platform; d = jax.devices()[0]; assert is_device_platform(d.platform), d.platform; print('platform', d.platform, d.device_kind)"; then
         echo "[$(date +%H:%M:%S)] TUNNEL UP — capturing"
-        # Capture the observability registry alongside the bench output:
-        # every process in the run dumps its counters (per-transport bytes,
-        # ICI pull ops, ...) into OUTDIR as pid-claimed JSON files.
+        # Capture the full observability plane alongside the bench output:
+        # per-process metrics dumps (pid-claimed JSON), a distributed trace
+        # merged into one Perfetto timeline, and a LIVE /metrics scrape of
+        # the run through the HTTP exporter while it executes. Stale trace
+        # files AND the .owner claim sidecar from a previous capture in
+        # this OUTDIR must not pollute the merge or divert the new run's
+        # claim arbitration.
+        rm -f "$OUTDIR"/device_trace*
         timeout 400 env TORCHSTORE_TPU_METRICS_DUMP="$OUTDIR/device_metrics.json" \
+            TORCHSTORE_TPU_TRACE="$OUTDIR/device_trace.json" \
+            TORCHSTORE_TPU_METRICS_PORT="$METRICS_PORT" \
             python bench.py --device-section \
-            >"$OUTDIR/device_section.out" 2>&1
+            >"$OUTDIR/device_section.out" 2>&1 &
+        BENCH_PID=$!
+        # Poll the live endpoint until the run answers (or exits): proof
+        # the scrape path works on hardware, and a mid-run counter snapshot.
+        for _ in $(seq 1 60); do
+            if curl -sf "http://127.0.0.1:$METRICS_PORT/metrics" \
+                >"$OUTDIR/live_metrics.prom" 2>/dev/null; then
+                curl -sf "http://127.0.0.1:$METRICS_PORT/healthz" \
+                    >"$OUTDIR/live_healthz.json" 2>/dev/null || true
+                echo "live /metrics scraped mid-run"
+                break
+            fi
+            kill -0 "$BENCH_PID" 2>/dev/null || break
+            sleep 2
+        done
+        wait "$BENCH_PID"
         echo "device section exit: $?"
+        # Stitch every process's trace file into one timeline.
+        python scripts/merge_traces.py "$OUTDIR/device_trace.json" \
+            -o "$OUTDIR/device_trace.merged.json" \
+            && echo "merged trace -> $OUTDIR/device_trace.merged.json"
         timeout 600 python benchmarks/flash_kernel_bench.py \
             >"$OUTDIR/flash_kernel.out" 2>&1
         echo "flash kernel exit: $?"

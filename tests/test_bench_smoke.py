@@ -49,6 +49,19 @@ async def test_bench_run_tiny(capsys):
     )
     assert put_bytes >= 2 * 0.0625 * 1024 * 1024
 
+    # The merged fleet snapshot rides the record too: process-labeled
+    # series covering the controller and the volume, no scrape errors.
+    fleet = result["fleet"]
+    assert fleet["errors"] == {}
+    procs = {p["process"] for p in fleet["processes"]}
+    assert {"client", "controller", "volume"} <= procs
+    vol_puts = [
+        s
+        for s in fleet["metrics"]["ts_volume_put_ops_total"]["series"]
+        if s["labels"].get("process") == "volume"
+    ]
+    assert vol_puts and sum(s["value"] for s in vol_puts) > 0
+
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
     json.dumps(result)

@@ -1,0 +1,97 @@
+"""Cross-address DCN smoke test (VERDICT r5 #5): the whole stack must work
+when nothing listens on 127.0.0.1 — controller/volume actors and the bulk
+data plane bound to 127.0.0.2 (and a second store on 127.0.0.3), with the
+client dialing across addresses. Any hardcoded 127.0.0.1 in the actor
+server, bulk listener, or client dial path fails this test. Also asserts
+the propagated trace id survives the cross-address hop (PR 2)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.observability import tracing
+
+
+@pytest.mark.anyio
+async def test_cross_address_fleet(tmp_path, monkeypatch):
+    import torchstore_tpu as ts
+
+    base = str(tmp_path / "trace.json")
+    monkeypatch.setenv("TORCHSTORE_TPU_TRACE", base)
+    collector = tracing.collector()
+    old_path = collector.path
+    collector.path = base
+
+    # Fleet A (controller + volume + bulk listener) on 127.0.0.2, forced
+    # onto the bulk transport so its dedicated data-plane sockets bind the
+    # non-default address too.
+    monkeypatch.setenv("TORCHSTORE_TPU_BIND_HOST", "127.0.0.2")
+    try:
+        await ts.initialize(
+            store_name="xaddr_a",
+            strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+        )
+        # Fleet B on 127.0.0.3 (default transport ladder).
+        monkeypatch.setenv("TORCHSTORE_TPU_BIND_HOST", "127.0.0.3")
+        await ts.initialize(store_name="xaddr_b")
+        try:
+            # Nothing in either fleet advertises loopback-default addresses.
+            for store, want in (("xaddr_a", "127.0.0.2"), ("xaddr_b", "127.0.0.3")):
+                c = ts.client(store)
+                assert c.controller.host == want, (store, c.controller.host)
+                vmap = await c.controller.get_volume_map.call_one()
+                for vid, info in vmap.items():
+                    assert info["ref"].host == want, (store, vid, info["ref"].host)
+
+            # Small put/get + a bulk transfer (multi-MB payload over the
+            # dedicated bulk sockets) across the 127.0.0.2 hop.
+            small = np.arange(256, dtype=np.float32)
+            await ts.put("x/small", small, store_name="xaddr_a")
+            np.testing.assert_array_equal(
+                np.asarray(await ts.get("x/small", store_name="xaddr_a")), small
+            )
+            bulk = np.random.default_rng(0).standard_normal(
+                (512, 1024)
+            ).astype(np.float32)  # 2 MiB
+            await ts.put("x/bulk", bulk, store_name="xaddr_a")
+            got = await ts.get("x/bulk", store_name="xaddr_a")
+            np.testing.assert_array_equal(np.asarray(got), bulk)
+            del got
+
+            # Cross-store relay: read from the .2 fleet, write to the .3
+            # fleet — one client talking to both addresses in one process.
+            relay = await ts.get("x/small", store_name="xaddr_a")
+            await ts.put("x/relay", np.asarray(relay), store_name="xaddr_b")
+            np.testing.assert_array_equal(
+                np.asarray(await ts.get("x/relay", store_name="xaddr_b")), small
+            )
+            del relay
+        finally:
+            await ts.shutdown("xaddr_b")
+            await ts.shutdown("xaddr_a")
+        merged = ts.collect_trace(str(tmp_path / "merged.json"))
+    finally:
+        collector.flush()
+        collector.path = old_path
+
+    # The trace id minted client-side survived the cross-address RPC hop:
+    # the bulk put's span and a remote process's rpc span share it.
+    events = json.load(open(merged["path"]))
+    spans = [e for e in events if e.get("ph") == "X"]
+    put_spans = [
+        e
+        for e in spans
+        if e["name"] == "put_batch" and "trace_id" in (e.get("args") or {})
+    ]
+    assert put_spans
+    stitched = 0
+    for put_span in put_spans:
+        tid = put_span["args"]["trace_id"]
+        pids = {
+            e["pid"]
+            for e in spans
+            if (e.get("args") or {}).get("trace_id") == tid
+        }
+        stitched += len(pids) >= 2
+    assert stitched >= 1, "no trace id crossed the 127.0.0.2/127.0.0.3 hop"

@@ -1,0 +1,432 @@
+"""The distributed observability plane (PR 2): trace-context propagation
+across real actor processes, fleet metrics aggregation, the live HTTP
+exporter, and the hot-key/slow-op profiler."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.observability import aggregate
+from torchstore_tpu.observability import context as obs_context
+from torchstore_tpu.observability import http_exporter
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import profile as obs_profile
+from torchstore_tpu.observability import tracing
+
+
+# --------------------------------------------------------------------------
+# trace context (in-process semantics)
+# --------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_no_context_by_default(self):
+        assert obs_context.current() is None
+
+    def test_ensure_root_creates_and_restores(self):
+        with obs_context.ensure_root():
+            ctx = obs_context.current()
+            assert ctx is not None and ctx["trace_id"]
+            # Nested ensure_root joins, never re-roots.
+            with obs_context.ensure_root():
+                assert obs_context.current()["trace_id"] == ctx["trace_id"]
+        assert obs_context.current() is None
+
+    def test_activate_adopts_rpc_carried_context(self):
+        with obs_context.activate({"trace_id": "t1", "parent_span_id": "s9"}):
+            assert obs_context.current() == {
+                "trace_id": "t1",
+                "parent_span_id": "s9",
+            }
+        assert obs_context.current() is None
+        with obs_context.activate(None):  # untraced callers cost nothing
+            assert obs_context.current() is None
+
+    def test_spans_chain_parent_ids(self, tmp_path):
+        collector = tracing.collector()
+        old = collector.path
+        collector.path = str(tmp_path / "trace.json")
+        try:
+            with obs_context.ensure_root():
+                with tracing.span("outer"):
+                    with tracing.span("inner"):
+                        pass
+            collector.flush()
+        finally:
+            collector.path = old
+        events = tracing.load_trace_events(str(tmp_path / "trace.json"))
+        by_name = {e["name"]: e for e in events if e.get("ph") == "X"}
+        outer, inner = by_name["outer"], by_name["inner"]
+        assert outer["args"]["trace_id"] == inner["args"]["trace_id"]
+        assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+        assert "parent_id" not in outer["args"]  # root span has no parent
+
+
+# --------------------------------------------------------------------------
+# multi-process stitching through a real store
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_one_trace_id_spans_client_controller_volume(
+    tmp_path, monkeypatch
+):
+    """THE acceptance path: a single put's trace id must appear in spans
+    from the client process AND the controller/volume actor processes, and
+    the merged file must be one loadable Chrome trace with labeled
+    process tracks."""
+    import torchstore_tpu as ts
+
+    base = str(tmp_path / "trace.json")
+    # Children inherit the env var at spawn; the main process's collector
+    # predates it, so point it at the same base directly.
+    monkeypatch.setenv("TORCHSTORE_TPU_TRACE", base)
+    collector = tracing.collector()
+    old_path = collector.path
+    collector.path = base
+    try:
+        await ts.initialize(store_name="obs_stitch")
+        try:
+            arr = np.arange(1024, dtype=np.float32)
+            await ts.put("stitch/k", arr, store_name="obs_stitch")
+            out = await ts.get("stitch/k", store_name="obs_stitch")
+            np.testing.assert_array_equal(np.asarray(out), arr)
+            del out
+        finally:
+            await ts.shutdown("obs_stitch")
+        result = ts.collect_trace(str(tmp_path / "merged.json"))
+    finally:
+        collector.flush()
+        collector.path = old_path
+    assert result is not None
+    # Client + at least one actor process contributed files.
+    assert len(result["files"]) >= 2, result
+    events = json.load(open(result["path"]))  # loads as-is: one valid array
+    spans = [e for e in events if e.get("ph") == "X"]
+    put_spans = [e for e in spans if e["name"] == "put_batch"]
+    assert put_spans, {e["name"] for e in spans}
+    trace_id = put_spans[-1]["args"]["trace_id"]
+    pids_in_trace = {
+        e["pid"]
+        for e in spans
+        if (e.get("args") or {}).get("trace_id") == trace_id
+    }
+    assert len(pids_in_trace) >= 2, (
+        f"trace {trace_id} confined to one process; events: "
+        f"{[(e['name'], e['pid']) for e in spans]}"
+    )
+    # Server-side rpc spans adopted the client's trace id.
+    stitched_names = {
+        e["name"]
+        for e in spans
+        if (e.get("args") or {}).get("trace_id") == trace_id
+    }
+    assert any(n.startswith("rpc/") for n in stitched_names), stitched_names
+    # Labeled process tracks for every contributing file.
+    meta_names = [
+        e["args"]["name"] for e in events if e.get("ph") == "M"
+    ]
+    assert len(meta_names) == len(result["files"])
+    assert any("volume" in n for n in meta_names), meta_names
+
+
+# --------------------------------------------------------------------------
+# fleet snapshot
+# --------------------------------------------------------------------------
+
+
+class TestMergeSnapshots:
+    def _counter_snap(self, value, labels=None, help=""):
+        return {
+            "kind": "counter",
+            "help": help,
+            "series": [{"labels": labels or {}, "value": value}],
+        }
+
+    def test_labels_injected_per_process(self):
+        merged, conflicts = aggregate.merge_snapshots(
+            [
+                ({"process": "controller"}, {"ts_x_total": self._counter_snap(1)}),
+                (
+                    {"process": "volume", "volume_id": "7"},
+                    {"ts_x_total": self._counter_snap(2)},
+                ),
+            ]
+        )
+        assert conflicts == []
+        series = merged["ts_x_total"]["series"]
+        assert {"process": "controller"} in [s["labels"] for s in series]
+        assert {"process": "volume", "volume_id": "7"} in [
+            s["labels"] for s in series
+        ]
+
+    def test_label_collision_preserved_under_exported_prefix(self):
+        merged, _ = aggregate.merge_snapshots(
+            [
+                (
+                    {"process": "volume", "volume_id": "0"},
+                    {
+                        "ts_x_total": self._counter_snap(
+                            5, labels={"process": "impostor", "op": "put"}
+                        )
+                    },
+                )
+            ]
+        )
+        labels = merged["ts_x_total"]["series"][0]["labels"]
+        assert labels["process"] == "volume"  # scraper identity wins
+        assert labels["exported_process"] == "impostor"  # original kept
+        assert labels["op"] == "put"
+
+    def test_kind_conflict_dropped_and_reported(self):
+        merged, conflicts = aggregate.merge_snapshots(
+            [
+                ({"process": "a"}, {"ts_x": self._counter_snap(1)}),
+                (
+                    {"process": "b"},
+                    {
+                        "ts_x": {
+                            "kind": "gauge",
+                            "help": "",
+                            "series": [{"labels": {}, "value": 2}],
+                        }
+                    },
+                ),
+            ]
+        )
+        assert merged["ts_x"]["kind"] == "counter"
+        assert len(merged["ts_x"]["series"]) == 1  # gauge contribution dropped
+        assert conflicts and "ts_x" in conflicts[0]
+
+    def test_fleet_doc_renders_prometheus(self):
+        doc = aggregate.fleet_doc(
+            [({"process": "controller"}, {"ts_x_total": self._counter_snap(3)})],
+            errors={"1": "dead: ConnectionRefusedError"},
+        )
+        assert doc["errors"] == {"1": "dead: ConnectionRefusedError"}
+        text = aggregate.render_prometheus(doc["metrics"])
+        assert 'ts_x_total{process="controller"} 3' in text
+        json.dumps(doc)  # the whole envelope is JSON-serializable
+
+
+@pytest.mark.anyio
+async def test_fleet_snapshot_covers_controller_and_every_volume():
+    import torchstore_tpu as ts
+
+    await ts.initialize(store_name="obs_fleet", num_storage_volumes=2)
+    try:
+        arr = np.ones(512, np.float32)
+        await ts.put("fleet/k", arr, store_name="obs_fleet")
+        out = await ts.get("fleet/k", store_name="obs_fleet")
+        del out
+        doc = await ts.fleet_snapshot(store_name="obs_fleet")
+        assert doc["errors"] == {}
+        procs = doc["processes"]
+        assert {"process": "client"} in procs
+        assert {"process": "controller"} in procs
+        vol_ids = {
+            p["volume_id"] for p in procs if p.get("process") == "volume"
+        }
+        assert len(vol_ids) == 2, procs
+        merged = doc["metrics"]
+        # Controller-process series are labeled as such.
+        ctl = [
+            s
+            for s in merged["ts_controller_puts_total"]["series"]
+            if s["labels"].get("process") == "controller"
+        ]
+        assert ctl and ctl[0]["value"] >= 1
+        # Every series in the document carries a process label.
+        for name, snap in merged.items():
+            for series in snap["series"]:
+                assert "process" in series["labels"], (name, series)
+        # The client's hot keys made it into the envelope.
+        assert any(
+            h["key"] == "fleet/k" for h in doc["hot_keys"]["client"]
+        )
+        json.dumps(doc)
+        # Prometheus rendering of the same scrape.
+        text = await ts.fleet_snapshot(
+            store_name="obs_fleet", render="prometheus"
+        )
+        assert 'process="controller"' in text
+        assert 'process="volume"' in text
+    finally:
+        await ts.shutdown("obs_fleet")
+
+
+@pytest.mark.anyio
+async def test_fleet_snapshot_tolerates_dead_volume():
+    """A volume that can't be scraped lands in ``errors`` — the rest of the
+    fleet document still assembles (heartbeat tolerance)."""
+    import torchstore_tpu as ts
+    from torchstore_tpu.runtime import ActorDiedError
+
+    await ts.initialize(store_name="obs_dead", num_storage_volumes=2)
+    try:
+        await ts.put("dead/k", np.ones(64, np.float32), store_name="obs_dead")
+        handle = ts.api._stores["obs_dead"]
+        victim = handle.volume_mesh._processes[0]
+        victim.terminate()
+        victim.join(10.0)
+        doc = await ts.fleet_snapshot(store_name="obs_dead")
+        assert len(doc["errors"]) == 1, doc["errors"]
+        # The survivor and the controller still report.
+        assert {"process": "controller"} in doc["processes"]
+        assert any(p.get("process") == "volume" for p in doc["processes"])
+    finally:
+        try:
+            await ts.shutdown("obs_dead")
+        except (ActorDiedError, Exception):
+            pass
+
+
+# --------------------------------------------------------------------------
+# HTTP exporter
+# --------------------------------------------------------------------------
+
+
+class TestHTTPExporter:
+    def test_serves_metrics_healthz_and_shuts_down(self):
+        obs_metrics.counter("ts_http_probe_total", "probe").inc(7)
+        exp = http_exporter.start_http_exporter(0, host="127.0.0.1")
+        try:
+            assert exp.port > 0
+            base = f"http://127.0.0.1:{exp.port}"
+            body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+            text = body.decode()
+            assert "# TYPE ts_http_probe_total counter" in text
+            assert "ts_http_probe_total 7" in text
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/healthz", timeout=10).read()
+            )
+            assert health["status"] == "ok"
+            assert health["pid"] > 0
+            doc = json.loads(
+                urllib.request.urlopen(f"{base}/metrics.json", timeout=10).read()
+            )
+            assert "ts_http_probe_total" in doc["metrics"]
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope", timeout=10)
+            # The bound port is discoverable through the registry (how
+            # fleet_snapshot finds ephemeral-fallback siblings).
+            gauge = obs_metrics.get_registry().get("ts_metrics_http_port")
+            assert gauge.value() == exp.port
+        finally:
+            exp.close()
+        # Clean shutdown: the port no longer answers.
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/healthz", timeout=2
+            )
+
+    def test_maybe_start_is_env_gated_and_falls_back(self, monkeypatch):
+        monkeypatch.delenv(http_exporter.ENV_METRICS_PORT, raising=False)
+        assert http_exporter.maybe_start_http_exporter() is None
+        # Occupy a port, then ask maybe_start for exactly it: the exporter
+        # must fall back to an ephemeral port instead of dying (volume
+        # actors inherit the same env var as their spawner).
+        blocker = http_exporter.start_http_exporter(0, host="127.0.0.1")
+        try:
+            monkeypatch.setenv(
+                http_exporter.ENV_METRICS_PORT, str(blocker.port)
+            )
+            monkeypatch.setenv(http_exporter.ENV_METRICS_HOST, "127.0.0.1")
+            exp = http_exporter.maybe_start_http_exporter()
+            try:
+                assert exp is not None
+                assert exp.port != blocker.port
+                health = urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/healthz", timeout=10
+                ).read()
+                assert json.loads(health)["status"] == "ok"
+                # Idempotent: a second call returns the running exporter.
+                assert http_exporter.maybe_start_http_exporter() is exp
+            finally:
+                http_exporter.stop_http_exporter()
+        finally:
+            blocker.close()
+
+
+# --------------------------------------------------------------------------
+# hot-key / slow-op profiler
+# --------------------------------------------------------------------------
+
+
+class TestProfiler:
+    def test_hot_keys_top_k(self):
+        tracker = obs_profile.HotKeyTracker()
+        tracker.record("big", 1000)
+        tracker.record("big", 1000)
+        tracker.record("chatty", 1)
+        for _ in range(5):
+            tracker.record("chatty", 1)
+        top_bytes = tracker.top(1, by="bytes")
+        assert top_bytes[0]["key"] == "big"
+        assert top_bytes[0] == {"key": "big", "ops": 2, "bytes": 2000}
+        top_ops = tracker.top(1, by="ops")
+        assert top_ops[0]["key"] == "chatty"
+
+    def test_hot_keys_bounded_eviction_keeps_hottest(self):
+        tracker = obs_profile.HotKeyTracker()
+        tracker.MAX_KEYS = 8
+        tracker.record("whale", 10**9)
+        for i in range(50):
+            tracker.record(f"minnow/{i}", 1)
+        assert len(tracker._keys) <= tracker.MAX_KEYS
+        assert any(h["key"] == "whale" for h in tracker.top(3))
+
+    def test_slow_op_threshold_logs_counts_and_annotates(
+        self, monkeypatch, tmp_path, caplog
+    ):
+        monkeypatch.setenv(obs_profile.ENV_SLOW_OP_MS, "10")
+        collector = tracing.collector()
+        old = collector.path
+        collector.path = str(tmp_path / "trace.json")
+        slow_counter = obs_metrics.get_registry().counter("ts_slow_ops_total")
+        before = slow_counter.value(op="probe")
+        try:
+            with caplog.at_level("WARNING"):
+                # 5 ms: under threshold — nothing happens.
+                obs_profile.record_op("probe", "k/fast", 10, 0.0, 0.005)
+                assert slow_counter.value(op="probe") == before
+                # 50 ms: over threshold.
+                obs_profile.record_op("probe", "k/slow", 10, 0.0, 0.050)
+            collector.flush()
+        finally:
+            collector.path = old
+        assert slow_counter.value(op="probe") == before + 1
+        assert any("slow op" in r.getMessage() for r in caplog.records)
+        events = tracing.load_trace_events(str(tmp_path / "trace.json"))
+        slow = [e for e in events if e["name"] == "slow_op/probe"]
+        assert slow and slow[0]["args"]["key"] == "k/slow"
+        assert slow[0]["args"]["slow"] is True
+
+    def test_disabled_threshold_is_noop(self, monkeypatch):
+        monkeypatch.delenv(obs_profile.ENV_SLOW_OP_MS, raising=False)
+        assert obs_profile.slow_op_threshold_s() is None
+        monkeypatch.setenv(obs_profile.ENV_SLOW_OP_MS, "junk")
+        assert obs_profile.slow_op_threshold_s() is None
+
+    @pytest.mark.anyio
+    async def test_volume_stats_carry_hot_keys(self):
+        import torchstore_tpu as ts
+
+        await ts.initialize(store_name="obs_hot")
+        try:
+            arr = np.ones(2048, np.float32)
+            for _ in range(3):
+                await ts.put("hot/banger", arr, store_name="obs_hot")
+            await ts.put("hot/once", np.ones(4, np.float32), store_name="obs_hot")
+            stats = await ts.client(
+                "obs_hot"
+            ).controller.stats.call_one(include_volumes=True)
+            (vstats,) = stats["volumes"].values()
+            hot = vstats["hot_keys"]
+            assert hot[0]["key"] == "hot/banger"
+            assert hot[0]["bytes"] >= 3 * arr.nbytes
+        finally:
+            await ts.shutdown("obs_hot")

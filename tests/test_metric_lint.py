@@ -1,0 +1,55 @@
+"""Fast-tier guard for the metric namespace: scripts/check_metric_names.py
+must pass on the tree (no kind conflicts, snake_case only) and must actually
+catch the failure modes it exists for."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names", REPO_ROOT / "scripts" / "check_metric_names.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_metric_namespace_is_clean():
+    checker = _load_checker()
+    problems = checker.check(str(REPO_ROOT))
+    assert problems == [], "\n".join(problems)
+    # Sanity: the scan actually sees the instrumented tree (a glob/layout
+    # regression would otherwise make this test pass vacuously).
+    sites = checker.collect_sites(str(REPO_ROOT))
+    names = {name for _, _, name, _ in sites}
+    assert len(sites) >= 30, sites
+    assert "ts_client_ops_total" in names
+    assert "ts_volume_resident_bytes" in names
+
+
+def test_checker_catches_conflicts_and_bad_names(tmp_path):
+    checker = _load_checker()
+    pkg = tmp_path / "torchstore_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        textwrap.dedent(
+            """
+            from torchstore_tpu.observability import metrics as m
+            _C = m.counter("ts_thing_total", "help")
+            _BAD = m.gauge("Bad-Name", "not snake case")
+            """
+        )
+    )
+    (pkg / "b.py").write_text(
+        # Same name, different kind, different file — exactly the two-process
+        # fork the runtime guard cannot see.
+        'from torchstore_tpu.observability import metrics as m\n'
+        '_G = m.gauge("ts_thing_total")\n'
+    )
+    problems = checker.check(str(tmp_path))
+    assert any("conflicting kinds" in p and "ts_thing_total" in p for p in problems)
+    assert any("Bad-Name" in p and "snake_case" in p for p in problems)

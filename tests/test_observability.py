@@ -190,7 +190,9 @@ class TestSpans:
             collector.path = old
         content = open(path).read()
         data = json.loads(content + "\n]")
-        assert data[0]["args"]["error"] == "RuntimeError"
+        # Files lead with a process_name metadata event now — find the span.
+        (boom,) = [e for e in data if e["name"] == "boom"]
+        assert boom["args"]["error"] == "RuntimeError"
 
     def test_span_disabled_is_noop(self):
         collector = tracing.collector()

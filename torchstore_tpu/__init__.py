@@ -13,10 +13,12 @@ from torchstore_tpu.api import (
     Shard,
     barrier,
     client,
+    collect_trace,
     delete,
     delete_batch,
     delete_prefix,
     exists,
+    fleet_snapshot,
     get,
     get_batch,
     direct_staging_buffers,
@@ -37,7 +39,11 @@ from torchstore_tpu.client import LocalClient
 from torchstore_tpu.weight_channel import WeightPublisher, WeightSubscriber
 from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import init_logging
-from torchstore_tpu.observability import maybe_start_dumper, span
+from torchstore_tpu.observability import (
+    maybe_start_dumper,
+    maybe_start_http_exporter,
+    span,
+)
 from torchstore_tpu.strategy import (
     HostStrategy,
     LocalRankStrategy,
@@ -49,8 +55,12 @@ from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
 
 init_logging()
 # Every torchstore process (clients, volume actors, the controller) starts
-# its metrics dump thread here when TORCHSTORE_TPU_METRICS_DUMP is set.
+# its metrics dump thread here when TORCHSTORE_TPU_METRICS_DUMP is set, and
+# its live /metrics + /healthz HTTP endpoint when
+# TORCHSTORE_TPU_METRICS_PORT is set (siblings that lose the port race fall
+# back to an ephemeral port, published via the ts_metrics_http_port gauge).
 maybe_start_dumper()
+maybe_start_http_exporter()
 
 __version__ = "0.1.0"
 
@@ -71,10 +81,12 @@ __all__ = [
     "WeightSubscriber",
     "barrier",
     "client",
+    "collect_trace",
     "delete",
     "delete_batch",
     "delete_prefix",
     "exists",
+    "fleet_snapshot",
     "get",
     "get_batch",
     "get_state_dict",

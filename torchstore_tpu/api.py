@@ -512,8 +512,67 @@ def metrics_snapshot() -> dict:
     through their ``stats()`` endpoints
     (``controller.stats.call_one(include_volumes=True)`` collects the whole
     fleet), and ``TORCHSTORE_TPU_METRICS_DUMP=/path`` makes every process
-    periodically write its own dump."""
+    periodically write its own dump. For the MERGED fleet view, see
+    :func:`fleet_snapshot`."""
     return obs_metrics.metrics_snapshot()
+
+
+async def fleet_snapshot(
+    store_name: str = DEFAULT_STORE, render: Optional[str] = None
+) -> Any:
+    """One merged, process-labeled registry for the whole store fleet.
+
+    Scrapes the controller's registry and — through the controller's
+    ``stats(include_volumes=True)`` fan-out — every live volume's, merges
+    them with this process's own (the client), and labels every series with
+    ``process="client" | "controller" | "volume"`` (volumes additionally
+    carry ``volume_id``; pre-existing colliding labels are preserved under
+    an ``exported_`` prefix). Unreachable volumes land in ``errors`` instead
+    of failing the scrape (heartbeat tolerance), and kind conflicts are
+    dropped into ``conflicts`` rather than corrupting the document.
+
+    Returns ``{"ts", "scraper_pid", "processes", "errors", "conflicts",
+    "hot_keys", "metrics"}`` (JSON-serializable; ``hot_keys`` maps
+    ``client``/volume ids to their rolling top-K keys by bytes).
+    ``render="prometheus"`` returns one Prometheus-text document instead;
+    ``render="json"`` a JSON string."""
+    from torchstore_tpu.observability import aggregate, profile
+
+    c = client(store_name)
+    stats = await c.controller.stats.call_one(include_volumes=True)
+    entries: list[tuple[dict, dict]] = [
+        ({"process": "client"}, obs_metrics.metrics_snapshot()),
+        ({"process": "controller"}, stats.get("metrics") or {}),
+    ]
+    errors: dict[str, str] = {}
+    hot: dict[str, list] = {"client": profile.hot_keys(10)}
+    for vid, vstats in sorted((stats.get("volumes") or {}).items()):
+        if "metrics" not in vstats:
+            errors[vid] = str(vstats.get("error", "no metrics in stats()"))
+            continue
+        entries.append(
+            ({"process": "volume", "volume_id": vid}, vstats["metrics"])
+        )
+        if vstats.get("hot_keys"):
+            hot[f"volume:{vid}"] = vstats["hot_keys"]
+    doc = aggregate.fleet_doc(entries, errors=errors, hot_keys=hot)
+    if render == "prometheus":
+        return aggregate.render_prometheus(doc["metrics"])
+    if render == "json":
+        return aggregate.render_json(doc)
+    return doc
+
+
+def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
+    """Merge every process's Chrome-trace file (``TORCHSTORE_TPU_TRACE``
+    base + pid-suffixed siblings) into ONE Perfetto-loadable timeline with
+    labeled process tracks and cross-process trace ids. Call after
+    ``ts.shutdown()`` so actor processes have flushed their atexit dumps.
+    Returns ``{"path", "files", "events", "trace_ids"}`` or None when
+    tracing is disabled. Default output: ``<root>.merged<ext>``."""
+    from torchstore_tpu.observability import tracing
+
+    return tracing.collect_trace(out_path)
 
 
 async def barrier(
@@ -569,10 +628,12 @@ __all__ = [
     "Shard",
     "barrier",
     "client",
+    "collect_trace",
     "delete",
     "delete_batch",
     "delete_prefix",
     "exists",
+    "fleet_snapshot",
     "get",
     "get_batch",
     "get_state_dict",

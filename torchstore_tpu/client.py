@@ -22,7 +22,9 @@ from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
 from torchstore_tpu.native import copy_into
+from torchstore_tpu.observability import context as obs_context
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import profile as obs_profile
 from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
@@ -203,18 +205,31 @@ class LocalClient:
     async def put_batch(self, items: dict[str, Any]) -> None:
         t0 = time.perf_counter()
         try:
-            with span(
+            # ensure_root: every logical op roots (or joins) a distributed
+            # trace — the id rides the notify/volume RPC frames so remote
+            # spans stitch to this one in a merged timeline.
+            with obs_context.ensure_root(), span(
                 "put_batch",
                 keys=len(items),
                 key=next(iter(items), None),
             ) as sp:
                 nbytes = await self._put_batch(items, sp)
+                dur = time.perf_counter() - t0
+                obs_profile.record_op(
+                    "put",
+                    next(iter(items), None),
+                    nbytes,
+                    t0,
+                    dur,
+                    tally=False,  # per-key tallies happen in _put_batch
+                    keys=len(items),
+                )
         except BaseException:
             _OP_ERRORS.inc(op="put")
             raise
         _OP_COUNT.inc(op="put")
         _OP_BYTES.inc(nbytes, op="put")
-        _OP_SECONDS.observe(time.perf_counter() - t0, op="put")
+        _OP_SECONDS.observe(dur, op="put")
 
     async def _put_batch(self, items: dict[str, Any], sp) -> int:
         await self._ensure_setup()
@@ -232,6 +247,9 @@ class LocalClient:
         volumes = self._put_volumes()
         nbytes = sum(r.nbytes for r in requests)
         sp.set(nbytes=nbytes, replicas=len(volumes))
+        hot = obs_profile.hot_key_tracker()
+        for req in requests:
+            hot.record(req.key, req.nbytes)
 
         async def put_to(volume: StorageVolumeRef) -> dict[str, int]:
             try:
@@ -304,22 +322,29 @@ class LocalClient:
         signature parity, /root/reference/torchstore/api.py:242-279)."""
         t0 = time.perf_counter()
         try:
-            with span("get_batch", keys=len(items)) as sp:
+            with obs_context.ensure_root(), span(
+                "get_batch", keys=len(items)
+            ) as sp:
                 out = await self._get_batch(items)
                 # Stored OBJECTS come back as arbitrary user types; only
                 # count an nbytes attribute that is actually a number.
-                nbytes = sum(
-                    n
-                    for v in out.values()
-                    if isinstance((n := getattr(v, "nbytes", 0)), int)
-                )
+                sizes = [
+                    (
+                        key,
+                        n if isinstance((n := getattr(v, "nbytes", 0)), int) else 0,
+                    )
+                    for key, v in out.items()
+                ]
+                nbytes = sum(n for _, n in sizes)
                 sp.set(nbytes=nbytes)
+                dur = time.perf_counter() - t0
+                obs_profile.record_keys("get", sizes, t0, dur)
         except BaseException:
             _OP_ERRORS.inc(op="get")
             raise
         _OP_COUNT.inc(op="get")
         _OP_BYTES.inc(nbytes, op="get")
-        _OP_SECONDS.observe(time.perf_counter() - t0, op="get")
+        _OP_SECONDS.observe(dur, op="get")
         return out
 
     async def _get_batch(self, items) -> dict[str, Any]:

@@ -23,8 +23,36 @@ Instrumented layers: ``client.py``/``api.py`` (per-op latency + bytes),
 registration counts), ``controller.py``/``storage_volume.py`` (keys,
 resident bytes, write generations, pending reclaims), and
 ``weight_channel.py`` (publish/acquire versions and subscriber lag).
+
+The distributed layer (PR 2) turns those per-process substrates into one
+operable plane:
+
+- **Trace-context propagation** (``observability.context``): a contextvars
+  ``trace_id``/``parent_span_id`` carried in every actor-RPC frame, so
+  client, controller, and volume spans share one trace id;
+  ``ts.collect_trace()`` / ``scripts/merge_traces.py`` stitch the
+  per-process files into one Perfetto timeline with labeled process tracks.
+- **Fleet aggregation** (``observability.aggregate``): ``ts.fleet_snapshot()``
+  scrapes every process's registry through the controller and merges them
+  into one process-labeled snapshot / Prometheus document.
+- **Live HTTP scrape** (``observability.http_exporter``):
+  ``TORCHSTORE_TPU_METRICS_PORT`` serves ``/metrics`` + ``/healthz`` from
+  any process (ephemeral-port fallback on sibling conflicts; the bound port
+  rides the ``ts_metrics_http_port`` gauge).
+- **Hot-key/slow-op profiling** (``observability.profile``): rolling top-K
+  keys by bytes/ops per process, and a ``TORCHSTORE_TPU_SLOW_OP_MS``
+  threshold that turns outliers into logs, ``ts_slow_ops_total`` counts,
+  and trace annotations.
 """
 
+from torchstore_tpu.observability import aggregate, context, profile
+from torchstore_tpu.observability.http_exporter import (
+    ENV_METRICS_PORT,
+    MetricsHTTPExporter,
+    maybe_start_http_exporter,
+    start_http_exporter,
+    stop_http_exporter,
+)
 from torchstore_tpu.observability.metrics import (
     ENV_METRICS_DUMP,
     ENV_METRICS_INTERVAL,
@@ -39,36 +67,73 @@ from torchstore_tpu.observability.metrics import (
     histogram,
     maybe_start_dumper,
     metrics_snapshot,
+    render_prometheus_snapshot,
     reset_metrics,
+)
+from torchstore_tpu.observability.profile import (
+    ENV_SLOW_OP_MS,
+    hot_keys,
+    record_op,
 )
 from torchstore_tpu.observability.tracing import (
     ENV_TRACE,
     TraceCollector,
+    collect_trace,
     collector,
     flush_trace,
+    merge_traces,
     span,
     trace_enabled,
 )
 
+
+def reinit_after_fork() -> None:
+    """Re-arm every env-gated observability facility in a freshly forked
+    actor child (called from the actor runtime's child bootstrap AFTER the
+    child's env is corrected). Forked children inherit the forkserver's
+    module state — a trace collector whose path snapshot predates the
+    spawner's env, and dumper/exporter 'started' flags whose threads died
+    in the fork — so each facility re-reads the env and starts fresh."""
+    from torchstore_tpu.observability import http_exporter as _http
+    from torchstore_tpu.observability import metrics as _metrics
+
+    collector().reinit_after_fork()
+    _metrics.reinit_dumper_after_fork()
+    _http.reinit_after_fork()
+
 __all__ = [
     "ENV_METRICS_DUMP",
     "ENV_METRICS_INTERVAL",
+    "ENV_METRICS_PORT",
+    "ENV_SLOW_OP_MS",
     "ENV_TRACE",
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricsHTTPExporter",
     "MetricsRegistry",
     "TraceCollector",
+    "aggregate",
+    "collect_trace",
     "collector",
+    "context",
     "counter",
     "dump_metrics",
     "flush_trace",
     "gauge",
     "get_registry",
     "histogram",
+    "hot_keys",
     "maybe_start_dumper",
+    "maybe_start_http_exporter",
+    "merge_traces",
     "metrics_snapshot",
+    "profile",
+    "record_op",
+    "render_prometheus_snapshot",
     "reset_metrics",
     "span",
+    "start_http_exporter",
+    "stop_http_exporter",
     "trace_enabled",
 ]

@@ -1,0 +1,117 @@
+"""Fleet metrics aggregation: merge per-process registries into one document.
+
+Metrics are process-local by design (Prometheus client-library semantics —
+see observability/metrics.py): the client, the controller, and every storage
+volume each hold their own registry, surfaced through ``stats()`` endpoints.
+This module is the scrape side: :func:`merge_snapshots` takes those
+per-process snapshots and produces ONE registry-shaped snapshot in which
+every series carries identifying labels (``process="client" | "controller" |
+"volume"`` plus ``volume_id=...``), renderable as a single Prometheus-text
+or JSON document via ``metrics.render_prometheus_snapshot``.
+
+Merge semantics:
+
+- **Label injection**: each contributed series gains its process labels. A
+  pre-existing label with the same name is preserved under an ``exported_``
+  prefix (the Prometheus honor-labels convention) — the scraper's identity
+  labels are authoritative, the original value is never lost.
+- **Kind conflicts**: if two processes registered the same metric name with
+  different kinds (which scripts/check_metric_names.py lints against), the
+  first-seen kind wins and the conflicting contribution is dropped and
+  recorded in the returned ``conflicts`` list — one bad process must not
+  corrupt the whole fleet document.
+- **Dead volumes**: scrape errors are the CALLER's to record (see
+  ``api.fleet_snapshot``) — merge only ever sees snapshots that arrived.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _inject_labels(series_labels: dict, inject: dict) -> dict:
+    out = dict(series_labels)
+    for key, value in inject.items():
+        if key in out and str(out[key]) != str(value):
+            out[f"exported_{key}"] = out.pop(key)
+        out[key] = str(value)
+    return out
+
+
+def merge_snapshots(
+    entries: list[tuple[dict, dict]],
+) -> tuple[dict, list[str]]:
+    """Merge ``[(labels, snapshot), ...]`` into one snapshot.
+
+    ``labels`` identify the contributing process (e.g. ``{"process":
+    "volume", "volume_id": "0"}``) and are injected into every series;
+    ``snapshot`` is a ``MetricsRegistry.snapshot()``-shaped dict. Returns
+    ``(merged_snapshot, conflicts)`` where conflicts lists
+    ``"metric_name (kind_a vs kind_b from <labels>)"`` strings for
+    contributions dropped on kind mismatch."""
+    merged: dict[str, dict] = {}
+    conflicts: list[str] = []
+    for labels, snapshot in entries:
+        for name, snap in (snapshot or {}).items():
+            target = merged.get(name)
+            if target is None:
+                target = merged[name] = {
+                    "kind": snap.get("kind", "untyped"),
+                    "help": snap.get("help", ""),
+                    "series": [],
+                }
+            elif target["kind"] != snap.get("kind", "untyped"):
+                conflicts.append(
+                    f"{name} ({target['kind']} vs "
+                    f"{snap.get('kind', 'untyped')} from {labels})"
+                )
+                continue
+            if not target["help"] and snap.get("help"):
+                target["help"] = snap["help"]
+            for series in snap.get("series", ()):
+                target["series"].append(
+                    {
+                        "labels": _inject_labels(
+                            series.get("labels", {}), labels
+                        ),
+                        "value": series.get("value"),
+                    }
+                )
+    return dict(sorted(merged.items())), conflicts
+
+
+def render_prometheus(merged_snapshot: dict) -> str:
+    """One Prometheus-text document for a merged fleet snapshot."""
+    from torchstore_tpu.observability.metrics import (
+        render_prometheus_snapshot,
+    )
+
+    return render_prometheus_snapshot(merged_snapshot)
+
+
+def render_json(fleet_doc: dict) -> str:
+    """JSON document for a full ``fleet_snapshot()`` result."""
+    import json
+
+    return json.dumps(fleet_doc)
+
+
+def fleet_doc(
+    entries: list[tuple[dict, dict]],
+    errors: Optional[dict] = None,
+    hot_keys: Optional[dict] = None,
+) -> dict:
+    """Assemble the standard fleet-snapshot envelope around a merge."""
+    import os
+    import time
+
+    merged, conflicts = merge_snapshots(entries)
+    return {
+        "ts": time.time(),
+        "scraper_pid": os.getpid(),
+        "processes": [labels for labels, _ in entries],
+        "errors": dict(errors or {}),
+        "conflicts": conflicts,
+        "hot_keys": dict(hot_keys or {}),
+        "metrics": merged,
+    }

@@ -246,27 +246,34 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format (0.0.4)."""
-        lines: list[str] = []
-        for name, snap in self.snapshot().items():
-            if snap["help"]:
-                lines.append(f"# HELP {name} {snap['help']}")
-            lines.append(f"# TYPE {name} {snap['kind']}")
-            for series in snap["series"]:
-                labels = series["labels"]
-                if snap["kind"] == "histogram":
-                    value = series["value"]
-                    for le, cum in value["buckets"].items():
-                        lines.append(
-                            f"{name}_bucket"
-                            f"{_fmt_labels({**labels, 'le': le})} {cum}"
-                        )
-                    lines.append(f"{name}_sum{_fmt_labels(labels)} {value['sum']}")
+        return render_prometheus_snapshot(self.snapshot())
+
+
+def render_prometheus_snapshot(snapshot: dict) -> str:
+    """Render any registry-shaped snapshot (``{name: {"kind", "help",
+    "series"}}``) as Prometheus text — the local registry or a merged fleet
+    snapshot (observability/aggregate.py) render identically."""
+    lines: list[str] = []
+    for name, snap in sorted(snapshot.items()):
+        if snap.get("help"):
+            lines.append(f"# HELP {name} {snap['help']}")
+        lines.append(f"# TYPE {name} {snap['kind']}")
+        for series in snap["series"]:
+            labels = series["labels"]
+            if snap["kind"] == "histogram":
+                value = series["value"]
+                for le, cum in value["buckets"].items():
                     lines.append(
-                        f"{name}_count{_fmt_labels(labels)} {value['count']}"
+                        f"{name}_bucket"
+                        f"{_fmt_labels({**labels, 'le': le})} {cum}"
                     )
-                else:
-                    lines.append(f"{name}{_fmt_labels(labels)} {series['value']}")
-        return "\n".join(lines) + "\n"
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {value['sum']}")
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} {value['count']}"
+                )
+            else:
+                lines.append(f"{name}{_fmt_labels(labels)} {series['value']}")
+    return "\n".join(lines) + "\n"
 
 
 def _fmt_labels(labels: dict) -> str:
@@ -322,6 +329,7 @@ def reset_metrics() -> None:
 
 _dumper_lock = threading.Lock()
 _dumper_started = False
+_dumper_thread: Optional[threading.Thread] = None
 _dump_path: Optional[str] = None
 
 
@@ -424,9 +432,29 @@ def maybe_start_dumper() -> bool:
             time.sleep(interval)
             dump_metrics()
 
+    global _dumper_thread
     thread = threading.Thread(
         target=loop, name="torchstore-tpu-metrics-dump", daemon=True
     )
     thread.start()
+    _dumper_thread = thread
     atexit.register(dump_metrics)
     return True
+
+
+def reinit_dumper_after_fork() -> bool:
+    """Re-arm the periodic dumper in an actor child. Under forkserver, fork
+    copies the ``_dumper_started`` flag but NOT the dump thread (only the
+    forking thread survives), so an inherited True flag means "claims to
+    run, never dumps" — reset and start fresh. Under spawn, the child's own
+    import already started a LIVE thread: starting another would double
+    every dump; only the claimed path is dropped so the next tick
+    re-resolves against the child's corrected env."""
+    global _dumper_started, _dump_path, _dumper_thread
+    with _dumper_lock:
+        _dump_path = None
+        if _dumper_thread is not None and _dumper_thread.is_alive():
+            return True
+        _dumper_started = False
+        _dumper_thread = None
+    return maybe_start_dumper()

@@ -1,0 +1,168 @@
+"""Hot-key / slow-op profiler: who is hammering the store, and what stalled.
+
+Two process-local facilities fed from the client's logical ops and each
+volume's data-plane RPCs:
+
+- **Hot keys**: a rolling per-key tally of ops and bytes (bounded — when the
+  table overflows ``MAX_KEYS`` the coldest half is dropped, so a key-churny
+  workload can't grow it unboundedly). ``hot_keys(k)`` returns the top-K by
+  bytes; volumes embed theirs in ``stats()`` and ``ts.fleet_snapshot()``
+  collects the whole fleet's — the first question of any traffic
+  investigation ("which key is 90% of the bytes?") answered without a trace.
+
+- **Slow ops**: set ``TORCHSTORE_TPU_SLOW_OP_MS`` and any recorded operation
+  whose wall time crosses the threshold is (1) logged with key/bytes/
+  duration and the active trace id, (2) counted in ``ts_slow_ops_total``
+  (labeled by op), and (3) emitted as a ``slow_op/<op>`` trace event when
+  tracing is enabled — so outliers are findable in metrics, logs, AND the
+  merged timeline. Unset, the check is one env read + a float compare.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from torchstore_tpu.observability import context as trace_context
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import tracing
+
+ENV_SLOW_OP_MS = "TORCHSTORE_TPU_SLOW_OP_MS"
+
+_SLOW_OPS = obs_metrics.counter(
+    "ts_slow_ops_total",
+    "Operations slower than TORCHSTORE_TPU_SLOW_OP_MS, by op",
+)
+
+
+def slow_op_threshold_s() -> Optional[float]:
+    """The configured slow-op threshold in seconds, or None when disabled.
+    Read per call (not cached) so tests and live operators can retune a
+    running process; one getenv is noise next to any op worth profiling."""
+    raw = os.environ.get(ENV_SLOW_OP_MS)
+    if not raw:
+        return None
+    try:
+        return float(raw) / 1e3
+    except ValueError:
+        return None
+
+
+class HotKeyTracker:
+    """Rolling per-key op/byte tally (process-local, lock-protected)."""
+
+    MAX_KEYS = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._keys: dict[str, list] = {}  # key -> [ops, bytes]
+
+    def record(self, key: str, nbytes: int = 0) -> None:
+        with self._lock:
+            stat = self._keys.get(key)
+            if stat is None:
+                if len(self._keys) >= self.MAX_KEYS:
+                    self._evict_cold_locked()
+                stat = self._keys[key] = [0, 0]
+            stat[0] += 1
+            stat[1] += int(nbytes)
+
+    def _evict_cold_locked(self) -> None:
+        # Keep the hottest half by bytes (ops as tiebreak): the keys an
+        # operator would ask about survive churn from one-shot keys.
+        survivors = sorted(
+            self._keys.items(), key=lambda kv: (kv[1][1], kv[1][0]), reverse=True
+        )[: self.MAX_KEYS // 2]
+        self._keys = dict(survivors)
+
+    def top(self, k: int = 10, by: str = "bytes") -> list[dict]:
+        idx = 1 if by == "bytes" else 0
+        with self._lock:
+            items = sorted(
+                self._keys.items(), key=lambda kv: kv[1][idx], reverse=True
+            )[:k]
+        return [
+            {"key": key, "ops": stat[0], "bytes": stat[1]}
+            for key, stat in items
+        ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._keys.clear()
+
+
+_tracker = HotKeyTracker()
+
+
+def hot_key_tracker() -> HotKeyTracker:
+    return _tracker
+
+
+def hot_keys(k: int = 10, by: str = "bytes") -> list[dict]:
+    """This process's top-K keys (``[{"key", "ops", "bytes"}, ...]``)."""
+    return _tracker.top(k, by=by)
+
+
+def reset_hot_keys() -> None:
+    _tracker.reset()
+
+
+def record_op(
+    op: str,
+    key: Optional[str],
+    nbytes: int,
+    start_s: float,
+    dur_s: float,
+    tally: bool = True,
+    **attrs,
+) -> None:
+    """Record one completed operation: feeds the hot-key tally and, past the
+    env threshold, the slow-op log/counter/trace annotation. ``start_s`` is
+    the ``perf_counter`` start so the trace annotation lands at the right
+    place on the timeline."""
+    if tally and key is not None:
+        _tracker.record(key, nbytes)
+    threshold = slow_op_threshold_s()
+    if threshold is None or dur_s < threshold:
+        return
+    _SLOW_OPS.inc(op=op)
+    tid = trace_context.trace_id()
+    from torchstore_tpu.logging import get_logger
+
+    get_logger("torchstore_tpu.observability").warning(
+        "slow op: %s key=%r %d bytes took %.1f ms (threshold %.1f ms)%s",
+        op,
+        key,
+        nbytes,
+        dur_s * 1e3,
+        threshold * 1e3,
+        f" [trace {tid}]" if tid else "",
+    )
+    if tracing.trace_enabled():
+        args = {"op": op, "key": key, "bytes": nbytes, "slow": True, **attrs}
+        if tid is not None:
+            args["trace_id"] = tid
+        tracing.collector().add_event(f"slow_op/{op}", start_s, dur_s, args)
+
+
+def record_keys(op: str, items, start_s: float, dur_s: float) -> None:
+    """Batch entry point: ``items`` is ``[(key, nbytes), ...]`` — every key
+    feeds the hot-key tally; the slow-op check runs ONCE for the whole batch
+    (one RPC, one stall) with the total bytes and a representative key."""
+    total = 0
+    first_key = None
+    for key, nbytes in items:
+        if first_key is None:
+            first_key = key
+        total += int(nbytes)
+        _tracker.record(key, nbytes)
+    record_op(
+        op,
+        first_key,
+        total,
+        start_s,
+        dur_s,
+        tally=False,  # keys already recorded above
+        keys=len(items),
+    )

@@ -30,13 +30,52 @@ client all trace) and losers take a pid-suffixed name.
 from __future__ import annotations
 
 import atexit
+import glob as _glob
 import json
 import os
+import re
 import threading
 import time
 from typing import Optional
 
+from torchstore_tpu.observability import context as trace_context
+from torchstore_tpu.observability.metrics import _pid_alive
+
 ENV_TRACE = "TORCHSTORE_TPU_TRACE"
+# One id per RUN (process tree): minted by the first process to claim a
+# trace file, inherited by every actor child through the TORCHSTORE_TPU_*
+# env forwarding. Distinguishes "sibling of this run already exited" (its
+# events must survive into the merge) from "leftover file of a FINISHED
+# run" (must be cleared, or tpu_watch's reused OUTDIR merges dead spans).
+ENV_TRACE_RUN = "TORCHSTORE_TPU_TRACE_RUN"
+
+
+def _current_run_id() -> str:
+    rid = os.environ.get(ENV_TRACE_RUN)
+    if not rid:
+        rid = f"{os.getpid()}.{trace_context.new_id()}"
+        os.environ[ENV_TRACE_RUN] = rid
+    return rid
+
+
+# spawn_actors calls this BEFORE forwarding env to children, so the whole
+# process tree shares one run id (a child minting its own would mistake an
+# exited sibling's file for a dead run's and truncate it).
+ensure_run_id = _current_run_id
+
+
+def process_label() -> str:
+    """Human-readable track label for this process in a merged trace.
+    Actor children are named ``ts-<actor>-<rank>`` by spawn_actors; the
+    initiating process shows up as its script (or ``MainProcess``)."""
+    import multiprocessing as mp
+    import sys
+
+    name = mp.current_process().name
+    if name in ("MainProcess", None, ""):
+        argv0 = os.path.basename(sys.argv[0] or "") or "python"
+        name = argv0
+    return f"{name}[{os.getpid()}]"
 
 
 class TraceCollector:
@@ -107,30 +146,90 @@ class TraceCollector:
         self.add_event(f"{name}/{phase}", start_s, dur_s, args)
 
     def _resolve_path(self) -> str:
-        # Re-resolve if the target changed (tests swap it) — and CLAIM the
-        # file with O_EXCL: two processes exists()-checking concurrently
-        # would interleave appends into one corrupt file. The loser takes a
-        # pid-suffixed name.
+        # Claim the base path through a ``<base>.owner`` sidecar recording
+        # the claimant's pid (same arbitration as the metrics dumper): a
+        # LIVE concurrent process owning it sends us to a pid-suffixed
+        # sibling, but a leftover file from a FINISHED run is taken over and
+        # truncated — tpu_watch reuses its OUTDIR across runs, and a stale
+        # base full of dead spans must not pollute the next merge. The pid
+        # path is always truncated on claim: any existing content is ours
+        # from a previous resolution or a recycled pid's dead run, and
+        # appending to it would emit a second '[' header (corrupt JSON).
         if self._resolved_path is None or self._resolved_for != self.path:
             base = self.path
             root, ext = os.path.splitext(base)
             pid_path = f"{root}.{os.getpid()}{ext or '.json'}"
-            chosen = pid_path
-            for cand in (base, pid_path):
-                try:
-                    os.close(
-                        os.open(cand, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
-                    )
-                    chosen = cand
-                    break
-                except FileExistsError:
-                    continue
-                except OSError:
-                    break
-            self._resolved_path = chosen
+            self._resolved_path = self._claim(base, pid_path)
             self._resolved_for = self.path
             self._wrote_header = False
         return self._resolved_path
+
+    @staticmethod
+    def _claim(base: str, pid_path: str) -> str:
+        def truncate(path: str) -> None:
+            os.close(os.open(path, os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644))
+
+        # The whole decide-and-claim sequence runs under an exclusive flock
+        # on the owner sidecar: two processes racing a stale claim must
+        # never BOTH conclude "dead owner, mine" — each would truncate the
+        # other's header mid-append and corrupt the base file. flock is
+        # released by the kernel even on SIGKILL, so a crashed claimant
+        # can't wedge the path.
+        import fcntl
+
+        pid = os.getpid()
+        run_id = _current_run_id()
+        payload = f"{pid}\n{run_id}"
+        try:
+            fd = os.open(f"{base}.owner", os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            try:
+                truncate(pid_path)
+            except OSError:
+                pass
+            return pid_path
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            lines = os.read(fd, 256).decode(errors="replace").splitlines()
+            try:
+                owner = int((lines[0] if lines else "").strip() or 0)
+            except ValueError:
+                owner = 0
+            owner_run = lines[1].strip() if len(lines) > 1 else ""
+            if owner and owner_run == run_id and owner != pid:
+                # A sibling process of THIS run owns the base — alive, or
+                # already exited with its events in the file. Either way
+                # those events belong in the merge: take a pid path.
+                claim_base = False
+            elif owner and owner_run != run_id and _pid_alive(owner):
+                claim_base = False  # live owner from another run
+            else:
+                # Unclaimed, our own re-claim, or a FINISHED run's leftover:
+                # take the base and clear any dead run's file set so stale
+                # spans can't pollute this run's merge.
+                claim_base = True
+            if claim_base:
+                os.ftruncate(fd, 0)
+                os.lseek(fd, 0, os.SEEK_SET)
+                os.write(fd, payload.encode())
+                truncate(base)
+                if owner and owner != pid and owner_run != run_id:
+                    for stale in trace_files(base):
+                        if stale != base:
+                            try:
+                                os.unlink(stale)
+                            except OSError:
+                                pass
+                return base
+        except OSError:
+            pass
+        finally:
+            os.close(fd)  # releases the flock
+        try:
+            truncate(pid_path)
+        except OSError:
+            pass
+        return pid_path
 
     def _flush_locked(self) -> None:
         if not self.path or not self.events:
@@ -138,7 +237,22 @@ class TraceCollector:
         chunk = self.events
         self.events = []
         try:
-            with open(self._resolve_path(), "a") as f:
+            path = self._resolve_path()
+            if not self._wrote_header:
+                # First write into this file: lead with a process_name
+                # metadata event so a merged multi-process trace shows
+                # labeled tracks (client / controller / volume_N) instead
+                # of bare pids.
+                chunk.insert(
+                    0,
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": os.getpid(),
+                        "args": {"name": process_label()},
+                    },
+                )
+            with open(path, "a") as f:
                 for event in chunk:
                     f.write("[\n" if not self._wrote_header else ",\n")
                     self._wrote_header = True
@@ -149,6 +263,20 @@ class TraceCollector:
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
+
+    def reinit_after_fork(self) -> None:
+        """Re-arm in a freshly forked actor child. The forkserver imports
+        this module at ITS start (preload), so children inherit a collector
+        whose ``path`` snapshot predates the spawner's env — e.g. disabled
+        even though TORCHSTORE_TPU_TRACE is set in the child's corrected
+        env. Re-read the env and drop any inherited buffer/claim state so
+        this process claims its own file."""
+        with self._lock:
+            self.path = os.environ.get(ENV_TRACE)
+            self.events = []
+            self._resolved_path = None
+            self._resolved_for = None
+            self._wrote_header = False
 
 
 _collector = TraceCollector()
@@ -172,14 +300,21 @@ class span:
     Attrs are arbitrary small values (key, nbytes, transport, volume, shard
     coords); ``bytes``/``nbytes`` get a derived GBps in the trace. Nesting
     works naturally — Chrome's 'X' events on one tid stack by containment.
+
+    When tracing is enabled each span also mints a ``span_id``, records the
+    active ``trace_id``/``parent_id`` (see observability/context.py), and
+    becomes the parent of anything opened — or any RPC issued — inside it,
+    so per-process files merge into one cross-process tree.
     """
 
-    __slots__ = ("name", "attrs", "_t0")
+    __slots__ = ("name", "attrs", "_t0", "_span_id", "_token")
 
     def __init__(self, name: str, **attrs) -> None:
         self.name = name
         self.attrs = attrs
         self._t0 = 0.0
+        self._span_id = None
+        self._token = None
 
     def set(self, **attrs) -> "span":
         self.attrs.update(attrs)
@@ -187,9 +322,17 @@ class span:
 
     def __enter__(self) -> "span":
         self._t0 = time.perf_counter()
+        if _collector.enabled:
+            self._span_id = trace_context.new_id()
+            self._token = trace_context.push_span(self._span_id)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        parent = None
+        if self._token is not None:
+            parent = trace_context.token_parent(self._token)
+            trace_context.pop_span(self._token)
+            self._token = None
         if not _collector.enabled:
             return
         dur = time.perf_counter() - self._t0
@@ -201,4 +344,102 @@ class span:
             args["bytes"] = args.pop("nbytes")
         if exc_type is not None:
             args["error"] = exc_type.__name__
+        tid = trace_context.trace_id()
+        if tid is not None:
+            args["trace_id"] = tid
+        if self._span_id is not None:
+            args["span_id"] = self._span_id
+        if parent is not None:
+            args["parent_id"] = parent
         _collector.add_event(self.name, self._t0, dur, args or None)
+
+
+# --------------------------------------------------------------------------
+# cross-process trace merging
+# --------------------------------------------------------------------------
+
+
+def load_trace_events(path: str) -> list[dict]:
+    """Events from one per-process trace file. The streaming writer leaves
+    the closing ``]`` off (crash-safe JSON-array format) — repair it here."""
+    try:
+        with open(path) as f:
+            content = f.read().strip()
+    except OSError:
+        return []
+    if not content:
+        return []
+    if not content.endswith("]"):
+        content += "\n]"
+    try:
+        events = json.loads(content)
+    except ValueError:
+        return []
+    return [e for e in events if isinstance(e, dict)]
+
+
+def trace_files(base: str) -> list[str]:
+    """The per-process trace files belonging to one configured base path:
+    the base itself (claimed by whichever process flushed first) plus every
+    pid-suffixed sibling (``<root>.<pid><ext>``). Merged outputs and other
+    non-numeric siblings are excluded."""
+    root, ext = os.path.splitext(base)
+    ext = ext or ".json"
+    pid_re = re.compile(re.escape(root) + r"\.(\d+)" + re.escape(ext) + r"$")
+    out = []
+    if os.path.exists(base):
+        out.append(base)
+    for cand in sorted(_glob.glob(f"{root}.*{ext}")):
+        if pid_re.match(cand):
+            out.append(cand)
+    return out
+
+
+def merge_traces(paths: list[str], out_path: str) -> dict:
+    """Merge per-process trace files into one Perfetto-loadable timeline.
+
+    Events keep their originating pid (one track per process, labeled by
+    each file's ``process_name`` metadata event) and are ordered by
+    timestamp; the shared ``trace_id`` args stitch one logical operation
+    across tracks. Returns ``{"path", "files", "events", "trace_ids"}``."""
+    events: list[dict] = []
+    for path in paths:
+        events.extend(load_trace_events(path))
+    meta = [e for e in events if e.get("ph") == "M"]
+    rest = [e for e in events if e.get("ph") != "M"]
+    rest.sort(key=lambda e: e.get("ts", 0))
+    merged = meta + rest
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    trace_ids = {
+        e["args"]["trace_id"]
+        for e in rest
+        if isinstance(e.get("args"), dict) and "trace_id" in e["args"]
+    }
+    return {
+        "path": out_path,
+        "files": list(paths),
+        "events": len(rest),
+        "trace_ids": sorted(trace_ids),
+    }
+
+
+def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
+    """Flush this process's collector and merge every sibling process's
+    trace file (same configured base path) into one timeline. Returns the
+    merge summary dict, or None when tracing is disabled. Call after the
+    store is shut down so actor processes have flushed their atexit dumps;
+    default output is ``<root>.merged<ext>``."""
+    base = _collector.path or os.environ.get(ENV_TRACE)
+    if not base:
+        return None
+    _collector.flush()
+    files = trace_files(base)
+    if not files:
+        return None
+    if out_path is None:
+        root, ext = os.path.splitext(base)
+        out_path = f"{root}.merged{ext or '.json'}"
+    return merge_traces(files, out_path)

@@ -21,6 +21,8 @@ import traceback
 from typing import Any, Callable, Optional
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import context as trace_context
+from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime.serialization import (
     KIND_CONTROL,
     KIND_ERROR,
@@ -118,6 +120,13 @@ class _Connection:
         req_id = self.next_id
         self.next_id += 1
         body = dict(body, id=req_id)
+        # Distributed tracing: the caller's trace context rides the frame so
+        # server-side spans stitch into the same trace (client put ->
+        # controller notify -> volume put share one trace_id). ~Free when no
+        # trace is active (one contextvar read).
+        ctx = trace_context.current()
+        if ctx is not None:
+            body["trace"] = ctx
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.pending[req_id] = fut
         async with self.write_lock:
@@ -513,9 +522,15 @@ class ActorServer:
             raise RemoteActorError(
                 f"{type(actor).__name__}.{msg['method']} is not an @endpoint"
             )
-        result = method(actor, *msg["args"], **msg["kwargs"])
-        if asyncio.iscoroutine(result):
-            result = await result
+        # Adopt the caller's trace context (if any) for the whole dispatch:
+        # the rpc span and everything the endpoint emits (transport spans,
+        # nested RPCs to other actors) carry the client's trace_id and hang
+        # off the client-side span that issued this request.
+        with trace_context.activate(msg.get("trace")):
+            with span(f"rpc/{msg['method']}", actor=msg["actor"]):
+                result = method(actor, *msg["args"], **msg["kwargs"])
+                if asyncio.iscoroutine(result):
+                    result = await result
         return result
 
     async def serve_until_stopped(self) -> None:
@@ -557,10 +572,31 @@ def _child_main(pipe, actor_cls, name: str, args: tuple, kwargs: dict, env: dict
     from torchstore_tpu import config as _config_mod
 
     _config_mod._default_config = None  # re-seed from the corrected env
+    # Re-arm env-gated observability against the CORRECTED env: the
+    # forkserver's preload imported torchstore with whatever env IT started
+    # under, and its dumper/exporter threads did not survive the fork.
+    from torchstore_tpu import observability as _obs
+
+    _obs.reinit_after_fork()
     try:
         asyncio.run(_child_async(pipe, actor_cls, name, args, kwargs))
     except KeyboardInterrupt:
         pass
+    finally:
+        # Multiprocessing children exit via os._exit, which skips atexit —
+        # the trace collector's and metrics dumper's exit hooks would never
+        # fire in actor processes. Flush both explicitly so a volume's
+        # spans/counters survive a clean stop (crash paths still lose at
+        # most the last partial buffer; the streaming trace format and
+        # periodic dumps keep earlier data loadable).
+        try:
+            from torchstore_tpu.observability import metrics as _obs_metrics
+            from torchstore_tpu.observability.tracing import flush_trace
+
+            flush_trace()
+            _obs_metrics.dump_metrics()
+        except Exception:
+            pass
 
 
 async def _child_async(pipe, actor_cls, name: str, args: tuple, kwargs: dict) -> None:
@@ -600,6 +636,30 @@ def _mp_context() -> mp.context.BaseContext:
         _ctx = mp.get_context(method)
         if method == "forkserver":
             _ctx.set_forkserver_preload(["torchstore_tpu.runtime"])
+            # Launch the forkserver NOW with env-gated observability
+            # stripped: the preload imports torchstore_tpu in the helper
+            # process, which would otherwise start its own metrics dumper /
+            # HTTP exporter for an idle registry — and could win the claim
+            # on the configured dump path or port. Actor children re-arm
+            # from their corrected env in _child_main (reinit_after_fork).
+            from torchstore_tpu.observability import (
+                ENV_METRICS_DUMP,
+                ENV_METRICS_PORT,
+                ENV_TRACE,
+            )
+
+            saved = {}
+            for key in (ENV_METRICS_DUMP, ENV_METRICS_PORT, ENV_TRACE):
+                if key in os.environ:
+                    saved[key] = os.environ.pop(key)
+            try:
+                from multiprocessing import forkserver as _forkserver
+
+                _forkserver.ensure_running()
+            except Exception:  # noqa: BLE001 - lazy start on first spawn
+                pass
+            finally:
+                os.environ.update(saved)
     return _ctx
 
 
@@ -621,6 +681,11 @@ async def spawn_actors(
     loop = asyncio.get_running_loop()
     procs: list[mp.Process] = []
     pipes = []
+    # The whole process tree must share one trace run id BEFORE env capture
+    # (see observability/tracing.py: sibling-vs-stale-run arbitration).
+    from torchstore_tpu.observability.tracing import ensure_run_id
+
+    ensure_run_id()
     # Forward store handles and config to children explicitly: forkserver
     # children inherit the fork server's env (snapshotted at its start), not
     # the parent's current env.

@@ -16,6 +16,7 @@ mechanics live entirely in the transport buffer that rides each RPC.
 from __future__ import annotations
 
 import os
+import time
 from abc import ABC, abstractmethod
 from typing import Any, Optional
 
@@ -23,6 +24,7 @@ import numpy as np
 
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import profile as obs_profile
 from torchstore_tpu.runtime import Actor, endpoint
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
@@ -323,8 +325,6 @@ class StorageVolume(Actor):
         self._publish_residency()
 
     def _bump_write_gens(self, metas: list[Request]) -> dict[str, int]:
-        import time
-
         now = int(time.time() * 1e6)
         gens: dict[str, int] = {}
         for meta in metas:
@@ -334,8 +334,15 @@ class StorageVolume(Actor):
             gens[meta.key] = gen
         return gens
 
+    @staticmethod
+    def _meta_nbytes(meta: Request) -> int:
+        if meta.tensor_meta is not None:
+            return int(meta.tensor_meta.nbytes)
+        return int(meta.nbytes)
+
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
+        t0 = time.perf_counter()
         existing = self.store.extract_existing(metas)
         values = await maybe_await(
             buffer.handle_put_request(self.ctx, metas, existing)
@@ -345,6 +352,15 @@ class StorageVolume(Actor):
         self.store.store(metas, values)
         self._apply_residency_delta(affected, before)
         _PUT_OPS.inc(volume=self.volume_id)
+        # Data-plane profiling: this volume's own hot-key view + slow-op
+        # log (the RPC-dispatch trace context is active here, so a slow put
+        # annotates the client's trace).
+        obs_profile.record_keys(
+            "volume_put",
+            [(meta.key, self._meta_nbytes(meta)) for meta in metas],
+            t0,
+            time.perf_counter() - t0,
+        )
         return {
             "reply": buffer.put_reply(),
             "write_gens": self._bump_write_gens(metas),
@@ -354,9 +370,25 @@ class StorageVolume(Actor):
     async def get(
         self, buffer: TransportBuffer, metas: list[Request]
     ) -> TransportBuffer:
+        t0 = time.perf_counter()
         entries = [self.store.get_data(meta) for meta in metas]
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
         _GET_OPS.inc(volume=self.volume_id)
+        obs_profile.record_keys(
+            "volume_get",
+            [
+                # Object entries are arbitrary user types: only count an
+                # nbytes attribute that is actually a number (same guard as
+                # the client side).
+                (
+                    meta.key,
+                    n if isinstance((n := getattr(entry, "nbytes", 0)), int) else 0,
+                )
+                for meta, entry in zip(metas, entries)
+            ],
+            t0,
+            time.perf_counter() - t0,
+        )
         return buffer
 
     @endpoint
@@ -475,6 +507,9 @@ class StorageVolume(Actor):
             # This volume process's registry (process-local; the controller's
             # stats(include_volumes=True) aggregates the fleet).
             "metrics": obs_metrics.metrics_snapshot(),
+            # Rolling top-K keys by bytes served/stored through THIS volume
+            # (ts.fleet_snapshot collects every volume's view).
+            "hot_keys": obs_profile.hot_keys(10),
         }
         from torchstore_tpu.transport.shared_memory import ShmServerCache
 

@@ -267,3 +267,18 @@ def get_free_port() -> int:
 
 def get_hostname() -> str:
     return socket.gethostname()
+
+
+# jax platform names that mean "a real accelerator is attached". On this
+# image the TPU is reached through the axon tunnel, whose devices report
+# platform 'axon', not 'tpu' — any hardware check that tests only 'tpu'
+# silently falls through to CPU/interpret mode (ADVICE r5). Shared by
+# bench.py's device section, benchmarks/flash_kernel_bench.py, and
+# scripts/tpu_watch.sh's probe.
+DEVICE_PLATFORMS = ("tpu", "axon")
+
+
+def is_device_platform(platform) -> bool:
+    """True when a jax ``device.platform`` string names real TPU hardware
+    (direct or tunneled) rather than a CPU/interpret fallback."""
+    return str(platform).lower() in DEVICE_PLATFORMS
