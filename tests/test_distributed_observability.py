@@ -215,7 +215,12 @@ class TestMergeSnapshots:
 @pytest.mark.anyio
 async def test_fleet_snapshot_covers_controller_and_every_volume():
     import torchstore_tpu as ts
+    from torchstore_tpu.observability import profile
 
+    # The hot-key tracker is process-global and rolling: earlier tests in
+    # the same process may have recorded bigger keys that would evict this
+    # test's tiny one from the top-K — reset for a deterministic envelope.
+    profile.reset_hot_keys()
     await ts.initialize(store_name="obs_fleet", num_storage_volumes=2)
     try:
         arr = np.ones(512, np.float32)

@@ -3,7 +3,10 @@ tilings and request regions must always reassemble to the dense oracle —
 the correctness core everything else stands on."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from torchstore_tpu.transport.types import TensorSlice
 from torchstore_tpu.utils import (

@@ -1,0 +1,461 @@
+"""Cold-start provisioning subsystem tests (ISSUE 3).
+
+Three tiers:
+
+- pure planner math (manifest derivation, segment/dial plans, replication
+  fan-out, oversubscription clamping) — no store needed;
+- fault injection: prewarm failures (broken volume executor, tmpfs too
+  small, uninitialized store) must degrade to the lazy path — the
+  subsequent sync succeeds, errors are reported + counted, nothing raises;
+- tier-1 integration: first-put after ``ts.prewarm`` creates ZERO new pool
+  segments (the volume's ``ts_shm_segments_created_total`` is flat across
+  the put), bulk pre-dial reuse, the auto-hint path, the direct-path plan
+  precompute, and controller capacity reservations.
+"""
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import provision
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.provision.manifest import StateDictManifest
+from torchstore_tpu.provision.planner import (
+    VolumePlan,
+    clamp_to_grant,
+    plan_provisioning,
+)
+
+
+# ---------------------------------------------------------------------------
+# planner math (pure units)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_from_numpy_state_dict():
+    sd = {
+        "layers": {
+            "0": np.zeros((4, 8), np.float32),  # 128 B
+            "1": np.zeros((16,), np.float64),  # 128 B
+        },
+        "step": 7,  # object leaf: not provisioned
+    }
+    m = StateDictManifest.from_state_dict(sd)
+    assert len(m.entries) == 2
+    assert m.total_bytes == 256
+    assert m.segment_sizes() == {128: 2}
+    assert not m.device_resident
+
+
+def test_manifest_transfer_dtype_halves_floating_leaves():
+    sd = {"w": np.zeros((64,), np.float32), "ids": np.zeros((64,), np.int32)}
+    m = StateDictManifest.from_state_dict(sd, transfer_dtype="bfloat16")
+    sizes = m.segment_sizes()
+    # float leaf casts to 2-byte bf16; int leaf crosses uncast.
+    assert sizes == {128: 1, 256: 1}
+
+
+def test_manifest_from_sharded_jax_array():
+    jax = pytest.importorskip("jax")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchstore_tpu import parallel
+
+    mesh = parallel.make_mesh({"x": 4})
+    arr = jax.device_put(
+        np.zeros((8, 4), np.float32), NamedSharding(mesh, P("x", None))
+    )
+    m = StateDictManifest.from_state_dict({"w": arr})
+    (entry,) = m.entries
+    # 4 shards of (2, 4) f32 = 32 B each; derived WITHOUT materializing.
+    assert entry.request_nbytes == (32, 32, 32, 32)
+    assert m.segment_sizes() == {32: 4}
+    assert m.device_resident
+
+
+def test_plan_replication_fanout_and_transport_split():
+    sd = {"a": np.zeros((1024,), np.float32)}  # 4 KB
+    m = StateDictManifest.from_state_dict(sd)
+    plan = plan_provisioning(
+        m,
+        ["v0", "v1", "v2"],
+        {"v0": "shm", "v1": "bulk", "v2": "rpc"},
+    )
+    assert plan.replicas == 3
+    assert plan.volumes["v0"].segment_sizes == {4096: 1}
+    assert plan.volumes["v0"].dials == 0
+    assert plan.volumes["v1"].segment_sizes == {}
+    assert plan.volumes["v1"].dials == 1  # below stripe threshold: main only
+    assert plan.volumes["v2"].segment_sizes == {}
+    assert plan.volumes["v2"].dials == 0
+    assert plan.planned_bytes == 4096  # only the shm leg carries segments
+
+
+def test_plan_bulk_stripe_dials_above_threshold():
+    from torchstore_tpu.transport.bulk import STRIPE_CONNS, STRIPE_THRESHOLD
+
+    m = StateDictManifest(
+        entries=[
+            provision.ManifestEntry(
+                "big", (1,), "float32", (STRIPE_THRESHOLD + 1,)
+            )
+        ]
+    )
+    plan = plan_provisioning(m, ["v0"], {"v0": "bulk"})
+    assert plan.volumes["v0"].dials == STRIPE_CONNS
+
+
+def test_clamp_keeps_largest_segments_first():
+    vp = VolumePlan(
+        volume_id="v0",
+        transport="shm",
+        segment_sizes={100: 3, 1000: 2, 10: 5},
+    )
+    # Budget fits both 1000s and one 100: the big cold allocations win.
+    clamp_to_grant(vp, 2150)
+    assert vp.segment_sizes == {1000: 2, 100: 1, 10: 5}
+    assert vp.clamped_bytes == 200
+    assert vp.planned_bytes <= 2150
+
+
+def test_clamp_zero_grant_drops_plan_and_none_is_ungoverned():
+    vp = VolumePlan("v0", "shm", segment_sizes={64: 2})
+    clamp_to_grant(vp, 0)
+    assert vp.segment_sizes == {}
+    assert vp.clamped_bytes == 128
+    vp2 = VolumePlan("v0", "shm", segment_sizes={64: 2})
+    clamp_to_grant(vp2, None)
+    assert vp2.segment_sizes == {64: 2}
+    assert vp2.clamped_bytes == 0
+
+
+def test_clamp_ignores_non_shm_legs():
+    vp = VolumePlan("v0", "bulk", dials=4)
+    clamp_to_grant(vp, 0)
+    assert vp.dials == 4
+
+
+# ---------------------------------------------------------------------------
+# fault injection: prewarm failure must never fail the sync
+# ---------------------------------------------------------------------------
+
+
+def _errors_total() -> float:
+    metric = obs_metrics.counter(
+        "ts_prewarm_errors_total", "Prewarm stage failures (lazy path proceeded)"
+    )
+    return metric.total()
+
+
+async def test_prewarm_on_uninitialized_store_reports_not_raises():
+    before = _errors_total()
+    report = await ts.prewarm(
+        {"w": np.zeros((8,), np.float32)}, store_name="no_such_store"
+    )
+    assert report["ok"] is False
+    assert report["errors"]
+    assert _errors_total() > before
+
+
+async def test_prewarm_volume_executor_failure_degrades_to_lazy_path(
+    monkeypatch,
+):
+    """A broken volume-side provisioner (colocated, so the monkeypatch
+    reaches it) must leave prewarm ok=False with the stage named, count the
+    error, and the subsequent put/get must work unchanged."""
+    from torchstore_tpu.transport.shared_memory import ShmServerCache
+
+    async def boom(self, sizes, hugepages=True, nthreads=0):
+        raise RuntimeError("injected provision failure")
+
+    await ts.initialize(store_name="pv_fault", colocated=True)
+    try:
+        monkeypatch.setattr(ShmServerCache, "provision", boom)
+        sd = {"w": np.random.rand(65536).astype(np.float32)}  # 256 KB
+        before = _errors_total()
+        report = await ts.prewarm(sd, store_name="pv_fault")
+        assert report["ok"] is False
+        assert any(k.startswith("volume:") for k in report["errors"])
+        assert _errors_total() > before
+        # The lazy path proceeds untouched.
+        await ts.put_state_dict("k/sd", sd, store_name="pv_fault")
+        out = await ts.get_state_dict("k/sd", store_name="pv_fault")
+        np.testing.assert_array_equal(out["w"], sd["w"])
+    finally:
+        await ts.shutdown("pv_fault")
+
+
+async def test_prewarm_clamped_by_tiny_pool_then_sync_succeeds():
+    """tmpfs-too-small analog: a pool cap far below the working set clamps
+    the grant (segments mostly dropped, clamped bytes reported) and the
+    sync still completes on the lazy path."""
+    config = ts.StoreConfig(shm_pool_max_bytes=4096, prewarm_auto=False)
+    await ts.initialize(store_name="pv_small", config=config)
+    try:
+        sd = {
+            str(i): np.random.rand(65536).astype(np.float32) for i in range(4)
+        }  # 4 x 256 KB >> 4 KB cap
+        report = await ts.prewarm(sd, store_name="pv_small")
+        assert report["segments"] == 0
+        assert report["clamped_bytes"] >= 4 * 262144 - 4096
+        await ts.put_state_dict("k/sd", sd, store_name="pv_small")
+        out = await ts.get_state_dict("k/sd", store_name="pv_small")
+        np.testing.assert_array_equal(out["0"], sd["0"])
+    finally:
+        await ts.shutdown("pv_small")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 integration
+# ---------------------------------------------------------------------------
+
+
+async def _volume_created_total(store: str) -> float:
+    stats = await ts.client(store).controller.stats.call_one(
+        include_volumes=True
+    )
+    total = 0.0
+    for vstats in stats["volumes"].values():
+        metric = vstats["metrics"].get("ts_shm_segments_created_total")
+        if metric:
+            total += sum(s["value"] for s in metric["series"])
+    return total
+
+
+async def test_first_put_after_prewarm_creates_zero_segments():
+    """THE acceptance invariant: after ts.prewarm of the working set, the
+    first put draws every segment from the provisioned pool — the volume's
+    segments-created counter does not move across the put, and the client's
+    offers all hit."""
+    await ts.initialize(
+        store_name="pv_zero",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        config=ts.StoreConfig(prewarm_auto=False),
+    )
+    try:
+        sd = {
+            "layers": {
+                str(i): np.random.rand(65536).astype(np.float32)
+                for i in range(3)
+            }
+        }  # 3 x 256 KB: above the inline-put ceiling, handshake path
+        report = await ts.prewarm(sd, store_name="pv_zero")
+        assert report["ok"] and not report["errors"], report
+        assert report["segments"] == 3
+        assert report["bytes"] == 3 * 262144
+        assert report.get("pre_attached") == 3
+        created_before = await _volume_created_total("pv_zero")
+        await ts.put_state_dict("m/sd", sd, store_name="pv_zero")
+        created_after = await _volume_created_total("pv_zero")
+        assert created_after == created_before, (
+            "first put cold-created segments despite prewarm"
+        )
+        out = await ts.get_state_dict("m/sd", store_name="pv_zero")
+        np.testing.assert_array_equal(out["layers"]["0"], sd["layers"]["0"])
+    finally:
+        await ts.shutdown("pv_zero")
+
+
+async def test_prewarm_bulk_predials_promoted_connection():
+    await ts.initialize(
+        store_name="pv_bulk",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+        config=ts.StoreConfig(prewarm_auto=False),
+    )
+    try:
+        from torchstore_tpu.transport.bulk import BulkClientCache
+
+        sd = {"w": np.random.rand(65536).astype(np.float32)}
+        report = await ts.prewarm(sd, store_name="pv_bulk")
+        assert report["ok"] and not report["errors"], report
+        assert report["dials"] == 1
+        client = ts.client("pv_bulk")
+        volume = next(iter(client._volume_refs.values()))
+        cache = volume.transport_context.get_cache(BulkClientCache)
+        assert cache.get_alive(volume.volume_id) is not None
+        conn_before = cache.get_alive(volume.volume_id)
+        await ts.put_state_dict("m/sd", sd, store_name="pv_bulk")
+        # The put rode the PRE-DIALED promoted connection, not a fresh one.
+        assert cache.get_alive(volume.volume_id) is conn_before
+        out = await ts.get_state_dict("m/sd", store_name="pv_bulk")
+        np.testing.assert_array_equal(out["w"], sd["w"])
+    finally:
+        await ts.shutdown("pv_bulk")
+
+
+async def test_auto_prewarm_hint_fires_once_per_signature():
+    runs = obs_metrics.counter(
+        "ts_prewarm_runs_total", "Prewarm invocations (explicit + auto-hint)"
+    )
+    config = ts.StoreConfig(prewarm_auto=True, prewarm_auto_min_bytes=1024)
+    await ts.initialize(store_name="pv_auto", config=config)
+    try:
+        sd = {"w": np.random.rand(65536).astype(np.float32)}
+        before = runs.total()
+        await ts.put_state_dict("m/sd", sd, store_name="pv_auto")
+        assert runs.total() == before + 1  # hint fired ahead of the commit
+        await ts.put_state_dict("m/sd", sd, store_name="pv_auto")
+        assert runs.total() == before + 1  # same signature: once only
+        tiny = {"w": np.zeros((4,), np.float32)}
+        await ts.put_state_dict("tiny/sd", tiny, store_name="pv_auto")
+        assert runs.total() == before + 1  # below min_bytes: no hint
+    finally:
+        await ts.shutdown("pv_auto")
+
+
+async def test_prewarm_direct_acquire_precomputes_plan():
+    hits = obs_metrics.counter(
+        "ts_prewarm_plan_cache_hits_total",
+        "Direct-sync pulls that hit a prewarm-built transfer plan",
+    )
+    await ts.initialize(
+        store_name="pv_direct", config=ts.StoreConfig(prewarm_auto=False)
+    )
+    try:
+        sd = {"w": np.random.rand(4096).astype(np.float32)}
+        await ts.put_state_dict("d/sd", sd, direct=True, store_name="pv_direct")
+        user = {"w": np.zeros(4096, np.float32)}
+        report = await ts.prewarm(
+            user, store_name="pv_direct", acquire_key="d/sd"
+        )
+        assert report["plan_ops"] == 1
+        assert report["segments_attached"] == 1  # same-host shm staging
+        before = hits.total()
+        out = await ts.get_state_dict(
+            "d/sd", user_state_dict=user, direct=True, store_name="pv_direct"
+        )
+        np.testing.assert_array_equal(out["w"], sd["w"])
+        assert hits.total() == before + 1  # iteration 0 hit the preplan
+    finally:
+        await ts.shutdown("pv_direct")
+
+
+async def test_prewarm_direct_source_draws_local_staging():
+    from torchstore_tpu.provision.pool import local_pool
+
+    await ts.initialize(
+        store_name="pv_src", config=ts.StoreConfig(prewarm_auto=False)
+    )
+    try:
+        sd = {"w": np.random.rand(65536).astype(np.float32)}
+        report = await ts.prewarm(sd, store_name="pv_src", direct=True)
+        assert report["local_segments"] == 1
+        assert local_pool().pooled_bytes == 262144
+        # register() (first direct publish) draws the provisioned segment.
+        await ts.put_state_dict("d/sd", sd, direct=True, store_name="pv_src")
+        assert local_pool().pooled_bytes == 0
+        user = {"w": np.zeros(65536, np.float32)}
+        out = await ts.get_state_dict(
+            "d/sd", user_state_dict=user, direct=True, store_name="pv_src"
+        )
+        np.testing.assert_array_equal(out["w"], sd["w"])
+    finally:
+        await ts.shutdown("pv_src")
+
+
+async def test_reservations_prevent_oversubscription():
+    """Two concurrent reservations can't both get the full headroom; release
+    returns the capacity."""
+    await ts.initialize(
+        store_name="pv_res", config=ts.StoreConfig(prewarm_auto=False)
+    )
+    try:
+        client = ts.client("pv_res")
+        await client._ensure_setup()
+        vid = next(iter(client._volume_refs))
+        cap = await client._volume_refs[vid].actor.shm_capacity.call_one()
+        headroom = min(
+            cap["available_bytes"], cap["pool_cap"] - cap["pool_bytes"]
+        )
+        ask = headroom  # first reservation takes everything
+        r1 = await client.controller.reserve_prewarm.call_one("r1", {vid: ask})
+        assert r1["grants"][vid] == ask
+        r2 = await client.controller.reserve_prewarm.call_one("r2", {vid: ask})
+        assert r2["grants"][vid] == 0  # fully reserved: nothing left
+        await client.controller.release_prewarm.call_one("r1")
+        r3 = await client.controller.reserve_prewarm.call_one("r3", {vid: ask})
+        assert r3["grants"][vid] == ask  # release returned the capacity
+        await client.controller.release_prewarm.call_one("r2")
+        await client.controller.release_prewarm.call_one("r3")
+    finally:
+        await ts.shutdown("pv_res")
+
+
+async def test_reservations_net_tmpfs_per_host():
+    """Volumes co-located on one host share /dev/shm: grants across them
+    must be netted against ONE host budget, not each volume's independent
+    view of the same tmpfs."""
+    from torchstore_tpu.transport.shared_memory import shm_available_bytes
+
+    await ts.initialize(
+        store_name="pv_host",
+        num_storage_volumes=2,
+        config=ts.StoreConfig(prewarm_auto=False),
+    )
+    try:
+        client = ts.client("pv_host")
+        await client._ensure_setup()
+        vids = sorted(client._volume_refs)
+        avail = shm_available_bytes()
+        # Pool caps far above tmpfs so the HOST budget is the binding
+        # constraint; each volume asks 80% of the tmpfs.
+        big = ts.StoreConfig(
+            shm_pool_max_bytes=avail * 4, prewarm_auto=False
+        )
+        ask = int(avail * 0.8)
+        res = await client.controller.reserve_prewarm.call_one(
+            "host1", {vids[0]: ask, vids[1]: ask}, config=big
+        )
+        grants = res["grants"]
+        assert sum(grants.values()) <= avail, (grants, avail)
+        assert grants[vids[1]] < ask  # second volume got the remainder only
+        await client.controller.release_prewarm.call_one("host1")
+    finally:
+        await ts.shutdown("pv_host")
+
+
+async def test_concurrent_reservations_cannot_overgrant():
+    """Two reservations issued CONCURRENTLY (the endpoint suspends on the
+    volumes' capacity RPCs) must not collectively grant more than the
+    volume's headroom — the placeholder-before-await closes the
+    read-compute-write race."""
+    import asyncio
+
+    await ts.initialize(
+        store_name="pv_race", config=ts.StoreConfig(prewarm_auto=False)
+    )
+    try:
+        client = ts.client("pv_race")
+        await client._ensure_setup()
+        vid = next(iter(client._volume_refs))
+        cap = await client._volume_refs[vid].actor.shm_capacity.call_one()
+        headroom = min(
+            cap["available_bytes"], cap["pool_cap"] - cap["pool_bytes"]
+        )
+        r1, r2 = await asyncio.gather(
+            client.controller.reserve_prewarm.call_one("c1", {vid: headroom}),
+            client.controller.reserve_prewarm.call_one("c2", {vid: headroom}),
+        )
+        assert r1["grants"][vid] + r2["grants"][vid] <= headroom, (r1, r2)
+        await client.controller.release_prewarm.call_one("c1")
+        await client.controller.release_prewarm.call_one("c2")
+    finally:
+        await ts.shutdown("pv_race")
+
+
+async def test_weight_publisher_register_prewarms_channel():
+    await ts.initialize(
+        store_name="pv_chan", config=ts.StoreConfig(prewarm_auto=False)
+    )
+    try:
+        sd = {"w": np.random.rand(65536).astype(np.float32)}
+        pub = ts.WeightPublisher("policy", store_name="pv_chan")
+        report = await pub.register(sd)
+        assert report["ok"] and report["segments"] == 1, report
+        version = await pub.publish(sd)
+        sub = ts.WeightSubscriber("policy", store_name="pv_chan")
+        out, got = await sub.acquire(timeout=60.0)
+        assert got == version
+        np.testing.assert_array_equal(out["w"], sd["w"])
+    finally:
+        await ts.shutdown("pv_chan")

@@ -27,6 +27,7 @@ from torchstore_tpu.api import (
     initialize_spmd,
     keys,
     metrics_snapshot,
+    prewarm,
     put,
     put_batch,
     put_state_dict,
@@ -35,6 +36,7 @@ from torchstore_tpu.api import (
     shutdown,
     wait_for,
 )
+from torchstore_tpu.provision import StateDictManifest
 from torchstore_tpu.client import LocalClient
 from torchstore_tpu.weight_channel import WeightPublisher, WeightSubscriber
 from torchstore_tpu.config import StoreConfig
@@ -72,6 +74,7 @@ __all__ = [
     "Request",
     "Shard",
     "SingletonStrategy",
+    "StateDictManifest",
     "StoreConfig",
     "StoreStrategy",
     "TensorMeta",
@@ -94,6 +97,7 @@ __all__ = [
     "initialize_spmd",
     "keys",
     "metrics_snapshot",
+    "prewarm",
     "put",
     "put_batch",
     "direct_staging_buffers",

@@ -503,6 +503,98 @@ async def repair(store_name: str = DEFAULT_STORE) -> dict:
     return report
 
 
+async def prewarm(
+    state_dict_or_manifest: Any,
+    store_name: str = DEFAULT_STORE,
+    transfer_dtype=None,
+    direct: bool = False,
+    acquire_key: Optional[str] = None,
+) -> dict:
+    """Cold-start provisioning: size and warm every layer the first sync of
+    this working set will touch, BEFORE the first byte moves.
+
+    Accepts a state dict (nested; jax/numpy/torch/ShapeDtypeStruct leaves —
+    only metadata is read, no device->host copies) or a prebuilt
+    :class:`~torchstore_tpu.provision.StateDictManifest`. The planner fans
+    the manifest out over the strategy's put volumes (replication included),
+    reserves tmpfs capacity through the controller (concurrent prewarms
+    can't oversubscribe /dev/shm), then provisions per transport rung:
+    SHM volumes pre-create hugepage-advised, prefaulted pool segments; bulk
+    volumes pre-dial the promoted connection (+ stripe set for payloads
+    above the striping threshold); device-resident working sets start the
+    ICI transfer server.
+
+    ``direct=True`` additionally pre-creates the client-local staging
+    segments a direct-source ``register`` will draw. ``acquire_key`` (with
+    the state dict as the ACQUIRE targets) precomputes the direct-dest
+    transfer plan for an already-published direct key: plan build, source
+    dials, and same-host segment attaches all happen now, so iteration 0 of
+    ``get_state_dict(direct=True)`` / ``WeightSubscriber.acquire`` starts at
+    the data movement.
+
+    ADVISORY by contract: prewarm never raises and never fails the
+    subsequent sync — stage failures are logged, counted in
+    ``ts_prewarm_errors_total``, reported in the returned dict, and the
+    lazy path serves exactly as before. Returns the provisioning report
+    (``segments``, ``bytes``, ``dials``, ``granted_bytes``, ``errors``,
+    ...)."""
+    from torchstore_tpu import provision
+
+    def _advisory_failure(stage: str, exc: Exception) -> dict:
+        logger.warning(
+            "prewarm %s failed: %s; lazy path will serve", stage, exc
+        )
+        obs_metrics.counter(
+            "ts_prewarm_errors_total",
+            "Prewarm stage failures (lazy path proceeded)",
+        ).inc(stage=stage)
+        return {"ok": False, "errors": {stage: str(exc)}}
+
+    try:
+        c = client(store_name)
+    except Exception as exc:  # noqa: BLE001 - advisory, never raises
+        return _advisory_failure("client", exc)
+    if acquire_key is not None:
+        from torchstore_tpu import state_dict_utils
+
+        try:
+            return await state_dict_utils.preplan_direct(
+                c, acquire_key, state_dict_or_manifest
+            )
+        except Exception as exc:  # noqa: BLE001 - advisory
+            return _advisory_failure("preplan", exc)
+    try:
+        arrays = None
+        if isinstance(state_dict_or_manifest, provision.StateDictManifest):
+            manifest = state_dict_or_manifest
+        else:
+            # ONE flatten serves both the manifest and the registration
+            # scan (flattening an already-flat dict is a shallow pass).
+            import numpy as _np
+
+            from torchstore_tpu.state_dict_utils import flatten_state_dict
+
+            flat, _ = flatten_state_dict(state_dict_or_manifest)
+            manifest = provision.StateDictManifest.from_state_dict(
+                flat, transfer_dtype=transfer_dtype
+            )
+            if transfer_dtype is None:
+                # Real source buffers in hand: feed the bulk registration
+                # cache too (numpy leaves only; a transfer-dtype cast
+                # produces fresh arrays at put time, which the put
+                # registers itself).
+                arrays = [
+                    v for v in flat.values() if isinstance(v, _np.ndarray)
+                ]
+    except Exception as exc:  # noqa: BLE001 - manifest derivation is
+        # advisory too (e.g. flatten's duplicate-key ValueError): the sync
+        # itself will surface real problems loudly.
+        return _advisory_failure("manifest", exc)
+    return await provision.prewarm_manifest(
+        c, manifest, direct=direct, arrays=arrays
+    )
+
+
 def metrics_snapshot() -> dict:
     """This process's observability registry: every counter/gauge/histogram
     the store's layers emit (client ops, per-transport bytes, SHM pool
@@ -608,6 +700,15 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
         from torchstore_tpu import state_dict_utils
 
         await state_dict_utils.close_direct_caches(handle.client)
+    # Release prewarmed-but-undrawn direct staging segments once the LAST
+    # store is gone (the pool is process-local and advisory; another live
+    # store may have prewarmed it, so a per-store shutdown must not discard
+    # its segments — but without this, segments a register() never took
+    # would pin tmpfs until process exit).
+    if not _stores:
+        from torchstore_tpu.provision.pool import local_pool
+
+        local_pool().clear()
     if handle.owner:
         try:
             await handle.controller.teardown.call_one()
@@ -641,6 +742,7 @@ __all__ = [
     "initialize_spmd",
     "keys",
     "metrics_snapshot",
+    "prewarm",
     "put",
     "put_batch",
     "direct_staging_buffers",

@@ -85,6 +85,32 @@ class StoreConfig:
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_USE_NATIVE", True)
     )
 
+    # --- cold-start provisioning (prewarm) ----------------------------------
+    # Automatic hint path: put_state_dict derives a manifest from the state
+    # dict and provisions pools/dials BEFORE the data-plane puts, so the very
+    # first sync of a working set draws pre-faulted segments instead of
+    # allocating cold on the critical path. Only fires for working sets of
+    # prewarm_auto_min_bytes or more (tiny dicts would pay RPC overhead for
+    # nothing) and at most once per distinct size-signature per client.
+    prewarm_auto: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_PREWARM_AUTO", True)
+    )
+    prewarm_auto_min_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_PREWARM_AUTO_MIN_BYTES", 32 << 20
+        )
+    )
+    # madvise(MADV_HUGEPAGE) on provisioned segments so tmpfs backs them with
+    # transparent huge pages where the kernel allows (fewer TLB misses on the
+    # hot memcpy; fail-open — plain pages otherwise).
+    prewarm_hugepages: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_PREWARM_HUGEPAGES", True)
+    )
+    # Threads for the native prefault of provisioned segments (0 = auto).
+    prewarm_threads: int = field(
+        default_factory=lambda: _env_int("TORCHSTORE_TPU_PREWARM_THREADS", 0)
+    )
+
     # --- security -----------------------------------------------------------
     # Shared secret for connection auth (HMAC challenge-response on every
     # actor/rendezvous/bulk/peer-read listener). Empty = auth disabled; set

@@ -40,6 +40,10 @@ _RECLAIMED = obs_metrics.counter(
     "ts_controller_reclaimed_keys_total",
     "Stale copies deleted by the background reclaim",
 )
+_PREWARM_RESERVED = obs_metrics.gauge(
+    "ts_prewarm_reserved_bytes",
+    "tmpfs bytes held by live prewarm reservations, per volume",
+)
 
 
 class ObjectType(Enum):
@@ -169,6 +173,13 @@ class Controller(Actor):
         self._pending_reclaims: dict[str, dict[str, int]] = {}
         self._reclaim_running: set = set()
         self._reclaim_tasks: set = set()
+        # Prewarm capacity reservations: rid -> (monotonic expiry,
+        # {volume_id: granted bytes}). Grants are counted against volume
+        # tmpfs headroom so CONCURRENT prewarms (several trainers booting on
+        # one host) can't collectively oversubscribe /dev/shm; a crashed
+        # prewarmer's reservation expires by TTL instead of pinning capacity
+        # forever.
+        self._prewarm_reservations: dict[str, tuple[float, dict[str, int]]] = {}
 
     def _cond(self):
         import asyncio
@@ -670,6 +681,132 @@ class Controller(Actor):
             )
             return {"gen": self._key_gens.get(key, 0), "state": state}
 
+    # ---- prewarm capacity reservations -----------------------------------
+
+    def _expire_prewarm(self) -> None:
+        import time
+
+        now = time.monotonic()
+        for rid in [
+            r
+            for r, (expiry, _) in self._prewarm_reservations.items()
+            if expiry <= now
+        ]:
+            del self._prewarm_reservations[rid]
+        outstanding: dict[str, int] = {vid: 0 for vid in self.volume_refs}
+        for _, grants in self._prewarm_reservations.values():
+            for vid, nbytes in grants.items():
+                outstanding[vid] = outstanding.get(vid, 0) + nbytes
+        for vid, nbytes in outstanding.items():
+            _PREWARM_RESERVED.set(nbytes, volume=vid)
+
+    @endpoint
+    async def reserve_prewarm(
+        self,
+        reservation_id: str,
+        asks: dict[str, int],
+        ttl_s: float = 120.0,
+        config=None,
+    ) -> dict[str, Any]:
+        """Grant tmpfs capacity for a prewarm: for each asked volume, the
+        grant is ``min(ask, volume headroom - outstanding grants)`` where
+        headroom is the smaller of actual /dev/shm availability and the
+        pool cap's remaining room (the volume's own view via its
+        ``shm_capacity`` endpoint). Unreachable volumes grant 0 and land in
+        ``errors`` — the prewarmer skips them and the lazy path serves.
+        Returns ``{"grants": {vid: bytes}, "errors": {vid: reason}}``."""
+        import asyncio
+        import time
+
+        self._expire_prewarm()
+        outstanding: dict[str, int] = {}
+        for _, grants in self._prewarm_reservations.values():
+            for vid, nbytes in grants.items():
+                outstanding[vid] = outstanding.get(vid, 0) + nbytes
+        # Placeholder reservation at the FULL ask BEFORE awaiting the
+        # capacity RPCs: endpoints dispatch concurrently, so without it two
+        # simultaneous reservers would both compute headroom against the
+        # same outstanding set and collectively over-grant — the exact
+        # oversubscription this endpoint exists to prevent. Pessimistic
+        # (may under-grant a concurrent peer); replaced by the real grants
+        # below, dropped on failure.
+        self._prewarm_reservations[reservation_id] = (
+            time.monotonic() + ttl_s,
+            {vid: int(nbytes) for vid, nbytes in asks.items()},
+        )
+
+        async def capacity(vid: str):
+            ref = self.volume_refs.get(vid)
+            if ref is None:
+                return vid, None, "unknown volume"
+            try:
+                # The asking client's config rides along so the volume
+                # reports headroom against the POOL CAP the later
+                # provision_shm will actually run under.
+                info = await asyncio.wait_for(
+                    ref.shm_capacity.call_one(config), timeout=10.0
+                )
+                return vid, info, None
+            except Exception as exc:  # noqa: BLE001 - reported, not raised
+                return vid, None, f"{type(exc).__name__}: {exc}"
+
+        try:
+            results = await asyncio.gather(
+                *(capacity(vid) for vid in sorted(asks))
+            )
+        except BaseException:
+            self._prewarm_reservations.pop(reservation_id, None)
+            raise
+        # tmpfs is a PER-HOST resource: volumes co-located on one host share
+        # /dev/shm, so availability is budgeted per hostname (each co-located
+        # volume reports the same tmpfs; take the min) with outstanding
+        # grants netted per host too — otherwise two volumes on one host
+        # could be jointly granted more than the tmpfs holds. Pool-cap
+        # headroom stays per volume (each volume owns its pool).
+        host_of = {
+            vid: self.volume_hostnames.get(vid, vid) for vid in asks
+        }
+        host_budget: dict[str, int] = {}
+        for vid, info, err in results:
+            if info is not None and info.get("shm"):
+                host = host_of[vid]
+                avail = int(info["available_bytes"])
+                host_budget[host] = min(host_budget.get(host, avail), avail)
+        for rid_vid, nbytes in outstanding.items():
+            host = self.volume_hostnames.get(rid_vid, rid_vid)
+            if host in host_budget:
+                host_budget[host] = max(0, host_budget[host] - nbytes)
+        granted: dict[str, int] = {}
+        errors: dict[str, str] = {}
+        for vid, info, err in results:
+            if info is None or not info.get("shm"):
+                granted[vid] = 0
+                errors[vid] = err or "shm unavailable on volume"
+                continue
+            host = host_of[vid]
+            cap_headroom = max(
+                0, int(info["pool_cap"]) - int(info["pool_bytes"])
+            ) - outstanding.get(vid, 0)
+            grant = max(
+                0,
+                min(int(asks[vid]), cap_headroom, host_budget.get(host, 0)),
+            )
+            host_budget[host] = host_budget.get(host, 0) - grant
+            granted[vid] = grant
+        self._prewarm_reservations[reservation_id] = (
+            time.monotonic() + ttl_s,
+            dict(granted),
+        )
+        self._expire_prewarm()
+        return {"grants": granted, "errors": errors}
+
+    @endpoint
+    async def release_prewarm(self, reservation_id: str) -> None:
+        """Drop a reservation once its provisioning landed (the pool itself
+        now holds the bytes) or was abandoned. Idempotent."""
+        self._prewarm_reservations.pop(reservation_id, None)
+        self._expire_prewarm()
+
     @endpoint
     async def check_volumes(self, timeout: float = 5.0) -> dict[str, str]:
         """Health-check every volume (failure detection — SURVEY §5 notes
@@ -862,6 +999,8 @@ class Controller(Actor):
         for task in list(self._reclaim_tasks):
             task.cancel()
         self._reclaim_tasks.clear()
+        self._prewarm_reservations.clear()
+        self._expire_prewarm()  # zero the reserved-bytes gauges too
         self.index = Trie()
         await asyncio.gather(
             *(ref.reset.call_one() for ref in self.volume_refs.values()),

@@ -30,6 +30,7 @@ import numpy as np
 from torchstore_tpu import sharding as shd
 from torchstore_tpu.logging import LatencyTracker, get_logger
 from torchstore_tpu.native import copy_into
+from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.state_dict_utils import flatten_state_dict
 from torchstore_tpu.transport import shared_memory as shm
 from torchstore_tpu.transport.types import TensorMeta, TensorSlice
@@ -42,6 +43,14 @@ from torchstore_tpu.utils import (
 )
 
 logger = get_logger("torchstore_tpu.direct")
+
+# Cold-start observability: a first pull that reuses a plan built by
+# ``ts.prewarm`` (DirectWeightSyncDest.preplan) counts here — the signal
+# that iteration 0 skipped plan construction.
+_PLAN_PREWARM_HITS = obs_metrics.counter(
+    "ts_prewarm_plan_cache_hits_total",
+    "Direct-sync pulls that hit a prewarm-built transfer plan",
+)
 
 
 class PullRaceError(RuntimeError):
@@ -376,7 +385,14 @@ class DirectWeightSyncSource:
                 self._next_id += 1
                 shm_name = None
                 if self.use_shm:
-                    seg = shm.ShmSegment.create(max(host_arr.nbytes, 1))
+                    # Prewarmed staging: an exact-size pre-faulted segment
+                    # from the client-local pool (ts.prewarm direct=True)
+                    # skips the cold create+zero on the first publish.
+                    from torchstore_tpu.provision.pool import local_pool
+
+                    seg = local_pool().take(max(host_arr.nbytes, 1))
+                    if seg is None:
+                        seg = shm.ShmSegment.create(max(host_arr.nbytes, 1))
                     staged = seg.view(TensorMeta.of(host_arr))
                     np.copyto(staged, host_arr)
                     self.segments[buffer_id] = seg
@@ -557,7 +573,22 @@ class DirectWeightSyncSource:
                 buffer_id = self._next_id
                 self._next_id += 1
                 self._host_fallback_ids[idx] = buffer_id
-            self.server.buffers[buffer_id] = host_arr
+            # Staging-buffer reuse across generations: land the new bytes in
+            # the SAME published buffer when layout is unchanged — its pages
+            # are already faulted and any warm reader connection keeps
+            # serving one stable address (refresh-in-place, like the host
+            # path's registered buffers). Seqlock busy/gen markers already
+            # fence readers during the overwrite.
+            staged = self.server.buffers.get(buffer_id)
+            if (
+                staged is not None
+                and staged.shape == host_arr.shape
+                and staged.dtype == host_arr.dtype
+            ):
+                np.copyto(staged, host_arr)
+                host_arr = staged
+            else:
+                self.server.buffers[buffer_id] = host_arr
             handles.setdefault(flat_key, []).append(
                 WeightHandle(
                     buffer_id=buffer_id,
@@ -837,6 +868,9 @@ class DirectWeightSyncDest:
         self._conns: dict[tuple[str, int], dict] = {}
         self._segments: dict[str, shm.ShmSegment] = {}
         self._lock = asyncio.Lock()
+        # Set by preplan() (the ts.prewarm transfer-plan precompute); the
+        # first pull that reuses the preplanned plan counts a cache hit.
+        self._preplanned = False
 
     # ---- plan -------------------------------------------------------------
 
@@ -959,13 +993,10 @@ class DirectWeightSyncDest:
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 0.25)
 
-    async def _pull_once(
-        self,
-        all_handles: dict[str, list[WeightHandle]],
-        dest_state_dict: Any,
-    ) -> Any:
-        tracker = LatencyTracker("direct_pull")
-        dest_flat, mapping = flatten_state_dict(dest_state_dict)
+    @staticmethod
+    def _plan_signature(
+        all_handles: dict[str, list[WeightHandle]], dest_flat: dict[str, Any]
+    ) -> tuple:
         # The signature must cover the dest layouts, not just key names — a
         # changed target sharding must rebuild the plan (and re-run its
         # coverage validation), never reuse a stale one.
@@ -996,10 +1027,90 @@ class DirectWeightSyncDest:
                 for k, v in all_handles.items()
             )
         )
-        sig = (handle_sig, target_sig)
-        if self._plan is None or self._plan_sig != sig:
-            self._plan = self._build_plan(all_handles, dest_flat)
-            self._plan_sig = sig
+        return (handle_sig, target_sig)
+
+    def _ensure_plan(
+        self,
+        all_handles: dict[str, list[WeightHandle]],
+        dest_flat: dict[str, Any],
+    ) -> bool:
+        """Build (or reuse) the transfer plan for this handle/target pair;
+        returns True when the cached plan was reused."""
+        sig = self._plan_signature(all_handles, dest_flat)
+        if self._plan is not None and self._plan_sig == sig:
+            return True
+        self._plan = self._build_plan(all_handles, dest_flat)
+        self._plan_sig = sig
+        return False
+
+    async def preplan(
+        self,
+        all_handles: dict[str, list[WeightHandle]],
+        dest_state_dict: Any,
+    ) -> dict:
+        """Transfer-plan precompute (the ts.prewarm hook for the direct
+        path): build + cache the plan, pre-dial every source endpoint's
+        first connection, and pre-attach same-host SHM staging segments —
+        so iteration 0 of acquire() pays only the data movement. Failures
+        are per-resource and advisory (the lazy path re-dials/attaches as
+        before); the plan itself raises on genuine coverage errors so a
+        misconfigured dest fails at prewarm time rather than mid-sync."""
+        dest_flat, _ = flatten_state_dict(dest_state_dict)
+        reused = self._ensure_plan(all_handles, dest_flat)
+        self._preplanned = True
+        dials = 0
+        dial_errors = 0
+        endpoints = sorted(
+            {
+                (h.hostname, h.port)
+                for handle_list in all_handles.values()
+                for h in handle_list
+            }
+        )
+        for hostname, port in endpoints:
+            host = "127.0.0.1" if hostname == get_hostname() else hostname
+            try:
+                await self._get_conn(host, port)
+                dials += 1
+            except Exception:  # noqa: BLE001 - advisory; lazy path re-dials
+                dial_errors += 1
+        attached = 0
+        for handle_list in all_handles.values():
+            for h in handle_list:
+                if (
+                    h.shm_name is None
+                    or h.hostname != get_hostname()
+                    or h.shm_name in self._segments
+                ):
+                    continue
+                try:
+                    self._segments[h.shm_name] = shm.ShmSegment.attach(
+                        h.shm_name, max(h.meta.nbytes, 1), populate=True
+                    )
+                    attached += 1
+                except OSError:
+                    pass  # source gone/re-registered; lazy path resolves
+        return {
+            "plan_ops": len(self._plan or ()),
+            "plan_reused": reused,
+            "dials": dials,
+            "dial_errors": dial_errors,
+            "segments_attached": attached,
+        }
+
+    async def _pull_once(
+        self,
+        all_handles: dict[str, list[WeightHandle]],
+        dest_state_dict: Any,
+    ) -> Any:
+        tracker = LatencyTracker("direct_pull")
+        dest_flat, mapping = flatten_state_dict(dest_state_dict)
+        reused = self._ensure_plan(all_handles, dest_flat)
+        if reused and self._preplanned:
+            # Iteration-0 hit on a prewarm-built plan: the cold/steady gap's
+            # plan component was paid at prewarm time.
+            _PLAN_PREWARM_HITS.inc()
+            self._preplanned = False
         tracker.track_step("plan")
 
         # Host landing buffers per (flat_key, target slice). A numpy target

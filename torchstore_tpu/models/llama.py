@@ -301,23 +301,33 @@ def _attend(cfg: LlamaConfig, q, k, v):
         ):
             head_axis = "tp"
             tp_size = size
-    if cfg.attn_impl == "ulysses":
+    impl = cfg.attn_impl
+    if impl == "ulysses":
         # Divisibility applies to the SHARD-LOCAL head counts (after any tp
-        # split); kv heads pass through unrepeated (GQA-native).
+        # split); kv heads pass through unrepeated (GQA-native). Indivisible
+        # head counts FALL BACK to ring attention (which has no head
+        # constraint — k/v blocks rotate whole) instead of failing the
+        # forward pass: the model keeps training, one warning names the
+        # boundary that was hit.
         local_heads = cfg.num_heads // tp_size
         local_kv = cfg.num_kv_heads // tp_size
         if local_heads % sp_size != 0 or local_kv % sp_size != 0:
-            raise ValueError(
-                f"ulysses attention needs per-shard head counts "
-                f"(q={local_heads}, kv={local_kv}) divisible by the sp axis "
-                f"size ({sp_size}); use attn_impl='ring' for smaller head "
-                "counts"
+            from torchstore_tpu.logging import get_logger
+
+            get_logger("torchstore_tpu.models.llama").warning(
+                "ulysses attention needs per-shard head counts (q=%d, kv=%d) "
+                "divisible by the sp axis size (%d); falling back to ring "
+                "attention for this config",
+                local_heads,
+                local_kv,
+                sp_size,
             )
-    body = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+            impl = "ring"
+    body = ring_attention if impl == "ring" else ulysses_attention
     fn = make_sharded_attention(
         body, cfg.mesh, "sp", True, head_axis,
         # Ring's default ("auto") body may run the fused pallas kernel.
-        relax_vma=cfg.attn_impl == "ring",
+        relax_vma=impl == "ring",
     )
     return fn(q, k, v)
 

@@ -28,6 +28,9 @@ PARALLEL_THRESHOLD = 8 * 1024 * 1024
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+# True once a v2+ library bound the threaded-prefault entry (v1 binaries
+# carry an incompatible 2-arg ts_prefault that must never be called).
+_has_prefault = False
 
 
 def _try_build() -> bool:
@@ -83,7 +86,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ts_write_fd.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
         lib.ts_write_fd.restype = ctypes.c_int64
         lib.ts_version.restype = ctypes.c_uint32
-        assert lib.ts_version() == 1
+        version = lib.ts_version()
+        assert version in (1, 2), version
+        if version >= 2:
+            # v2: multi-threaded page prefault (the provisioning subsystem's
+            # prewarm entry). v1 binaries carry an incompatible 2-arg
+            # ts_prefault — never bind it there.
+            lib.ts_prefault.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.ts_prefault.restype = ctypes.c_int
+            global _has_prefault
+            _has_prefault = True
+        else:
+            logger.info("native library is v1 (no threaded prefault)")
         _lib = lib
         logger.info("native data path loaded (%s)", _LIB_PATH)
     except Exception as exc:
@@ -143,6 +159,21 @@ def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
     if fast_copy_2d(dst, src):
         return
     np.copyto(dst, src)
+
+
+def prefault(addr: int, length: int, nthreads: int = 0) -> bool:
+    """Multi-threaded prefault of ``length`` bytes at ``addr`` (one write per
+    page, spread over ``nthreads``; 0 = auto). Returns True when the native
+    path ran; False means the caller must fall back to touching pages itself
+    (v1 library or numpy-only build). Used by the provisioning subsystem to
+    pre-allocate tmpfs segment pages off the first-sync critical path."""
+    lib = get_lib()
+    if lib is None or not _has_prefault:
+        return False
+    if length <= 0:
+        return True
+    lib.ts_prefault(addr, length, nthreads)
+    return True
 
 
 def fast_copy_2d(dst: np.ndarray, src: np.ndarray) -> bool:

@@ -41,11 +41,19 @@ def make_sharded_attention(
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     if relax_vma:
-        try:
-            fn = shard_map(
-                functools.partial(body, **kwargs), check_vma=False, **sm_kwargs
-            )
-        except TypeError:  # older jax: no check_vma kwarg
+        # The relax knob was renamed across jax versions (check_rep ->
+        # check_vma); try the current name first, then the older one. Bodies
+        # running pallas kernels need ONE of them off, or shard_map's
+        # replication checker rejects pallas_call outright.
+        for kw in ("check_vma", "check_rep"):
+            try:
+                fn = shard_map(
+                    functools.partial(body, **kwargs), **{kw: False}, **sm_kwargs
+                )
+                break
+            except TypeError:  # this jax doesn't know the kwarg
+                continue
+        else:  # neither name exists: run with checking on
             fn = shard_map(functools.partial(body, **kwargs), **sm_kwargs)
     else:
         fn = shard_map(functools.partial(body, **kwargs), **sm_kwargs)

@@ -1,0 +1,58 @@
+"""Cold-start provisioning: manifest-driven prewarm of pools, transports,
+and transfer plans.
+
+The store's steady state is fast (segment-reuse handshakes, promoted bulk
+connections, cached transfer plans) but the FIRST sync of a working set pays
+every layer's lazy setup on its critical path: tmpfs segment allocation and
+page faults, TCP dials, transfer-plan construction, device-transfer-server
+startup. This subsystem provisions all of it ahead of time from a
+**StateDictManifest** — keys, shapes, dtypes, shardings, total bytes —
+derived from a live state dict (metadata only; no bytes move) or built by
+hand before weights exist.
+
+    planner      manifest + fleet topology -> per-volume segment/dial plan
+                 (provision/planner.py, pure math)
+    reservation  controller-arbitrated tmpfs capacity grants so concurrent
+                 prewarms can't oversubscribe /dev/shm (controller.py)
+    executors    pool pre-sizing with hugepage-backed, native-threaded
+                 prefault (shared_memory / tsnative.cc), bulk pre-dial +
+                 registration prewarm (bulk.py), ICI server start
+                 (device_transfer.py), direct-path plan precompute
+                 (direct_weight_sync.py)
+    api          ``ts.prewarm(...)`` plus the automatic hint path in
+                 ``put_state_dict`` / ``WeightPublisher.register``
+
+Failure contract: prewarm is ADVISORY. Any stage failing logs, increments
+``ts_prewarm_errors_total``, and the subsequent sync proceeds on the lazy
+path unchanged.
+"""
+
+from torchstore_tpu.provision.executors import (
+    as_manifest,
+    maybe_auto_prewarm,
+    prewarm_manifest,
+)
+from torchstore_tpu.provision.manifest import ManifestEntry, StateDictManifest
+from torchstore_tpu.provision.planner import (
+    ProvisionPlan,
+    VolumePlan,
+    clamp_to_grant,
+    expected_bulk_conns,
+    plan_provisioning,
+)
+from torchstore_tpu.provision.pool import LocalSegmentPool, local_pool
+
+__all__ = [
+    "LocalSegmentPool",
+    "ManifestEntry",
+    "ProvisionPlan",
+    "StateDictManifest",
+    "VolumePlan",
+    "as_manifest",
+    "clamp_to_grant",
+    "expected_bulk_conns",
+    "local_pool",
+    "maybe_auto_prewarm",
+    "plan_provisioning",
+    "prewarm_manifest",
+]

@@ -1,0 +1,176 @@
+"""StateDictManifest: the shape of a working set, without its bytes.
+
+A manifest describes what a state-dict publish WILL put through the store —
+per-flat-key shapes, dtypes, shardings (as per-request payload sizes) and the
+total — derived purely from metadata: no device->host copies, no array
+materialization. It is the planner's input (provision/planner.py) and the
+picklable currency of ``ts.prewarm``: a trainer can derive it from a live
+state dict, a ShapeDtypeStruct tree, or construct it by hand from a model
+config before any weights exist at all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from torchstore_tpu.transport.types import _np_dtype
+
+
+@dataclass(frozen=True)
+class ManifestEntry:
+    """One flat state-dict leaf as the data plane will see it: the key,
+    global shape/dtype, and the payload size of every put request the leaf
+    decomposes into (one per addressable shard for mesh-sharded jax arrays,
+    exactly one otherwise)."""
+
+    key: str
+    shape: tuple[int, ...]
+    dtype: str
+    # Bytes of each put-request payload this leaf expands to. Sums to the
+    # leaf's (transfer-dtype-adjusted) nbytes.
+    request_nbytes: tuple[int, ...]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(self.request_nbytes)
+
+
+@dataclass
+class StateDictManifest:
+    """Keys, shapes, dtypes, shardings (as request sizes), and total bytes of
+    a working set — everything the provisioning planner needs to size pools,
+    dials, and transfer plans before the first byte moves."""
+
+    entries: list[ManifestEntry] = field(default_factory=list)
+    # True when any tensor leaf is a device-resident jax array: the ICI rung
+    # (transfer server) is worth prewarming too.
+    device_resident: bool = False
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.nbytes for e in self.entries)
+
+    def segment_sizes(self) -> dict[int, int]:
+        """{segment size: count} over every put request — exactly the pool
+        the SHM transport's put handshake will ask the volume for (request
+        payloads land in size-exact segments; empty tensors take the 1-byte
+        minimum mapping)."""
+        sizes: dict[int, int] = {}
+        for entry in self.entries:
+            for nbytes in entry.request_nbytes:
+                size = max(int(nbytes), 1)
+                sizes[size] = sizes.get(size, 0) + 1
+        return sizes
+
+    def max_request_nbytes(self) -> int:
+        return max(
+            (n for e in self.entries for n in e.request_nbytes), default=0
+        )
+
+    @classmethod
+    def from_state_dict(
+        cls, state_dict: Any, transfer_dtype=None
+    ) -> "StateDictManifest":
+        """Derive a manifest from a (possibly nested) state dict without
+        moving any bytes. Tensor-ish leaves (numpy, torch, jax arrays and
+        ShapeDtypeStructs, ``Shard`` wrappers) become entries; everything
+        else (scalars, configs, opaque objects) is skipped — object puts ride
+        the RPC codec and need no provisioning."""
+        from torchstore_tpu.state_dict_utils import flatten_state_dict
+
+        flat, _ = flatten_state_dict(state_dict)
+        entries: list[ManifestEntry] = []
+        device = False
+        for key, value in sorted(flat.items()):
+            entry, on_device = _entry_of(key, value, transfer_dtype)
+            if entry is not None:
+                entries.append(entry)
+                device = device or on_device
+        return cls(entries=entries, device_resident=device)
+
+
+def _itemsize(dtype_name: str) -> int:
+    try:
+        return _np_dtype(dtype_name).itemsize
+    except Exception:  # noqa: BLE001 - exotic dtype: assume 4 bytes
+        return 4
+
+
+def _is_floating_name(dtype_name: str) -> bool:
+    if "bfloat16" in dtype_name:
+        return True
+    try:
+        return np.issubdtype(np.dtype(dtype_name), np.floating)
+    except TypeError:
+        return "float" in dtype_name
+
+
+def _transfer_itemsize(dtype_name: str, transfer_dtype) -> int:
+    """Per-element wire size after the optional transfer-dtype cast (floating
+    leaves only — ints/bools cross uncast, mirroring cast_floating_tensors)."""
+    if transfer_dtype is not None and _is_floating_name(dtype_name):
+        return _itemsize(str(np.dtype(transfer_dtype)))
+    return _itemsize(dtype_name)
+
+
+def _entry_of(
+    key: str, value: Any, transfer_dtype
+) -> tuple[Optional[ManifestEntry], bool]:
+    """(entry, is_device_resident) for one flat leaf; (None, False) for
+    non-tensor leaves."""
+    from torchstore_tpu import sharding as shd
+    from torchstore_tpu import torch_interop
+    from torchstore_tpu.client import Shard
+
+    if isinstance(value, Shard):
+        ts = value.tensor_slice
+        shape = tuple(ts.local_shape)
+        data = value.data
+        dtype = str(data.dtype) if data is not None else "float32"
+        itemsize = _transfer_itemsize(dtype, transfer_dtype)
+        nbytes = int(np.prod(shape)) * itemsize if shape else itemsize
+        return ManifestEntry(key, shape, dtype, (nbytes,)), False
+    if isinstance(value, np.ndarray) or torch_interop.is_torch_tensor(value):
+        shape = tuple(int(s) for s in value.shape)
+        dtype = str(value.dtype).replace("torch.", "")
+        itemsize = _transfer_itemsize(dtype, transfer_dtype)
+        count = int(np.prod(shape)) if shape else 1
+        return ManifestEntry(key, shape, dtype, (count * itemsize,)), False
+    if (
+        shd.is_jax_array(value)
+        or shd.is_sharded_spec(value)
+        or shd.is_plain_spec(value)
+    ):
+        shape = tuple(int(s) for s in value.shape)
+        dtype = str(value.dtype)
+        itemsize = _transfer_itemsize(dtype, transfer_dtype)
+        on_device = shd.is_jax_array(value)
+        sharding = getattr(value, "sharding", None)
+        if sharding is None or shd._is_demotable(sharding):
+            count = int(np.prod(shape)) if shape else 1
+            return ManifestEntry(key, shape, dtype, (count * itemsize,)), on_device
+        # Per-shard request sizes from the sharding's index map — the exact
+        # decomposition sharding.put_requests will produce (one request per
+        # addressable shard, replicated coordinates included), metadata-only.
+        sizes: list[int] = []
+        index_map = sharding.addressable_devices_indices_map(shape)
+        for index in index_map.values():
+            local = tuple(
+                int((sl.stop if sl.stop is not None else dim) - (sl.start or 0))
+                for sl, dim in zip(index, shape)
+            )
+            count = int(np.prod(local)) if local else 1
+            sizes.append(count * itemsize)
+        return ManifestEntry(key, shape, dtype, tuple(sizes)), on_device
+    if hasattr(value, "__array_interface__"):
+        arr = np.asarray(value)
+        itemsize = _transfer_itemsize(str(arr.dtype), transfer_dtype)
+        count = int(np.prod(arr.shape)) if arr.shape else 1
+        return (
+            ManifestEntry(key, tuple(arr.shape), str(arr.dtype), (count * itemsize,)),
+            False,
+        )
+    return None, False

@@ -1,0 +1,125 @@
+"""Capacity planner: manifest + fleet topology -> per-volume provisioning.
+
+Pure math, no IO: given a StateDictManifest, the store's volume ids, the
+placement strategy (which volumes a put from this client fans out to —
+replication included), and each volume's transport rung, produce the
+ProvisionPlan the executors drive:
+
+- per volume: the exact {segment size: count} pool the SHM put handshake
+  will ask for, the bytes that implies, and how many bulk connections to
+  pre-dial (1 main + stripe extras when any single payload exceeds the
+  striping threshold);
+- clamping: a capacity grant smaller than the ask shrinks the plan
+  largest-segments-first (big segments are the expensive cold allocations;
+  a clamp should spend its budget where the first sync hurts most).
+
+Everything here is unit-testable without a store (tests/test_provision.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from torchstore_tpu.provision.manifest import StateDictManifest
+
+
+@dataclass
+class VolumePlan:
+    """What one volume should be provisioned with before the first sync."""
+
+    volume_id: str
+    transport: str  # "shm" | "bulk" | "rpc"
+    # {segment size: count} to pre-create into the volume's warm free pool
+    # (SHM rung only; other transports carry no segment plan).
+    segment_sizes: dict[int, int] = field(default_factory=dict)
+    # Bulk connections to pre-dial: 0 for non-bulk rungs, else 1 main
+    # (+ stripe extras for payloads above the striping threshold).
+    dials: int = 0
+    # Bytes the segment plan was shrunk by to fit a capacity grant.
+    clamped_bytes: int = 0
+
+    @property
+    def planned_bytes(self) -> int:
+        return sum(size * count for size, count in self.segment_sizes.items())
+
+
+@dataclass
+class ProvisionPlan:
+    volumes: dict[str, VolumePlan] = field(default_factory=dict)
+    # Manifest total (pre-replication); per-volume asks can sum to a
+    # multiple of this under replicated strategies.
+    manifest_bytes: int = 0
+    replicas: int = 1
+    device_server: bool = False  # prewarm the ICI transfer server too
+
+    @property
+    def planned_bytes(self) -> int:
+        return sum(p.planned_bytes for p in self.volumes.values())
+
+    @property
+    def clamped_bytes(self) -> int:
+        return sum(p.clamped_bytes for p in self.volumes.values())
+
+
+def expected_bulk_conns(manifest: StateDictManifest) -> int:
+    """Connections one bulk volume needs for this working set: the main
+    promoted connection, plus the stripe set when any single payload will be
+    striped."""
+    from torchstore_tpu.transport.bulk import STRIPE_CONNS, STRIPE_THRESHOLD
+
+    if manifest.max_request_nbytes() > STRIPE_THRESHOLD:
+        return STRIPE_CONNS
+    return 1
+
+
+def plan_provisioning(
+    manifest: StateDictManifest,
+    put_volume_ids: list[str],
+    transports: dict[str, str],
+    ici_available: bool = False,
+) -> ProvisionPlan:
+    """Build the plan: every volume a put will land on (primary + replicas,
+    already resolved by the caller through the strategy) gets the manifest's
+    full segment plan on the SHM rung, a dial plan on the bulk rung, and
+    nothing on the RPC rung (payloads ride the codec — nothing to warm)."""
+    sizes = manifest.segment_sizes()
+    plan = ProvisionPlan(
+        manifest_bytes=manifest.total_bytes,
+        replicas=max(1, len(put_volume_ids)),
+        device_server=bool(ici_available and manifest.device_resident),
+    )
+    for vid in put_volume_ids:
+        transport = transports.get(vid, "rpc")
+        vp = VolumePlan(volume_id=vid, transport=transport)
+        if transport == "shm":
+            vp.segment_sizes = dict(sizes)
+        elif transport == "bulk":
+            vp.dials = expected_bulk_conns(manifest)
+        plan.volumes[vid] = vp
+    return plan
+
+
+def clamp_to_grant(vp: VolumePlan, granted_bytes: Optional[int]) -> VolumePlan:
+    """Shrink a volume's segment plan to a capacity grant. ``None`` means
+    ungoverned (no clamp); 0 drops the whole plan. The budget is spent
+    LARGEST segments first: cold-creating a 256 MB segment on the first
+    put's critical path costs orders of magnitude more than a 4 KB one, so
+    when tmpfs can't hold everything the big allocations are what prewarm
+    must cover. Returns ``vp`` mutated (also its return value, for
+    chaining)."""
+    if granted_bytes is None or vp.transport != "shm":
+        return vp
+    budget = max(0, int(granted_bytes))
+    kept: dict[int, int] = {}
+    clamped = 0
+    for size in sorted(vp.segment_sizes, reverse=True):
+        want = vp.segment_sizes[size]
+        fit = min(want, budget // size) if size > 0 else want
+        if fit:
+            kept[size] = fit
+            budget -= size * fit
+        clamped += (want - fit) * size
+    vp.segment_sizes = kept
+    vp.clamped_bytes = clamped
+    return vp

@@ -1,0 +1,102 @@
+"""Client-local segment pool: pre-created staging for the direct path.
+
+The volume-side warm pool (ShmServerCache.provision) covers buffered puts;
+the DIRECT path's cold cost is different — the SOURCE process creates one
+/dev/shm staging segment per shard at ``register`` time, on the critical
+path of the first publish. This pool lets ``ts.prewarm(..., direct=True)``
+pre-create and prefault those segments in the trainer's own process;
+``DirectWeightSyncSource.register`` then draws exact-size segments instead
+of allocating cold.
+
+Process-local and advisory: ``take`` returning None simply means the lazy
+path allocates as before.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from torchstore_tpu.logging import get_logger
+
+logger = get_logger("torchstore_tpu.provision.pool")
+
+
+class LocalSegmentPool:
+    def __init__(self) -> None:
+        self._by_size: dict[int, list] = {}
+
+    @property
+    def pooled_bytes(self) -> int:
+        return sum(
+            size * len(segs) for size, segs in self._by_size.items()
+        )
+
+    def provision(
+        self, sizes: dict[int, int], hugepages: bool = True, nthreads: int = 0
+    ) -> dict:
+        """Pre-create + prefault ``{size: count}`` segments (counting
+        segments already pooled against the want). Synchronous — call it
+        from an executor thread via the prewarm orchestrator."""
+        from torchstore_tpu.transport import shared_memory as shm
+
+        created = 0
+        created_bytes = 0
+        clamped_bytes = 0
+        if not shm.is_available():
+            return {"created": 0, "bytes": 0, "error": "shm unavailable"}
+        # Clamp to HALF of tmpfs availability (minus a safety margin):
+        # pre-faulting writes every page, and a write past tmpfs-full is
+        # SIGBUS — fatal to the trainer process, not an exception the
+        # advisory-prewarm contract could absorb. Unlike the volume legs,
+        # client-local staging is NOT governed by the controller's
+        # reservation (the trainer's host may not run a volume at all), so
+        # the half-budget keeps two trainers booting simultaneously on one
+        # host from jointly writing past the tmpfs; wider races stay
+        # possible and are accepted — this leg is advisory, and a clamped
+        # pool just means register() cold-creates the remainder lazily.
+        budget = max(0, (shm.shm_available_bytes() - (256 << 20)) // 2)
+        for size, count in sorted(sizes.items(), reverse=True):
+            size = max(int(size), 1)
+            want = max(0, int(count) - len(self._by_size.get(size, ())))
+            fits = min(want, budget // size)
+            budget -= fits * size
+            clamped_bytes += (want - fits) * size
+            for _ in range(fits):
+                seg = shm.ShmSegment.create_provisioned(
+                    size, hugepages=hugepages, nthreads=nthreads
+                )
+                self._by_size.setdefault(size, []).append(seg)
+                created += 1
+                created_bytes += size
+        if clamped_bytes:
+            logger.info(
+                "local staging prewarm clamped %d bytes to tmpfs headroom",
+                clamped_bytes,
+            )
+        return {
+            "created": created,
+            "bytes": created_bytes,
+            "clamped_bytes": clamped_bytes,
+        }
+
+    def take(self, size: int):
+        segs = self._by_size.get(max(int(size), 1))
+        if not segs:
+            return None
+        return segs.pop()
+
+    def clear(self) -> None:
+        for segs in self._by_size.values():
+            for seg in segs:
+                seg.unlink()
+        self._by_size.clear()
+
+
+_pool: Optional[LocalSegmentPool] = None
+
+
+def local_pool() -> LocalSegmentPool:
+    global _pool
+    if _pool is None:
+        _pool = LocalSegmentPool()
+    return _pool

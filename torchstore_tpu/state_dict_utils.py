@@ -412,50 +412,75 @@ async def _put_state_dict_direct(
         await source.refresh()
 
 
+async def _resolve_direct_entry(client, key: str):
+    """The cached (dest, all_handles, device_infos) for a direct-pushed key,
+    fetching published handles and building the dest on first use (shared by
+    the pull path and the prewarm preplan path)."""
+    from torchstore_tpu.direct_weight_sync import DirectWeightSyncDest
+
+    cache = _direct_cache(client)
+    entry = cache.dests.get(key)
+    if entry is not None:
+        return entry
+    try:
+        num_ranks = await client.get(f"{key}{_SEP}num_ranks")
+    except KeyError as exc:
+        raise NoMatchingPush(
+            f"no matching direct push for state dict key {key!r}"
+        ) from exc
+    all_handles: dict[str, list] = {}
+    device_infos: list = []
+    for rank in range(num_ranks):
+        try:
+            published = await client.get(f"{key}{_SEP}rank_{rank}")
+        except KeyError as exc:
+            # num_ranks (written by rank 0) can land before other ranks
+            # publish their handles; keep the retry contract intact.
+            raise NoMatchingPush(
+                f"direct push for {key!r} incomplete: rank {rank} has not "
+                "published handles yet"
+            ) from exc
+        for flat_key, handle_list in published["handles"].items():
+            all_handles.setdefault(flat_key, []).extend(handle_list)
+        if published.get("device") is not None:
+            device_infos.append(published["device"])
+    if device_infos and len(device_infos) != num_ranks:
+        raise RuntimeError(
+            f"direct push {key!r}: {len(device_infos)} of {num_ranks} "
+            "ranks published device-path entries — mixed device/host "
+            "publication cannot be merged (check ici_enabled agrees "
+            "across ranks)"
+        )
+    entry = (DirectWeightSyncDest(), all_handles, device_infos or None)
+    cache.dests[key] = entry
+    return entry
+
+
+async def preplan_direct(client, key: str, user_state_dict: Any) -> dict:
+    """ts.prewarm hook for the direct acquire path: resolve the published
+    handles, build + cache the transfer plan, pre-dial source connections,
+    pre-attach same-host staging segments. The first real
+    ``get_state_dict(direct=True)`` then starts at the data movement."""
+    converted = torch_interop.convert_tree(user_state_dict, allow_copy=False)
+    dest, all_handles, device_infos = await _resolve_direct_entry(client, key)
+    # Reports share ts.prewarm's contract shape: "ok"/"errors" always
+    # present (callers branch on them regardless of which mode ran).
+    if device_infos is not None:
+        # Device-path pulls have no host plan to precompute; the engine-side
+        # prewarm (transfer server) is handled by the provision orchestrator.
+        return {"ok": True, "errors": {}, "plan_ops": 0, "device": True}
+    return {"ok": True, "errors": {}, **await dest.preplan(all_handles, converted)}
+
+
 async def _get_state_dict_direct(
     client, key: str, user_state_dict: Any, _retry: bool = True
 ) -> Any:
-    from torchstore_tpu.direct_weight_sync import (
-        DirectWeightSyncDest,
-        PullRaceError,
-    )
+    from torchstore_tpu.direct_weight_sync import PullRaceError
 
     if user_state_dict is None:
         raise ValueError("direct get_state_dict requires user_state_dict targets")
     cache = _direct_cache(client)
-    entry = cache.dests.get(key)
-    if entry is None:
-        try:
-            num_ranks = await client.get(f"{key}{_SEP}num_ranks")
-        except KeyError as exc:
-            raise NoMatchingPush(
-                f"no matching direct push for state dict key {key!r}"
-            ) from exc
-        all_handles: dict[str, list] = {}
-        device_infos: list = []
-        for rank in range(num_ranks):
-            try:
-                published = await client.get(f"{key}{_SEP}rank_{rank}")
-            except KeyError as exc:
-                # num_ranks (written by rank 0) can land before other ranks
-                # publish their handles; keep the retry contract intact.
-                raise NoMatchingPush(
-                    f"direct push for {key!r} incomplete: rank {rank} has not "
-                    "published handles yet"
-                ) from exc
-            for flat_key, handle_list in published["handles"].items():
-                all_handles.setdefault(flat_key, []).extend(handle_list)
-            if published.get("device") is not None:
-                device_infos.append(published["device"])
-        if device_infos and len(device_infos) != num_ranks:
-            raise RuntimeError(
-                f"direct push {key!r}: {len(device_infos)} of {num_ranks} "
-                "ranks published device-path entries — mixed device/host "
-                "publication cannot be merged (check ici_enabled agrees "
-                "across ranks)"
-            )
-        entry = (DirectWeightSyncDest(), all_handles, device_infos or None)
-        cache.dests[key] = entry
+    entry = await _resolve_direct_entry(client, key)
     dest, all_handles, device_infos = entry
     try:
         if device_infos is not None:
@@ -529,6 +554,14 @@ async def put_state_dict(
         flat, quant_meta = quantize_int8(flat)
         marker["quant"] = quant_meta
     tracker.track_step("flatten")
+    # Automatic provisioning hint: the first push of a big working set
+    # derives a manifest from the flat dict and prewarms pools/dials ahead
+    # of the data-plane puts (config.prewarm_auto; once per size-signature
+    # per client; never fails the put — see provision.maybe_auto_prewarm).
+    from torchstore_tpu import provision
+
+    await provision.maybe_auto_prewarm(client, flat)
+    tracker.track_step("prewarm_hint")
     await client.put_batch({_store_key(key, k): v for k, v in flat.items()})
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("put_batch", nbytes)

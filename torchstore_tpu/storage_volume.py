@@ -482,6 +482,74 @@ class StorageVolume(Actor):
         return items
 
     @endpoint
+    async def shm_capacity(self, config=None) -> dict:
+        """Capacity view for the controller's prewarm reservations: tmpfs
+        bytes actually available, plus the SHM pool's cap and current fill.
+        The controller grants prewarm reservations against
+        ``min(available, cap - pooled)`` minus outstanding grants, so
+        concurrent prewarms cannot oversubscribe /dev/shm. ``config`` (the
+        prewarming CLIENT's StoreConfig, forwarded through the controller)
+        is adopted first — a programmatic pool cap must govern the grant,
+        not the volume's env default, or the later provision_shm would be
+        clamped against a cap the grant never saw."""
+        from torchstore_tpu.transport import shared_memory as shm_mod
+
+        out = {
+            "shm": shm_mod.is_available(),
+            "available_bytes": 0,
+            "pool_cap": 0,
+            "pool_bytes": 0,
+        }
+        if not out["shm"]:
+            return out
+        out["available_bytes"] = shm_mod.shm_available_bytes()
+        cache = self.ctx.get_cache(shm_mod.ShmServerCache)
+        cache.adopt_config(config)
+        out["pool_cap"] = cache.pool_cap
+        out["pool_bytes"] = cache.free_bytes
+        return out
+
+    @endpoint
+    async def provision_shm(self, sizes: dict, config=None) -> dict:
+        """Prewarm executor (SHM leg): pre-create + prefault ``{size:
+        count}`` segments into this volume's warm free pool so the first
+        put handshake of the provisioned working set offers every segment
+        instead of cold-creating on the critical path. Config travels from
+        the client (pool cap, hugepage/thread knobs) exactly as it does on
+        the put path."""
+        from torchstore_tpu.observability.tracing import span
+        from torchstore_tpu.transport import shared_memory as shm_mod
+
+        if not shm_mod.is_available():
+            return {"created": 0, "bytes": 0, "error": "shm unavailable"}
+        cache = self.ctx.get_cache(shm_mod.ShmServerCache)
+        cache.adopt_config(config)
+        hugepages = getattr(config, "prewarm_hugepages", True)
+        nthreads = getattr(config, "prewarm_threads", 0)
+        with span(
+            "provision.shm_pool",
+            volume=self.volume_id,
+            sizes=len(sizes),
+            nbytes=sum(int(s) * int(c) for s, c in sizes.items()),
+        ):
+            result = await cache.provision(
+                {int(s): int(c) for s, c in sizes.items()},
+                hugepages=hugepages,
+                nthreads=nthreads,
+            )
+        if result.get("created"):
+            logger.info(
+                "provisioned %d segment(s) / %d bytes into volume %s pool "
+                "(%d already pooled, %d bytes clamped)",
+                result["created"],
+                result["bytes"],
+                self.volume_id,
+                result["already_pooled"],
+                result["clamped_bytes"],
+            )
+        return result
+
+    @endpoint
     async def stats(self) -> dict:
         """Data-plane observability: stored entry/byte counts plus SHM
         segment economics (live/retired/pooled bytes, outstanding read

@@ -703,6 +703,56 @@ class BulkClientCache(TransportCache):
         self.endpoints.clear()
 
 
+async def prewarm_connection(
+    volume, config: Optional[StoreConfig] = None, stripes: int = 0
+) -> int:
+    """Cold-start provisioning for the bulk rung: perform the two-phase
+    endpoint handshake, dial + authenticate the main connection, promote it
+    to the per-volume cache (a successful dial IS the success the
+    promote-on-success invariant gates on), and optionally pre-open the
+    stripe set so a large first transfer stripes from byte zero. Returns
+    the number of fresh dials made (0 when everything was already warm).
+    Raises on dial/handshake failure — the prewarm orchestrator reports and
+    degrades to the lazy path."""
+    config = config or default_config()
+    cache: BulkClientCache = volume.transport_context.get_cache(BulkClientCache)
+    dials = 0
+    if cache.get_alive(volume.volume_id) is None:
+        buffer = BulkTransportBuffer(config)
+        await buffer._ensure_conn(volume)
+        buffer._post_request_success(volume)
+        if not buffer._promoted:
+            # Lost a promote race with a concurrent first request; the cache
+            # has a live connection either way — close the spare.
+            buffer._conn.close_now()
+        else:
+            dials += 1
+    if stripes > 0:
+        before = len(
+            [c for c in cache.stripe_conns.get(volume.volume_id, []) if not c.closed]
+        )
+        conns = await cache.get_stripe_conns(
+            volume.volume_id, stripes, config.handshake_timeout
+        )
+        dials += max(0, len(conns) - before)
+    return dials
+
+
+def prewarm_registrations(volume, arrays) -> int:
+    """Warm the array-registration cache for ``arrays`` (the buffers a bulk
+    put will pin/register): repeat puts of the same working set then skip
+    per-(ptr, nbytes) registration on the critical path."""
+    regs: ArrayRegistrationCache = volume.transport_context.get_cache(
+        ArrayRegistrationCache
+    )
+    count = 0
+    for arr in arrays:
+        if isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]:
+            regs.register(arr)
+            count += 1
+    return count
+
+
 class BulkTransportBuffer(TransportBuffer):
     transport_name = "bulk"
     requires_handshake = True  # dynamically skipped when a promoted conn exists

@@ -260,3 +260,17 @@ class DeviceTransferEngine:
     def reset(self) -> None:
         """Drop connections (tests); the server itself is process-lifetime."""
         self._conns.clear()
+
+
+def prewarm_engine() -> Optional[str]:
+    """Cold-start provisioning for the ICI rung: start this process's
+    transfer server BEFORE the first publish/pull needs it (server startup
+    binds a listener and initializes the backend's transfer machinery — paid
+    once, and without prewarm it lands on iteration 0's critical path).
+    Returns the server address, or None when this jax build has no transfer
+    engine. Staging itself stays per-pull (the engine's one-shot contract);
+    dest-side staging buffers are the pull targets the caller provides."""
+    if not is_available():
+        return None
+    with tracing.span("provision.device_server"):
+        return DeviceTransferEngine.get().ensure_server()

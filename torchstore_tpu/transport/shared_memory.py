@@ -104,6 +104,18 @@ def is_available() -> bool:
     return os.path.isdir(SHM_DIR) and os.access(SHM_DIR, os.W_OK)
 
 
+def shm_available_bytes() -> int:
+    """Free bytes in /dev/shm right now (0 when unreadable). Provisioners
+    clamp against this: tmpfs pages are allocated by WRITES, and a write
+    past tmpfs-full raises SIGBUS — not an exception any try/except can
+    catch — so pre-faulting must never be allowed to run past it."""
+    try:
+        st = os.statvfs(SHM_DIR)
+        return int(st.f_frsize * st.f_bavail)
+    except OSError:
+        return 0
+
+
 def reap_orphaned_segments() -> int:
     """Unlink ts_shm_* segments whose creating process is gone (crashed
     volumes/clients leave them behind; nothing else ever cleans /dev/shm).
@@ -189,6 +201,58 @@ class ShmSegment:
             os.close(fd)
         _SEGMENTS_CREATED.inc()
         return cls(name, size, mm, owner=True)
+
+    @classmethod
+    def create_provisioned(
+        cls, size: int, hugepages: bool = True, nthreads: int = 0
+    ) -> "ShmSegment":
+        """Cold-start provisioning variant of ``create``: map WITHOUT
+        MAP_POPULATE, advise transparent huge pages while the range is still
+        untouched (the advice must precede the faults to influence them),
+        then prefault every page with the native multi-threaded entry
+        (``tsnative.cc ts_prefault``; single-thread touch fallback). Used by
+        the prewarm path to build the volume's warm pool off the first
+        sync's critical path."""
+        name = f"ts_shm_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        fd = os.open(cls._path(name), os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size, flags=mmap.MAP_SHARED)
+        finally:
+            os.close(fd)
+        _SEGMENTS_CREATED.inc()
+        seg = cls(name, size, mm, owner=True)
+        if hugepages:
+            seg.madvise_hugepage()
+        seg.prefault(nthreads)
+        return seg
+
+    def madvise_hugepage(self) -> None:
+        """Advise the kernel to back this mapping with transparent huge
+        pages (fewer TLB misses on the hot memcpy). Fail-open: kernels
+        without THP-on-shmem, or mmap modules without MADV_HUGEPAGE, leave
+        the mapping on plain pages."""
+        advice = getattr(mmap, "MADV_HUGEPAGE", None)
+        if advice is None or self.size == 0:
+            return
+        try:
+            self.mmap.madvise(advice)
+        except (OSError, ValueError):
+            pass
+
+    def prefault(self, nthreads: int = 0) -> None:
+        """Touch every page so later copies into this segment never
+        soft-fault. Native multi-threaded path when the v2 library is
+        present; single-thread stride touch otherwise."""
+        if self.size == 0:
+            return
+        from torchstore_tpu import native as native_mod
+
+        addr = self.base_addr()
+        if addr is not None and native_mod.prefault(addr, self.size, nthreads):
+            return
+        view = np.frombuffer(self.mmap, dtype=np.uint8)
+        view[::4096] = 0  # page starts are 4096-multiples: every page hit
 
     @classmethod
     def attach(cls, name: str, size: int, populate: bool = False) -> "ShmSegment":
@@ -527,6 +591,95 @@ class ShmServerCache(TransportCache):
             else:
                 self._warming.pop(size, None)
 
+    async def provision(
+        self,
+        sizes: dict[int, int],
+        hugepages: bool = True,
+        nthreads: int = 0,
+    ) -> dict:
+        """Manifest-driven pool pre-sizing (the prewarm executor's SHM leg):
+        for each requested ``{size: count}``, create-and-prefault enough
+        segments that the pool can serve that many put-handshake offers —
+        counting segments already pooled, warming, or reserved against the
+        want. Creation + prefault run on executor threads (the native
+        prefault releases the GIL, so multi-segment provisioning
+        parallelizes); pool bookkeeping happens back on the event loop.
+        Largest sizes first and clamped to the pool cap's remaining budget:
+        when everything can't fit, prewarm covers the allocations that hurt
+        the cold path most."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        reserved_by_size: dict[int, int] = {}
+        for seg, _ in self.reserved.values():
+            reserved_by_size[seg.size] = reserved_by_size.get(seg.size, 0) + 1
+        # Clamped at zero: adopt_config may have SHRUNK pool_cap below what
+        # the pool already holds — a negative budget would let the floor
+        # division below go negative and corrupt the accounting. ALSO
+        # clamped to actual tmpfs availability (minus a safety margin for
+        # concurrent tenants): the prefault WRITES every page, and a write
+        # past tmpfs-full is SIGBUS — fatal to the volume process — not a
+        # catchable exception. The controller's reservation normally
+        # prevents this, but the volume must protect itself when the
+        # reserve step failed and the plan arrived unclamped.
+        budget = max(0, self.pool_cap - self.free_bytes)
+        budget = min(budget, max(0, shm_available_bytes() - (256 << 20)))
+        created = 0
+        created_bytes = 0
+        already = 0
+        clamped_bytes = 0
+        plan: list[int] = []
+        for size in sorted(sizes, reverse=True):
+            count = int(sizes[size])
+            if size <= 0 or count <= 0:
+                continue
+            have = (
+                len(self.free_by_size.get(size, ()))
+                + self._warming.get(size, 0)
+                + reserved_by_size.get(size, 0)
+            )
+            want = max(0, count - have)
+            already += count - want
+            fits = min(want, budget // size) if want else 0
+            budget -= fits * size
+            clamped_bytes += (want - fits) * size
+            plan.extend([size] * fits)
+        segs = await asyncio.gather(
+            *(
+                loop.run_in_executor(
+                    None, ShmSegment.create_provisioned, size, hugepages, nthreads
+                )
+                for size in plan
+            ),
+            return_exceptions=True,
+        )
+        errors = 0
+        names: list[tuple[str, int]] = []
+        for seg in segs:
+            if isinstance(seg, BaseException):
+                errors += 1
+                continue
+            if self._closed:
+                seg.unlink()  # clear() ran mid-provision: don't leak the file
+                continue
+            self._add_free(seg)
+            created += 1
+            created_bytes += seg.size
+            names.append((seg.name, seg.size))
+        _POOL_BYTES.set(self.free_bytes)
+        return {
+            "created": created,
+            "bytes": created_bytes,
+            "already_pooled": already,
+            "clamped_bytes": clamped_bytes,
+            "errors": errors,
+            # Created segment names: the prewarming CLIENT pre-attaches these
+            # (populate=True page-table wiring off the critical path) so the
+            # first put's handshake offers hit its attachment cache and only
+            # the copy remains on the hot path.
+            "names": names,
+        }
+
     def take_free(self, size: int) -> Optional[ShmSegment]:
         segs = self.free_by_size.get(size)
         if not segs:
@@ -778,6 +931,41 @@ class ShmClientCache(TransportCache):
         self.pending.clear()
         self.unacked.clear()
         self.seq.clear()
+
+
+async def pre_attach_segments(volume, names: list[tuple[str, int]]) -> int:
+    """Prewarm helper: synchronously attach volume-provisioned segments into
+    this client's attachment cache (populate=True — the page-table wiring a
+    put would otherwise pay on its critical path). Unlike the background
+    ``ShmClientCache.pre_attach`` (best-effort, races the next handshake),
+    this AWAITS completion: prewarm returns only when the first put's offers
+    will hit the cache. Attachments are tracked as pre-attached spares, so
+    the standard staleness eviction applies — a prewarm more than the
+    reserved TTL ahead of the first put keeps the volume-side pool benefit
+    but re-attaches lazily. Returns the number of fresh attachments."""
+    import asyncio
+
+    cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
+    loop = asyncio.get_running_loop()
+
+    async def one(name: str, size: int) -> int:
+        if name in cache.segments:
+            return 0
+        try:
+            seg = await loop.run_in_executor(
+                None, ShmSegment.attach, name, size, True
+            )
+        except OSError:
+            return 0  # pool-cap evicted (or volume reset) meanwhile
+        if name in cache.segments:
+            seg.close()  # a concurrent attach won the race
+            return 0
+        cache.segments[name] = seg
+        cache._pre_attached[name] = time.monotonic()
+        return 1
+
+    results = await asyncio.gather(*(one(n, s) for n, s in names))
+    return sum(results)
 
 
 # --------------------------------------------------------------------------

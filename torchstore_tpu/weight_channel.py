@@ -99,6 +99,43 @@ class WeightPublisher:
             self._client = api.client(self._store_name)
         return self._client
 
+    async def register(
+        self, state_dict: Any, transfer_dtype=None, direct: bool = False
+    ) -> dict:
+        """Provision the store for this channel's working set BEFORE the
+        first publish (the cold-start hint path): derives a manifest from
+        the state dict (metadata only — no bytes move) and prewarms volume
+        pools, transport connections, and — with ``direct=True`` — the
+        client-local staging segments the direct source will draw. Call it
+        during model setup, while the trainer is still compiling/loading,
+        so the first publish lands in pre-faulted segments. Advisory:
+        failures are reported in the returned dict, never raised, and the
+        first publish falls back to the lazy path."""
+        from torchstore_tpu import provision
+
+        try:
+            client = self._resolve_client()
+            manifest = provision.as_manifest(
+                state_dict, transfer_dtype=transfer_dtype
+            )
+        except Exception as exc:  # noqa: BLE001 - advisory: the first
+            # publish surfaces real problems loudly; register never does.
+            logger.warning(
+                "channel %s register failed (%s); first publish will take "
+                "the lazy path",
+                self.name,
+                exc,
+            )
+            return {"ok": False, "errors": {"register": str(exc)}}
+        with span(
+            "weight_channel.register",
+            channel=self.name,
+            nbytes=manifest.total_bytes,
+        ):
+            return await provision.prewarm_manifest(
+                client, manifest, direct=direct
+            )
+
     async def publish(
         self,
         state_dict: Any,
