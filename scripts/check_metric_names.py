@@ -1,19 +1,13 @@
 #!/usr/bin/env python
-"""Static lint for the metric namespace: names can't silently fork.
+"""Static lint for the metric namespace — THIN SHIM.
 
-AST-walks every ``counter("name", ...)`` / ``gauge(...)`` / ``histogram(...)``
-call site (module-level functions AND registry methods) across the package
-and benches, then fails on:
-
-- **kind conflicts** — the same metric name registered as two different
-  instrument kinds anywhere in the tree. The runtime raises on this too,
-  but only when both call sites execute in ONE process; two processes
-  registering ``ts_foo`` as a counter here and a gauge there would each run
-  fine and corrupt the merged fleet document (observability/aggregate.py
-  drops the conflicting side and reports it — this lint keeps it from ever
-  landing).
-- **non-snake-case names** — anything not matching ``[a-z][a-z0-9_]*``
-  breaks Prometheus exposition and grep-ability.
+The implementation moved into the repo's static-analysis suite:
+``torchstore_tpu/analysis/checkers/metric_discipline.py`` (which also adds
+ts_-prefix, label-cardinality, and span-name rules — run
+``python scripts/tslint.py`` for the full set). This shim keeps the
+historical entry point and its ``collect_sites(root)`` / ``check(root,
+sites=None)`` API working for tests/test_metric_lint.py and any external
+callers.
 
 Run standalone (``python scripts/check_metric_names.py``) or through the
 tier-1 test (tests/test_metric_lint.py). Exit 0 clean, 1 on findings.
@@ -21,115 +15,41 @@ tier-1 test (tests/test_metric_lint.py). Exit 0 clean, 1 on findings.
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
+import types
 
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-INSTRUMENT_CALLS = {"counter", "gauge", "histogram"}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
-# Directories scanned relative to the repo root. Tests are deliberately
-# excluded: they register throwaway names (and one intentionally conflicting
-# pair) on PRIVATE registries to test the runtime guard itself.
-SCAN_DIRS = ("torchstore_tpu", "benchmarks", "scripts")
-SCAN_FILES = ("bench.py", "__graft_entry__.py")
+if "torchstore_tpu" not in sys.modules:
+    # Preserve the old script's stdlib-only contract: load the analysis
+    # subpackage without executing torchstore_tpu/__init__.py (the full
+    # store runtime + numpy).
+    _pkg = types.ModuleType("torchstore_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "torchstore_tpu")]
+    sys.modules["torchstore_tpu"] = _pkg
 
+from torchstore_tpu.analysis.checkers import metric_discipline as _impl  # noqa: E402
 
-def _call_name(node: ast.Call) -> str | None:
-    func = node.func
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return None
+NAME_RE = _impl.NAME_RE
+INSTRUMENT_CALLS = _impl.INSTRUMENT_CALLS
 
 
-def collect_sites(root: str) -> list[tuple[str, int, str, str]]:
+def collect_sites(root: str):
     """Every (file, line, metric_name, kind) instrument call site with a
     string-literal first argument under the scanned tree."""
-    paths: list[str] = []
-    for rel in SCAN_DIRS:
-        base = os.path.join(root, rel)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            paths.extend(
-                os.path.join(dirpath, f)
-                for f in filenames
-                if f.endswith(".py")
-            )
-    for rel in SCAN_FILES:
-        path = os.path.join(root, rel)
-        if os.path.exists(path):
-            paths.append(path)
-    sites: list[tuple[str, int, str, str]] = []
-    for path in sorted(paths):
-        try:
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
-        except (OSError, SyntaxError) as exc:
-            print(f"check_metric_names: cannot parse {path}: {exc}", file=sys.stderr)
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            kind = _call_name(node)
-            if kind not in INSTRUMENT_CALLS or not node.args:
-                continue
-            first = node.args[0]
-            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
-                continue  # dynamic names (registry internals) are not sites
-            sites.append(
-                (os.path.relpath(path, root), node.lineno, first.value, kind)
-            )
-    return sites
+    return _impl.collect_sites(root)
 
 
 def check(root: str, sites=None) -> list[str]:
     """All namespace violations in the tree (empty list = clean). Pass
     pre-collected ``sites`` to avoid re-walking the tree."""
-    if sites is None:
-        sites = collect_sites(root)
-    problems: list[str] = []
-    by_name: dict[str, dict[str, list[str]]] = {}
-    for path, line, name, kind in sites:
-        if not NAME_RE.match(name):
-            problems.append(
-                f"{path}:{line}: metric name {name!r} is not snake_case "
-                "([a-z][a-z0-9_]*)"
-            )
-        by_name.setdefault(name, {}).setdefault(kind, []).append(
-            f"{path}:{line}"
-        )
-    for name, kinds in sorted(by_name.items()):
-        if len(kinds) > 1:
-            detail = "; ".join(
-                f"{kind} at {', '.join(locs)}" for kind, locs in sorted(kinds.items())
-            )
-            problems.append(
-                f"metric {name!r} registered with conflicting kinds: {detail}"
-            )
-    return problems
+    return _impl.check_names(root, sites)
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sites = collect_sites(root)
-    problems = check(root, sites)
-    if problems:
-        for problem in problems:
-            print(f"check_metric_names: {problem}", file=sys.stderr)
-        print(
-            f"check_metric_names: FAILED ({len(problems)} problem(s) across "
-            f"{len(sites)} instrument call sites)",
-            file=sys.stderr,
-        )
-        return 1
-    names = {name for _, _, name, _ in sites}
-    print(
-        f"check_metric_names: OK — {len(sites)} call sites, "
-        f"{len(names)} distinct metric names, no conflicts"
-    )
-    return 0
+    return _impl.main()
 
 
 if __name__ == "__main__":

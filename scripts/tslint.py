@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""tslint — the repo's static-analysis suite (torchstore_tpu/analysis/).
+
+Seven checkers grounded in real shipped bug classes: endpoint-drift,
+async-blocking, cancellation-swallow, orphan-task, fork-safety,
+env-registry, metric-discipline. See docs/ARCHITECTURE.md ("Static
+analysis") for the rule catalog and the baseline workflow.
+
+Usage:
+    python scripts/tslint.py                 # report; exit 1 on NEW findings
+    python scripts/tslint.py --json          # machine-readable report
+    python scripts/tslint.py --fail-on-new   # gate mode: print only new findings
+    python scripts/tslint.py --rules orphan-task,cancellation-swallow
+    python scripts/tslint.py --write-baseline  # re-grandfather current findings
+    python scripts/tslint.py --regen-env-docs  # rewrite docs/API.md env table
+    python scripts/tslint.py --list-rules
+
+Suppression: ``# tslint: disable=<rule>[,<rule>]`` on the offending line or
+the line above (add a comment saying WHY); ``# tslint: disable-file=<rule>``
+in the first 20 lines of a file. Grandfathered findings live in
+tslint_baseline.json — the gate fails only on findings absent from it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+if "torchstore_tpu" not in sys.modules:
+    # Keep the linter stdlib-only: importing the analysis subpackage must
+    # not execute torchstore_tpu/__init__.py (which pulls the whole store
+    # runtime + numpy). Register a minimal parent package pointing at the
+    # real directory so only analysis/* modules load.
+    _pkg = types.ModuleType("torchstore_tpu")
+    _pkg.__path__ = [os.path.join(REPO_ROOT, "torchstore_tpu")]
+    sys.modules["torchstore_tpu"] = _pkg
+
+from torchstore_tpu.analysis import (  # noqa: E402
+    DEFAULT_BASELINE,
+    run_checks,
+    save_baseline,
+)
+from torchstore_tpu.analysis.checkers import CHECKERS  # noqa: E402
+
+
+def regen_env_docs(root: str) -> int:
+    """Rewrite the generated env-var table in docs/API.md from the registry
+    parsed out of config.py (static — same parse the checker uses)."""
+    from torchstore_tpu.analysis.checkers.env_registry import (
+        DOCS_BEGIN,
+        DOCS_END,
+        parse_registry,
+        render_env_table,
+    )
+
+    config_path = os.path.join(root, "torchstore_tpu", "config.py")
+    with open(config_path, encoding="utf-8") as f:
+        entries, _prefixes, _span = parse_registry(f.read())
+    if not entries:
+        print("tslint: config.py defines no ENV_REGISTRY", file=sys.stderr)
+        return 1
+    docs_path = os.path.join(root, "docs", "API.md")
+    with open(docs_path, encoding="utf-8") as f:
+        docs = f.read()
+    table = render_env_table(entries)
+    block = f"{DOCS_BEGIN}\n{table}\n{DOCS_END}"
+    if DOCS_BEGIN in docs and DOCS_END in docs:
+        head = docs.split(DOCS_BEGIN, 1)[0]
+        tail = docs.split(DOCS_END, 1)[1]
+        docs = head + block + tail
+    else:
+        docs = docs.rstrip() + "\n\n## Environment variables\n\n" + block + "\n"
+    with open(docs_path, "w", encoding="utf-8") as f:
+        f.write(docs)
+    print(f"tslint: regenerated env-var table ({len(entries)} entries) in docs/API.md")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--json", action="store_true", help="JSON report")
+    parser.add_argument(
+        "--fail-on-new",
+        action="store_true",
+        help="gate mode: print only findings absent from the baseline",
+    )
+    parser.add_argument(
+        "--rules", help="comma-separated subset of rules (default: all)"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=os.path.join(REPO_ROOT, DEFAULT_BASELINE),
+        help="baseline file (default: tslint_baseline.json at the repo root)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="treat every finding as new (ignore the baseline)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--regen-env-docs",
+        action="store_true",
+        help="regenerate the env-var table in docs/API.md from config.ENV_REGISTRY",
+    )
+    parser.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(CHECKERS):
+            print(rule)
+        return 0
+    if args.regen_env_docs:
+        return regen_env_docs(args.root)
+
+    rules = args.rules.split(",") if args.rules else None
+    baseline = None if args.no_baseline else args.baseline
+    result = run_checks(args.root, rules=rules, baseline_path=baseline)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, result.findings)
+        print(
+            f"tslint: wrote {len(result.findings)} finding(s) to "
+            f"{os.path.relpath(args.baseline, args.root)}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+        return 1 if result.new else 0
+
+    new_keys = {f.key for f in result.new}
+    shown = result.new if args.fail_on_new else result.findings
+    for f in shown:
+        tag = "" if f.key in new_keys else " [baselined]"
+        print(f"{f.render()}{tag}")
+    n_rules = len(result.rules)
+    if result.new:
+        print(
+            f"\ntslint: FAILED — {len(result.new)} NEW finding(s) "
+            f"({len(result.baselined)} baselined) across {n_rules} rule(s). "
+            "Fix them, pragma with justification, or (last resort) "
+            "--write-baseline."
+        )
+        return 1
+    print(
+        f"tslint: OK — 0 new findings ({len(result.baselined)} baselined) "
+        f"across {n_rules} rule(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""Cancellation and fire-and-forget regression tests (ISSUE 4 satellites).
+
+- cancellation must PROPAGATE through the transport-buffer put lifecycle
+  (transport/buffers.py wraps the data-plane RPC in ``except BaseException``
+  blocks that count errors — they must re-raise, never swallow, and drop()
+  must still run);
+- ``utils.spawn_logged`` is the repo's only sanctioned fire-and-forget
+  spawn: it retains the task, and a failing task is logged + counted in
+  ``ts_background_task_errors_total`` instead of vanishing (the
+  orphan-task tslint rule points here).
+"""
+
+import asyncio
+import logging
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from torchstore_tpu.observability import metrics as obs_metrics  # noqa: E402
+from torchstore_tpu.strategy import StorageVolumeRef  # noqa: E402
+from torchstore_tpu.transport.buffers import (  # noqa: E402
+    TransportBuffer,
+    TransportContext,
+)
+from torchstore_tpu.transport.types import Request  # noqa: E402
+from torchstore_tpu.utils import spawn_logged  # noqa: E402
+
+
+class _HangingEndpoint:
+    """Stands in for ``volume.actor.put``: hangs until cancelled."""
+
+    def __init__(self) -> None:
+        self.started = asyncio.Event()
+
+    def with_timeout(self, timeout):
+        return self
+
+    def _effective_timeout(self):
+        return None
+
+    async def call_one(self, *args, **kwargs):
+        self.started.set()
+        await asyncio.Event().wait()  # forever
+
+
+class _FakeActor:
+    def __init__(self) -> None:
+        self.put = _HangingEndpoint()
+
+
+class _NullBuffer(TransportBuffer):
+    transport_name = "test_cancel"
+    requires_handshake = False
+
+    def __init__(self) -> None:
+        self.dropped = 0
+
+    def _handle_storage_volume_response(self, volume, remote, requests):
+        return []
+
+    def handle_put_request(self, ctx, metas, existing):
+        return {}
+
+    def handle_get_request(self, ctx, metas, entries):
+        return None
+
+    def drop(self) -> None:
+        self.dropped += 1
+
+
+def _errors(op: str) -> float:
+    return obs_metrics.counter("ts_transport_errors_total").value(
+        transport="test_cancel", op=op
+    )
+
+
+def test_cancellation_propagates_through_put_lifecycle():
+    async def main():
+        buf = _NullBuffer()
+        actor = _FakeActor()
+        volume = StorageVolumeRef(
+            actor=actor, volume_id="v0", transport_context=TransportContext()
+        )
+        req = Request.from_tensor("k", np.zeros(16, dtype=np.float32))
+        before = _errors("put")
+        task = asyncio.create_task(buf.put_to_storage_volume(volume, [req]))
+        await asyncio.wait_for(actor.put.started.wait(), 10)
+        task.cancel()
+        # The whole point: CancelledError comes back out — the lifecycle's
+        # broad error accounting must re-raise, not swallow.
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert task.cancelled()
+        # ... while the finally-guaranteed release still ran, and the error
+        # counter recorded the aborted transfer.
+        assert buf.dropped == 1
+        assert _errors("put") == before + 1
+
+    asyncio.run(main())
+
+
+def test_spawn_logged_counts_and_logs_failures(caplog):
+    async def main():
+        tasks: set = set()
+
+        async def boom():
+            raise RuntimeError("kaboom")
+
+        counter = obs_metrics.counter("ts_background_task_errors_total")
+        before = counter.value(task="test.boom")
+        with caplog.at_level(logging.ERROR, logger="torchstore_tpu.tasks"):
+            t = spawn_logged(boom(), name="test.boom", tasks=tasks)
+            assert t in tasks  # retained while in flight
+            with pytest.raises(RuntimeError):
+                await t
+            await asyncio.sleep(0)  # let the done-callback run
+        assert t not in tasks  # discarded once done
+        assert counter.value(task="test.boom") == before + 1
+        assert any("test.boom" in rec.getMessage() for rec in caplog.records)
+
+    asyncio.run(main())
+
+
+def test_spawn_logged_cancellation_is_not_an_error():
+    async def main():
+        tasks: set = set()
+
+        async def forever():
+            await asyncio.Event().wait()
+
+        counter = obs_metrics.counter("ts_background_task_errors_total")
+        before = counter.value(task="test.cancelled")
+        t = spawn_logged(forever(), name="test.cancelled", tasks=tasks)
+        await asyncio.sleep(0)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        await asyncio.sleep(0)
+        assert t not in tasks
+        assert counter.value(task="test.cancelled") == before
+
+    asyncio.run(main())
+
+
+def test_spawn_logged_success_keeps_result():
+    async def main():
+        async def work():
+            return 42
+
+        t = spawn_logged(work(), name="test.ok")
+        assert await t == 42
+
+    asyncio.run(main())
+
+
+def test_bulk_send_join_does_not_eat_outer_cancellation():
+    """The bulk reader joins cancelled send tasks via gather(...,
+    return_exceptions=True): cancelling the JOINING coroutine itself must
+    still propagate (the old per-task ``except (CancelledError, Exception)``
+    swallowed it)."""
+
+    async def main():
+        started = asyncio.Event()
+
+        async def send():
+            await asyncio.Event().wait()
+
+        sends = [asyncio.ensure_future(send()) for _ in range(3)]
+
+        async def reader_teardown():
+            for s in sends:
+                s.cancel()
+            started.set()
+            await asyncio.gather(*sends, return_exceptions=True)
+            await asyncio.Event().wait()  # simulate further teardown work
+
+        t = asyncio.create_task(reader_teardown())
+        await started.wait()
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert t.cancelled()
+
+    asyncio.run(main())

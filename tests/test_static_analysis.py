@@ -1,0 +1,578 @@
+"""Tier-1 guard for the static-analysis suite (torchstore_tpu/analysis/).
+
+Two layers:
+
+1. **Checker self-tests on fixture snippets** — each of the seven rules must
+   catch a seeded defect (a synthetic endpoint typo, a swallowed
+   CancelledError, an unregistered env var, ...) and stay quiet on the
+   matching clean snippet, so a refactor of the suite cannot silently turn
+   a rule into a no-op.
+2. **The zero-new-findings gate** — the full suite over THIS repo against
+   the committed baseline (tslint_baseline.json) must report no new
+   findings, and the orphan-task / cancellation-swallow rules must not be
+   baselined away (their fixes landed with the checkers that found them).
+"""
+
+import asyncio
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from torchstore_tpu.analysis import (  # noqa: E402
+    DEFAULT_BASELINE,
+    Project,
+    load_baseline,
+    run_checks,
+    save_baseline,
+)
+from torchstore_tpu.analysis.checkers import (  # noqa: E402
+    CHECKERS,
+    async_blocking,
+    cancellation,
+    endpoint_drift,
+    env_registry,
+    fork_safety,
+    metric_discipline,
+    orphan_task,
+)
+
+
+def _project(tmp_path, files: dict) -> Project:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(str(tmp_path))
+
+
+def _msgs(findings, rule=None):
+    return [f.message for f in findings if rule is None or f.rule == rule]
+
+
+# --------------------------------------------------------------------------
+# 1. endpoint-drift
+# --------------------------------------------------------------------------
+
+_ACTOR_SRC = """
+    class Vol:
+        @endpoint
+        async def put(self, buffer, metas): ...
+
+        @endpoint
+        async def stats(self, include_volumes=False): ...
+    """
+
+
+def test_endpoint_drift_catches_typo_and_arity(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/vol.py": _ACTOR_SRC,
+            "torchstore_tpu/caller.py": """
+                async def go(ref):
+                    await ref.put.call_one(buf, metas)          # ok
+                    await ref.putt.call_one(buf, metas)         # typo
+                    await ref.put.call_one(buf)                 # missing arg
+                    await ref.stats.call_one(include_volumes=True)  # ok kw
+                    await ref.stats.call_one(bogus=True)        # unknown kw
+                    put = volume.actor.put
+                    await put.with_timeout(9).call_one(b, m)    # ok (alias)
+                    await put.with_timeout(9).call_one()        # alias, bad arity
+                """,
+        },
+    )
+    found = endpoint_drift.check(proj)
+    msgs = _msgs(found)
+    assert any("unknown endpoint 'putt'" in m for m in msgs), msgs
+    assert sum("endpoint 'put'" in m and "matches no endpoint" in m for m in msgs) == 2
+    assert any("bogus" in m for m in msgs), msgs
+    # exactly the four seeded defects, nothing else
+    assert len(found) == 4, [f.render() for f in found]
+
+
+def test_endpoint_drift_live_coverage_not_vacuous():
+    """The real tree must expose a meaningful surface to the checker — a
+    scan-scope regression would otherwise pass the gate vacuously."""
+    proj = Project(str(REPO_ROOT))
+    endpoints = endpoint_drift.collect_endpoints(proj)
+    assert len(endpoints) >= 25, sorted(endpoints)
+    assert "put" in endpoints and "reserve_prewarm" in endpoints
+    assert endpoint_drift.check(proj) == []
+
+
+# --------------------------------------------------------------------------
+# 2. async-blocking
+# --------------------------------------------------------------------------
+
+
+def test_async_blocking_flags_blocking_calls(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio, time, subprocess
+
+                async def bad():
+                    time.sleep(1)
+                    subprocess.run(["true"])
+                    open("/tmp/x")
+                    fut.result()
+
+                async def good(loop):
+                    await asyncio.sleep(1)
+
+                    def thunk():
+                        time.sleep(1)  # executor thunk: exempt
+
+                    await loop.run_in_executor(None, thunk)
+                """,
+        },
+    )
+    msgs = _msgs(async_blocking.check(proj))
+    assert len(msgs) == 4, msgs
+    assert all("'bad'" in m for m in msgs), msgs
+
+
+# --------------------------------------------------------------------------
+# 3. cancellation-swallow
+# --------------------------------------------------------------------------
+
+
+def test_cancellation_swallow_rules(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+
+                async def swallow_base():
+                    try:
+                        await x()
+                    except BaseException:
+                        pass  # seeded defect
+
+                async def swallow_bare():
+                    try:
+                        await x()
+                    except:
+                        log()  # seeded defect
+
+                async def swallow_cancel():
+                    try:
+                        await x()
+                    except asyncio.CancelledError:
+                        return  # seeded defect
+
+                async def ok_reraise():
+                    try:
+                        await x()
+                    except BaseException:
+                        cleanup()
+                        raise
+
+                async def ok_forward_idiom():
+                    try:
+                        await x()
+                    except asyncio.CancelledError:
+                        raise
+                    except BaseException as exc:
+                        report(exc)
+
+                def sync_is_exempt():
+                    try:
+                        run()
+                    except BaseException:
+                        pass
+                """,
+        },
+    )
+    found = cancellation.check(proj)
+    assert len(found) == 3, [f.render() for f in found]
+    assert {"swallow_base", "swallow_bare", "swallow_cancel"} == {
+        m.split("async def ")[1].split("'")[1] for m in _msgs(found)
+    }
+
+
+# --------------------------------------------------------------------------
+# 4. orphan-task
+# --------------------------------------------------------------------------
+
+
+def test_orphan_task_rules(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+
+                def fire_and_forget():
+                    asyncio.create_task(work())  # seeded defect
+
+                def discard_only(tasks):
+                    t = asyncio.ensure_future(work())
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)  # seeded defect
+
+                def logged(tasks):
+                    t = asyncio.create_task(work())
+                    tasks.add(t)
+                    t.add_done_callback(_log_failure)
+
+                class C:
+                    def owner_managed(self):
+                        self._t = asyncio.create_task(work())
+
+                async def awaited():
+                    t = asyncio.create_task(work())
+                    await t
+
+                async def gathered():
+                    t = asyncio.create_task(work())
+                    await asyncio.gather(t)
+                """,
+        },
+    )
+    found = orphan_task.check(proj)
+    assert len(found) == 2, [f.render() for f in found]
+    assert any("fire-and-forget" in m for m in _msgs(found))
+    assert any("set discard" in m for m in _msgs(found))
+
+
+# --------------------------------------------------------------------------
+# 5. fork-safety
+# --------------------------------------------------------------------------
+
+
+def test_fork_safety_rules(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/bad.py": """
+                import threading
+                _registry = {}
+                _lock = threading.Lock()
+                RULE_TABLE = {"a": 1}   # constant convention: exempt
+                _FROZEN = frozenset()   # immutable: exempt
+                """,
+            "torchstore_tpu/good.py": """
+                _registry = {}
+
+                def reinit_after_fork():
+                    _registry.clear()
+                """,
+            "torchstore_tpu/pragma.py": """
+                _cache = {}  # tslint: disable=fork-safety
+                """,
+            "scripts/tool.py": """
+                _state = {}  # scripts never run inside forked actors
+                """,
+        },
+    )
+    found = fork_safety.check(proj)
+    # the raw checker sees the pragma'd file too; suppression is run_checks' job
+    assert {f.path for f in found} == {
+        "torchstore_tpu/bad.py",
+        "torchstore_tpu/pragma.py",
+    }
+    assert sum(f.path == "torchstore_tpu/bad.py" for f in found) == 2
+    result = run_checks(str(tmp_path), rules=["fork-safety"], project=proj)
+    assert {f.path for f in result.findings} == {"torchstore_tpu/bad.py"}
+
+
+# --------------------------------------------------------------------------
+# 6. env-registry
+# --------------------------------------------------------------------------
+
+_FIXTURE_CONFIG = """
+    ENV_REGISTRY = (
+        EnvVar("TORCHSTORE_TPU_FOO", "int", 7, "Foo knob."),
+        EnvVar("TORCHSTORE_TPU_DEAD", "str", None, "Referenced nowhere."),
+    )
+    ENV_PREFIXES = ("TORCHSTORE_TPU_DYN_",)
+    """
+
+
+def test_env_registry_rules(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/config.py": _FIXTURE_CONFIG,
+            "torchstore_tpu/m.py": """
+                import os
+                ok = os.environ.get("TORCHSTORE_TPU_FOO", "7")
+                unregistered = os.environ.get("TORCHSTORE_TPU_BAR")  # seeded
+                dyn = os.environ.get("TORCHSTORE_TPU_DYN_THING")     # prefix ok
+                drifted = os.environ.get("TORCHSTORE_TPU_FOO", "9")  # seeded
+                """,
+        },
+    )
+    msgs = _msgs(env_registry.check(proj))
+    assert any("'TORCHSTORE_TPU_BAR'" in m and "not declared" in m for m in msgs), msgs
+    assert any("'TORCHSTORE_TPU_DEAD'" in m and "dead knob" in m for m in msgs), msgs
+    assert any("defaults must not fork" in m for m in msgs), msgs
+    assert any("docs/API.md is missing" in m for m in msgs), msgs
+    assert not any("TORCHSTORE_TPU_DYN_THING" in m for m in msgs), msgs
+    assert len(msgs) == 4, msgs
+
+
+def test_env_registry_bool_default_comparison(tmp_path):
+    """bool registry defaults must compare by _env_bool semantics, not
+    bool("0") truthiness: True vs "0" is drift, False vs "0" is not."""
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/config.py": """
+                ENV_REGISTRY = (
+                    EnvVar("TORCHSTORE_TPU_ON", "bool", True, "On knob."),
+                    EnvVar("TORCHSTORE_TPU_OFF", "bool", False, "Off knob."),
+                )
+                """,
+            "torchstore_tpu/m.py": """
+                import os
+                drift = os.environ.get("TORCHSTORE_TPU_ON", "0")   # seeded
+                fine = os.environ.get("TORCHSTORE_TPU_OFF", "0")   # equivalent
+                also = os.environ.get("TORCHSTORE_TPU_ON", "1")    # equivalent
+                """,
+        },
+    )
+    msgs = [
+        m for m in _msgs(env_registry.check(proj)) if "defaults must not fork" in m
+    ]
+    assert len(msgs) == 1 and "TORCHSTORE_TPU_ON" in msgs[0], msgs
+
+
+def test_env_registry_docs_block_roundtrip(tmp_path):
+    entries, prefixes, _ = env_registry.parse_registry(
+        textwrap.dedent(_FIXTURE_CONFIG)
+    )
+    assert [e.name for e in entries] == ["TORCHSTORE_TPU_FOO", "TORCHSTORE_TPU_DEAD"]
+    assert prefixes == ["TORCHSTORE_TPU_DYN_"]
+    table = env_registry.render_env_table(entries)
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/config.py": _FIXTURE_CONFIG,
+            "torchstore_tpu/m.py": """
+                import os
+                a = os.environ.get("TORCHSTORE_TPU_FOO", "7")
+                b = os.environ.get("TORCHSTORE_TPU_DEAD")
+                """,
+        },
+    )
+    docs = tmp_path / "docs" / "API.md"
+    docs.parent.mkdir()
+    docs.write_text(
+        f"# API\n\n{env_registry.DOCS_BEGIN}\n{table}\n{env_registry.DOCS_END}\n"
+    )
+    assert env_registry.check(proj) == []
+    # a stale table (entry edited without regen) is a finding
+    docs.write_text(
+        f"# API\n\n{env_registry.DOCS_BEGIN}\nstale\n{env_registry.DOCS_END}\n"
+    )
+    msgs = _msgs(env_registry.check(proj))
+    assert any("stale" in m for m in msgs), msgs
+
+
+# --------------------------------------------------------------------------
+# 7. metric-discipline
+# --------------------------------------------------------------------------
+
+
+def test_metric_discipline_rules(tmp_path):
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/a.py": """
+                from torchstore_tpu.observability import metrics as m
+                _C = m.counter("ts_thing_total", "help")
+                _BAD = m.gauge("Bad-Name", "not snake case")
+                _NOPREFIX = m.counter("thing_total", "missing ts_")
+
+                def use(key):
+                    _C.inc(key=key)  # unbounded label: seeded defect
+                    _C.inc(op="put")  # allowlisted: ok
+
+                def trace():
+                    with span("Bad Span"):  # seeded defect
+                        pass
+                    with span("rpc/put"):
+                        pass
+                """,
+            "torchstore_tpu/b.py": """
+                from torchstore_tpu.observability import metrics as m
+                _G = m.gauge("ts_thing_total")
+                """,
+        },
+    )
+    msgs = _msgs(metric_discipline.check(proj))
+    assert any("conflicting kinds" in m and "ts_thing_total" in m for m in msgs), msgs
+    assert any("Bad-Name" in m and "snake_case" in m for m in msgs), msgs
+    assert any("'thing_total'" in m and "prefix" in m for m in msgs), msgs
+    assert any("label key 'key'" in m for m in msgs), msgs
+    assert any("span name 'Bad Span'" in m for m in msgs), msgs
+    assert len(msgs) == 5, msgs
+
+
+# --------------------------------------------------------------------------
+# Framework: pragmas, baseline, runner
+# --------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_findings(tmp_path):
+    _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+
+                def spawn():
+                    asyncio.create_task(work())  # tslint: disable=orphan-task
+                """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["orphan-task"])
+    assert result.findings == []
+
+
+def test_file_pragma_suppresses_whole_file(tmp_path):
+    _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                # tslint: disable-file=orphan-task
+                import asyncio
+
+                def spawn():
+                    asyncio.create_task(work())
+                """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["orphan-task"])
+    assert result.findings == []
+
+
+def test_baseline_splits_new_from_grandfathered(tmp_path):
+    _project(
+        tmp_path,
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+
+                def one():
+                    asyncio.create_task(work())
+                """,
+        },
+    )
+    # grandfather the current state
+    result = run_checks(str(tmp_path), rules=["orphan-task"])
+    assert len(result.new) == 1
+    baseline = tmp_path / "baseline.json"
+    save_baseline(str(baseline), result.findings)
+    result = run_checks(
+        str(tmp_path), rules=["orphan-task"], baseline_path=str(baseline)
+    )
+    assert result.new == [] and len(result.baselined) == 1
+    # a SECOND, identical-message defect in the same file exceeds the count
+    (tmp_path / "torchstore_tpu" / "m.py").write_text(
+        textwrap.dedent(
+            """
+            import asyncio
+
+            def one():
+                asyncio.create_task(work())
+
+            def two():
+                asyncio.create_task(work())
+            """
+        )
+    )
+    result = run_checks(
+        str(tmp_path), rules=["orphan-task"], baseline_path=str(baseline)
+    )
+    assert len(result.new) == 1 and len(result.baselined) == 1
+
+
+def test_unknown_rule_rejected(tmp_path):
+    (tmp_path / "torchstore_tpu").mkdir()
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_checks(str(tmp_path), rules=["no-such-rule"])
+
+
+# --------------------------------------------------------------------------
+# The tier-1 gate: zero NEW findings on this repo
+# --------------------------------------------------------------------------
+
+
+def test_repo_is_clean_against_baseline():
+    baseline = REPO_ROOT / DEFAULT_BASELINE
+    assert baseline.exists(), "tslint_baseline.json must be committed"
+    result = run_checks(str(REPO_ROOT), baseline_path=str(baseline))
+    assert result.new == [], "NEW tslint findings:\n" + "\n".join(
+        f.render() for f in result.new
+    )
+    assert set(result.rules) == set(CHECKERS)
+
+
+def test_orphan_and_cancellation_rules_not_baselined_away():
+    """Acceptance: the orphan-task and cancellation-swallow fixes landed
+    WITH their checkers enabled — no grandfathered findings for either."""
+    grandfathered = load_baseline(str(REPO_ROOT / DEFAULT_BASELINE))
+    offenders = [
+        key
+        for key in grandfathered
+        if key[0] in ("orphan-task", "cancellation-swallow")
+    ]
+    assert offenders == []
+
+
+def test_cli_json_runs_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "tslint.py"), "--json"],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO_ROOT),
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["new"] == 0
+    assert sorted(doc["rules"]) == sorted(CHECKERS)
+
+
+def test_cli_fail_on_new_reports_seeded_defect(tmp_path):
+    """--fail-on-new gate mode: a synthetic endpoint typo added to a copy of
+    the scan scope fails the run and names the typo."""
+    _project(
+        tmp_path,
+        {
+            "torchstore_tpu/vol.py": _ACTOR_SRC,
+            "torchstore_tpu/caller.py": """
+                async def go(ref):
+                    await ref.putt.call_one(1, 2)
+                """,
+        },
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO_ROOT / "scripts" / "tslint.py"),
+            "--fail-on-new",
+            "--rules",
+            "endpoint-drift",
+            "--root",
+            str(tmp_path),
+            "--no-baseline",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "putt" in proc.stdout

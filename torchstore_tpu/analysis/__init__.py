@@ -1,0 +1,29 @@
+"""Repo-specific static analysis (``tslint``): mechanical enforcement of
+the conventions the store's correctness rests on.
+
+Seven AST-based checkers (see ``analysis/checkers/``), a committed baseline
+of grandfathered findings (``tslint_baseline.json``), per-line
+``# tslint: disable=<rule>`` pragmas, and a CLI (``scripts/tslint.py``)
+with human and ``--json`` output plus a ``--fail-on-new`` gate mode wired
+into tier-1 via tests/test_static_analysis.py.
+"""
+
+from torchstore_tpu.analysis.core import (
+    DEFAULT_BASELINE,
+    Finding,
+    Project,
+    RunResult,
+    load_baseline,
+    run_checks,
+    save_baseline,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Project",
+    "RunResult",
+    "load_baseline",
+    "run_checks",
+    "save_baseline",
+]

@@ -1,0 +1,29 @@
+"""Checker registry: rule name -> check(project) -> list[Finding].
+
+Adding a checker: write ``checkers/<name>.py`` with ``RULE`` and
+``check(project)``, register it here, add fixture self-tests in
+tests/test_static_analysis.py proving it catches a seeded defect, run
+``python scripts/tslint.py`` and triage what it finds in the live tree
+(fix, pragma with justification, or baseline), and document the rule in
+docs/ARCHITECTURE.md.
+"""
+
+from torchstore_tpu.analysis.checkers import (
+    async_blocking,
+    cancellation,
+    endpoint_drift,
+    env_registry,
+    fork_safety,
+    metric_discipline,
+    orphan_task,
+)
+
+CHECKERS = {
+    endpoint_drift.RULE: endpoint_drift.check,
+    async_blocking.RULE: async_blocking.check,
+    cancellation.RULE: cancellation.check,
+    orphan_task.RULE: orphan_task.check,
+    fork_safety.RULE: fork_safety.check,
+    env_registry.RULE: env_registry.check,
+    metric_discipline.RULE: metric_discipline.check,
+}

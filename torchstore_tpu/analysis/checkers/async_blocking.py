@@ -1,0 +1,95 @@
+"""async-blocking: no synchronous blocking calls on the event loop.
+
+Every actor endpoint runs on its process's single event loop; one blocking
+call inside an ``async def`` stalls every in-flight RPC that process serves
+(the SHM pool's MAP_POPULATE prefault at 0.1-0.2 s/GB was exactly this bug
+before it moved to an executor thread). The checker flags a curated set of
+known-blocking calls inside ``async def`` bodies. Nested synchronous
+``def``/``lambda`` bodies are exempt — that is the executor-thunk idiom
+(``loop.run_in_executor(None, fn)``).
+
+Legitimate exceptions (startup-only paths, sub-millisecond file reads)
+carry a ``# tslint: disable=async-blocking`` pragma with a justification
+comment, or live in the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, call_tail, dotted_name, walk_scope
+
+RULE = "async-blocking"
+
+# dotted-call suffixes that block the calling thread.
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep() blocks the event loop; use await asyncio.sleep()",
+    "os.system": "os.system() blocks the event loop",
+    "os.popen": "os.popen() blocks the event loop",
+    "os.waitpid": "os.waitpid() blocks the event loop",
+    "subprocess.run": "subprocess.run() blocks the event loop",
+    "subprocess.call": "subprocess.call() blocks the event loop",
+    "subprocess.check_call": "subprocess.check_call() blocks the event loop",
+    "subprocess.check_output": "subprocess.check_output() blocks the event loop",
+    "shutil.copy": "sync file IO blocks the event loop",
+    "shutil.copy2": "sync file IO blocks the event loop",
+    "shutil.copyfile": "sync file IO blocks the event loop",
+    "shutil.copytree": "sync file IO blocks the event loop",
+    "shutil.rmtree": "sync file IO blocks the event loop",
+    "socket.create_connection": "blocking connect; use loop.sock_connect",
+    "socket.getaddrinfo": "blocking DNS resolution; use loop.getaddrinfo",
+}
+
+# bare-name calls
+_BLOCKING_NAMES = {
+    "open": "sync file IO in a coroutine blocks the event loop (move to an "
+    "executor thread, or pragma startup-only reads)",
+}
+
+# method tails flagged regardless of receiver
+_BLOCKING_TAILS = {
+    "ts_prefault": "native prefault releases the GIL but still blocks THIS "
+    "thread; run it via loop.run_in_executor",
+}
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_scope(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                dotted = dotted_name(node.func)
+                tail = call_tail(node)
+                if dotted is not None and dotted in _BLOCKING_DOTTED:
+                    msg = _BLOCKING_DOTTED[dotted]
+                elif isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_NAMES:
+                    msg = _BLOCKING_NAMES[node.func.id]
+                elif tail in _BLOCKING_TAILS:
+                    msg = _BLOCKING_TAILS[tail]
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    msg = (
+                        ".result() on a concurrent Future blocks the event "
+                        "loop (await it, or asyncio.wrap_future first)"
+                    )
+                if msg is not None:
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.path,
+                            node.lineno,
+                            f"blocking call in async def {fn.name!r}: {msg}",
+                        )
+                    )
+    return findings

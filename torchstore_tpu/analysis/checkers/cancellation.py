@@ -1,0 +1,79 @@
+"""cancellation-swallow: coroutines must let CancelledError escape.
+
+``asyncio.CancelledError`` derives from ``BaseException`` precisely so that
+``except Exception`` cannot eat it — but a bare ``except:``, an
+``except BaseException:``, or an explicit ``except CancelledError`` handler
+that fails to re-raise swallows cancellation silently. The symptom is a
+task that .cancel() cannot stop: stop() hangs for its full timeout, fleets
+leak processes, tests wedge (PR 3's review pass hand-fixed this class on
+the prewarm paths).
+
+Rule: inside any ``async def``, an except handler that can catch
+``CancelledError`` (bare / BaseException / CancelledError, alone or in a
+tuple) must contain a ``raise``. A preceding ``except asyncio.CancelledError:
+raise`` handler in the same ``try`` satisfies the rule for the broad
+handlers after it (the standard forward-the-error idiom in
+runtime/actors.py's dispatcher).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name, walk_scope
+
+RULE = "cancellation-swallow"
+
+
+def _catches(handler: ast.ExceptHandler) -> tuple[bool, bool, str]:
+    """(catches_cancellation, is_cancel_only, description)."""
+    if handler.type is None:
+        return True, False, "bare except:"
+    exprs = (
+        list(handler.type.elts)
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    names = [dotted_name(e) or "?" for e in exprs]
+    tails = {n.rsplit(".", 1)[-1] for n in names}
+    catches = bool(tails & {"BaseException", "CancelledError", "KeyboardInterrupt"})
+    cancel_only = tails <= {"CancelledError"}
+    return catches, cancel_only, f"except ({', '.join(names)})"
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in walk_scope(handler.body))
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_scope(fn.body):
+                if not isinstance(node, ast.Try):
+                    continue
+                cancel_reraised_earlier = False
+                for handler in node.handlers:
+                    catches, cancel_only, desc = _catches(handler)
+                    if not catches:
+                        continue
+                    if _reraises(handler):
+                        cancel_reraised_earlier = True
+                        continue
+                    if cancel_reraised_earlier and not cancel_only:
+                        continue  # CancelledError already re-raised above
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.path,
+                            handler.lineno,
+                            f"{desc} in async def {fn.name!r} swallows "
+                            "asyncio.CancelledError (no re-raise): narrow "
+                            "to except Exception, or re-raise",
+                        )
+                    )
+    return findings

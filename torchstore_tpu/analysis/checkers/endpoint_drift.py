@@ -1,0 +1,197 @@
+"""endpoint-drift: stub/mesh RPC call sites must match a real ``@endpoint``.
+
+The actor runtime dispatches by name: ``runtime/actors.py`` resolves
+``msg["method"]`` with ``getattr`` + the ``_ENDPOINT_ATTR`` flag, and
+``ActorRef.__getattr__`` happily builds an endpoint ref for ANY attribute.
+A typo'd method or a re-signatured endpoint therefore raises only at
+runtime, deep inside a fleet test ("RPC Considered Harmful", PAPERS.md).
+
+This checker cross-references every ``<ref>.<method>.call_one(...)`` /
+``.call(...)`` / ``.with_timeout(...).call_one(...)`` site against the
+``@endpoint``-decorated methods collected from every ``Actor`` class in the
+tree, including arity and keyword compatibility. Single-level local aliases
+are resolved (``put = volume.actor.put; await put.with_timeout(t).call_one(..)``).
+Dynamic dispatch (``getattr(ref, name)``) is invisible to the checker and
+deliberately skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from torchstore_tpu.analysis.core import Finding, Project, iter_function_scopes, walk_scope
+
+RULE = "endpoint-drift"
+
+_CALL_METHODS = ("call", "call_one")
+
+
+@dataclass(frozen=True)
+class EndpointSig:
+    cls: str
+    path: str
+    params: tuple[str, ...]  # positional(+kw) params, self excluded
+    defaults: int  # how many trailing params have defaults
+    vararg: bool
+    kwonly: tuple[str, ...]
+    kwonly_required: tuple[str, ...]
+    kwarg: bool
+
+    def describe(self) -> str:
+        parts = list(self.params)
+        if self.vararg:
+            parts.append("*args")
+        parts.extend(self.kwonly)
+        if self.kwarg:
+            parts.append("**kwargs")
+        return f"{self.cls}.({', '.join(parts)})"
+
+    def accepts(self, n_pos: int, kwargs: set[str]) -> bool:
+        if not self.vararg and n_pos > len(self.params):
+            return False
+        bound = set(self.params[:n_pos])
+        for kw in kwargs:
+            if kw in bound:
+                return False  # duplicate binding
+            if kw in self.params or kw in self.kwonly:
+                bound.add(kw)
+            elif not self.kwarg:
+                return False
+        required = set(self.params[: len(self.params) - self.defaults])
+        required.update(self.kwonly_required)
+        return required <= bound | set(self.params[:n_pos])
+
+
+def collect_endpoints(project: Project) -> dict[str, list[EndpointSig]]:
+    endpoints: dict[str, list[EndpointSig]] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if not any(
+                    (isinstance(d, ast.Name) and d.id == "endpoint")
+                    or (isinstance(d, ast.Attribute) and d.attr == "endpoint")
+                    for d in item.decorator_list
+                ):
+                    continue
+                a = item.args
+                params = tuple(x.arg for x in a.args[1:])  # drop self
+                kwonly = tuple(x.arg for x in a.kwonlyargs)
+                kw_required = tuple(
+                    x.arg
+                    for x, dflt in zip(a.kwonlyargs, a.kw_defaults)
+                    if dflt is None
+                )
+                endpoints.setdefault(item.name, []).append(
+                    EndpointSig(
+                        cls=node.name,
+                        path=sf.path,
+                        params=params,
+                        defaults=len(a.defaults),
+                        vararg=a.vararg is not None,
+                        kwonly=kwonly,
+                        kwonly_required=kw_required,
+                        kwarg=a.kwarg is not None,
+                    )
+                )
+    return endpoints
+
+
+def _method_of(call: ast.Call, aliases: dict[str, str]) -> tuple[str | None, bool]:
+    """(endpoint method name, resolvable) for a ``.call``/``.call_one`` Call.
+
+    Handles ``<expr>.<method>.call_one(..)`` and the ``with_timeout`` chain
+    ``<expr>.<method>.with_timeout(t).call_one(..)`` plus one level of local
+    alias (``put = volume.actor.put``). Returns (None, False) when the
+    receiver is dynamic (getattr, subscripts, ...) — those are skipped.
+    """
+    base = call.func.value  # type: ignore[union-attr]
+    if (
+        isinstance(base, ast.Call)
+        and isinstance(base.func, ast.Attribute)
+        and base.func.attr == "with_timeout"
+    ):
+        base = base.func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr, True
+    if isinstance(base, ast.Name):
+        alias = aliases.get(base.id)
+        return (alias, True) if alias is not None else (None, False)
+    return None, False
+
+
+def check(project: Project) -> list[Finding]:
+    endpoints = collect_endpoints(project)
+    findings: list[Finding] = []
+    if not endpoints:
+        return findings  # tree defines no actors; nothing to drift from
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for _fn, body in iter_function_scopes(sf.tree):
+            # One-level alias map for this scope: name <- trailing attribute
+            # of a plain attribute-chain assignment.
+            aliases: dict[str, str] = {}
+            for node in walk_scope(body):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Attribute)
+                ):
+                    aliases[node.targets[0].id] = node.value.attr
+            for node in walk_scope(body):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CALL_METHODS
+                ):
+                    continue
+                method, ok = _method_of(node, aliases)
+                if not ok or method is None:
+                    continue
+                if method.startswith("_") or method in (
+                    "call",
+                    "call_one",
+                    "with_timeout",
+                ):
+                    continue
+                sigs = endpoints.get(method)
+                if sigs is None:
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.path,
+                            node.lineno,
+                            f"RPC to unknown endpoint {method!r}: no actor "
+                            "class defines an @endpoint method with this "
+                            "name (typo or removed endpoint?)",
+                        )
+                    )
+                    continue
+                if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                    kw.arg is None for kw in node.keywords
+                ):
+                    continue  # *args/**kwargs call: arity unknowable
+                n_pos = len(node.args)
+                kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+                if not any(sig.accepts(n_pos, kwargs) for sig in sigs):
+                    cands = "; ".join(sorted(s.describe() for s in sigs))
+                    kwtxt = f" + kwargs {sorted(kwargs)}" if kwargs else ""
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.path,
+                            node.lineno,
+                            f"RPC to endpoint {method!r} with {n_pos} "
+                            f"positional arg(s){kwtxt} matches no endpoint "
+                            f"signature (candidates: {cands})",
+                        )
+                    )
+    return findings

@@ -1,0 +1,133 @@
+"""fork-safety: module-level mutable state needs a fork story.
+
+The actor runtime spawns children via forkserver, and the forkserver helper
+preloads ``torchstore_tpu.runtime`` — so every module imported by that
+preload has its module-level state SNAPSHOTTED at the helper's start and
+inherited by every actor child. PR 2 fixed a whole class of bugs this
+caused by hand (dumper/exporter threads that didn't survive the fork, a
+trace collector claiming a dead run's file); the fix was per-facility
+``reinit_after_fork`` hooks re-armed in ``_child_main``.
+
+Rule: a module that creates mutable state at import time — dict/list/set
+registries, ``threading`` primitives, sockets — must either define a
+``reinit_after_fork`` hook (the convention ``runtime/actors.py`` re-arms in
+children), call ``os.register_at_fork``, or annotate each benign global
+with a ``# tslint: disable=fork-safety`` pragma whose comment explains why
+stale inheritance is safe (e.g. keyed by event loop and pruned, or only
+ever populated post-fork).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name
+
+RULE = "fork-safety"
+
+_MUTABLE_CALLS = {
+    "dict",
+    "list",
+    "set",
+    "defaultdict",
+    "deque",
+    "OrderedDict",
+    "Counter",
+    "WeakValueDictionary",
+    "WeakKeyDictionary",
+    "WeakSet",
+}
+_PRIMITIVE_CALLS = {
+    "Thread",
+    "Lock",
+    "RLock",
+    "Condition",
+    "Event",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "local",
+    "socket",
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+}
+
+_EXEMPT_NAMES = {"__all__"}
+# Constant-convention globals (ALL_CAPS) are rule tables, never mutated;
+# inheriting them across a fork is exactly as safe as re-importing them.
+_CONST_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+def _mutable_kind(value: ast.expr) -> str | None:
+    if isinstance(value, ast.Dict):
+        return "dict literal"
+    if isinstance(value, ast.List):
+        return "list literal"
+    if isinstance(value, ast.Set):
+        return "set literal"
+    if isinstance(value, ast.Call):
+        tail = None
+        if isinstance(value.func, ast.Name):
+            tail = value.func.id
+        elif isinstance(value.func, ast.Attribute):
+            tail = value.func.attr
+        if tail in _MUTABLE_CALLS:
+            return f"{tail}()"
+        if tail in _PRIMITIVE_CALLS:
+            dn = dotted_name(value.func) or tail
+            return f"{dn}() sync/thread/socket primitive"
+    return None
+
+
+def _has_fork_story(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in ("reinit_after_fork", "_reinit_after_fork")
+        ):
+            return True
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn == "os.register_at_fork":
+                return True
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith("torchstore_tpu/"):
+            continue  # scripts/benches never run inside forked actors
+        if _has_fork_story(sf.tree):
+            continue
+        for node in sf.tree.body:
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            kind = _mutable_kind(value)
+            if kind is None:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names or all(
+                n in _EXEMPT_NAMES or _CONST_RE.match(n) for n in names
+            ):
+                continue
+            findings.append(
+                Finding(
+                    RULE,
+                    sf.path,
+                    node.lineno,
+                    f"module-level mutable state {'/'.join(names)!s} "
+                    f"({kind}) in a module with no reinit_after_fork/"
+                    "register_at_fork hook: forkserver children inherit "
+                    "this object's pre-fork contents",
+                )
+            )
+    return findings

@@ -1,0 +1,140 @@
+"""orphan-task: fire-and-forget tasks must retain + retrieve exceptions.
+
+``asyncio`` holds spawned tasks weakly: a ``create_task`` whose result is
+dropped can be garbage-collected mid-flight, and a task whose exception is
+never retrieved dies silently (one "Task exception was never retrieved"
+line at GC time, long after the fact — if at all). The store's reclaim
+drainer, SHM pool warmer, and pre-attacher were all spawned this way.
+
+Rule: every ``asyncio.create_task`` / ``ensure_future`` /
+``loop.create_task`` call must either
+
+- assign the task to an attribute (``self._reader_task = ...`` — the owner
+  awaits/cancels it), or
+- be awaited / returned / gathered in the same scope, or
+- register a done-callback that can RETRIEVE the exception. A callback
+  that is just ``<set>.discard`` / ``.remove`` only un-retains — it never
+  calls ``task.exception()``, so failures stay silent; use
+  ``utils.spawn_logged`` which retains AND logs + counts failures.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import (
+    Finding,
+    Project,
+    iter_function_scopes,
+    walk_scope,
+)
+
+RULE = "orphan-task"
+
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+
+def _is_spawn(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (
+            (isinstance(node.func, ast.Attribute) and node.func.attr in _SPAWN_ATTRS)
+            or (isinstance(node.func, ast.Name) and node.func.id in _SPAWN_ATTRS)
+        )
+    )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for _fn, body in iter_function_scopes(sf.tree):
+            stmts = list(walk_scope(body))
+            # name -> spawn line, for tasks bound to a local name
+            spawned: dict[str, int] = {}
+            callbacks: dict[str, list[ast.expr]] = {}
+            safe: set[str] = set()
+            for node in stmts:
+                # task = create_task(...)
+                if isinstance(node, ast.Assign) and _is_spawn(node.value):
+                    if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                        spawned[node.targets[0].id] = node.value.lineno
+                    # self._x = create_task(...): owner-managed, fine
+                    continue
+                # bare create_task(...) statement: nothing retains it
+                if isinstance(node, ast.Expr) and _is_spawn(node.value):
+                    findings.append(
+                        Finding(
+                            RULE,
+                            sf.path,
+                            node.value.lineno,
+                            "fire-and-forget task: create_task result is "
+                            "dropped (GC can cancel it mid-flight; its "
+                            "exception is never retrieved) — use "
+                            "utils.spawn_logged",
+                        )
+                    )
+                    continue
+            for node in stmts:
+                # t.add_done_callback(cb)
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_done_callback"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in spawned
+                    and node.args
+                ):
+                    callbacks.setdefault(node.func.value.id, []).append(node.args[0])
+                # await t / return t / gather(.., t, ..) / wait([...t...])
+                if isinstance(node, ast.Await) and isinstance(node.value, ast.Name):
+                    safe.add(node.value.id)
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+                    safe.add(node.value.id)
+                if isinstance(node, ast.Call):
+                    tail = (
+                        node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name)
+                        else None
+                    )
+                    if tail in ("gather", "wait", "wait_for", "shield", "as_completed"):
+                        for a in node.args:
+                            for sub in ast.walk(a):
+                                if isinstance(sub, ast.Name):
+                                    safe.add(sub.id)
+                # self.attr = t  (ownership transferred)
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and any(isinstance(t, ast.Attribute) for t in node.targets)
+                ):
+                    safe.add(node.value.id)
+            for name, line in spawned.items():
+                if name in safe:
+                    continue
+                cbs = callbacks.get(name, [])
+                has_logging_cb = any(
+                    not (isinstance(cb, ast.Attribute) and cb.attr in ("discard", "remove"))
+                    for cb in cbs
+                )
+                if has_logging_cb:
+                    continue
+                if cbs:
+                    msg = (
+                        f"task {name!r} is retained only until completion: "
+                        "its sole done-callback is a set discard, which "
+                        "never retrieves the exception — failures vanish "
+                        "silently; use utils.spawn_logged"
+                    )
+                else:
+                    msg = (
+                        f"task {name!r} is spawned but never awaited, "
+                        "stored, or given a done-callback — it can be "
+                        "garbage-collected mid-flight and its exception is "
+                        "never retrieved; use utils.spawn_logged"
+                    )
+                findings.append(Finding(RULE, sf.path, line, msg))
+    return findings

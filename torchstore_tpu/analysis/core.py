@@ -1,0 +1,294 @@
+"""Core infrastructure for the repo's static-analysis suite (``tslint``).
+
+The store's correctness rests on conventions no general-purpose linter knows
+about: actor endpoints are dispatched dynamically by name (a typo'd RPC only
+fails at runtime), coroutines must never swallow ``asyncio.CancelledError``,
+forkserver children inherit module state, every ``TORCHSTORE_TPU_*`` knob
+must live in the typed registry in ``config.py``, and the metric/span
+namespace must not fork. Each of those conventions has shipped at least one
+real bug (see ISSUE 4 / CHANGES.md); the checkers under
+``analysis/checkers/`` turn them into mechanical, tier-1-enforced rules.
+
+This module provides the shared plumbing:
+
+- ``SourceFile`` / ``Project`` — the scanned tree, parsed once (one
+  ``ast.parse`` per file shared by every checker).
+- ``Finding`` — one diagnostic, with a line-independent identity
+  (rule, path, message) so the baseline survives unrelated edits.
+- pragma suppression — ``# tslint: disable=<rule>[,<rule>...]`` on the
+  finding line or the line directly above; ``# tslint: disable-file=<rule>``
+  in the first 20 lines disables a rule for the whole file.
+- baseline — a checked-in JSON multiset of grandfathered findings;
+  ``run_checks`` splits results into baselined and NEW findings so the
+  tier-1 gate can fail only on regressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# Mirrors scripts/check_metric_names.py's historical scope: the shipped
+# package plus every executable entry point. Tests are deliberately excluded
+# — they seed intentional violations against private registries/fixtures.
+SCAN_DIRS = ("torchstore_tpu", "benchmarks", "scripts", "examples")
+SCAN_FILES = ("bench.py", "__graft_entry__.py")
+
+DEFAULT_BASELINE = "tslint_baseline.json"
+
+_PRAGMA_RE = re.compile(r"#\s*tslint:\s*disable=([a-z0-9_,\- ]+)")
+_PRAGMA_FILE_RE = re.compile(r"#\s*tslint:\s*disable-file=([a-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``message`` must not embed line numbers — the
+    baseline matches on (rule, path, message) so unrelated edits that shift
+    lines do not resurrect grandfathered findings."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed file: source text, AST, and pragma tables."""
+
+    def __init__(self, root: str, abspath: str) -> None:
+        self.abspath = abspath
+        self.path = os.path.relpath(abspath, root).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=abspath)
+        except SyntaxError as exc:
+            self.parse_error = f"{type(exc).__name__}: {exc}"
+        # line -> set of rules disabled on that line (pragma on the line
+        # itself or the line directly above).
+        self._line_disables: dict[int, set[str]] = {}
+        self._file_disables: set[str] = set()
+        for idx, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self._line_disables.setdefault(idx, set()).update(rules)
+                self._line_disables.setdefault(idx + 1, set()).update(rules)
+            if idx <= 20:
+                m = _PRAGMA_FILE_RE.search(line)
+                if m:
+                    self._file_disables.update(
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    )
+
+    def disabled(self, rule: str, line: int) -> bool:
+        if rule in self._file_disables or "all" in self._file_disables:
+            return True
+        rules = self._line_disables.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+class Project:
+    """The scanned tree, parsed once and shared by every checker."""
+
+    def __init__(self, root: str, paths: Optional[Iterable[str]] = None) -> None:
+        self.root = os.path.abspath(root)
+        if paths is None:
+            paths = discover_files(self.root)
+        self.files: list[SourceFile] = [SourceFile(self.root, p) for p in sorted(paths)]
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        for sf in self.files:
+            if sf.path == relpath:
+                return sf
+        return None
+
+
+def discover_files(root: str) -> list[str]:
+    paths: list[str] = []
+    for rel in SCAN_DIRS:
+        base = os.path.join(root, rel)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            paths.extend(
+                os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+            )
+    for rel in SCAN_FILES:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            paths.append(path)
+    return paths
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], int]:
+    """{(rule, path, message): count} multiset of grandfathered findings."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    out: dict[tuple[str, str, str], int] = {}
+    for entry in doc.get("findings", ()):
+        key = (entry["rule"], entry["path"], entry["message"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    doc = {
+        "comment": (
+            "Grandfathered tslint findings. Entries here do NOT fail the "
+            "tier-1 gate; fix the code and delete the entry rather than "
+            "adding new ones. Regenerate with: python scripts/tslint.py "
+            "--write-baseline"
+        ),
+        "findings": [
+            {"rule": rule, "path": p, "message": msg, "count": n}
+            for (rule, p, msg), n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    rules: tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        new_keys = {f.key for f in self.new}
+        return {
+            "rules": list(self.rules),
+            "total": len(self.findings),
+            "new": len(self.new),
+            "baselined": len(self.baselined),
+            "findings": [
+                dict(f.to_dict(), baselined=f.key not in new_keys)
+                for f in self.findings
+            ],
+        }
+
+
+def run_checks(
+    root: str,
+    rules: Optional[Iterable[str]] = None,
+    baseline_path: Optional[str] = None,
+    project: Optional[Project] = None,
+) -> RunResult:
+    """Run (a subset of) the checkers over ``root``; split findings into
+    baselined and new against ``baseline_path`` (None = no baseline)."""
+    from torchstore_tpu.analysis.checkers import CHECKERS
+
+    if project is None:
+        project = Project(root)
+    selected = dict(CHECKERS)
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - set(selected)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; have {sorted(selected)}"
+            )
+        selected = {k: v for k, v in selected.items() if k in wanted}
+
+    findings: list[Finding] = []
+    for rule, checkfn in selected.items():
+        for f in checkfn(project):
+            sf = project.file(f.path)
+            if sf is not None and sf.disabled(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    result = RunResult(findings=findings, rules=tuple(selected))
+    budget = load_baseline(baseline_path) if baseline_path else {}
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            result.baselined.append(f)
+        else:
+            result.new.append(f)
+    return result
+
+
+# --------------------------------------------------------------------------
+# Shared AST helpers
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_tail(node: ast.Call) -> Optional[str]:
+    """Last attribute/name of the called object ('sleep' for time.sleep(..))."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def iter_function_scopes(tree: ast.AST):
+    """Yield (func_node_or_None, body_statements) for the module and every
+    function, with nested function bodies EXCLUDED from the enclosing
+    scope's statement walk (a nested sync ``def`` inside an ``async def``
+    runs on its own rules)."""
+    yield None, getattr(tree, "body", [])
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+
+
+def walk_scope(stmts: Iterable[ast.stmt]):
+    """ast.walk over statements without descending into nested function or
+    lambda bodies."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # nested scope: yielded as a leaf, body not entered
+        stack.extend(ast.iter_child_nodes(node))

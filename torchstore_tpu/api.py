@@ -54,7 +54,10 @@ class _StoreHandle:
     repair_meshes: list = None  # replacement volumes spawned by repair()
 
 
-_stores: dict[str, _StoreHandle] = {}
+# Per-process store registry: forked actor children never reuse the parent's
+# handles — they rebuild from the TORCHSTORE_TPU_STORE_* env their spawner
+# passes explicitly (see spawn_actors' env forwarding).
+_stores: dict[str, _StoreHandle] = {}  # tslint: disable=fork-safety
 
 
 def _publish_handle(store_name: str, controller: ActorRef) -> None:

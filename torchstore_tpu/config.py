@@ -14,6 +14,138 @@ import os
 from dataclasses import dataclass, field, replace
 
 
+@dataclass(frozen=True)
+class EnvVar:
+    """One operator knob: the single source of truth the env-registry lint
+    (torchstore_tpu/analysis/checkers/env_registry.py) and the generated
+    docs/API.md table are derived from. ``default=None`` means unset /
+    computed dynamically (the doc string says how)."""
+
+    name: str
+    type: str  # "bool" | "int" | "float" | "str" | "path"
+    default: object
+    doc: str
+
+
+# Every TORCHSTORE_TPU_* variable the tree reads. Adding a read site without
+# an entry here fails `python scripts/tslint.py` (env-registry rule); after
+# editing, regenerate the docs table with `scripts/tslint.py --regen-env-docs`.
+ENV_REGISTRY: tuple[EnvVar, ...] = (
+    # --- transports ---------------------------------------------------------
+    EnvVar("TORCHSTORE_TPU_SHM_ENABLED", "bool", True,
+           "Enable the shared-memory transport rung (same-host transfers "
+           "through /dev/shm segments)."),
+    EnvVar("TORCHSTORE_TPU_BULK_TCP_ENABLED", "bool", True,
+           "Enable the bulk TCP transport rung (cross-host striped "
+           "transfers over DCN)."),
+    EnvVar("TORCHSTORE_TPU_ICI_ENABLED", "bool", True,
+           "Enable the device (ICI) transfer rung for on-device arrays."),
+    EnvVar("TORCHSTORE_TPU_ZERO_COPY_GET", "bool", True,
+           "Same-host gets without an in-place destination return read-only "
+           "snapshot views of SHM segments instead of copies."),
+    EnvVar("TORCHSTORE_TPU_SHM_POOL_MAX_BYTES", "int", None,
+           "Cap on the volume-side recycled SHM segment pool, bytes. "
+           "Default: a quarter of /dev/shm's available space at startup, "
+           "clamped to [4 GB, 64 GB]."),
+    EnvVar("TORCHSTORE_TPU_USE_NATIVE", "bool", True,
+           "Use the native C++ data-path library (libtsnative) when built."),
+    # --- cold-start provisioning (prewarm) ----------------------------------
+    EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
+           "put_state_dict derives a manifest and provisions pools/dials "
+           "before the first data-plane puts of a large working set."),
+    EnvVar("TORCHSTORE_TPU_PREWARM_AUTO_MIN_BYTES", "int", 33554432,
+           "Working sets below this many bytes skip the automatic prewarm "
+           "hint."),
+    EnvVar("TORCHSTORE_TPU_PREWARM_HUGEPAGES", "bool", True,
+           "madvise(MADV_HUGEPAGE) on provisioned segments while untouched "
+           "(fail-open to plain pages)."),
+    EnvVar("TORCHSTORE_TPU_PREWARM_THREADS", "int", 0,
+           "Threads for the native prefault of provisioned segments "
+           "(0 = auto, one per 16 MiB)."),
+    # --- security -----------------------------------------------------------
+    EnvVar("TORCHSTORE_TPU_AUTH_SECRET", "str", "",
+           "Shared secret for HMAC challenge-response connection auth on "
+           "every listener; empty disables auth (loopback-only deployments)."),
+    # --- timeouts (seconds) -------------------------------------------------
+    EnvVar("TORCHSTORE_TPU_RPC_TIMEOUT", "float", 120,
+           "Default control-plane RPC deadline in seconds (<= 0 disables); "
+           "data-plane RPCs scale it with payload size."),
+    EnvVar("TORCHSTORE_TPU_HANDSHAKE_TIMEOUT", "float", 60,
+           "Transport handshake deadline, seconds."),
+    EnvVar("TORCHSTORE_TPU_DIRECT_SETTLE_TIMEOUT", "float", 30,
+           "How long a direct weight-sync pull waits for the source seqlock "
+           "generation to settle (even), seconds."),
+    # --- logging / observability --------------------------------------------
+    EnvVar("TORCHSTORE_TPU_LOG_LEVEL", "str", "WARNING",
+           "Root level for torchstore loggers."),
+    EnvVar("TORCHSTORE_TPU_TRACE", "path", None,
+           "Write Chrome-trace span events to this file (pid-suffixed per "
+           "process); merge with ts.collect_trace() / scripts/merge_traces.py."),
+    EnvVar("TORCHSTORE_TPU_TRACE_RUN", "str", None,
+           "Internal: per-run id the spawner stamps so reused trace OUTDIRs "
+           "can arbitrate file ownership. Set automatically; do not set by "
+           "hand."),
+    EnvVar("TORCHSTORE_TPU_METRICS_DUMP", "path", None,
+           "Every process periodically rewrites this file with its metrics "
+           "registry (.json, or .prom for Prometheus text)."),
+    EnvVar("TORCHSTORE_TPU_METRICS_INTERVAL_S", "float", 60,
+           "Metrics dump period, seconds."),
+    EnvVar("TORCHSTORE_TPU_METRICS_PORT", "int", None,
+           "Serve live /metrics + /metrics.json + /healthz on this port "
+           "from every process (ephemeral-port fallback on sibling "
+           "conflicts, published via the ts_metrics_http_port gauge)."),
+    EnvVar("TORCHSTORE_TPU_METRICS_HOST", "str", "127.0.0.1",
+           "Bind address for the metrics HTTP exporter."),
+    EnvVar("TORCHSTORE_TPU_SLOW_OP_MS", "float", None,
+           "Client ops / volume puts+gets slower than this many "
+           "milliseconds log a warning with the trace id and count "
+           "ts_slow_ops_total."),
+    # --- runtime / fleet ----------------------------------------------------
+    EnvVar("TORCHSTORE_TPU_BIND_HOST", "str", "127.0.0.1",
+           "Bind address for actor, bulk, and device-transfer listeners "
+           "(set 0.0.0.0 for multi-host DCN)."),
+    EnvVar("TORCHSTORE_TPU_ADVERTISE_HOST", "str", None,
+           "Reachable address advertised in actor refs and bulk endpoints "
+           "when binding 0.0.0.0/:: (default: the real hostname)."),
+    EnvVar("TORCHSTORE_TPU_MP_CONTEXT", "str", "forkserver",
+           "Multiprocessing start method for actor children (forkserver "
+           "amortizes interpreter startup; spawn remains available)."),
+    EnvVar("TORCHSTORE_TPU_HOSTNAME", "str", None,
+           "Override the hostname strategies use for same-host transport "
+           "selection (tests / containers with unstable hostnames)."),
+    EnvVar("TORCHSTORE_TPU_VOLUME_ID", "str", None,
+           "Force a spawned storage volume's id (volume replacement and "
+           "repair flows)."),
+    EnvVar("TORCHSTORE_TPU_STORAGE_DIR", "path", None,
+           "Durable backend directory for storage volumes (unset = "
+           "in-memory only)."),
+    EnvVar("TORCHSTORE_TPU_RECLAIM_DELAYS", "str", None,
+           "Comma-separated backoff delays, seconds, for the controller's "
+           "stale-replica reclaim drainer (default 1,5,15,60; malformed "
+           "values fall back)."),
+    # --- bench --------------------------------------------------------------
+    EnvVar("TORCHSTORE_TPU_BENCH_COLD_MB", "int", None,
+           "bench.py cold-path working-set size in MB (default scales with "
+           "the bench tensor set)."),
+    EnvVar("TORCHSTORE_TPU_BENCH_DEVICE", "str", "1",
+           "Set 0/false to skip bench.py device phases."),
+    EnvVar("TORCHSTORE_TPU_BENCH_DEVICE_ALLOW_CPU", "bool", False,
+           "Allow bench.py device phases on CPU jax (interpret mode) "
+           "instead of refusing."),
+)
+
+# Dynamic families: names extending these prefixes are per-instance handles
+# (one per store), not individually registrable knobs.
+ENV_PREFIXES: tuple[str, ...] = ("TORCHSTORE_TPU_STORE_",)
+
+
+def env_registry_entry(name: str) -> EnvVar | None:
+    for entry in ENV_REGISTRY:
+        if entry.name == name:
+            return entry
+    return None
+
+
 def _env_bool(name: str, default: bool) -> bool:
     val = os.environ.get(name)
     if val is None:

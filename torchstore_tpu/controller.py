@@ -20,6 +20,7 @@ from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.runtime import Actor, ActorRef, endpoint
 from torchstore_tpu.storage_utils.trie import Trie
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+from torchstore_tpu.utils import spawn_logged
 
 logger = get_logger("torchstore_tpu.controller")
 
@@ -392,8 +393,6 @@ class Controller(Actor):
         """``keys``: {key: stale write generation} — the generation of the
         copy that was just detached (the newest bytes the reclaim is
         allowed to delete)."""
-        import asyncio
-
         pending = self._pending_reclaims.setdefault(volume_id, {})
         for key, gen in keys.items():
             # -1 = unknown generation (resolved volume-side at drain time);
@@ -403,9 +402,16 @@ class Controller(Actor):
         if volume_id in self._reclaim_running:
             return  # the volume's drainer picks the new keys up
         self._reclaim_running.add(volume_id)
-        task = asyncio.create_task(self._reclaim_detached(volume_id))
-        self._reclaim_tasks.add(task)
-        task.add_done_callback(self._reclaim_tasks.discard)
+        # A drainer that dies on an unexpected exception must be LOUD: the
+        # volume's running-flag was cleared in its finally, but the stale
+        # bytes stay resident until the next detach — spawn_logged retains
+        # the task and logs + counts the failure instead of dropping it.
+        spawn_logged(
+            self._reclaim_detached(volume_id),
+            name="controller.reclaim",
+            tasks=self._reclaim_tasks,
+            log=logger,
+        )
 
     async def _reclaim_detached(self, volume_id: str) -> None:
         """Drain the volume's pending stale keys once it recovers (ADVICE

@@ -327,7 +327,10 @@ def reset_metrics() -> None:
 # env-gated periodic dumper
 # --------------------------------------------------------------------------
 
-_dumper_lock = threading.Lock()
+# Fork story lives one level up: observability.reinit_after_fork() (called
+# from actor children's _child_main) resets the started-flag and re-arms the
+# dumper thread; the lock itself is never held across a spawn.
+_dumper_lock = threading.Lock()  # tslint: disable=fork-safety
 _dumper_started = False
 _dumper_thread: Optional[threading.Thread] = None
 _dump_path: Optional[str] = None

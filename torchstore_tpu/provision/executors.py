@@ -324,7 +324,9 @@ def _run_registration_leg(client, plan, arrays, report) -> None:
 
 # Per-client size-signatures already prewarmed this process lifetime: the
 # hint fires once per distinct working-set shape, not once per publish.
-_auto_seen: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# Weak client keys cannot survive a fork (children build fresh clients), so
+# inherited entries are unreachable garbage at worst, never stale hits.
+_auto_seen: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()  # tslint: disable=fork-safety
 
 
 async def maybe_auto_prewarm(client, flat: dict) -> Optional[dict]:

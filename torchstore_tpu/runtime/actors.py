@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import context as trace_context
+from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime.serialization import (
     KIND_CONTROL,
@@ -178,7 +179,10 @@ def _rebuild_remote_error(msg: dict) -> Exception:
 # entirely — direct async method invocation, zero serialization (the
 # colocated-volume fast path; remote processes still reach the same actor
 # over its real server).
-_inproc_actors: dict[tuple[str, int, str], Actor] = {}
+# Safe across forkserver: only the process that HOSTS an actor registers it
+# here, and children never inherit a hosting role (each child registers its
+# own actor in _child_async).
+_inproc_actors: dict[tuple[str, int, str], Actor] = {}  # tslint: disable=fork-safety
 
 
 def register_inproc(host: str, port: int, name: str, actor: Actor) -> None:
@@ -190,8 +194,10 @@ def unregister_inproc(host: str, port: int, name: str) -> None:
 
 
 # Pools are per (event loop, address): tests run many asyncio.run loops;
-# entries of closed loops are pruned so they never accumulate.
-_conn_pools: dict[
+# entries of closed loops are pruned so they never accumulate. Children
+# fork from the forkserver HELPER, which imports this module but never
+# opens a connection — the inherited pool is always empty.
+_conn_pools: dict[  # tslint: disable=fork-safety
     tuple[int, str, int], tuple[asyncio.AbstractEventLoop, _Connection]
 ] = {}
 
@@ -446,11 +452,15 @@ class ActorServer:
         try:
             while True:
                 kind, msg = await read_message(reader)
-                task = asyncio.ensure_future(
-                    self._dispatch(kind, msg, writer, write_lock)
+                # _dispatch reports endpoint errors to the caller itself;
+                # spawn_logged is the belt-and-braces for a failure in that
+                # reporting path (and retains the task until done).
+                spawn_logged(
+                    self._dispatch(kind, msg, writer, write_lock),
+                    name="actor.dispatch",
+                    tasks=tasks,
+                    log=logger,
                 )
-                tasks.add(task)
-                task.add_done_callback(tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -755,7 +765,9 @@ def _pipe_recv(pipe, proc: mp.Process, timeout: float):
 # Singleton actors (get_or_spawn_controller analog)
 # --------------------------------------------------------------------------
 
-_singletons: dict[str, ActorMesh] = {}
+# Owner-side registry only: actor children never spawn singletons (the
+# spawner owns process handles; children hold plain ActorRefs from env).
+_singletons: dict[str, ActorMesh] = {}  # tslint: disable=fork-safety
 
 
 async def get_or_spawn_singleton(name: str, actor_cls: type, *args, **kwargs) -> ActorRef:

@@ -18,6 +18,7 @@ import pickle
 from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.runtime.serialization import (
     KIND_REQUEST,
     KIND_RESPONSE,
@@ -59,11 +60,14 @@ class RendezvousServer:
         try:
             while True:
                 _, msg = await read_message(reader)
-                task = asyncio.ensure_future(
-                    self._dispatch(msg, writer, write_lock)
+                # _dispatch replies with repr(exc) on op failures itself;
+                # spawn_logged retains the task and surfaces failures in
+                # that reply path instead of dropping them.
+                spawn_logged(
+                    self._dispatch(msg, writer, write_lock),
+                    name="rendezvous.dispatch",
+                    tasks=tasks,
                 )
-                tasks.add(task)
-                task.add_done_callback(tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:

@@ -154,7 +154,9 @@ class _SPMDSession:
             os.environ.pop(api.ENV_STORE_PREFIX + self.store_name, None)
 
 
-_spmd_sessions: dict[str, _SPMDSession] = {}
+# Per-rank session registry; actor children are never SPMD ranks, and ranks
+# themselves are started by torchrun, not forked from each other.
+_spmd_sessions: dict[str, _SPMDSession] = {}  # tslint: disable=fork-safety
 
 
 async def initialize(

@@ -360,7 +360,9 @@ class _DirectSyncCache:
 
 # Weakly keyed by the client object: a GC'd client cannot hand its cache to
 # an unrelated new client via id() reuse.
-_direct_caches: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# Weak client keys cannot survive a fork (children build fresh clients), so
+# inherited entries are unreachable garbage at worst, never stale hits.
+_direct_caches: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()  # tslint: disable=fork-safety
 
 
 def _direct_cache(client) -> _DirectSyncCache:

@@ -48,6 +48,7 @@ from torchstore_tpu.transport.buffers import (
     TransportContext,
 )
 from torchstore_tpu.transport.cache import ArrayRegistrationCache
+from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.transport.types import Request, TensorMeta
 
 logger = get_logger("torchstore_tpu.transport.bulk")
@@ -258,7 +259,7 @@ class BulkServer:
             try:
                 conn, _ = await loop.sock_accept(self._listen_sock)
             except asyncio.CancelledError:
-                return
+                raise  # cancellation must mark the accept task cancelled
             except OSError as exc:
                 # Transient accept failures (EMFILE/ECONNABORTED/...): log,
                 # back off, keep accepting — the old asyncio.Server did the
@@ -270,9 +271,12 @@ class BulkServer:
                 continue
             conn.setblocking(False)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            task = asyncio.ensure_future(self._handle_conn(conn))
-            self._conn_tasks.add(task)
-            task.add_done_callback(self._conn_tasks.discard)
+            spawn_logged(
+                self._handle_conn(conn),
+                name="bulk.conn",
+                tasks=self._conn_tasks,
+                log=logger,
+            )
 
     async def _handle_conn(self, sock: socket.socket) -> None:
         from torchstore_tpu.runtime.auth import server_authenticate_sock
@@ -363,12 +367,15 @@ class BulkServer:
             # in-flight sends, then close. The reader's own recv just
             # returned, so after the sends are joined no loop.sock_* op can
             # reference the fd.
-            for task in list(self._send_tasks.pop(sock, ())):
+            send_tasks = list(self._send_tasks.pop(sock, ()))
+            for task in send_tasks:
                 task.cancel()
-                try:
-                    await task
-                except (asyncio.CancelledError, Exception):
-                    pass
+            if send_tasks:
+                # Join the cancelled sends without eating OUR OWN
+                # cancellation: per-task outcomes land in the result list
+                # (return_exceptions), while cancelling this reader during
+                # the join cancels the gather future itself and propagates.
+                await asyncio.gather(*send_tasks, return_exceptions=True)
             _close_sock(sock)
 
     def _purge_stale(self) -> None:
@@ -760,6 +767,10 @@ class BulkTransportBuffer(TransportBuffer):
     requires_contiguous_inplace = False
     supports_batch_puts = True
     supports_batch_gets = True
+    # Process-wide retention for in-flight abort/close tasks: drop() returns
+    # synchronously and the buffer instance may be GC'd immediately after,
+    # so the cleanup task must be anchored somewhere that outlives it.
+    _cleanup_tasks: set = set()
 
     def __init__(
         self, config: Optional[StoreConfig] = None, inproc_copy: bool = False
@@ -1101,7 +1112,12 @@ class BulkTransportBuffer(TransportBuffer):
                     conn.close_now()
 
             try:
-                asyncio.ensure_future(_cleanup())
+                spawn_logged(
+                    _cleanup(),
+                    name="bulk.cleanup",
+                    tasks=BulkTransportBuffer._cleanup_tasks,
+                    log=logger,
+                )
             except RuntimeError:  # no running loop (interpreter teardown)
                 if not promoted:
                     _close_sock(conn.sock)

@@ -48,6 +48,7 @@ from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -393,6 +394,9 @@ class ShmServerCache(TransportCache):
         # these too, or an interrupted warm-up leaks the file for the
         # process lifetime (colocated volumes never exit to be reaped)
         self._warm_inflight: set[ShmSegment] = set()
+        # strong refs to in-flight warm-up tasks (asyncio holds tasks
+        # weakly; an unretained warmer can be GC'd mid-prefault)
+        self._warm_tasks: set = set()
         self._closed = False
         # last time a client RPC touched this cache (warm-up tasks only
         # burn CPU in idle windows, never against live traffic)
@@ -530,7 +534,12 @@ class ShmServerCache(TransportCache):
                     break
                 budget -= size
                 self._warming[size] = self._warming.get(size, 0) + 1
-                loop.create_task(self._warm_one(size))
+                spawn_logged(
+                    self._warm_one(size),
+                    name="shm.pool_warm",
+                    tasks=self._warm_tasks,
+                    log=logger,
+                )
 
     async def _warm_one(self, size: int) -> None:
         import asyncio
@@ -849,11 +858,15 @@ class ShmClientCache(TransportCache):
                 self._pre_attached[name] = time.monotonic()
 
         for name, size in spares:
-            # The loop holds tasks weakly — keep a strong ref until done or
-            # a pending pre-attach can be garbage-collected mid-flight.
-            task = loop.create_task(one(name, size))
-            self._pre_attach_tasks.add(task)
-            task.add_done_callback(self._pre_attach_tasks.discard)
+            # spawn_logged keeps a strong ref until done (a pending
+            # pre-attach can otherwise be garbage-collected mid-flight) and
+            # surfaces unexpected failures instead of dropping them.
+            spawn_logged(
+                one(name, size),
+                name="shm.pre_attach",
+                tasks=self._pre_attach_tasks,
+                log=logger,
+            )
 
     def rekey(self, old_name: str, new_name: str) -> None:
         """The volume adopted + renamed a segment this client created: track

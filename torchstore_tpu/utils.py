@@ -282,3 +282,39 @@ def is_device_platform(platform) -> bool:
     """True when a jax ``device.platform`` string names real TPU hardware
     (direct or tunneled) rather than a CPU/interpret fallback."""
     return str(platform).lower() in DEVICE_PLATFORMS
+
+
+def spawn_logged(coro, *, name: str, tasks: Optional[set] = None, log=None):
+    """``asyncio.ensure_future`` with the retention + error contract every
+    fire-and-forget task in this codebase must honor (tslint rule
+    ``orphan-task``): the task is retained in ``tasks`` until done (asyncio
+    holds spawned tasks weakly — an unretained task can be garbage-collected
+    mid-flight), and a done-callback RETRIEVES the exception, logs it, and
+    increments ``ts_background_task_errors_total{task=name}`` instead of
+    letting the failure vanish. Cancellation is not an error."""
+    import asyncio
+
+    task = asyncio.ensure_future(coro)
+    if tasks is not None:
+        tasks.add(task)
+
+    def _done(t: "asyncio.Task") -> None:
+        if tasks is not None:
+            tasks.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            from torchstore_tpu.logging import get_logger
+            from torchstore_tpu.observability import metrics as obs_metrics
+
+            obs_metrics.counter(
+                "ts_background_task_errors_total",
+                "Unhandled exceptions from background (fire-and-forget) tasks",
+            ).inc(task=name)
+            (log or get_logger("torchstore_tpu.tasks")).error(
+                "background task %r failed: %r", name, exc, exc_info=exc
+            )
+
+    task.add_done_callback(_done)
+    return task
