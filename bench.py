@@ -373,6 +373,81 @@ async def cold_path_section(
     return out
 
 
+async def many_keys_section(
+    n_keys: int = 2048,
+    key_kb: float = 64,
+    iters: int = 5,
+) -> dict:
+    """Many-small-keys section (ISSUE 5): a realistic state dict is
+    thousands of parameters, not 32 big blocks — per-key overhead (request
+    building, handshake entries, volume indexing, notify metadata)
+    dominates long before bandwidth does. This section measures the
+    steady-state sync pipeline's answer: small-key arena packing (one
+    segment + one index pass per batch), overlapped landing copies, and
+    the iteration-stable transfer-plan cache.
+
+    Emits ``many_keys_gbps`` (delivered, warm median) and
+    ``per_key_put_us`` (warm-median put wall time / key)."""
+    import statistics
+
+    import torchstore_tpu as ts
+
+    await ts.initialize(
+        store_name="bench_keys",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        n_elem = max(1, int(key_kb * 1024 // 4))
+        sd = {
+            "params": {
+                str(i): np.random.rand(n_elem).astype(np.float32)
+                for i in range(n_keys)
+            }
+        }
+        total = sum(v.nbytes for v in sd["params"].values())
+        puts, gets, rates = [], [], []
+        for it in range(iters + 1):  # iter 0 is the cold start
+            stamp = float(it + 1)
+            for arr in sd["params"].values():
+                arr[0] = stamp
+            t0 = time.perf_counter()
+            await ts.put_state_dict("mk/sd", sd, store_name="bench_keys")
+            t1 = time.perf_counter()
+            out = await ts.get_state_dict("mk/sd", store_name="bench_keys")
+            t2 = time.perf_counter()
+            assert out["params"]["0"][0] == stamp, "many_keys stale data"
+            assert out["params"][str(n_keys - 1)][0] == stamp
+            if it > 0:
+                puts.append(t1 - t0)
+                gets.append(t2 - t1)
+                rates.append(2 * total / 1e9 / (t2 - t0))
+            print(
+                f"# many_keys iter {it}: put {(t1-t0)*1e3:.0f} ms "
+                f"({(t1-t0)/n_keys*1e6:.0f} us/key), "
+                f"get {(t2-t1)*1e3:.0f} ms",
+                file=sys.stderr,
+            )
+        put_s = statistics.median(puts)
+        out = {
+            "n_keys": n_keys,
+            "key_kb": key_kb,
+            "total_mb": round(total / 1e6, 1),
+            "many_keys_gbps": round(statistics.median(rates), 3),
+            "per_key_put_us": round(put_s / n_keys * 1e6, 2),
+            "put_s": round(put_s, 4),
+            "get_s": round(statistics.median(gets), 4),
+        }
+        print(
+            f"# many_keys ({n_keys} x {key_kb:.0f} KB): "
+            f"{out['many_keys_gbps']:.3f} GB/s delivered, "
+            f"{out['per_key_put_us']:.0f} us/key put",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        await ts.shutdown("bench_keys")
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -380,6 +455,8 @@ async def run(
     calib_mb: float = 256,
     lat_iters: int = 40,
     cold_steady_iters: int = 4,
+    many_keys_n: int = 2048,
+    many_keys_kb: float = 64,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -587,6 +664,9 @@ async def run(
         tensor_mb=cold_mb / n_tensors,
         steady_iters=cold_steady_iters,
     )
+    # Many-small-keys section (its own fleet: thousands of tiny entries
+    # must not pollute the headline fleet's pools or location caches).
+    many_keys = await many_keys_section(n_keys=many_keys_n, key_kb=many_keys_kb)
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -617,6 +697,11 @@ async def run(
         "cold_vs_steady": cold["cold_vs_steady"],
         "cold_prewarmed_vs_steady": cold["cold_prewarmed_vs_steady"],
         "cold": cold,
+        # ISSUE-5 headline stats at top level; the full section under
+        # "many_keys" (per-iteration medians, working-set shape).
+        "many_keys_gbps": many_keys["many_keys_gbps"],
+        "per_key_put_us": many_keys["per_key_put_us"],
+        "many_keys": many_keys,
         "metrics": metrics,
         "fleet": fleet,
     }

@@ -1,0 +1,70 @@
+"""Quick many-small-keys probe used to record the pre/post-PR per-key cost
+for the steady-state sync pipeline PR (ISSUE 5 acceptance: the many_keys
+bench section must be >= 2x faster than the pre-PR per-key path).
+
+Usage: JAX_PLATFORMS=cpu python scripts/bench_many_keys_probe.py [n_keys] [key_kb]
+Prints one JSON line: {"n_keys", "key_kb", "put_s", "get_s",
+"per_key_put_us", "gbps"} (medians over warm iterations).
+"""
+
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+async def main(n_keys: int, key_kb: int, iters: int = 3) -> dict:
+    import torchstore_tpu as ts
+
+    await ts.initialize(
+        store_name="probe",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        n_elem = max(1, key_kb * 1024 // 4)
+        sd = {
+            "params": {
+                str(i): np.random.rand(n_elem).astype(np.float32)
+                for i in range(n_keys)
+            }
+        }
+        total = sum(v.nbytes for v in sd["params"].values())
+        puts, gets = [], []
+        for it in range(iters + 1):  # iter 0 cold, rest warm
+            stamp = float(it + 1)
+            for arr in sd["params"].values():
+                arr[0] = stamp
+            t0 = time.perf_counter()
+            await ts.put_state_dict("probe/sd", sd, store_name="probe")
+            t1 = time.perf_counter()
+            out = await ts.get_state_dict("probe/sd", store_name="probe")
+            t2 = time.perf_counter()
+            assert out["params"]["0"][0] == stamp
+            if it > 0:
+                puts.append(t1 - t0)
+                gets.append(t2 - t1)
+            print(
+                f"# iter {it}: put {t1-t0:.3f}s get {t2-t1:.3f}s",
+                file=sys.stderr,
+            )
+        put_s = statistics.median(puts)
+        get_s = statistics.median(gets)
+        return {
+            "n_keys": n_keys,
+            "key_kb": key_kb,
+            "put_s": round(put_s, 4),
+            "get_s": round(get_s, 4),
+            "per_key_put_us": round(put_s / n_keys * 1e6, 2),
+            "gbps": round(2 * total / 1e9 / (put_s + get_s), 3),
+        }
+    finally:
+        await ts.shutdown("probe")
+
+
+if __name__ == "__main__":
+    n_keys = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    key_kb = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    print(json.dumps(asyncio.run(main(n_keys, key_kb))))

@@ -23,7 +23,13 @@ async def test_bench_run_tiny(capsys):
         sys.path.remove(REPO_ROOT)
 
     result = await bench.run(
-        n_tensors=2, tensor_mb=0.0625, iters=2, calib_mb=1, lat_iters=4
+        n_tensors=2,
+        tensor_mb=0.0625,
+        iters=2,
+        calib_mb=1,
+        lat_iters=4,
+        many_keys_n=16,
+        many_keys_kb=4,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -79,9 +85,35 @@ async def test_bench_run_tiny(capsys):
     assert cold["prewarm"]["ok"] is True
     assert cold["prewarm"]["errors"] == {}
 
+    # Many-keys section (ISSUE 5): headline stats at top level, the full
+    # section dict alongside. At KB scale the VALUES are noise — structure
+    # and positivity only; the >=2x-vs-pre-PR bar is the full-scale run's.
+    assert result["many_keys_gbps"] > 0
+    assert result["per_key_put_us"] > 0
+    assert result["many_keys"]["n_keys"] == 16
+    assert result["many_keys"]["put_s"] > 0
+
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
     json.dumps(result)
+
+
+@pytest.mark.anyio
+async def test_bench_many_keys_section_tiny():
+    """The many-keys section standalone at KB scale: the real arena/plan
+    path through a real fleet, so the section can never ship broken."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.many_keys_section(n_keys=24, key_kb=4, iters=2)
+    assert out["n_keys"] == 24
+    assert out["many_keys_gbps"] > 0
+    assert out["per_key_put_us"] > 0
+    assert out["put_s"] > 0 and out["get_s"] > 0
+    json.dumps(out)
 
 
 @pytest.mark.anyio
@@ -100,7 +132,9 @@ async def test_bench_cold_path_section_tiny():
         n_tensors=2, tensor_mb=0.25, steady_iters=2
     )
     assert cold["prewarm"]["ok"] is True
-    assert cold["prewarm"]["segments"] == 2  # both tensors provisioned
+    # 256 KB tensors sit at the arena threshold: both pack into ONE
+    # provisioned arena segment (steady-state pipeline).
+    assert cold["prewarm"]["segments"] == 1
     assert cold["prewarm"]["bytes"] == 2 * 256 * 1024
     assert cold["cold_gbps"] > 0 and cold["cold_prewarmed_gbps"] > 0
     assert cold["cold_vs_steady"] > 0

@@ -2,7 +2,7 @@
 
 Two layers:
 
-1. **Checker self-tests on fixture snippets** — each of the seven rules must
+1. **Checker self-tests on fixture snippets** — each of the eight rules must
    catch a seeded defect (a synthetic endpoint typo, a swallowed
    CancelledError, an unregistered env var, ...) and stay quiet on the
    matching clean snippet, so a refactor of the suite cannot silently turn
@@ -39,6 +39,7 @@ from torchstore_tpu.analysis.checkers import (  # noqa: E402
     endpoint_drift,
     env_registry,
     fork_safety,
+    landing_copy,
     metric_discipline,
     orphan_task,
 )
@@ -497,6 +498,56 @@ def test_baseline_splits_new_from_grandfathered(tmp_path):
         str(tmp_path), rules=["orphan-task"], baseline_path=str(baseline)
     )
     assert len(result.new) == 1 and len(result.baselined) == 1
+
+
+def test_landing_copy_rules(tmp_path):
+    """landing-copy: bare np.copyto in transport/landing modules is flagged;
+    native.py and out-of-scope modules are exempt; the native helpers pass."""
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/transport/somexport.py": """
+                import numpy as np
+                def land(dst, src):
+                    np.copyto(dst, src)  # seeded defect
+            """,
+            "torchstore_tpu/client.py": """
+                import numpy as np
+                from torchstore_tpu.native import copy_into
+                def land(dst, src):
+                    copy_into(dst, src)  # the sanctioned path
+            """,
+            "torchstore_tpu/native.py": """
+                import numpy as np
+                def fallback(dst, src):
+                    np.copyto(dst, src)  # the fallback IS allowed here
+            """,
+            "torchstore_tpu/torch_interop.py": """
+                import numpy as np
+                def convert(dst, src):
+                    np.copyto(dst, src)  # out of scope (not a landing module)
+            """,
+        },
+    )
+    findings = landing_copy.check(project)
+    assert len(findings) == 1
+    assert findings[0].path == "torchstore_tpu/transport/somexport.py"
+    assert "np.copyto" in findings[0].message
+
+
+def test_landing_copy_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/transport/x.py": """
+                import numpy as np
+                def land(dst, src):
+                    np.copyto(dst, src)  # tslint: disable=landing-copy
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["landing-copy"])
+    assert result.new == []
 
 
 def test_unknown_rule_rejected(tmp_path):

@@ -1,0 +1,433 @@
+"""Steady-state sync pipeline tests (ISSUE 5): small-key arena packing edge
+cases, overlapped landing-copy pool, the iteration-stable transfer-plan
+cache (hit metric + placement-epoch invalidation + loud failure on shape
+change), the bulk packed frame, and arena segment lifecycle (refcounts,
+lease release returning the arena to the pool)."""
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.transport import landing
+from torchstore_tpu.transport.shared_memory import (
+    ShmSegment,
+    ShmServerCache,
+)
+from torchstore_tpu.transport.types import TensorMeta
+
+
+# --------------------------------------------------------------------------
+# layout + landing pool units (no fleet)
+# --------------------------------------------------------------------------
+
+
+class TestArenaLayout:
+    def test_offsets_aligned_and_total(self):
+        offsets, total = landing.compute_arena_layout([100, 64, 0, 1])
+        assert offsets == [0, 128, 192, 192]  # 0-byte member holds no span
+        assert total == 256
+        assert all(off % landing.ARENA_ALIGN == 0 for off in offsets)
+
+    def test_empty_and_single(self):
+        assert landing.compute_arena_layout([]) == ([], 1)
+        offsets, total = landing.compute_arena_layout([10])
+        assert offsets == [0] and total == 64
+
+    def test_manifest_matches_transport_layout(self):
+        """The provisioning manifest and the transport must agree on the
+        arena segment size, or a prewarmed pool never serves the first
+        put's handshake."""
+        from torchstore_tpu.provision.manifest import StateDictManifest
+
+        sd = {str(i): np.zeros(1000, np.float32) for i in range(5)}
+        manifest = StateDictManifest.from_state_dict(sd)
+        sizes = manifest.segment_sizes(arena_max_bytes=256 << 10)
+        _, total = landing.compute_arena_layout([4000] * 5)
+        assert sizes == {total: 1}
+
+    def test_manifest_respects_threshold(self):
+        from torchstore_tpu.provision.manifest import StateDictManifest
+
+        sd = {"small": np.zeros(10, np.float32), "big": np.zeros(100000, np.float32)}
+        manifest = StateDictManifest.from_state_dict(sd)
+        sizes = manifest.segment_sizes(arena_max_bytes=1024)
+        # one lone small key: plain exact-size segment, no arena
+        assert sizes == {40: 1, 400000: 1}
+
+
+class TestLandingPool:
+    def test_task_planning_groups_small_pairs(self):
+        pairs = [
+            (np.zeros(16, np.uint8), np.ones(16, np.uint8)) for _ in range(100)
+        ]
+        tasks = landing._plan_tasks(pairs, threads=4, copy=landing.copy_into)
+        assert 1 <= len(tasks) <= 8  # grouped, not one future per pair
+        assert sum(len(group) for _, group in tasks) == 100
+
+    def test_task_planning_chunks_large_pairs(self, monkeypatch):
+        monkeypatch.setattr(landing, "CHUNK_BYTES", 1 << 10)
+        dst = np.zeros(5000, np.uint8)
+        src = np.arange(5000, dtype=np.uint8)
+        tasks = landing._plan_tasks([(dst, src)], threads=4, copy=landing.copy_into)
+        assert len(tasks) == 5  # 5000 B / 1 KB chunks
+
+    @pytest.mark.anyio
+    async def test_land_async_correctness(self, monkeypatch):
+        monkeypatch.setattr(landing, "CHUNK_BYTES", 1 << 12)
+        big_src = np.random.randint(0, 255, size=50_000).astype(np.uint8)
+        big_dst = np.zeros_like(big_src)
+        smalls = [
+            (np.zeros(100, np.float32), np.random.rand(100).astype(np.float32))
+            for _ in range(32)
+        ]
+        await landing.land_async([(big_dst, big_src), *smalls], stage="get")
+        np.testing.assert_array_equal(big_dst, big_src)
+        for dst, src in smalls:
+            np.testing.assert_array_equal(dst, src)
+
+    @pytest.mark.anyio
+    async def test_land_async_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="shape mismatch"):
+            await landing.land_async(
+                [(np.zeros(4), np.zeros(5))], stage="put"
+            )
+
+    def test_land_sync_correctness(self):
+        pairs = [
+            (np.zeros(64, np.int32), np.arange(64, dtype=np.int32))
+            for _ in range(8)
+        ]
+        landing.land_sync(pairs, stage="inline")
+        for dst, src in pairs:
+            np.testing.assert_array_equal(dst, src)
+
+
+# --------------------------------------------------------------------------
+# arena segment lifecycle (server cache, no fleet)
+# --------------------------------------------------------------------------
+
+
+class TestArenaRefcounts:
+    def _meta(self, n=4):
+        return TensorMeta(shape=(n,), dtype="uint8")
+
+    def test_shared_segment_survives_partial_replacement(self):
+        cache = ShmServerCache()
+        arena = ShmSegment.create(64)
+        cache.put("k1", None, arena, self._meta())
+        cache.put("k2", None, arena, self._meta())
+        assert cache.seg_refs[arena.name] == 2
+        solo = ShmSegment.create(64)
+        cache.put("k1", None, solo, self._meta())
+        # one member replaced: arena still backs k2, nothing pooled yet
+        assert cache.seg_refs[arena.name] == 1
+        assert cache.free_bytes == 0
+        arena2 = ShmSegment.create(64)
+        cache.put("k2", None, arena2, self._meta())
+        # last member replaced: arena (unleased) returns to the free pool
+        assert arena.name not in cache.seg_refs
+        assert cache.free_bytes == 64
+        cache.clear()
+
+    def test_leased_arena_retires_then_pools_on_release(self):
+        cache = ShmServerCache()
+        arena = ShmSegment.create(64)
+        cache.put("k1", None, arena, self._meta())
+        cache.put("k2", None, arena, self._meta())
+        cache.grant(arena.name)  # a zero-copy reader holds a lease
+        repl = ShmSegment.create(64)
+        cache.put("k1", None, repl, self._meta())
+        cache.put("k2", None, ShmSegment.create(64), self._meta())
+        assert arena.name in cache.retired  # leased: retired, not pooled
+        cache.apply_releases(
+            {"client": "c1", "batches": [(1, {arena.name: 1})]}
+        )
+        assert arena.name not in cache.retired
+        assert cache.free_bytes == 64  # lease released -> back to the pool
+        cache.clear()
+
+    def test_delete_key_respects_shared_refs(self):
+        cache = ShmServerCache()
+        arena = ShmSegment.create(64)
+        cache.put("k1", None, arena, self._meta())
+        cache.put("k2", None, arena, self._meta())
+        cache.delete_key("k1")
+        assert cache.seg_refs[arena.name] == 1  # k2 still backed
+        cache.delete_key("k2")
+        assert arena.name not in cache.seg_refs  # last ref: unlinked
+        cache.clear()
+
+
+# --------------------------------------------------------------------------
+# arena round trips through a real fleet
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_arena_roundtrip_edge_cases():
+    """One fleet, every packing edge case: mixed dtypes, 0-byte tensors,
+    keys below/at/above the threshold boundary, subset zero-copy pulls,
+    and the arena returning to the pool after lease release."""
+    limit = 256 << 10  # default TORCHSTORE_TPU_ARENA_MAX_BYTES
+    await ts.initialize(
+        store_name="arena",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        packed_before = landing.ARENA_KEYS.total()
+        sd = {
+            "f32": np.random.rand(24 * 1024).astype(np.float32),  # 96 KB
+            "i8": np.random.randint(-100, 100, 70000).astype(np.int8),
+            "f64": np.random.rand(4096),  # 32 KB
+            "zero": np.zeros((0, 3), np.float32),  # 0-byte member
+            "at_boundary": np.random.rand(limit // 8),  # == limit bytes
+            "above": np.random.rand((limit // 8) + 1),  # limit+8: NOT packed
+        }
+        await ts.put_state_dict("e/sd", sd, store_name="arena")
+        packed_delta = landing.ARENA_KEYS.total() - packed_before
+        # f32, i8, f64, zero, at_boundary pack; 'above' gets its own segment
+        assert packed_delta == 5, packed_delta
+        out = await ts.get_state_dict("e/sd", store_name="arena")
+        for key, arr in sd.items():
+            np.testing.assert_array_equal(out[key], arr), key
+            assert out[key].dtype == arr.dtype
+        # Subset pull: single-key gets serve zero-copy subviews of the arena.
+        one = await ts.get("e/sd/f64", store_name="arena")
+        np.testing.assert_array_equal(one, sd["f64"])
+        assert not one.flags.writeable  # snapshot view, not a copy
+        # Overwrite loop: the previous iteration's arena rotates through
+        # retirement (views held) back into the warm pool once released.
+        del out, one
+        gc.collect()
+        for it in range(3):
+            for arr in sd.values():
+                if arr.size:
+                    arr.flat[0] = it + 1
+            await ts.put_state_dict("e/sd", sd, store_name="arena")
+            out = await ts.get_state_dict("e/sd", store_name="arena")
+            np.testing.assert_array_equal(out["f32"], sd["f32"])
+            del out
+            gc.collect()
+        stats = await ts.client("arena").controller.stats.call_one(
+            include_volumes=True
+        )
+        (vstats,) = stats["volumes"].values()
+        # The rotation recycles arenas instead of leaking them: pooled or
+        # retired-awaiting-release, and at most double-buffered live.
+        shm = vstats["shm"]
+        assert shm["arena_segments"] >= 1
+        assert shm["pool_segments"] + shm["retired_segments"] >= 1
+    finally:
+        await ts.shutdown("arena")
+
+
+@pytest.mark.anyio
+async def test_single_small_key_and_empty_dict():
+    """Degenerate batches: a lone small key (no arena to amortize) and an
+    empty state dict (marker-only push) round-trip unchanged."""
+    await ts.initialize(
+        store_name="arena1",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        lone = {"only": np.random.rand(40 * 1024 // 8)}  # 40 KB, inline path
+        await ts.put_state_dict("lone/sd", lone, store_name="arena1")
+        out = await ts.get_state_dict("lone/sd", store_name="arena1")
+        np.testing.assert_array_equal(out["only"], lone["only"])
+
+        big_lone = {"only": np.random.rand(1 << 17)}  # 1 MB, handshake path
+        await ts.put_state_dict("bl/sd", big_lone, store_name="arena1")
+        out = await ts.get_state_dict("bl/sd", store_name="arena1")
+        np.testing.assert_array_equal(out["only"], big_lone["only"])
+
+        await ts.put_state_dict("empty/sd", {}, store_name="arena1")
+        out = await ts.get_state_dict("empty/sd", store_name="arena1")
+        assert out == {}
+    finally:
+        await ts.shutdown("arena1")
+
+
+# --------------------------------------------------------------------------
+# bulk packed frame
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_bulk_packed_frame_roundtrip():
+    await ts.initialize(
+        store_name="bulkpack",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+    try:
+        packed_before = landing.ARENA_KEYS.value(transport="bulk")
+        sd = {
+            "params": {
+                str(i): np.random.rand(2048).astype(np.float32)  # 8 KB each
+                for i in range(40)
+            },
+            "big": np.random.rand(1 << 17),  # 1 MB: its own frame
+        }
+        await ts.put_state_dict("bp/sd", sd, store_name="bulkpack")
+        assert landing.ARENA_KEYS.value(transport="bulk") - packed_before >= 40
+        out = await ts.get_state_dict("bp/sd", store_name="bulkpack")
+        for i in range(40):
+            np.testing.assert_array_equal(
+                out["params"][str(i)], sd["params"][str(i)]
+            )
+        np.testing.assert_array_equal(out["big"], sd["big"])
+        # Overwrite via the packed path lands in place (invariant 6).
+        sd["params"]["0"][0] = 42.0
+        await ts.put_state_dict("bp/sd", sd, store_name="bulkpack")
+        out = await ts.get_state_dict("bp/sd", store_name="bulkpack")
+        assert out["params"]["0"][0] == 42.0
+    finally:
+        await ts.shutdown("bulkpack")
+
+
+# --------------------------------------------------------------------------
+# plan cache
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_plan_cache_hits_and_epoch_invalidation():
+    """Acceptance: the second iteration of a repeated signature hits the
+    plan cache (counter moves) and skips re-locate (controller locate
+    counter still); a placement-epoch bump (delete) invalidates it."""
+    from torchstore_tpu.client import _PLAN_HITS, _PLAN_INVALIDATIONS
+
+    await ts.initialize(
+        store_name="plans",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        sd = {str(i): np.random.rand(8192).astype(np.float32) for i in range(8)}
+        user = {str(i): np.zeros(8192, np.float32) for i in range(8)}
+
+        async def locates() -> int:
+            stats = await ts.client("plans").controller.stats.call_one()
+            return stats["locates"]
+
+        hits0 = _PLAN_HITS.total()
+        # Iteration 1: builds + stores plans.
+        await ts.put_state_dict("p/sd", sd, store_name="plans")
+        out = await ts.get_state_dict(
+            "p/sd", user_state_dict=user, store_name="plans"
+        )
+        np.testing.assert_array_equal(out["0"], sd["0"])
+        locates_warm = await locates()
+        # Iteration 2: same signature — put AND get plans hit.
+        sd["0"][0] = 7.0
+        await ts.put_state_dict("p/sd", sd, store_name="plans")
+        out = await ts.get_state_dict(
+            "p/sd", user_state_dict=user, store_name="plans"
+        )
+        assert out["0"][0] == 7.0
+        assert _PLAN_HITS.total() - hits0 >= 2
+        assert _PLAN_HITS.value(op="put") >= 1
+        assert _PLAN_HITS.value(op="get") >= 1
+        # skipped re-locate: the cached-plan get issued no locate RPC
+        assert await locates() == locates_warm
+
+        # Epoch bump: a structural change (delete) invalidates every plan.
+        inv0 = _PLAN_INVALIDATIONS.total()
+        await ts.put("unrelated", np.ones(4), store_name="plans")
+        await ts.delete("unrelated", store_name="plans")
+        sd["0"][0] = 9.0
+        await ts.put_state_dict("p/sd", sd, store_name="plans")
+        out = await ts.get_state_dict(
+            "p/sd", user_state_dict=user, store_name="plans"
+        )
+        assert out["0"][0] == 9.0
+        assert _PLAN_INVALIDATIONS.total() > inv0
+    finally:
+        await ts.shutdown("plans")
+
+
+@pytest.mark.anyio
+async def test_plan_cache_shape_change_fails_loudly():
+    """Re-publishing a key under a new shape must never land wrong bytes
+    through a stale plan: the publisher's signature change bumps the
+    placement epoch, and an old-shape in-place target fails loudly (the
+    fast_copy no-broadcast rule) instead of filling with garbage."""
+    await ts.initialize(
+        store_name="shapes",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        sd = {"w": np.random.rand(4096).astype(np.float32)}
+        user = {"w": np.zeros(4096, np.float32)}
+        await ts.put_state_dict("s/sd", sd, store_name="shapes")
+        await ts.get_state_dict("s/sd", user_state_dict=user, store_name="shapes")
+        # warm the plans
+        await ts.put_state_dict("s/sd", sd, store_name="shapes")
+        await ts.get_state_dict("s/sd", user_state_dict=user, store_name="shapes")
+        # republish under a DIFFERENT shape
+        sd2 = {"w": np.random.rand(128).astype(np.float32)}
+        await ts.put_state_dict("s/sd", sd2, store_name="shapes")
+        with pytest.raises((ValueError, KeyError)):
+            await ts.get_state_dict(
+                "s/sd", user_state_dict=user, store_name="shapes"
+            )
+        # the right-shaped target works
+        out = await ts.get_state_dict(
+            "s/sd",
+            user_state_dict={"w": np.zeros(128, np.float32)},
+            store_name="shapes",
+        )
+        np.testing.assert_array_equal(out["w"], sd2["w"])
+    finally:
+        await ts.shutdown("shapes")
+
+
+@pytest.mark.anyio
+async def test_plan_cache_key_drop_republish_invalidates():
+    """A republish that only DROPS keys deletes nothing, so the index alone
+    cannot see the restructure — the publisher-side signature bump must
+    invalidate consumer get plans even across a publisher restart (no
+    memory of the previous push)."""
+    await ts.initialize(
+        store_name="drops",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        sd = {
+            "head": np.random.rand(1024).astype(np.float32),
+            "body": np.random.rand(1024).astype(np.float32),
+        }
+        await ts.put_state_dict("d/sd", sd, store_name="drops")
+        out = await ts.get_state_dict("d/sd", store_name="drops")
+        out2 = await ts.get_state_dict("d/sd", store_name="drops")  # plan hit
+        assert set(out2) == {"head", "body"}
+        del out, out2
+        # Simulate a publisher restart: no memory of the previous signature.
+        ts.client("drops").plan_cache.last_put_sig.clear()
+        await ts.put_state_dict(
+            "d/sd", {"body": sd["body"]}, store_name="drops"
+        )
+        out = await ts.get_state_dict("d/sd", store_name="drops")
+        # The cached two-key plan must NOT serve: the new push has one key.
+        assert set(out) == {"body"}
+    finally:
+        await ts.shutdown("drops")
+
+
+@pytest.mark.anyio
+async def test_plan_cache_disabled_by_config():
+    await ts.initialize(
+        store_name="noplan",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        config=ts.StoreConfig(plan_cache=False),
+    )
+    try:
+        assert ts.client("noplan").plan_cache is None
+        sd = {"w": np.random.rand(1024).astype(np.float32)}
+        for _ in range(2):
+            await ts.put_state_dict("n/sd", sd, store_name="noplan")
+            out = await ts.get_state_dict("n/sd", store_name="noplan")
+            np.testing.assert_array_equal(out["w"], sd["w"])
+    finally:
+        await ts.shutdown("noplan")

@@ -14,6 +14,7 @@ from torchstore_tpu.analysis.checkers import (
     endpoint_drift,
     env_registry,
     fork_safety,
+    landing_copy,
     metric_discipline,
     orphan_task,
 )
@@ -26,4 +27,5 @@ CHECKERS = {
     fork_safety.RULE: fork_safety.check,
     env_registry.RULE: env_registry.check,
     metric_discipline.RULE: metric_discipline.check,
+    landing_copy.RULE: landing_copy.check,
 }

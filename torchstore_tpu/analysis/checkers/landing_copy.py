@@ -1,0 +1,68 @@
+"""landing-copy: transport/landing modules never call bare ``np.copyto``.
+
+Every copy that lands fetched or staged bytes must go through the native
+helpers in ``torchstore_tpu/native.py`` (``copy_into`` / ``fast_copy``):
+
+- they take the multi-threaded native path (contiguous memcpy + strided
+  row-block) on large payloads — a bare ``np.copyto`` silently forfeits the
+  data plane's throughput on exactly the hot copies;
+- they REFUSE to broadcast (shapes must match exactly), so a stale-plan or
+  stale-metadata fetch fails loudly instead of smearing a wrong-shaped
+  payload across the destination (the ``fast_copy`` no-broadcast rule,
+  native.py).
+
+The rule covers the transport package and the landing-heavy client modules
+(client.py, direct_weight_sync.py, state_dict_utils.py). ``native.py``
+itself is exempt — it IS the fallback implementation. Non-landing modules
+(torch interop conversion, tests) are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name
+
+RULE = "landing-copy"
+
+# Modules whose copies land transport/staging bytes. native.py is the one
+# transport-adjacent file allowed to spell np.copyto (it is the fallback).
+_SCOPED_PREFIXES = ("torchstore_tpu/transport/",)
+_SCOPED_FILES = (
+    "torchstore_tpu/client.py",
+    "torchstore_tpu/direct_weight_sync.py",
+    "torchstore_tpu/state_dict_utils.py",
+)
+_EXEMPT = ("torchstore_tpu/native.py",)
+
+_MESSAGE = (
+    "bare np.copyto in a transport/landing module: use native.copy_into / "
+    "native.fast_copy (multi-threaded native path, no silent broadcast)"
+)
+
+
+def _in_scope(path: str) -> bool:
+    if path in _EXEMPT:
+        return False
+    return path.startswith(_SCOPED_PREFIXES) or path in _SCOPED_FILES
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not _in_scope(sf.path):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted in ("np.copyto", "numpy.copyto"):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+    return findings

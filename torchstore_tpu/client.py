@@ -65,6 +65,100 @@ _FETCH_RETRIES = obs_metrics.counter(
     "ts_client_fetch_retries_total",
     "Batch fetches retried after a stale-location/ref failure",
 )
+_PLAN_HITS = obs_metrics.counter(
+    "ts_plan_cache_hits_total",
+    "put/get_state_dict iterations served by a cached transfer plan, by op",
+)
+_PLAN_MISSES = obs_metrics.counter(
+    "ts_plan_cache_misses_total",
+    "put/get_state_dict iterations that (re)built their transfer plan, by op",
+)
+_PLAN_INVALIDATIONS = obs_metrics.counter(
+    "ts_plan_cache_invalidations_total",
+    "Cached transfer plans dropped, by reason (epoch/capacity)",
+)
+
+
+class SyncPlanCache:
+    """Iteration-stable transfer plans for ``put_state_dict`` /
+    ``get_state_dict`` (the steady-state sync pipeline's control-plane leg).
+
+    An RL weight-sync loop repeats the SAME size signature every iteration,
+    yet the naive path re-validates structure, re-fetches the commit
+    marker, and rebuilds request metadata each time. Plans are keyed by
+    (op, state-dict key, size signature) and validated against the
+    controller's placement epoch — which moves only on STRUCTURAL metadata
+    changes (new/changed/deleted keys, detaches, repairs), never on
+    same-shape overwrites — so iteration N+1 goes straight to the data
+    plane; any placement change drops every plan (and the caller clears
+    its location cache with them)."""
+
+    MAX_ENTRIES = 64
+
+    def __init__(self) -> None:
+        self.entries: dict[tuple, dict] = {}
+        # Last adopted controller placement epoch (None until first seen).
+        self.epoch: Optional[int] = None
+        # signature -> plan hint seeded by ts.prewarm (provision handoff):
+        # the first put of a prewarmed working set adopts the arena layout
+        # the provisioner already computed instead of re-deriving it.
+        self.seeds: dict[tuple, dict] = {}
+        # key -> signature of this client's last put_state_dict push: a
+        # CHANGED signature under the same key means the structure was
+        # republished — the index alone cannot always see that (dropping
+        # keys from a push deletes nothing), so the publisher bumps the
+        # placement epoch explicitly.
+        self.last_put_sig: dict[str, tuple] = {}
+
+    def observe_epoch(self, epoch: Optional[int]) -> bool:
+        """Adopt a controller placement epoch; returns True when the bump
+        invalidated cached plans (caller should clear location caches)."""
+        if epoch is None or epoch == self.epoch:
+            return False
+        moved = self.epoch is not None
+        self.epoch = epoch
+        if moved and self.entries:
+            _PLAN_INVALIDATIONS.inc(len(self.entries), reason="epoch")
+            self.entries.clear()
+        return moved
+
+    def lookup(self, op: str, key: str, signature: tuple) -> Optional[dict]:
+        entry = self.entries.get((op, key, signature))
+        if entry is not None and entry.get("epoch") == self.epoch:
+            _PLAN_HITS.inc(op=op)
+            return entry
+        _PLAN_MISSES.inc(op=op)
+        return None
+
+    def peek(self, op: str, key: str, signature: tuple) -> Optional[dict]:
+        """Like lookup but without counting a hit/miss — used to decide
+        whether an epoch-validation RPC is even worth issuing."""
+        return self.entries.get((op, key, signature))
+
+    def store(
+        self,
+        op: str,
+        key: str,
+        signature: tuple,
+        plan: dict,
+        epoch: Optional[int] = None,
+    ) -> None:
+        """``epoch`` pins the plan to the placement epoch it was BUILT
+        under (callers capture it before fetching the data the plan
+        describes) — stamping a later-observed epoch onto an earlier-built
+        plan would let a mid-build structural change validate forever."""
+        if len(self.entries) >= self.MAX_ENTRIES:
+            # Wholesale clear, like the location cache: cheap, and a warm
+            # working set re-fills in one iteration.
+            _PLAN_INVALIDATIONS.inc(len(self.entries), reason="capacity")
+            self.entries.clear()
+        plan["epoch"] = self.epoch if epoch is None else epoch
+        self.entries[(op, key, signature)] = plan
+
+    def seed(self, signature: tuple, hint: dict) -> None:
+        if len(self.seeds) >= self.MAX_ENTRIES:
+            self.seeds.clear()
+        self.seeds[signature] = hint
 
 
 @dataclass
@@ -104,6 +198,11 @@ class LocalClient:
         # Bumped whenever the volume map is dropped as stale (repair
         # replaced actors); _fetch retries once after any bump.
         self._refresh_epoch = 0
+        # Iteration-stable transfer-plan cache (state_dict sync hot path);
+        # None when disabled by config.
+        self.plan_cache: Optional[SyncPlanCache] = (
+            SyncPlanCache() if self._config.plan_cache else None
+        )
 
     @property
     def controller(self) -> ActorRef:
@@ -140,14 +239,40 @@ class LocalClient:
             for vid, info in vmap.items()
         }
 
+    def _observe_epoch(self, epoch: Optional[int]) -> None:
+        """Adopt a controller placement epoch from any RPC reply; a bump
+        drops cached plans AND cached locations together (both describe the
+        placement that just changed)."""
+        if self.plan_cache is not None and self.plan_cache.observe_epoch(epoch):
+            self._loc_cache.clear()
+
+    async def placement_epoch(self) -> int:
+        """Fetch + adopt the controller's current placement epoch (one
+        cheap RPC — what a cached-plan get pays instead of a commit-marker
+        fetch plus per-key locates)."""
+        epoch = await self._controller.placement_epoch.call_one()
+        self._observe_epoch(epoch)
+        return epoch
+
+    async def bump_placement_epoch(self) -> int:
+        """Force-invalidate cached transfer plans fleet-wide (publisher-side
+        escape hatch for restructures the index cannot see)."""
+        epoch = await self._controller.bump_placement_epoch.call_one()
+        self._observe_epoch(epoch)
+        return epoch
+
     async def _land_requests(
-        self, volume: StorageVolumeRef, requests: list[Request]
+        self,
+        volume: StorageVolumeRef,
+        requests: list[Request],
+        plan_hint: Optional[dict] = None,
     ) -> dict[str, int]:
         """Data-plane landing of ``requests`` on one volume (batched where
         the transport supports it) — shared by put_batch and replicate_to.
         Returns the volume-assigned per-key write generations, forwarded to
         the controller so stale-replica reclaims can delete conditionally."""
         buffer = create_transport_buffer(volume, self._config)
+        buffer.plan_hint = plan_hint
         if buffer.supports_batch_puts:
             await buffer.put_to_storage_volume(volume, requests)
             return buffer.write_gens or {}
@@ -202,7 +327,9 @@ class LocalClient:
     async def put(self, key: str, value: Any) -> None:
         await self.put_batch({key: value})
 
-    async def put_batch(self, items: dict[str, Any]) -> None:
+    async def put_batch(
+        self, items: dict[str, Any], plan_hint: Optional[dict] = None
+    ) -> None:
         t0 = time.perf_counter()
         try:
             # ensure_root: every logical op roots (or joins) a distributed
@@ -213,7 +340,7 @@ class LocalClient:
                 keys=len(items),
                 key=next(iter(items), None),
             ) as sp:
-                nbytes = await self._put_batch(items, sp)
+                nbytes = await self._put_batch(items, sp, plan_hint)
                 dur = time.perf_counter() - t0
                 obs_profile.record_op(
                     "put",
@@ -231,7 +358,9 @@ class LocalClient:
         _OP_BYTES.inc(nbytes, op="put")
         _OP_SECONDS.observe(dur, op="put")
 
-    async def _put_batch(self, items: dict[str, Any], sp) -> int:
+    async def _put_batch(
+        self, items: dict[str, Any], sp, plan_hint: Optional[dict] = None
+    ) -> int:
         await self._ensure_setup()
         tracker = LatencyTracker("put_batch")
         # Issue every device->host copy for the WHOLE batch up front so
@@ -253,7 +382,7 @@ class LocalClient:
 
         async def put_to(volume: StorageVolumeRef) -> dict[str, int]:
             try:
-                return await self._land_requests(volume, requests)
+                return await self._land_requests(volume, requests, plan_hint)
             except (ActorDiedError, ConnectionError, OSError) as exc:
                 # Bulk/peer transports surface volume death as
                 # ConnectionError — normalize so callers and the failover
@@ -297,12 +426,15 @@ class LocalClient:
         # landed (/root/reference/torchstore/client.py:86-90). ONE RPC
         # indexes every landed replica and detaches every failed one — no
         # window where new metadata coexists with a stale replica location.
-        await self._controller.notify_put_batch.call_one(
+        epoch = await self._controller.notify_put_batch.call_one(
             [r.meta_only() for r in requests],
             [v.volume_id for v, _ in landed],
             detach_volume_ids=[v.volume_id for v, _ in failed] or None,
             write_gens={v.volume_id: gens for v, gens in landed},
         )
+        # The notify reply carries the placement epoch for free: a bump
+        # (structural change anywhere in the fleet) drops cached plans.
+        self._observe_epoch(epoch)
         tracker.track_step("notify")
         tracker.log_summary()
         return nbytes
@@ -715,7 +847,9 @@ class LocalClient:
             out = arrays[0][0]
             if dest is not None:
                 if out is not dest and not tensors_overlap_in_memory(dest, [out]):
-                    np.copyto(dest, out)
+                    # Native landing path; raises on shape mismatch instead
+                    # of broadcasting (a stale-plan fetch must fail loudly).
+                    copy_into(dest, out)
                 return dest
             return out
         if dest is not None and tensors_overlap_in_memory(

@@ -49,6 +49,23 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "clamped to [4 GB, 64 GB]."),
     EnvVar("TORCHSTORE_TPU_USE_NATIVE", "bool", True,
            "Use the native C++ data-path library (libtsnative) when built."),
+    # --- steady-state sync pipeline -----------------------------------------
+    EnvVar("TORCHSTORE_TPU_LANDING_THREADS", "int", 0,
+           "Size of the shared landing-copy thread pool that overlaps "
+           "per-request segment copies with the event loop (0 = auto: one "
+           "per core, capped at 4 — fast_copy is already internally "
+           "threaded for large arrays, so the pool budgets against cores)."),
+    EnvVar("TORCHSTORE_TPU_ARENA_MAX_BYTES", "int", 262144,
+           "Tensors at or below this many bytes are packed into one shared "
+           "arena segment per put batch (one handshake entry + one "
+           "volume-side index pass instead of per-key segments); the bulk "
+           "transport packs the same set into a single framed payload. "
+           "0 disables packing."),
+    EnvVar("TORCHSTORE_TPU_PLAN_CACHE", "bool", True,
+           "Cache put/get_state_dict transfer plans per (store, size "
+           "signature), invalidated by the controller's placement epoch, "
+           "so repeated RL-sync iterations skip re-validation and "
+           "re-locate."),
     # --- cold-start provisioning (prewarm) ----------------------------------
     EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
            "put_state_dict derives a manifest and provisions pools/dials "
@@ -215,6 +232,26 @@ class StoreConfig:
     # Use the native C++ data-path library when built.
     use_native: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_USE_NATIVE", True)
+    )
+
+    # --- steady-state sync pipeline -----------------------------------------
+    # Landing-copy pool: client/volume-side segment copies fan out to this
+    # many threads so they overlap each other and the event loop's RPC work
+    # (0 = auto, one per core capped at 4; fast_copy already threads
+    # internally for large arrays, so the pool budgets against cores).
+    landing_threads: int = field(
+        default_factory=lambda: _env_int("TORCHSTORE_TPU_LANDING_THREADS", 0)
+    )
+    # Small-key arena packing threshold: tensors at or below this many bytes
+    # share one arena segment per put batch (0 disables).
+    arena_max_bytes: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_ARENA_MAX_BYTES", 256 << 10
+        )
+    )
+    # Iteration-stable transfer-plan cache for put/get_state_dict.
+    plan_cache: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_PLAN_CACHE", True)
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

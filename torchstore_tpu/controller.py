@@ -167,6 +167,15 @@ class Controller(Actor):
         # consumers to poll get_state_dict in a try/except loop).
         self._key_gens: dict[str, int] = {}
         self._update_cond: Optional[Any] = None  # lazily created on its loop
+        # Placement epoch: bumped ONLY on structural metadata changes (a
+        # key appearing/disappearing, a shape/dtype/layout change, a
+        # replica detach, volume replacement, index rebuild) — NOT on
+        # same-shape overwrites. The iteration-stable transfer-plan cache
+        # (client.SyncPlanCache) validates against it: an RL loop's steady
+        # re-publish keeps the epoch still, so iteration N+1's plans stay
+        # hot, while any change that could re-route or re-shape a fetch
+        # invalidates every cached plan fleet-wide.
+        self._placement_epoch = 1
         # Best-effort reclaims of stale copies on detached replicas:
         # {key: stale write gen} pending per volume, ONE drainer task per
         # volume (a publisher hammering a wedged replica must not spawn a
@@ -308,6 +317,7 @@ class Controller(Actor):
         reclaims of this copy can be made conditional."""
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         stale_gens: dict[str, dict[str, int]] = {}
+        structural = bool(detach_volume_ids)
         for meta in metas:
             if meta.tensor_val is not None or meta.objects is not None:
                 raise ValueError(
@@ -339,14 +349,25 @@ class Controller(Actor):
                             stale = True
                 if stale:
                     infos = None
+                    structural = True  # layout change re-routes every fetch
             if infos is None:
                 infos = {}
                 self.index[meta.key] = infos
+                structural = True  # key newly (re)appears in the index
             for vid in volume_ids:
                 info = infos.get(vid)
                 if info is None:
                     info = infos[vid] = StorageInfo.from_meta(meta)
+                    structural = True  # new replica placement
                 else:
+                    if (
+                        meta.tensor_meta is not None
+                        and info.tensor_meta is not None
+                        and info.tensor_meta != meta.tensor_meta
+                    ):
+                        # Same key, different shape/dtype: any plan built
+                        # against the old meta would land wrong bytes.
+                        structural = True
                     info.merge(meta)
                 if write_gens:
                     info.write_gen = max(
@@ -387,7 +408,12 @@ class Controller(Actor):
             # it's reachable.
             for vid, keys in stale_gens.items():
                 self._schedule_reclaim(vid, keys)
+        if structural:
+            self._placement_epoch += 1
         await self._bump({meta.key for meta in metas})
+        # The reply carries the placement epoch so publishers track it for
+        # free (no extra RPC): a bump invalidates their cached plans.
+        return self._placement_epoch
 
     def _schedule_reclaim(self, volume_id: str, keys: dict[str, int]) -> None:
         """``keys``: {key: stale write generation} — the generation of the
@@ -605,8 +631,24 @@ class Controller(Actor):
         # (they re-check state and see 'missing').
         deleted = {k for vkeys in by_volume.values() for k in vkeys}
         if deleted:
+            self._placement_epoch += 1
             await self._bump(deleted)
         return by_volume
+
+    @endpoint
+    async def placement_epoch(self) -> int:
+        """Current placement epoch (see __init__): ONE cheap RPC that lets a
+        consumer validate a whole cached transfer plan instead of
+        re-fetching the commit marker and re-locating every key."""
+        return self._placement_epoch
+
+    @endpoint
+    async def bump_placement_epoch(self) -> int:
+        """Force-invalidate every cached transfer plan fleet-wide. Called by
+        publishers that restructure a state dict in a way the index cannot
+        see (e.g. dropping keys from a push without deleting them)."""
+        self._placement_epoch += 1
+        return self._placement_epoch
 
     @endpoint
     async def keys(self, prefix: Optional[str] = None) -> list[str]:
@@ -877,6 +919,7 @@ class Controller(Actor):
             else:
                 lost.append(key)
                 self.index.pop(key, None)
+        self._placement_epoch += 1
         if changed:
             await self._bump(changed)
         return {"recoverable": recoverable, "lost": lost}
@@ -939,6 +982,7 @@ class Controller(Actor):
             for key in self.index:
                 self._key_gens[key] = offset
             cond.notify_all()
+        self._placement_epoch += 1  # rebuilt routing invalidates all plans
         return count
 
     @endpoint

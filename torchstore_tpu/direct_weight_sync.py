@@ -394,7 +394,7 @@ class DirectWeightSyncSource:
                     if seg is None:
                         seg = shm.ShmSegment.create(max(host_arr.nbytes, 1))
                     staged = seg.view(TensorMeta.of(host_arr))
-                    np.copyto(staged, host_arr)
+                    copy_into(staged, host_arr)
                     self.segments[buffer_id] = seg
                     self.server.buffers[buffer_id] = staged
                     shm_name = seg.name
@@ -585,7 +585,7 @@ class DirectWeightSyncSource:
                 and staged.shape == host_arr.shape
                 and staged.dtype == host_arr.dtype
             ):
-                np.copyto(staged, host_arr)
+                copy_into(staged, host_arr)
                 host_arr = staged
             else:
                 self.server.buffers[buffer_id] = host_arr
@@ -683,7 +683,7 @@ class DirectWeightSyncSource:
                     # refresh copy vanishes, matching RDMA's register-once
                     # read-live semantics.
                     continue
-                np.copyto(staged, np.ascontiguousarray(host_arr))
+                copy_into(staged, np.ascontiguousarray(host_arr))
 
     def staging_state_dict(self) -> Optional[Any]:
         """The registered staging buffers in the ORIGINAL state-dict
@@ -1635,11 +1635,11 @@ def _land_device(target, arr):
         )
         part = np.asarray(arr[region])
         if target.data is not None:
-            np.copyto(target.data, part)
+            copy_into(target.data, part)
             return target.data
         return part
     # numpy target: full copy in place.
-    np.copyto(target, np.asarray(arr))
+    copy_into(target, np.asarray(arr))
     return target
 
 
@@ -1671,7 +1671,7 @@ def _rebuild(target, parts: list[tuple[TensorSlice, np.ndarray]]):
     if isinstance(target, Shard):
         ((_, arr),) = parts
         if target.data is not None:
-            np.copyto(target.data, arr)
+            copy_into(target.data, arr)
             return target.data
         return arr
     if shd.is_jax_array(target) or shd.is_sharded_spec(target):
@@ -1684,5 +1684,5 @@ def _rebuild(target, parts: list[tuple[TensorSlice, np.ndarray]]):
         return jnp.asarray(arr, dtype=target.dtype)
     # numpy target: single full slice, filled in place.
     ((_, arr),) = parts
-    np.copyto(target, arr)
+    copy_into(target, arr)
     return target

@@ -159,7 +159,16 @@ async def _build_plan(client, manifest, report):
                 put_ids,
                 transports,
                 ici_available=client._config.ici_enabled and dt.is_available(),
+                arena_max_bytes=client._config.arena_max_bytes,
             )
+            # Plan-cache handoff: hand the provisioned arena layout to the
+            # client so even the FIRST put_state_dict of this working set
+            # adopts it verbatim instead of re-deriving the packing.
+            plan_cache = getattr(client, "plan_cache", None)
+            if plan_cache is not None:
+                hint = manifest.arena_hint(client._config.arena_max_bytes)
+                if hint is not None:
+                    plan_cache.seed(hint["sizes"], hint)
             report["transports"] = transports
             report["planned_bytes"] = plan.planned_bytes
             return plan

@@ -53,17 +53,54 @@ class StateDictManifest:
     def total_bytes(self) -> int:
         return sum(e.nbytes for e in self.entries)
 
-    def segment_sizes(self) -> dict[int, int]:
+    def segment_sizes(self, arena_max_bytes: int = 0) -> dict[int, int]:
         """{segment size: count} over every put request — exactly the pool
         the SHM transport's put handshake will ask the volume for (request
         payloads land in size-exact segments; empty tensors take the 1-byte
-        minimum mapping)."""
+        minimum mapping).
+
+        With ``arena_max_bytes`` > 0, requests at or below the threshold are
+        packed the way the transport's small-key arena packs them (same
+        layout function — ``transport.landing.compute_arena_layout``), so
+        the provisioned pool holds ONE arena-sized segment instead of a
+        thousand tiny ones the first put would never ask for."""
         sizes: dict[int, int] = {}
+        small: list[int] = []
         for entry in self.entries:
             for nbytes in entry.request_nbytes:
+                if 0 < arena_max_bytes and int(nbytes) <= arena_max_bytes:
+                    small.append(int(nbytes))
+                    continue
                 size = max(int(nbytes), 1)
                 sizes[size] = sizes.get(size, 0) + 1
+        if len(small) >= 2:
+            from torchstore_tpu.transport.landing import compute_arena_layout
+
+            _, total = compute_arena_layout(small)
+            sizes[total] = sizes.get(total, 0) + 1
+        elif small:
+            size = max(small[0], 1)
+            sizes[size] = sizes.get(size, 0) + 1
         return sizes
+
+    def arena_hint(self, arena_max_bytes: int) -> Optional[dict]:
+        """The transport-shape arena layout for this manifest (plan-cache
+        seed: ``ts.prewarm`` hands it to the client so even the FIRST
+        put_state_dict adopts the provisioned layout verbatim)."""
+        if arena_max_bytes <= 0:
+            return None
+        small = [
+            int(n)
+            for entry in self.entries
+            for n in entry.request_nbytes
+            if int(n) <= arena_max_bytes
+        ]
+        if len(small) < 2:
+            return None
+        from torchstore_tpu.transport.landing import compute_arena_layout
+
+        offsets, total = compute_arena_layout(small)
+        return {"sizes": tuple(small), "offsets": offsets, "total": total}
 
     def max_request_nbytes(self) -> int:
         return max(

@@ -78,12 +78,15 @@ def plan_provisioning(
     put_volume_ids: list[str],
     transports: dict[str, str],
     ici_available: bool = False,
+    arena_max_bytes: int = 0,
 ) -> ProvisionPlan:
     """Build the plan: every volume a put will land on (primary + replicas,
     already resolved by the caller through the strategy) gets the manifest's
     full segment plan on the SHM rung, a dial plan on the bulk rung, and
-    nothing on the RPC rung (payloads ride the codec — nothing to warm)."""
-    sizes = manifest.segment_sizes()
+    nothing on the RPC rung (payloads ride the codec — nothing to warm).
+    ``arena_max_bytes`` mirrors the transport's small-key arena packing so
+    the provisioned pool matches what the first put's handshake asks for."""
+    sizes = manifest.segment_sizes(arena_max_bytes)
     plan = ProvisionPlan(
         manifest_bytes=manifest.total_bytes,
         replicas=max(1, len(put_volume_ids)),

@@ -130,6 +130,24 @@ class _Connection:
             body["trace"] = ctx
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.pending[req_id] = fut
+        try:
+            return await self._request_inner(fut, req_id, kind, body, timeout)
+        except BaseException:
+            # The awaiter is gone (cancelled mid-RPC, or the write itself
+            # failed): drop the pending slot and mark any late-set
+            # exception retrieved — otherwise a dying volume's _fail_all
+            # sprays "exception was never retrieved" ActorDiedErrors into
+            # whatever event loop hosts this connection.
+            self.pending.pop(req_id, None)
+            if fut.done() and not fut.cancelled():
+                fut.exception()
+            else:
+                fut.cancel()
+            raise
+
+    async def _request_inner(
+        self, fut: asyncio.Future, req_id: int, kind: int, body: dict, timeout
+    ) -> Any:
         async with self.write_lock:
             await write_message(self.writer, kind, body)
         if timeout is None or timeout <= 0:
@@ -588,6 +606,11 @@ def _child_main(pipe, actor_cls, name: str, args: tuple, kwargs: dict, env: dict
     from torchstore_tpu import observability as _obs
 
     _obs.reinit_after_fork()
+    # Landing-copy pool threads do not survive the fork either; drop the
+    # inherited (dead) executor so the first landing re-creates a live one.
+    from torchstore_tpu.transport import landing as _landing
+
+    _landing.reinit_after_fork()
     try:
         asyncio.run(_child_async(pipe, actor_cls, name, args, kwargs))
     except KeyboardInterrupt:

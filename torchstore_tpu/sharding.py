@@ -185,3 +185,22 @@ def build_array(like, parts: list[tuple[Any, np.ndarray]]):
 
 def full_box(global_shape: tuple[int, ...]) -> Box:
     return Box((0,) * len(global_shape), tuple(global_shape))
+
+
+def plan_signature(value: Any):
+    """Hashable transfer-plan signature component for a jax leaf, or None
+    for non-jax values. Includes the SHARDING, not just shape/dtype: two
+    pushes of the same global shape under different meshes decompose into
+    different request sets, so a cached plan keyed without the sharding
+    would replay the wrong fan-out (the iteration-stable plan cache keys on
+    this, client.SyncPlanCache)."""
+    if is_jax_array(value) or is_sharded_spec(value):
+        return (
+            "jax",
+            tuple(int(s) for s in value.shape),
+            str(value.dtype),
+            value.sharding,  # NamedSharding et al. are hashable
+        )
+    if is_plain_spec(value):
+        return ("spec", tuple(int(s) for s in value.shape), str(value.dtype))
+    return None

@@ -22,6 +22,7 @@ import numpy as np
 from torchstore_tpu import sharding as shd
 from torchstore_tpu import torch_interop
 from torchstore_tpu.logging import LatencyTracker, get_logger
+from torchstore_tpu.native import copy_into
 from torchstore_tpu.transport.types import _np_dtype  # bf16-aware name->dtype
 
 logger = get_logger("torchstore_tpu.state_dict")
@@ -286,7 +287,8 @@ def _dequantize(q: Any, scale: float, dtype_name: str, target: Any = None):
         return (q.astype(jnp.float32) * scale).astype(_np_dtype(dtype_name))
     dequant = q.astype(np.float32) * np.float32(scale)
     if target is not None:
-        np.copyto(target, dequant.astype(target.dtype))
+        # Native landing path; raises on shape mismatch (no broadcast).
+        copy_into(target, dequant.astype(target.dtype))
         return target
     return dequant.astype(_np_dtype(dtype_name))
 
@@ -337,6 +339,68 @@ def _dequant_result(got: Any, scale: float, dtype_name: str, user_leaf: Any):
 
 def _store_key(key: str, flat_key: str) -> str:
     return f"{key}{_SEP}{flat_key}" if flat_key else key
+
+
+# --------------------------------------------------------------------------
+# iteration-stable transfer plans (client.SyncPlanCache integration)
+# --------------------------------------------------------------------------
+
+
+def _leaf_signature(value: Any) -> tuple:
+    """Hashable shape/dtype/sharding signature of one flat leaf — the unit
+    the plan cache keys on. Signature equality means the leaf decomposes
+    into byte-identical requests, so a cached plan replays exactly."""
+    sig = shd.plan_signature(value)
+    if sig is not None:
+        return sig
+    from torchstore_tpu.client import Shard
+
+    if isinstance(value, Shard):
+        ts = value.tensor_slice
+        data_sig = (
+            _leaf_signature(value.data) if value.data is not None else None
+        )
+        return (
+            "shard",
+            ts.offsets,
+            ts.local_shape,
+            ts.global_shape,
+            ts.coordinates,
+            data_sig,
+        )
+    if torch_interop.is_torch_tensor(value):
+        return ("torch", tuple(value.shape), str(value.dtype))
+    if isinstance(value, np.ndarray):
+        return ("np", tuple(value.shape), str(value.dtype))
+    return ("obj",)  # opaque objects re-pickle every iteration anyway
+
+
+def _flat_signature(flat: dict, *extra) -> tuple:
+    return tuple((k, _leaf_signature(v)) for k, v in flat.items()) + extra
+
+
+def _arena_hint_from_flat(flat: dict, config) -> Optional[dict]:
+    """Precompute the small-key arena layout for a flat dict of PLAIN numpy
+    leaves (the common trainer-host case). Any leaf whose request fan-out
+    this function cannot see exactly (jax shards, torch views, Shards)
+    returns None — the transport derives the layout itself and validates
+    any hint against the real request set regardless."""
+    if config is None or config.arena_max_bytes <= 0:
+        return None
+    from torchstore_tpu.transport import landing
+
+    sizes: list[int] = []
+    for value in flat.values():
+        if isinstance(value, np.ndarray):
+            if value.nbytes <= config.arena_max_bytes:
+                sizes.append(int(value.nbytes))
+            continue
+        if _is_fetch_target(value):  # jax/torch/Shard: fan-out not 1:1 here
+            return None
+    if len(sizes) < 2:
+        return None
+    offsets, total = landing.compute_arena_layout(sizes)
+    return {"sizes": tuple(sizes), "offsets": offsets, "total": total}
 
 
 class _DirectSyncCache:
@@ -544,11 +608,39 @@ async def put_state_dict(
         )
     tracker = LatencyTracker(f"put_state_dict[{key}]")
     flat, mapping = flatten_state_dict(state_dict)
-    if MAPPING_KEY in flat:
-        raise ValueError(
-            f"{MAPPING_KEY!r} is a reserved top-level state-dict key (it is "
-            "the commit marker); rename that entry"
+    cache = getattr(client, "plan_cache", None)
+    plan = None
+    signature = None
+    if cache is not None:
+        signature = _flat_signature(
+            flat, ("cast", str(transfer_dtype), transfer_quant)
         )
+        if cache.last_put_sig.get(key) != signature:
+            # Any publish whose signature this client cannot PROVE is
+            # unchanged bumps the epoch: a restructure that only DROPS
+            # keys deletes nothing, so the index alone cannot see it and
+            # consumers' cached get plans would serve the old structure
+            # forever. Covers publisher restarts too (no memory of the
+            # previous push -> one bump per key per process).
+            await client.bump_placement_epoch()
+        cache.last_put_sig[key] = signature
+        plan = cache.lookup("put", key, signature)
+    else:
+        # No publisher-side signature memory at all (plan cache disabled):
+        # every push could be an invisible restructure — invalidate
+        # consumer plans each time. They fall back to the full (pre-PR)
+        # marker-validated path; plan caching across the fleet is only
+        # effective when publishers keep their caches on.
+        await client.bump_placement_epoch()
+    if plan is None:
+        if MAPPING_KEY in flat:
+            raise ValueError(
+                f"{MAPPING_KEY!r} is a reserved top-level state-dict key (it "
+                "is the commit marker); rename that entry"
+            )
+        store_keys = {k: _store_key(key, k) for k in flat}
+    else:
+        store_keys = plan["store_keys"]
     marker: dict = {"mapping": mapping}
     if transfer_dtype is not None:
         flat = cast_floating_tensors(flat, transfer_dtype)
@@ -556,15 +648,32 @@ async def put_state_dict(
         flat, quant_meta = quantize_int8(flat)
         marker["quant"] = quant_meta
     tracker.track_step("flatten")
-    # Automatic provisioning hint: the first push of a big working set
-    # derives a manifest from the flat dict and prewarms pools/dials ahead
-    # of the data-plane puts (config.prewarm_auto; once per size-signature
-    # per client; never fails the put — see provision.maybe_auto_prewarm).
-    from torchstore_tpu import provision
+    if plan is None:
+        # Automatic provisioning hint: the first push of a big working set
+        # derives a manifest from the flat dict and prewarms pools/dials
+        # ahead of the data-plane puts (config.prewarm_auto; once per
+        # size-signature per client; never fails the put). Cached-plan
+        # iterations skip even this no-op check.
+        from torchstore_tpu import provision
 
-    await provision.maybe_auto_prewarm(client, flat)
-    tracker.track_step("prewarm_hint")
-    await client.put_batch({_store_key(key, k): v for k, v in flat.items()})
+        await provision.maybe_auto_prewarm(client, flat)
+        tracker.track_step("prewarm_hint")
+        arena_hint = None
+        if cache is not None:
+            config = getattr(client, "_config", None)
+            arena_hint = _arena_hint_from_flat(flat, config)
+            if arena_hint is not None:
+                # Prewarm-seeded layouts (provision handoff) take over when
+                # they describe exactly these sizes.
+                arena_hint = cache.seeds.get(
+                    arena_hint["sizes"], arena_hint
+                )
+    else:
+        arena_hint = plan.get("arena")
+    await client.put_batch(
+        {store_keys[k]: v for k, v in flat.items()},
+        plan_hint={"arena": arena_hint} if arena_hint else None,
+    )
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("put_batch", nbytes)
     # Commit marker LAST: its presence implies every entry above landed
@@ -572,6 +681,13 @@ async def put_state_dict(
     # together with a complete push).
     await client.put(_store_key(key, MAPPING_KEY), marker)
     tracker.track_step("commit_marker")
+    if cache is not None and plan is None:
+        cache.store(
+            "put",
+            key,
+            signature,
+            {"store_keys": store_keys, "arena": arena_hint},
+        )
     tracker.log_summary(level=20)  # INFO: weight-sync phases are user-facing
 
 
@@ -633,6 +749,33 @@ async def get_state_dict(
                     )
         return result
     tracker = LatencyTracker(f"get_state_dict[{key}]")
+    cache = getattr(client, "plan_cache", None)
+    user_flat = user_mapping = None
+    if user_state_dict is not None:
+        user_flat, user_mapping = flatten_state_dict(user_state_dict)
+    signature = None
+    epoch_at_build = None
+    if cache is not None:
+        signature = (
+            _flat_signature(user_flat) if user_flat is not None else ("none",)
+        )
+        if cache.peek("get", key, signature) is not None:
+            # ONE epoch RPC validates the whole cached plan (instead of a
+            # commit-marker fetch + per-key structure checks); a bumped
+            # epoch invalidates it right here and falls through to the
+            # full path.
+            await client.placement_epoch()
+            plan = cache.lookup("get", key, signature)
+            if plan is not None:
+                return await _get_with_plan(
+                    client, plan, user_flat, user_mapping, tracker
+                )
+        if cache.epoch is None:
+            await client.placement_epoch()  # once per consumer client
+        # Capture the epoch BEFORE fetching the marker: a structural change
+        # that lands mid-build must leave the stored plan already stale
+        # (stamping a later-observed epoch would validate it forever).
+        epoch_at_build = cache.epoch
     try:
         marker = await client.get(_store_key(key, MAPPING_KEY))
     except KeyError as exc:
@@ -646,7 +789,6 @@ async def get_state_dict(
     tracker.track_step("mapping")
 
     if user_state_dict is not None:
-        user_flat, user_mapping = flatten_state_dict(user_state_dict)
         stored_keys = _leaf_keys(mapping)
         # Unknown keys always fail; missing keys fail only in strict mode
         # (strict=False pulls a subset, e.g. just the lm_head).
@@ -691,6 +833,52 @@ async def get_state_dict(
             flat[k] = got
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("get_batch", nbytes)
+    result = unflatten_state_dict(flat, mapping)
+    tracker.track_step("unflatten")
+    if cache is not None and quant is None:
+        # Quantized pushes are NOT plan-cached: the scales ride the commit
+        # marker and change every publish, so the marker fetch stays on
+        # the hot path for them.
+        if user_flat is not None:
+            targets_spec = [
+                (k, _store_key(key, k), _is_fetch_target(v))
+                for k, v in user_flat.items()
+            ]
+        else:
+            targets_spec = [
+                (k, _store_key(key, k), False)
+                for k in sorted(_leaf_keys(mapping))
+            ]
+        cache.store(
+            "get",
+            key,
+            signature,
+            {
+                "targets": targets_spec,
+                # The stored mapping is needed to rebuild structure only
+                # when the caller passes no user dict.
+                "mapping": mapping if user_flat is None else None,
+            },
+            epoch=epoch_at_build,
+        )
+    tracker.log_summary(level=20)
+    return result
+
+
+async def _get_with_plan(client, plan, user_flat, user_mapping, tracker):
+    """Plan-cache hit: the placement epoch validated the whole plan, so the
+    commit-marker fetch and structure validation are skipped and the
+    iteration goes straight to the data plane (locations are already warm
+    in the client's location cache for the same reason)."""
+    targets = {
+        sk: (user_flat[k] if fetch and user_flat is not None else None)
+        for k, sk, fetch in plan["targets"]
+    }
+    fetched = await client.get_batch(targets)
+    flat = {k: fetched[sk] for k, sk, _ in plan["targets"]}
+    nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
+    tracker.track_step("get_batch_planned", nbytes)
+    mapping = user_mapping if user_flat is not None else plan["mapping"]
     result = unflatten_state_dict(flat, mapping)
     tracker.track_step("unflatten")
     tracker.log_summary(level=20)

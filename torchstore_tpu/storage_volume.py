@@ -587,6 +587,12 @@ class StorageVolume(Actor):
                 "live_segments": sum(
                     len(by_coords) for by_coords in cache.by_key.values()
                 ),
+                # Segments shared by >1 entry are packed small-key arenas
+                # (steady-state pipeline): one segment carrying a whole put
+                # batch's small-tensor tail.
+                "arena_segments": sum(
+                    1 for refs in cache.seg_refs.values() if refs > 1
+                ),
                 "retired_segments": len(cache.retired),
                 "pool_segments": sum(
                     len(s) for s in cache.free_by_size.values()

@@ -131,6 +131,11 @@ class TransportBuffer(ABC):
     # buffer carried (set by put_to_storage_volume; forwarded by the client
     # to the controller so stale-replica reclaims can delete conditionally).
     write_gens: "Optional[dict[str, int]]" = None
+    # Optional transfer-plan hint from the iteration-stable plan cache
+    # (client.put_batch plumbs it): e.g. a precomputed arena layout the
+    # transport may adopt instead of recomputing. Transports MUST validate
+    # the hint against the actual requests before trusting it.
+    plan_hint: "Optional[dict]" = None
 
     # ---- client-side lifecycle ------------------------------------------
 
@@ -222,7 +227,10 @@ class TransportBuffer(ABC):
         self._pre_handshake(volume, requests, op)
         metas = [r.meta_only() for r in requests]
         reply = await volume.actor.handshake.call_one(self, metas, op)
-        self._post_handshake(volume, requests, reply, op)
+        # May be a coroutine: the SHM buffer lands its post-handshake
+        # segment copies through the overlap pool instead of serially on
+        # the event loop thread.
+        await maybe_await(self._post_handshake(volume, requests, reply, op))
 
     # ---- hooks (client) --------------------------------------------------
 

@@ -67,6 +67,11 @@ IDX_SESSION_OPEN = 0xFFFFFFFD
 # transfers split into stripes ridden over several connections in parallel
 # (the uniflow multi-QP striping role, uniflow_buffer.py:400-497).
 IDX_STRIPED = 0xFFFFFFFC
+# One frame carrying the PACKED small-key payload of a put batch (offset
+# table rides the RPC manifest): 2048 small tensors cost one header +
+# one sendall instead of 2048 framed sends — the DCN analog of the SHM
+# arena. Not a control index: the server stores it like any payload.
+IDX_PACKED = 0xFFFFFFFB
 _CONTROL_IDXS = frozenset({IDX_HELLO, IDX_ABORT, IDX_SESSION_OPEN, IDX_STRIPED})
 
 _STRIPE = struct.Struct("<IQQ")  # real_idx, offset, total_nbytes
@@ -784,6 +789,10 @@ class BulkTransportBuffer(TransportBuffer):
         self.client_id: Optional[int] = None
         # RPC-carried metadata
         self.manifest: dict[int, TensorMeta] = {}
+        # Packed small-key frame: request idx -> (byte offset, TensorMeta)
+        # into the single IDX_PACKED payload (the DCN arena).
+        self.packed_manifest: dict[int, tuple[int, TensorMeta]] = {}
+        self.packed_total = 0
         self.objects: dict[int, Any] = {}
         self.descriptors: dict[int, TensorMeta] = {}
         # client-only live state
@@ -933,10 +942,13 @@ class BulkTransportBuffer(TransportBuffer):
         cache: BulkClientCache = volume.transport_context.get_cache(
             BulkClientCache
         )
+        packed_members = await self._pack_small_requests(requests)
         for idx, req in enumerate(requests):
             if req.is_object:
                 self.objects[idx] = req.objects
                 continue
+            if idx in packed_members:
+                continue  # rides the single packed frame
             arr = np.ascontiguousarray(req.tensor_val)
             regs.register(arr)
             self.manifest[idx] = TensorMeta.of(arr)
@@ -960,6 +972,60 @@ class BulkTransportBuffer(TransportBuffer):
                 view,
             )
         self._sent_put = True
+
+    async def _pack_small_requests(self, requests: list[Request]) -> set[int]:
+        """Pack every tensor at or below the arena threshold into ONE framed
+        payload (offset table rides the RPC manifest): the per-key framing —
+        a header, a lock round, and a sendall per tensor — collapses to a
+        single frame for the whole small-key tail of the batch."""
+        from torchstore_tpu.transport import landing
+
+        limit = getattr(self.config, "arena_max_bytes", 0)
+        if limit <= 0:
+            return set()
+        members = [
+            idx
+            for idx, req in enumerate(requests)
+            if not req.is_object
+            and req.tensor_val is not None
+            and req.nbytes <= limit
+        ]
+        if len(members) < 2:
+            return set()
+        arrs = {
+            idx: np.ascontiguousarray(requests[idx].tensor_val)
+            for idx in members
+        }
+        offsets, total = landing.compute_arena_layout(
+            [arrs[idx].nbytes for idx in members]
+        )
+        packed = np.empty(total, np.uint8)
+        pairs = []
+        for idx, off in zip(members, offsets):
+            arr = arrs[idx]
+            self.packed_manifest[idx] = (off, requests[idx].meta_only().tensor_meta)
+            if arr.nbytes:
+                pairs.append(
+                    (
+                        packed[off : off + arr.nbytes],
+                        np.frombuffer(arr, dtype=np.uint8),
+                    )
+                )
+        # land_async, not land_sync: this runs ON the event loop, and a
+        # ~100 MB pack must not freeze concurrent replication fan-outs /
+        # heartbeats for its full copy duration.
+        await landing.land_async(pairs, stage="bulk_pack")
+        self.packed_total = total
+        landing.ARENA_KEYS.inc(len(members), transport="bulk")
+        landing.ARENA_BYTES.inc(sum(a.nbytes for a in arrs.values()), transport="bulk")
+        await _send_frame(
+            self._conn.sock,
+            self._conn.write_lock,
+            self.session,
+            IDX_PACKED,
+            memoryview(packed),
+        )
+        return set(members)
 
     async def _send_striped(
         self, idx: int, view: memoryview, conns: list[BulkClientConn]
@@ -1010,21 +1076,37 @@ class BulkTransportBuffer(TransportBuffer):
 
         # Size-scaled: a multi-GB DCN transfer slower than the flat
         # handshake timeout must not spuriously fail the put.
-        total = sum(m.nbytes for m in self.manifest.values())
+        total = sum(m.nbytes for m in self.manifest.values()) + self.packed_total
+        indices = sorted(self.manifest)
+        if self.packed_manifest:
+            indices.append(IDX_PACKED)
         payloads = await asyncio.wait_for(
-            server.collect(self.session, sorted(self.manifest)),
+            server.collect(self.session, indices),
             timeout=transfer_timeout(self.config.handshake_timeout, total),
         )
+        if self.packed_manifest:
+            # One unpack pass serves the whole small-key tail: member
+            # arrays are zero-copy views into the single packed frame.
+            raw = payloads.pop(IDX_PACKED)
+            for idx, (off, meta) in self.packed_manifest.items():
+                count = int(np.prod(meta.shape)) if meta.shape else 1
+                arr = np.frombuffer(
+                    raw, dtype=meta.np_dtype, count=count, offset=off
+                ).reshape(meta.shape)
+                out[idx] = self._land_existing(existing, idx, arr)
         for idx, raw in payloads.items():
             meta = self.manifest[idx]
             arr = np.frombuffer(raw, dtype=meta.np_dtype).reshape(meta.shape)
-            prev = existing.get(idx)
-            if prev is not None and prev.shape == arr.shape and prev.dtype == arr.dtype:
-                fast_copy(prev, arr)  # in-place reuse (invariant 6)
-                out[idx] = prev
-            else:
-                out[idx] = arr
+            out[idx] = self._land_existing(existing, idx, arr)
         return out
+
+    @staticmethod
+    def _land_existing(existing: dict, idx: int, arr: np.ndarray):
+        prev = existing.get(idx)
+        if prev is not None and prev.shape == arr.shape and prev.dtype == arr.dtype:
+            fast_copy(prev, arr)  # in-place reuse (invariant 6)
+            return prev
+        return arr
 
     def handle_get_request(
         self, ctx: TransportContext, metas: list[Request], entries: list[Any]
@@ -1123,5 +1205,7 @@ class BulkTransportBuffer(TransportBuffer):
                     _close_sock(conn.sock)
         self._conn = None
         self.manifest = {}
+        self.packed_manifest = {}
+        self.packed_total = 0
         self.objects = {}
         self.descriptors = {}

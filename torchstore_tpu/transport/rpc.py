@@ -17,7 +17,7 @@ from typing import Any, Optional
 import numpy as np
 
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
-from torchstore_tpu.native import fast_copy
+from torchstore_tpu.native import copy_into, fast_copy
 from torchstore_tpu.transport.types import Request
 
 
@@ -59,7 +59,10 @@ class RPCTransportBuffer(TransportBuffer):
                 continue
             arr = remote.tensors[idx]
             if req.destination_view is not None:
-                np.copyto(req.destination_view, arr)
+                # Native landing path (multi-threaded contiguous + strided
+                # row-block); raises on shape mismatch instead of
+                # broadcasting stale-metadata fetches into place.
+                copy_into(req.destination_view, arr)
                 results.append(req.destination_view)
             else:
                 results.append(arr)

@@ -46,9 +46,10 @@ import numpy as np
 
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
-from torchstore_tpu.native import fast_copy
+from torchstore_tpu.native import copy_into, fast_copy
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.utils import spawn_logged
+from torchstore_tpu.transport import landing
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
     TransportCache,
@@ -99,6 +100,10 @@ RESERVED_TTL_S = 60.0  # handshake offers whose put never arrived
 # of two — the small-op fast path. The volume still lands them in (pooled)
 # segments, so zero-copy gets work identically.
 SMALL_INLINE_BYTES = 64 * 1024
+
+# Handshake-reply key for the batch's shared arena segment offer; request
+# indices are always >= 0 so -1 can never collide.
+ARENA_OFFER_KEY = -1
 
 
 def is_available() -> bool:
@@ -192,12 +197,19 @@ class ShmSegment:
     _POPULATE = getattr(mmap, "MAP_POPULATE", 0)
 
     @classmethod
-    def create(cls, size: int, name: Optional[str] = None) -> "ShmSegment":
+    def create(
+        cls, size: int, name: Optional[str] = None, populate: bool = True
+    ) -> "ShmSegment":
+        """``populate=False`` skips MAP_POPULATE's eager page zeroing — for
+        the volume's inline-put residual path, where actor dispatch must not
+        stall on population (tiny segments fault their few pages during the
+        landing copy instead)."""
         name = name or f"ts_shm_{os.getpid()}_{uuid.uuid4().hex[:12]}"
         fd = os.open(cls._path(name), os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, size)
-            mm = mmap.mmap(fd, size, flags=mmap.MAP_SHARED | cls._POPULATE)
+            flags = mmap.MAP_SHARED | (cls._POPULATE if populate else 0)
+            mm = mmap.mmap(fd, size, flags=flags)
         finally:
             os.close(fd)
         _SEGMENTS_CREATED.inc()
@@ -364,6 +376,11 @@ class ShmServerCache(TransportCache):
 
     def __init__(self) -> None:
         self.by_key: dict[str, dict[Optional[tuple], _Entry]] = {}
+        # name -> number of live (key, coords) entries backed by the
+        # segment. 1 for ordinary segments; >1 for arena segments shared by
+        # a whole batch of small keys — the segment retires/frees/unlinks
+        # only when the LAST referencing entry is replaced or deleted.
+        self.seg_refs: dict[str, int] = {}
         self.staged: dict[str, tuple[ShmSegment, float]] = {}
         # name -> outstanding read leases across all clients (zero-copy
         # views AND in-flight destination copies)
@@ -712,9 +729,22 @@ class ShmServerCache(TransportCache):
     ) -> None:
         entries = self.by_key.setdefault(key, {})
         prev = entries.get(coords)
-        if prev is not None and prev.seg.name != seg.name:
-            self._retire_or_free(prev.seg)
         entries[coords] = _Entry(seg, meta)
+        if prev is not None and prev.seg.name == seg.name:
+            return  # in-place overwrite: refcount unchanged
+        self.seg_refs[seg.name] = self.seg_refs.get(seg.name, 0) + 1
+        if prev is not None and self._release_entry_ref(prev.seg):
+            self._retire_or_free(prev.seg)
+
+    def _release_entry_ref(self, seg: ShmSegment) -> bool:
+        """One entry stopped referencing ``seg``. Returns True when it was
+        the last reference (the segment left the entry set)."""
+        left = self.seg_refs.get(seg.name, 1) - 1
+        if left > 0:
+            self.seg_refs[seg.name] = left
+            return False
+        self.seg_refs.pop(seg.name, None)
+        return True
 
     def _retire_or_free(self, seg: ShmSegment) -> None:
         if self.grants.get(seg.name):
@@ -739,6 +769,10 @@ class ShmServerCache(TransportCache):
 
     def delete_key(self, key: str) -> None:
         for entry in self.by_key.pop(key, {}).values():
+            if not self._release_entry_ref(entry.seg):
+                # Arena segment still backing other live keys: its bytes
+                # stay until the last referencing entry goes.
+                continue
             entry.seg.unlink()
             self.grants.pop(entry.seg.name, None)
 
@@ -768,6 +802,7 @@ class ShmServerCache(TransportCache):
             seg.unlink()
         self._warm_inflight.clear()
         self.grants.clear()
+        self.seg_refs.clear()
 
 
 class ShmClientCache(TransportCache):
@@ -1013,6 +1048,12 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         # Small-put fast path: payload arrays riding the put RPC itself
         # (zero-copy pickle-5 frames), landed server-side into segments.
         self.inline: dict[int, np.ndarray] = {}
+        # Small-key arena: {"offsets": {req_idx: byte offset}, "total": n,
+        # "segment": name, "segment_size": n} — computed client-side before
+        # the handshake, ridden to the server on BOTH RPCs (handshake offers
+        # one pooled segment for the whole batch; the put indexes every
+        # member out of it in one pass).
+        self.arena_plan: Optional[dict] = None
         # client -> server piggyback: sequenced view-release batches
         self.released: Optional[dict] = None
         # server -> client (via put_reply): adopted-segment renames
@@ -1035,7 +1076,46 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         if 0 < total <= SMALL_INLINE_BYTES:
             # One-RPC small put: skip the segment handshake entirely.
             self.handshake_ops = ()
+        else:
+            self.arena_plan = self._compute_arena_plan(requests)
         return await super().put_to_storage_volume(volume, requests)
+
+    def _compute_arena_plan(self, requests) -> Optional[dict]:
+        """Pack every tensor at or below the arena threshold into one shared
+        segment: one handshake entry, one segment rotation, and one
+        volume-side index pass for the whole small-key tail of a batch —
+        instead of a pooled segment per key. A valid ``plan_hint`` from the
+        iteration-stable plan cache (or a prewarm seed) is adopted verbatim
+        so repeat iterations skip even the layout arithmetic."""
+        config = self.config or default_config()
+        limit = config.arena_max_bytes
+        if limit <= 0:
+            return None
+        members = [
+            idx
+            for idx, req in enumerate(requests)
+            if not req.is_object
+            and req.tensor_val is not None
+            and req.nbytes <= limit
+        ]
+        if len(members) < 2:
+            return None  # nothing to amortize
+        sizes = tuple(requests[idx].nbytes for idx in members)
+        hint = (self.plan_hint or {}).get("arena")
+        if (
+            hint is not None
+            and hint.get("sizes") == sizes
+            and len(hint.get("offsets", ())) == len(members)
+        ):
+            offsets = hint["offsets"]
+            total = hint["total"]
+        else:
+            offsets, total = landing.compute_arena_layout(list(sizes))
+        return {
+            "offsets": dict(zip(members, offsets)),
+            "sizes": sizes,
+            "total": total,
+        }
 
     async def _pre_put_hook(self, volume, requests) -> None:
         if self.handshake_ops:
@@ -1054,7 +1134,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
         self.released = cache.collect_released(volume.volume_id)
 
-    def _post_handshake(self, volume, requests, reply, op) -> None:
+    async def _post_handshake(self, volume, requests, reply, op) -> None:
         if op != "put":
             return
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
@@ -1063,12 +1143,33 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache.ack_released(volume.volume_id, self.released)
         self.released = None
         offered: dict[int, ShmDescriptor] = reply or {}
+        arena = self.arena_plan
+        arena_seg: Optional[ShmSegment] = None
+        if arena:
+            arena_seg = self._attach_arena(volume, cache, offered, requests)
+        # Landing copies for the whole batch are collected first, then fanned
+        # out to the shared overlap pool: copies run concurrently with each
+        # other (and, chunked, within one huge tensor) while the event loop
+        # stays free for sibling volumes' RPCs.
+        pairs: list[tuple[np.ndarray, np.ndarray]] = []
         for idx, req in enumerate(requests):
             if req.is_object:
                 self.objects[idx] = req.objects
                 continue
             arr = np.ascontiguousarray(req.tensor_val)
-            meta = TensorMeta.of(arr)
+            meta = req.meta_only().tensor_meta
+            if arena_seg is not None and idx in arena["offsets"]:
+                # Arena member: no per-key descriptor rides the RPC — the
+                # server rebuilds every member view from the (already
+                # carried) arena plan plus the request metas.
+                off = arena["offsets"][idx]
+                cache.key_to_segments.setdefault(req.key, set()).add(
+                    arena_seg.name
+                )
+                if arr.nbytes:
+                    pairs.append((arena_seg.view(meta, off), arr))
+                self._client_segments[idx] = arena_seg
+                continue
             desc = offered.get(idx)
             if desc is not None and desc.meta == meta:
                 seg = cache.attach(desc, req.key, volume.volume_id)
@@ -1081,10 +1182,36 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 cache.key_to_segments.setdefault(req.key, set()).add(seg.name)
                 cache.seg_volume[seg.name] = volume.volume_id
             # THE hot memcpy: client array -> shared segment (native
-            # multi-threaded path on multi-core hosts).
-            fast_copy(seg.view(meta, desc.offset), arr)
+            # multi-threaded path; overlapped below).
+            pairs.append((seg.view(meta, desc.offset), arr))
             self.descriptors[idx] = desc
             self._client_segments[idx] = seg
+        await landing.land_async(
+            pairs, stage="put", copy=fast_copy, config=self.config
+        )
+
+    def _attach_arena(
+        self, volume, cache: "ShmClientCache", offered: dict, requests
+    ) -> ShmSegment:
+        """Resolve the batch's shared arena segment: the handshake's pooled
+        offer when one arrived, a cold create otherwise."""
+        arena = self.arena_plan
+        size = max(int(arena["total"]), 1)
+        desc = offered.get(ARENA_OFFER_KEY)
+        if desc is not None and desc.segment_size >= size:
+            first_key = requests[next(iter(arena["offsets"]))].key
+            seg = cache.attach(desc, first_key, volume.volume_id)
+            _CLIENT_ATTACH.inc(outcome="offer_hit")
+        else:
+            _CLIENT_ATTACH.inc(outcome="cold_create")
+            seg = ShmSegment.create(size)
+            cache.segments[seg.name] = seg
+            cache.seg_volume[seg.name] = volume.volume_id
+        arena["segment"] = seg.name
+        arena["segment_size"] = seg.size
+        landing.ARENA_KEYS.inc(len(arena["offsets"]), transport="shm")
+        landing.ARENA_BYTES.inc(sum(arena["sizes"]), transport="shm")
+        return seg
 
     def _handle_put_reply(self, volume, reply, requests) -> None:
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
@@ -1114,8 +1241,25 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache.apply_releases(self.released)
         cache.sweep()
         offered: dict[int, ShmDescriptor] = {}
+        misses: list[int] = []
+        arena = self.arena_plan
+        arena_members = set(arena["offsets"]) if arena else set()
+        if arena:
+            # ONE offer serves the whole small-key tail of the batch: the
+            # arena segment rotates through the pool exactly like a
+            # per-key segment, just shared by every member entry.
+            size = max(int(arena["total"]), 1)
+            seg = self._offer_from_pool(cache, size)
+            if seg is not None:
+                offered[ARENA_OFFER_KEY] = ShmDescriptor(
+                    seg.name,
+                    seg.size,
+                    TensorMeta(shape=(size,), dtype="uint8"),
+                )
+            else:
+                misses.append(size)
         for idx, meta in enumerate(metas):
-            if meta.tensor_meta is None:
+            if meta.tensor_meta is None or idx in arena_members:
                 continue
             # Puts NEVER overwrite a live entry segment — between this
             # handshake and the put RPC a concurrent get could be serving
@@ -1128,41 +1272,13 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             # handshake role, reference shared_memory.py:340-360, with
             # rotation instead of in-place overwrite).
             size = max(meta.tensor_meta.nbytes, 1)
-            # Pre-announced spares first: the client may have attached them
-            # in the background already (see put_reply "spares").
-            spare = None
-            names = cache.spare_by_size.get(size)
-            while names:
-                name = names.pop()
-                entry = cache.reserved.get(name)
-                if entry is not None:
-                    # Membership in `reserved` IS liveness: reserved
-                    # segments are only unlinked by sweep(), which removes
-                    # them from `reserved` in the same step. Refresh the
-                    # reservation timestamp for the put now in flight.
-                    cache.reserved[name] = (entry[0], time.monotonic())
-                    spare = entry[0]
-                    break
-            if spare is not None:
-                _POOL_OFFERS.inc(outcome="spare")
+            seg = self._offer_from_pool(cache, size)
+            if seg is not None:
                 offered[idx] = ShmDescriptor(
-                    spare.name, spare.size, meta.tensor_meta
-                )
-                continue
-            pooled = cache.take_free(size)
-            if pooled is not None:
-                _POOL_OFFERS.inc(outcome="pooled")
-                cache.reserved[pooled.name] = (pooled, time.monotonic())
-                offered[idx] = ShmDescriptor(
-                    pooled.name, pooled.size, meta.tensor_meta
+                    seg.name, seg.size, meta.tensor_meta
                 )
             else:
-                _POOL_OFFERS.inc(outcome="miss")
-        misses = [
-            max(meta.tensor_meta.nbytes, 1)
-            for idx, meta in enumerate(metas)
-            if meta.tensor_meta is not None and idx not in offered
-        ]
+                misses.append(size)
         if misses:
             # Warm spares for the sizes this handshake could NOT serve,
             # starting NOW: the client spends the next stretch copying its
@@ -1171,6 +1287,33 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             # set draws warm segments.
             cache.schedule_warm(misses)
         return offered
+
+    @staticmethod
+    def _offer_from_pool(
+        cache: "ShmServerCache", size: int
+    ) -> Optional[ShmSegment]:
+        """One handshake offer: pre-announced spares first (the client may
+        have background-attached them already), then the warm free pool.
+        The returned segment is reserved for the put now in flight."""
+        names = cache.spare_by_size.get(size)
+        while names:
+            name = names.pop()
+            entry = cache.reserved.get(name)
+            if entry is not None:
+                # Membership in `reserved` IS liveness: reserved segments
+                # are only unlinked by sweep(), which removes them from
+                # `reserved` in the same step. Refresh the reservation
+                # timestamp for the put now in flight.
+                cache.reserved[name] = (entry[0], time.monotonic())
+                _POOL_OFFERS.inc(outcome="spare")
+                return entry[0]
+        pooled = cache.take_free(size)
+        if pooled is not None:
+            _POOL_OFFERS.inc(outcome="pooled")
+            cache.reserved[pooled.name] = (pooled, time.monotonic())
+            return pooled
+        _POOL_OFFERS.inc(outcome="miss")
+        return None
 
     def handle_put_request(
         self, ctx: TransportContext, metas: list[Request], existing: dict
@@ -1182,8 +1325,10 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         out: dict[int, Any] = {}
         for idx, obj in self.objects.items():
             out[idx] = _copy_obj(obj) if self.inproc_copy else obj
+        cold_sizes: list[int] = []
+        cold_inline: list[int] = []
         for idx, arr in self.inline.items():
-            # Small inline put: the VOLUME lands the payload into a (pooled)
+            # Small inline put: the VOLUME lands the payload into a pooled
             # segment, so these entries get the same zero-copy get serving
             # as handshake puts. Volume-created segments already carry the
             # volume's pid — no rename round trip needed.
@@ -1192,12 +1337,49 @@ class SharedMemoryTransportBuffer(TransportBuffer):
             tmeta = TensorMeta.of(arr)
             seg = cache.take_free(max(arr.nbytes, 1))
             if seg is None:
-                seg = ShmSegment.create(max(arr.nbytes, 1))
+                # Residual cold path (the arena makes this rare): dispatch
+                # must not stall on segment population, so the create skips
+                # MAP_POPULATE (an inline payload is <= 64 KB — its few
+                # pages fault during the landing copy) and the warm pool is
+                # scheduled to absorb the NEXT inline put of this size.
+                seg = ShmSegment.create(max(arr.nbytes, 1), populate=False)
+                cold_inline.append(max(arr.nbytes, 1))
             view = seg.view(tmeta)
-            np.copyto(view, arr)
+            copy_into(view, arr)
             cache.put(meta.key, coords, seg, tmeta)
             out[idx] = view
-        cold_sizes: list[int] = []
+        if cold_inline:
+            cache.schedule_warm(cold_inline)
+        arena = self.arena_plan
+        arena_seg: Optional[ShmSegment] = None
+        arena_name = arena.get("segment") if arena else None
+        if arena_name:
+            # Resolve the batch's shared arena segment ONCE; every member
+            # below is a pure view+index step against it.
+            reserved = cache.reserved.pop(arena_name, None)
+            if reserved is not None:
+                arena_seg = reserved[0]
+            else:
+                arena_seg = ShmSegment.attach(
+                    arena_name, arena["segment_size"]
+                )
+                arena_seg.owner = True
+                old_name = arena_seg.name
+                arena_seg.rename_to_owner()
+                self.renames[old_name] = arena_seg.name
+                cold_sizes.append(arena_seg.size)
+            # One volume-side index pass: each arena member becomes a view
+            # at its packed offset (meta from the request list — members
+            # carry no per-key descriptors); the segment's entry refcount
+            # keeps it alive until the last member is replaced/deleted.
+            for idx, off in arena["offsets"].items():
+                meta = metas[idx]
+                coords = (
+                    meta.tensor_slice.coordinates if meta.tensor_slice else None
+                )
+                tmeta = meta.tensor_meta
+                cache.put(meta.key, coords, arena_seg, tmeta)
+                out[idx] = arena_seg.view(tmeta, off)
         for idx, desc in self.descriptors.items():
             meta = metas[idx]
             coords = meta.tensor_slice.coordinates if meta.tensor_slice else None
@@ -1313,7 +1495,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
         self.released = cache.collect_released(volume.volume_id)
 
-    def _handle_storage_volume_response(
+    async def _handle_storage_volume_response(
         self, volume, remote: "SharedMemoryTransportBuffer", requests
     ) -> list[Any]:
         cache: ShmClientCache = volume.transport_context.get_cache(ShmClientCache)
@@ -1322,6 +1504,12 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         self.released = None
         zero_copy = self.config is None or self.config.zero_copy_get
         results: list[Any] = []
+        # Landing copies are collected, fanned out to the overlap pool
+        # together, and only then do the per-copy completions (lease
+        # releases, staged-segment unlinks) run — a failed landing leaves
+        # those to the server's TTL sweeps instead of mis-releasing.
+        pairs: list[tuple[np.ndarray, np.ndarray]] = []
+        done: list = []
         for idx, req in enumerate(requests):
             if req.is_object or idx in remote.objects:
                 results.append(remote.objects[idx])
@@ -1332,17 +1520,23 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                     desc.segment_name, desc.segment_size, populate=True
                 )
                 src = seg.view(desc.meta, desc.offset)
-                landed = self._land(req, src)
-                seg.unlink()
+                if req.destination_view is not None:
+                    landed = req.destination_view
+                else:
+                    landed = np.empty(src.shape, src.dtype)
+                pairs.append((landed, src))
+                done.append(seg.unlink)
                 results.append(landed)
                 continue
             seg = cache.attach(desc, req.key, volume.volume_id)
             src = seg.strided_view(desc.meta, desc.offset, desc.strides)
             if req.destination_view is not None:
-                fast_copy(req.destination_view, src)
-                # The copy has landed; release the read lease the volume
+                pairs.append((req.destination_view, src))
+                # Once the copy lands: release the read lease the volume
                 # granted for the duration of this in-place read.
-                cache.count_release(desc.segment_name)
+                done.append(
+                    lambda name=desc.segment_name: cache.count_release(name)
+                )
                 results.append(req.destination_view)
             elif zero_copy:
                 # Zero-copy read: hand out a read-only snapshot view of the
@@ -1352,17 +1546,17 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 cache.track_view(desc.segment_name, src)
                 results.append(src)
             else:
-                # Copying instead of keeping the view: release immediately.
-                cache.count_release(desc.segment_name)
-                results.append(src.copy())
+                # Copying instead of keeping the view: release once landed.
+                buf = np.empty(src.shape, src.dtype)
+                pairs.append((buf, src))
+                done.append(
+                    lambda name=desc.segment_name: cache.count_release(name)
+                )
+                results.append(buf)
+        await landing.land_async(pairs, stage="get", config=self.config)
+        for fn in done:
+            fn()
         return results
-
-    @staticmethod
-    def _land(req: Request, src: np.ndarray) -> np.ndarray:
-        if req.destination_view is not None:
-            fast_copy(req.destination_view, src)
-            return req.destination_view
-        return src.copy()
 
     def drop(self) -> None:
         # self.released is NOT re-credited here: unacked batches persist in
@@ -1370,6 +1564,7 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         self.descriptors = {}
         self.objects = {}
         self.inline = {}
+        self.arena_plan = None
         self.released = None
         self.renames = {}
         self._client_segments = {}

@@ -175,11 +175,19 @@ class Request:
         return cls(key=key)
 
     def meta_only(self) -> "Request":
-        """Copy carrying metadata only (never tensor bytes or object payloads)."""
+        """Copy carrying metadata only (never tensor bytes or object
+        payloads). Memoized: one request's meta rides the handshake, the
+        put, and the notify — a many-key batch would otherwise rebuild
+        thousands of identical copies (and re-stringify dtypes) per
+        iteration. The cached copy is immutable by convention: every
+        consumer reads it only."""
+        cached = self.__dict__.get("_meta_only")
+        if cached is not None:
+            return cached
         meta = self.tensor_meta
         if meta is None and self.tensor_val is not None:
             meta = TensorMeta.of(self.tensor_val)
-        return Request(
+        mo = Request(
             key=self.key,
             tensor_val=None,
             tensor_slice=self.tensor_slice,
@@ -187,6 +195,8 @@ class Request:
             is_object=self.is_object,
             tensor_meta=meta,
         )
+        self.__dict__["_meta_only"] = mo
+        return mo
 
     @property
     def nbytes(self) -> int:
@@ -195,6 +205,7 @@ class Request:
     def __getstate__(self):
         state = self.__dict__.copy()
         state["destination_view"] = None
+        state.pop("_meta_only", None)
         return state
 
     def __setstate__(self, state):
