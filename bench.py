@@ -448,6 +448,175 @@ async def many_keys_section(
         await ts.shutdown("bench_keys")
 
 
+async def recovery_section(
+    n_keys: int = 64,
+    key_kb: float = 256,
+    load_hz: float = 20.0,
+) -> dict:
+    """Time-to-heal after a volume kill under load (ISSUE 6): its own
+    3-volume replication-2 fleet publishes a working set, background
+    put/get traffic keeps flowing, one data-holding volume is SIGKILLed,
+    and the section times the self-healing pipeline:
+
+    - ``detect_s``: kill -> the health supervisor quarantines the volume
+      (consecutive-miss heartbeat threshold);
+    - ``first_get_s``: kill -> first successful get of a key the dead
+      volume held (client replica failover — should be near-instant,
+      long before repair);
+    - ``rereplicate_s``: kill -> every working-set key restored to full
+      replication on healthy volumes (automatic, no ts.repair());
+    - ``heal_s``: the total (== rereplicate_s, the last stage to finish).
+    """
+    import os as _os
+
+    import torchstore_tpu as ts
+    from torchstore_tpu import api as ts_api
+    from torchstore_tpu.strategy import LocalRankStrategy
+
+    saved = {
+        k: _os.environ.get(k)
+        for k in (
+            "TORCHSTORE_TPU_HEALTH_INTERVAL_S",
+            "TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD",
+        )
+    }
+    _os.environ["TORCHSTORE_TPU_HEALTH_INTERVAL_S"] = "0.25"
+    _os.environ["TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD"] = "2"
+    try:
+        await ts.initialize(
+            num_storage_volumes=3,
+            strategy=LocalRankStrategy(replication=2),
+            store_name="bench_recovery",
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    stop_load = asyncio.Event()
+    load_task = None
+    try:
+        client = ts.client("bench_recovery")
+        n_elem = max(1, int(key_kb * 1024 // 4))
+        keys = [f"rec/w{i}" for i in range(n_keys)]
+        total = n_keys * n_elem * 4
+        await ts.put_batch(
+            {
+                k: np.random.rand(n_elem).astype(np.float32)
+                for k in keys
+            },
+            store_name="bench_recovery",
+        )
+        located = await client.controller.locate_volumes.call_one(keys)
+        victim = sorted(located[keys[0]])[0]
+        victim_keys = [k for k in keys if victim in located[k]]
+
+        async def load_loop():
+            i = 0
+            while not stop_load.is_set():
+                k = keys[i % n_keys]
+                await ts.put(
+                    k,
+                    np.random.rand(n_elem).astype(np.float32),
+                    store_name="bench_recovery",
+                )
+                await ts.get(k, store_name="bench_recovery")
+                i += 1
+                await asyncio.sleep(1.0 / load_hz)
+
+        load_task = asyncio.ensure_future(load_loop())
+        # Kill the victim the same way tests do: match the mesh process.
+        handle = ts_api._stores["bench_recovery"]
+        vmap = await client.controller.get_volume_map.call_one()
+        target = vmap[victim]["ref"]
+        for idx, ref in enumerate(handle.volume_mesh.refs):
+            if (ref.host, ref.port, ref.name) == (
+                target.host,
+                target.port,
+                target.name,
+            ):
+                proc = handle.volume_mesh._processes[idx]
+                t_kill = time.perf_counter()
+                proc.kill()
+                proc.join(5)
+                break
+        else:
+            raise AssertionError(f"no process for volume {victim!r}")
+
+        # One deadline for the whole healing pipeline: a self-healing
+        # regression must FAIL the section (and the tier-1 smoke test),
+        # not hang it until an opaque outer CI timeout.
+        deadline = time.monotonic() + 120.0
+
+        # First successful post-kill get of a key the victim held.
+        first_get_s = None
+        probe = victim_keys[0]
+        while first_get_s is None:
+            try:
+                await ts.get(probe, store_name="bench_recovery")
+                first_get_s = time.perf_counter() - t_kill
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        "post-kill get never succeeded (failover broken)"
+                    )
+                await asyncio.sleep(0.02)
+
+        detect_s = None
+        while detect_s is None:
+            vh = await ts.volume_health("bench_recovery")
+            if vh[victim]["state"] == "quarantined":
+                detect_s = time.perf_counter() - t_kill
+            elif time.monotonic() > deadline:
+                raise AssertionError(
+                    "supervisor never quarantined the killed volume"
+                )
+            else:
+                await asyncio.sleep(0.05)
+
+        rereplicate_s = None
+        while rereplicate_s is None:
+            loc = await client.controller.locate_volumes.call_one(keys)
+            if all(
+                victim not in loc[k] and len(loc[k]) == 2 for k in keys
+            ):
+                rereplicate_s = time.perf_counter() - t_kill
+            elif time.monotonic() > deadline:
+                raise AssertionError("re-replication did not converge")
+            else:
+                await asyncio.sleep(0.1)
+
+        stop_load.set()
+        await asyncio.gather(load_task, return_exceptions=True)
+        out = {
+            "n_keys": n_keys,
+            "key_kb": key_kb,
+            "total_mb": round(total / 1e6, 1),
+            "victim_keys": len(victim_keys),
+            "detect_s": round(detect_s, 3),
+            "first_get_s": round(first_get_s, 4),
+            "rereplicate_s": round(rereplicate_s, 3),
+            "heal_s": round(rereplicate_s, 3),
+        }
+        print(
+            f"# recovery ({n_keys} x {key_kb:.0f} KB, kill under load): "
+            f"failover get {out['first_get_s']*1e3:.0f} ms, "
+            f"detect {out['detect_s']:.2f} s, "
+            f"heal {out['heal_s']:.2f} s",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        # A deadline AssertionError above must not leak the load loop into
+        # shutdown (puts/gets against a torn-down fleet, unretrieved-task
+        # noise bleeding into the next bench section).
+        stop_load.set()
+        if load_task is not None:
+            await asyncio.gather(load_task, return_exceptions=True)
+        await ts.shutdown("bench_recovery")
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -457,6 +626,8 @@ async def run(
     cold_steady_iters: int = 4,
     many_keys_n: int = 2048,
     many_keys_kb: float = 64,
+    recovery_n_keys: int = 64,
+    recovery_key_kb: float = 256,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -667,6 +838,11 @@ async def run(
     # Many-small-keys section (its own fleet: thousands of tiny entries
     # must not pollute the headline fleet's pools or location caches).
     many_keys = await many_keys_section(n_keys=many_keys_n, key_kb=many_keys_kb)
+    # Recovery section (ISSUE 6): time-to-heal after a volume kill under
+    # load, on its own replicated fleet.
+    recovery = await recovery_section(
+        n_keys=recovery_n_keys, key_kb=recovery_key_kb
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -702,6 +878,11 @@ async def run(
         "many_keys_gbps": many_keys["many_keys_gbps"],
         "per_key_put_us": many_keys["per_key_put_us"],
         "many_keys": many_keys,
+        # ISSUE-6 headline stats at top level; the full section under
+        # "recovery" (detection / failover-get / re-replication timings).
+        "heal_s": recovery["heal_s"],
+        "failover_get_s": recovery["first_get_s"],
+        "recovery": recovery,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -726,6 +907,10 @@ if __name__ == "__main__":
             )
         )
         print(json.dumps(cold_result))
+        sys.exit(0)
+    if "--recovery" in sys.argv:
+        # Standalone recovery run: one JSON line with time-to-heal timings.
+        print(json.dumps(asyncio.run(recovery_section())))
         sys.exit(0)
     result = asyncio.run(run())
     # The headline JSON lands BEFORE the device section: a wedged TPU

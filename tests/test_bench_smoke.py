@@ -30,6 +30,8 @@ async def test_bench_run_tiny(capsys):
         lat_iters=4,
         many_keys_n=16,
         many_keys_kb=4,
+        recovery_n_keys=8,
+        recovery_key_kb=4,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -93,6 +95,14 @@ async def test_bench_run_tiny(capsys):
     assert result["many_keys"]["n_keys"] == 16
     assert result["many_keys"]["put_s"] > 0
 
+    # Recovery section (ISSUE 6): time-to-heal keys at top level, full
+    # timings under "recovery" — a real kill + quarantine + auto-repair.
+    assert result["heal_s"] > 0
+    assert result["failover_get_s"] > 0
+    rec = result["recovery"]
+    assert rec["detect_s"] > 0 and rec["rereplicate_s"] > 0
+    assert rec["victim_keys"] > 0
+
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
     json.dumps(result)
@@ -113,6 +123,26 @@ async def test_bench_many_keys_section_tiny():
     assert out["many_keys_gbps"] > 0
     assert out["per_key_put_us"] > 0
     assert out["put_s"] > 0 and out["get_s"] > 0
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_recovery_section_tiny():
+    """The recovery section standalone (``bench.py --recovery``) at KB
+    scale: a real volume kill under load, supervisor detection, failover
+    get, and automatic re-replication — so time-to-heal can never ship
+    broken."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.recovery_section(n_keys=8, key_kb=4)
+    assert out["detect_s"] > 0
+    assert out["first_get_s"] > 0
+    assert out["rereplicate_s"] >= out["detect_s"]
+    assert out["heal_s"] == out["rereplicate_s"]
     json.dumps(out)
 
 

@@ -550,6 +550,95 @@ def test_landing_copy_pragma(tmp_path):
     assert result.new == []
 
 
+def test_retry_discipline_flags_bare_sleep_retry_loop(tmp_path):
+    """retry-discipline: a constant-delay sleep inside a try-bearing loop is
+    the ad-hoc retry idiom RetryPolicy replaced; policy-derived delays,
+    pacing loops without exception handling, sleep(0) yields, and closures
+    merely DEFINED inside a loop all pass."""
+    from torchstore_tpu.analysis.checkers import retry_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/bad.py": """
+                import asyncio, time
+                async def drain():
+                    while True:
+                        try:
+                            await push()
+                            return
+                        except ConnectionError:
+                            await asyncio.sleep(1.0)  # seeded defect
+                def sync_drain():
+                    for _ in range(3):
+                        try:
+                            return push()
+                        except OSError:
+                            time.sleep(0.5)  # seeded defect
+            """,
+            "torchstore_tpu/good.py": """
+                import asyncio
+                async def drain(policy):
+                    deadline = policy.start()
+                    attempt = 0
+                    while policy.should_retry(attempt, deadline):
+                        try:
+                            await push()
+                            return
+                        except ConnectionError:
+                            await asyncio.sleep(policy.backoff(attempt))
+                            attempt += 1
+                async def pace(interval):
+                    while True:
+                        await asyncio.sleep(interval)  # pacing, no except
+                async def batched():
+                    while True:
+                        try:
+                            await one()
+                        except ValueError:
+                            pass
+                        await asyncio.sleep(0)  # cooperative yield
+                async def definer():
+                    while True:
+                        try:
+                            spawn(lambda: time.sleep(1.0))
+                            async def helper():
+                                await asyncio.sleep(2.0)  # closure: opaque
+                            return helper
+                        except RuntimeError:
+                            raise
+            """,
+        },
+    )
+    findings = retry_discipline.check(project)
+    assert sorted((f.path, f.line) for f in findings) == [
+        ("torchstore_tpu/bad.py", 9),
+        ("torchstore_tpu/bad.py", 15),
+    ]
+
+
+def test_retry_discipline_flags_unregistered_faultpoint(tmp_path):
+    from torchstore_tpu.analysis.checkers import retry_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/sites.py": """
+                from torchstore_tpu import faults
+                async def serve():
+                    await faults.afire("volume.put")       # registered
+                    faults.fire("volume.typo")             # drift
+                    faults.arm("contoller.notify", "raise")  # drift
+                    faults.fire(dynamic_name)              # out of scope
+            """,
+        },
+    )
+    findings = retry_discipline.check(project)
+    assert len(findings) == 2
+    assert all("not in faults.REGISTRY" in f.message for f in findings)
+    assert {f.line for f in findings} == {5, 6}
+
+
 def test_unknown_rule_rejected(tmp_path):
     (tmp_path / "torchstore_tpu").mkdir()
     with pytest.raises(ValueError, match="unknown rule"):

@@ -1,6 +1,14 @@
 """HostStrategy mapping, MoE expert-parallel store round trip, and failure
 behavior (volume death, failed-put consistency) — the strategy x fault axes
-of the reference suite (tests/utils.py strategy params, fault injection)."""
+of the reference suite (tests/utils.py strategy params, fault injection).
+
+Fault injection here rides the deterministic faultpoint framework
+(``torchstore_tpu/faults.py`` + ``ts.inject_fault``): faults are armed
+INSIDE the already-forked volume processes over the control RPC, replacing
+the old idiom of monkeypatching client-side helpers (which could never
+reach a forked volume's put path) — only whole-process kills (SIGKILL/
+SIGSTOP) remain as raw OS operations, since dying is the one fault a
+process cannot inject into itself and keep serving the injection RPC."""
 
 import numpy as np
 import pytest
@@ -223,6 +231,66 @@ async def test_dcn_bind_and_advertise_env():
     finally:
         del os.environ["TORCHSTORE_TPU_BIND_HOST"]
         del os.environ["TORCHSTORE_TPU_ADVERTISE_HOST"]
+
+
+async def test_replicated_put_detaches_faulted_replica():
+    """Regression for the replicated-put detach path, driven by an ARMED
+    faultpoint inside the replica's own process (not a client-side patch):
+    a replica whose put keeps failing is detached in the same notify that
+    indexes the landed copies — readers only ever see volumes holding the
+    new bytes — and the next clean put restores full replication."""
+    from torchstore_tpu.strategy import LocalRankStrategy
+
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="fp_detach",
+    )
+    try:
+        client = ts.client("fp_detach")
+        await client._ensure_setup()
+        targets = [v.volume_id for v in client._put_volumes()]
+        assert len(targets) == 2
+        # Every put to the SECOND target fails until disarmed.
+        await ts.inject_fault(
+            "volume.put", "raise", count=1000, scope=targets[1],
+            store_name="fp_detach",
+        )
+        await ts.put("k", np.arange(8.0, dtype=np.float32), store_name="fp_detach")
+        located = await client.controller.locate_volumes.call_one(["k"])
+        assert set(located["k"]) == {targets[0]}  # faulted replica detached
+        np.testing.assert_array_equal(
+            await ts.get("k", store_name="fp_detach"),
+            np.arange(8.0, dtype=np.float32),
+        )
+        # Disarm; the next put re-replicates onto both targets again.
+        await ts.clear_faults(store_name="fp_detach")
+        await ts.put("k", np.arange(8.0, dtype=np.float32) + 1, store_name="fp_detach")
+        located = await client.controller.locate_volumes.call_one(["k"])
+        assert set(located["k"]) == set(targets)
+    finally:
+        await ts.shutdown("fp_detach")
+
+
+async def test_injected_volume_death_diagnosed_dead():
+    """The 'die' action (os._exit mid-operation, armed over the control
+    RPC) reproduces a real volume crash across the process boundary: the
+    client error carries the controller's 'dead' diagnosis."""
+    await ts.initialize(store_name="fp_die")
+    try:
+        await ts.put("k", np.ones(4), store_name="fp_die")
+        await ts.inject_fault("volume.get", "die", store_name="fp_die")
+        with pytest.raises(ActorDiedError) as exc_info:
+            await ts.get("k", store_name="fp_die")
+        msg = str(exc_info.value)
+        assert "diagnosis" in msg and "dead" in msg
+    finally:
+        from torchstore_tpu import api
+
+        api._stores.pop("fp_die", None)
+        from torchstore_tpu.runtime import stop_singleton
+
+        await stop_singleton("ts_fp_die_controller")
 
 
 async def test_partial_commit_counts_as_exists_but_not_readable():

@@ -12,6 +12,7 @@ from torchstore_tpu.api import (
     DEFAULT_STORE,
     Shard,
     barrier,
+    clear_faults,
     client,
     collect_trace,
     delete,
@@ -25,6 +26,7 @@ from torchstore_tpu.api import (
     get_state_dict,
     initialize,
     initialize_spmd,
+    inject_fault,
     keys,
     metrics_snapshot,
     prewarm,
@@ -34,6 +36,7 @@ from torchstore_tpu.api import (
     repair,
     reset_client,
     shutdown,
+    volume_health,
     wait_for,
 )
 from torchstore_tpu.provision import StateDictManifest
@@ -83,6 +86,7 @@ __all__ = [
     "WeightPublisher",
     "WeightSubscriber",
     "barrier",
+    "clear_faults",
     "client",
     "collect_trace",
     "delete",
@@ -95,6 +99,7 @@ __all__ = [
     "get_state_dict",
     "initialize",
     "initialize_spmd",
+    "inject_fault",
     "keys",
     "metrics_snapshot",
     "prewarm",
@@ -106,5 +111,6 @@ __all__ = [
     "reset_client",
     "shutdown",
     "span",
+    "volume_health",
     "wait_for",
 ]

@@ -17,6 +17,7 @@ from torchstore_tpu.analysis.checkers import (
     landing_copy,
     metric_discipline,
     orphan_task,
+    retry_discipline,
 )
 
 CHECKERS = {
@@ -28,4 +29,5 @@ CHECKERS = {
     env_registry.RULE: env_registry.check,
     metric_discipline.RULE: metric_discipline.check,
     landing_copy.RULE: landing_copy.check,
+    retry_discipline.RULE: retry_discipline.check,
 }

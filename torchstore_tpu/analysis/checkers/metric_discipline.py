@@ -60,6 +60,10 @@ ALLOWED_LABEL_KEYS = {
     "reason",
     "phase",
     "rule",
+    # Faultpoint metrics: one series per (site, action) — both enums are
+    # closed sets in faults.py (REGISTRY, ACTIONS).
+    "point",
+    "action",
 }
 
 

@@ -1,0 +1,151 @@
+"""retry-discipline: retries ride ``config.RetryPolicy``; faultpoint names
+are registered.
+
+Two sub-rules, both grounded in this PR's unification work:
+
+1. **Bare-sleep retry loops.** A ``time.sleep``/``asyncio.sleep`` with a
+   hardcoded (constant) delay inside a loop that also catches exceptions is
+   the ad-hoc retry idiom the unified ``RetryPolicy`` replaced (the reclaim
+   drainer's env-list delays, hardcoded client deadlines). Such loops must
+   derive their schedule from a policy (``policy.backoff(attempt)``) — a
+   computed delay expression is accepted, a numeric literal inside a
+   try-bearing loop is flagged. Sleeps outside loops, or in loops that
+   never catch (pacing loops like the health supervisor's interval sleep),
+   are fine.
+
+2. **Faultpoint name drift.** Every ``faults.fire("...")`` /
+   ``faults.afire("...")`` / ``faults.arm("...")`` call site with a literal
+   name must name a site in ``faults.REGISTRY`` — a typo'd faultpoint never
+   fires, silently turning the chaos test that arms it vacuous. (Names
+   passed as variables are out of scope: the registry check in
+   ``faults.arm`` catches those at runtime, loudly.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name
+
+RULE = "retry-discipline"
+
+_SLEEP_CALLS = ("time.sleep", "asyncio.sleep")
+_FAULT_CALLS = {
+    "faults.fire": 0,
+    "faults.afire": 0,
+    "faults.arm": 0,
+    "fire": 0,
+    "afire": 0,
+}
+
+_SLEEP_MESSAGE = (
+    "hardcoded sleep inside a retry loop: derive the backoff schedule from "
+    "config.RetryPolicy (policy.backoff(attempt) / should_retry) instead of "
+    "an ad-hoc constant delay"
+)
+
+
+def _constant_delay(call: ast.Call) -> bool:
+    if not call.args:
+        return False
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+        # sleep(0) is the cooperative-yield idiom, not a backoff.
+        return arg.value > 0
+    # Unary minus on a literal etc. still counts as hardcoded.
+    if (
+        isinstance(arg, ast.UnaryOp)
+        and isinstance(arg.operand, ast.Constant)
+        and isinstance(arg.operand.value, (int, float))
+    ):
+        return True
+    return False
+
+
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_opaque(root: ast.AST):
+    """ast.walk that does NOT descend into nested function/lambda bodies:
+    a loop that merely DEFINES a retrying closure is not itself the retry
+    loop, and a closure's sleep belongs to the closure's own loops."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _OPAQUE):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _loop_catches(loop: ast.AST) -> bool:
+    """Does this loop body contain a try/except (the retry shape)?"""
+    return any(
+        isinstance(node, ast.Try) and node.handlers
+        for node in _walk_opaque(loop)
+    )
+
+
+def _registry() -> frozenset[str]:
+    from torchstore_tpu.faults import REGISTRY
+
+    return REGISTRY
+
+
+def check(project: Project) -> list[Finding]:
+    registry = _registry()
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith("torchstore_tpu/"):
+            continue
+        if sf.path == "torchstore_tpu/faults.py":
+            continue  # the framework itself (wedge sleeps, registry source)
+        # Collect loops that catch exceptions, then flag constant-delay
+        # sleeps lexically inside them (excluding nested function bodies,
+        # matched by walking each loop with the same opacity rule).
+        retry_loops = [
+            node
+            for node in ast.walk(sf.tree)
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor))
+            and _loop_catches(node)
+        ]
+        flagged: set[int] = set()
+        for loop in retry_loops:
+            for node in _walk_opaque(loop):
+                if (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) in _SLEEP_CALLS
+                    and _constant_delay(node)
+                    and node.lineno not in flagged
+                ):
+                    flagged.add(node.lineno)
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=_SLEEP_MESSAGE,
+                        )
+                    )
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted not in _FAULT_CALLS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant):
+                continue
+            name = node.args[0].value
+            if isinstance(name, str) and name not in registry:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"faultpoint {name!r} is not in faults.REGISTRY:"
+                            " a typo'd site never fires (chaos tests arming"
+                            " it run vacuously)"
+                        ),
+                    )
+                )
+    return findings

@@ -658,6 +658,91 @@ async def fleet_snapshot(
     return doc
 
 
+async def inject_fault(
+    name: str,
+    action: str,
+    count: Optional[int] = None,
+    prob: Optional[float] = None,
+    delay_ms: Optional[float] = None,
+    scope: str = "volumes",
+    store_name: str = DEFAULT_STORE,
+) -> dict:
+    """Arm a deterministic faultpoint across the fleet (test/chaos control
+    plane; see ``torchstore_tpu/faults.py`` for sites and actions).
+
+    ``scope``: ``"client"`` (this process), ``"controller"``, ``"volumes"``
+    (every volume), a specific volume id, or ``"all"``. Arming rides the
+    ``inject_fault`` control RPC, so it reaches ALREADY-RUNNING forked
+    actor processes — the capability the old monkeypatch-per-test idiom
+    never had. Returns ``{target: armed spec}``."""
+    from torchstore_tpu import faults
+
+    c = client(store_name)
+    await c._ensure_setup()
+    kwargs = {"count": count, "prob": prob, "delay_ms": delay_ms}
+    out: dict[str, dict] = {}
+    if scope in ("client", "all"):
+        out["client"] = faults.arm(name, action, **kwargs)
+    if scope in ("controller", "all"):
+        out["controller"] = await c.controller.inject_fault.call_one(
+            name, action, **kwargs
+        )
+    if scope in ("volumes", "all"):
+        targets = list(c._volume_refs)
+    elif scope in c._volume_refs:
+        targets = [scope]
+    elif scope in ("client", "controller"):
+        targets = []
+    else:
+        raise ValueError(
+            f"unknown fault scope {scope!r}; expected 'client', "
+            f"'controller', 'volumes', 'all', or a volume id "
+            f"({sorted(c._volume_refs)})"
+        )
+    for vid in targets:
+        out[f"volume:{vid}"] = await c._volume_refs[
+            vid
+        ].actor.inject_fault.call_one(name, action, **kwargs)
+    return out
+
+
+async def clear_faults(
+    name: Optional[str] = None, store_name: str = DEFAULT_STORE
+) -> int:
+    """Disarm ``name`` (or ALL faultpoints when None) in every reachable
+    fleet process; returns how many armed specs were dropped. Unreachable
+    processes are skipped — a volume a test killed cannot answer."""
+    from torchstore_tpu import faults
+
+    cleared = faults.disarm(name)
+    try:
+        c = client(store_name)
+        await c._ensure_setup()
+    except Exception:  # noqa: BLE001 - no fleet: local disarm is all there is
+        return cleared
+    try:
+        cleared += await c.controller.clear_faults.call_one(name)
+    except Exception:  # noqa: BLE001 - best-effort cleanup
+        pass
+    for vid in list(c._volume_refs):
+        try:
+            cleared += await c._volume_refs[vid].actor.clear_faults.call_one(
+                name
+            )
+        except Exception:  # noqa: BLE001 - dead volumes can't disarm
+            pass
+    return cleared
+
+
+async def volume_health(store_name: str = DEFAULT_STORE) -> dict:
+    """The health supervisor's per-volume view:
+    ``{volume_id: {"state": "ok"|"probation"|"quarantined", "misses",
+    "oks"}}``."""
+    c = client(store_name)
+    await c._ensure_setup()
+    return await c.controller.volume_health.call_one()
+
+
 def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
     """Merge every process's Chrome-trace file (``TORCHSTORE_TPU_TRACE``
     base + pid-suffixed siblings) into ONE Perfetto-loadable timeline with

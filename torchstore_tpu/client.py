@@ -19,6 +19,7 @@ import numpy as np
 from torchstore_tpu import sharding as shd
 from torchstore_tpu import torch_interop
 from torchstore_tpu.config import StoreConfig, default_config
+from torchstore_tpu.faults import FaultInjectedError
 from torchstore_tpu.controller import ObjectType, StorageInfo
 from torchstore_tpu.logging import LatencyTracker, get_logger
 from torchstore_tpu.native import copy_into
@@ -29,7 +30,11 @@ from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
 from torchstore_tpu.transport.buffers import TransportContext
-from torchstore_tpu.transport.factory import create_transport_buffer
+from torchstore_tpu.transport.factory import (
+    TransportType,
+    create_transport_buffer,
+    demotion_ladder,
+)
 from torchstore_tpu.transport.types import (
     OpaqueBlob,
     Request,
@@ -77,6 +82,22 @@ _PLAN_INVALIDATIONS = obs_metrics.counter(
     "ts_plan_cache_invalidations_total",
     "Cached transfer plans dropped, by reason (epoch/capacity)",
 )
+_PUT_RETRIES = obs_metrics.counter(
+    "ts_client_put_retries_total",
+    "Non-replicated put landings retried under the unified RetryPolicy, "
+    "by the transport rung the retry used",
+)
+_FAILOVERS = obs_metrics.counter(
+    "ts_client_failovers_total",
+    "Operations that succeeded only after failing over (get replica "
+    "re-route or put transport demotion), by op",
+)
+
+# The ONE transient-failure family every retry/failover decision keys on:
+# dead/wedged actors (ActorTimeoutError subclasses ActorDiedError), broken
+# transport sockets, and injected chaos faults. Anything else (missing key,
+# shape mismatch, type error) is a real answer and surfaces immediately.
+RETRYABLE_ERRORS = (ActorDiedError, ConnectionError, OSError, FaultInjectedError)
 
 
 class SyncPlanCache:
@@ -195,6 +216,22 @@ class LocalClient:
         # healthy replicas, so a replicated key survives a volume death
         # transparently (cleared when a later health check reports ok).
         self._dead_volumes: set[str] = set()
+        # Last full-fleet diagnosis (monotonic timestamp + statuses): the
+        # retry loops can fail many attempts per second during a correlated
+        # outage, and each _raise_with_diagnosis would otherwise trigger a
+        # controller-side ping fan-out across EVERY volume — one diagnosis
+        # per window serves the whole loop.
+        self._diag_at: float = 0.0
+        self._diag_statuses: dict[str, str] = {}
+        # Volumes the CONTROLLER's health supervisor has quarantined: puts
+        # route around them and get ordering deprioritizes them. Refreshed
+        # lazily after any placement-epoch bump (quarantine/reinstatement
+        # transitions always bump the epoch).
+        self._avoid_volumes: set[str] = set()
+        self._volumes_stale = False
+        # Epoch tracking when the plan cache is disabled (the cache tracks
+        # it itself otherwise).
+        self._seen_epoch: Optional[int] = None
         # Bumped whenever the volume map is dropped as stale (repair
         # replaced actors); _fetch retries once after any bump.
         self._refresh_epoch = 0
@@ -242,9 +279,35 @@ class LocalClient:
     def _observe_epoch(self, epoch: Optional[int]) -> None:
         """Adopt a controller placement epoch from any RPC reply; a bump
         drops cached plans AND cached locations together (both describe the
-        placement that just changed)."""
-        if self.plan_cache is not None and self.plan_cache.observe_epoch(epoch):
+        placement that just changed) and marks the health view stale —
+        quarantine/reinstatement transitions always bump the epoch, so the
+        next put re-reads volume health before selecting targets."""
+        if epoch is None:
+            return
+        bumped = False
+        if self.plan_cache is not None:
+            bumped = self.plan_cache.observe_epoch(epoch)
+        elif self._seen_epoch is not None and epoch != self._seen_epoch:
+            bumped = True
+        self._seen_epoch = epoch
+        if bumped:
             self._loc_cache.clear()
+            self._volumes_stale = True
+
+    async def _refresh_health(self) -> None:
+        """Re-read the controller's per-volume health (one cheap RPC, only
+        after an epoch bump): quarantined volumes go into the avoid set so
+        puts route around them and get ordering deprioritizes them."""
+        self._volumes_stale = False
+        try:
+            vmap = await self._controller.get_volume_map.call_one()
+        except RETRYABLE_ERRORS:  # controller hiccup: keep the stale view
+            return
+        self._avoid_volumes = {
+            vid
+            for vid, info in vmap.items()
+            if info.get("health") == "quarantined"
+        }
 
     async def placement_epoch(self) -> int:
         """Fetch + adopt the controller's current placement epoch (one
@@ -266,12 +329,15 @@ class LocalClient:
         volume: StorageVolumeRef,
         requests: list[Request],
         plan_hint: Optional[dict] = None,
+        transport: Optional[TransportType] = None,
     ) -> dict[str, int]:
         """Data-plane landing of ``requests`` on one volume (batched where
         the transport supports it) — shared by put_batch and replicate_to.
-        Returns the volume-assigned per-key write generations, forwarded to
-        the controller so stale-replica reclaims can delete conditionally."""
-        buffer = create_transport_buffer(volume, self._config)
+        ``transport`` forces a specific rung (the put retry's demotion
+        ladder). Returns the volume-assigned per-key write generations,
+        forwarded to the controller so stale-replica reclaims can delete
+        conditionally."""
+        buffer = create_transport_buffer(volume, self._config, force=transport)
         buffer.plan_hint = plan_hint
         if buffer.supports_batch_puts:
             await buffer.put_to_storage_volume(volume, requests)
@@ -279,20 +345,37 @@ class LocalClient:
         await buffer.put_to_storage_volume(volume, requests[:1])
         gens = dict(buffer.write_gens or {})
         for req in requests[1:]:
-            b = create_transport_buffer(volume, self._config)
+            b = create_transport_buffer(volume, self._config, force=transport)
             await b.put_to_storage_volume(volume, [req])
             gens.update(b.write_gens or {})
         return gens
 
     def _put_volumes(self) -> list[StorageVolumeRef]:
-        """Every volume a put writes to (primary + replicas)."""
+        """Every volume a put writes to (primary + replicas). The strategy
+        selects against the FULL volume list (strategies like
+        LocalRankStrategy key on the client's own id being present); any
+        selected volume that is quarantined or client-observed-dead is then
+        substituted with a healthy unselected volume. With no healthy spare
+        the avoided volume stays (degraded put: land on whoever answers,
+        detach the rest) rather than starving the write."""
         client_id = self._strategy.get_client_id()
-        return [
-            self._volume_refs[vid]
-            for vid in self._strategy.select_put_volume_ids(
+        selected = list(
+            self._strategy.select_put_volume_ids(
                 client_id, list(self._volume_refs)
             )
-        ]
+        )
+        avoid = self._avoid_volumes | self._dead_volumes
+        if avoid and any(vid in avoid for vid in selected):
+            spares = sorted(
+                vid
+                for vid in self._volume_refs
+                if vid not in avoid and vid not in selected
+            )
+            selected = [
+                spares.pop(0) if vid in avoid and spares else vid
+                for vid in selected
+            ]
+        return [self._volume_refs[vid] for vid in selected]
 
     # ------------------------------------------------------------------
     # put
@@ -362,6 +445,8 @@ class LocalClient:
         self, items: dict[str, Any], sp, plan_hint: Optional[dict] = None
     ) -> int:
         await self._ensure_setup()
+        if self._volumes_stale:
+            await self._refresh_health()
         tracker = LatencyTracker("put_batch")
         # Issue every device->host copy for the WHOLE batch up front so
         # transfers overlap across arrays too, not just across one array's
@@ -389,23 +474,116 @@ class LocalClient:
                 # machinery see one exception family.
                 await self._raise_with_diagnosis(volume.volume_id, exc)
 
-        # Replicated puts hit every target volume concurrently.
-        # return_exceptions: every write FINISHES before we decide (no
-        # detached sibling tasks racing a caller's retry, no unretrieved
-        # exceptions).
-        results = await asyncio.gather(
-            *(put_to(v) for v in volumes), return_exceptions=True
-        )
-        landed = [
-            (v, r)
-            for v, r in zip(volumes, results)
-            if not isinstance(r, BaseException)
-        ]
-        failed = [
-            (v, r)
-            for v, r in zip(volumes, results)
-            if isinstance(r, BaseException)
-        ]
+        async def land_all() -> tuple[list, list]:
+            # Replicated puts hit every target volume concurrently.
+            # return_exceptions: every write FINISHES before we decide (no
+            # detached sibling tasks racing a caller's retry, no
+            # unretrieved exceptions).
+            results = await asyncio.gather(
+                *(put_to(v) for v in volumes), return_exceptions=True
+            )
+            return (
+                [
+                    (v, r)
+                    for v, r in zip(volumes, results)
+                    if not isinstance(r, BaseException)
+                ],
+                [
+                    (v, r)
+                    for v, r in zip(volumes, results)
+                    if isinstance(r, BaseException)
+                ],
+            )
+
+        landed, failed = await land_all()
+        if (
+            not landed
+            and len(volumes) > 1
+            and all(isinstance(r, RETRYABLE_ERRORS) for _, r in failed)
+        ):
+            # EVERY replica failed transiently (correlated chaos, a fleet-
+            # wide hiccup): a partial failure would detach-and-continue,
+            # but with zero landed copies there is nothing to commit —
+            # retry the whole replicated landing under the unified policy.
+            policy = self._config.retry
+            deadline = policy.start()
+            attempt = 0
+            while not landed and policy.should_retry(attempt, deadline):
+                await asyncio.sleep(policy.backoff(attempt))
+                attempt += 1
+                # Re-resolve placement each attempt: the supervisor may
+                # have quarantined the failed replicas meanwhile, or the
+                # diagnosis marked them dead — _put_volumes substitutes
+                # healthy spares for both, and land_all reads the rebound
+                # list (the supersede notify detaches whatever the old
+                # replicas still hold under these keys).
+                if self._volumes_stale:
+                    await self._refresh_health()
+                fresh = self._put_volumes()
+                if {v.volume_id for v in fresh} != {
+                    v.volume_id for v in volumes
+                }:
+                    logger.warning(
+                        "replicated put re-routed: %s -> %s",
+                        sorted(v.volume_id for v in volumes),
+                        sorted(v.volume_id for v in fresh),
+                    )
+                    volumes = fresh
+                landed, retry_failed = await land_all()
+                if landed:
+                    failed = retry_failed
+                    _FAILOVERS.inc(op="put")
+                    logger.warning(
+                        "replicated put recovered on retry %d (first "
+                        "failure: %s)",
+                        attempt,
+                        failed[0][1] if failed else "all replicas",
+                    )
+                elif not all(
+                    isinstance(r, RETRYABLE_ERRORS) for _, r in retry_failed
+                ):
+                    failed = retry_failed
+                    break  # a real (non-transient) answer surfaced
+        if not landed and len(volumes) == 1:
+            # Non-replicated put: no sibling replica absorbs the failure,
+            # so retry transient transport failures under the unified
+            # RetryPolicy, demoting one transport rung per attempt
+            # (shm -> bulk -> rpc). Volumes the controller diagnosed
+            # dead/wedged/quarantined are NOT retried here — no transport
+            # reaches a dead process (put_to's diagnosis populated
+            # _dead_volumes before we got here).
+            gens = await self._retry_put_demoted(
+                volumes[0], requests, failed[0][1]
+            )
+            if gens is not None:
+                landed, failed = [(volumes[0], gens)], []
+            elif isinstance(failed[0][1], RETRYABLE_ERRORS):
+                # The target itself is gone (diagnosed dead/wedged): re-
+                # resolve placement — _put_volumes now filters it out — and
+                # land on the next healthy volume. The supersede notify
+                # below detaches whatever the dead volume still holds under
+                # these keys, so its stale bytes can never resurface if it
+                # is later reinstated.
+                if self._volumes_stale:
+                    await self._refresh_health()
+                retry = self._put_volumes()
+                if retry and retry[0].volume_id != volumes[0].volume_id:
+                    try:
+                        gens = await self._land_requests(retry[0], requests)
+                    except RETRYABLE_ERRORS as exc:
+                        logger.warning(
+                            "put failover to %s failed too: %s",
+                            retry[0].volume_id,
+                            exc,
+                        )
+                    else:
+                        landed, failed = [(retry[0], gens)], []
+                        _FAILOVERS.inc(op="put")
+                        logger.warning(
+                            "put failed over from %s to %s",
+                            volumes[0].volume_id,
+                            retry[0].volume_id,
+                        )
         if not landed:
             raise failed[0][1]
         tracker.track_step("data_plane", nbytes)
@@ -431,6 +609,11 @@ class LocalClient:
             [v.volume_id for v, _ in landed],
             detach_volume_ids=[v.volume_id for v, _ in failed] or None,
             write_gens={v.volume_id: gens for v, gens in landed},
+            # Full overwrite: any volume OUTSIDE this put's replica set
+            # still indexed for these metas (an auto-repair extra copy, or
+            # a previous placement before failover re-routed) holds
+            # superseded bytes — detach + reclaim them in the same step.
+            supersede=True,
         )
         # The notify reply carries the placement epoch for free: a bump
         # (structural change anywhere in the fleet) drops cached plans.
@@ -438,6 +621,59 @@ class LocalClient:
         tracker.track_step("notify")
         tracker.log_summary()
         return nbytes
+
+    async def _retry_put_demoted(
+        self,
+        volume: StorageVolumeRef,
+        requests: list[Request],
+        first_exc: BaseException,
+    ) -> Optional[dict[str, int]]:
+        """Retry a failed single-volume landing under ``config.retry``,
+        walking down the transport ladder one rung per attempt. Returns the
+        write generations on success, None when the policy is exhausted or
+        the volume is diagnosed dead (caller surfaces ``first_exc``)."""
+        if not isinstance(first_exc, RETRYABLE_ERRORS):
+            return None
+        if volume.volume_id in self._dead_volumes:
+            return None
+        policy = self._config.retry
+        deadline = policy.start()
+        ladder = demotion_ladder(volume, self._config)
+        attempt = 0
+        while policy.should_retry(attempt, deadline):
+            await asyncio.sleep(policy.backoff(attempt))
+            rung = ladder[min(attempt + 1, len(ladder) - 1)]
+            try:
+                # plan_hint deliberately dropped: it describes the rung
+                # that just failed (e.g. an shm arena layout).
+                gens = await self._land_requests(
+                    volume, requests, transport=rung
+                )
+            except RETRYABLE_ERRORS as exc:
+                attempt += 1
+                logger.warning(
+                    "put retry %d on %s over %s failed: %s",
+                    attempt,
+                    volume.volume_id,
+                    rung.value,
+                    exc,
+                )
+                if volume.volume_id in self._dead_volumes:
+                    return None
+                continue
+            _PUT_RETRIES.inc(transport=rung.value)
+            _FAILOVERS.inc(op="put")
+            logger.warning(
+                "non-replicated put to %s recovered on transport %s after "
+                "%d retr%s (first failure: %s)",
+                volume.volume_id,
+                rung.value,
+                attempt + 1,
+                "y" if attempt == 0 else "ies",
+                first_exc,
+            )
+            return gens
+        return None
 
     # ------------------------------------------------------------------
     # get
@@ -583,30 +819,67 @@ class LocalClient:
     # ------------------------------------------------------------------
 
     async def _fetch(self, requests: list[Request]) -> list[Any]:
-        epoch = self._refresh_epoch
-        try:
-            return await self._fetch_once(requests, use_cache=True)
-        except (KeyError, ValueError, ActorDiedError) as exc:
-            # Stale state (another client deleted/re-published a key, a
-            # volume died and the key lives elsewhere, or repair replaced
-            # an actor our refs predate): drop the batch's cached
-            # locations and retry once fresh. KeyError covers missing
-            # keys/shards; ValueError covers layout mismatches surfacing
-            # as shape errors; ActorDiedError covers dead/stale refs; an
-            # epoch bump means the diagnosis already refreshed the volume
-            # map for us.
-            stale = [r.key for r in requests if r.key in self._loc_cache]
-            if not stale and self._refresh_epoch == epoch:
-                raise
-            for key in stale:
-                self._loc_cache.pop(key, None)
-            _FETCH_RETRIES.inc()
-            logger.info(
-                "stale location/refs for %d key(s) (%s); re-locating",
-                len(stale),
-                exc,
-            )
-            return await self._fetch_once(requests, use_cache=False)
+        """Fetch with two retry families layered on ``_fetch_once``:
+
+        - *Stale state* (KeyError/ValueError: another client deleted or
+          re-published a key, layout mismatch): ONE fresh retry — a missing
+          key is an answer, not a transient, so no backoff loop.
+        - *Transient* (dead/wedged actors, broken sockets, injected
+          faults): retries under the unified RetryPolicy. Each failure's
+          diagnosis marks unhealthy volumes, so the re-located retry fails
+          over to the next healthy replica; retries continue only while a
+          volume this client has NOT seen fail remains (when every volume
+          is known-dead, waiting out the deadline helps nobody — surface)."""
+        policy = self._config.retry
+        deadline = policy.start()
+        attempt = 0
+        stale_retried = False
+        while True:
+            epoch = self._refresh_epoch
+            try:
+                out = await self._fetch_once(
+                    requests, use_cache=attempt == 0 and not stale_retried
+                )
+                if attempt > 0:
+                    _FAILOVERS.inc(op="get")
+                return out
+            except RETRYABLE_ERRORS as exc:
+                for req in requests:
+                    self._loc_cache.pop(req.key, None)
+                alive = [
+                    v
+                    for v in (self._volume_refs or {})
+                    if v not in self._dead_volumes
+                ]
+                if not alive and attempt > 0:
+                    raise  # whole fleet diagnosed down: nothing to fail over to
+                if not policy.should_retry(attempt, deadline):
+                    raise
+                _FETCH_RETRIES.inc()
+                logger.warning(
+                    "fetch attempt %d failed (%s); failing over "
+                    "(%d healthy volume(s) remain)",
+                    attempt + 1,
+                    exc,
+                    len(alive),
+                )
+                await asyncio.sleep(policy.backoff(attempt))
+                attempt += 1
+            except (KeyError, ValueError) as exc:
+                stale = [r.key for r in requests if r.key in self._loc_cache]
+                if stale_retried or (
+                    not stale and self._refresh_epoch == epoch
+                ):
+                    raise
+                stale_retried = True
+                for key in stale:
+                    self._loc_cache.pop(key, None)
+                _FETCH_RETRIES.inc()
+                logger.info(
+                    "stale location/refs for %d key(s) (%s); re-locating",
+                    len(stale),
+                    exc,
+                )
 
     async def _fetch_once(
         self, requests: list[Request], use_cache: bool
@@ -696,14 +969,34 @@ class LocalClient:
         health-check the fleet and re-raise with the diagnosis attached
         (dead vs wedged vs healthy-but-slow is actionable for operators).
         The failed volume is remembered so retried gets prefer healthy
-        replicas; volumes the health check clears are forgiven."""
+        replicas; volumes the health check clears are forgiven. The fleet
+        fan-out runs at most once per 2 s window: retry loops under a
+        correlated outage reuse the cached verdict instead of pinging
+        every volume on every failed attempt."""
+        import time as _time
+
         self._dead_volumes.add(vid)
+        now = _time.monotonic()
+        if now - self._diag_at < 2.0:
+            cached = self._diag_statuses.get(vid)
+            if cached is None or cached == "ok":
+                # _dead_volumes means CONTROLLER-confirmed dead (it gates
+                # the put demotion retry and replicated re-routing): a
+                # failure the last fan-out didn't confirm stays retryable.
+                self._dead_volumes.discard(vid)
+            raise ActorDiedError(
+                f"storage volume {vid!r} RPC failed: {exc} "
+                f"[controller diagnosis (cached): "
+                f"{cached or 'not in last health check'}]"
+            ) from exc
+        self._diag_at = now
         diagnosis = "controller unreachable"
         try:
             statuses = await self._controller.check_volumes.with_timeout(
                 15.0
             ).call_one(timeout=5.0)
             diagnosis = statuses.get(vid, "unknown volume")
+            self._diag_statuses = statuses
             self._dead_volumes = {
                 v for v, status in statuses.items() if status != "ok"
             }
@@ -755,11 +1048,16 @@ class LocalClient:
             pass
         # Prefer healthy volumes first (replica failover), then this
         # client's own volume, then stable order (locality). Known-dead
-        # volumes stay as a last resort: if they hold the only copy the
-        # fetch still tries them and surfaces the real error.
+        # and supervisor-quarantined volumes stay as a last resort: if
+        # they hold the only copy the fetch still tries them and surfaces
+        # the real error.
         ordered = sorted(
             infos,
-            key=lambda v: (v in self._dead_volumes, v != own_id, v),
+            key=lambda v: (
+                v in self._dead_volumes or v in self._avoid_volumes,
+                v != own_id,
+                v,
+            ),
         )
 
         if any_info.object_type == ObjectType.OBJECT:
@@ -893,12 +1191,30 @@ class LocalClient:
         await self._ensure_setup()
         # Notify-before-delete ordering (invariant 1 delete path).
         by_volume = await self._controller.notify_delete_batch.call_one(keys)
-        await asyncio.gather(
+        ordered = sorted(by_volume.items())
+        results = await asyncio.gather(
             *(
                 self._volume_refs[vid].actor.delete_batch.call_one(vkeys)
-                for vid, vkeys in by_volume.items()
-            )
+                for vid, vkeys in ordered
+            ),
+            return_exceptions=True,
         )
+        for (vid, vkeys), result in zip(ordered, results):
+            if isinstance(result, RETRYABLE_ERRORS):
+                # The keys are already de-indexed (notify above), so a
+                # dead/wedged volume only strands unreachable bytes — a
+                # GC-during-failure must not kill the caller over them
+                # (process exit reclaims memory-backed volumes; durable
+                # backends reconcile on rebuild).
+                logger.warning(
+                    "delete of %d key(s) on unreachable volume %s skipped "
+                    "(%s); bytes reclaimed when the volume exits/rebuilds",
+                    len(vkeys),
+                    vid,
+                    result,
+                )
+            elif isinstance(result, BaseException):
+                raise result
         for key in keys:
             self._ctx.delete_key(key)
             self._loc_cache.pop(key, None)

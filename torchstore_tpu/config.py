@@ -11,7 +11,10 @@ and user code can override programmatically via ``initialize(config=...)``.
 from __future__ import annotations
 
 import os
+import random
+import time
 from dataclasses import dataclass, field, replace
+from typing import Optional
 
 
 @dataclass(frozen=True)
@@ -139,7 +142,39 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
     EnvVar("TORCHSTORE_TPU_RECLAIM_DELAYS", "str", None,
            "Comma-separated backoff delays, seconds, for the controller's "
            "stale-replica reclaim drainer (default 1,5,15,60; malformed "
-           "values fall back)."),
+           "values fall back). Parsed into an explicit-delays RetryPolicy."),
+    # --- self-healing: health supervisor + retry/failover -------------------
+    EnvVar("TORCHSTORE_TPU_HEALTH_INTERVAL_S", "float", 2.0,
+           "Controller heartbeat period, seconds: every interval the health "
+           "supervisor pings every volume. <= 0 disables the supervisor "
+           "(quarantine and auto-repair never trigger)."),
+    EnvVar("TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD", "int", 3,
+           "Consecutive missed heartbeats that quarantine a volume; the "
+           "same count of consecutive successful pings reinstates a "
+           "quarantined volume through probation."),
+    EnvVar("TORCHSTORE_TPU_AUTO_REPAIR", "bool", True,
+           "Quarantining a volume automatically re-replicates every key it "
+           "held that still has a healthy copy onto healthy volumes "
+           "(volume-to-volume, no client involvement). Off: quarantine "
+           "only, redundancy stays degraded until ts.repair()."),
+    EnvVar("TORCHSTORE_TPU_FAULTPOINTS", "str", None,
+           "Arm deterministic fault injection at named sites, e.g. "
+           "'volume.put=raise:count=2;actor.ping=wedge'. Parsed at process "
+           "start (and after fork) in every store process; see "
+           "torchstore_tpu/faults.py for the site registry and actions. "
+           "Test/chaos tooling only — leave unset in production."),
+    EnvVar("TORCHSTORE_TPU_RETRY_BASE_S", "float", 0.05,
+           "Unified RetryPolicy: first backoff delay, seconds."),
+    EnvVar("TORCHSTORE_TPU_RETRY_MAX_S", "float", 2.0,
+           "Unified RetryPolicy: backoff ceiling, seconds."),
+    EnvVar("TORCHSTORE_TPU_RETRY_MULTIPLIER", "float", 2.0,
+           "Unified RetryPolicy: exponential backoff multiplier."),
+    EnvVar("TORCHSTORE_TPU_RETRY_JITTER", "float", 0.1,
+           "Unified RetryPolicy: fraction of each delay randomized "
+           "(de-synchronizes fleet-wide retry storms)."),
+    EnvVar("TORCHSTORE_TPU_RETRY_DEADLINE_S", "float", 30.0,
+           "Unified RetryPolicy: total retry budget per logical operation, "
+           "seconds; the first failure after the deadline surfaces."),
     # --- bench --------------------------------------------------------------
     EnvVar("TORCHSTORE_TPU_BENCH_COLD_MB", "int", None,
            "bench.py cold-path working-set size in MB (default scales with "
@@ -177,6 +212,87 @@ def _env_int(name: str, default: int) -> int:
 
 def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
+
+
+def _env_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    return float(val) if val is not None else default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """The ONE retry/backoff vocabulary for the whole store.
+
+    Every layer that retries — client get failover, non-replicated put
+    transport demotion, weight-channel publish/acquire survival, the
+    controller's stale-replica reclaim drainer — derives its schedule from
+    an instance of this type instead of inventing env-list parsing or
+    hardcoded deadlines (enforced by the ``retry-discipline`` tslint rule).
+
+    Delay for attempt ``i`` (0-based) is ``min(max_s, base_s *
+    multiplier**i)`` with ``jitter`` fraction of it randomized, unless
+    ``delays`` pins an explicit schedule (then the schedule IS the attempt
+    budget). ``deadline_s`` bounds the TOTAL time spent retrying one
+    logical operation: the first failure after the deadline surfaces.
+    Frozen + picklable: it rides StoreConfig through actor RPCs."""
+
+    base_s: float = 0.05
+    max_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    deadline_s: float = 30.0
+    # Explicit delay schedule (seconds). When set, backoff() indexes into it
+    # and attempts are capped at len(delays); the reclaim drainer's
+    # TORCHSTORE_TPU_RECLAIM_DELAYS compatibility rides this.
+    delays: Optional[tuple[float, ...]] = None
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            base_s=_env_float("TORCHSTORE_TPU_RETRY_BASE_S", 0.05),
+            max_s=_env_float("TORCHSTORE_TPU_RETRY_MAX_S", 2.0),
+            multiplier=_env_float("TORCHSTORE_TPU_RETRY_MULTIPLIER", 2.0),
+            jitter=_env_float("TORCHSTORE_TPU_RETRY_JITTER", 0.1),
+            deadline_s=_env_float("TORCHSTORE_TPU_RETRY_DEADLINE_S", 30.0),
+        )
+
+    @classmethod
+    def from_delays(
+        cls, delays, deadline_s: Optional[float] = None
+    ) -> "RetryPolicy":
+        delays = tuple(float(d) for d in delays)
+        if not delays:
+            raise ValueError("explicit delay schedule must not be empty")
+        return cls(
+            deadline_s=sum(delays) * 2 if deadline_s is None else deadline_s,
+            delays=delays,
+        )
+
+    @property
+    def max_attempts(self) -> Optional[int]:
+        """Bound on RETRIES (not first attempts): None = deadline-limited."""
+        return len(self.delays) if self.delays is not None else None
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (0-based), jittered."""
+        if self.delays is not None:
+            delay = self.delays[min(attempt, len(self.delays) - 1)]
+        else:
+            delay = min(self.max_s, self.base_s * self.multiplier**attempt)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(0.0, delay)
+
+    def start(self) -> float:
+        """Monotonic deadline for one logical operation's retry budget."""
+        return time.monotonic() + self.deadline_s
+
+    def should_retry(self, attempt: int, deadline: float) -> bool:
+        """Whether retry ``attempt`` (0-based) may still run: within both
+        the attempt cap (explicit schedules) and the time budget."""
+        if self.delays is not None and attempt >= len(self.delays):
+            return False
+        return time.monotonic() < deadline
 
 
 def _default_shm_pool_cap() -> int:
@@ -306,6 +422,11 @@ class StoreConfig:
             _env_str("TORCHSTORE_TPU_DIRECT_SETTLE_TIMEOUT", "30")
         )
     )
+
+    # --- retry / failover ---------------------------------------------------
+    # The unified retry policy every layer derives backoff schedules from
+    # (client failover, put transport demotion, publish/acquire survival).
+    retry: RetryPolicy = field(default_factory=RetryPolicy.from_env)
 
     # --- logging ------------------------------------------------------------
     log_level: str = field(

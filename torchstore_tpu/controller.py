@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Optional
 
+from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.runtime import Actor, ActorRef, endpoint
@@ -44,6 +45,18 @@ _RECLAIMED = obs_metrics.counter(
 _PREWARM_RESERVED = obs_metrics.gauge(
     "ts_prewarm_reserved_bytes",
     "tmpfs bytes held by live prewarm reservations, per volume",
+)
+_VOLUME_HEALTH = obs_metrics.gauge(
+    "ts_volume_health",
+    "Supervisor view of each volume: 1 healthy, 0.5 probation, 0 quarantined",
+)
+_QUARANTINES = obs_metrics.counter(
+    "ts_quarantines_total",
+    "Volumes moved to quarantine by the health supervisor",
+)
+_AUTO_REPAIRS = obs_metrics.counter(
+    "ts_auto_repairs_total",
+    "Keys re-replicated automatically after a quarantine",
 )
 
 
@@ -183,6 +196,30 @@ class Controller(Actor):
         self._pending_reclaims: dict[str, dict[str, int]] = {}
         self._reclaim_running: set = set()
         self._reclaim_tasks: set = set()
+        # Health supervisor state: per-volume heartbeat bookkeeping. A
+        # volume is 'ok' | 'probation' (answered pings again after a
+        # quarantine; not yet trusted) | 'quarantined' (missed
+        # consecutive-miss-threshold heartbeats: placement skips it, reads
+        # are served from healthy replicas, and — with auto-repair on — its
+        # keys re-replicate onto healthy volumes). One supervisor task,
+        # started by init(), cancelled at teardown.
+        self._vol_health: dict[str, dict] = {}
+        self._health_task = None
+        self._health_tasks: set = set()
+        # Volumes with an auto re-replication pass in flight (one per
+        # quarantine event; a flapping volume must not stack repairs).
+        self._repairing: set[str] = set()
+        import os
+
+        self._health_interval = float(
+            os.environ.get("TORCHSTORE_TPU_HEALTH_INTERVAL_S", 2.0)
+        )
+        self._miss_threshold = max(
+            1, int(os.environ.get("TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD", 3))
+        )
+        self._auto_repair = os.environ.get(
+            "TORCHSTORE_TPU_AUTO_REPAIR", "1"
+        ).strip().lower() not in ("0", "false", "no", "off", "")
         # Prewarm capacity reservations: rid -> (monotonic expiry,
         # {volume_id: granted bytes}). Grants are counted against volume
         # tmpfs headroom so CONCURRENT prewarms (several trainers booting on
@@ -226,6 +263,13 @@ class Controller(Actor):
                 )
             self.volume_refs[vid] = ref
             self.volume_hostnames[vid] = info["hostname"]
+        self._vol_health = {
+            vid: {"state": "ok", "misses": 0, "oks": 0}
+            for vid in self.volume_refs
+        }
+        for vid in self.volume_refs:
+            _VOLUME_HEALTH.set(1, volume=vid)
+        self._start_supervisor()
         return {
             "volume_ids": sorted(self.volume_refs),
             "hostnames": self.volume_hostnames,
@@ -234,7 +278,11 @@ class Controller(Actor):
     @endpoint
     async def get_volume_map(self) -> dict[str, dict]:
         return {
-            vid: {"ref": ref, "hostname": self.volume_hostnames[vid]}
+            vid: {
+                "ref": ref,
+                "hostname": self.volume_hostnames[vid],
+                "health": self._vol_health.get(vid, {}).get("state", "ok"),
+            }
             for vid, ref in self.volume_refs.items()
         }
 
@@ -260,6 +308,25 @@ class Controller(Actor):
         expected = math.prod(mesh_shape) if mesh_shape else 0
         return "committed" if len(coords) >= expected else "partial"
 
+    def _covers(
+        self,
+        subset: dict[str, StorageInfo],
+        full: dict[str, StorageInfo],
+    ) -> bool:
+        """Whether ``subset``'s replicas serve everything ``full``'s do.
+        Non-sharded entries are full copies, so any surviving replica
+        covers; sharded keys compare the UNION of stored coordinates."""
+        any_info = next(iter(full.values()))
+        if any_info.object_type != ObjectType.TENSOR_SLICE:
+            return True
+        sub_coords: set[tuple] = set()
+        for info in subset.values():
+            sub_coords.update(info.tensor_slices.keys())
+        full_coords: set[tuple] = set()
+        for info in full.values():
+            full_coords.update(info.tensor_slices.keys())
+        return sub_coords >= full_coords
+
     # ---- endpoints -------------------------------------------------------
 
     @endpoint
@@ -269,8 +336,10 @@ class Controller(Actor):
         missing_ok: bool = False,
         require_fully_committed: bool = True,
     ) -> dict[str, dict[str, StorageInfo]]:
+        await faults.afire("controller.locate")
         self.counters["locates"] += len(keys)
         _LOCATES.inc(len(keys))
+        quarantined = self._quarantined_ids()
         out: dict[str, dict[str, StorageInfo]] = {}
         for key in keys:
             infos = self.index.get(key)
@@ -283,6 +352,22 @@ class Controller(Actor):
                     f"Key {key!r} is only partially committed; not all mesh "
                     "coordinates have been stored yet"
                 )
+            if quarantined and any(vid in quarantined for vid in infos):
+                # Readers skip quarantined replicas whenever the healthy
+                # subset alone still serves everything the full set does
+                # (shard-coordinate coverage, not just the coarse
+                # committed/partial label — a quarantined volume holding
+                # the only copy of SOME shard of a partially-committed key
+                # must stay listed). A quarantined volume holding the ONLY
+                # copy stays listed: the client tries it and surfaces the
+                # real failure rather than a bogus missing-key.
+                healthy = {
+                    vid: info
+                    for vid, info in infos.items()
+                    if vid not in quarantined
+                }
+                if healthy and self._covers(healthy, infos):
+                    infos = healthy
             out[key] = infos
         return out
 
@@ -300,6 +385,7 @@ class Controller(Actor):
         volume_id: "str | list[str]",
         detach_volume_ids: Optional[list[str]] = None,
         write_gens: Optional[dict[str, dict[str, int]]] = None,
+        supersede: bool = False,
     ) -> None:
         """Index ``metas`` as stored on ``volume_id`` — a single id, or a
         LIST of ids for replicated puts (one RPC, one generation bump, and
@@ -314,7 +400,17 @@ class Controller(Actor):
 
         ``write_gens``: {volume_id: {key: gen}} — the volume-assigned write
         generations from the data-plane acks; indexed per replica so later
-        reclaims of this copy can be made conditional."""
+        reclaims of this copy can be made conditional.
+
+        ``supersede``: this notify is a full overwrite of each meta (a
+        normal client put that landed on EVERY replica the strategy chose):
+        any OTHER volume still indexed for the same meta now holds
+        superseded bytes under committed metadata — e.g. an extra copy an
+        auto-repair re-replicated while its home volume was quarantined —
+        and is detached + reclaimed in the same indexing step. Must stay
+        False for partial writers (``replicate_to``/repair, which add
+        copies without touching the others)."""
+        await faults.afire("controller.notify")
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         stale_gens: dict[str, dict[str, int]] = {}
         structural = bool(detach_volume_ids)
@@ -399,6 +495,24 @@ class Controller(Actor):
                 else:
                     stale_gens.setdefault(vid, {}).setdefault(meta.key, -1)
                 self._detach_meta(meta, vid)
+            if supersede:
+                # Full overwrite: volumes outside this put's replica set
+                # that still hold THIS meta (same coordinates for shards,
+                # the whole entry otherwise) now carry superseded bytes —
+                # detach them here, reclaim their bytes in the background.
+                for vid in [v for v in list(infos) if v not in volume_ids]:
+                    prev = infos.get(vid)
+                    if prev is None:
+                        continue
+                    if meta.tensor_slice is not None and (
+                        prev.object_type != ObjectType.TENSOR_SLICE
+                        or meta.tensor_slice.coordinates
+                        not in prev.tensor_slices
+                    ):
+                        continue  # holds other shards only: not superseded
+                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
+                    self._detach_meta(meta, vid)
+                    structural = True
         if stale_gens:
             # The detached replica may be wedged-but-ALIVE and still holding
             # the old bytes: clients with warm location caches would read
@@ -414,6 +528,35 @@ class Controller(Actor):
         # The reply carries the placement epoch so publishers track it for
         # free (no extra RPC): a bump invalidates their cached plans.
         return self._placement_epoch
+
+    def _reclaim_policy(self):
+        """The drainer's backoff schedule as a RetryPolicy (the unified
+        retry vocabulary — config.RetryPolicy). TORCHSTORE_TPU_RECLAIM_DELAYS
+        overrides the default 1,5,15,60 schedule; malformed values fall back
+        (a parse error must not kill the drainer — it would leave the
+        volume's running-flag set and wedge reclaims forever)."""
+        import os
+
+        from torchstore_tpu.config import RetryPolicy
+
+        # deadline_s=inf: the schedule length IS the attempt budget (the
+        # pre-policy drainer always ran every entry). A wall-clock deadline
+        # here would skip the long tail exactly when a slow-recovering
+        # volume makes each attempt's RPCs block until their own timeout —
+        # the case the 60 s entry exists for.
+        env = os.environ.get("TORCHSTORE_TPU_RECLAIM_DELAYS")
+        if env:
+            try:
+                return RetryPolicy.from_delays(
+                    env.split(","), deadline_s=float("inf")
+                )
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed TORCHSTORE_TPU_RECLAIM_DELAYS=%r", env
+                )
+        return RetryPolicy.from_delays(
+            (1.0, 5.0, 15.0, 60.0), deadline_s=float("inf")
+        )
 
     def _schedule_reclaim(self, volume_id: str, keys: dict[str, int]) -> None:
         """``keys``: {key: stale write generation} — the generation of the
@@ -460,24 +603,14 @@ class Controller(Actor):
         entry is detached loudly (degraded redundancy, healed by the next
         publish) instead of pointing readers at missing bytes."""
         import asyncio
-        import os
 
         try:
-            delays = (1.0, 5.0, 15.0, 60.0)
-            env = os.environ.get("TORCHSTORE_TPU_RECLAIM_DELAYS")
-            if env:
-                # Malformed values fall back to the defaults — a parse
-                # error must not kill the drainer (it would leave the
-                # volume's running-flag set and wedge reclaims forever).
-                try:
-                    delays = tuple(float(d) for d in env.split(","))
-                except ValueError:
-                    logger.warning(
-                        "ignoring malformed TORCHSTORE_TPU_RECLAIM_DELAYS=%r",
-                        env,
-                    )
-            for delay in delays:
-                await asyncio.sleep(delay)
+            policy = self._reclaim_policy()
+            deadline = policy.start()
+            attempt = 0
+            while policy.should_retry(attempt, deadline):
+                await asyncio.sleep(policy.backoff(attempt))
+                attempt += 1
                 ref = self.volume_refs.get(volume_id)
                 pending = self._pending_reclaims.get(volume_id)
                 if ref is None or not pending:
@@ -866,6 +999,12 @@ class Controller(Actor):
         async def ping(vid: str, ref: ActorRef) -> tuple[str, str]:
             try:
                 await asyncio.wait_for(ref.ping(), timeout=timeout)
+                # The supervisor's verdict outranks one lucky ping: a
+                # quarantined volume stays reported as such until probation
+                # reinstates it, so clients keep avoiding it meanwhile.
+                state = self._vol_health.get(vid, {}).get("state", "ok")
+                if state == "quarantined":
+                    return vid, "quarantined: skipped by placement until reinstated"
                 return vid, "ok"
             except asyncio.TimeoutError:
                 return (
@@ -881,6 +1020,281 @@ class Controller(Actor):
             *(ping(vid, ref) for vid, ref in self.volume_refs.items())
         )
         return dict(results)
+
+    # ---- health supervisor ------------------------------------------------
+
+    def _quarantined_ids(self) -> set:
+        return {
+            vid
+            for vid, h in self._vol_health.items()
+            if h["state"] == "quarantined"
+        }
+
+    def _start_supervisor(self) -> None:
+        """(Re)start the heartbeat loop — called from init(); idempotent
+        across re-inits. Disabled when the interval is <= 0."""
+        if self._health_task is not None:
+            self._health_task.cancel()
+            self._health_task = None
+        if self._health_interval <= 0:
+            return
+        self._health_task = spawn_logged(
+            self._health_loop(),
+            name="controller.health",
+            tasks=self._health_tasks,
+            log=logger,
+        )
+
+    async def _health_loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self._health_interval)
+            await self._health_sweep()
+
+    async def _health_sweep(self) -> None:
+        """One heartbeat round: ping every volume, run the per-volume state
+        machine (ok -> quarantined after miss-threshold consecutive misses;
+        quarantined -> probation on the first answered ping -> ok after
+        miss-threshold consecutive answers), bump the placement epoch on
+        every transition so clients drop plans/locations, and kick off auto
+        re-replication when a volume is quarantined."""
+        import asyncio
+
+        timeout = min(max(self._health_interval, 0.5), 5.0)
+
+        async def ping(vid: str, ref: ActorRef) -> tuple[str, bool]:
+            try:
+                await asyncio.wait_for(ref.ping(), timeout=timeout)
+                return vid, True
+            except Exception:  # noqa: BLE001 - any failure is a miss
+                return vid, False
+
+        results = await asyncio.gather(
+            *(ping(vid, ref) for vid, ref in self.volume_refs.items())
+        )
+        changed = False
+        for vid, ok in results:
+            h = self._vol_health.get(vid)
+            if h is None:
+                h = self._vol_health[vid] = {
+                    "state": "ok", "misses": 0, "oks": 0
+                }
+            state = h["state"]
+            if ok:
+                h["misses"] = 0
+                if state == "quarantined":
+                    h["state"] = "probation"
+                    h["oks"] = 1
+                    _VOLUME_HEALTH.set(0.5, volume=vid)
+                    changed = True
+                    logger.warning(
+                        "volume %s answered pings again: probation "
+                        "(%d/%d stable rounds to reinstate)",
+                        vid, 1, self._miss_threshold,
+                    )
+                elif state == "probation":
+                    h["oks"] += 1
+                    if h["oks"] >= self._miss_threshold:
+                        h["state"] = "ok"
+                        _VOLUME_HEALTH.set(1, volume=vid)
+                        changed = True
+                        logger.warning(
+                            "volume %s reinstated after %d stable rounds",
+                            vid, h["oks"],
+                        )
+            else:
+                h["oks"] = 0
+                h["misses"] += 1
+                if (
+                    state != "quarantined"
+                    and h["misses"] >= self._miss_threshold
+                ):
+                    h["state"] = "quarantined"
+                    _VOLUME_HEALTH.set(0, volume=vid)
+                    _QUARANTINES.inc(volume=vid)
+                    changed = True
+                    logger.warning(
+                        "volume %s QUARANTINED after %d missed heartbeats; "
+                        "placement skips it%s",
+                        vid,
+                        h["misses"],
+                        "; auto-repair starting" if self._auto_repair else "",
+                    )
+                    if self._auto_repair:
+                        self._start_auto_repair(vid)
+        if changed:
+            # One bump per sweep however many volumes transitioned: clients
+            # drop cached plans/locations and re-resolve against the new
+            # health picture on their next operation.
+            self._placement_epoch += 1
+
+    def _start_auto_repair(self, volume_id: str) -> None:
+        if volume_id in self._repairing:
+            return
+        self._repairing.add(volume_id)
+        spawn_logged(
+            self._auto_repair_volume(volume_id),
+            name="controller.auto_repair",
+            tasks=self._health_tasks,
+            log=logger,
+        )
+
+    async def _auto_repair_volume(self, volume_id: str) -> None:
+        """Re-replicate every key the quarantined volume held that still
+        has a healthy copy onto healthy volumes (volume-to-volume over the
+        RPC transport — no client involvement), restoring redundancy
+        without ts.repair(). Keys whose only copy lived on the quarantined
+        volume are skipped (nothing to copy from; ts.repair()/recover
+        remains the story for those). Raced overwrites are detected by
+        write-generation snapshot and the extra copy is reclaimed instead
+        of indexed, so a repaired replica can never serve stale bytes
+        under fresh metadata."""
+        import asyncio
+
+        try:
+            healthy = [
+                vid
+                for vid, h in self._vol_health.items()
+                if h["state"] == "ok" and vid in self.volume_refs
+            ]
+            if not healthy:
+                return
+            # Plan: (src, tgt) -> list of (key, meta-only Requests, src_gen).
+            plan: dict[tuple[str, str], list] = {}
+            rr = 0
+            for key in list(self.index):
+                infos = self.index.get(key)
+                if infos is None or volume_id not in infos:
+                    continue
+                lost = infos[volume_id]
+                sources = [v for v in healthy if v in infos]
+                src = None
+                for cand in sources:
+                    have = infos[cand]
+                    if lost.object_type != have.object_type:
+                        continue
+                    if lost.object_type == ObjectType.TENSOR_SLICE and not (
+                        set(lost.tensor_slices) <= set(have.tensor_slices)
+                    ):
+                        continue  # survivor lacks some of the lost shards
+                    src = cand
+                    break
+                if src is None:
+                    continue
+                targets = [v for v in healthy if v not in infos]
+                if not targets:
+                    continue  # every healthy volume already holds a copy
+                tgt = sorted(targets)[rr % len(targets)]
+                rr += 1
+                if lost.object_type == ObjectType.OBJECT:
+                    metas = [Request(key=key, is_object=True)]
+                elif lost.object_type == ObjectType.TENSOR:
+                    metas = [Request(key=key, tensor_meta=lost.tensor_meta)]
+                else:
+                    metas = [
+                        Request(
+                            key=key,
+                            tensor_slice=ts,
+                            tensor_meta=lost.tensor_meta,
+                        )
+                        for ts in lost.tensor_slices.values()
+                    ]
+                plan.setdefault((src, tgt), []).append(
+                    (key, metas, self.index[key][src].write_gen)
+                )
+            if not plan:
+                return
+            repaired = 0
+            for (src, tgt), items in plan.items():
+                src_ref = self.volume_refs.get(src)
+                tgt_ref = self.volume_refs.get(tgt)
+                if src_ref is None or tgt_ref is None:
+                    continue
+                # Bounded batches: one pull RPC moves up to 64 keys.
+                for i in range(0, len(items), 64):
+                    batch = items[i : i + 64]
+                    metas = [m for _, ms, _ in batch for m in ms]
+                    try:
+                        result = await tgt_ref.pull_from.call_one(
+                            src_ref, metas
+                        )
+                    except Exception as exc:  # noqa: BLE001 - per-batch
+                        logger.warning(
+                            "auto-repair pull %s -> %s failed for %d "
+                            "key(s): %s",
+                            src, tgt, len(batch), exc,
+                        )
+                        continue
+                    gens = result.get("write_gens", {})
+                    touched = set()
+                    for key, kmetas, src_gen in batch:
+                        infos = self.index.get(key)
+                        cur = infos.get(src) if infos else None
+                        if cur is None or cur.write_gen != src_gen:
+                            # The key was overwritten/deleted while the
+                            # copy was in flight: the pulled bytes may be
+                            # stale — reclaim them on the target instead
+                            # of indexing (gen -1: resolve target-side).
+                            self._schedule_reclaim(tgt, {key: -1})
+                            continue
+                        info = infos.get(tgt)
+                        for m in kmetas:
+                            if info is None:
+                                info = infos[tgt] = StorageInfo.from_meta(m)
+                            else:
+                                info.merge(m)
+                        info.write_gen = max(
+                            info.write_gen, gens.get(key, 0)
+                        )
+                        touched.add(key)
+                        repaired += 1
+                    if touched:
+                        _AUTO_REPAIRS.inc(len(touched))
+                        self._placement_epoch += 1
+                        await self._bump(touched)
+                    await asyncio.sleep(0)  # yield between batches
+            if repaired:
+                logger.warning(
+                    "auto-repair for quarantined volume %s: re-replicated "
+                    "%d key(s) onto healthy volumes",
+                    volume_id,
+                    repaired,
+                )
+        finally:
+            self._repairing.discard(volume_id)
+
+    @endpoint
+    async def volume_health(self) -> dict[str, dict]:
+        """Supervisor view per volume: {"state", "misses", "oks"} — the
+        fleet's self-healing dashboard (also embedded in stats())."""
+        return {vid: dict(h) for vid, h in self._vol_health.items()}
+
+    # ---- fault injection (test/chaos control plane) ------------------------
+
+    @endpoint
+    async def inject_fault(
+        self,
+        name: str,
+        action: str,
+        count: Optional[int] = None,
+        prob: Optional[float] = None,
+        delay_ms: Optional[float] = None,
+    ) -> dict:
+        """Arm a faultpoint INSIDE the controller process (see
+        torchstore_tpu/faults.py) — the control RPC that lets tests
+        schedule deterministic failures in an already-running fleet."""
+        return faults.arm(
+            name, action, count=count, prob=prob, delay_ms=delay_ms
+        )
+
+    @endpoint
+    async def clear_faults(self, name: Optional[str] = None) -> int:
+        return faults.disarm(name)
+
+    @endpoint
+    async def list_faults(self) -> list:
+        return faults.armed()
 
     @endpoint
     async def replace_volume(
@@ -1021,6 +1435,11 @@ class Controller(Actor):
                 for vid, keys in self._pending_reclaims.items()
                 if keys
             },
+            # Health supervisor view (state/misses/oks per volume) — the
+            # same data volume_health() serves, embedded for fleet scrapes.
+            "volume_health": {
+                vid: dict(h) for vid, h in self._vol_health.items()
+            },
             # The controller process's own registry — metrics are
             # process-local, so remote clients reach these through stats().
             "metrics": obs_metrics.metrics_snapshot(),
@@ -1046,6 +1465,12 @@ class Controller(Actor):
     async def teardown(self) -> None:
         import asyncio
 
+        if self._health_task is not None:
+            self._health_task.cancel()
+            self._health_task = None
+        for task in list(self._health_tasks):
+            task.cancel()
+        self._health_tasks.clear()
         for task in list(self._reclaim_tasks):
             task.cancel()
         self._reclaim_tasks.clear()

@@ -1,0 +1,305 @@
+"""Deterministic fault injection: named faultpoints compiled into hot paths.
+
+The fault-handling layers (health supervisor, retry/failover, reclaim) are
+only credible if their failure modes can be reproduced ON DEMAND, inside the
+real process topology — not by monkeypatching client-side helpers in the
+test process (the old ``tests/test_strategies_and_faults.py`` idiom), which
+can never reach a forked volume's put path or a controller's notify.
+
+This module provides named injection sites ("faultpoints") wired into the
+store's hot paths:
+
+    controller.notify     Controller.notify_put_batch entry
+    controller.locate     Controller.locate_volumes entry
+    volume.put            StorageVolume.put entry
+    volume.get            StorageVolume.get entry
+    volume.handshake      StorageVolume.handshake entry (all transports)
+    shm.handshake         SHM server-side recv_handshake (volume process)
+    actor.ping            ActorServer control-ping (per process: arming it
+                          inside a volume wedges THAT volume's heartbeats)
+    bulk.send_frame       bulk transport frame send (client and server)
+    bulk.recv_frame       bulk server frame receive (supports drop-frame)
+    rendezvous.dispatch   rendezvous server op dispatch
+
+Cost when disarmed: ONE dict lookup (``_armed.get(name)`` on an empty dict)
+— measured indistinguishable from noise on the many_keys bench. Sites fire
+via :func:`fire` (sync paths) or :func:`afire` (async paths).
+
+Arming:
+
+- env: ``TORCHSTORE_TPU_FAULTPOINTS="volume.put=raise:count=2;actor.ping=wedge"``
+  parsed at import and after fork, so faults ride into freshly spawned
+  volume/controller processes (spawn_actors forwards TORCHSTORE_TPU_*).
+- control RPC: ``ts.inject_fault(name, action, count=, prob=, delay_ms=,
+  scope=)`` arms faults inside ALREADY-RUNNING actor processes through the
+  ``inject_fault`` endpoints on the controller and every volume — the only
+  way to schedule a fault mid-test without restarting the fleet.
+
+Actions:
+
+    raise       raise FaultInjectedError at the site
+    delay       sleep delay_ms then proceed (asyncio.sleep at async sites)
+    wedge       hang far past any configured deadline (cancellable at async
+                sites; at sync sites this blocks the process's event loop —
+                the whole process looks wedged, pings included)
+    die         os._exit(17): the process vanishes mid-operation
+    drop-frame  return the sentinel "drop-frame" for the site to interpret
+                (bulk frame paths silently drop the frame; elsewhere no-op)
+
+``count=N`` fires N times then self-disarms (deterministic schedules);
+``prob=P`` fires with probability P per pass (chaos soaks). Unset count
+with unset prob fires every pass until disarmed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+
+logger = get_logger("torchstore_tpu.faults")
+
+ENV_FAULTPOINTS = "TORCHSTORE_TPU_FAULTPOINTS"
+
+# Every faultpoint name a call site may fire. The tslint ``retry-discipline``
+# checker cross-references fire()/afire() string literals against this
+# registry, so a typo'd site name fails pre-merge instead of silently never
+# firing.
+REGISTRY: frozenset[str] = frozenset(
+    {
+        "controller.notify",
+        "controller.locate",
+        "volume.put",
+        "volume.get",
+        "volume.handshake",
+        "shm.handshake",
+        "actor.ping",
+        "bulk.send_frame",
+        "bulk.recv_frame",
+        "rendezvous.dispatch",
+    }
+)
+
+ACTIONS = ("raise", "delay", "wedge", "die", "drop-frame")
+
+# How long a "wedge" hangs: far past any configured RPC deadline, short
+# enough that an orphaned wedged task cannot outlive a test session by much.
+WEDGE_S = 600.0
+
+_FIRED = obs_metrics.counter(
+    "ts_faults_fired_total", "Fault injections triggered, by point and action"
+)
+
+
+class FaultInjectedError(RuntimeError):
+    """Raised at a faultpoint armed with action='raise'."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. ``count`` is the REMAINING fire budget (None =
+    unlimited); ``prob`` gates each pass; ``delay_ms`` parameterizes the
+    ``delay`` action only (other actions execute immediately)."""
+
+    name: str
+    action: str
+    count: Optional[int] = None
+    prob: Optional[float] = None
+    delay_ms: float = 100.0
+    fired: int = field(default=0)
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "action": self.action,
+            "count": self.count,
+            "prob": self.prob,
+            "delay_ms": self.delay_ms,
+            "fired": self.fired,
+        }
+
+
+# Armed faults for THIS process. Empty in production: every fire() is one
+# failed dict lookup. Actor children re-arm from env in reinit_after_fork.
+_armed: dict[str, FaultSpec] = {}  # tslint: disable=fork-safety
+
+
+def arm(
+    name: str,
+    action: str,
+    count: Optional[int] = None,
+    prob: Optional[float] = None,
+    delay_ms: Optional[float] = None,
+) -> dict[str, Any]:
+    """Arm one faultpoint in THIS process; returns the armed spec. Unknown
+    names/actions fail loudly — a typo'd injection that never fires would
+    make a chaos test silently vacuous."""
+    if name not in REGISTRY:
+        raise ValueError(
+            f"unknown faultpoint {name!r}; registered: {sorted(REGISTRY)}"
+        )
+    if action not in ACTIONS:
+        raise ValueError(f"unknown fault action {action!r}; have {ACTIONS}")
+    if count is not None and count <= 0:
+        raise ValueError("count must be positive (or None for unlimited)")
+    if prob is not None and not (0.0 < prob <= 1.0):
+        raise ValueError("prob must be in (0, 1]")
+    spec = FaultSpec(
+        name=name,
+        action=action,
+        count=count,
+        prob=prob,
+        delay_ms=100.0 if delay_ms is None else float(delay_ms),
+    )
+    _armed[name] = spec
+    logger.warning(
+        "faultpoint armed: %s=%s count=%s prob=%s delay_ms=%s [pid %d]",
+        name,
+        action,
+        count,
+        prob,
+        spec.delay_ms,
+        os.getpid(),
+    )
+    return spec.describe()
+
+
+def disarm(name: Optional[str] = None) -> int:
+    """Disarm one faultpoint (or ALL when name is None); returns how many
+    were dropped. Unknown/unarmed names are a no-op (idempotent cleanup)."""
+    if name is None:
+        n = len(_armed)
+        _armed.clear()
+        return n
+    return 1 if _armed.pop(name, None) is not None else 0
+
+
+def armed() -> list[dict[str, Any]]:
+    """Describe every armed fault in this process (test introspection)."""
+    return [spec.describe() for spec in _armed.values()]
+
+
+def _take(spec: FaultSpec) -> bool:
+    """Decide whether this pass fires; consume count budget when it does."""
+    if spec.prob is not None and random.random() >= spec.prob:
+        return False
+    if spec.count is not None:
+        if spec.count <= 0:
+            _armed.pop(spec.name, None)
+            return False
+        spec.count -= 1
+        if spec.count == 0:
+            _armed.pop(spec.name, None)
+    spec.fired += 1
+    _FIRED.inc(point=spec.name, action=spec.action)
+    logger.warning(
+        "faultpoint FIRING: %s action=%s (fire #%d) [pid %d]",
+        spec.name,
+        spec.action,
+        spec.fired,
+        os.getpid(),
+    )
+    return True
+
+
+def _execute_sync(spec: FaultSpec) -> Optional[str]:
+    if spec.action == "die":
+        os._exit(17)
+    if spec.action == "raise":
+        raise FaultInjectedError(f"injected fault at {spec.name!r}")
+    if spec.action == "delay":
+        time.sleep(spec.delay_ms / 1000.0)
+        return None
+    if spec.action == "wedge":
+        time.sleep(WEDGE_S)
+        return None
+    return spec.action  # drop-frame: the site interprets the sentinel
+
+
+async def _execute_async(spec: FaultSpec) -> Optional[str]:
+    import asyncio
+
+    if spec.action == "die":
+        os._exit(17)
+    if spec.action == "raise":
+        raise FaultInjectedError(f"injected fault at {spec.name!r}")
+    if spec.action == "delay":
+        await asyncio.sleep(spec.delay_ms / 1000.0)
+        return None
+    if spec.action == "wedge":
+        await asyncio.sleep(WEDGE_S)
+        return None
+    return spec.action
+
+
+def fire(name: str) -> Optional[str]:
+    """Synchronous faultpoint. Disarmed cost: one dict lookup. Returns the
+    action sentinel for pass-through actions (``drop-frame``), else None."""
+    spec = _armed.get(name)
+    if spec is None or not _take(spec):
+        return None
+    return _execute_sync(spec)
+
+
+async def afire(name: str) -> Optional[str]:
+    """Async faultpoint: like :func:`fire` but delay/wedge suspend only the
+    firing task (the process's event loop — and its ping — stay live)."""
+    spec = _armed.get(name)
+    if spec is None or not _take(spec):
+        return None
+    return await _execute_async(spec)
+
+
+# --------------------------------------------------------------------------
+# env parsing (import-time + after fork)
+# --------------------------------------------------------------------------
+
+
+def parse_spec(text: str) -> list[dict[str, Any]]:
+    """Parse ``name=action[:count=N][:prob=P][:delay_ms=D];...`` into arm()
+    kwargs. Raises ValueError on malformed entries (a chaos schedule that
+    silently half-parses would make tests vacuous)."""
+    out: list[dict[str, Any]] = []
+    for chunk in text.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        head, _, opts = chunk.partition(":")
+        name, sep, action = head.partition("=")
+        if not sep:
+            raise ValueError(f"malformed faultpoint entry {chunk!r}")
+        kwargs: dict[str, Any] = {"name": name.strip(), "action": action.strip()}
+        for opt in filter(None, (o.strip() for o in opts.split(":"))):
+            k, sep, v = opt.partition("=")
+            if not sep or k not in ("count", "prob", "delay_ms"):
+                raise ValueError(f"malformed faultpoint option {opt!r}")
+            kwargs[k] = int(v) if k == "count" else float(v)
+        out.append(kwargs)
+    return out
+
+
+def _arm_from_env() -> None:
+    text = os.environ.get(ENV_FAULTPOINTS)
+    if not text:
+        return
+    try:
+        for kwargs in parse_spec(text):
+            arm(**kwargs)
+    except ValueError:
+        # Malformed env must not kill a booting volume; it just disarms.
+        logger.exception("ignoring malformed %s=%r", ENV_FAULTPOINTS, text)
+
+
+def reinit_after_fork() -> None:
+    """Re-arm from the (corrected) child env: forked actor children inherit
+    the forkserver's module state, not its parent's env."""
+    _armed.clear()
+    _arm_from_env()
+
+
+_arm_from_env()

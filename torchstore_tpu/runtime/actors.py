@@ -529,6 +529,13 @@ class ActorServer:
     async def _handle_control(self, msg: dict) -> Any:
         op = msg["op"]
         if op == "ping":
+            # Faultpoint: arming "actor.ping" in a process makes ITS
+            # heartbeat responses raise/stall — the handle the health
+            # supervisor's quarantine tests use to simulate a wedged-but-
+            # alive volume without blocking its event loop.
+            from torchstore_tpu import faults
+
+            await faults.afire("actor.ping")
             return "pong"
         if op == "stop":
             # Respond first; the serve loop exits after this dispatch returns.
@@ -611,6 +618,12 @@ def _child_main(pipe, actor_cls, name: str, args: tuple, kwargs: dict, env: dict
     from torchstore_tpu.transport import landing as _landing
 
     _landing.reinit_after_fork()
+    # Re-arm faultpoints from the CORRECTED env: the forkserver's module
+    # state carries whatever TORCHSTORE_TPU_FAULTPOINTS it imported under,
+    # not what this child was spawned with.
+    from torchstore_tpu import faults as _faults
+
+    _faults.reinit_after_fork()
     try:
         asyncio.run(_child_async(pipe, actor_cls, name, args, kwargs))
     except KeyboardInterrupt:

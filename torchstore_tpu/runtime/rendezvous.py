@@ -82,6 +82,9 @@ class RendezvousServer:
     async def _dispatch(self, msg: dict, writer, write_lock) -> None:
         op = msg["op"]
         try:
+            from torchstore_tpu import faults
+
+            await faults.afire("rendezvous.dispatch")
             if op == "set":
                 async with self._changed:
                     self.kv[msg["key"]] = msg["value"]
@@ -145,7 +148,17 @@ class RendezvousClient:
         self._reader_task: Optional[asyncio.Task] = None
 
     async def connect(self, timeout: float = DEFAULT_TIMEOUT_S) -> None:
-        deadline = asyncio.get_running_loop().time() + timeout
+        # Rank 0's server may not be up yet: retry under the unified
+        # RetryPolicy (caller's timeout = the deadline budget), gentle
+        # start + jitter so a whole world connecting at once doesn't
+        # hammer the listener in lockstep.
+        from torchstore_tpu.config import RetryPolicy
+
+        policy = RetryPolicy(
+            base_s=0.2, max_s=1.0, multiplier=1.5, deadline_s=timeout
+        )
+        deadline = policy.start()
+        attempt = 0
         while True:
             try:
                 self._reader, self._writer = await asyncio.open_connection(
@@ -153,9 +166,10 @@ class RendezvousClient:
                 )
                 break
             except (ConnectionError, OSError):
-                if asyncio.get_running_loop().time() > deadline:
+                if not policy.should_retry(attempt, deadline):
                     raise
-                await asyncio.sleep(0.2)  # rank 0 may not be up yet
+                await asyncio.sleep(policy.backoff(attempt))
+                attempt += 1
         from torchstore_tpu.runtime.auth import client_authenticate
 
         await client_authenticate(self._reader, self._writer)

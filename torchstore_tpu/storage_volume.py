@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
@@ -299,6 +300,7 @@ class StorageVolume(Actor):
     async def handshake(
         self, buffer: TransportBuffer, metas: list[Request], op: str
     ) -> Any:
+        await faults.afire("volume.handshake")
         existing = self.store.extract_existing(metas) if op == "put" else {}
         return await maybe_await(buffer.recv_handshake(self.ctx, metas, existing, op))
 
@@ -342,6 +344,7 @@ class StorageVolume(Actor):
 
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
+        await faults.afire("volume.put")
         t0 = time.perf_counter()
         existing = self.store.extract_existing(metas)
         values = await maybe_await(
@@ -370,6 +373,7 @@ class StorageVolume(Actor):
     async def get(
         self, buffer: TransportBuffer, metas: list[Request]
     ) -> TransportBuffer:
+        await faults.afire("volume.get")
         t0 = time.perf_counter()
         entries = [self.store.get_data(meta) for meta in metas]
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
@@ -460,6 +464,56 @@ class StorageVolume(Actor):
             for key in keys
             if key in self._write_gens
         }
+
+    @endpoint
+    async def pull_from(self, src, metas: list[Request]) -> dict[str, Any]:
+        """Volume-to-volume re-replication (the controller's auto-repair
+        data plane): pull ``metas`` from the volume at ActorRef ``src``
+        over the RPC transport and store them locally — no client
+        involvement, works across hosts (actor RPC frames tensor bytes
+        out-of-band). Returns fresh local write generations so the
+        controller can index the new copy with a sound reclaim token."""
+        from torchstore_tpu.transport.rpc import RPCTransportBuffer
+
+        buffer = RPCTransportBuffer()
+        remote = await src.get.call_one(buffer, metas)
+        values: dict[int, Any] = {}
+        for idx, meta in enumerate(metas):
+            if meta.is_object or idx in remote.objects:
+                values[idx] = remote.objects[idx]
+            else:
+                values[idx] = remote.tensors[idx]
+        affected = {meta.key for meta in metas}
+        before = sum(self._entry_nbytes(k) for k in affected)
+        self.store.store(metas, values)
+        self._apply_residency_delta(affected, before)
+        return {"write_gens": self._bump_write_gens(metas)}
+
+    # ---- fault injection (test/chaos control plane) ----------------------
+
+    @endpoint
+    async def inject_fault(
+        self,
+        name: str,
+        action: str,
+        count: Optional[int] = None,
+        prob: Optional[float] = None,
+        delay_ms: Optional[float] = None,
+    ) -> dict:
+        """Arm a faultpoint INSIDE this volume process (see
+        torchstore_tpu/faults.py) — lets tests schedule deterministic
+        failures in an already-forked volume without restarting the fleet."""
+        return faults.arm(
+            name, action, count=count, prob=prob, delay_ms=delay_ms
+        )
+
+    @endpoint
+    async def clear_faults(self, name: Optional[str] = None) -> int:
+        return faults.disarm(name)
+
+    @endpoint
+    async def list_faults(self) -> list:
+        return faults.armed()
 
     @endpoint
     async def manifest(self) -> list:

@@ -38,6 +38,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from torchstore_tpu import faults
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
@@ -143,6 +144,8 @@ async def _send_frame(
     idx: int,
     payload: Optional[memoryview],
 ) -> None:
+    if await faults.afire("bulk.send_frame") == "drop-frame":
+        return  # frame silently lost: the receiver's deadline machinery owns recovery
     loop = asyncio.get_running_loop()
     async with lock:
         nbytes = payload.nbytes if payload is not None else 0
@@ -272,7 +275,10 @@ class BulkServer:
                 if self._listen_sock is None or self._listen_sock.fileno() < 0:
                     return  # listener closed: normal shutdown
                 logger.warning("bulk accept failed (%s); retrying in 1s", exc)
-                await asyncio.sleep(1.0)
+                # Not a RetryPolicy site: the accept loop must retry FOREVER
+                # (a deadline here would strand every future client); this is
+                # pacing against EMFILE churn, not a bounded retry.
+                await asyncio.sleep(1.0)  # tslint: disable=retry-discipline
                 continue
             conn.setblocking(False)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -300,6 +306,11 @@ class BulkServer:
             while True:
                 await _recv_exact(sock, header_view)
                 session, idx, nbytes = _FRAME.unpack(header)
+                if await faults.afire("bulk.recv_frame") == "drop-frame":
+                    # Swallow the frame (payload drained so the stream stays
+                    # parseable): the sender sees silence, not an error.
+                    await _discard(sock, nbytes)
+                    continue
                 if idx == IDX_HELLO:
                     client_id = session
                     self.client_conns[client_id] = (sock, conn_lock)

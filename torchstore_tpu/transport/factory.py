@@ -68,11 +68,49 @@ def bulk_available(volume: "StorageVolumeRef", config: StoreConfig) -> bool:
 _logged_resolution = False
 
 
-def create_transport_buffer(
+def demotion_ladder(
     volume: "StorageVolumeRef", config: Optional[StoreConfig] = None
-) -> TransportBuffer:
+) -> list[TransportType]:
+    """The rungs a put retry may walk DOWN, best first, STARTING at the
+    rung the volume actually uses (``ladder[0]`` is what a plain
+    ``create_transport_buffer`` call resolves to): a broken shm handshake
+    or reset bulk socket demotes to the next rung instead of surfacing —
+    rpc (always last) rides the actor channel itself, so if it fails too
+    the volume is gone, not the transport. A volume whose
+    ``transport_type`` is pinned never retries ABOVE the pinned rung:
+    rungs the operator excluded (e.g. shm known-broken in a deployment
+    that forced rpc) stay excluded."""
     config = config or default_config()
     forced = volume.transport_type
+    if forced in (None, TransportType.UNSET, TransportType.UNSET.value):
+        start = None
+    else:
+        start = TransportType(forced)
+    order = (TransportType.SHM, TransportType.BULK, TransportType.RPC)
+    available = {
+        TransportType.SHM: shm_available(volume, config),
+        TransportType.BULK: bulk_available(volume, config),
+        TransportType.RPC: True,
+    }
+    rungs: list[TransportType] = []
+    for rung in order:
+        if start is not None and not rungs:
+            if rung != start:
+                continue  # a rung above the pin was deliberately excluded
+            rungs.append(rung)  # the pin itself: what the failure used
+            continue
+        if available[rung]:
+            rungs.append(rung)
+    return rungs or [TransportType.RPC]
+
+
+def create_transport_buffer(
+    volume: "StorageVolumeRef",
+    config: Optional[StoreConfig] = None,
+    force: "Optional[TransportType | str]" = None,
+) -> TransportBuffer:
+    config = config or default_config()
+    forced = force if force is not None else volume.transport_type
     if forced in (None, TransportType.UNSET, TransportType.UNSET.value):
         chosen = _auto_select(volume, config)
     else:

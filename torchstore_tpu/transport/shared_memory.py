@@ -1233,6 +1233,12 @@ class SharedMemoryTransportBuffer(TransportBuffer):
     def recv_handshake(
         self, ctx: TransportContext, metas: list[Request], existing: dict, op: str
     ) -> Any:
+        # Sync faultpoint: a "wedge" here blocks the volume's event loop —
+        # the WHOLE process (pings included) looks dead to the supervisor,
+        # the deterministic stand-in for a volume stuck in a native copy.
+        from torchstore_tpu import faults
+
+        faults.fire("shm.handshake")
         if op != "put":
             return None
         cache: ShmServerCache = ctx.get_cache(ShmServerCache)
