@@ -157,6 +157,56 @@ int ts_prefault(void* addr, uint64_t len, int nthreads) {
   return 0;
 }
 
+// Batched scatter memcpy: count independent (dst, src, len) copies in one
+// GIL-free call, partitioned byte-balanced across threads. This is the
+// one-sided warm get's data plane — hundreds of ~64 KB stamped reads per
+// batch, where a per-pair Python np.copyto loop pays interpreter + GIL
+// hand-off costs comparable to the memcpy itself. Pointers ride as uint64
+// arrays (numpy-friendly ctypes ABI). Overlapping ranges are the caller's
+// bug. nthreads <= 0 -> auto.
+void ts_copy_batch(const uint64_t* dsts, const uint64_t* srcs,
+                   const uint64_t* lens, uint64_t count, int nthreads) {
+  if (count == 0) return;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < count; ++i) total += lens[i];
+  if (total == 0) return;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  size_t want = nthreads > 0 ? static_cast<size_t>(nthreads)
+                             : static_cast<size_t>(hw);
+  size_t threads =
+      std::min(want, std::max<uint64_t>(1, total / kMinPerThread));
+  threads = std::min<size_t>(threads, 16);
+  threads = std::min<uint64_t>(threads, count);
+  auto run = [=](uint64_t lo, uint64_t hi) {
+    for (uint64_t i = lo; i < hi; ++i) {
+      std::memcpy(reinterpret_cast<void*>(dsts[i]),
+                  reinterpret_cast<const void*>(srcs[i]), lens[i]);
+    }
+  };
+  if (threads <= 1) {
+    run(0, count);
+    return;
+  }
+  // Byte-balanced split points: pair i goes to the thread whose byte range
+  // contains its cumulative start (pairs stay whole — intra-pair splitting
+  // is ts_parallel_memcpy's job, and callers chunk huge pairs first).
+  std::vector<uint64_t> bounds(threads + 1, count);
+  bounds[0] = 0;
+  uint64_t per = total / threads, acc = 0, t = 1;
+  for (uint64_t i = 0; i < count && t < threads; ++i) {
+    acc += lens[i];
+    if (acc >= per * t) bounds[t++] = i + 1;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  for (size_t i = 0; i + 1 < threads; ++i) {
+    pool.emplace_back(run, bounds[i], bounds[i + 1]);
+  }
+  run(bounds[threads - 1], bounds[threads]);
+  for (auto& t2 : pool) t2.join();
+}
+
 // Blocking full-length fd IO, releasing the GIL on the Python side (called
 // via ctypes from executor threads). Returns bytes moved or -errno.
 int64_t ts_write_fd(int fd, const void* buf, uint64_t n) {
@@ -190,6 +240,8 @@ int64_t ts_read_fd(int fd, void* buf, uint64_t n) {
 
 // v2: ts_prefault gained the (addr, len, nthreads) multi-threaded signature
 // (the provisioning subsystem's prewarm path); v1 binaries lack it.
-uint32_t ts_version() { return 2; }
+// v3: ts_copy_batch (one-sided warm-get scatter memcpy); v2 binaries fall
+// back to the per-pair Python landing loop.
+uint32_t ts_version() { return 3; }
 
 }  // extern "C"

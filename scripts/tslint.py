@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """tslint — the repo's static-analysis suite (torchstore_tpu/analysis/).
 
-Seven checkers grounded in real shipped bug classes: endpoint-drift,
+Ten checkers grounded in real shipped bug classes: endpoint-drift,
 async-blocking, cancellation-swallow, orphan-task, fork-safety,
-env-registry, metric-discipline. See docs/ARCHITECTURE.md ("Static
-analysis") for the rule catalog and the baseline workflow.
+env-registry, metric-discipline, landing-copy, retry-discipline,
+one-sided-discipline. See docs/ARCHITECTURE.md ("Static analysis") for
+the rule catalog and the baseline workflow.
 
 Usage:
     python scripts/tslint.py                 # report; exit 1 on NEW findings

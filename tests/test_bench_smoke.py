@@ -95,6 +95,14 @@ async def test_bench_run_tiny(capsys):
     assert result["many_keys"]["n_keys"] == 16
     assert result["many_keys"]["put_s"] > 0
 
+    # One-sided get leg (ISSUE 7): per-key get cost, delivered get rate,
+    # distance from the memcpy ceiling, and the warm 1KB p50 — all present
+    # and positive (the <=0.35 ms / <=2.5x bars are the full-scale run's).
+    assert result["per_key_get_us"] > 0
+    assert result["many_keys_get_gbps"] > 0
+    assert result["get_memcpy_ratio"] > 0
+    assert result["p50_get_1kb_ms"] > 0
+
     # Recovery section (ISSUE 6): time-to-heal keys at top level, full
     # timings under "recovery" — a real kill + quarantine + auto-repair.
     assert result["heal_s"] > 0
@@ -122,6 +130,8 @@ async def test_bench_many_keys_section_tiny():
     assert out["n_keys"] == 24
     assert out["many_keys_gbps"] > 0
     assert out["per_key_put_us"] > 0
+    assert out["per_key_get_us"] > 0
+    assert out["get_gbps"] > 0 and out["get_memcpy_ratio"] > 0
     assert out["put_s"] > 0 and out["get_s"] > 0
     json.dumps(out)
 

@@ -228,6 +228,15 @@ async def test_chaos_deterministic_fault_schedule(fast_health):
                     "volume.handshake", "delay", count=2, delay_ms=150,
                     store_name="chaos_sched",
                 )
+            elif version == 10:
+                # One-sided bracket held open mid-landing: entry stamps
+                # stay visibly odd, concurrent one-sided readers fall back
+                # to the RPC path — acquire must still see zero errors and
+                # never a mixed-generation state dict.
+                await ts.inject_fault(
+                    "shm.landing_stamp", "delay", count=2, delay_ms=200,
+                    store_name="chaos_sched",
+                )
 
         report = await _run_chaos("chaos_sched", versions=12, chaos=chaos)
         assert report["publish_errors"] == []

@@ -1,0 +1,402 @@
+"""One-sided data plane (ISSUE 7): seqlock-stamped warm gets + doorbells.
+
+Covers the stamp protocol at unit level (stale / torn / borrow semantics
+against a hand-built stamp table), the fleet-level zero-RPC warm get
+(asserted via metrics snapshots on BOTH sides), the ``shm.landing_stamp``
+faultpoint (writer visibly mid-landing -> reader falls back loudly, never
+serves mixed-generation bytes), epoch-bump plan drops, the bulk doorbell
+vertical, and get_batch's batch-level plan seeding.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.transport import shared_memory as shm_mod
+
+pytestmark = pytest.mark.anyio
+
+
+def _counter(name: str, **labels) -> float:
+    snap = obs_metrics.metrics_snapshot()
+    return sum(
+        s["value"]
+        for s in snap.get(name, {}).get("series", [])
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+async def _volume_get_rpcs(client) -> float:
+    total = 0.0
+    for ref in client._volume_refs.values():
+        stats = await ref.actor.stats.call_one()
+        total += sum(
+            s["value"]
+            for s in stats["metrics"]
+            .get("ts_volume_get_ops_total", {})
+            .get("series", [])
+        )
+    return total
+
+
+# --------------------------------------------------------------------------
+# unit: the seqlock protocol itself
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def stamped_plan():
+    """A hand-built (segment, stamp table, plan, client cache) quartet —
+    the stamped-read protocol without any fleet."""
+    if not shm_mod.is_available():
+        pytest.skip("/dev/shm unavailable")
+    from torchstore_tpu.transport.types import TensorMeta
+
+    data = np.arange(1024, dtype=np.float32)
+    seg = shm_mod.ShmSegment.create(data.nbytes)
+    seg.view(TensorMeta.of(data))[:] = data
+    table = shm_mod.StampTable.create()
+    slot = 7
+    table.write(slot, 4)  # even: stable at generation 4
+    meta = TensorMeta.of(data)
+    plan = {
+        "volume_id": "v0",
+        "segment": seg.name,
+        "segment_size": seg.size,
+        "offset": 0,
+        "strides": None,
+        "meta": meta,
+        "nbytes": meta.nbytes,
+        "shape": tuple(meta.shape),
+        "npdtype": meta.np_dtype,
+        "stamp_name": table.seg.name,
+        "stamp_size": table.seg.size,
+        "slot": slot,
+        "gen": 4,
+    }
+    cache = shm_mod.ShmClientCache()
+    try:
+        yield data, seg, table, plan, cache
+    finally:
+        cache.clear()
+        seg.unlink()
+        table.seg.unlink()
+
+
+async def test_stamped_read_serves_and_validates(stamped_plan):
+    data, _seg, table, plan, cache = stamped_plan
+    out, extra = shm_mod.stamped_read(cache, plan)
+    assert extra is None
+    assert np.array_equal(out, data)
+    # In-place destination.
+    dest = np.zeros_like(data)
+    out2, _ = shm_mod.stamped_read(cache, plan, dest=dest)
+    assert out2 is dest and np.array_equal(dest, data)
+    # Stale stamp (entry replaced since the plan was recorded).
+    table.write(plan["slot"], 6)
+    with pytest.raises(shm_mod.OneSidedMiss) as exc:
+        shm_mod.stamped_read(cache, plan)
+    assert exc.value.reason == "stale_stamp"
+    # Odd stamp (writer in flight) is stale too.
+    table.write(plan["slot"], 5)
+    with pytest.raises(shm_mod.OneSidedMiss):
+        shm_mod.stamped_read(cache, plan)
+
+
+async def test_stamped_read_detects_torn_copy(stamped_plan, monkeypatch):
+    """A stamp that moves MID-COPY (writer landed while we memcpy'd) must
+    discard the copy: mixed-generation bytes are never returned."""
+    data, _seg, table, plan, cache = stamped_plan
+    real_copy = shm_mod.copy_into
+
+    def tearing_copy(dst, src):
+        real_copy(dst, src)
+        table.write(plan["slot"], 6)  # the landing settled mid-copy
+
+    monkeypatch.setattr(shm_mod, "copy_into", tearing_copy)
+    torn0 = _counter("ts_one_sided_torn_total", transport="shm")
+    with pytest.raises(shm_mod.OneSidedMiss) as exc:
+        shm_mod.stamped_read(cache, plan)
+    assert exc.value.reason == "torn"
+    assert _counter("ts_one_sided_torn_total", transport="shm") > torn0
+
+
+async def test_stamped_read_borrow_recheck(stamped_plan):
+    data, _seg, table, plan, cache = stamped_plan
+    view, recheck = shm_mod.stamped_read(cache, plan, borrow=True)
+    assert np.array_equal(view, data)
+    assert not view.flags.writeable
+    assert recheck() is True
+    table.write(plan["slot"], 6)
+    assert recheck() is False
+
+
+async def test_overlapping_write_brackets_stay_odd():
+    """Two puts of one key overlap (endpoints dispatch as independent
+    tasks): the entry stamp may only settle EVEN when the LAST bracket
+    closes — settling at the first close would let a reader validate
+    against bytes the second put is still writing."""
+    if not shm_mod.is_available():
+        pytest.skip("/dev/shm unavailable")
+    from torchstore_tpu.transport.types import TensorMeta
+
+    cache = shm_mod.ShmServerCache()
+    data = np.arange(64, dtype=np.float32)
+    seg = shm_mod.ShmSegment.create(data.nbytes)
+    try:
+        cache.put("k", None, seg, TensorMeta.of(data))
+        pair = [("k", None)]
+        cache.begin_writes(pair)
+        cache.end_writes(pair)  # first landing settles a slot, even gen
+        entry = cache.lookup("k", None)
+        base = cache.stamps.read(entry.slot)
+        assert base % 2 == 0
+
+        cache.begin_writes(pair)  # put A opens
+        cache.begin_writes(pair)  # put B overlaps
+        assert cache.stamps.read(entry.slot) % 2 == 1
+        cache.end_writes(pair)  # A closes: B still writing -> stays odd
+        assert cache.stamps.read(entry.slot) % 2 == 1
+        cache.end_writes(pair)  # last close settles the next even gen
+        after = cache.stamps.read(entry.slot)
+        assert after % 2 == 0 and after > base
+        assert not cache._write_nesting
+    finally:
+        cache.clear()
+
+
+async def test_stamped_read_batch_all_or_nothing(stamped_plan):
+    data, _seg, table, plan, cache = stamped_plan
+    good = dict(plan)
+    bad = dict(plan)
+    bad["gen"] = 2  # recorded against an older generation
+    dests = [np.zeros_like(data), np.zeros_like(data)]
+    with pytest.raises(shm_mod.OneSidedMiss):
+        await shm_mod.stamped_read_batch(cache, [good, bad], dests)
+    # The good plan alone serves.
+    out = await shm_mod.stamped_read_batch(cache, [good], [dests[0]])
+    assert np.array_equal(out[0], data)
+
+
+# --------------------------------------------------------------------------
+# fleet: zero-RPC warm gets (SHM)
+# --------------------------------------------------------------------------
+
+
+async def test_warm_get_zero_rpcs_and_invalidation():
+    """The acceptance assertion: a warm same-host get is served with ZERO
+    get RPCs (volume-side op counter flat, client-side one-sided counter
+    up), and an overwrite invalidates the plan without ever serving stale
+    or torn bytes."""
+    await ts.initialize(
+        store_name="os_shm",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        a = np.random.rand(512).astype(np.float32)
+        await ts.put("k", a, store_name="os_shm")
+        out1 = await ts.get("k", like=np.zeros_like(a), store_name="os_shm")
+        assert np.array_equal(np.asarray(out1), a)
+
+        client = ts.client("os_shm")
+        rpcs0 = await _volume_get_rpcs(client)
+        reads0 = _counter("ts_one_sided_reads_total", transport="shm")
+        out2 = await ts.get("k", like=np.zeros_like(a), store_name="os_shm")
+        assert np.array_equal(np.asarray(out2), a)
+        assert _counter("ts_one_sided_reads_total", transport="shm") > reads0
+        assert await _volume_get_rpcs(client) == rpcs0, (
+            "warm same-host get issued a get RPC"
+        )
+
+        # Overwrite: the stamped plan goes stale; the next get serves the
+        # NEW bytes (loud fallback, then a fresh plan serves one-sided).
+        b = (a * 2).astype(np.float32)
+        await ts.put("k", b, store_name="os_shm")
+        out3 = await ts.get("k", like=np.zeros_like(a), store_name="os_shm")
+        assert np.array_equal(np.asarray(out3), b)
+        reads1 = _counter("ts_one_sided_reads_total", transport="shm")
+        out4 = await ts.get("k", like=np.zeros_like(a), store_name="os_shm")
+        assert np.array_equal(np.asarray(out4), b)
+        assert _counter("ts_one_sided_reads_total", transport="shm") > reads1
+    finally:
+        await ts.shutdown("os_shm")
+
+
+async def test_landing_stamp_faultpoint_forces_loud_fallback():
+    """The new ``shm.landing_stamp`` faultpoint: a writer wedged inside the
+    landing bracket holds the entry stamp ODD — a concurrent one-sided
+    reader observes it, falls back to the RPC path (metric bumps), and the
+    value it returns is a CONSISTENT generation (old or new, never mixed)."""
+    await ts.initialize(
+        store_name="os_fault",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        a = np.full(256, 1.0, dtype=np.float32)
+        b = np.full(256, 2.0, dtype=np.float32)
+        await ts.put("k", a, store_name="os_fault")
+        warm = await ts.get("k", like=np.zeros_like(a), store_name="os_fault")
+        assert np.array_equal(np.asarray(warm), a)
+
+        await ts.inject_fault(
+            "shm.landing_stamp",
+            "delay",
+            count=1,
+            delay_ms=1200,
+            scope="volumes",
+            store_name="os_fault",
+        )
+        put_task = asyncio.create_task(ts.put("k", b, store_name="os_fault"))
+        await asyncio.sleep(0.4)  # the put is now inside the bracket
+        fb0 = _counter("ts_one_sided_fallbacks_total")
+        out = await ts.get("k", like=np.zeros_like(a), store_name="os_fault")
+        got = np.asarray(out)
+        assert np.array_equal(got, a) or np.array_equal(got, b), (
+            "mixed-generation bytes served during a landing"
+        )
+        assert _counter("ts_one_sided_fallbacks_total") > fb0, (
+            "reader did not fall back while the stamp was odd"
+        )
+        await put_task
+        # Settled: the new generation serves one-sided again.
+        out2 = await ts.get("k", like=np.zeros_like(a), store_name="os_fault")
+        assert np.array_equal(np.asarray(out2), b)
+        await ts.clear_faults(store_name="os_fault")
+    finally:
+        await ts.shutdown("os_fault")
+
+
+async def test_epoch_bump_drops_one_sided_plans():
+    """Quarantine/repair transitions bump the placement epoch; the client
+    must drop every cached one-sided plan with it (stale placement)."""
+    await ts.initialize(
+        store_name="os_epoch",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        a = np.random.rand(64).astype(np.float32)
+        await ts.put("k", a, store_name="os_epoch")
+        await ts.get("k", like=np.zeros_like(a), store_name="os_epoch")
+        client = ts.client("os_epoch")
+        cache = client._ctx.peek(shm_mod.ShmClientCache)
+        assert cache is not None and cache.one_sided, "plan was not recorded"
+        await client.bump_placement_epoch()
+        assert not cache.one_sided, "epoch bump did not drop one-sided plans"
+        # Correctness after the drop: the RPC path re-records and serves.
+        out = await ts.get("k", like=np.zeros_like(a), store_name="os_epoch")
+        assert np.array_equal(np.asarray(out), a)
+        assert cache.one_sided
+    finally:
+        await ts.shutdown("os_epoch")
+
+
+# --------------------------------------------------------------------------
+# fleet: bulk doorbell
+# --------------------------------------------------------------------------
+
+
+async def test_bulk_doorbell_warm_batch():
+    """Cross-host rung (bulk transport): the second identical get_batch
+    rings ONE doorbell instead of the get RPC + per-key frames, serves
+    fresh bytes against the cached plan after an overwrite, and falls back
+    loudly when the volume no longer knows the plan."""
+    await ts.initialize(
+        store_name="os_bulk",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+    try:
+        items = {
+            f"d/{i}": np.random.rand(128).astype(np.float32) for i in range(4)
+        }
+        await ts.put_batch(items, store_name="os_bulk")
+        out1 = await ts.get_batch(list(items), store_name="os_bulk")
+        for k, v in items.items():
+            assert np.array_equal(np.asarray(out1[k]), v)
+        reads0 = _counter("ts_one_sided_reads_total", transport="bulk")
+        out2 = await ts.get_batch(list(items), store_name="os_bulk")
+        for k, v in items.items():
+            assert np.array_equal(np.asarray(out2[k]), v)
+        assert (
+            _counter("ts_one_sided_reads_total", transport="bulk")
+            >= reads0 + len(items)
+        ), "warm batch did not ride the doorbell"
+
+        # Same cached plan, NEW bytes: that is the point of the doorbell.
+        items2 = {k: (v * 3).astype(np.float32) for k, v in items.items()}
+        await ts.put_batch(items2, store_name="os_bulk")
+        out3 = await ts.get_batch(list(items), store_name="os_bulk")
+        for k, v in items2.items():
+            assert np.array_equal(np.asarray(out3[k]), v)
+
+        # Unknown plan at the volume -> miss frame -> loud RPC fallback.
+        from torchstore_tpu.transport.bulk import BulkClientCache
+
+        client = ts.client("os_bulk")
+        bcache = client._ctx.peek(BulkClientCache)
+        assert bcache is not None and bcache.doorbells
+        for entry in bcache.doorbells.values():
+            entry["plan_id"] = 12345
+        fb0 = _counter(
+            "ts_one_sided_fallbacks_total", reason="doorbell_unknown_plan"
+        )
+        out4 = await ts.get_batch(list(items), store_name="os_bulk")
+        for k, v in items2.items():
+            assert np.array_equal(np.asarray(out4[k]), v)
+        assert (
+            _counter(
+                "ts_one_sided_fallbacks_total", reason="doorbell_unknown_plan"
+            )
+            > fb0
+        )
+    finally:
+        await ts.shutdown("os_bulk")
+
+
+# --------------------------------------------------------------------------
+# get_batch plan seeding
+# --------------------------------------------------------------------------
+
+
+async def test_get_batch_seeds_plan_cache_and_goes_zero_rpc():
+    """The satellite fix: get_batch populates the iteration-stable plan
+    cache (previously only state-dict ops did), and a warm fully-covered
+    batch is served one-sided with ZERO RPCs — no locate, no epoch check,
+    no gets (the covered-batch fast path runs before the plan-cache layer
+    even looks, so the hit counter stays put while the one-sided read
+    counter moves)."""
+    await ts.initialize(
+        store_name="os_batch",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        items = {
+            f"b/{i}": np.random.rand(64).astype(np.float32) for i in range(8)
+        }
+        await ts.put_batch(items, store_name="os_batch")
+        targets = {k: np.zeros_like(v) for k, v in items.items()}
+        await ts.get_batch(dict(targets), store_name="os_batch")
+        client = ts.client("os_batch")
+        # The cold batch seeded an iteration-stable plan (satellite claim).
+        assert any(
+            op == "get_batch" for op, _, _ in client.plan_cache.entries
+        ), "cold get_batch did not seed the plan cache"
+        rpcs0 = await _volume_get_rpcs(client)
+        reads0 = _counter("ts_one_sided_reads_total", transport="shm")
+        out = await ts.get_batch(
+            {k: np.zeros_like(v) for k, v in items.items()},
+            store_name="os_batch",
+        )
+        for k, v in items.items():
+            assert np.array_equal(out[k], v)
+        assert (
+            _counter("ts_one_sided_reads_total", transport="shm")
+            >= reads0 + len(items)
+        ), "warm covered batch was not served one-sided"
+        assert await _volume_get_rpcs(client) == rpcs0, (
+            "warm covered batch still issued get RPCs"
+        )
+    finally:
+        await ts.shutdown("os_batch")

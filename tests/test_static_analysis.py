@@ -716,3 +716,68 @@ def test_cli_fail_on_new_reports_seeded_defect(tmp_path):
     )
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "putt" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# one-sided-discipline
+# --------------------------------------------------------------------------
+
+
+def test_one_sided_discipline_flags_raw_segment_reads(tmp_path):
+    """one-sided-discipline: raw seg.view/strided_view and frombuffer(mmap)
+    reads in client/direct modules are flagged; the blessed accessors and
+    out-of-scope modules (the transport itself, numpy dtype-views) pass."""
+    from torchstore_tpu.analysis.checkers import one_sided
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/client.py": """
+                import numpy as np
+                def bad(seg, meta, plan):
+                    a = seg.strided_view(meta, 0, None)  # seeded defect
+                    b = seg.view(meta)  # seeded defect
+                    c = np.frombuffer(seg.mmap, dtype=np.uint64)  # seeded
+                    return a, b, c
+                def fine(arr):
+                    return arr.view(np.uint8)  # numpy dtype view: no segment
+            """,
+            "torchstore_tpu/direct_weight_sync.py": """
+                from torchstore_tpu.transport import shared_memory as shm
+                def good(seg, meta):
+                    return shm.segment_read_view(seg, meta)  # blessed path
+            """,
+            "torchstore_tpu/transport/shared_memory.py": """
+                def stamped_read(seg, meta):
+                    return seg.strided_view(meta, 0, None)  # implements it
+            """,
+        },
+    )
+    findings = one_sided.check(project)
+    assert len(findings) == 3
+    assert all(f.path == "torchstore_tpu/client.py" for f in findings)
+    assert all("segment_read_view" in f.message for f in findings)
+
+
+def test_one_sided_discipline_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/direct_weight_sync.py": """
+                def writer(seg, meta):
+                    # writer side publishes the seqlock itself
+                    return seg.view(meta)  # tslint: disable=one-sided-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["one-sided-discipline"])
+    assert result.new == []
+
+
+def test_one_sided_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays empty):
+    every client/direct segment read goes through the stamped helpers, and
+    the one writer-side staging view carries its justified pragma."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["one-sided-discipline"])
+    assert _msgs(result.findings, "one-sided-discipline") == []

@@ -16,6 +16,7 @@ from torchstore_tpu.analysis.checkers import (
     fork_safety,
     landing_copy,
     metric_discipline,
+    one_sided,
     orphan_task,
     retry_discipline,
 )
@@ -30,4 +31,5 @@ CHECKERS = {
     metric_discipline.RULE: metric_discipline.check,
     landing_copy.RULE: landing_copy.check,
     retry_discipline.RULE: retry_discipline.check,
+    one_sided.RULE: one_sided.check,
 }

@@ -1,0 +1,112 @@
+"""one-sided-discipline: client/direct modules read segments ONLY stamped.
+
+The one-sided data plane (PR 7) lets client-side code read bytes straight
+out of attached /dev/shm segments with zero RPCs. That is only sound when
+every such read is bracketed by a seqlock/generation validation — the
+per-entry stamp table (``shared_memory.stamped_read`` /
+``stamped_read_batch``) or the direct-sync source-generation check
+around ``segment_read_view``. A raw ``seg.view(...)`` /
+``seg.strided_view(...)`` / ``np.frombuffer(seg.mmap, ...)`` in a client
+or direct module bypasses that validation and can observe mixed-generation
+bytes whenever a landing races the read — the exact silent-corruption
+class the stamp protocol exists to kill.
+
+Rule: in the client-side modules (client.py, direct_weight_sync.py,
+state_dict_utils.py), attached-segment reads must go through
+``shared_memory.segment_read_view`` (whose contract requires the
+surrounding validation) or the stamped-read helpers. Flagged patterns:
+
+- any ``X.strided_view(...)`` call (only segments have strided_view);
+- ``X.view(...)`` where the receiver names a segment (identifier contains
+  ``seg``) — numpy's dtype-``view`` on arrays stays out of scope;
+- ``np.frombuffer(X.mmap, ...)`` — a raw mapping read.
+
+``transport/shared_memory.py`` itself and the volume/transport server side
+are out of scope: they implement the protocol (and the volume is the
+writer — its reads of its own segments are serialized by the event loop).
+Writer-side staging uses in direct_weight_sync carry a pragma with the
+seqlock justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name
+
+RULE = "one-sided-discipline"
+
+_SCOPED_FILES = (
+    "torchstore_tpu/client.py",
+    "torchstore_tpu/direct_weight_sync.py",
+    "torchstore_tpu/state_dict_utils.py",
+)
+
+_MESSAGE = (
+    "raw attached-segment read in a client/direct module: route it through "
+    "shared_memory.segment_read_view / stamped_read (seqlock-validated) — "
+    "an unstamped read can observe mixed-generation bytes"
+)
+
+
+def _receiver_names_segment(node: ast.expr) -> bool:
+    """True when the attribute receiver's source identifiers suggest a
+    segment object (``seg``, ``segment``, ``self._segments[...]`` ...)."""
+    dotted = dotted_name(node)
+    if dotted is not None:
+        return "seg" in dotted.lower()
+    # Subscripts like self._segments[name] have no dotted name; scan ids.
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "seg" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "seg" in sub.attr.lower():
+            return True
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path not in _SCOPED_FILES:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "strided_view":
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=_MESSAGE,
+                        )
+                    )
+                    continue
+                if func.attr == "view" and _receiver_names_segment(func.value):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=_MESSAGE,
+                        )
+                    )
+                    continue
+            dotted = dotted_name(func)
+            if dotted in ("np.frombuffer", "numpy.frombuffer") and node.args:
+                first = node.args[0]
+                if (
+                    isinstance(first, ast.Attribute)
+                    and first.attr == "mmap"
+                ):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=_MESSAGE,
+                        )
+                    )
+    return findings

@@ -69,6 +69,13 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "signature), invalidated by the controller's placement epoch, "
            "so repeated RL-sync iterations skip re-validation and "
            "re-locate."),
+    EnvVar("TORCHSTORE_TPU_ONE_SIDED", "bool", True,
+           "One-sided data plane for warm gets: same-host readers with a "
+           "cached plan read stamped (seqlock-validated) bytes directly "
+           "from pre-attached SHM segments with zero RPCs; cross-host "
+           "readers ring a bulk doorbell frame against a volume-cached "
+           "get plan instead of issuing the get RPC. Torn/stale reads "
+           "fall back loudly to the RPC path."),
     # --- cold-start provisioning (prewarm) ----------------------------------
     EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
            "put_state_dict derives a manifest and provisions pools/dials "
@@ -368,6 +375,13 @@ class StoreConfig:
     # Iteration-stable transfer-plan cache for put/get_state_dict.
     plan_cache: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_PLAN_CACHE", True)
+    )
+    # One-sided data plane: warm same-host gets are seqlock-stamped direct
+    # segment reads (zero RPCs); warm cross-host gets ring a bulk doorbell
+    # against a volume-cached plan. Stale/torn reads fail over loudly to
+    # the RPC path and bump ts_one_sided_fallbacks_total.
+    one_sided: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_ONE_SIDED", True)
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

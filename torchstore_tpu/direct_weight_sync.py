@@ -393,7 +393,10 @@ class DirectWeightSyncSource:
                     seg = local_pool().take(max(host_arr.nbytes, 1))
                     if seg is None:
                         seg = shm.ShmSegment.create(max(host_arr.nbytes, 1))
-                    staged = seg.view(TensorMeta.of(host_arr))
+                    # WRITER side: this module publishes the generation
+                    # seqlock that brackets these staging writes (readers
+                    # validate against it) — not an unstamped read.
+                    staged = seg.view(TensorMeta.of(host_arr))  # tslint: disable=one-sided-discipline
                     copy_into(staged, host_arr)
                     self.segments[buffer_id] = seg
                     self.server.buffers[buffer_id] = staged
@@ -1385,14 +1388,18 @@ class DirectWeightSyncDest:
         ``(shard-shaped array rows, first_row)``."""
         shape = handle.meta.shape
         if handle.shm_name is not None and handle.hostname == get_hostname():
-            # Attach is free — no transfer to range.
+            # Attach is free — no transfer to range. The blessed one-sided
+            # accessor: the surrounding pull() brackets this read with the
+            # source's generation seqlock (_stable_gens before, gens
+            # re-read after), so a torn read is detected and retried.
             seg = self._segments.get(handle.shm_name)
             if seg is None:
                 seg = shm.ShmSegment.attach(
                     handle.shm_name, max(handle.meta.nbytes, 1), populate=True
                 )
                 self._segments[handle.shm_name] = seg
-            return np.asarray(seg.view(handle.meta)).reshape(shape), 0
+            view = shm.segment_read_view(seg, handle.meta)
+            return np.asarray(view).reshape(shape), 0
         # Same-host TCP reads dial loopback (the container hostname may not
         # route back to this process); cross-host uses the advertised name.
         host = (

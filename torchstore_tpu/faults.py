@@ -15,6 +15,11 @@ store's hot paths:
     volume.get            StorageVolume.get entry
     volume.handshake      StorageVolume.handshake entry (all transports)
     shm.handshake         SHM server-side recv_handshake (volume process)
+    shm.landing_stamp     volume-side entry-stamp bracket: fires after the
+                          per-entry seqlock goes odd, before the landing is
+                          applied — delay/wedge holds entries visibly
+                          write-in-flight so one-sided readers observe the
+                          odd stamp and fall back
     actor.ping            ActorServer control-ping (per process: arming it
                           inside a volume wedges THAT volume's heartbeats)
     bulk.send_frame       bulk transport frame send (client and server)
@@ -78,6 +83,7 @@ REGISTRY: frozenset[str] = frozenset(
         "volume.get",
         "volume.handshake",
         "shm.handshake",
+        "shm.landing_stamp",
         "actor.ping",
         "bulk.send_frame",
         "bulk.recv_frame",

@@ -31,6 +31,8 @@ _load_attempted = False
 # True once a v2+ library bound the threaded-prefault entry (v1 binaries
 # carry an incompatible 2-arg ts_prefault that must never be called).
 _has_prefault = False
+# True once a v3+ library bound the batched scatter memcpy.
+_has_copy_batch = False
 
 
 def _try_build() -> bool:
@@ -87,7 +89,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.ts_write_fd.restype = ctypes.c_int64
         lib.ts_version.restype = ctypes.c_uint32
         version = lib.ts_version()
-        assert version in (1, 2), version
+        assert version in (1, 2, 3), version
         if version >= 2:
             # v2: multi-threaded page prefault (the provisioning subsystem's
             # prewarm entry). v1 binaries carry an incompatible 2-arg
@@ -100,6 +102,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _has_prefault = True
         else:
             logger.info("native library is v1 (no threaded prefault)")
+        if version >= 3:
+            # v3: batched scatter memcpy (the one-sided warm get's landing
+            # loop). v2 binaries fall back to the per-pair Python loop.
+            lib.ts_copy_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.ts_copy_batch.restype = None
+            global _has_copy_batch
+            _has_copy_batch = True
         _lib = lib
         logger.info("native data path loaded (%s)", _LIB_PATH)
     except Exception as exc:
@@ -110,6 +122,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def copy_batch_available() -> bool:
+    """True when the v3 batched scatter memcpy is bound (callers build the
+    pointer arrays only when the call can actually happen)."""
+    return get_lib() is not None and _has_copy_batch
 
 
 def _addr(arr: np.ndarray) -> int:
@@ -159,6 +177,31 @@ def copy_into(dst: np.ndarray, src: np.ndarray) -> None:
     if fast_copy_2d(dst, src):
         return
     np.copyto(dst, src)
+
+
+def copy_batch(
+    dst_addrs: np.ndarray,
+    src_addrs: np.ndarray,
+    lens: np.ndarray,
+    nthreads: int = 0,
+) -> bool:
+    """Batched scatter memcpy: one GIL-free native call lands ``len(lens)``
+    independent (dst, src, len) copies, byte-balanced across threads. The
+    caller OWNS eligibility: every pair must be same-size, both sides
+    C-contiguous, and non-overlapping (the landing layer checks this).
+    Arrays must be uint64 and C-contiguous. Returns False when the library
+    is absent or pre-v3 — the caller runs its per-pair Python loop."""
+    lib = get_lib()
+    if lib is None or not _has_copy_batch:
+        return False
+    n = len(lens)
+    if n == 0:
+        return True
+    lib.ts_copy_batch(
+        dst_addrs.ctypes.data, src_addrs.ctypes.data, lens.ctypes.data,
+        n, nthreads,
+    )
+    return True
 
 
 def prefault(addr: int, length: int, nthreads: int = 0) -> bool:

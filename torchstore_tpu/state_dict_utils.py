@@ -350,6 +350,12 @@ def _leaf_signature(value: Any) -> tuple:
     """Hashable shape/dtype/sharding signature of one flat leaf — the unit
     the plan cache keys on. Signature equality means the leaf decomposes
     into byte-identical requests, so a cached plan replays exactly."""
+    if type(value) is np.ndarray:
+        # Exact-type fast path first: plain numpy leaves dominate trainer
+        # state dicts, and this runs per leaf per warm iteration — the
+        # jax/shard probes below cost more than the whole signature.
+        # (.shape is already a tuple; .str is a C attribute.)
+        return ("np", value.shape, value.dtype.str)
     sig = shd.plan_signature(value)
     if sig is not None:
         return sig
@@ -371,7 +377,11 @@ def _leaf_signature(value: Any) -> tuple:
     if torch_interop.is_torch_tensor(value):
         return ("torch", tuple(value.shape), str(value.dtype))
     if isinstance(value, np.ndarray):
-        return ("np", tuple(value.shape), str(value.dtype))
+        # dtype.str (C attribute), not str(dtype): this runs per leaf per
+        # warm iteration, and dtype.__str__'s name derivation was ~2ms per
+        # 512-leaf signature on the warm get path. Signatures are opaque
+        # cache keys, only ever compared to each other.
+        return ("np", tuple(value.shape), value.dtype.str)
     return ("obj",)  # opaque objects re-pickle every iteration anyway
 
 
@@ -759,12 +769,23 @@ async def get_state_dict(
         signature = (
             _flat_signature(user_flat) if user_flat is not None else ("none",)
         )
-        if cache.peek("get", key, signature) is not None:
+        peeked = cache.peek("get", key, signature)
+        if peeked is not None:
             # ONE epoch RPC validates the whole cached plan (instead of a
             # commit-marker fetch + per-key structure checks); a bumped
             # epoch invalidates it right here and falls through to the
-            # full path.
-            await client.placement_epoch()
+            # full path. Skipped entirely when every target is covered by
+            # a one-sided plan (same rule as get_batch seeding): the
+            # per-entry stamps self-validate, so the warm sync iteration
+            # makes ZERO RPCs.
+            covers = getattr(client, "one_sided_covers_items", None)
+            if covers is None or not covers(
+                [
+                    (sk, user_flat is not None and fetch)
+                    for _, sk, fetch in peeked.get("targets", ())
+                ]
+            ):
+                await client.placement_epoch()
             plan = cache.lookup("get", key, signature)
             if plan is not None:
                 return await _get_with_plan(
@@ -810,7 +831,10 @@ async def get_state_dict(
                 targets[_store_key(key, k)] = _quant_fetch_target(v)
             else:
                 targets[_store_key(key, k)] = v if _is_fetch_target(v) else None
-        fetched = await client.get_batch(targets)
+        # _seed_plan=False: this op owns its SyncPlanCache entry (op="get")
+        # and already validated the epoch above — the batch-level seeding
+        # inside get_batch would double-book both.
+        fetched = await client.get_batch(targets, _seed_plan=False)
         flat = {}
         for k, v in user_flat.items():
             got = fetched[_store_key(key, k)]
@@ -821,7 +845,7 @@ async def get_state_dict(
     else:
         leaf_keys = sorted(_leaf_keys(mapping))
         fetched = await client.get_batch(
-            {_store_key(key, k): None for k in leaf_keys}
+            {_store_key(key, k): None for k in leaf_keys}, _seed_plan=False
         )
         flat = {}
         for k in leaf_keys:
@@ -874,7 +898,7 @@ async def _get_with_plan(client, plan, user_flat, user_mapping, tracker):
         sk: (user_flat[k] if fetch and user_flat is not None else None)
         for k, sk, fetch in plan["targets"]
     }
-    fetched = await client.get_batch(targets)
+    fetched = await client.get_batch(targets, _seed_plan=False)
     flat = {k: fetched[sk] for k, sk, _ in plan["targets"]}
     nbytes = sum(getattr(v, "nbytes", 0) for v in flat.values())
     tracker.track_step("get_batch_planned", nbytes)

@@ -262,6 +262,17 @@ class StorageVolume(Actor):
                 storage = InMemoryStore()
         self.store = storage
         self.ctx = TransportContext()
+        # Volume-wide landing bracket: ``_landing_inflight`` counts open
+        # landings (puts/pulls/deletes interleave at awaits — actor
+        # endpoints dispatch as independent tasks, so parity of a shared
+        # counter says nothing); ``_landing_stamp`` only ever increases,
+        # bumped at every bracket open AND close so an unchanged stamp
+        # plus inflight==0 at both ends of a doorbell pack proves no
+        # landing touched entries meanwhile. Per-entry precision lives in
+        # the SHM stamp table; this pair covers entries no stamp table
+        # describes (bulk/rpc-stored plain arrays).
+        self._landing_stamp = 0
+        self._landing_inflight = 0
         # Per-key write generation: microsecond timestamp (strictly
         # monotonic per key via max(prev+1, now)). Assigned on every
         # successful put, echoed to the client in the put reply, forwarded
@@ -287,6 +298,17 @@ class StorageVolume(Actor):
             # Crashed processes leave /dev/shm segments behind; sweep any
             # whose creator pid is gone before this volume starts serving.
             shared_memory.reap_orphaned_segments()
+        # One-sided cross-host gets: doorbell frames on the bulk socket read
+        # this volume's store directly (same process, no RPC dispatch).
+        self._install_doorbell_hook()
+
+    def _install_doorbell_hook(self) -> None:
+        """Point the bulk server's doorbell at this volume's store. Eager is
+        free — the BulkServer only binds a listener at the first bulk
+        handshake. Re-run after reset(): ctx.clear() drops cache instances."""
+        from torchstore_tpu.transport.bulk import BulkServerCache
+
+        self.ctx.get_cache(BulkServerCache).server.doorbell_volume = self
 
     @endpoint
     async def get_id(self) -> dict:
@@ -342,17 +364,85 @@ class StorageVolume(Actor):
             return int(meta.tensor_meta.nbytes)
         return int(meta.nbytes)
 
+    # ---- one-sided stamp brackets ----------------------------------------
+
+    def _shm_cache(self):
+        from torchstore_tpu.transport.shared_memory import ShmServerCache
+
+        return self.ctx.peek(ShmServerCache)
+
+    @staticmethod
+    def _stamp_pairs(metas: list[Request]) -> list[tuple]:
+        return [
+            (
+                meta.key,
+                meta.tensor_slice.coordinates if meta.tensor_slice else None,
+            )
+            for meta in metas
+        ]
+
+    def _landing_open(self) -> None:
+        """Open the volume-wide landing bracket: doorbell serves racing
+        this landing see inflight != 0 (busy) or a moved stamp (torn)."""
+        self._landing_inflight += 1
+        self._landing_stamp += 1
+
+    def _landing_close(self) -> None:
+        self._landing_inflight -= 1
+        self._landing_stamp += 1
+
+    async def _begin_landing(self, pairs: list[tuple]) -> None:
+        """Open the one-sided write bracket: per-entry seqlock stamps go odd
+        for every existing entry about to be (re)written — BEFORE any
+        transport lands bytes that could alias entry memory (the bulk/rpc
+        in-place overwrite paths) — and the volume-wide landing bracket
+        opens so doorbell serves in flight declare themselves torn. The
+        ``shm.landing_stamp`` faultpoint fires inside the bracket (async:
+        a delay/wedge holds entries visibly write-in-flight without
+        freezing the event loop's RPC fallback path)."""
+        cache = self._shm_cache()
+        if cache is not None:
+            cache.begin_writes(pairs)
+        self._landing_open()
+        try:
+            await faults.afire("shm.landing_stamp")
+        except BaseException:
+            # A raise-action fault (or cancellation during a delay/wedge)
+            # escapes before the caller's try/finally is armed: close the
+            # bracket here or inflight/nesting leak forever — every future
+            # doorbell answers busy and stamps never settle even again.
+            self._end_landing(pairs)
+            raise
+
+    def _end_landing(self, pairs: list[tuple]) -> None:
+        """Close the bracket: written entries settle at their next EVEN
+        generation (fresh entries get slots) strictly before the put RPC
+        dispatch returns — i.e. before any retired segment could be
+        re-offered to another writer, which is what makes a one-sided
+        reader's post-copy re-check sound. Runs in a finally: a FAILED
+        landing also settles (at a new generation), so cached plans built
+        against the old bytes fall back instead of wedging odd forever."""
+        cache = self._shm_cache()
+        if cache is not None:
+            cache.end_writes(pairs)
+        self._landing_close()
+
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
         await faults.afire("volume.put")
         t0 = time.perf_counter()
-        existing = self.store.extract_existing(metas)
-        values = await maybe_await(
-            buffer.handle_put_request(self.ctx, metas, existing)
-        )
-        affected = {meta.key for meta in metas}
-        before = sum(self._entry_nbytes(k) for k in affected)
-        self.store.store(metas, values)
+        pairs = self._stamp_pairs(metas)
+        await self._begin_landing(pairs)
+        try:
+            existing = self.store.extract_existing(metas)
+            values = await maybe_await(
+                buffer.handle_put_request(self.ctx, metas, existing)
+            )
+            affected = {meta.key for meta in metas}
+            before = sum(self._entry_nbytes(k) for k in affected)
+            self.store.store(metas, values)
+        finally:
+            self._end_landing(pairs)
         self._apply_residency_delta(affected, before)
         _PUT_OPS.inc(volume=self.volume_id)
         # Data-plane profiling: this volume's own hot-key view + slow-op
@@ -405,11 +495,18 @@ class StorageVolume(Actor):
         # (/root/reference/torchstore/api.py:308).
         deleted = 0
         before = sum(self._entry_nbytes(k) for k in keys)
-        for key in keys:
-            if self.store.delete(key):
-                self.ctx.delete_key(key)
-                deleted += 1
-            self._write_gens.pop(key, None)
+        # Coarse landing bracket for in-flight doorbell serves; the
+        # per-entry stamps are tombstoned by ctx.delete_key (one-sided
+        # readers of deleted entries fall back from their first check).
+        self._landing_open()
+        try:
+            for key in keys:
+                if self.store.delete(key):
+                    self.ctx.delete_key(key)
+                    deleted += 1
+                self._write_gens.pop(key, None)
+        finally:
+            self._landing_close()
         self._apply_residency_delta(keys, before)
         return deleted
 
@@ -432,20 +529,24 @@ class StorageVolume(Actor):
         kept_gens: dict[str, int] = {}
         affected = [key for key, _ in items]
         before = sum(self._entry_nbytes(k) for k in affected)
-        for key, stale_gen in items:
-            current = self._write_gens.get(key)
-            if current is not None and current > stale_gen:
-                # ``kept_gens`` lets the controller re-verify later: if the
-                # fresh put's notify never arrives (client died between
-                # data-plane ack and notify), a follow-up conditional
-                # delete at THIS generation reclaims the orphaned bytes.
-                kept_fresh.append(key)
-                kept_gens[key] = current
-                continue
-            if self.store.delete(key):
-                self.ctx.delete_key(key)
-                removed.append(key)
-            self._write_gens.pop(key, None)
+        self._landing_open()
+        try:
+            for key, stale_gen in items:
+                current = self._write_gens.get(key)
+                if current is not None and current > stale_gen:
+                    # ``kept_gens`` lets the controller re-verify later: if the
+                    # fresh put's notify never arrives (client died between
+                    # data-plane ack and notify), a follow-up conditional
+                    # delete at THIS generation reclaims the orphaned bytes.
+                    kept_fresh.append(key)
+                    kept_gens[key] = current
+                    continue
+                if self.store.delete(key):
+                    self.ctx.delete_key(key)
+                    removed.append(key)
+                self._write_gens.pop(key, None)
+        finally:
+            self._landing_close()
         self._apply_residency_delta(affected, before)
         return {
             "removed": removed,
@@ -485,7 +586,14 @@ class StorageVolume(Actor):
                 values[idx] = remote.tensors[idx]
         affected = {meta.key for meta in metas}
         before = sum(self._entry_nbytes(k) for k in affected)
-        self.store.store(metas, values)
+        # Repair pull is a landing like any put: bracket it so one-sided
+        # readers of entries it replaces fall back instead of tearing.
+        pairs = self._stamp_pairs(metas)
+        await self._begin_landing(pairs)
+        try:
+            self.store.store(metas, values)
+        finally:
+            self._end_landing(pairs)
         self._apply_residency_delta(affected, before)
         return {"write_gens": self._bump_write_gens(metas)}
 
@@ -659,8 +767,13 @@ class StorageVolume(Actor):
 
     @endpoint
     async def reset(self) -> None:
-        self.store.reset()
-        self.ctx.clear()
-        self._write_gens.clear()
+        self._landing_open()
+        try:
+            self.store.reset()
+            self.ctx.clear()  # tombstones + unlinks the stamp table
+            self._write_gens.clear()
+        finally:
+            self._landing_close()
+        self._install_doorbell_hook()
         self._resident_bytes = 0
         self._publish_residency()

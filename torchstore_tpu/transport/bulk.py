@@ -73,7 +73,28 @@ IDX_STRIPED = 0xFFFFFFFC
 # one sendall instead of 2048 framed sends — the DCN analog of the SHM
 # arena. Not a control index: the server stores it like any payload.
 IDX_PACKED = 0xFFFFFFFB
+# One-sided warm get: the client rings "plan N ready?" with an 8-byte plan
+# id instead of a get RPC; the volume streams every member of the cached
+# plan back in a single IDX_PACKED reply (bracketed by its landing stamp),
+# or answers with an IDX_DOORBELL miss frame carrying a 1-byte reason —
+# the client then falls back loudly to the RPC path.
+IDX_DOORBELL = 0xFFFFFFFA
 _CONTROL_IDXS = frozenset({IDX_HELLO, IDX_ABORT, IDX_SESSION_OPEN, IDX_STRIPED})
+
+_U64 = struct.Struct("<Q")
+
+# Doorbell miss reasons (1-byte reply payload -> fallback metric label).
+_DOORBELL_MISS = {
+    0: "unknown_plan",
+    1: "missing_key",
+    2: "meta_drift",
+    3: "torn",
+    4: "busy",
+}
+
+# Server-side cached get plans awaiting doorbells; wholesale clear on
+# overflow (a warm working set re-registers in one iteration).
+DOORBELL_PLANS_MAX = 512
 
 _STRIPE = struct.Struct("<IQQ")  # real_idx, offset, total_nbytes
 # Payloads above this are striped across STRIPE_CONNS connections.
@@ -229,6 +250,12 @@ class BulkServer:
         # that connection's fd is closed (deterministic teardown — no
         # sleep-based grace period).
         self._send_tasks: dict[socket.socket, set[asyncio.Task]] = {}
+        # One-sided doorbell state: the StorageVolume of this process (set
+        # by the volume at init — doorbell serves read its store directly,
+        # no RPC dispatch) and the registered get plans
+        # (plan_id -> {"metas": [Request], "serve_metas": [TensorMeta]}).
+        self.doorbell_volume: Optional[Any] = None
+        self.get_plans: dict[int, dict] = {}
 
     async def ensure_started(self, bind_host: str) -> tuple[str, int]:
         if self._listen_sock is None:
@@ -325,6 +352,20 @@ class BulkServer:
                         conns.append((sock, conn_lock))
                     self._session_ts[session] = _now()
                     await _send_frame(sock, conn_lock, session, IDX_SESSION_OPEN, None)
+                    continue
+                if idx == IDX_DOORBELL:
+                    payload = bytearray(nbytes)
+                    await _recv_exact(sock, memoryview(payload))
+                    (plan_id,) = _U64.unpack(payload[:8])
+                    # Serve off the reader loop (the pack copies must not
+                    # block this connection's frame parsing); tracked in
+                    # _send_tasks so teardown joins it before closing the fd.
+                    spawn_logged(
+                        self._serve_doorbell(session, plan_id, sock, conn_lock),
+                        name="bulk.doorbell",
+                        tasks=self._send_tasks.setdefault(sock, set()),
+                        log=logger,
+                    )
                     continue
                 if idx == IDX_ABORT:
                     async with self._arrival:
@@ -522,6 +563,90 @@ class BulkServer:
             sock, lock = conns[0]
             _track(sock, _send_plain(sock, lock, plain))
 
+    def register_plan(self, metas, serve_metas) -> int:
+        """Cache a served get batch as a doorbell plan; returns the plan id
+        the client rings to repeat the batch without the get RPC."""
+        if len(self.get_plans) >= DOORBELL_PLANS_MAX:
+            self.get_plans.clear()
+        plan_id = _new_id()
+        self.get_plans[plan_id] = {
+            "metas": list(metas),
+            "serve_metas": list(serve_metas),
+        }
+        return plan_id
+
+    async def _serve_doorbell(
+        self,
+        session: int,
+        plan_id: int,
+        sock: socket.socket,
+        lock: asyncio.Lock,
+    ) -> None:
+        """Answer one doorbell: re-read every member of the cached plan from
+        the volume's store, pack them at the shared arena layout, and stream
+        ONE IDX_PACKED frame back — bracketed by the volume's landing stamp
+        so a reply that raced ANY landing is declared torn (miss frame) and
+        the client falls back to the RPC path, which serves a consistent
+        snapshot. Replies ride the session's registered connection."""
+        from torchstore_tpu.transport import landing
+
+        self.session_conns.pop(session, None)
+
+        async def miss(code: int) -> None:
+            try:
+                await _send_frame(
+                    sock, lock, session, IDX_DOORBELL, memoryview(bytes([code]))
+                )
+            except (ConnectionError, OSError):
+                pass  # client gone: its timeout owns the fallback
+
+        vol = self.doorbell_volume
+        plan = self.get_plans.get(plan_id)
+        if vol is None or plan is None:
+            return await miss(0)
+        stamp0 = vol._landing_stamp
+        if vol._landing_inflight:
+            return await miss(4)  # a landing is mid-flight right now
+        arrays: list[np.ndarray] = []
+        try:
+            for meta, expect in zip(plan["metas"], plan["serve_metas"]):
+                arr = np.ascontiguousarray(vol.store.get_data(meta))
+                if TensorMeta.of(arr) != expect:
+                    # Shape/dtype drift since registration: the client's
+                    # cached unpack layout no longer matches.
+                    del self.get_plans[plan_id]
+                    return await miss(2)
+                arrays.append(arr)
+        except KeyError:
+            del self.get_plans[plan_id]
+            return await miss(1)
+        offsets, total = landing.compute_arena_layout(
+            [a.nbytes for a in arrays]
+        )
+        packed = np.empty(total, np.uint8)
+        pairs = [
+            (
+                packed[off : off + a.nbytes],
+                np.frombuffer(a, dtype=np.uint8),
+            )
+            for a, off in zip(arrays, offsets)
+            if a.nbytes
+        ]
+        await landing.land_async(pairs, stage="doorbell")
+        if vol._landing_inflight or vol._landing_stamp != stamp0:
+            # A put/delete landed (or is still landing) while we packed:
+            # the packed bytes may mix generations — never serve them.
+            # The stamp bumps at every bracket open, so inflight==0 at
+            # both ends plus an unchanged stamp proves no overlap even
+            # when landings themselves overlapped each other.
+            return await miss(3)
+        try:
+            await _send_frame(
+                sock, lock, session, IDX_PACKED, memoryview(packed)
+            )
+        except (ConnectionError, OSError):
+            pass
+
 
 class BulkServerCache(TransportCache):
     def __init__(self) -> None:
@@ -684,6 +809,20 @@ class BulkClientCache(TransportCache):
         self.connections: dict[str, BulkClientConn] = {}
         self.stripe_conns: dict[str, list[BulkClientConn]] = {}
         self.endpoints: dict[str, tuple[str, int]] = {}
+        # One-sided doorbell plans: (volume_id, request signature) ->
+        # {"plan_id", "metas": [TensorMeta], "offsets", "total"} recorded
+        # from plan-annotated get replies. Dropped wholesale on placement-
+        # epoch bumps (the client owns that) and per-plan on any miss.
+        self.doorbells: dict[tuple, dict] = {}
+
+    DOORBELLS_MAX = 4096
+
+    def drop_one_sided(self) -> int:
+        """Drop every cached doorbell plan (placement-epoch bump: the
+        placement the plans describe changed)."""
+        n = len(self.doorbells)
+        self.doorbells.clear()
+        return n
 
     def get_alive(self, volume_id: str) -> Optional[BulkClientConn]:
         conn = self.connections.get(volume_id)
@@ -715,6 +854,10 @@ class BulkClientCache(TransportCache):
             pass
         return conns
 
+    def delete_key(self, key: str) -> None:
+        for dkey in [d for d in self.doorbells if any(k == key for k, _ in d[1])]:
+            del self.doorbells[dkey]
+
     def clear(self) -> None:
         for conn in self.connections.values():
             conn.close_now()
@@ -724,6 +867,7 @@ class BulkClientCache(TransportCache):
                 conn.close_now()
         self.stripe_conns.clear()
         self.endpoints.clear()
+        self.doorbells.clear()
 
 
 async def prewarm_connection(
@@ -806,6 +950,10 @@ class BulkTransportBuffer(TransportBuffer):
         self.packed_total = 0
         self.objects: dict[int, Any] = {}
         self.descriptors: dict[int, TensorMeta] = {}
+        # Doorbell plan id advertised by the server in the get reply (the
+        # client caches it and rings it instead of the next identical get
+        # RPC); None when the batch is not one-sided-servable.
+        self.doorbell_plan: Optional[int] = None
         # client-only live state
         self._conn: Optional[BulkClientConn] = None
         self._promoted = False
@@ -864,8 +1012,53 @@ class BulkTransportBuffer(TransportBuffer):
         await self._ensure_conn(volume)
         return await super().put_to_storage_volume(volume, requests)
 
+    @staticmethod
+    def _doorbell_key(volume, requests: list[Request]) -> Optional[tuple]:
+        from torchstore_tpu.transport.shared_memory import slice_sig
+
+        if any(r.is_object for r in requests):
+            return None
+        return (
+            volume.volume_id,
+            tuple((r.key, slice_sig(r.tensor_slice)) for r in requests),
+        )
+
     async def get_from_storage_volume(self, volume, requests: list[Request]):
+        from torchstore_tpu.transport.shared_memory import (
+            ONE_SIDED_FALLBACKS,
+            ONE_SIDED_TORN,
+            OneSidedMiss,
+        )
+
         await self._ensure_conn(volume)
+        if self.config is None or self.config.one_sided:
+            cache: BulkClientCache = volume.transport_context.get_cache(
+                BulkClientCache
+            )
+            dkey = self._doorbell_key(volume, requests)
+            entry = cache.doorbells.get(dkey) if dkey is not None else None
+            if entry is not None:
+                try:
+                    return await self._get_via_doorbell(requests, entry)
+                except OneSidedMiss as miss:
+                    # Loud fallback: drop the plan (the RPC serve below
+                    # re-registers a fresh one) and take the RPC path.
+                    cache.doorbells.pop(dkey, None)
+                    if miss.reason == "torn":
+                        ONE_SIDED_TORN.inc(transport="bulk")
+                    ONE_SIDED_FALLBACKS.inc(
+                        reason=f"doorbell_{miss.reason}"
+                    )
+                    # Fresh session id for the fallback: a TIMED-OUT
+                    # doorbell's reply may still be in flight on this
+                    # shared connection, and reusing the id would misroute
+                    # that late IDX_PACKED/IDX_DOORBELL frame into the RPC
+                    # get (the demux drains unknown-session frames, so
+                    # under a new id the stale reply is read and dropped).
+                    self.session = _new_id()
+                    # The doorbell may have died with the connection; the
+                    # RPC path needs a live one.
+                    await self._ensure_conn(volume)
         try:
             return await self._get_with_session(volume, requests)
         finally:
@@ -940,6 +1133,99 @@ class BulkTransportBuffer(TransportBuffer):
                     f"bulk session-open handshake failed (got frame {ack_idx})"
                 )
         return await super().get_from_storage_volume(volume, requests)
+
+    async def _get_via_doorbell(
+        self, requests: list[Request], entry: dict
+    ) -> list[Any]:
+        """One-sided warm get over the bulk socket: ring the cached plan id
+        (one tiny frame instead of the get RPC + per-key request frames),
+        land the single IDX_PACKED reply straight into a pre-registered
+        read buffer, and unpack members at the shared arena layout. Any
+        miss frame, timeout, or connection loss raises
+        :class:`shared_memory.OneSidedMiss` — the caller falls back loudly
+        to the RPC path."""
+        from torchstore_tpu.transport import landing
+        from torchstore_tpu.transport.buffers import transfer_timeout
+        from torchstore_tpu.transport.shared_memory import (
+            ONE_SIDED_READS,
+            OneSidedMiss,
+        )
+
+        conn = self._conn
+        sess = conn.register_session(self.session)
+        packed = bytearray(max(int(entry["total"]), 1))
+        try:
+            # Pre-registered read buffer: the demux loop recv()s the packed
+            # reply kernel->buffer, no staging copy.
+            if entry["total"]:
+                sess.dests[IDX_PACKED] = memoryview(packed)
+            try:
+                # SESSION_OPEN then DOORBELL on the same connection: the
+                # server processes them in order, so routing is in place
+                # before the serve starts — no ack round trip needed.
+                await _send_frame(
+                    conn.sock,
+                    conn.write_lock,
+                    self.session,
+                    IDX_SESSION_OPEN,
+                    None,
+                )
+                await _send_frame(
+                    conn.sock,
+                    conn.write_lock,
+                    self.session,
+                    IDX_DOORBELL,
+                    memoryview(_U64.pack(entry["plan_id"])),
+                )
+                timeout = transfer_timeout(
+                    (self.config or default_config()).handshake_timeout,
+                    int(entry["total"]),
+                )
+                while True:
+                    idx, raw = await asyncio.wait_for(
+                        sess.queue.get(), timeout=timeout
+                    )
+                    if idx == IDX_SESSION_OPEN:
+                        continue  # the routing ack; the reply follows
+                    break
+            except (TimeoutError, asyncio.TimeoutError):
+                raise OneSidedMiss("timeout") from None
+            except (ConnectionError, OSError):
+                raise OneSidedMiss("conn") from None
+        finally:
+            conn.release_session(self.session)
+        if idx is None:
+            raise OneSidedMiss("conn")
+        if idx == IDX_DOORBELL:
+            code = raw[0] if raw else 0
+            raise OneSidedMiss(_DOORBELL_MISS.get(code, "unknown"))
+        if idx != IDX_PACKED:
+            raise OneSidedMiss("protocol")
+        if raw is not LANDED:
+            # Dest registration raced (or zero-size batch): the demux
+            # buffered the payload instead.
+            packed = raw if isinstance(raw, (bytes, bytearray)) else packed
+        results: list[Any] = []
+        pairs: list[tuple[np.ndarray, np.ndarray]] = []
+        for req, meta, off in zip(requests, entry["metas"], entry["offsets"]):
+            count = int(np.prod(meta.shape)) if meta.shape else 1
+            arr = np.frombuffer(
+                packed, dtype=meta.np_dtype, count=count, offset=off
+            ).reshape(meta.shape)
+            dest = req.destination_view
+            if dest is not None:
+                if (
+                    tuple(dest.shape) != tuple(meta.shape)
+                    or dest.dtype != meta.np_dtype
+                ):
+                    raise OneSidedMiss("shape")
+                pairs.append((dest, arr))
+                results.append(dest)
+            else:
+                results.append(arr)
+        await landing.land_async(pairs, stage="doorbell", config=self.config)
+        ONE_SIDED_READS.inc(len(results), transport="bulk")
+        return results
 
     async def _perform_handshake(self, volume, requests, op) -> None:
         # The real handshake (endpoint exchange + dial) happened in
@@ -1135,6 +1421,19 @@ class BulkTransportBuffer(TransportBuffer):
             arr = np.ascontiguousarray(entry)
             self.descriptors[idx] = TensorMeta.of(arr)
             payloads[idx] = arr
+        if (
+            (self.config is None or self.config.one_sided)
+            and payloads
+            and len(payloads) == len(metas)
+            and server.doorbell_volume is not None
+        ):
+            # All-tensor batch with a doorbell-capable volume: register the
+            # plan; the id rides this buffer back in the get RPC reply and
+            # the client's next identical batch rings it instead.
+            self.doorbell_plan = server.register_plan(
+                [m for m in metas],
+                [self.descriptors[i] for i in range(len(metas))],
+            )
         if payloads:
             server.send_background(self.client_id, self.session, payloads)
 
@@ -1176,6 +1475,32 @@ class BulkTransportBuffer(TransportBuffer):
                 results.append(req.destination_view)
             else:
                 results.append(arr)
+        if remote.doorbell_plan is not None and (
+            self.config is None or self.config.one_sided
+        ):
+            # Cache the server's plan id with the per-member layout so the
+            # next identical batch unpacks the IDX_PACKED reply locally.
+            from torchstore_tpu.transport import landing
+
+            cache: BulkClientCache = volume.transport_context.get_cache(
+                BulkClientCache
+            )
+            dkey = self._doorbell_key(volume, requests)
+            if dkey is not None and len(remote.descriptors) == len(requests):
+                member_metas = [
+                    remote.descriptors[i] for i in range(len(requests))
+                ]
+                offsets, total = landing.compute_arena_layout(
+                    [m.nbytes for m in member_metas]
+                )
+                if len(cache.doorbells) >= cache.DOORBELLS_MAX:
+                    cache.doorbells.clear()
+                cache.doorbells[dkey] = {
+                    "plan_id": remote.doorbell_plan,
+                    "metas": member_metas,
+                    "offsets": offsets,
+                    "total": total,
+                }
         return results
 
     # ---- cleanup ---------------------------------------------------------

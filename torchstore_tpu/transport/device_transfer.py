@@ -262,6 +262,58 @@ class DeviceTransferEngine:
         self._conns.clear()
 
 
+def finalize_stamped(uploaded, recheck) -> bool:
+    """Settle a device upload that consumed a BORROWED stamped SHM view
+    (``shared_memory.stamped_read(..., borrow=True)``): block until the
+    device has fully read the mapped bytes, then re-check the seqlock.
+    True -> the upload holds one consistent generation; False -> a landing
+    raced the read and the arrays may mix generations — the caller MUST
+    discard them and fall back to the RPC path."""
+    import jax
+
+    jax.block_until_ready(uploaded)
+    return bool(recheck())
+
+
+def upload_stamped(view, recheck, dtype=None, sharding=None):
+    """One-sided host->device upload: hand the borrowed stamped segment
+    view straight to the device runtime (``jax.device_put`` reads the
+    mmapped bytes itself — no intermediate host staging copy, the staging
+    buffer IS the stamped segment), then :func:`finalize_stamped`. With an
+    ICI-capable backend the very same call pulls over the accelerator
+    fabric; on host-only backends it is still the zero-extra-copy path.
+    Returns the device array, or None when the upload tore (the caller
+    falls back to the RPC path, which serves a consistent snapshot)."""
+    import jax
+
+    import numpy as np
+
+    devices = (
+        list(sharding.device_set) if sharding is not None else jax.devices()
+    )
+    if all(d.platform == "cpu" for d in devices):
+        # Host-only backend: device_put of an aligned C-contiguous host
+        # array may SHARE the buffer instead of copying — the "device"
+        # array would alias recyclable segment memory and mutate under
+        # the caller after a later landing. Materialize a private copy
+        # first (the cost real accelerators pay in the H2D DMA anyway);
+        # the recheck below still validates it was not torn.
+        view = np.asarray(view).copy()
+    out = (
+        jax.device_put(view, sharding)
+        if sharding is not None
+        else jax.device_put(view)
+    )
+    if dtype is not None and str(out.dtype) != str(dtype):
+        out = out.astype(dtype)  # on-device; depends on the H2D transfer
+    if not finalize_stamped(out, recheck):
+        from torchstore_tpu.transport.shared_memory import ONE_SIDED_TORN
+
+        ONE_SIDED_TORN.inc(transport="device")
+        return None
+    return out
+
+
 def prewarm_engine() -> Optional[str]:
     """Cold-start provisioning for the ICI rung: start this process's
     transfer server BEFORE the first publish/pull needs it (server startup

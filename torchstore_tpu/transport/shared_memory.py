@@ -30,10 +30,33 @@ Caches: ``ShmServerCache`` (volume side: entries, leases, retired/free
 pools, staged-get TTLs), ``ShmClientCache`` (client side: attachments +
 view weakrefs), both invalidated per-key on delete (reference cache
 semantics, shared_memory.py:56-131).
+
+One-sided warm gets (the "RPC Considered Harmful" data plane): every entry
+carries a slot in a per-volume **stamp table** — a shared-memory array of
+uint64 seqlock words (even = stable, odd = write-in-flight), bumped by the
+volume around every landing that can change what the entry's bytes mean
+(replace, in-place overwrite, delete, repair pull). Get descriptors are
+annotated with (stamp segment, slot, generation); the client caches them as
+one-sided plans and serves warm repeat gets by ``stamped_read_batch``:
+check the stamp, memcpy straight out of the pre-attached segment through
+the landing pool, re-check the stamp — ZERO RPCs. Any mismatch (replaced
+entry, writer in flight, torn copy, unlinked segment) invalidates the plan
+and falls back loudly to the RPC path (``ts_one_sided_fallbacks_total``);
+a post-copy stamp change additionally counts ``ts_one_sided_torn_total``
+and discards the copy, so mixed-generation bytes are never served. The
+protocol leans on two existing invariants: puts never write a live entry
+segment (so an even, matching stamp means the mapped bytes are the exact
+generation the plan was built against), and a retired segment can only be
+re-offered to a writer AFTER the replacing put bumped the entry stamp (so
+a reader that raced the recycling always sees the bump on its re-check).
+Staleness is bounded exactly like the location cache: a detached replica
+serves its last committed generation until the reclaim deletes it (stamp
+tombstone), never torn bytes.
 """
 
 from __future__ import annotations
 
+import math
 import mmap
 import os
 import time
@@ -89,6 +112,21 @@ _RESERVED_SEGMENTS = obs_metrics.gauge(
     "ts_shm_reserved_segments", "Handshake-offered segments awaiting their put"
 )
 
+# One-sided data-plane instruments (client side). Shared by the SHM stamped
+# read and the bulk doorbell (transport label distinguishes them).
+ONE_SIDED_READS = obs_metrics.counter(
+    "ts_one_sided_reads_total",
+    "Warm gets served one-sided (zero RPCs), by transport",
+)
+ONE_SIDED_FALLBACKS = obs_metrics.counter(
+    "ts_one_sided_fallbacks_total",
+    "One-sided attempts that fell back to the RPC path, by reason",
+)
+ONE_SIDED_TORN = obs_metrics.counter(
+    "ts_one_sided_torn_total",
+    "One-sided reads discarded because the stamp moved mid-copy, by transport",
+)
+
 SHM_DIR = "/dev/shm"
 
 STAGED_TTL_S = 120.0  # staged-get segments a crashed client never unlinked
@@ -104,6 +142,41 @@ SMALL_INLINE_BYTES = 64 * 1024
 # Handshake-reply key for the batch's shared arena segment offer; request
 # indices are always >= 0 so -1 can never collide.
 ARENA_OFFER_KEY = -1
+
+# Stamp-table capacity: one uint64 seqlock word per live (key, coords)
+# entry. 64K slots = a 512 KB segment; entries beyond capacity simply are
+# not one-sided-servable (their gets stay on the RPC path).
+STAMP_SLOTS = 1 << 16
+
+# A one-sided get WITHOUT a destination must copy (a zero-copy view of a
+# recyclable segment cannot be stamp-re-checked after it is handed out), so
+# above this size the RPC path's zero-copy snapshot view wins and the
+# one-sided path stands down. In-place gets copy on both paths, so they go
+# one-sided at any size.
+ONE_SIDED_COPY_MAX = 4 << 20
+
+# The OneSidedMiss reasons that invalidate the cached plan (the bytes or
+# stamps the plan points at moved/vanished): the fallback RPC serve
+# re-records a fresh plan. Other reasons (e.g. shape policy) keep it.
+PLAN_DROPPING_MISSES = frozenset(
+    {"stale_stamp", "torn", "segment_gone", "stamp_table_gone"}
+)
+
+
+def covered_plan(
+    one_sided: dict, key: str, slice_key, has_dest: bool
+) -> Optional[dict]:
+    """The cached one-sided plan for ``(key, slice_key)`` IF the one-sided
+    path may serve it — the single coverage predicate every client-side
+    coverage check shares. A destination-less get above
+    ``ONE_SIDED_COPY_MAX`` stands down to the RPC zero-copy path (standing
+    policy, not a fallback), so it reports uncovered."""
+    plan = one_sided.get((key, slice_key))
+    if plan is None or (
+        not has_dest and plan["nbytes"] > ONE_SIDED_COPY_MAX
+    ):
+        return None
+    return plan
 
 
 def is_available() -> bool:
@@ -198,12 +271,17 @@ class ShmSegment:
 
     @classmethod
     def create(
-        cls, size: int, name: Optional[str] = None, populate: bool = True
+        cls,
+        size: int,
+        name: Optional[str] = None,
+        populate: bool = True,
+        count: bool = True,
     ) -> "ShmSegment":
         """``populate=False`` skips MAP_POPULATE's eager page zeroing — for
         the volume's inline-put residual path, where actor dispatch must not
         stall on population (tiny segments fault their few pages during the
-        landing copy instead)."""
+        landing copy instead). ``count=False`` keeps protocol-metadata
+        segments (the stamp table) out of the pool-economics counter."""
         name = name or f"ts_shm_{os.getpid()}_{uuid.uuid4().hex[:12]}"
         fd = os.open(cls._path(name), os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
@@ -212,7 +290,8 @@ class ShmSegment:
             mm = mmap.mmap(fd, size, flags=flags)
         finally:
             os.close(fd)
-        _SEGMENTS_CREATED.inc()
+        if count:
+            _SEGMENTS_CREATED.inc()
         return cls(name, size, mm, owner=True)
 
     @classmethod
@@ -294,7 +373,10 @@ class ShmSegment:
         return self._base_addr
 
     def view(self, meta: TensorMeta, offset: int = 0) -> np.ndarray:
-        count = int(np.prod(meta.shape))
+        # math.prod, not np.prod: this runs once per member on the warm
+        # one-sided batch path, and the ufunc reduction is ~30x the cost
+        # of the builtin on the small shape tuples that dominate there.
+        count = math.prod(meta.shape)
         if count == 0:
             # Zero-size tensors carry no bytes; an empty array of the right
             # shape/dtype IS the value (np.frombuffer(count=0) would also
@@ -340,6 +422,41 @@ class ShmSegment:
         self._closed = True
 
 
+class StampTable:
+    """Per-volume shared array of per-entry seqlock words.
+
+    Word semantics: even = entry stable at that generation; odd = a write
+    that can change the entry's bytes/placement is in flight. Values only
+    ever increase (slots are reused across entries without reset), so a
+    reader comparing against the generation its plan recorded can never be
+    fooled by wrap-behind. Aligned 8-byte loads/stores of the numpy view
+    are single instructions on the platforms this runs on; the protocol
+    additionally re-checks after the copy, so even a torn stamp read only
+    costs a spurious fallback, never wrong data."""
+
+    def __init__(self, seg: ShmSegment) -> None:
+        self.seg = seg
+        self.words = np.frombuffer(seg.mmap, dtype=np.uint64)
+
+    @classmethod
+    def create(cls) -> "StampTable":
+        # populate=True zeroes every word: slot generation starts at 0.
+        # count=False: the table is protocol metadata, not pool economics —
+        # its lazy creation must not move ts_shm_segments_created_total
+        # across a prewarmed first put.
+        return cls(ShmSegment.create(STAMP_SLOTS * 8, count=False))
+
+    @classmethod
+    def attach(cls, name: str, size: int) -> "StampTable":
+        return cls(ShmSegment.attach(name, size, populate=True))
+
+    def read(self, slot: int) -> int:
+        return int(self.words[slot])
+
+    def write(self, slot: int, value: int) -> None:
+        self.words[slot] = value
+
+
 @dataclass
 class ShmDescriptor:
     """Picklable handle to a tensor inside a segment."""
@@ -354,6 +471,11 @@ class ShmDescriptor:
     # 'volume' -> long-lived, volume owns; 'client' -> staged for one get,
     # the client unlinks after landing the data.
     owner: str = "volume"
+    # One-sided annotation: (stamp segment name, stamp segment size, slot,
+    # generation at serve time). Present only for volume-owned serves whose
+    # entry stamp was stable (even) — the client caches it as a one-sided
+    # plan and serves warm repeats without the RPC.
+    stamp: Optional[tuple] = None
 
 
 @dataclass
@@ -362,6 +484,18 @@ class _Entry:
 
     seg: ShmSegment
     meta: TensorMeta
+    # Stamp-table slot carried across replacements (the entry identity owns
+    # the slot; the segment rotates underneath it). None = table full or
+    # stamping unavailable — the entry is just not one-sided-servable.
+    slot: Optional[int] = None
+
+
+def slice_sig(ts) -> Optional[tuple]:
+    """Hashable identity of a sub-request's wanted slice — the one-sided
+    plan index key component (None for whole-tensor requests)."""
+    if ts is None:
+        return None
+    return (ts.offsets, ts.local_shape, ts.coordinates)
 
 
 # --------------------------------------------------------------------------
@@ -418,6 +552,19 @@ class ShmServerCache(TransportCache):
         # last time a client RPC touched this cache (warm-up tasks only
         # burn CPU in idle windows, never against live traffic)
         self.last_activity = 0.0
+        # Per-entry seqlock stamps (one-sided reads). Lazily created on the
+        # first entry; creation failure disables stamping (entries are then
+        # simply not one-sided-servable — fail open, never fail the put).
+        self.stamps: Optional[StampTable] = None
+        self._stamps_failed = False
+        self._stamp_next = 0
+        self._stamp_free: list[int] = []
+        # Open write brackets per (key, coords): endpoints dispatch as
+        # independent tasks, so two puts of the same key can overlap at
+        # awaits — the stamp may only settle EVEN when the LAST of them
+        # closes, else a reader validates against bytes the other put is
+        # still writing.
+        self._write_nesting: dict[tuple, int] = {}
 
     def adopt_config(self, config: Optional[StoreConfig]) -> None:
         if config is not None:
@@ -716,6 +863,85 @@ class ShmServerCache(TransportCache):
         _SEGMENTS_RECYCLED.inc()
         return seg
 
+    # ---- entry stamps (one-sided read seqlocks) --------------------------
+
+    def _stamp_table(self) -> Optional[StampTable]:
+        if self.stamps is None and not self._stamps_failed:
+            try:
+                self.stamps = StampTable.create()
+            except OSError:
+                self._stamps_failed = True
+        return self.stamps
+
+    def _alloc_slot(self) -> Optional[int]:
+        if self._stamp_table() is None:
+            return None
+        if self._stamp_free:
+            return self._stamp_free.pop()
+        if self._stamp_next < STAMP_SLOTS:
+            slot = self._stamp_next
+            self._stamp_next += 1
+            return slot
+        return None
+
+    def _tombstone(self, entry: "_Entry") -> None:
+        """Entry is going away: leave its stamp ODD forever (until the slot
+        is reused, when the word keeps counting up) so one-sided readers of
+        any plan built against it fall back from the first check."""
+        if entry.slot is None or self.stamps is None:
+            return
+        w = self.stamps.read(entry.slot)
+        if w % 2 == 0:
+            self.stamps.write(entry.slot, w + 1)
+        self._stamp_free.append(entry.slot)
+        entry.slot = None
+
+    def begin_writes(self, pairs: list[tuple[str, Optional[tuple]]]) -> None:
+        """Mark every existing entry about to be (re)written as
+        write-in-flight (stamp odd). Called by the volume at put/pull entry
+        — BEFORE any transport lands bytes that could alias entry memory
+        (the bulk/rpc in-place overwrite paths) and before the entry is
+        repointed. The volume fires the ``shm.landing_stamp`` faultpoint
+        (async, so a delay/wedge holds entries visibly write-in-flight
+        without freezing the event loop's RPC fallback path) right after
+        this returns."""
+        for key, coords in pairs:
+            pair = (key, coords)
+            nesting = self._write_nesting.get(pair, 0)
+            self._write_nesting[pair] = nesting + 1
+            if nesting or self.stamps is None:
+                continue  # already held odd by an overlapping writer
+            entry = self.by_key.get(key, {}).get(coords)
+            if entry is not None and entry.slot is not None:
+                w = self.stamps.read(entry.slot)
+                if w % 2 == 0:
+                    self.stamps.write(entry.slot, w + 1)
+
+    def end_writes(self, pairs: list[tuple[str, Optional[tuple]]]) -> None:
+        """Settle every written entry at its next EVEN generation (allocate
+        slots for fresh entries). Runs after the store adopted the new
+        values and strictly before the old segments could be re-offered to
+        another writer (both happen inside the same RPC dispatch), which is
+        what makes the reader's post-copy re-check sound. An entry another
+        put still holds open (overlapping writes of one key) stays ODD —
+        only the last closing bracket settles it."""
+        for key, coords in pairs:
+            pair = (key, coords)
+            nesting = self._write_nesting.get(pair, 1) - 1
+            if nesting > 0:
+                self._write_nesting[pair] = nesting
+                continue
+            self._write_nesting.pop(pair, None)
+            entry = self.by_key.get(key, {}).get(coords)
+            if entry is None:
+                continue
+            if entry.slot is None:
+                entry.slot = self._alloc_slot()
+                if entry.slot is None:
+                    continue
+            w = self.stamps.read(entry.slot)
+            self.stamps.write(entry.slot, w + 1 if w % 2 else w + 2)
+
     # ---- entries ---------------------------------------------------------
 
     def track_staged(self, seg: ShmSegment) -> None:
@@ -729,7 +955,11 @@ class ShmServerCache(TransportCache):
     ) -> None:
         entries = self.by_key.setdefault(key, {})
         prev = entries.get(coords)
-        entries[coords] = _Entry(seg, meta)
+        # The stamp slot rides the ENTRY identity across segment rotations
+        # (end_writes settles it even once the new bytes are adopted).
+        entries[coords] = _Entry(
+            seg, meta, slot=prev.slot if prev is not None else None
+        )
         if prev is not None and prev.seg.name == seg.name:
             return  # in-place overwrite: refcount unchanged
         self.seg_refs[seg.name] = self.seg_refs.get(seg.name, 0) + 1
@@ -755,20 +985,22 @@ class ShmServerCache(TransportCache):
     def segments_for(self, key: str) -> list[ShmSegment]:
         return [e.seg for e in self.by_key.get(key, {}).values()]
 
-    def locate(self, key: str, arr: np.ndarray) -> Optional[tuple[ShmSegment, int]]:
-        """Find the entry segment ``arr``'s memory lives in (anywhere within
-        it — sub-slice views included), or None."""
+    def locate(self, key: str, arr: np.ndarray) -> Optional[tuple[_Entry, int]]:
+        """Find the entry whose segment ``arr``'s memory lives in (anywhere
+        within it — sub-slice views included), or None. Returns the entry
+        (its segment AND its stamp slot) plus the byte offset."""
         if arr.nbytes == 0:
             return None
         ptr = arr.__array_interface__["data"][0]
-        for seg in self.segments_for(key):
-            base = seg.base_addr()
-            if base is not None and base <= ptr < base + seg.size:
-                return seg, ptr - base
+        for entry in self.by_key.get(key, {}).values():
+            base = entry.seg.base_addr()
+            if base is not None and base <= ptr < base + entry.seg.size:
+                return entry, ptr - base
         return None
 
     def delete_key(self, key: str) -> None:
         for entry in self.by_key.pop(key, {}).values():
+            self._tombstone(entry)
             if not self._release_entry_ref(entry.seg):
                 # Arena segment still backing other live keys: its bytes
                 # stay until the last referencing entry goes.
@@ -779,8 +1011,16 @@ class ShmServerCache(TransportCache):
     def clear(self) -> None:
         for entries in self.by_key.values():
             for entry in entries.values():
+                # Readers keep their stamp-table mapping after the unlink
+                # below; the tombstone makes every cached plan fall back.
+                self._tombstone(entry)
                 entry.seg.unlink()
         self.by_key.clear()
+        if self.stamps is not None:
+            self.stamps.seg.unlink()
+            self.stamps = None
+        self._stamp_next = 0
+        self._stamp_free.clear()
         for seg, _ in self.staged.values():
             seg.unlink()
         self.staged.clear()
@@ -831,6 +1071,75 @@ class ShmClientCache(TransportCache):
         # an unused spare by then; keeping the populated mapping would pin
         # its tmpfs pages for the client's lifetime).
         self._pre_attached: dict[str, float] = {}
+        # One-sided plans: (key, slice_sig) -> plan dict recorded from
+        # stamp-annotated get descriptors (the serving volume rides INSIDE
+        # the plan). Bounded; cleared wholesale on overflow and on
+        # placement-epoch bumps (the client owns that).
+        self.one_sided: dict[tuple, dict] = {}
+        # Attached volume stamp tables: name -> (segment, uint64 word view).
+        self.stamp_tables: dict[str, tuple[ShmSegment, np.ndarray]] = {}
+
+    ONE_SIDED_MAX = 65536
+
+    def record_one_sided(self, volume_id: str, req, desc: ShmDescriptor) -> None:
+        """Cache a stamp-annotated descriptor as a one-sided plan for the
+        exact (key, wanted-slice) request it answered. Keyed WITHOUT the
+        volume id (a warm get must find the plan before it knows which
+        replica it would route to); the serving volume rides inside the
+        plan so replica re-routing replaces rather than duplicates."""
+        if desc.stamp is None or desc.owner != "volume":
+            return
+        if len(self.one_sided) >= self.ONE_SIDED_MAX:
+            self.one_sided.clear()
+        meta = desc.meta
+        self.one_sided[(req.key, slice_sig(req.tensor_slice))] = {
+            "volume_id": volume_id,
+            "segment": desc.segment_name,
+            "segment_size": desc.segment_size,
+            "offset": desc.offset,
+            "strides": desc.strides,
+            "meta": meta,
+            # Pre-resolved meta scalars: the warm loops read these per
+            # member per iteration, and the TensorMeta property walks
+            # (math.prod, dtype parse) cost more than the stamp checks.
+            "nbytes": meta.nbytes,
+            "shape": tuple(meta.shape),
+            "npdtype": meta.np_dtype,
+            "stamp_name": desc.stamp[0],
+            "stamp_size": desc.stamp[1],
+            "slot": desc.stamp[2],
+            "gen": desc.stamp[3],
+        }
+
+    def drop_one_sided(self) -> int:
+        """Drop every cached one-sided plan (placement-epoch bump /
+        quarantine transition: the placement the plans describe changed).
+        LIVE attached stamp tables are kept — they re-validate instantly
+        and a reinstated volume's table is still the one in use — but a
+        table whose backing file is gone (volume reset unlinked it and
+        made a fresh one) is closed here, or each reset would pin another
+        512KB of unlinked tmpfs pages for this client's lifetime."""
+        n = len(self.one_sided)
+        self.one_sided.clear()
+        for name in list(self.stamp_tables):
+            if not os.path.exists(os.path.join(SHM_DIR, name)):
+                seg, _ = self.stamp_tables.pop(name)
+                seg.close()
+        return n
+
+    def stamp_words(self, plan: dict) -> Optional[np.ndarray]:
+        """The uint64 word view of the plan's stamp table (attached and
+        cached on first use); None when the table is gone (volume reset)."""
+        name = plan["stamp_name"]
+        cached = self.stamp_tables.get(name)
+        if cached is None:
+            try:
+                seg = ShmSegment.attach(name, plan["stamp_size"], populate=True)
+            except (OSError, ValueError):
+                return None
+            cached = (seg, np.frombuffer(seg.mmap, dtype=np.uint64))
+            self.stamp_tables[name] = cached
+        return cached[1]
 
     def attach(self, desc: ShmDescriptor, key: str, volume_id: str) -> ShmSegment:
         seg = self.segments.get(desc.segment_name)
@@ -968,6 +1277,8 @@ class ShmClientCache(TransportCache):
             # seg_volume is kept: views handed out for this key may still
             # be alive, and their eventual release must still route to the
             # owning volume (or its retired segment waits out the full TTL).
+        for pk in [pk for pk in self.one_sided if pk[0] == key]:
+            del self.one_sided[pk]
 
     def clear(self) -> None:
         for seg in self.segments.values():
@@ -979,6 +1290,10 @@ class ShmClientCache(TransportCache):
         self.pending.clear()
         self.unacked.clear()
         self.seq.clear()
+        self.one_sided.clear()
+        for seg, _ in self.stamp_tables.values():
+            seg.close()
+        self.stamp_tables.clear()
 
 
 async def pre_attach_segments(volume, names: list[tuple[str, int]]) -> int:
@@ -1014,6 +1329,219 @@ async def pre_attach_segments(volume, names: list[tuple[str, int]]) -> int:
 
     results = await asyncio.gather(*(one(n, s) for n, s in names))
     return sum(results)
+
+
+# --------------------------------------------------------------------------
+# one-sided stamped reads (client side)
+# --------------------------------------------------------------------------
+
+
+class OneSidedMiss(Exception):
+    """A one-sided attempt cannot (or must not) serve this request — the
+    caller falls back to the RPC path and counts the reason. Carrying the
+    reason in the exception keeps every fallback LOUD in metrics while the
+    data path stays correct by construction (the fallback re-fetches)."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(reason)
+
+
+def segment_read_view(
+    seg: ShmSegment,
+    meta: TensorMeta,
+    offset: int = 0,
+    strides: Optional[tuple[int, ...]] = None,
+) -> np.ndarray:
+    """THE blessed raw-segment read accessor for client/direct modules (the
+    ``one-sided-discipline`` tslint rule routes every attached-segment read
+    here): callers MUST pair it with a seqlock/generation validation around
+    the consuming copy — ``stamped_read`` does that internally; the direct
+    sync path validates its source generations over the control socket
+    before and after consuming the view."""
+    return seg.strided_view(meta, offset, strides)
+
+
+def stamped_read(
+    cache: "ShmClientCache",
+    plan: dict,
+    dest: Optional[np.ndarray] = None,
+    borrow: bool = False,
+) -> tuple[np.ndarray, Optional[Any]]:
+    """Serve one warm get straight out of a pre-attached volume segment
+    under the plan's per-entry seqlock stamp — ZERO RPCs.
+
+    Protocol: check the stamp word equals the plan's recorded (even)
+    generation, copy the bytes out (into ``dest`` when given), re-check the
+    stamp. Any pre-copy mismatch means the entry was replaced/deleted/is
+    mid-write (stale plan); a post-copy mismatch means the copy may be torn
+    — both raise :class:`OneSidedMiss` so the caller falls back to the RPC
+    path, which fully overwrites any partial landing. Soundness leans on
+    the volume-side ordering: a recycled segment is only re-offered to a
+    writer after the replacing put went through begin_writes (stamp odd)
+    — so a reader racing the recycle always sees the stamp move.
+
+    ``borrow=True`` (destination-less device uploads) returns a READ-ONLY
+    view of the segment plus a ``recheck`` callable instead of copying;
+    the consumer must finish reading (e.g. jax.block_until_ready after
+    device_put) and then call ``recheck()`` — False means the upload may
+    hold mixed-generation bytes and must be discarded
+    (``device_transfer.finalize_stamped`` wraps that)."""
+    src, words, slot, gen = _stamped_source(cache, plan)
+
+    def recheck() -> bool:
+        return int(words[slot]) == gen
+
+    if borrow and dest is None:
+        view = src.view()
+        view.flags.writeable = False
+        ONE_SIDED_READS.inc(transport="shm")
+        return view, recheck
+    if dest is None:
+        if plan["nbytes"] > ONE_SIDED_COPY_MAX:
+            # Destination-less big get: the RPC path's zero-copy snapshot
+            # view wins (a one-sided serve would have to copy).
+            raise OneSidedMiss("too_large")
+        dest = np.empty(plan["shape"], plan["npdtype"])
+    elif dest.shape != plan["shape"] or dest.dtype != plan["npdtype"]:
+        # Stale-metadata target (dtype-converting get / re-published shape):
+        # the RPC path owns the conversion story.
+        raise OneSidedMiss("shape")
+    copy_into(dest, src)
+    if not recheck():
+        # Copy raced a replacement landing: the bytes in ``dest`` may mix
+        # generations — discard (the RPC fallback fully overwrites).
+        ONE_SIDED_TORN.inc(transport="shm")
+        raise OneSidedMiss("torn")
+    ONE_SIDED_READS.inc(transport="shm")
+    return dest, None
+
+
+def _stamped_source(
+    cache: "ShmClientCache", plan: dict
+) -> tuple[np.ndarray, np.ndarray, int, int]:
+    """Resolve a plan's source view after the pre-copy stamp check; returns
+    (src_view, stamp_words, slot, gen) or raises :class:`OneSidedMiss`.
+
+    The stamp-word array, the constructed source view, and its base address
+    are memoized ON the plan dict: a warm iteration repeats the same plans,
+    and per-member view construction was a measurable slice of the
+    many-keys get leg. Safe because plans are dropped wholesale whenever
+    the underlying placement can change (stale/torn miss, epoch bump,
+    delete), and a segment's mapping outlives ``close()`` as long as any
+    view references it (close never unmaps; GC does)."""
+    words = plan.get("words")
+    if words is None:
+        words = cache.stamp_words(plan)
+        if words is None:
+            raise OneSidedMiss("stamp_table_gone")
+        plan["words"] = words
+    slot, gen = plan["slot"], plan["gen"]
+    if int(words[slot]) != gen:
+        raise OneSidedMiss("stale_stamp")
+    src = plan.get("view")
+    if src is None:
+        name = plan["segment"]
+        seg = cache.segments.get(name)
+        if seg is None:
+            try:
+                seg = ShmSegment.attach(
+                    name, plan["segment_size"], populate=True
+                )
+            except (OSError, ValueError):
+                raise OneSidedMiss("segment_gone") from None
+            cache.segments[name] = seg
+        src = segment_read_view(
+            seg, plan["meta"], plan["offset"], plan["strides"]
+        )
+        plan["view"] = src
+        # Base address for the native scatter-copy batch; None marks the
+        # member ineligible (strided source — memcpy would read stray
+        # bytes), which stands the whole batch down to the grouped path.
+        plan["src_addr"] = (
+            src.__array_interface__["data"][0]
+            if plan["strides"] is None and src.size
+            else None
+        )
+    return src, words, slot, gen
+
+
+async def stamped_read_batch(
+    cache: "ShmClientCache",
+    plans: list[dict],
+    dests: list[Optional[np.ndarray]],
+    config: Optional[StoreConfig] = None,
+) -> list[np.ndarray]:
+    """The many-keys warm get leg: serve a whole batch of one-sided plans as
+    ONE stamped memcpy loop on the shared landing pool — check every stamp,
+    fan all copies out to :func:`landing.land_async` together (they overlap
+    each other and the event loop), then re-check every stamp.
+
+    All-or-nothing: any pre-copy mismatch, shape drift, or post-copy tear
+    raises :class:`OneSidedMiss` for the WHOLE batch — the caller falls back
+    to the RPC path, which fully overwrites any partial in-place landings,
+    so mixed-generation bytes are never observable. Destination-less members
+    above ONE_SIDED_COPY_MAX stand down (the RPC path's zero-copy snapshot
+    view wins there)."""
+    results: list[np.ndarray] = []
+    # Native scatter-copy batch (landing.land_batch_async): one GIL-free
+    # call replaces the per-pair grouped pool path. Any ineligible member
+    # (strided source, non-contiguous destination) stands the whole batch
+    # down to land_async — correctness is identical, only dispatch differs.
+    dst_addrs: list[int] = []
+    src_addrs: list[int] = []
+    lens: list[int] = []
+    batch_ok = True
+    for plan, dest in zip(plans, dests):
+        src, words, slot, gen = _stamped_source(cache, plan)
+        nbytes = plan["nbytes"]
+        if dest is None:
+            if nbytes > ONE_SIDED_COPY_MAX:
+                raise OneSidedMiss("too_large")
+            dest = np.empty(plan["shape"], plan["npdtype"])
+        elif dest.shape != plan["shape"] or dest.dtype != plan["npdtype"]:
+            # Stale-metadata target (dtype-converting get / re-published
+            # shape): the RPC path owns the conversion story.
+            raise OneSidedMiss("shape")
+        results.append(dest)
+        if batch_ok and nbytes:
+            src_addr = plan.get("src_addr")
+            if src_addr is None or not dest.flags["C_CONTIGUOUS"]:
+                batch_ok = False
+            else:
+                dst_addrs.append(dest.__array_interface__["data"][0])
+                src_addrs.append(src_addr)
+                lens.append(nbytes)
+    copied = batch_ok and await landing.land_batch_async(
+        dst_addrs, src_addrs, lens, stage="one_sided", config=config
+    )
+    if not copied:
+        # Grouped-pool fallback (pre-v3 library / ineligible member): the
+        # (dest, src) pairs are rebuilt off the hot path from the plans'
+        # memoized views.
+        await landing.land_async(
+            [(dest, plan["view"]) for plan, dest in zip(plans, results)],
+            stage="one_sided",
+            config=config,
+        )
+    # Post-copy recheck, vectorized per stamp table: one fancy-indexed
+    # gather + compare replaces a per-member int() round trip.
+    by_table: dict[int, tuple[np.ndarray, list, list]] = {}
+    for plan in plans:
+        words = plan["words"]
+        entry = by_table.get(id(words))
+        if entry is None:
+            entry = by_table[id(words)] = (words, [], [])
+        entry[1].append(plan["slot"])
+        entry[2].append(plan["gen"])
+    for words, slots, gens in by_table.values():
+        if not np.array_equal(
+            words[np.asarray(slots)], np.asarray(gens, dtype=np.uint64)
+        ):
+            ONE_SIDED_TORN.inc(transport="shm")
+            raise OneSidedMiss("torn")
+    ONE_SIDED_READS.inc(len(results), transport="shm")
+    return results
 
 
 # --------------------------------------------------------------------------
@@ -1473,7 +2001,8 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         loc = cache.locate(meta.key, entry)
         if loc is None:
             return None
-        seg, offset = loc
+        stored, offset = loc
+        seg = stored.seg
         strides = entry.strides
         if any(s < 0 for s in strides):
             return None
@@ -1487,12 +2016,26 @@ class SharedMemoryTransportBuffer(TransportBuffer):
         # copy lands (released on its next RPC). Either way a concurrent
         # put can never be offered this segment mid-read.
         cache.grant(seg.name)
+        # One-sided annotation: a stable (even) entry stamp rides the
+        # descriptor so the client can serve warm repeats of this exact
+        # request with zero RPCs (stamped_read_batch).
+        stamp = None
+        if stored.slot is not None and cache.stamps is not None:
+            gen = cache.stamps.read(stored.slot)
+            if gen % 2 == 0:
+                stamp = (
+                    cache.stamps.seg.name,
+                    cache.stamps.seg.size,
+                    stored.slot,
+                    gen,
+                )
         return ShmDescriptor(
             seg.name,
             seg.size,
             TensorMeta.of(entry),
             offset=offset,
             strides=None if entry.flags["C_CONTIGUOUS"] else tuple(strides),
+            stamp=stamp,
         )
 
     # ---- client: get -----------------------------------------------------
@@ -1535,6 +2078,10 @@ class SharedMemoryTransportBuffer(TransportBuffer):
                 results.append(landed)
                 continue
             seg = cache.attach(desc, req.key, volume.volume_id)
+            if self.config is None or self.config.one_sided:
+                # Stamp-annotated serve: cache it as a one-sided plan so the
+                # client's next repeat of this exact request skips the RPC.
+                cache.record_one_sided(volume.volume_id, req, desc)
             src = seg.strided_view(desc.meta, desc.offset, desc.strides)
             if req.destination_view is not None:
                 pairs.append((req.destination_view, src))
