@@ -511,6 +511,163 @@ async def many_keys_section(
         await ts.shutdown("bench_keys")
 
 
+async def streamed_sync_section(
+    n_layers: int = 16,
+    layer_kb: float = 256,
+    train_ms: float = 15.0,
+    decode_ms: float = 15.0,
+    iters: int = 3,
+) -> dict:
+    """Layer-streamed weight sync (ISSUE 9): the simulated RL
+    train→publish→decode loop, barrier vs streamed.
+
+    Barrier leg: train every layer (simulated compute sleep per layer),
+    publish the whole dict, acquire the whole dict, decode every layer —
+    iteration time is train + sync + decode with zero overlap. Streamed
+    leg: each layer is stream-published the moment it is "trained"
+    (``ts.state_dict_stream``), while a concurrent consumer acquires
+    layer-by-layer in forward order (``ts.get_state_dict_streamed``) and
+    "decodes" each layer as it lands — decode starts long before the last
+    layer is published. Emits ``barrier_s``/``streamed_s`` wall clocks,
+    ``overlap_ratio`` (fraction of the publish window the acquire ran
+    inside — 0 by construction on the barrier path, the ISSUE-9
+    acceptance is > 0 here) and ``first_token_after_publish_ms`` (first
+    decoded layer relative to publish completion; negative when decode
+    beat the seal)."""
+    import statistics
+
+    import torchstore_tpu as ts
+
+    train_s = train_ms / 1e3
+    decode_s = decode_ms / 1e3
+    n_elem = max(1, int(layer_kb * 1024 // 4))
+    await ts.initialize(
+        store_name="bench_stream",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        layers = {
+            str(i): np.random.rand(n_elem).astype(np.float32)
+            for i in range(n_layers)
+        }
+        order = [f"layers/{i}" for i in range(n_layers)]
+        barrier_walls, streamed_walls = [], []
+        overlaps, ftap_s, ftap_b = [], [], []
+        for it in range(iters):
+            stamp = float(it + 1)
+            # ---- barrier leg --------------------------------------------
+            t0 = time.perf_counter()
+            for i in range(n_layers):
+                await asyncio.sleep(train_s)
+                layers[str(i)][0] = stamp
+            await ts.put_state_dict(
+                "st/sd", {"layers": layers}, store_name="bench_stream"
+            )
+            t_pub_end = time.perf_counter()
+            out = await ts.get_state_dict("st/sd", store_name="bench_stream")
+            first_token = None
+            for i in range(n_layers):
+                assert out["layers"][str(i)][0] == stamp, "barrier stale"
+                await asyncio.sleep(decode_s)
+                if first_token is None:
+                    first_token = time.perf_counter()
+            barrier_walls.append(time.perf_counter() - t0)
+            ftap_b.append((first_token - t_pub_end) * 1e3)
+
+            # ---- streamed leg -------------------------------------------
+            stamp = stamp + 0.5
+            marks: dict = {}
+
+            async def publisher():
+                stream = ts.state_dict_stream(
+                    "st/sds", store_name="bench_stream"
+                )
+                await stream.begin()
+                marks["pub_begin"] = time.perf_counter()
+                for i in range(n_layers):
+                    await asyncio.sleep(train_s)
+                    layers[str(i)][0] = stamp
+                    await stream.put({"layers": {str(i): layers[str(i)]}})
+                await stream.seal()
+                marks["pub_end"] = time.perf_counter()
+
+            async def on_layer(fk, v):
+                marks.setdefault("first_serve", time.perf_counter())
+                assert np.asarray(v)[0] == stamp, f"streamed stale {fk}"
+                await asyncio.sleep(decode_s)
+                marks.setdefault("first_token", time.perf_counter())
+
+            t0 = time.perf_counter()
+            _, sd = await asyncio.gather(
+                publisher(),
+                ts.get_state_dict_streamed(
+                    "st/sds",
+                    key_order=order,
+                    on_layer=on_layer,
+                    wait_for_stream_s=60,
+                    timeout=300,
+                    store_name="bench_stream",
+                ),
+            )
+            t_end = time.perf_counter()
+            for i in range(n_layers):
+                assert sd["layers"][str(i)][0] == stamp, "streamed mixed"
+            streamed_walls.append(t_end - t0)
+            pub_span = max(1e-9, marks["pub_end"] - marks["pub_begin"])
+            overlap = max(
+                0.0,
+                min(marks["pub_end"], t_end)
+                - max(marks["pub_begin"], marks["first_serve"]),
+            )
+            overlaps.append(overlap / pub_span)
+            ftap_s.append((marks["first_token"] - marks["pub_end"]) * 1e3)
+            print(
+                f"# streamed_sync iter {it}: barrier {barrier_walls[-1]*1e3:.0f} ms, "
+                f"streamed {streamed_walls[-1]*1e3:.0f} ms, "
+                f"overlap {overlaps[-1]:.2f}, "
+                f"first token {ftap_s[-1]:+.0f} ms after publish "
+                f"(barrier {ftap_b[-1]:+.0f} ms)",
+                file=sys.stderr,
+            )
+        barrier_s = statistics.median(barrier_walls)
+        streamed_s = statistics.median(streamed_walls)
+        out = {
+            "n_layers": n_layers,
+            "layer_kb": layer_kb,
+            "train_ms": train_ms,
+            "decode_ms": decode_ms,
+            "barrier_s": round(barrier_s, 4),
+            "streamed_s": round(streamed_s, 4),
+            "wall_clock_win_s": round(barrier_s - streamed_s, 4),
+            "speedup": round(barrier_s / streamed_s, 3)
+            if streamed_s > 0
+            else None,
+            # Fraction of the publish window the acquire overlapped (the
+            # ISSUE-9 acceptance: > 0, i.e. sync hides under compute).
+            "overlap_ratio": round(statistics.median(overlaps), 3),
+            # First decoded layer relative to publish completion: negative
+            # = decode beat the seal (the pipeline's whole point).
+            "first_token_after_publish_ms": round(
+                statistics.median(ftap_s), 1
+            ),
+            "barrier_first_token_after_publish_ms": round(
+                statistics.median(ftap_b), 1
+            ),
+        }
+        print(
+            f"# streamed_sync ({n_layers} x {layer_kb:.0f} KB, "
+            f"{train_ms:.0f}/{decode_ms:.0f} ms train/decode per layer): "
+            f"barrier {barrier_s*1e3:.0f} ms -> streamed "
+            f"{streamed_s*1e3:.0f} ms ({out['speedup']}x), overlap "
+            f"{out['overlap_ratio']:.2f}, first token "
+            f"{out['first_token_after_publish_ms']:+.0f} ms vs publish end",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        await ts.shutdown("bench_stream")
+
+
 async def recovery_section(
     n_keys: int = 64,
     key_kb: float = 256,
@@ -691,6 +848,11 @@ async def run(
     many_keys_kb: float = 64,
     recovery_n_keys: int = 64,
     recovery_key_kb: float = 256,
+    streamed_layers: int = 16,
+    streamed_layer_kb: float = 256,
+    streamed_train_ms: float = 15.0,
+    streamed_decode_ms: float = 15.0,
+    streamed_iters: int = 3,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -920,6 +1082,15 @@ async def run(
     many_keys = await many_keys_section(
         n_keys=many_keys_n, key_kb=many_keys_kb
     )
+    # Streamed-sync section (ISSUE 9): the simulated train→publish→decode
+    # loop, barrier vs layer-streamed, on its own fleet.
+    streamed = await streamed_sync_section(
+        n_layers=streamed_layers,
+        layer_kb=streamed_layer_kb,
+        train_ms=streamed_train_ms,
+        decode_ms=streamed_decode_ms,
+        iters=streamed_iters,
+    )
     # Recovery section (ISSUE 6): time-to-heal after a volume kill under
     # load, on its own replicated fleet.
     recovery = await recovery_section(
@@ -967,6 +1138,15 @@ async def run(
         "many_keys_get_gbps": many_keys["get_gbps"],
         "get_memcpy_ratio": many_keys["get_memcpy_ratio"],
         "many_keys": many_keys,
+        # ISSUE-9 headline stats at top level: how much of the publish
+        # window the streamed acquire overlapped (acceptance > 0) and the
+        # first decoded layer relative to publish completion (negative =
+        # decode beat the seal); the full section under "streamed_sync".
+        "overlap_ratio": streamed["overlap_ratio"],
+        "first_token_after_publish_ms": streamed[
+            "first_token_after_publish_ms"
+        ],
+        "streamed_sync": streamed,
         # ISSUE-6 headline stats at top level; the full section under
         # "recovery" (detection / failover-get / re-replication timings).
         "heal_s": recovery["heal_s"],
@@ -1000,6 +1180,11 @@ if __name__ == "__main__":
     if "--recovery" in sys.argv:
         # Standalone recovery run: one JSON line with time-to-heal timings.
         print(json.dumps(asyncio.run(recovery_section())))
+        sys.exit(0)
+    if "--streamed-sync" in sys.argv:
+        # Standalone streamed-sync run: one JSON line with the barrier vs
+        # streamed wall clocks and overlap metrics.
+        print(json.dumps(asyncio.run(streamed_sync_section())))
         sys.exit(0)
     result = asyncio.run(run())
     # The headline JSON lands BEFORE the device section: a wedged TPU

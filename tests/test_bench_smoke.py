@@ -32,6 +32,11 @@ async def test_bench_run_tiny(capsys):
         many_keys_kb=4,
         recovery_n_keys=8,
         recovery_key_kb=4,
+        streamed_layers=4,
+        streamed_layer_kb=4,
+        streamed_train_ms=5.0,
+        streamed_decode_ms=5.0,
+        streamed_iters=1,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -103,6 +108,15 @@ async def test_bench_run_tiny(capsys):
     assert result["get_memcpy_ratio"] > 0
     assert result["p50_get_1kb_ms"] > 0
 
+    # Streamed-sync section (ISSUE 9): overlap metrics at top level, the
+    # full section under "streamed_sync". At KB scale the VALUES are noise
+    # — structure + positivity of the wall clocks only; the overlap_ratio
+    # > 0 acceptance is the standalone section test's (larger sleeps).
+    assert result["streamed_sync"]["barrier_s"] > 0
+    assert result["streamed_sync"]["streamed_s"] > 0
+    assert "overlap_ratio" in result
+    assert "first_token_after_publish_ms" in result
+
     # Recovery section (ISSUE 6): time-to-heal keys at top level, full
     # timings under "recovery" — a real kill + quarantine + auto-repair.
     assert result["heal_s"] > 0
@@ -153,6 +167,35 @@ async def test_bench_recovery_section_tiny():
     assert out["first_get_s"] > 0
     assert out["rereplicate_s"] >= out["detect_s"]
     assert out["heal_s"] == out["rereplicate_s"]
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_streamed_sync_section_tiny():
+    """The streamed-sync section standalone (``bench.py --streamed-sync``)
+    at small scale with compute sleeps large enough to dominate host
+    noise: the streamed leg must demonstrably overlap acquire with
+    publish (overlap_ratio > 0 — the ISSUE-9 acceptance shape) and beat
+    the barrier wall clock."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.streamed_sync_section(
+        n_layers=4, layer_kb=8, train_ms=40.0, decode_ms=40.0, iters=1
+    )
+    assert out["barrier_s"] > 0 and out["streamed_s"] > 0
+    # Train (4 x 40 ms) + decode (4 x 40 ms) serialize on the barrier path
+    # and overlap on the streamed one: the win must be visible even on a
+    # noisy host, and the acquire must overlap the publish window.
+    assert out["overlap_ratio"] > 0, out
+    assert out["streamed_s"] < out["barrier_s"], out
+    assert (
+        out["first_token_after_publish_ms"]
+        < out["barrier_first_token_after_publish_ms"]
+    ), out
     json.dumps(out)
 
 

@@ -97,7 +97,17 @@ async def _run_chaos(
         try:
             for v in range(versions):
                 await chaos(v)
-                version = await publisher.publish(_state_dict(v))
+                if v % 3 == 2:
+                    # Every third version publishes LAYER-STREAMED (one
+                    # fragment per key): the chaos schedule interleaves
+                    # with watermarked partial versions, and the barrier
+                    # acquire loop must still never see them unsealed.
+                    cs = publisher.stream()
+                    for key, arr in _state_dict(v).items():
+                        await cs.put({key: arr})
+                    version = await cs.seal()
+                else:
+                    version = await publisher.publish(_state_dict(v))
                 report["published"].append(version)
                 if publish_interval:
                     await asyncio.sleep(publish_interval)
@@ -176,9 +186,16 @@ async def test_chaos_deterministic_kill_and_reconverge(fast_health):
         # The subscriber may skip versions (acquire-latest semantics) but
         # must end on the final one with zero errors.
         assert report["acquired"][-1] == 17
-        # Self-healing: quarantined without intervention...
-        vh = await ts.volume_health("chaos_kill")
-        assert vh[victim["vid"]]["state"] == "quarantined"
+        # Self-healing: quarantined without intervention. Bounded wait —
+        # the run can outpace the supervisor's miss window (streamed
+        # publishes shortened the post-kill phase below 2 x 0.25 s).
+        deadline = time.monotonic() + 30.0
+        while True:
+            vh = await ts.volume_health("chaos_kill")
+            if vh[victim["vid"]]["state"] == "quarantined":
+                break
+            assert time.monotonic() < deadline, f"never quarantined: {vh}"
+            await asyncio.sleep(0.1)
         # ...and the LAST version's keys reconverged to 2 healthy replicas.
         deadline = time.monotonic() + 30.0
         keys = [f"chaos/v17/w{i}" for i in range(4)]
@@ -228,6 +245,16 @@ async def test_chaos_deterministic_fault_schedule(fast_health):
                     "volume.handshake", "delay", count=2, delay_ms=150,
                     store_name="chaos_sched",
                 )
+            elif version == 7:
+                # Watermark application delayed INSIDE the controller's
+                # notify: committed streamed bytes stay invisible to
+                # streaming readers for 150 ms (they keep long-polling);
+                # version 8 is a streamed publish, so this fires mid-
+                # stream under live acquire traffic.
+                await ts.inject_fault(
+                    "channel.watermark", "delay", count=2, delay_ms=150,
+                    scope="controller", store_name="chaos_sched",
+                )
             elif version == 10:
                 # One-sided bracket held open mid-landing: entry stamps
                 # stay visibly odd, concurrent one-sided readers fall back
@@ -247,6 +274,83 @@ async def test_chaos_deterministic_fault_schedule(fast_health):
         await ts.clear_faults(store_name="chaos_sched")
     finally:
         await ts.shutdown("chaos_sched")
+
+
+async def test_chaos_wedged_stream_publisher_never_mixes(fast_health):
+    """A publisher WEDGED mid-stream (channel.publish_layer faultpoint)
+    provably never yields a mixed-generation acquire: barrier subscribers
+    keep getting the previous sealed version, a streaming subscriber
+    serves only the wedged stream's committed prefix and then times out
+    (never returns a dict), and a resumed publisher reclaims the partial
+    before republishing the same version number."""
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="chaos_wedge",
+    )
+    try:
+        pub = ts.WeightPublisher("chaos", store_name="chaos_wedge", keep=3)
+        sub = ts.WeightSubscriber("chaos", store_name="chaos_wedge")
+        # Healthy streamed v0.
+        cs = pub.stream()
+        for key, arr in _state_dict(0).items():
+            await cs.put({key: arr})
+        assert await cs.seal() == 0
+        sd, version = await sub.acquire(timeout=30)
+        assert version == 0
+        _assert_consistent(sd, 0)
+        # v1: two layers land, then the publisher wedges on the third
+        # (client-scope faultpoint — the publisher lives in this process).
+        cs1 = pub.stream()
+        sd1 = _state_dict(1)
+        keys = sorted(sd1)
+        await cs1.put({keys[0]: sd1[keys[0]]})
+        await cs1.put({keys[1]: sd1[keys[1]]})
+        await ts.inject_fault(
+            "channel.publish_layer", "wedge", count=1, scope="client",
+            store_name="chaos_wedge",
+        )
+
+        async def wedged_rest():
+            for key in keys[2:]:
+                await cs1.put({key: sd1[key]})
+            await cs1.seal()
+
+        wedged = asyncio.ensure_future(wedged_rest())
+        await asyncio.sleep(0.3)
+        assert not wedged.done()
+        # Barrier subscriber joining now: v0, fully consistent — the
+        # wedged partial v1 is invisible.
+        sub2 = ts.WeightSubscriber("chaos", store_name="chaos_wedge")
+        sd, version = await sub2.acquire(timeout=15)
+        assert version == 0
+        _assert_consistent(sd, 0)
+        # Streaming subscriber: serves ONLY the committed prefix of v1
+        # (each layer individually consistent at generation 1), then times
+        # out — it never returns a state dict, mixed or otherwise.
+        served = []
+        sub3 = ts.WeightSubscriber("chaos", store_name="chaos_wedge")
+        with pytest.raises((TimeoutError, asyncio.TimeoutError)):
+            await sub3.acquire_streamed(
+                on_layer=lambda fk, v: served.append((fk, float(v[0]))),
+                timeout=3,
+            )
+        assert set(k for k, _ in served) <= set(keys[:2])
+        assert all(val == 1.0 for _, val in served)
+        # The wedged task never completes inside this test: cancel it
+        # (the crash), clear faults, resume with a fresh publisher.
+        wedged.cancel()
+        await asyncio.gather(wedged, return_exceptions=True)
+        await ts.clear_faults(store_name="chaos_wedge")
+        pub2 = ts.WeightPublisher("chaos", store_name="chaos_wedge", keep=3)
+        version = await pub2.publish(_state_dict(1))
+        assert version == 1  # partial v1 reclaimed, number reused
+        sd, version = await sub2.acquire(timeout=30)
+        assert version == 1
+        _assert_consistent(sd, 1)
+    finally:
+        await ts.clear_faults(store_name="chaos_wedge")
+        await ts.shutdown("chaos_wedge")
 
 
 @pytest.mark.slow

@@ -774,6 +774,66 @@ def test_one_sided_discipline_pragma(tmp_path):
     assert result.new == []
 
 
+def test_stream_discipline_flags_raw_watermark_reads(tmp_path):
+    """stream-discipline: raw ``["watermarks"]`` subscripts and
+    ``.get("watermarks")`` in acquire-side modules are flagged; the
+    blessed helpers' home (stream_sync.py) and out-of-scope modules (the
+    controller implements the protocol) pass."""
+    from torchstore_tpu.analysis.checkers import stream_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/weight_channel.py": """
+                async def acquire(client, state, key, version):
+                    wm = state["watermarks"][key]  # seeded defect
+                    ok = state.get("watermarks")  # seeded defect
+                    return wm, ok
+            """,
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu import stream_sync
+                def fine(state, keys, version):
+                    return stream_sync.inconsistent_keys(state, keys, version)
+            """,
+            "torchstore_tpu/stream_sync.py": """
+                def watermark_of(state, key):
+                    return (state.get("watermarks") or {}).get(key)
+            """,
+            "torchstore_tpu/controller.py": """
+                def server_side(rec, key, version):
+                    rec["watermarks"][key] = version  # protocol home
+            """,
+        },
+    )
+    findings = stream_discipline.check(project)
+    assert len(findings) == 2
+    assert all(f.path == "torchstore_tpu/weight_channel.py" for f in findings)
+    assert all("watermark_of" in f.message for f in findings)
+
+
+def test_stream_discipline_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/state_dict_utils.py": """
+                def debug_dump(state):
+                    return dict(state["watermarks"])  # tslint: disable=stream-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["stream-discipline"])
+    assert result.new == []
+
+
+def test_stream_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays
+    empty): every acquire-side watermark check routes through
+    stream_sync's blessed helpers."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["stream-discipline"])
+    assert _msgs(result.findings, "stream-discipline") == []
+
+
 def test_one_sided_discipline_live_tree_clean():
     """The live tree stays clean under the new rule (baseline stays empty):
     every client/direct segment read goes through the stamped helpers, and

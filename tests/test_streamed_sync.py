@@ -1,0 +1,588 @@
+"""Layer-streamed weight sync (ISSUE 9): publish/acquire as a pipeline.
+
+Covers the satellite checklist: out-of-order layer publish with in-order
+delivery, a subscriber joining mid-stream seeing only the previous SEALED
+version, a publisher crash mid-stream leaving the previous version
+acquirable (and GC reclaiming the partial), the per-subscriber lag gauge
+moving during a stream — plus mixed-generation protection under racing
+publishes, the direct-path key order, the doorbell-striping leg, and the
+llama train→publish→decode driver (decode tokens identical to the barrier
+path while layers stream in forward order)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.observability import metrics as obs_metrics
+
+
+def _counter(name: str, **labels) -> float:
+    snap = obs_metrics.metrics_snapshot()
+    return sum(
+        s["value"]
+        for s in snap.get(name, {}).get("series", [])
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _gauge(name: str) -> float:
+    snap = obs_metrics.metrics_snapshot()
+    series = snap.get(name, {}).get("series", [])
+    return series[0]["value"] if series else 0.0
+
+
+# --------------------------------------------------------------------------
+# core protocol: out-of-order publish, in-order delivery, consistency
+# --------------------------------------------------------------------------
+
+
+async def test_out_of_order_publish_in_order_delivery():
+    """Layers published 1,0,3,2 are DELIVERED 0,1,2,3 under key_order —
+    each the moment its watermark (and its predecessors') lands — with the
+    consumer starting before the publisher's first layer, and the barrier
+    reader untouched (wakes only on the sealed, complete dict)."""
+    await ts.initialize(store_name="ss_order")
+    try:
+        order = [f"layers/{i}/w" for i in range(4)]
+        events: list[str] = []
+        consumer = asyncio.ensure_future(
+            ts.get_state_dict_streamed(
+                "m/sd",
+                key_order=order,
+                on_layer=lambda fk, v: events.append(fk),
+                wait_for_stream_s=30,
+                timeout=60,
+                store_name="ss_order",
+            )
+        )
+        await asyncio.sleep(0.05)
+        stream = ts.state_dict_stream("m/sd", store_name="ss_order")
+        for i in (1, 0, 3, 2):  # out-of-order arrival
+            await stream.put(
+                {"layers": {str(i): {"w": np.full(64, float(i), np.float32)}}}
+            )
+            await asyncio.sleep(0.01)
+        version = await stream.seal()
+        assert version == 1
+        sd = await consumer
+        assert events == order, events
+        for i in range(4):
+            assert sd["layers"][str(i)]["w"][0] == float(i)
+        # Barrier path serves the sealed dict exactly as before.
+        sd2 = await ts.get_state_dict("m/sd", store_name="ss_order")
+        assert sd2["layers"]["3"]["w"][0] == 3.0
+        assert _counter("ts_stream_acquires_total") >= 1
+    finally:
+        await ts.shutdown("ss_order")
+
+
+async def test_streamed_get_with_in_place_destinations():
+    """get_state_dict(stream=True) with a user dict lands layers in place
+    (numpy destinations) and validates structure strictly."""
+    await ts.initialize(store_name="ss_dest")
+    try:
+        stream = ts.state_dict_stream("d/sd", store_name="ss_dest")
+        src = {f"w{i}": np.full(128, float(i) + 1, np.float32) for i in range(3)}
+        for k, v in src.items():
+            await stream.put({k: v})
+        await stream.seal()
+        user = {k: np.zeros(128, np.float32) for k in src}
+        out = await ts.get_state_dict(
+            "d/sd", user_state_dict=user, stream=True, store_name="ss_dest"
+        )
+        for k, v in src.items():
+            assert out[k] is user[k]  # in-place landing
+            np.testing.assert_array_equal(user[k], v)
+        # Strict structure check still fires.
+        with pytest.raises(ValueError, match="not present"):
+            await ts.get_state_dict(
+                "d/sd",
+                user_state_dict={**user, "extra": np.zeros(4, np.float32)},
+                stream=True,
+                store_name="ss_dest",
+            )
+    finally:
+        await ts.shutdown("ss_dest")
+
+
+async def test_superseded_stream_restarts_to_newest_consistent():
+    """A faster publisher overwriting the same key mid-acquire: the
+    consumer restarts LOUDLY (ts_stream_fallbacks_total) and returns the
+    newest version's dict — never a mix of generations."""
+    await ts.initialize(store_name="ss_race")
+    try:
+        keys = [f"w{i}" for i in range(3)]
+        served_first = asyncio.Event()
+        resume = asyncio.Event()
+
+        async def on_layer(fk, v):
+            served_first.set()
+            await resume.wait()
+
+        stream1 = ts.state_dict_stream("r/sd", store_name="ss_race")
+        await stream1.put({keys[0]: np.full(64, 10.0, np.float32)})
+        consumer = asyncio.ensure_future(
+            ts.get_state_dict_streamed(
+                "r/sd",
+                on_layer=on_layer,
+                timeout=60,
+                store_name="ss_race",
+            )
+        )
+        await asyncio.wait_for(served_first.wait(), 30)
+        # Supersede: a second stream republishes EVERY key and seals while
+        # the consumer is still blocked inside layer 0 of stream 1.
+        stream2 = ts.state_dict_stream("r/sd", store_name="ss_race")
+        for k in keys:
+            await stream2.put({k: np.full(64, 20.0, np.float32)})
+        await stream2.seal()
+        fb0 = _counter("ts_stream_fallbacks_total", reason="superseded")
+        resume.set()
+        sd = await consumer
+        for k in keys:
+            vals = np.unique(np.asarray(sd[k]))
+            assert vals.size == 1 and vals[0] == 20.0, (k, vals)
+        assert (
+            _counter("ts_stream_fallbacks_total", reason="superseded")
+            > fb0
+            or _counter("ts_stream_fallbacks_total", reason="mixed_generation")
+            > 0
+        )
+    finally:
+        await ts.shutdown("ss_race")
+
+
+async def test_lag_gauge_moves_during_stream():
+    """ts_stream_lag_keys: watermarked-but-unserved keys of the in-flight
+    acquire — nonzero while the subscriber trails the publisher, 0 after."""
+    await ts.initialize(store_name="ss_lag")
+    try:
+        stream = ts.state_dict_stream("l/sd", store_name="ss_lag")
+        for i in range(4):
+            await stream.put({f"w{i}": np.full(64, float(i), np.float32)})
+        await stream.seal()
+        observed: list[float] = []
+
+        async def on_layer(fk, v):
+            observed.append(_gauge("ts_stream_lag_keys"))
+
+        await ts.get_state_dict_streamed(
+            "l/sd", on_layer=on_layer, timeout=60, store_name="ss_lag"
+        )
+        # All four keys were ready before the first serve: the lag gauge
+        # read 4 - served_so_far during the wave (nonzero mid-stream).
+        assert len(observed) == 4
+        assert _gauge("ts_stream_lag_keys") == 0
+    finally:
+        await ts.shutdown("ss_lag")
+
+
+async def test_barrier_republish_over_streamed_key_falls_back():
+    """A BARRIER put_state_dict over a previously streamed key leaves a
+    stale stream record behind (barrier notifies never touch it): the
+    streamed get must serve the barrier dict via the marker-drift
+    fallback, not burn its retries into MixedGenerationError."""
+    await ts.initialize(store_name="ss_drift")
+    try:
+        stream = ts.state_dict_stream("b/sd", store_name="ss_drift")
+        await stream.put({"w": np.full(32, 1.0, np.float32)})
+        await stream.seal()
+        await ts.put_state_dict(
+            "b/sd", {"w": np.full(32, 2.0, np.float32)}, store_name="ss_drift"
+        )
+        fb0 = _counter("ts_stream_fallbacks_total", reason="marker_drift")
+        out = await ts.get_state_dict("b/sd", stream=True, store_name="ss_drift")
+        assert np.asarray(out["w"])[0] == 2.0  # the barrier dict, served
+        assert _counter("ts_stream_fallbacks_total", reason="marker_drift") > fb0
+    finally:
+        await ts.shutdown("ss_drift")
+
+
+async def test_record_cap_evicts_sealed_not_live_streams():
+    """256 one-shot sealed streams must not evict a hot channel's LIVE
+    (unsealed) record: eviction prefers sealed records and touch order."""
+    await ts.initialize(store_name="ss_cap")
+    try:
+        client = ts.client("ss_cap")
+        live = await client.stream_begin("hot/sd")  # in flight, never sealed
+        for i in range(300):  # > MAX_STREAMS one-shot sealed records
+            key = f"cold/{i}"
+            await client.stream_begin(key)
+            await client.stream_seal(key, 1)
+        state = await client.stream_state("hot/sd")
+        assert state is not None and state["version"] == live
+    finally:
+        await ts.shutdown("ss_cap")
+
+
+async def test_phantom_key_order_entry_still_completes_in_order():
+    """A key_order entry the publisher never pushes blocks in-order
+    delivery until the seal (only the seal proves it absent) but the
+    acquire still completes, with on_layer in key_order positions."""
+    await ts.initialize(store_name="ss_phantom")
+    try:
+        stream = ts.state_dict_stream("p/sd", store_name="ss_phantom")
+        for i in range(3):
+            await stream.put({f"w{i}": np.full(32, float(i), np.float32)})
+        await stream.seal()
+        served: list[str] = []
+        out = await ts.get_state_dict_streamed(
+            "p/sd",
+            key_order=["w0", "phantom", "w2", "w1"],
+            on_layer=lambda fk, v: served.append(fk),
+            timeout=60,
+            store_name="ss_phantom",
+        )
+        # w0 serves pre-phantom; the rest at seal, still in caller order.
+        assert served == ["w0", "w2", "w1"]
+        assert all(np.asarray(out[f"w{i}"])[0] == float(i) for i in range(3))
+    finally:
+        await ts.shutdown("ss_phantom")
+
+
+async def test_stream_record_retired_with_its_keys():
+    """Deleting a streamed state dict (its MAPPING marker rides the
+    prefix delete) retires the controller's stream record: a later
+    streamed get falls back to the barrier path's loud NoMatchingPush
+    instead of chasing stale watermarks into missing bytes (regression:
+    an off-by-one in the MAPPING-suffix strip left records alive
+    forever, eventually evicting LIVE streams at the record cap)."""
+    from torchstore_tpu.state_dict_utils import NoMatchingPush
+
+    await ts.initialize(store_name="ss_retire")
+    try:
+        stream = ts.state_dict_stream("g/sd", store_name="ss_retire")
+        await stream.put({"w": np.ones(32, np.float32)})
+        await stream.seal()
+        client = ts.client("ss_retire")
+        assert await client.stream_state("g/sd") is not None
+        removed = await ts.delete_prefix("g/sd", store_name="ss_retire")
+        assert removed >= 2  # the layer key and the marker
+        assert await client.stream_state("g/sd") is None
+        with pytest.raises(NoMatchingPush):
+            await ts.get_state_dict(
+                "g/sd", stream=True, store_name="ss_retire"
+            )
+    finally:
+        await ts.shutdown("ss_retire")
+
+
+# --------------------------------------------------------------------------
+# weight channel: mid-stream join, crash + partial GC
+# --------------------------------------------------------------------------
+
+
+async def test_mid_stream_join_gets_previous_sealed_version():
+    """A barrier subscriber joining while v1 streams (unsealed) gets v0 —
+    partial versions are invisible outside the streamed acquire path."""
+    await ts.initialize(store_name="ss_join")
+    try:
+        pub = ts.WeightPublisher("chan", store_name="ss_join", keep=2)
+        cs0 = pub.stream()
+        for i in range(3):
+            await cs0.put({f"w{i}": np.full(64, 0.0, np.float32)})
+        assert await cs0.seal() == 0
+        # v1 in flight: two of three layers published, NOT sealed.
+        cs1 = pub.stream()
+        await cs1.put({"w0": np.full(64, 1.0, np.float32)})
+        await cs1.put({"w1": np.full(64, 1.0, np.float32)})
+        sub = ts.WeightSubscriber("chan", store_name="ss_join")
+        sd, version = await sub.acquire(timeout=15)
+        assert version == 0
+        assert all(np.asarray(sd[f"w{i}"])[0] == 0.0 for i in range(3))
+        # Sealing v1 wakes the same subscriber with the complete dict.
+        await cs1.put({"w2": np.full(64, 1.0, np.float32)})
+        assert await cs1.seal() == 1
+        sd, version = await sub.acquire(timeout=15)
+        assert version == 1
+        assert all(np.asarray(sd[f"w{i}"])[0] == 1.0 for i in range(3))
+    finally:
+        await ts.shutdown("ss_join")
+
+
+async def test_publisher_crash_leaves_previous_acquirable_and_gc_reclaims():
+    """A publisher dying mid-stream: the previous sealed version stays
+    fully acquirable, and the NEXT publisher's resume reclaims the
+    partial version's keys before republishing the same version number."""
+    await ts.initialize(store_name="ss_crash")
+    try:
+        pub = ts.WeightPublisher("chan", store_name="ss_crash", keep=2)
+        v0 = await pub.publish(
+            {f"w{i}": np.full(64, 0.0, np.float32) for i in range(3)}
+        )
+        assert v0 == 0
+        crashed = pub.stream()
+        await crashed.put({"w0": np.full(64, 1.0, np.float32)})
+        del crashed  # crash: never sealed, never advanced a pointer
+        partial = await ts.keys("chan/v1", store_name="ss_crash")
+        assert partial, "partial stream left no keys to reclaim?"
+        # Previous version still served (barrier AND streamed acquire).
+        sub = ts.WeightSubscriber("chan", store_name="ss_crash")
+        sd, version = await sub.acquire(timeout=15)
+        assert version == 0 and np.asarray(sd["w1"])[0] == 0.0
+        # Resumed publisher reclaims the partial, then reuses v1.
+        pub2 = ts.WeightPublisher("chan", store_name="ss_crash", keep=2)
+        v1 = await pub2.publish(
+            {f"w{i}": np.full(64, 5.0, np.float32) for i in range(3)}
+        )
+        assert v1 == 1
+        sd, version = await sub.acquire(timeout=15)
+        assert version == 1
+        assert all(np.asarray(sd[f"w{i}"])[0] == 5.0 for i in range(3))
+    finally:
+        await ts.shutdown("ss_crash")
+
+
+async def test_channel_streamed_acquire_overlaps_publish():
+    """acquire_streamed wakes on the in-flight announce and serves layers
+    BEFORE the seal: the first on_layer fires while the publisher still
+    has layers to push (the overlap the whole PR exists for)."""
+    await ts.initialize(store_name="ss_chan")
+    try:
+        pub = ts.WeightPublisher("chan", store_name="ss_chan", keep=2)
+        sub = ts.WeightSubscriber("chan", store_name="ss_chan")
+        first_sertwo = asyncio.Event()
+        served: list[str] = []
+
+        def on_layer(fk, v):
+            served.append(fk)
+            first_sertwo.set()
+
+        task = asyncio.ensure_future(
+            sub.acquire_streamed(
+                key_order=[f"w{i}" for i in range(3)],
+                on_layer=on_layer,
+                timeout=60,
+            )
+        )
+        await asyncio.sleep(0.05)
+        cs = pub.stream()
+        await cs.put({"w0": np.full(64, 7.0, np.float32)})
+        # The consumer serves layer 0 while w1/w2 are still unpublished.
+        await asyncio.wait_for(first_sertwo.wait(), 30)
+        assert served == ["w0"]
+        await cs.put({"w1": np.full(64, 7.0, np.float32)})
+        await cs.put({"w2": np.full(64, 7.0, np.float32)})
+        version = await cs.seal()
+        sd, got = await task
+        assert got == version == 0
+        assert served == [f"w{i}" for i in range(3)]
+        assert all(np.asarray(sd[f"w{i}"])[0] == 7.0 for i in range(3))
+    finally:
+        await ts.shutdown("ss_chan")
+
+
+# --------------------------------------------------------------------------
+# direct path: ordered pull
+# --------------------------------------------------------------------------
+
+
+async def test_direct_pull_key_order_and_on_layer():
+    """The one-hop direct path honors key_order/on_layer: layers land and
+    are reported in forward order, values exact, in place."""
+    await ts.initialize(store_name="ss_direct")
+    try:
+        src = {f"w{i}": np.full(256, float(i) + 1, np.float32) for i in range(4)}
+        await ts.put_state_dict(
+            "dk/sd", src, direct=True, store_name="ss_direct"
+        )
+        user = {k: np.zeros(256, np.float32) for k in src}
+        order = [f"w{i}" for i in (0, 1, 2, 3)]
+        served: list[str] = []
+        out = await ts.get_state_dict(
+            "dk/sd",
+            user_state_dict=user,
+            direct=True,
+            key_order=order,
+            on_layer=lambda fk, v: served.append(fk),
+            store_name="ss_direct",
+        )
+        assert served == order
+        for k, v in src.items():
+            np.testing.assert_array_equal(np.asarray(out[k]), v)
+    finally:
+        await ts.shutdown("ss_direct")
+
+
+# --------------------------------------------------------------------------
+# doorbell striping (ROADMAP item-4 remaining depth)
+# --------------------------------------------------------------------------
+
+
+async def test_doorbell_packed_reply_stripes_above_threshold(monkeypatch):
+    """IDX_PACKED doorbell replies above the striping threshold split
+    across the pre-opened stripe set: the volume counts a doorbell-striped
+    transfer and the client reassembles identical bytes."""
+    from torchstore_tpu.transport import bulk
+
+    # Client side reads the module global at call time; the forked volume
+    # re-imports bulk under the forwarded env, so both sides see 8 KB.
+    monkeypatch.setenv("TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD", "8192")
+    monkeypatch.setattr(bulk, "STRIPE_THRESHOLD", 8192)
+    await ts.initialize(
+        store_name="ss_stripe",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+    try:
+        items = {
+            f"s/{i}": np.random.rand(2048).astype(np.float32)  # 8 KB each
+            for i in range(4)
+        }
+        await ts.put_batch(items, store_name="ss_stripe")
+        dests = {k: np.zeros(2048, np.float32) for k in items}
+        # Recording get registers the doorbell plan; the warm repeat rings
+        # it and — with a ~32 KB packed reply over an 8 KB threshold —
+        # receives a striped reply.
+        await ts.get_batch(dict(dests), store_name="ss_stripe")
+        reads0 = _counter("ts_one_sided_reads_total", transport="bulk")
+        await ts.get_batch(dict(dests), store_name="ss_stripe")
+        assert (
+            _counter("ts_one_sided_reads_total", transport="bulk")
+            >= reads0 + len(items)
+        ), "warm batch did not ride the doorbell"
+        for k, v in items.items():
+            np.testing.assert_array_equal(dests[k], v)
+        # The stripe counter lives in the VOLUME process: read it through
+        # the controller's stats fan-out.
+        client = ts.client("ss_stripe")
+        stats = await client.controller.stats.call_one(include_volumes=True)
+        striped = 0.0
+        for vstats in stats["volumes"].values():
+            for s in (
+                vstats.get("metrics", {})
+                .get("ts_bulk_striped_transfers_total", {})
+                .get("series", [])
+            ):
+                if s["labels"].get("direction") == "doorbell":
+                    striped += s["value"]
+        assert striped > 0, "doorbell reply did not stripe"
+    finally:
+        await ts.shutdown("ss_stripe")
+
+
+# --------------------------------------------------------------------------
+# the llama train→publish→decode driver
+# --------------------------------------------------------------------------
+
+
+async def test_llama_streamed_decode_matches_barrier():
+    """The real model loop: tiny-llama params stream-published per module
+    in forward order, acquired streamed (decode-side key order from
+    models.generate.forward_key_order), and greedy decode produces tokens
+    IDENTICAL to the barrier path — while the acquire provably overlapped
+    the publish (first layer served before the last was published)."""
+    import jax
+
+    from torchstore_tpu.models.generate import Decoder, forward_key_order
+    from torchstore_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny()
+    _, params = init_params(cfg)
+    await ts.initialize(store_name="ss_llama")
+    try:
+        # Barrier publish + acquire: the reference tokens.
+        await ts.put_state_dict("llama/sd", params, store_name="ss_llama")
+        barrier_params = await ts.get_state_dict(
+            "llama/sd", store_name="ss_llama"
+        )
+        prompt = np.array([[1, 2, 3, 4]], np.int32)
+        dec = Decoder(cfg, max_len=16)
+        ref_tokens = np.asarray(
+            dec.generate(barrier_params, prompt, max_new_tokens=4)
+        )
+
+        # Streamed publish per top-level module (embed, layer_0, ...).
+        served: list[str] = []
+        first_served = asyncio.Event()
+        publish_done = asyncio.Event()
+        overlap_seen = asyncio.Event()
+
+        async def publisher():
+            stream = ts.state_dict_stream("llama/sds", store_name="ss_llama")
+            await stream.begin()
+            modules = list(params["params"])
+            for name in modules:
+                await stream.put({"params": {name: params["params"][name]}})
+                if name == modules[0]:
+                    # Hold the stream open until the consumer demonstrably
+                    # served the first module — the overlap assertion.
+                    await asyncio.wait_for(first_served.wait(), 30)
+                    overlap_seen.set()
+            await stream.seal()
+            publish_done.set()
+
+        def on_layer(fk, value):
+            served.append(fk)
+            first_served.set()
+
+        order = forward_key_order(params)
+        _, streamed_params = await asyncio.gather(
+            publisher(),
+            ts.get_state_dict_streamed(
+                "llama/sds",
+                key_order=order,
+                on_layer=on_layer,
+                wait_for_stream_s=30,
+                timeout=120,
+                store_name="ss_llama",
+            ),
+        )
+        assert overlap_seen.is_set() and publish_done.is_set()
+        assert served == order  # forward order, every leaf exactly once
+        # Embedding leaves served before any layer_1 leaf: decode-side
+        # forward order held even though publish order was module order.
+        emb_last = max(i for i, k in enumerate(served) if "embed" in k)
+        l1_first = min(i for i, k in enumerate(served) if "layer_1" in k)
+        assert emb_last < l1_first
+        tokens = np.asarray(
+            dec.generate(streamed_params, prompt, max_new_tokens=4)
+        )
+        np.testing.assert_array_equal(tokens, ref_tokens)
+        jax.block_until_ready(tokens)
+    finally:
+        await ts.shutdown("ss_llama")
+
+
+# --------------------------------------------------------------------------
+# manifest / generate key-order helpers
+# --------------------------------------------------------------------------
+
+
+def test_manifest_key_order_preserves_insertion_order():
+    from torchstore_tpu.provision import StateDictManifest
+
+    sd = {
+        "embed": np.zeros(8, np.float32),
+        "layer_1": np.zeros(8, np.float32),
+        "layer_0": np.zeros(8, np.float32),
+        "meta": "not-a-tensor",
+    }
+    manifest = StateDictManifest.from_state_dict(sd)
+    # entries stay name-sorted for pool planning; key_order preserves the
+    # source dict's (model-forward) insertion order, tensors only.
+    assert [e.key for e in manifest.entries] == ["embed", "layer_0", "layer_1"]
+    assert manifest.key_order == ["embed", "layer_1", "layer_0"]
+
+
+def test_forward_key_order_ranks_modules():
+    from torchstore_tpu.models.generate import forward_key_order
+
+    params = {
+        "params": {
+            "lm_head": {"kernel": np.zeros(4, np.float32)},
+            "layer_10": {"w": np.zeros(4, np.float32)},
+            "layer_2": {"w": np.zeros(4, np.float32)},
+            "final_norm": {"scale": np.zeros(4, np.float32)},
+            "embed": {"embedding": np.zeros(4, np.float32)},
+        }
+    }
+    order = forward_key_order(params)
+    assert order == [
+        "params/embed/embedding",
+        "params/layer_2/w",
+        "params/layer_10/w",  # numeric, not lexical
+        "params/final_norm/scale",
+        "params/lm_head/kernel",
+    ]

@@ -24,6 +24,8 @@ from torchstore_tpu.api import (
     get_batch,
     direct_staging_buffers,
     get_state_dict,
+    get_state_dict_streamed,
+    state_dict_stream,
     initialize,
     initialize_spmd,
     inject_fault,
@@ -97,6 +99,8 @@ __all__ = [
     "get",
     "get_batch",
     "get_state_dict",
+    "get_state_dict_streamed",
+    "state_dict_stream",
     "initialize",
     "initialize_spmd",
     "inject_fault",

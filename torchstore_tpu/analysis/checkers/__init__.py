@@ -19,6 +19,7 @@ from torchstore_tpu.analysis.checkers import (
     one_sided,
     orphan_task,
     retry_discipline,
+    stream_discipline,
 )
 
 CHECKERS = {
@@ -32,4 +33,5 @@ CHECKERS = {
     landing_copy.RULE: landing_copy.check,
     retry_discipline.RULE: retry_discipline.check,
     one_sided.RULE: one_sided.check,
+    stream_discipline.RULE: stream_discipline.check,
 }

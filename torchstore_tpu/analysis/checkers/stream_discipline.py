@@ -1,0 +1,83 @@
+"""stream-discipline: watermark checks go through the blessed helpers.
+
+The layer-streamed sync protocol (PR 9, torchstore_tpu/stream_sync.py) is
+only sound because every served key's version watermark is validated the
+same way: ``stream_sync.watermark_of`` / ``stream_sync.inconsistent_keys``
+own the exact-equality rule ("every served key must carry the target
+version watermark; newer IS mixed-generation") and the None-handling for
+evicted/restarted records. Acquire-side code that reads the raw
+``watermarks`` dict out of a stream-state reply (or compares versions by
+hand) re-derives that rule — and the first drift (a ``>=`` instead of
+``==``, a missing None guard) silently reintroduces the mixed-generation
+reads the watermark protocol exists to kill.
+
+Rule: in the acquire-side modules (client.py, direct_weight_sync.py,
+state_dict_utils.py, weight_channel.py, api.py), any subscript or
+``.get(...)`` whose key is the string literal ``"watermarks"`` is
+forbidden — route the check through the blessed helpers instead.
+``stream_sync.py`` (the helpers' home) and the controller (the protocol's
+server side) are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project
+
+RULE = "stream-discipline"
+
+_SCOPED_FILES = (
+    "torchstore_tpu/client.py",
+    "torchstore_tpu/direct_weight_sync.py",
+    "torchstore_tpu/state_dict_utils.py",
+    "torchstore_tpu/weight_channel.py",
+    "torchstore_tpu/api.py",
+)
+
+_MESSAGE = (
+    "raw stream-watermark read in an acquire-side module: check served "
+    "keys through stream_sync.watermark_of / stream_sync.inconsistent_keys "
+    "(the blessed helpers own the exact-version consistency rule) — a "
+    "hand-rolled read can silently serve mixed-generation weights"
+)
+
+
+def _is_watermarks_literal(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value == "watermarks"
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path not in _SCOPED_FILES:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Subscript) and _is_watermarks_literal(
+                node.slice
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+                continue
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and _is_watermarks_literal(node.args[0])
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+    return findings

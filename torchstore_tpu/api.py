@@ -376,12 +376,68 @@ async def get_state_dict(
     user_state_dict: Any = None,
     direct: bool = False,
     strict: bool = True,
+    key_order: Optional[list] = None,
+    on_layer: Any = None,
+    stream: bool = False,
     store_name: str = DEFAULT_STORE,
 ) -> Any:
     from torchstore_tpu import state_dict_utils
 
     return await state_dict_utils.get_state_dict(
-        client(store_name), key, user_state_dict, direct=direct, strict=strict
+        client(store_name),
+        key,
+        user_state_dict,
+        direct=direct,
+        strict=strict,
+        key_order=key_order,
+        on_layer=on_layer,
+        stream=stream,
+    )
+
+
+def state_dict_stream(
+    key: str, transfer_dtype=None, store_name: str = DEFAULT_STORE
+):
+    """Open an incremental (layer-streamed) publish of ``key``: push
+    fragments with ``await stream.put(...)`` as tensors become ready, then
+    ``await stream.seal()`` — each batch is watermarked per key so
+    streaming consumers (``get_state_dict(stream=True)`` /
+    ``WeightSubscriber.acquire_streamed``) serve it immediately, while
+    barrier readers still wake only on the sealed, complete dict. See
+    :mod:`torchstore_tpu.stream_sync`."""
+    from torchstore_tpu import state_dict_utils
+
+    return state_dict_utils.stream_state_dict(
+        client(store_name), key, transfer_dtype=transfer_dtype
+    )
+
+
+async def get_state_dict_streamed(
+    key: str,
+    user_state_dict: Any = None,
+    key_order: Optional[list] = None,
+    on_layer: Any = None,
+    strict: bool = True,
+    timeout: Optional[float] = None,
+    wait_for_stream_s: Optional[float] = None,
+    store_name: str = DEFAULT_STORE,
+) -> Any:
+    """Acquire a streamed publish layer by layer (long-poll, no spin):
+    each key is served the moment its watermark lands, in ``key_order``
+    when given, with ``on_layer(flat_key, value)`` per served leaf.
+    ``wait_for_stream_s`` waits for a publisher that hasn't begun yet.
+    Never mixes generations — see torchstore_tpu/stream_sync.py."""
+    from torchstore_tpu import stream_sync
+
+    return await stream_sync.get_state_dict_streamed(
+        client(store_name),
+        key,
+        user_state_dict=user_state_dict,
+        key_order=key_order,
+        on_layer=on_layer,
+        strict=strict,
+        timeout=timeout,
+        wait_for_stream_s=wait_for_stream_s,
     )
 
 
@@ -826,6 +882,7 @@ __all__ = [
     "get",
     "get_batch",
     "get_state_dict",
+    "get_state_dict_streamed",
     "initialize",
     "initialize_spmd",
     "keys",
@@ -838,5 +895,6 @@ __all__ = [
     "repair",
     "reset_client",
     "shutdown",
+    "state_dict_stream",
     "wait_for",
 ]

@@ -442,7 +442,10 @@ class LocalClient:
         await self.put_batch({key: value})
 
     async def put_batch(
-        self, items: dict[str, Any], plan_hint: Optional[dict] = None
+        self,
+        items: dict[str, Any],
+        plan_hint: Optional[dict] = None,
+        watermark: Optional[tuple] = None,
     ) -> None:
         t0 = time.perf_counter()
         try:
@@ -454,7 +457,7 @@ class LocalClient:
                 keys=len(items),
                 key=next(iter(items), None),
             ) as sp:
-                nbytes = await self._put_batch(items, sp, plan_hint)
+                nbytes = await self._put_batch(items, sp, plan_hint, watermark)
                 dur = time.perf_counter() - t0
                 obs_profile.record_op(
                     "put",
@@ -473,7 +476,11 @@ class LocalClient:
         _OP_SECONDS.observe(dur, op="put")
 
     async def _put_batch(
-        self, items: dict[str, Any], sp, plan_hint: Optional[dict] = None
+        self,
+        items: dict[str, Any],
+        sp,
+        plan_hint: Optional[dict] = None,
+        watermark: Optional[tuple] = None,
     ) -> int:
         await self._ensure_setup()
         if self._volumes_stale:
@@ -645,6 +652,10 @@ class LocalClient:
             # a previous placement before failover re-routed) holds
             # superseded bytes — detach + reclaim them in the same step.
             supersede=True,
+            # Streamed publishes stamp every key of this batch with the
+            # stream version in the same indexing step — the watermark is
+            # only ever visible once its bytes are committed.
+            watermark=watermark,
         )
         # The notify reply carries the placement epoch for free: a bump
         # (structural change anywhere in the fleet) drops cached plans.
@@ -1628,3 +1639,39 @@ class LocalClient:
         return await self._controller.wait_for_change.with_timeout(
             self._wait_rpc_timeout(timeout)
         ).call_one(key, last_gen, timeout)
+
+    # ------------------------------------------------------------------
+    # layer-streamed sync (see torchstore_tpu/stream_sync.py)
+    # ------------------------------------------------------------------
+
+    async def stream_begin(self, key: str) -> int:
+        """Open the next streamed publish of ``key``; returns the assigned
+        stream version."""
+        await self._ensure_setup()
+        return await self._controller.stream_begin.call_one(key)
+
+    async def stream_seal(self, key: str, version: int) -> None:
+        await self._ensure_setup()
+        await self._controller.stream_seal.call_one(key, version)
+
+    async def stream_state(self, key: str) -> Optional[dict]:
+        """Snapshot of ``key``'s stream record, or None when never
+        streamed. Always validate served keys through the blessed helpers
+        in :mod:`torchstore_tpu.stream_sync` (tslint ``stream-discipline``)."""
+        await self._ensure_setup()
+        return await self._controller.stream_state.call_one(key)
+
+    async def wait_for_stream(
+        self,
+        key: str,
+        version: int,
+        known: int = 0,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Long-poll streamed-publish progress (see
+        Controller.wait_for_stream); the substrate for layer-by-layer
+        acquires — woken by the notify that commits each layer, no spin."""
+        await self._ensure_setup()
+        return await self._controller.wait_for_stream.with_timeout(
+            self._wait_rpc_timeout(timeout)
+        ).call_one(key, version, known, timeout)

@@ -69,6 +69,20 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "signature), invalidated by the controller's placement epoch, "
            "so repeated RL-sync iterations skip re-validation and "
            "re-locate."),
+    EnvVar("TORCHSTORE_TPU_STREAM_POLL_S", "float", 10.0,
+           "Layer-streamed sync: per-round long-poll window, seconds, for "
+           "wait_for_stream on the controller (the acquire side re-polls "
+           "after each window to refresh its lag gauge and deadline; "
+           "wakeups are notify-driven, never a spin)."),
+    EnvVar("TORCHSTORE_TPU_STREAM_RETRIES", "int", 2,
+           "Layer-streamed sync: how many times a streamed acquire "
+           "restarts after observing a superseded or mixed-generation "
+           "stream (a newer publish overwrote keys mid-acquire) before "
+           "failing loudly."),
+    EnvVar("TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD", "int", 67108864,
+           "Bulk transport payloads above this many bytes are striped "
+           "across the pre-opened stripe connection set (puts, get "
+           "replies, and IDX_PACKED doorbell replies)."),
     EnvVar("TORCHSTORE_TPU_ONE_SIDED", "bool", True,
            "One-sided data plane for warm gets: same-host readers with a "
            "cached plan read stamped (seqlock-validated) bytes directly "
@@ -382,6 +396,14 @@ class StoreConfig:
     # the RPC path and bump ts_one_sided_fallbacks_total.
     one_sided: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_ONE_SIDED", True)
+    )
+    # Layer-streamed sync: long-poll window per wait_for_stream round and
+    # the mixed-generation/superseded re-acquire budget (stream_sync.py).
+    stream_poll_s: float = field(
+        default_factory=lambda: _env_float("TORCHSTORE_TPU_STREAM_POLL_S", 10.0)
+    )
+    stream_retries: int = field(
+        default_factory=lambda: _env_int("TORCHSTORE_TPU_STREAM_RETRIES", 2)
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

@@ -227,6 +227,18 @@ class Controller(Actor):
         # prewarmer's reservation expires by TTL instead of pinning capacity
         # forever.
         self._prewarm_reservations: dict[str, tuple[float, dict[str, int]]] = {}
+        # Layer-streamed sync state: sd_key -> {"version", "sealed",
+        # "watermarks": {store_key: version}}. ``version`` is the stream in
+        # flight (or last begun), ``sealed`` the highest sealed version, and
+        # each watermark records the NEWEST version whose bytes landed for
+        # that store key (set inside notify_put_batch, so a watermark is
+        # only ever visible once its data-plane bytes are committed). The
+        # marker (sd_key/MAPPING) stays the terminal seal record readers of
+        # the barrier path key on; these records are the append-progressive
+        # half that lets streaming readers serve per-key partial versions.
+        self._streams: dict[str, dict] = {}
+
+    MAX_STREAMS = 256
 
     def _cond(self):
         import asyncio
@@ -386,6 +398,7 @@ class Controller(Actor):
         detach_volume_ids: Optional[list[str]] = None,
         write_gens: Optional[dict[str, dict[str, int]]] = None,
         supersede: bool = False,
+        watermark: Optional[tuple] = None,
     ) -> None:
         """Index ``metas`` as stored on ``volume_id`` — a single id, or a
         LIST of ids for replicated puts (one RPC, one generation bump, and
@@ -409,7 +422,13 @@ class Controller(Actor):
         auto-repair re-replicated while its home volume was quarantined —
         and is detached + reclaimed in the same indexing step. Must stay
         False for partial writers (``replicate_to``/repair, which add
-        copies without touching the others)."""
+        copies without touching the others).
+
+        ``watermark``: ``(stream_key, version)`` from a layer-streamed
+        publish — every meta in this batch records ``version`` as its
+        per-key stream watermark IN THE SAME INDEXING STEP as the metadata
+        (no RPC between bytes-committed and watermark-visible), and the
+        generation bump below wakes ``wait_for_stream`` long-pollers."""
         await faults.afire("controller.notify")
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         stale_gens: dict[str, dict[str, int]] = {}
@@ -524,6 +543,20 @@ class Controller(Actor):
                 self._schedule_reclaim(vid, keys)
         if structural:
             self._placement_epoch += 1
+        if watermark is not None:
+            # Faultpoint INSIDE the watermark step: a delay/wedge here holds
+            # committed bytes invisible to streaming readers (they keep
+            # long-polling — never serve unwatermarked keys); a raise fails
+            # the whole notify, so the publisher sees the error before any
+            # reader could have trusted the partial version.
+            await faults.afire("channel.watermark")
+            stream_key, version = watermark
+            rec = self._stream_rec(stream_key, int(version))
+            for meta in metas:
+                prev = rec["watermarks"].get(meta.key, 0)
+                # max(): a delayed notify from a superseded stream must
+                # never roll a key's watermark backwards.
+                rec["watermarks"][meta.key] = max(prev, int(version))
         await self._bump({meta.key for meta in metas})
         # The reply carries the placement epoch so publishers track it for
         # free (no extra RPC): a bump invalidates their cached plans.
@@ -764,6 +797,15 @@ class Controller(Actor):
         # (they re-check state and see 'missing').
         deleted = {k for vkeys in by_volume.values() for k in vkeys}
         if deleted:
+            # Deleting a streamed state dict's commit marker retires its
+            # stream record too (delete_prefix of a version directory takes
+            # the marker with it): established wait_for_stream pollers wake
+            # and observe the record gone instead of blocking forever, and
+            # per-key watermarks are dropped with the bytes they described.
+            for key in deleted:
+                self._streams.pop(key, None)
+                if key.endswith("/MAPPING"):
+                    self._streams.pop(key[: -len("/MAPPING")], None)
             self._placement_epoch += 1
             await self._bump(deleted)
         return by_volume
@@ -861,6 +903,151 @@ class Controller(Actor):
                 "missing" if infos is None else self._committed_state(infos)
             )
             return {"gen": self._key_gens.get(key, 0), "state": state}
+
+    # ---- layer-streamed sync (watermark protocol) ------------------------
+
+    def _stream_rec(self, key: str, version: Optional[int] = None) -> dict:
+        """The stream record for ``key``, created on first touch. Bounded:
+        at MAX_STREAMS the least-recently-touched SEALED (idle) record is
+        evicted first — a hot RL channel's live record must never lose to
+        256 one-shot streams — falling back to the overall oldest only
+        when every record has a stream in flight. Readers of an evicted
+        record fall back to the barrier path loudly."""
+        rec = self._streams.pop(key, None)
+        if rec is None:
+            if len(self._streams) >= self.MAX_STREAMS:
+                victim = next(
+                    (
+                        k
+                        for k, r in self._streams.items()
+                        if r["sealed"] >= r["version"]
+                    ),
+                    next(iter(self._streams)),
+                )
+                self._streams.pop(victim)
+            rec = {
+                "version": version or 1,
+                "sealed": 0,
+                "watermarks": {},
+            }
+        elif version is not None and version > rec["version"]:
+            rec["version"] = version
+        # Re-insert at the END: dict order doubles as touch recency, so a
+        # steadily re-streamed key stays clear of the eviction scan.
+        self._streams[key] = rec
+        return rec
+
+    @endpoint
+    async def stream_begin(self, key: str) -> int:
+        """Open the next streamed publish of ``key``; returns the assigned
+        version (monotonic per key per controller lifetime). Long-pollers
+        waiting for a stream to appear are woken (they observe the new
+        in-flight version and can start acquiring layer by layer)."""
+        rec = self._streams.get(key)
+        version = (max(rec["version"], rec["sealed"]) + 1) if rec else 1
+        self._stream_rec(key, version)
+        cond = self._cond()
+        async with cond:
+            cond.notify_all()
+        return version
+
+    @endpoint
+    async def stream_seal(self, key: str, version: int) -> None:
+        """Terminal seal record for one streamed publish: the publisher
+        calls it AFTER writing the MAPPING commit marker, so a sealed
+        stream always has a readable barrier-path state dict too."""
+        rec = self._stream_rec(key, int(version))
+        rec["sealed"] = max(rec["sealed"], int(version))
+        cond = self._cond()
+        async with cond:
+            cond.notify_all()
+
+    @endpoint
+    async def stream_state(self, key: str) -> Optional[dict]:
+        """Snapshot of a stream record ({"version", "sealed", "watermarks"})
+        or None when ``key`` was never streamed (or its record was evicted
+        / lost to a controller restart) — the acquire side's final
+        consistency re-check reads this once after serving every layer."""
+        rec = self._streams.get(key)
+        if rec is None:
+            return None
+        return {
+            "version": rec["version"],
+            "sealed": rec["sealed"],
+            "watermarks": dict(rec["watermarks"]),
+        }
+
+    @endpoint
+    async def wait_for_stream(
+        self,
+        key: str,
+        version: int,
+        known: int = 0,
+        timeout: Optional[float] = None,
+    ) -> dict[str, Any]:
+        """Long-poll for streamed-publish progress (notify-woken, no spin):
+        blocks until ``key``'s stream has MORE than ``known`` store keys
+        watermarked at ``version`` or newer, or version ``version`` seals,
+        or a newer stream begins (superseded), or the record disappears.
+        ``known = -1`` waits for the stream record to EXIST at all (a
+        consumer arriving before the publisher's first layer).
+
+        Returns ``{"missing", "version", "sealed", "superseded", "ready",
+        "watermarks"}`` — ``ready`` lists store keys whose watermark is at
+        least ``version`` and ``watermarks`` carries their exact values
+        (a reader treats > ``version`` as mixed-generation and restarts)."""
+        import asyncio
+
+        version = int(version)
+        cond = self._cond()
+
+        def _view() -> Optional[dict]:
+            rec = self._streams.get(key)
+            if rec is None:
+                return None
+            ready = {
+                k: v for k, v in rec["watermarks"].items() if v >= version
+            }
+            return {
+                "missing": False,
+                "version": rec["version"],
+                "sealed": rec["sealed"] >= version,
+                "superseded": rec["version"] > version,
+                "ready": sorted(ready),
+                "watermarks": ready,
+            }
+
+        def _changed() -> bool:
+            view = _view()
+            if view is None:
+                return known >= 0  # absent record wakes established readers
+            if known < 0:
+                return True  # the record exists: that is what was awaited
+            return (
+                len(view["ready"]) > known
+                or view["sealed"]
+                or view["superseded"]
+            )
+
+        async with cond:
+            try:
+                await asyncio.wait_for(cond.wait_for(_changed), timeout)
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"wait_for_stream({key!r}, v{version}) timed out after "
+                    f"{timeout}s with {known} key(s) already served"
+                ) from None
+            view = _view()
+            if view is None:
+                return {
+                    "missing": True,
+                    "version": 0,
+                    "sealed": False,
+                    "superseded": False,
+                    "ready": [],
+                    "watermarks": {},
+                }
+            return view
 
     # ---- prewarm capacity reservations -----------------------------------
 
@@ -1476,6 +1663,7 @@ class Controller(Actor):
         self._reclaim_tasks.clear()
         self._prewarm_reservations.clear()
         self._expire_prewarm()  # zero the reserved-bytes gauges too
+        self._streams.clear()
         self.index = Trie()
         await asyncio.gather(
             *(ref.reset.call_one() for ref in self.volume_refs.values()),

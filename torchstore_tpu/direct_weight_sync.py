@@ -918,6 +918,8 @@ class DirectWeightSyncDest:
         self,
         all_handles: dict[str, list[WeightHandle]],
         dest_state_dict: Any,
+        key_order: Optional[list] = None,
+        on_layer=None,
     ) -> Any:
         """Concurrently pull every planned region and rebuild the dest dict,
         seqlock-validated against concurrent source refreshes: source
@@ -925,7 +927,16 @@ class DirectWeightSyncDest:
         retries ONCE when any source refreshed mid-flight (a retry fully
         overwrites in-place landings). The plan is cached and reused while
         the handle/dest signature is unchanged (reference cached-plan
-        invariant)."""
+        invariant).
+
+        ``key_order`` (model-forward order) serializes the pull into
+        per-key waves so the FIRST layers land first, with
+        ``on_layer(flat_key, value)`` (sync or async) invoked as each key
+        completes — the consumer's forward pass starts before the last
+        layer lands. Note the seqlock re-check still happens at the END of
+        the full pull: on_layer consumers must treat served layers as
+        tentative until pull returns (a raced refresh retries the whole
+        pull and re-serves every layer)."""
         endpoints = sorted(
             {
                 (h.hostname, h.port)
@@ -940,8 +951,12 @@ class DirectWeightSyncDest:
             except KeyError:
                 # Pre-generation source (or server without the op): serve
                 # the pull unchecked rather than failing it.
-                return await self._pull_once(all_handles, dest_state_dict)
-            result = await self._pull_once(all_handles, dest_state_dict)
+                return await self._pull_once(
+                    all_handles, dest_state_dict, key_order, on_layer
+                )
+            result = await self._pull_once(
+                all_handles, dest_state_dict, key_order, on_layer
+            )
             gens1 = list(
                 await asyncio.gather(
                     *(self._read_gen(h, p) for h, p in endpoints)
@@ -1105,6 +1120,8 @@ class DirectWeightSyncDest:
         self,
         all_handles: dict[str, list[WeightHandle]],
         dest_state_dict: Any,
+        key_order: Optional[list] = None,
+        on_layer=None,
     ) -> Any:
         tracker = LatencyTracker("direct_pull")
         dest_flat, mapping = flatten_state_dict(dest_state_dict)
@@ -1163,27 +1180,83 @@ class DirectWeightSyncDest:
             hkey: _row_range(handle, ops)
             for hkey, (handle, ops) in by_handle.items()
         }
-        reads = await asyncio.gather(
-            *(
-                self._read_shard(handle, row_ranges[hkey])
-                for hkey, (handle, _) in by_handle.items()
-            )
-        )
-        shard_raws = dict(zip(by_handle.keys(), reads))
-        ops_bytes = 0
-        for hkey, (arr, row0) in shard_raws.items():
-            ops_bytes += arr.nbytes
-            for op in by_handle[hkey][1]:
-                self._apply_op(op, arr, row0, landings)
-        tracker.track_step("reads", ops_bytes)
-
         out_flat = dict(dest_flat)
-        for flat_key, parts in landings.items():
-            if flat_key in inplace_targets:
-                out_flat[flat_key] = parts[0][1]  # already the target array
-            else:
-                out_flat[flat_key] = _rebuild(dest_flat[flat_key], parts)
-        tracker.track_step("rebuild")
+        if key_order is not None or on_layer is not None:
+            # Ordered per-key waves (layer-streamed consumers): each flat
+            # key's shard reads + landings complete before the next key
+            # starts, so forward-order consumers see layer k before k+1.
+            # A shard feeding several keys is still read ONCE (cached by
+            # handle key); keys outside the order are appended after it.
+            from torchstore_tpu.utils import maybe_await
+
+            ops_by_key: dict[str, list[_TransferOp]] = {}
+            for op in self._plan:
+                ops_by_key.setdefault(op.flat_key, []).append(op)
+            order = [k for k in (key_order or []) if k in ops_by_key]
+            tail = [k for k in ops_by_key if k not in set(order)]
+            shard_raws: dict[tuple, tuple] = {}
+            ops_bytes = 0
+            for flat_key in order + tail:
+                need = []
+                for op in ops_by_key[flat_key]:
+                    hkey = (
+                        op.handle.hostname,
+                        op.handle.port,
+                        op.handle.buffer_id,
+                    )
+                    if hkey not in shard_raws and hkey not in need:
+                        need.append(hkey)
+                reads = await asyncio.gather(
+                    *(
+                        self._read_shard(by_handle[hk][0], row_ranges[hk])
+                        for hk in need
+                    )
+                )
+                for hk, read in zip(need, reads):
+                    shard_raws[hk] = read
+                    ops_bytes += read[0].nbytes
+                for op in ops_by_key[flat_key]:
+                    hkey = (
+                        op.handle.hostname,
+                        op.handle.port,
+                        op.handle.buffer_id,
+                    )
+                    arr, row0 = shard_raws[hkey]
+                    self._apply_op(op, arr, row0, landings)
+                parts = landings[flat_key]
+                if flat_key in inplace_targets:
+                    out_flat[flat_key] = parts[0][1]
+                else:
+                    out_flat[flat_key] = _rebuild(
+                        dest_flat[flat_key], parts
+                    )
+                if on_layer is not None:
+                    await maybe_await(
+                        on_layer(flat_key, out_flat[flat_key])
+                    )
+            tracker.track_step("reads", ops_bytes)
+            tracker.track_step("rebuild")
+        else:
+            reads = await asyncio.gather(
+                *(
+                    self._read_shard(handle, row_ranges[hkey])
+                    for hkey, (handle, _) in by_handle.items()
+                )
+            )
+            shard_raws = dict(zip(by_handle.keys(), reads))
+            ops_bytes = 0
+            for hkey, (arr, row0) in shard_raws.items():
+                ops_bytes += arr.nbytes
+                for op in by_handle[hkey][1]:
+                    self._apply_op(op, arr, row0, landings)
+            tracker.track_step("reads", ops_bytes)
+
+            for flat_key, parts in landings.items():
+                if flat_key in inplace_targets:
+                    out_flat[flat_key] = parts[0][1]  # the target array
+                else:
+                    out_flat[flat_key] = _rebuild(dest_flat[flat_key], parts)
+            tracker.track_step("rebuild")
         tracker.log_summary(level=20)
         from torchstore_tpu.state_dict_utils import unflatten_state_dict
 

@@ -20,6 +20,14 @@ store's hot paths:
                           applied — delay/wedge holds entries visibly
                           write-in-flight so one-sided readers observe the
                           odd stamp and fall back
+    channel.publish_layer publisher-side entry of every streamed layer
+                          batch (stream_sync.StreamedPut.put) — wedge/delay
+                          freezes a publisher mid-stream; readers must keep
+                          serving the previous sealed version, never a mix
+    channel.watermark     controller-side watermark application inside
+                          notify_put_batch — delay/wedge holds committed
+                          bytes invisible to streaming readers (they keep
+                          long-polling); raise fails the publisher's put
     actor.ping            ActorServer control-ping (per process: arming it
                           inside a volume wedges THAT volume's heartbeats)
     bulk.send_frame       bulk transport frame send (client and server)
@@ -84,6 +92,8 @@ REGISTRY: frozenset[str] = frozenset(
         "volume.handshake",
         "shm.handshake",
         "shm.landing_stamp",
+        "channel.publish_layer",
+        "channel.watermark",
         "actor.ping",
         "bulk.send_frame",
         "bulk.recv_frame",

@@ -24,6 +24,33 @@ import jax.numpy as jnp
 from torchstore_tpu.models.llama import Llama, LlamaConfig
 
 
+def forward_key_order(params: Any) -> list:
+    """Flat param keys of a :class:`Llama` tree in MODEL-FORWARD order:
+    embedding, then ``layer_0 .. layer_N`` numerically, then the final
+    norm, then the lm head (anything else after, lexically). This is the
+    ``key_order`` a layer-streamed acquire consumes layers in so the
+    decoder's forward pass can start at the embedding while deeper layers
+    are still in flight (``ts.get_state_dict(stream=True, key_order=...)``
+    / ``WeightSubscriber.acquire_streamed``)."""
+    from torchstore_tpu.state_dict_utils import flatten_state_dict
+
+    flat, _ = flatten_state_dict(params)
+
+    def rank(key: str) -> tuple:
+        for part in key.split("/"):
+            if part == "embed":
+                return (0, 0)
+            if part.startswith("layer_") and part[6:].isdigit():
+                return (1, int(part[6:]))
+            if part == "final_norm":
+                return (2, 0)
+            if part == "lm_head":
+                return (3, 0)
+        return (4, 0)
+
+    return sorted(flat, key=lambda k: (rank(k), k))
+
+
 class Decoder:
     """Jitted prefill + per-token step over a KV cache.
 

@@ -48,10 +48,26 @@ class StateDictManifest:
     # True when any tensor leaf is a device-resident jax array: the ICI rung
     # (transfer server) is worth prewarming too.
     device_resident: bool = False
+    # Flat keys in the SOURCE dict's insertion order — for a model state
+    # dict this is model-forward order (flatten preserves dict iteration
+    # order), the key order layer-streamed acquires consume layers in.
+    # ``entries`` stays name-sorted for stable pool planning.
+    order: tuple = ()
 
     @property
     def total_bytes(self) -> int:
         return sum(e.nbytes for e in self.entries)
+
+    @property
+    def key_order(self) -> list[str]:
+        """Tensor-leaf flat keys in model-forward (insertion) order — the
+        ``key_order`` argument of streamed acquires
+        (``get_state_dict(stream=True, key_order=...)``,
+        ``WeightSubscriber.acquire_streamed``)."""
+        if self.order:
+            named = {e.key for e in self.entries}
+            return [k for k in self.order if k in named]
+        return [e.key for e in self.entries]
 
     def segment_sizes(self, arena_max_bytes: int = 0) -> dict[int, int]:
         """{segment size: count} over every put request — exactly the pool
@@ -126,7 +142,11 @@ class StateDictManifest:
             if entry is not None:
                 entries.append(entry)
                 device = device or on_device
-        return cls(entries=entries, device_resident=device)
+        return cls(
+            entries=entries,
+            device_resident=device,
+            order=tuple(flat),
+        )
 
 
 def _itemsize(dtype_name: str) -> int:

@@ -549,7 +549,12 @@ async def preplan_direct(client, key: str, user_state_dict: Any) -> dict:
 
 
 async def _get_state_dict_direct(
-    client, key: str, user_state_dict: Any, _retry: bool = True
+    client,
+    key: str,
+    user_state_dict: Any,
+    _retry: bool = True,
+    key_order: Optional[list] = None,
+    on_layer=None,
 ) -> Any:
     from torchstore_tpu.direct_weight_sync import PullRaceError
 
@@ -570,7 +575,14 @@ async def _get_state_dict_direct(
                     "the host path"
                 )
             return await dest.pull_device(device_infos, user_state_dict)
-        return await dest.pull(all_handles, user_state_dict)
+        # Ordering kwargs only when requested: plain pulls keep the
+        # two-argument call shape (test stubs and subclasses rely on it).
+        kwargs = {}
+        if key_order is not None:
+            kwargs["key_order"] = key_order
+        if on_layer is not None:
+            kwargs["on_layer"] = on_layer
+        return await dest.pull(all_handles, user_state_dict, **kwargs)
     except (ConnectionError, OSError, KeyError, ValueError, PullRaceError):
         # ValueError covers stale-plan shape mismatches after a source
         # republish; PullRaceError covers seqlock settle timeouts / double
@@ -583,7 +595,12 @@ async def _get_state_dict_direct(
         cache.dests.pop(key, None)
         await dest.close()
         return await _get_state_dict_direct(
-            client, key, user_state_dict, _retry=False
+            client,
+            key,
+            user_state_dict,
+            _retry=False,
+            key_order=key_order,
+            on_layer=on_layer,
         )
 
 
@@ -715,18 +732,51 @@ def direct_staging_buffers(client, key: str, rank: int = 0) -> Any:
     return source.staging_state_dict()
 
 
+def stream_state_dict(client, key: str, transfer_dtype=None):
+    """Open an incremental (layer-streamed) publish of ``key``: push
+    fragments with ``await stream.put(...)`` as tensors become ready, then
+    ``await stream.seal()``. See :mod:`torchstore_tpu.stream_sync`."""
+    from torchstore_tpu import stream_sync
+
+    return stream_sync.stream_state_dict(
+        client, key, transfer_dtype=transfer_dtype
+    )
+
+
 async def get_state_dict(
     client,
     key: str,
     user_state_dict: Any = None,
     direct: bool = False,
     strict: bool = True,
+    key_order: Optional[list] = None,
+    on_layer=None,
+    stream: bool = False,
 ) -> Any:
     """Fetch a complete state dict. With ``user_state_dict``, its leaves act
     as fetch targets (sharded jax.Arrays reshard on the fly; numpy arrays are
     filled in place) and the stored mapping must match the user structure
     exactly (strict=True parity,
-    /root/reference/torchstore/state_dict_utils.py:146-174)."""
+    /root/reference/torchstore/state_dict_utils.py:146-174).
+
+    ``stream=True`` (or any ``key_order``/``on_layer``) acquires layer by
+    layer against a streamed publish: each key is served the moment its
+    version watermark lands — in ``key_order`` (model-forward) order when
+    given — with ``on_layer(flat_key, value)`` invoked per served leaf, so
+    forward compute starts before the last layer lands. Falls back to the
+    barrier path when the key was never stream-published. On the direct
+    path, ``key_order``/``on_layer`` order the one-hop pull instead."""
+    if not direct and (stream or key_order is not None or on_layer is not None):
+        from torchstore_tpu import stream_sync
+
+        return await stream_sync.get_state_dict_streamed(
+            client,
+            key,
+            user_state_dict=user_state_dict,
+            key_order=key_order,
+            on_layer=on_layer,
+            strict=strict,
+        )
     if direct:
         # The direct path naturally pulls exactly the user dict's keys
         # (handles are matched per key), i.e. subset pulls just work —
@@ -734,7 +784,9 @@ async def get_state_dict(
         # allow_copy=False: an in-place target whose numpy view would need a
         # copy must fail loudly, not silently fill the copy.
         converted = torch_interop.convert_tree(user_state_dict, allow_copy=False)
-        result = await _get_state_dict_direct(client, key, converted)
+        result = await _get_state_dict_direct(
+            client, key, converted, key_order=key_order, on_layer=on_layer
+        )
         if converted is not user_state_dict:
             result = torch_interop.restore_torch_results(
                 user_state_dict, converted, result

@@ -1,0 +1,556 @@
+"""Layer-streamed weight sync: publish and acquire as a pipeline.
+
+The barrier protocol (state_dict_utils) publishes a whole state dict, THEN
+readers acquire a whole state dict — RL iteration time is train + sync +
+generate with zero overlap. This module makes sync a pipeline instead:
+
+- :class:`StreamedPut` accepts tensors incrementally (per layer, or per
+  arena batch) as they become ready and pushes each batch immediately.
+  Every batch's metadata notify carries a **per-key version watermark**
+  (``Controller.notify_put_batch(watermark=...)``), so partial versions are
+  first-class: a store key is trusted at version v the moment its bytes are
+  committed AND watermarked, long before the dict is complete. ``seal()``
+  writes the classic MAPPING commit marker last (barrier readers are
+  untouched — they still wake only on a complete dict) plus the terminal
+  ``stream_seal`` record.
+
+- :func:`get_state_dict_streamed` acquires layer by layer: a long-poll on
+  the controller (``wait_for_stream`` — notify-woken, never a spin) hands
+  back each batch of freshly watermarked keys, which are fetched through
+  the normal data plane (warm layers ride the one-sided stamped-read path
+  with zero RPCs) and optionally handed to an ``on_layer`` callback in
+  model-forward order — generation starts before the last layer lands.
+
+Consistency: a reader NEVER mixes generations. Every served key must carry
+the exact target version watermark; a key watermarked newer (a faster
+publisher overwrote it mid-acquire), a superseded stream, or a final
+re-check mismatch restarts the acquire at the newest version — loudly
+(``ts_stream_fallbacks_total``), bounded by ``config.stream_retries`` —
+exactly the fallback-ladder discipline of the one-sided data plane.
+
+Watermark reads are concentrated HERE: acquire-side code elsewhere must go
+through :func:`watermark_of` / :func:`inconsistent_keys` (enforced by the
+tslint ``stream-discipline`` rule) so the consistency proof has one home.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability.tracing import span
+from torchstore_tpu.utils import maybe_await
+
+logger = get_logger("torchstore_tpu.stream_sync")
+
+_LAYER_BATCHES = obs_metrics.counter(
+    "ts_stream_layer_batches_total",
+    "Streamed layer batches published (watermarked put batches)",
+)
+_SEALS = obs_metrics.counter(
+    "ts_stream_seals_total", "Streamed publishes sealed"
+)
+_ACQUIRES = obs_metrics.counter(
+    "ts_stream_acquires_total", "Streamed acquires completed consistently"
+)
+_FALLBACKS = obs_metrics.counter(
+    "ts_stream_fallbacks_total",
+    "Streamed acquires that fell back or restarted, by reason",
+)
+# Per-subscriber stream lag: store keys watermarked at the target version
+# but not yet served by this process's in-flight streamed acquire. Moves
+# during every stream (publisher ahead of consumer) and settles at 0.
+_LAG = obs_metrics.gauge(
+    "ts_stream_lag_keys",
+    "Watermarked-but-unserved keys in this process's streamed acquire",
+)
+
+
+class MixedGenerationError(RuntimeError):
+    """A streamed acquire could not complete a single-generation serve."""
+
+
+class _Restart(Exception):
+    """Internal: restart the acquire at the newest stream version."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
+# --------------------------------------------------------------------------
+# blessed watermark accessors (tslint stream-discipline)
+# --------------------------------------------------------------------------
+
+
+def watermark_of(state: Optional[dict], store_key: str) -> Optional[int]:
+    """The version whose bytes a store key currently holds, per the stream
+    record — None when unknown (never watermarked, or record gone)."""
+    if state is None:
+        return None
+    return (state.get("watermarks") or {}).get(store_key)
+
+
+def inconsistent_keys(
+    state: Optional[dict], store_keys, version: int
+) -> list[str]:
+    """Store keys whose watermark does NOT equal ``version`` — the served
+    set is a consistent single-generation snapshot iff this is empty."""
+    return [sk for sk in store_keys if watermark_of(state, sk) != version]
+
+
+# --------------------------------------------------------------------------
+# publish side
+# --------------------------------------------------------------------------
+
+
+def _merge_mapping(a: dict, b: dict) -> dict:
+    """Merge two flatten-mapping templates from different fragments of one
+    streamed publish. Dict containers merge per child; any other container
+    kind must arrive whole in one fragment (its leaves would otherwise
+    collide as duplicate flat keys anyway)."""
+    if a["kind"] != b["kind"]:
+        raise ValueError(
+            "streamed fragments disagree on container structure "
+            f"({a['kind']!r} vs {b['kind']!r})"
+        )
+    if a["kind"] == "dict":
+        items = dict(a["items"])
+        for k, v in b["items"].items():
+            items[k] = _merge_mapping(items[k], v) if k in items else v
+        key_types = dict(a.get("key_types", {}))
+        key_types.update(b.get("key_types", {}))
+        return {"kind": "dict", "items": items, "key_types": key_types}
+    if a == b:
+        return a
+    raise ValueError(
+        "streamed fragments overlap inside a non-dict container; publish "
+        "list/tuple containers whole in one fragment"
+    )
+
+
+class StreamedPut:
+    """One streamed publish of a state dict under ``key``.
+
+    >>> stream = stream_state_dict(client, "policy/sd")
+    >>> for name, layer in trainer.layers():        # as they become ready
+    ...     await stream.put({"layers": {name: layer}})
+    >>> await stream.seal()
+
+    ``put`` accepts nested fragments; flat keys must be disjoint across
+    fragments (a layer is published exactly once per stream). ``seal``
+    writes the MAPPING commit marker LAST — barrier readers still only ever
+    see complete dicts — and the controller's terminal seal record. An
+    abandoned stream (publisher crash before ``seal``) leaves the previous
+    sealed version fully acquirable: readers only trust watermarked keys,
+    and barrier readers key on the absent/old marker.
+    """
+
+    def __init__(self, client, key: str, transfer_dtype=None) -> None:
+        self._client = client
+        self.key = key
+        self.version: Optional[int] = None
+        self._transfer_dtype = transfer_dtype
+        self._mapping: Optional[dict] = None
+        self._leaf_sigs: dict[str, tuple] = {}
+        self._sealed = False
+
+    async def begin(self) -> int:
+        """Open the stream on the controller (implicit on first ``put``).
+        Eager ``begin()`` lets consumers start their long-poll before the
+        first layer is even trained."""
+        if self.version is None:
+            self.version = await self._client.stream_begin(self.key)
+        return self.version
+
+    @property
+    def published_keys(self) -> list[str]:
+        return sorted(self._leaf_sigs)
+
+    async def put(self, fragment: Any) -> int:
+        """Publish one fragment (nested dict / flat dict of leaves) and
+        watermark every key at this stream's version. Returns the number
+        of flat keys pushed. Safe to call from the training loop the
+        moment a layer's tensors stop changing."""
+        from torchstore_tpu import state_dict_utils as sdu
+
+        await faults.afire("channel.publish_layer")
+        if self._sealed:
+            raise RuntimeError(f"stream for {self.key!r} is already sealed")
+        version = await self.begin()
+        flat, mapping = sdu.flatten_state_dict(fragment)
+        if not flat:
+            return 0
+        if sdu.MAPPING_KEY in flat:
+            raise ValueError(
+                f"{sdu.MAPPING_KEY!r} is a reserved top-level state-dict "
+                "key (it is the commit marker); rename that entry"
+            )
+        dup = sorted(set(flat) & set(self._leaf_sigs))
+        if dup:
+            raise ValueError(
+                f"flat keys republished within one stream: {dup[:5]} — a "
+                "layer is published exactly once per stream"
+            )
+        self._mapping = (
+            mapping
+            if self._mapping is None
+            else _merge_mapping(self._mapping, mapping)
+        )
+        for k, v in flat.items():
+            self._leaf_sigs[k] = sdu._leaf_signature(v)
+        if self._transfer_dtype is not None:
+            flat = sdu.cast_floating_tensors(flat, self._transfer_dtype)
+        with span(
+            "stream.publish_layer",
+            key=self.key,
+            version=version,
+            keys=len(flat),
+        ):
+            await self._client.put_batch(
+                {sdu._store_key(self.key, k): v for k, v in flat.items()},
+                watermark=(self.key, version),
+            )
+        _LAYER_BATCHES.inc()
+        return len(flat)
+
+    async def seal(self) -> int:
+        """Write the terminal records: the MAPPING commit marker (barrier
+        readers wake on a complete dict, exactly as before) then the
+        controller's seal. Returns the stream version. Idempotent."""
+        from torchstore_tpu import state_dict_utils as sdu
+
+        if self._sealed:
+            return self.version
+        if self._mapping is None:
+            raise RuntimeError("seal() before any put(): nothing to commit")
+        # Plan-cache discipline mirrors put_state_dict: a restructure this
+        # client cannot PROVE unchanged (dropped keys delete nothing, so
+        # the index alone cannot see it) bumps the placement epoch so
+        # consumers' cached get plans never serve the old structure.
+        cache = getattr(self._client, "plan_cache", None)
+        signature = tuple(sorted(self._leaf_sigs.items())) + (
+            ("cast", str(self._transfer_dtype), None),
+        )
+        if cache is not None:
+            if cache.last_put_sig.get(self.key) != signature:
+                await self._client.bump_placement_epoch()
+            cache.last_put_sig[self.key] = signature
+        else:
+            await self._client.bump_placement_epoch()
+        marker = {
+            "mapping": self._mapping,
+            "stream": {"version": self.version},
+        }
+        with span(
+            "stream.seal",
+            key=self.key,
+            version=self.version,
+            keys=len(self._leaf_sigs),
+        ):
+            await self._client.put(
+                sdu._store_key(self.key, sdu.MAPPING_KEY), marker
+            )
+            await self._client.stream_seal(self.key, self.version)
+        self._sealed = True
+        _SEALS.inc()
+        return self.version
+
+
+def stream_state_dict(client, key: str, transfer_dtype=None) -> StreamedPut:
+    """Open an incremental (layer-streamed) publish of ``key``."""
+    return StreamedPut(client, key, transfer_dtype=transfer_dtype)
+
+
+# --------------------------------------------------------------------------
+# acquire side
+# --------------------------------------------------------------------------
+
+
+async def get_state_dict_streamed(
+    client,
+    key: str,
+    user_state_dict: Any = None,
+    key_order: Optional[list[str]] = None,
+    on_layer: Optional[Callable[[str, Any], Any]] = None,
+    strict: bool = True,
+    timeout: Optional[float] = None,
+    wait_for_stream_s: Optional[float] = None,
+) -> Any:
+    """Acquire a streamed state dict layer by layer.
+
+    Each store key is fetched the moment its watermark lands (long-poll on
+    the controller — notify-woken, no spin; warm layers are served by the
+    one-sided stamped-read path with zero RPCs). ``key_order`` (typically
+    model-forward order, e.g. ``StateDictManifest.key_order`` or
+    ``models.generate.forward_key_order``) makes delivery IN-ORDER: layer
+    k+1 is held until layer k has been served, so an ``on_layer`` callback
+    can start forward computation before the last layer lands. Without
+    ``key_order``, layers are served in arrival order.
+
+    ``on_layer(flat_key, value)`` (sync or async) runs once per leaf as it
+    is served. ``wait_for_stream_s`` long-polls for the stream to BEGIN
+    when no record exists yet (a consumer starting before the publisher's
+    first layer); with no record and no wait budget, this falls back to
+    the barrier ``get_state_dict`` path.
+
+    ``key_order`` should list only keys this publish will actually write:
+    an entry the publisher never pushes blocks in-order delivery of its
+    successors until the seal (only the seal proves it absent), costing
+    the publish/decode overlap — though delivery still completes, in
+    key_order positions, and the dict is still validated complete.
+
+    Never mixes generations: every served key must carry the target
+    version's watermark, re-verified once after the final layer; any drift
+    restarts at the newest version (``config.stream_retries`` budget) and
+    then fails loudly with :class:`MixedGenerationError`.
+    """
+    from torchstore_tpu.config import default_config
+    from torchstore_tpu.state_dict_utils import get_state_dict
+
+    config = getattr(client, "_config", None) or default_config()
+    retries = max(0, int(config.stream_retries))
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for attempt in range(retries + 1):
+        state = await client.stream_state(key)
+        if state is None and wait_for_stream_s:
+            try:
+                res = await client.wait_for_stream(
+                    key, 1, -1, timeout=wait_for_stream_s
+                )
+            except TimeoutError:
+                res = {"missing": True}
+            if not res.get("missing"):
+                state = await client.stream_state(key)
+        if state is None:
+            # Never streamed (or the record was evicted / lost to a
+            # controller restart): the barrier path owns the serve — and
+            # the loud NoMatchingPush when nothing was pushed at all.
+            _FALLBACKS.inc(reason="no_stream")
+            return await get_state_dict(
+                client, key, user_state_dict, strict=strict
+            )
+        target = int(state["version"])
+        try:
+            return await _acquire_stream(
+                client,
+                key,
+                target,
+                user_state_dict,
+                key_order,
+                on_layer,
+                strict,
+                deadline,
+                config,
+            )
+        except _Restart as exc:
+            _FALLBACKS.inc(reason=exc.reason)
+            _LAG.set(0)
+            logger.warning(
+                "streamed acquire of %r v%d restarting (%s; attempt %d/%d)",
+                key,
+                target,
+                exc.reason,
+                attempt + 1,
+                retries + 1,
+            )
+            if exc.reason in ("incomplete_seal", "marker_drift"):
+                # Retrying cannot help here: "incomplete_seal" means the
+                # publisher sealed without rewriting every mapping key this
+                # stream (e.g. skipped unchanged layers) — a single-
+                # generation streamed serve is impossible BY CONSTRUCTION;
+                # "marker_drift" means the commit marker belongs to a
+                # different publish than the stream record (typically a
+                # BARRIER put over a previously streamed key, whose
+                # notifies never touch the record) and would drift
+                # identically on every attempt. The barrier path serves
+                # the dict as of the commit marker, classic semantics.
+                return await get_state_dict(
+                    client, key, user_state_dict, strict=strict
+                )
+            continue
+    raise MixedGenerationError(
+        f"streamed acquire of {key!r} could not complete a consistent "
+        f"single-generation serve in {retries + 1} attempts (publishers "
+        "are overwriting keys faster than this consumer acquires them)"
+    )
+
+
+async def _acquire_stream(
+    client,
+    key: str,
+    target: int,
+    user_state_dict: Any,
+    key_order: Optional[list[str]],
+    on_layer,
+    strict: bool,
+    deadline: Optional[float],
+    config,
+) -> Any:
+    from torchstore_tpu import state_dict_utils as sdu
+
+    user_flat = user_mapping = None
+    if user_state_dict is not None:
+        user_flat, user_mapping = sdu.flatten_state_dict(user_state_dict)
+    # store key -> (flat key, fetch target): with a user dict only its keys
+    # are fetched (subset pulls under strict=False, in-place landings).
+    targets_of: dict[str, Any] = {}
+    flat_of: dict[str, str] = {}
+    if user_flat is not None:
+        for fk, v in user_flat.items():
+            sk = sdu._store_key(key, fk)
+            flat_of[sk] = fk
+            targets_of[sk] = v if sdu._is_fetch_target(v) else None
+    prefix_len = len(key) + len(sdu._SEP)
+    ordered_sks = (
+        [sdu._store_key(key, fk) for fk in key_order] if key_order else None
+    )
+    served: dict[str, Any] = {}  # flat key -> value
+    served_sks: list[str] = []
+    served_set: set[str] = set()
+    known = 0
+    sealed = False
+    poll = max(0.1, float(config.stream_poll_s))
+
+    async def serve(sks: list[str]) -> None:
+        if user_flat is not None:
+            sks = [sk for sk in sks if sk in flat_of]
+        if not sks:
+            return
+        fetched = await client.get_batch(
+            {sk: targets_of.get(sk) for sk in sks}, _seed_plan=False
+        )
+        for sk in sks:
+            fk = flat_of.get(sk, sk[prefix_len:])
+            served[fk] = fetched[sk]
+            served_sks.append(sk)
+            served_set.add(sk)
+            if on_layer is not None:
+                await maybe_await(on_layer(fk, fetched[sk]))
+
+    with span("stream.acquire", key=key, version=target):
+        while not sealed:
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(
+                    f"streamed acquire of {key!r} v{target} timed out with "
+                    f"{len(served_sks)} layer(s) served"
+                )
+            chunk = poll if remaining is None else min(poll, remaining)
+            try:
+                res = await client.wait_for_stream(
+                    key, target, known, timeout=chunk
+                )
+            except TimeoutError:
+                continue  # re-poll (refreshes lag + deadline accounting)
+            if res.get("missing"):
+                # Record evicted/reset mid-acquire: restart; the outer loop
+                # re-reads the state and falls back to the barrier path.
+                raise _Restart("stream_gone")
+            if res["superseded"]:
+                raise _Restart("superseded")
+            ready = res["ready"]
+            known = len(ready)
+            drift = inconsistent_keys(res, ready, target)
+            if drift:
+                # A key already watermarked NEWER than our target: serving
+                # it would mix generations — restart at the new version.
+                raise _Restart("mixed_generation")
+            sealed = bool(res["sealed"])
+            fresh = [sk for sk in ready if sk not in served_set]
+            if ordered_sks is not None:
+                # In-order delivery: serve the contiguous ready prefix of
+                # the caller's key order; out-of-order arrivals wait their
+                # turn (any remainder — keys outside the order — is served
+                # at seal below).
+                ready_set = set(ready)
+                wave: list[str] = []
+                for sk in ordered_sks:
+                    if sk in served_set:
+                        continue
+                    if sk not in ready_set:
+                        break
+                    wave.append(sk)
+                if sealed:
+                    # Remainder at seal — keys outside the caller's order,
+                    # plus everything held back behind a key_order entry
+                    # the publisher never pushed (a phantom key blocks the
+                    # contiguous-prefix scan; only the seal proves it is
+                    # absent from the mapping) — still served in key_order
+                    # position so on_layer ordering survives.
+                    pos = {sk: i for i, sk in enumerate(ordered_sks)}
+                    in_wave = set(wave)
+                    rest = sorted(
+                        (sk for sk in fresh if sk not in in_wave),
+                        key=lambda sk: (pos.get(sk, len(pos)), sk),
+                    )
+                    wave += rest
+                await serve(wave)
+            else:
+                await serve(fresh)
+            _LAG.set(known - len(served_sks))
+
+        # ---- finalize: seal record + structure + consistency re-check ----
+        try:
+            marker = await client.get(sdu._store_key(key, sdu.MAPPING_KEY))
+        except KeyError as exc:
+            raise _Restart("marker_gone") from exc
+        if (marker.get("stream") or {}).get("version") != target:
+            # The marker belongs to a different publish (a barrier push or
+            # a newer stream raced the seal): our served set cannot be
+            # trusted against it.
+            raise _Restart("marker_drift")
+        mapping = marker["mapping"]
+        leaf_keys = sdu._leaf_keys(mapping)
+        if user_flat is not None:
+            extra = set(user_flat) - leaf_keys
+            if extra:
+                raise ValueError(
+                    f"user dict keys not present in push {key!r}: "
+                    f"{sorted(extra)[:5]}"
+                )
+            missing = leaf_keys - set(user_flat)
+            if strict and missing:
+                raise ValueError(
+                    f"state dict structure mismatch for {key!r}: missing "
+                    f"in user dict: {sorted(missing)[:5]} (pass "
+                    "strict=False to pull a subset)"
+                )
+            unserved = [fk for fk in user_flat if fk not in served]
+        else:
+            unserved = [fk for fk in sorted(leaf_keys) if fk not in served]
+        if unserved:
+            # Sealed but some mapping keys never reached our target
+            # watermark (a publisher that skipped unchanged layers): a
+            # single-generation serve is impossible — restart; the barrier
+            # fallback path serves mixed-watermark dicts the classic way.
+            raise _Restart("incomplete_seal")
+        state2 = await client.stream_state(key)
+        if state2 is None:
+            raise _Restart("stream_gone")
+        if int(state2["version"]) != target:
+            # A newer stream has BEGUN: its begin strictly precedes any of
+            # its byte landings (publisher program order), so bytes we may
+            # have read from it exist only if this check fires — the
+            # watermark alone can lag those landings by an in-flight
+            # notify, which is exactly the window this closes.
+            raise _Restart("superseded")
+        bad = inconsistent_keys(state2, served_sks, target)
+        if bad:
+            raise _Restart("mixed_generation")
+        flat = (
+            {fk: served[fk] for fk in user_flat}
+            if user_flat is not None
+            else {fk: served[fk] for fk in sorted(leaf_keys)}
+        )
+        result = sdu.unflatten_state_dict(
+            flat, user_mapping if user_flat is not None else mapping
+        )
+    _LAG.set(0)
+    _ACQUIRES.inc()
+    return result

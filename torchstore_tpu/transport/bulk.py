@@ -39,7 +39,7 @@ from typing import Any, Optional
 import numpy as np
 
 from torchstore_tpu import faults
-from torchstore_tpu.config import StoreConfig, default_config
+from torchstore_tpu.config import StoreConfig, _env_int, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
 from torchstore_tpu.observability import metrics as obs_metrics
@@ -97,8 +97,12 @@ _DOORBELL_MISS = {
 DOORBELL_PLANS_MAX = 512
 
 _STRIPE = struct.Struct("<IQQ")  # real_idx, offset, total_nbytes
-# Payloads above this are striped across STRIPE_CONNS connections.
-STRIPE_THRESHOLD = 64 * 1024 * 1024
+# Payloads above this are striped across STRIPE_CONNS connections (puts,
+# get replies, and IDX_PACKED doorbell replies). Env-tunable so tests and
+# operators can exercise striping at realistic-for-them sizes.
+STRIPE_THRESHOLD = _env_int(
+    "TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD", 64 * 1024 * 1024
+)
 STRIPE_CONNS = 4
 
 _DIALS = obs_metrics.counter(
@@ -216,6 +220,18 @@ def _close_sock(sock: Optional[socket.socket]) -> None:
 
 def _family_for(host: str) -> int:
     return socket.AF_INET6 if ":" in host else socket.AF_INET
+
+
+def _stripe_ranges(total: int, n: int, k: int) -> list[tuple[int, int]]:
+    """Byte ranges connection ``k`` of ``n`` carries when striping a
+    ``total``-byte payload: contiguous chunks round-robined so every
+    connection streams in parallel (shared by the put, get-reply, and
+    doorbell-reply striping paths)."""
+    chunk = -(-total // n)
+    return [
+        (off, min(off + chunk, total))
+        for off in range(k * chunk, total, chunk * n)
+    ]
 
 
 # --------------------------------------------------------------------------
@@ -545,13 +561,8 @@ class BulkServer:
             nbytes = arr.nbytes
             if len(conns) > 1 and nbytes > STRIPE_THRESHOLD:
                 view = memoryview(np.ascontiguousarray(arr)).cast("B")
-                n = len(conns)
-                chunk = -(-nbytes // n)
                 for k, (sock, lock) in enumerate(conns):
-                    ranges = [
-                        (off, min(off + chunk, nbytes))
-                        for off in range(k * chunk, nbytes, chunk * n)
-                    ]
+                    ranges = _stripe_ranges(nbytes, len(conns), k)
                     if ranges:
                         _track(
                             sock,
@@ -587,10 +598,13 @@ class BulkServer:
         ONE IDX_PACKED frame back — bracketed by the volume's landing stamp
         so a reply that raced ANY landing is declared torn (miss frame) and
         the client falls back to the RPC path, which serves a consistent
-        snapshot. Replies ride the session's registered connection."""
+        snapshot. Replies ride the session's registered connection(s): a
+        packed reply above the striping threshold whose session the client
+        carried over several connections is STRIPED across them (the same
+        parallel-TCP path multi-GB get replies already ride)."""
         from torchstore_tpu.transport import landing
 
-        self.session_conns.pop(session, None)
+        conns = self.session_conns.pop(session, None) or [(sock, lock)]
 
         async def miss(code: int) -> None:
             try:
@@ -640,9 +654,51 @@ class BulkServer:
             # both ends plus an unchanged stamp proves no overlap even
             # when landings themselves overlapped each other.
             return await miss(3)
+        view = memoryview(packed).cast("B")
+        if len(conns) > 1 and view.nbytes > STRIPE_THRESHOLD:
+            # Multi-GB packed reply: stripe contiguous chunks round-robin
+            # over every connection the client opened for this session
+            # (the ROADMAP item-4 "remaining depth" — doorbells no longer
+            # fall off the parallel-TCP path above the threshold).
+            _STRIPED.inc(direction="doorbell")
+            total = view.nbytes
+
+            async def send_on(k: int, s_sock, s_lock) -> None:
+                for off, end in _stripe_ranges(total, len(conns), k):
+                    async with s_lock:
+                        await _send_frame_raw(
+                            s_sock,
+                            session,
+                            IDX_STRIPED,
+                            _STRIPE.pack(IDX_PACKED, off, total),
+                            view[off:end],
+                        )
+
+            try:
+                # Same stall guard as the get-reply stripes: a client that
+                # stops READING while keeping TCP open would otherwise
+                # block sendall forever, wedging this serve task and
+                # pinning the packed buffer for the volume's lifetime.
+                await asyncio.wait_for(
+                    asyncio.gather(
+                        *(send_on(k, s, l) for k, (s, l) in enumerate(conns))
+                    ),
+                    timeout=SESSION_TTL_S,
+                )
+            except (TimeoutError, asyncio.TimeoutError):
+                logger.warning(
+                    "bulk doorbell striped send timed out (session=%s); "
+                    "closing connections",
+                    session,
+                )
+                for s_sock, _ in conns:
+                    _shutdown_sock(s_sock)
+            except (ConnectionError, OSError):
+                pass  # client gone: its timeout owns the fallback
+            return
         try:
             await _send_frame(
-                sock, lock, session, IDX_PACKED, memoryview(packed)
+                sock, lock, session, IDX_PACKED, view
             )
         except (ConnectionError, OSError):
             pass
@@ -1039,7 +1095,7 @@ class BulkTransportBuffer(TransportBuffer):
             entry = cache.doorbells.get(dkey) if dkey is not None else None
             if entry is not None:
                 try:
-                    return await self._get_via_doorbell(requests, entry)
+                    return await self._get_via_doorbell(volume, requests, entry)
                 except OneSidedMiss as miss:
                     # Loud fallback: drop the plan (the RPC serve below
                     # re-registers a fresh one) and take the RPC path.
@@ -1135,13 +1191,16 @@ class BulkTransportBuffer(TransportBuffer):
         return await super().get_from_storage_volume(volume, requests)
 
     async def _get_via_doorbell(
-        self, requests: list[Request], entry: dict
+        self, volume, requests: list[Request], entry: dict
     ) -> list[Any]:
         """One-sided warm get over the bulk socket: ring the cached plan id
         (one tiny frame instead of the get RPC + per-key request frames),
         land the single IDX_PACKED reply straight into a pre-registered
-        read buffer, and unpack members at the shared arena layout. Any
-        miss frame, timeout, or connection loss raises
+        read buffer, and unpack members at the shared arena layout. A plan
+        whose packed reply exceeds the striping threshold carries the
+        session over the pre-opened stripe set first (acks awaited), so
+        the volume stripes the reply across parallel TCP streams. Any miss
+        frame, timeout, or connection loss raises
         :class:`shared_memory.OneSidedMiss` — the caller falls back loudly
         to the RPC path."""
         from torchstore_tpu.transport import landing
@@ -1153,23 +1212,47 @@ class BulkTransportBuffer(TransportBuffer):
 
         conn = self._conn
         sess = conn.register_session(self.session)
+        carriers = [conn]
         packed = bytearray(max(int(entry["total"]), 1))
         try:
             # Pre-registered read buffer: the demux loop recv()s the packed
-            # reply kernel->buffer, no staging copy.
+            # reply kernel->buffer, no staging copy (striped chunks land at
+            # their offsets in the same buffer).
             if entry["total"]:
                 sess.dests[IDX_PACKED] = memoryview(packed)
             try:
-                # SESSION_OPEN then DOORBELL on the same connection: the
-                # server processes them in order, so routing is in place
-                # before the serve starts — no ack round trip needed.
-                await _send_frame(
-                    conn.sock,
-                    conn.write_lock,
-                    self.session,
-                    IDX_SESSION_OPEN,
-                    None,
-                )
+                if int(entry["total"]) > STRIPE_THRESHOLD:
+                    cache: BulkClientCache = (
+                        volume.transport_context.get_cache(BulkClientCache)
+                    )
+                    for extra in await cache.get_stripe_conns(
+                        volume.volume_id,
+                        STRIPE_CONNS - 1,
+                        self.config.handshake_timeout,
+                    ):
+                        extra.adopt_session(self.session, sess)
+                        carriers.append(extra)
+                for carrier in carriers:
+                    await _send_frame(
+                        carrier.sock,
+                        carrier.write_lock,
+                        self.session,
+                        IDX_SESSION_OPEN,
+                        None,
+                    )
+                if len(carriers) > 1:
+                    # Stripe carriers ride independent TCP streams: their
+                    # routing must be acked BEFORE the doorbell rings, or
+                    # the volume could reply before session_conns lists
+                    # them (single-connection sessions keep the zero-RTT
+                    # same-connection ordering instead).
+                    for _ in range(len(carriers)):
+                        ack_idx, _ = await asyncio.wait_for(
+                            sess.queue.get(),
+                            timeout=self.config.handshake_timeout,
+                        )
+                        if ack_idx != IDX_SESSION_OPEN:
+                            raise OneSidedMiss("protocol")
                 await _send_frame(
                     conn.sock,
                     conn.write_lock,
@@ -1193,7 +1276,8 @@ class BulkTransportBuffer(TransportBuffer):
             except (ConnectionError, OSError):
                 raise OneSidedMiss("conn") from None
         finally:
-            conn.release_session(self.session)
+            for carrier in carriers:
+                carrier.release_session(self.session)
         if idx is None:
             raise OneSidedMiss("conn")
         if idx == IDX_DOORBELL:
@@ -1332,12 +1416,9 @@ class BulkTransportBuffer(TransportBuffer):
         volume reassembles order-independently."""
         _STRIPED.inc(direction="put")
         total = view.nbytes
-        n = len(conns)
-        chunk = -(-total // n)
 
         async def send_on(k: int, conn: BulkClientConn) -> None:
-            for off in range(k * chunk, total, chunk * n):
-                end = min(off + chunk, total)
+            for off, end in _stripe_ranges(total, len(conns), k):
                 async with conn.write_lock:
                     await _send_frame_raw(
                         conn.sock,

@@ -55,6 +55,9 @@ _SKIPPED = obs_metrics.counter(
 )
 
 _LATEST = "LATEST"
+# In-flight streamed-publish announce: written when a ChannelStream's first
+# layer opens, BEFORE any seal — the streaming subscriber's wakeup pointer.
+_STREAM_PTR = "STREAM"
 
 
 def _version_key(name: str, version: int) -> str:
@@ -136,6 +139,69 @@ class WeightPublisher:
                 client, manifest, direct=direct
             )
 
+    async def _resolve_next_version(self, client) -> int:
+        """Resume after the channel's existing LATEST (a restarted publisher
+        must not clobber live versions) — and reclaim any PARTIAL version a
+        crashed predecessor left beyond the pointer: an abandoned stream's
+        layer keys (never sealed, so never pointed at) would otherwise leak
+        until their version number is reused and GC'd."""
+        if self._next_version is None:
+            try:
+                current, epoch = _parse_pointer(
+                    await client.get(f"{self.name}/{_LATEST}")
+                )
+                self._next_version = current + 1
+                self._epoch = epoch
+            except KeyError:
+                import secrets
+
+                self._next_version = 0
+                self._epoch = secrets.randbits(62) or 1
+                current = -1
+            await self._reclaim_partials(client, current)
+        return self._next_version
+
+    async def _commit(self, client, version: int) -> None:
+        """The ONE commit tail for a published version, shared by the
+        barrier ``publish`` and ``ChannelStream.seal``: advance the LATEST
+        pointer (subscribers woken by it always find a committed dict —
+        callers must have finished the data/seal writes first), step the
+        version counter, and publish the channel metrics."""
+        await client.put(f"{self.name}/{_LATEST}", (version, self._epoch))
+        self._next_version = version + 1
+        _PUBLISHES.inc(channel=self.name)
+        _PUBLISHED_VERSION.set(version, channel=self.name)
+
+    async def _reclaim_partials(self, client, current: int) -> None:
+        """Delete every version directory BEYOND the committed pointer
+        (keys a crashed publisher streamed but never sealed). Runs once per
+        publisher lifetime, on resume."""
+        stale: set[int] = set()
+        for key in await client.keys(self.name):
+            seg = key[len(self.name) + 1 :].split("/", 1)[0]
+            if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) > current:
+                stale.add(int(seg[1:]))
+        for v in sorted(stale):
+            removed = await client.delete_prefix(_version_key(self.name, v))
+            if removed:
+                logger.warning(
+                    "channel %s: reclaimed partial v%d (%d keys) left by a "
+                    "crashed publisher",
+                    self.name,
+                    v,
+                    removed,
+                )
+
+    def stream(self, transfer_dtype=None) -> "ChannelStream":
+        """Open a LAYER-STREAMED publish of the next version: push
+        fragments with ``await cs.put(...)`` as the trainer produces them,
+        then ``await cs.seal()`` to advance LATEST/GC exactly like
+        ``publish``. Streaming subscribers (``acquire_streamed``) wake on
+        the in-flight announce and start pulling layers before the seal;
+        barrier subscribers (``acquire``) still wake only on the sealed
+        pointer. See torchstore_tpu/stream_sync.py."""
+        return ChannelStream(self, transfer_dtype=transfer_dtype)
+
     async def publish(
         self,
         state_dict: Any,
@@ -157,19 +223,7 @@ class WeightPublisher:
         from torchstore_tpu import state_dict_utils
 
         client = self._resolve_client()
-        if self._next_version is None:
-            try:
-                current, epoch = _parse_pointer(
-                    await client.get(f"{self.name}/{_LATEST}")
-                )
-                self._next_version = current + 1
-                self._epoch = epoch
-            except KeyError:
-                import secrets
-
-                self._next_version = 0
-                self._epoch = secrets.randbits(62) or 1
-        version = self._next_version
+        version = await self._resolve_next_version(client)
         data_key = (
             f"{self.name}/direct" if direct else _version_key(self.name, version)
         )
@@ -188,10 +242,7 @@ class WeightPublisher:
                 direct=direct,
             )
             # Pointer write LAST: subscribers woken by it see a committed dict.
-            await client.put(f"{self.name}/{_LATEST}", (version, self._epoch))
-        self._next_version = version + 1
-        _PUBLISHES.inc(channel=self.name)
-        _PUBLISHED_VERSION.set(version, channel=self.name)
+            await self._commit(client, version)
         if not direct:
             await self._gc(client, version)
         return version
@@ -223,6 +274,66 @@ class WeightPublisher:
             await client.delete_prefix(self.name)
 
 
+class ChannelStream:
+    """One layer-streamed publish of a channel version (see
+    :meth:`WeightPublisher.stream`). The first ``put`` resolves the next
+    version number, opens the stream, and announces it on the channel's
+    ``STREAM`` pointer so streaming subscribers wake immediately;
+    ``seal()`` commits the marker, advances ``LATEST`` (barrier
+    subscribers wake here), and GCs old versions. An abandoned stream
+    (publisher crash before seal) never advances a pointer — the previous
+    version stays fully acquirable, and the next publisher's resume
+    reclaims the partial keys."""
+
+    def __init__(self, publisher: WeightPublisher, transfer_dtype=None) -> None:
+        self._pub = publisher
+        self._transfer_dtype = transfer_dtype
+        self._stream = None
+        self.version: Optional[int] = None
+
+    async def put(self, fragment: Any) -> int:
+        from torchstore_tpu import stream_sync
+
+        if self._stream is None:
+            pub = self._pub
+            client = pub._resolve_client()
+            self.version = await pub._resolve_next_version(client)
+            self._stream = stream_sync.stream_state_dict(
+                client,
+                _version_key(pub.name, self.version),
+                transfer_dtype=self._transfer_dtype,
+            )
+            await self._stream.begin()
+            # Announce the IN-FLIGHT version before any layer lands:
+            # streaming subscribers wake on this pointer and long-poll the
+            # stream's watermarks — decode starts before the seal. A
+            # regular put, so a crashed publisher leaves at worst a stale
+            # announce that the next subscriber wakeup skips.
+            await client.put(
+                f"{pub.name}/{_STREAM_PTR}", (self.version, pub._epoch)
+            )
+        return await self._stream.put(fragment)
+
+    async def seal(self) -> int:
+        if self._stream is None:
+            raise RuntimeError("seal() before any put(): nothing published")
+        pub = self._pub
+        client = pub._resolve_client()
+        version = self.version
+        with span(
+            "weight_channel.publish",
+            channel=pub.name,
+            version=version,
+            streamed=True,
+        ):
+            await self._stream.seal()
+            # Pointer write LAST: barrier subscribers woken by it always
+            # see a committed (sealed) dict, exactly like publish().
+            await pub._commit(client, version)
+        await pub._gc(client, version)
+        return version
+
+
 class WeightSubscriber:
     """Consumer side: blocks for fresh versions instead of polling."""
 
@@ -233,6 +344,7 @@ class WeightSubscriber:
         self._store_name = store_name
         self._client = client
         self._last_gen = 0
+        self._last_stream_gen = 0
         self.last_version: Optional[int] = None
         self._last_epoch: Optional[int] = None
 
@@ -330,6 +442,90 @@ class WeightSubscriber:
                     data_key or pointer,
                 )
                 continue
+            self.last_version = version
+            self._last_epoch = epoch
+            _ACQUIRED_VERSION.set(version, channel=self.name)
+            return sd, version
+
+    async def acquire_streamed(
+        self,
+        user_state_dict: Any = None,
+        key_order: Optional[list] = None,
+        on_layer: Any = None,
+        timeout: Optional[float] = None,
+        strict: bool = True,
+    ) -> tuple[Any, int]:
+        """Like :meth:`acquire`, but against layer-streamed publishes
+        (:meth:`WeightPublisher.stream`): wakes on the channel's IN-FLIGHT
+        announce (written before any layer lands) and pulls layer by layer
+        as watermarks land — with ``key_order`` (model-forward order, e.g.
+        ``models.generate.forward_key_order`` or
+        ``StateDictManifest.key_order``) and an ``on_layer`` callback,
+        generation starts before the publisher seals. The returned dict is
+        always a single version's weights (stream_sync's watermark
+        consistency ladder), and versions are delivered at most once.
+        Requires streamed publishes; raises TimeoutError when nothing is
+        announced within ``timeout``."""
+        import time
+
+        from torchstore_tpu import stream_sync
+
+        client = self._resolve_client()
+        pointer = f"{self.name}/{_STREAM_PTR}"
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            change = await client.wait_for_change(
+                pointer, self._last_stream_gen, timeout=remaining
+            )
+            self._last_stream_gen = change["gen"]
+            if change["state"] != "committed":
+                continue  # deleted channel mid-rewrite; wait for the next
+            try:
+                version, epoch = _parse_pointer(await client.get(pointer))
+            except KeyError:
+                continue
+            if version == self.last_version and epoch == self._last_epoch:
+                continue  # duplicate wakeup: delivered at most once
+            data_key = _version_key(self.name, version)
+            if self.last_version is not None and epoch == self._last_epoch:
+                skipped = version - self.last_version - 1
+                _VERSION_LAG.set(max(0, skipped), channel=self.name)
+                if skipped > 0:
+                    _SKIPPED.inc(skipped, channel=self.name)
+            with span(
+                "weight_channel.acquire",
+                channel=self.name,
+                version=version,
+                streamed=True,
+            ):
+                try:
+                    sd = await stream_sync.get_state_dict_streamed(
+                        client,
+                        data_key,
+                        user_state_dict=user_state_dict,
+                        key_order=key_order,
+                        on_layer=on_layer,
+                        strict=strict,
+                        timeout=(
+                            None
+                            if deadline is None
+                            else max(0.0, deadline - time.monotonic())
+                        ),
+                    )
+                except (NoMatchingPush, KeyError):
+                    # The announced version vanished before the pull (GC'd
+                    # under a lagging subscriber, or a crashed publisher's
+                    # partial was reclaimed); wait for the next announce.
+                    logger.info(
+                        "channel %s: streamed %s vanished before pull; "
+                        "waiting for next version",
+                        self.name,
+                        data_key,
+                    )
+                    continue
             self.last_version = version
             self._last_epoch = epoch
             _ACQUIRED_VERSION.set(version, channel=self.name)
