@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""bench_compare — machine-read the BENCH_r* trajectory and fail on regressions.
+
+The repo accumulates one BENCH_*.json per round (r01..r05 so far) and until
+now nothing machine-read them: a regression was only caught if a human
+compared JSON blobs by eye. This tool diffs two or more headline records —
+the LAST file given is the candidate, the earlier ones the baseline — with
+per-metric, direction-aware regression thresholds, and exits non-zero when
+the candidate regresses.
+
+Accepted file shapes (both live in this repo):
+
+- the raw ``bench.py`` stdout record (``{"metric", "value", "unit",
+  "sections", ...}``);
+- the driver wrapper (``{"n", "cmd", "rc", "tail", "parsed"}``) whose
+  ``parsed`` carries the flat headline and whose ``tail`` may embed the
+  full JSON line (we recover it when present; a crashed round with
+  ``parsed: null`` contributes nothing and is reported as such).
+
+Usage:
+    python scripts/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_compare.py BENCH_r0*.json --baseline median
+    python scripts/bench_compare.py old.json new.json --json --scale 1.5
+
+``--baseline prev`` (default) compares against the newest baseline file
+that carries each metric; ``best``/``median`` aggregate across all
+baseline files (bench hosts are shared and noisy — median is the fairest
+cross-round bar). ``--scale`` multiplies every threshold (loosen on known-
+noisy hosts). Exit codes: 0 ok, 1 regression(s), 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Optional
+
+# metric -> (direction, allowed regression, unit). Direction "higher"
+# means bigger is better (a drop beyond the budget regresses); "lower"
+# means smaller is better. Unit "rel" budgets a FRACTION of the baseline;
+# "abs" budgets in the metric's own units — required for metrics that sit
+# near (or legitimately below) zero, where a fractional comparison
+# inverts: ledger_overhead_pct's baseline can be slightly negative under
+# host noise, and (cand - base) / base with base < 0 would wave a real
+# regression through while flagging an improvement. Thresholds are
+# deliberately generous: the bench box is shared and host weather moves
+# everything 2x between rounds — this gate catches collapses, not jitter.
+THRESHOLDS: dict[str, tuple[str, float, str]] = {
+    "value": ("higher", 0.30, "rel"),
+    "vs_baseline": ("higher", 0.30, "rel"),
+    "many_keys_gbps": ("higher", 0.40, "rel"),
+    "per_key_put_us": ("lower", 0.60, "rel"),
+    "per_key_get_us": ("lower", 0.60, "rel"),
+    "many_keys_get_gbps": ("higher", 0.40, "rel"),
+    "get_memcpy_ratio": ("lower", 0.60, "rel"),
+    "p50_put_ms": ("lower", 0.75, "rel"),
+    "p50_get_ms": ("lower", 0.75, "rel"),
+    "p50_get_1kb_ms": ("lower", 0.75, "rel"),
+    "cold_vs_steady": ("higher", 0.50, "rel"),
+    "cold_prewarmed_vs_steady": ("higher", 0.50, "rel"),
+    "overlap_ratio": ("higher", 0.25, "rel"),
+    # Absolute budgets: ms around zero (decode can beat the seal, so the
+    # value is signed) and percentage points for the telemetry overhead.
+    "first_token_after_publish_ms": ("lower", 200.0, "abs"),
+    "heal_s": ("lower", 1.0, "rel"),
+    "failover_get_s": ("lower", 1.0, "rel"),
+    "ledger_overhead_pct": ("lower", 2.0, "abs"),
+}
+
+
+def extract_metrics(doc: dict) -> dict[str, float]:
+    """Flatten one record (raw bench output or driver wrapper) into
+    {metric: float}. Non-numeric / missing values are skipped."""
+    flat: dict[str, object] = {}
+    if "parsed" in doc or "tail" in doc:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            flat.update(parsed)
+        # The wrapper's tail often carries the full headline JSON line —
+        # recover it so wrapper files compare as richly as raw ones.
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if line.startswith("{") and '"metric"' in line:
+                    try:
+                        flat.update(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    else:
+        flat.update(doc)
+    if isinstance(flat.get("ledger_overhead"), dict):
+        pct = flat["ledger_overhead"].get("overhead_pct")
+        if pct is not None:
+            flat["ledger_overhead_pct"] = pct
+    out: dict[str, float] = {}
+    for name in THRESHOLDS:
+        value = flat.get(name)
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[name] = float(value)
+    return out
+
+
+def _regression(
+    base: float, cand: float, direction: str, unit: str
+) -> Optional[float]:
+    """How far ``cand`` regressed past ``base`` (same units as the
+    threshold: a baseline fraction for "rel", metric units for "abs");
+    negative = improved. None when a relative comparison is meaningless
+    (non-positive baseline — dividing by it inverts the verdict)."""
+    worse_by = (base - cand) if direction == "higher" else (cand - base)
+    if unit == "abs":
+        return worse_by
+    if base <= 0:
+        return None
+    return worse_by / base
+
+
+def load(path: str) -> dict[str, float]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return extract_metrics(doc)
+
+
+def baseline_value(
+    values: list[float], mode: str, direction: str
+) -> float:
+    if mode == "prev":
+        return values[-1]
+    if mode == "median":
+        return statistics.median(values)
+    # best: the strongest bar the trajectory ever set.
+    return max(values) if direction == "higher" else min(values)
+
+
+def compare(
+    baselines: list[dict[str, float]],
+    candidate: dict[str, float],
+    mode: str = "prev",
+    scale: float = 1.0,
+) -> list[dict]:
+    """Per-metric comparison rows; ``row["regressed"]`` marks failures."""
+    rows: list[dict] = []
+    for name, (direction, threshold, unit) in THRESHOLDS.items():
+        cand = candidate.get(name)
+        history = [b[name] for b in baselines if name in b]
+        if cand is None or not history:
+            continue
+        base = baseline_value(history, mode, direction)
+        allowed = threshold * scale
+        delta = _regression(base, cand, direction, unit)
+        rows.append(
+            {
+                "metric": name,
+                "direction": direction,
+                "unit": unit,
+                "baseline": base,
+                "candidate": cand,
+                "regression": None if delta is None else round(delta, 4),
+                "allowed": allowed,
+                "regressed": delta is not None and delta > allowed,
+            }
+        )
+    return rows
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "files", nargs="+", help="2+ BENCH json files, oldest..newest"
+    )
+    parser.add_argument(
+        "--baseline",
+        choices=("prev", "best", "median"),
+        default="prev",
+        help="how baseline files aggregate (default: the newest one)",
+    )
+    parser.add_argument(
+        "--scale",
+        type=float,
+        default=1.0,
+        help="multiply every regression threshold (noisy hosts)",
+    )
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    if len(args.files) < 2:
+        print("bench_compare: need at least two files", file=sys.stderr)
+        return 2
+    try:
+        records = [(path, load(path)) for path in args.files]
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"bench_compare: {exc}", file=sys.stderr)
+        return 2
+    *base_records, (cand_path, candidate) = records
+    empty = [path for path, rec in base_records if not rec]
+    if not candidate:
+        print(
+            f"bench_compare: {cand_path} carries no headline metrics "
+            "(crashed round?)",
+            file=sys.stderr,
+        )
+        return 2
+    rows = compare(
+        [rec for _, rec in base_records],
+        candidate,
+        mode=args.baseline,
+        scale=args.scale,
+    )
+    regressed = [row for row in rows if row["regressed"]]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "candidate": cand_path,
+                    "baselines": [p for p, _ in base_records],
+                    "mode": args.baseline,
+                    "rows": rows,
+                    "regressed": [row["metric"] for row in regressed],
+                    "empty_baselines": empty,
+                }
+            )
+        )
+    else:
+        for path in empty:
+            print(f"# {path}: no headline metrics (skipped)")
+        width = max((len(r["metric"]) for r in rows), default=10)
+        for row in rows:
+            mark = "REGRESSED" if row["regressed"] else "ok"
+            arrow = "^" if row["direction"] == "higher" else "v"
+            if row["regression"] is None:
+                move = "n/a (non-positive baseline)"
+            elif row["unit"] == "abs":
+                move = (
+                    f"{row['regression']:+.4g} vs {row['allowed']:.4g} "
+                    "abs budget"
+                )
+            else:
+                move = f"{row['regression']:+.1%} vs {row['allowed']:.0%} budget"
+            print(
+                f"{row['metric']:<{width}} {arrow} "
+                f"{row['baseline']:>10.4g} -> {row['candidate']:>10.4g} "
+                f"({move})  {mark}"
+            )
+        print(
+            f"bench_compare: {len(rows)} metric(s) compared, "
+            f"{len(regressed)} regression(s) "
+            f"[{cand_path} vs {args.baseline} of "
+            f"{len(base_records)} baseline(s)]"
+        )
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Drive a small store round trip and capture the decision-telemetry plane:
+prints the traffic matrix JSON to stdout and writes the merged flight
+record to /tmp/ts_flight_record.json (tpu_watch.sh moves both into its
+OUTDIR during a device capture). Safe to run anywhere a store can boot."""
+
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+
+async def main() -> int:
+    import torchstore_tpu as ts
+
+    await ts.initialize(
+        store_name="telemetry_capture",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        items = {
+            f"cap/{i}": np.random.rand(65536).astype(np.float32)
+            for i in range(16)
+        }
+        await ts.put_batch(items, store_name="telemetry_capture")
+        dests = {k: np.empty_like(v) for k, v in items.items()}
+        await ts.get_batch(dict(dests), store_name="telemetry_capture")
+        await ts.get_batch(dict(dests), store_name="telemetry_capture")
+        matrix = await ts.traffic_matrix(store_name="telemetry_capture")
+        record = await ts.flight_record(store_name="telemetry_capture")
+        print(json.dumps(matrix))
+        # One-shot CLI at capture end: nothing else runs on this loop, so
+        # a synchronous write cannot stall concurrent work.
+        with open("/tmp/ts_flight_record.json", "w") as f:  # tslint: disable=async-blocking
+            json.dump(record, f)
+        print(
+            f"# captured {len(record['events'])} flight event(s), "
+            f"{len(matrix['edges'])} matrix source host(s)",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        await ts.shutdown("telemetry_capture")
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

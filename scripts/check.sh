@@ -1,0 +1,45 @@
+#!/bin/bash
+# Single CI/pre-PR entry point: everything fast that must be green before a
+# change ships, in the order that fails fastest.
+#
+#   scripts/check.sh            # the full fast gate
+#   scripts/check.sh --quick    # static analysis only (skip pytest)
+#
+# Stages:
+#   1. tslint --fail-on-new     repo-specific static analysis (11 rules,
+#                               incl. env-registry + metric-discipline docs
+#                               drift — regen with --regen-env-docs /
+#                               --regen-metric-docs after editing knobs or
+#                               instruments)
+#   2. metric namespace shim    scripts/check_metric_names.py (historical
+#                               entry point; same checker as tslint)
+#   3. bench + trajectory smoke pytest over test_bench_smoke.py (the REAL
+#                               bench.py code path at KB scale, incl. the
+#                               ledger_overhead telemetry-cost section) and
+#                               test_bench_compare.py (the BENCH_r*
+#                               regression gate itself)
+#
+# The full tier-1 suite stays `python -m pytest tests/ -q -m 'not slow'`.
+set -u
+cd "$(dirname "$0")/.."
+rc=0
+
+run() {
+    echo "== $*"
+    "$@" || rc=$?
+}
+
+run python scripts/tslint.py --fail-on-new
+run python scripts/check_metric_names.py
+if [ "${1:-}" != "--quick" ]; then
+    run env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_bench_smoke.py tests/test_bench_compare.py \
+        -q -p no:cacheprovider
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: FAILED (first failing stage's exit code: $rc)"
+else
+    echo "check.sh: OK"
+fi
+exit "$rc"

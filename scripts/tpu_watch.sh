@@ -29,9 +29,13 @@ while true; do
         # this OUTDIR must not pollute the merge or divert the new run's
         # claim arbitration.
         rm -f "$OUTDIR"/device_trace*
+        # Flight-recorder post-mortems from every process of the runs below
+        # land here (quarantines, injected faults, unclean exits).
+        mkdir -p "$OUTDIR/flight"
         timeout 400 env TORCHSTORE_TPU_METRICS_DUMP="$OUTDIR/device_metrics.json" \
             TORCHSTORE_TPU_TRACE="$OUTDIR/device_trace.json" \
             TORCHSTORE_TPU_METRICS_PORT="$METRICS_PORT" \
+            TORCHSTORE_TPU_FLIGHT_DIR="$OUTDIR/flight" \
             python bench.py --device-section \
             >"$OUTDIR/device_section.out" 2>&1 &
         BENCH_PID=$!
@@ -61,9 +65,19 @@ while true; do
         # tmpfs/DRAM. Working set stays modest (256 MB) so the capture
         # finishes even on a busy tunnel window.
         timeout 600 env TORCHSTORE_TPU_BENCH_COLD_MB=256 \
+            TORCHSTORE_TPU_FLIGHT_DIR="$OUTDIR/flight" \
             python bench.py --cold-path \
             >"$OUTDIR/cold_path.out" 2>&1
         echo "cold path exit: $?"
+        # Decision telemetry on the DEVICE HOST: drive a small store round
+        # trip and capture the traffic matrix + the merged flight-recorder
+        # timeline (one JSON each). Proof the ledger/recorder plane works
+        # where placement decisions will actually run.
+        timeout 300 env TORCHSTORE_TPU_FLIGHT_DIR="$OUTDIR/flight" \
+            python scripts/capture_telemetry.py \
+            >"$OUTDIR/traffic_matrix.json" 2>"$OUTDIR/telemetry_capture.log"
+        echo "telemetry capture exit: $? (matrix -> $OUTDIR/traffic_matrix.json, flight -> $OUTDIR/flight_record.json)"
+        mv -f /tmp/ts_flight_record.json "$OUTDIR/flight_record.json" 2>/dev/null || true
         timeout 600 python benchmarks/flash_kernel_bench.py \
             >"$OUTDIR/flash_kernel.out" 2>&1
         echo "flash kernel exit: $?"

@@ -83,6 +83,42 @@ def regen_env_docs(root: str) -> int:
     return 0
 
 
+def regen_metric_docs(root: str) -> int:
+    """Rewrite the generated metrics reference table in docs/API.md from a
+    static scan of every instrument registration site (same scan the
+    metric-discipline drift rule validates against)."""
+    from torchstore_tpu.analysis.checkers.metric_discipline import (
+        METRIC_DOCS_BEGIN,
+        METRIC_DOCS_END,
+        collect_instruments,
+        render_metric_table,
+    )
+
+    instruments = collect_instruments(root)
+    if not instruments:
+        print("tslint: no metric registration sites found", file=sys.stderr)
+        return 1
+    docs_path = os.path.join(root, "docs", "API.md")
+    with open(docs_path, encoding="utf-8") as f:
+        docs = f.read()
+    table = render_metric_table(instruments)
+    block = f"{METRIC_DOCS_BEGIN}\n{table}\n{METRIC_DOCS_END}"
+    if METRIC_DOCS_BEGIN in docs and METRIC_DOCS_END in docs:
+        head = docs.split(METRIC_DOCS_BEGIN, 1)[0]
+        tail = docs.split(METRIC_DOCS_END, 1)[1]
+        docs = head + block + tail
+    else:
+        docs = docs.rstrip() + "\n\n## Metrics reference\n\n" + block + "\n"
+    with open(docs_path, "w", encoding="utf-8") as f:
+        f.write(docs)
+    names = {name for _, _, name, _, _ in instruments}
+    print(
+        f"tslint: regenerated metrics table ({len(names)} metrics, "
+        f"{len(instruments)} registration sites) in docs/API.md"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--json", action="store_true", help="JSON report")
@@ -115,6 +151,12 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="regenerate the env-var table in docs/API.md from config.ENV_REGISTRY",
     )
+    parser.add_argument(
+        "--regen-metric-docs",
+        action="store_true",
+        help="regenerate the metrics reference table in docs/API.md from "
+        "a static scan of instrument registration sites",
+    )
     parser.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
 
@@ -124,6 +166,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.regen_env_docs:
         return regen_env_docs(args.root)
+    if args.regen_metric_docs:
+        return regen_metric_docs(args.root)
 
     rules = args.rules.split(",") if args.rules else None
     baseline = None if args.no_baseline else args.baseline

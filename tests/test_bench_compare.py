@@ -1,0 +1,148 @@
+"""Tier-1 smoke for scripts/bench_compare.py: the r01-r05 trajectory gate
+must actually read both record shapes, apply direction-aware thresholds,
+and exit non-zero on a regression (the satellite contract of ISSUE 10)."""
+
+import importlib.util
+import json
+import pathlib
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", REPO_ROOT / "scripts" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(path, doc):
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_clean_pair_passes_and_regression_fails(tmp_path, capsys):
+    bc = _load()
+    base = _write(
+        tmp_path / "BENCH_a.json",
+        {
+            "metric": "state_dict_weight_sync_round_trip",
+            "value": 10.0,
+            "per_key_get_us": 12.0,
+            "overlap_ratio": 0.9,
+            "p50_get_1kb_ms": 0.2,
+        },
+    )
+    # Within budget: tiny wobble both directions.
+    ok = _write(
+        tmp_path / "BENCH_b.json",
+        {
+            "value": 9.5,
+            "per_key_get_us": 13.0,
+            "overlap_ratio": 0.88,
+            "p50_get_1kb_ms": 0.21,
+        },
+    )
+    assert bc.main([base, ok]) == 0
+    # Collapse: headline halves AND per-key get triples — both breach.
+    bad = _write(
+        tmp_path / "BENCH_c.json",
+        {
+            "value": 4.0,
+            "per_key_get_us": 40.0,
+            "overlap_ratio": 0.9,
+            "p50_get_1kb_ms": 0.2,
+        },
+    )
+    assert bc.main([base, bad]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    assert "value" in out and "per_key_get_us" in out
+
+
+def test_wrapper_shape_and_tail_recovery(tmp_path):
+    """The driver wrapper ({"parsed", "tail"}) must compare as richly as a
+    raw record: the full headline JSON embedded in ``tail`` is recovered,
+    and a crashed round (parsed: null, no JSON in tail) is a usage error
+    rather than a silent pass."""
+    bc = _load()
+    headline = {"metric": "x", "value": 8.0, "per_key_get_us": 15.0}
+    wrapper = _write(
+        tmp_path / "BENCH_w.json",
+        {
+            "n": 1,
+            "cmd": "python bench.py",
+            "rc": 0,
+            "parsed": {"metric": "x", "value": 8.0, "unit": "GB/s"},
+            "tail": "# noise\n" + json.dumps(headline) + "\n# more",
+        },
+    )
+    raw = _write(
+        tmp_path / "BENCH_x.json", {"value": 7.8, "per_key_get_us": 16.0}
+    )
+    assert bc.main([wrapper, raw]) == 0
+    crashed = _write(
+        tmp_path / "BENCH_crash.json",
+        {"n": 5, "cmd": "python bench.py", "rc": 1, "parsed": None,
+         "tail": "Traceback ..."},
+    )
+    assert bc.main([raw, crashed]) == 2  # candidate carries nothing
+
+
+def test_baseline_modes_and_json_output(tmp_path, capsys):
+    bc = _load()
+    files = [
+        _write(tmp_path / f"BENCH_{i}.json", {"value": v})
+        for i, v in enumerate((6.0, 12.0, 7.0))
+    ]
+    cand = _write(tmp_path / "BENCH_cand.json", {"value": 7.5})
+    # prev baseline = 7.0 -> +7% improvement: fine.
+    assert bc.main([*files, cand, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rows"] and doc["regressed"] == []
+    # best baseline = 12.0 -> 37.5% drop: breaches the 30% budget...
+    assert bc.main([*files, cand, "--baseline", "best"]) == 1
+    capsys.readouterr()
+    # ...unless the operator loosens thresholds for a noisy host.
+    assert bc.main([*files, cand, "--baseline", "best", "--scale", "2"]) == 0
+
+
+def test_absolute_thresholds_survive_negative_baselines(tmp_path):
+    """ledger_overhead_pct legitimately sits near (or below) zero under
+    host noise — a fractional comparison against a negative baseline
+    inverts the verdict, so it budgets in absolute percentage points."""
+    bc = _load()
+    base = _write(
+        tmp_path / "BENCH_a.json", {"ledger_overhead_pct": -0.3}
+    )
+    # A real regression past the 2-point budget must FAIL even though the
+    # fractional delta against a negative baseline is negative...
+    bad = _write(tmp_path / "BENCH_b.json", {"ledger_overhead_pct": 5.0})
+    assert bc.main([base, bad]) == 1
+    # ...and an improvement must PASS even though its fractional delta
+    # against the negative baseline is large and positive.
+    good = _write(tmp_path / "BENCH_c.json", {"ledger_overhead_pct": -2.0})
+    assert bc.main([base, good]) == 0
+    # Relative metrics with a non-positive baseline are skipped, not
+    # mis-judged (a zeroed round must not wave any candidate through).
+    zero = _write(tmp_path / "BENCH_z.json", {"value": 0.0})
+    cand = _write(tmp_path / "BENCH_d.json", {"value": 0.001})
+    rows = bc.compare([bc.load(zero)], bc.load(cand))
+    (row,) = [r for r in rows if r["metric"] == "value"]
+    assert row["regression"] is None and not row["regressed"]
+
+
+def test_real_trajectory_files_parse():
+    """The committed BENCH_r* records must stay machine-readable (this is
+    the exact artifact set the tool exists for). No regression assertion —
+    the trajectory spans known host-weather swings — just that at least
+    one round yields metrics and the tool runs end to end."""
+    bc = _load()
+    paths = sorted(str(p) for p in REPO_ROOT.glob("BENCH_r0*.json"))
+    assert len(paths) >= 2
+    parsed = [bc.load(p) for p in paths]
+    assert any(rec for rec in parsed), "no BENCH round carries metrics"
+    rc = bc.main([*paths, "--baseline", "median", "--scale", "100"])
+    assert rc in (0, 2)  # 2 only if the newest round crashed pre-headline

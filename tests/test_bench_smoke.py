@@ -32,6 +32,8 @@ async def test_bench_run_tiny(capsys):
         many_keys_kb=4,
         recovery_n_keys=8,
         recovery_key_kb=4,
+        ledger_keys=16,
+        ledger_reps=2,
         streamed_layers=4,
         streamed_layer_kb=4,
         streamed_train_ms=5.0,
@@ -107,6 +109,14 @@ async def test_bench_run_tiny(capsys):
     assert result["many_keys_get_gbps"] > 0
     assert result["get_memcpy_ratio"] > 0
     assert result["p50_get_1kb_ms"] > 0
+
+    # Decision-telemetry overhead (ISSUE 10): the always-on recorder +
+    # ledger cost on the warm one-sided get leg. KB-scale values are
+    # noise — structure only; the <=2% bar is the full-scale run's.
+    assert "ledger_overhead_pct" in result
+    lo = result["ledger_overhead"]
+    assert lo["on_us_per_key"] > 0 and lo["off_us_per_key"] > 0
+    assert lo["n_keys"] == 16
 
     # Streamed-sync section (ISSUE 9): overlap metrics at top level, the
     # full section under "streamed_sync". At KB scale the VALUES are noise
@@ -223,3 +233,24 @@ async def test_bench_cold_path_section_tiny():
     assert cold["cold_vs_steady"] > 0
     assert cold["cold_prewarmed_vs_steady"] > 0
     json.dumps(cold)
+
+
+@pytest.mark.anyio
+async def test_bench_ledger_overhead_section_tiny():
+    """The ledger_overhead section standalone at KB scale: real warm
+    one-sided gets timed telemetry-on vs telemetry-off, and the toggles
+    restored afterwards (a bench crash must never leave telemetry off)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+    from torchstore_tpu.observability import ledger as obs_ledger
+    from torchstore_tpu.observability import recorder as obs_recorder
+
+    out = await bench.ledger_overhead_section(n_keys=16, key_kb=4, reps=2)
+    assert out["on_us_per_key"] > 0 and out["off_us_per_key"] > 0
+    assert "overhead_pct" in out
+    assert obs_ledger.ledger().enabled
+    assert obs_recorder.recorder().enabled
+    json.dumps(out)

@@ -1,0 +1,567 @@
+"""Decision telemetry (ISSUE 10): traffic ledger + matrix, sync-timeline
+SLOs, and the fault-triggered flight recorder — unit semantics plus the
+fleet-level acceptance paths (lag gauge rising/settling under an injected
+watermark delay, an SLO violation recorded, per-host egress matching bytes
+actually moved, and an auto-dumped post-mortem on an injected volume
+death)."""
+
+import asyncio
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import profile as obs_profile
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
+
+
+# --------------------------------------------------------------------------
+# unit: ledger cells, rolling windows, matrix folding
+# --------------------------------------------------------------------------
+
+
+class TestTrafficLedger:
+    def test_cells_and_key_windows(self):
+        led = obs_ledger.TrafficLedger(window_s=3600)
+        led.record(
+            "shm", obs_ledger.EGRESS, 100, peer_host="h2", volume="0",
+            items=[("a", 60), ("b", 40)],
+        )
+        led.record("shm", obs_ledger.EGRESS, 50, peer_host="h2", volume="0")
+        snap = led.snapshot()
+        (cell,) = snap["cells"]
+        assert cell["bytes"] == 150 and cell["ops"] == 2
+        assert cell["peer_host"] == "h2" and cell["direction"] == "egress"
+        keys = {k["key"]: k for k in snap["keys"]}
+        assert keys["a"]["bytes"] == 60 and keys["b"]["ops"] == 1
+
+    def test_weighted_sample_scales_to_expectation(self):
+        led = obs_ledger.TrafficLedger(window_s=3600)
+        # A 1-in-8 sampled batch recorded at weight 8 must read like the
+        # 8 batches it stands for.
+        led.record(
+            "one_sided", obs_ledger.INGRESS, 8 * 100, volume="0",
+            items=[("k", 100)], ops=8, weight=8,
+        )
+        (cell,) = led.snapshot()["cells"]
+        assert cell["bytes"] == 800 and cell["ops"] == 8
+        (key,) = led.snapshot()["keys"]
+        assert key["ops"] == 8 and key["bytes"] == 800
+
+    def test_window_rotation_decays_old_keys(self):
+        import time as _time
+
+        led = obs_ledger.TrafficLedger(window_s=0.05)
+        led.record("shm", obs_ledger.EGRESS, 10, items=[("old", 10)])
+        _time.sleep(0.06)
+        led.record("shm", obs_ledger.EGRESS, 10, items=[("new", 10)])
+        # "old" slid to the previous window (still visible)...
+        assert {k["key"] for k in led.top_keys()} == {"old", "new"}
+        _time.sleep(0.06)
+        led.record("shm", obs_ledger.EGRESS, 10, items=[("newer", 10)])
+        # ...and is gone after the second rotation.
+        assert "old" not in {k["key"] for k in led.top_keys()}
+        # Idle decay: READS rotate too — an idle process's snapshot must
+        # not serve hour-old keys as "hot right now".
+        _time.sleep(0.11)  # two full windows with zero records
+        assert led.top_keys() == []
+        assert led.snapshot()["cells"]  # totals are lifetime, not windowed
+
+    def test_disabled_ledger_records_nothing(self):
+        led = obs_ledger.TrafficLedger(window_s=3600)
+        led.set_enabled(False)
+        led.record("shm", obs_ledger.EGRESS, 10, items=[("k", 10)])
+        assert led.snapshot()["cells"] == []
+
+    def test_matrix_counts_each_transfer_once(self):
+        # Client on hostA: put 100 to a volume on hostB, get 40 back, plus
+        # a one-sided read of 60 (peer = own host). The volume's own
+        # peer-less cells for the SAME transfers must not double anything.
+        client_snap = {
+            "host": "hostA",
+            "cells": [
+                {"peer_host": "hostB", "volume": "0", "transport": "shm",
+                 "direction": "egress", "ops": 1, "bytes": 100},
+                {"peer_host": "hostB", "volume": "0", "transport": "shm",
+                 "direction": "ingress", "ops": 1, "bytes": 40},
+                {"peer_host": "hostA", "volume": "1", "transport":
+                 "one_sided", "direction": "ingress", "ops": 1, "bytes": 60},
+            ],
+            "keys": [],
+        }
+        volume_snap = {
+            "host": "hostB",
+            "cells": [
+                {"peer_host": "", "volume": "0", "transport": "shm",
+                 "direction": "ingress", "ops": 1, "bytes": 100},
+                {"peer_host": "", "volume": "0", "transport": "shm",
+                 "direction": "egress", "ops": 1, "bytes": 40},
+            ],
+            "keys": [],
+        }
+        m = obs_ledger.traffic_matrix(
+            {"client": client_snap, "volume:0": volume_snap}
+        )
+        assert m["edges"]["hostA"]["hostB"]["bytes"] == 100
+        assert m["edges"]["hostB"]["hostA"]["bytes"] == 40
+        assert m["edges"]["hostA"]["hostA"]["bytes"] == 60
+        assert m["egress"] == {"hostA": 160, "hostB": 40}
+        assert m["ingress"] == {"hostB": 100, "hostA": 100}
+        assert m["volumes"]["0"] == {"bytes_in": 100, "bytes_out": 40}
+        assert m["volumes"]["1"] == {"bytes_in": 0, "bytes_out": 60}
+        # Peer-less volume cells are visible but never double-counted.
+        assert m["unattributed"]["hostB"] == {
+            "bytes_in": 100, "bytes_out": 40
+        }
+
+
+# --------------------------------------------------------------------------
+# unit: quantile digests, SLO checks, timeline reconstruction, recorder
+# --------------------------------------------------------------------------
+
+
+class TestTimelineUnits:
+    def test_op_quantiles_publish_gauges(self):
+        q = obs_timeline.OpQuantiles()
+        for i in range(100):
+            q.observe("unit_op", 0.001 * (i + 1))
+        quant = q.quantiles("unit_op")
+        assert quant["0.5"] <= quant["0.99"]
+        assert (
+            obs_metrics.get_registry()
+            .gauge("ts_op_p99_seconds")
+            .value(op="unit_op")
+            > 0
+        )
+
+    def test_check_slo_counts_and_directions(self, monkeypatch):
+        counter = obs_metrics.get_registry().counter(
+            "ts_slo_violations_total"
+        )
+        monkeypatch.setenv("TORCHSTORE_TPU_SLO_GET_P99_MS", "10")
+        base = counter.value(slo="get_p99_ms")
+        assert obs_timeline.check_slo(obs_timeline.SLO_GET_P99_MS, 50.0)
+        assert not obs_timeline.check_slo(obs_timeline.SLO_GET_P99_MS, 5.0)
+        assert counter.value(slo="get_p99_ms") == base + 1
+        monkeypatch.setenv("TORCHSTORE_TPU_SLO_OVERLAP_MIN", "0.5")
+        assert obs_timeline.check_slo(
+            obs_timeline.SLO_OVERLAP_MIN, 0.2, worse="below"
+        )
+        assert not obs_timeline.check_slo(
+            obs_timeline.SLO_OVERLAP_MIN, 0.9, worse="below"
+        )
+        monkeypatch.delenv("TORCHSTORE_TPU_SLO_GET_P99_MS")
+        assert not obs_timeline.check_slo(obs_timeline.SLO_GET_P99_MS, 1e9)
+
+    def test_reconstruct_lifecycle(self):
+        state = {
+            "version": 3,
+            "sealed": 3,
+            "begin_ts": 100.0,
+            "seal_ts": 100.5,
+            "landing_ts": {"sd/b": 100.3, "sd/a": 100.1},
+            "acks": {"host:1": {"version": 3, "ts": 100.7}},
+            "watermarks": {"sd/a": 3, "sd/b": 3},
+        }
+        tl = obs_timeline.reconstruct(state)
+        assert tl["publish_window_s"] == 0.5
+        assert tl["first_layer_s"] == pytest.approx(0.1)
+        assert [l["key"] for l in tl["landings"]] == ["sd/a", "sd/b"]
+        assert tl["subscribers"]["host:1"]["completion_s"] == pytest.approx(
+            0.7
+        )
+        assert obs_timeline.reconstruct(None) is None
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_snapshot_ordered(self):
+        rec = obs_recorder.FlightRecorder(maxlen=4)
+        for i in range(10):
+            rec.record("op", f"e{i}")
+        events = rec.snapshot()
+        assert [e["name"] for e in events] == ["e6", "e7", "e8", "e9"]
+
+    def test_dump_writes_atomic_json(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_DIR", str(tmp_path))
+        rec = obs_recorder.FlightRecorder(maxlen=64)
+        rec.record("fault", "volume.put", action="die")
+        path = rec.dump(
+            "unit:test", extra_events=[{"ts": 0.0, "kind": "op", "name": "x"}]
+        )
+        assert path and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        assert doc["trigger"] == "unit:test"
+        # Merged + time-sorted: the extra (older) event sorts first.
+        assert doc["events"][0]["name"] == "x"
+        assert doc["events"][1]["name"] == "volume.put"
+        # Empty ring -> no file, no crash.
+        rec.clear()
+        assert rec.dump("unit:empty") is None
+
+    def test_disabled_recorder_is_silent(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_DIR", str(tmp_path))
+        rec = obs_recorder.FlightRecorder(maxlen=8)
+        rec.set_enabled(False)
+        rec.record("fault", "x")
+        assert rec.snapshot() == [] and rec.dump("unit:off") is None
+
+
+# --------------------------------------------------------------------------
+# fleet: matrix egress matches bytes moved; hot-key blind-spot regression
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_traffic_matrix_egress_matches_bytes_moved():
+    """ISSUE-10 acceptance leg: after a known workload, the matrix's
+    per-host egress equals the bytes actually moved (puts: client egress;
+    gets: volume egress / one-sided same-host edges) within tolerance."""
+    import torchstore_tpu as ts
+
+    obs_ledger.reset_ledger()
+    await ts.initialize(
+        store_name="tm",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        n_keys, n_elem = 16, 1024  # 16 x 4 KB: exact (unsampled) accounting
+        items = {
+            f"tm/{i}": np.random.rand(n_elem).astype(np.float32)
+            for i in range(n_keys)
+        }
+        per = n_elem * 4
+        await ts.put_batch(items, store_name="tm")
+        dests = {k: np.empty_like(v) for k, v in items.items()}
+        await ts.get_batch(dict(dests), store_name="tm")  # RPC, records plans
+        await ts.get_batch(dict(dests), store_name="tm")  # warm one-sided
+        matrix = await ts.traffic_matrix(store_name="tm")
+        host = obs_ledger.local_host()
+        moved = n_keys * per * 3  # one put + two gets, all on this host
+        assert matrix["egress"][host] == pytest.approx(moved, rel=0.02), (
+            matrix["egress"],
+            moved,
+        )
+        assert matrix["ingress"][host] == pytest.approx(moved, rel=0.02)
+        vol = matrix["volumes"]["0"]
+        assert vol["bytes_in"] == pytest.approx(n_keys * per, rel=0.02)
+        assert vol["bytes_out"] == pytest.approx(2 * n_keys * per, rel=0.02)
+        # The rolling key windows carry the workload's keys.
+        client_keys = {k["key"] for k in matrix["keys"]["client"]}
+        assert client_keys & set(items)
+    finally:
+        await ts.shutdown("tm")
+
+
+@pytest.mark.anyio
+async def test_one_sided_reads_feed_labeled_hot_keys():
+    """PR-7 blind-spot regression: warm zero-RPC gets must show up in the
+    labeled client-side profiler view (and the fleet snapshot's
+    ``client:one_sided`` hot list) — no volume can ever count them."""
+    import torchstore_tpu as ts
+
+    obs_profile.reset_hot_keys()
+    obs_ledger.reset_ledger()
+    await ts.initialize(
+        store_name="hk",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        arr = np.random.rand(2048).astype(np.float32)
+        await ts.put("hk/warm", arr, store_name="hk")
+        dest = np.empty_like(arr)
+        await ts.get("hk/warm", like=dest, store_name="hk")  # records plan
+        reads = obs_metrics.get_registry().counter(
+            "ts_one_sided_reads_total"
+        )
+        before = reads.total()
+        for _ in range(3):
+            await ts.get("hk/warm", like=dest, store_name="hk")
+        assert reads.total() - before >= 3  # genuinely one-sided
+        one_sided = obs_profile.hot_keys(source="one_sided")
+        assert any(h["key"] == "hk/warm" for h in one_sided), one_sided
+        hot = {h["key"]: h for h in one_sided}
+        assert hot["hk/warm"]["bytes"] >= 3 * arr.nbytes
+        doc = await ts.fleet_snapshot(store_name="hk")
+        assert any(
+            h["key"] == "hk/warm"
+            for h in doc["hot_keys"].get("client:one_sided", ())
+        ), doc["hot_keys"].keys()
+    finally:
+        await ts.shutdown("hk")
+
+
+# --------------------------------------------------------------------------
+# fleet: lag gauge + SLO + generation timeline under a watermark delay
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_stream_lag_slo_and_generation_timeline(monkeypatch):
+    """The two-fleet acceptance shape: a publisher streams layers while a
+    LAGGING subscriber (slow on_layer) acquires under an injected
+    ``channel.watermark`` delay — the lag gauge must rise then settle to
+    0, an SLO violation must be recorded, and the controller's timestamped
+    stream record must reconstruct into a full generation lifecycle with
+    this subscriber's ack."""
+    import torchstore_tpu as ts
+
+    monkeypatch.setenv("TORCHSTORE_TPU_SLO_FIRST_LAYER_MS", "0.001")
+    await ts.initialize(
+        store_name="tl",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        await ts.inject_fault(
+            "channel.watermark",
+            "delay",
+            count=2,
+            delay_ms=50,
+            scope="controller",
+            store_name="tl",
+        )
+        n_layers = 6
+        layers = {
+            str(i): np.random.rand(512).astype(np.float32)
+            for i in range(n_layers)
+        }
+        order = [f"layers/{i}" for i in range(n_layers)]
+        lag_gauge = obs_metrics.get_registry().gauge("ts_stream_lag_keys")
+        lag_samples: list[float] = []
+        stop_sampling = asyncio.Event()
+
+        async def sampler():
+            # The lag gauge moves between wait_for_stream rounds; a
+            # concurrent sampler sees it rise while in-order delivery
+            # holds ready-but-unserved layers back.
+            while not stop_sampling.is_set():
+                lag_samples.append(lag_gauge.value())
+                await asyncio.sleep(0.005)
+
+        async def publisher():
+            # REVERSED publish order: the subscriber's key_order delivery
+            # holds every landed layer until layers/0 arrives LAST — the
+            # watermarked-but-unserved lag climbs to n_layers - 1.
+            stream = ts.state_dict_stream("tl/sd", store_name="tl")
+            await stream.begin()
+            for i in reversed(range(n_layers)):
+                await stream.put({"layers": {str(i): layers[str(i)]}})
+                await asyncio.sleep(0.03)
+            await stream.seal()
+
+        async def on_layer(fk, value):
+            await asyncio.sleep(0.005)
+
+        violations = obs_metrics.get_registry().counter(
+            "ts_slo_violations_total"
+        )
+        base_violations = violations.value(slo="first_layer_ms")
+        sampler_task = asyncio.ensure_future(sampler())
+        try:
+            _, sd = await asyncio.gather(
+                publisher(),
+                ts.get_state_dict_streamed(
+                    "tl/sd",
+                    key_order=order,
+                    on_layer=on_layer,
+                    wait_for_stream_s=30,
+                    timeout=120,
+                    store_name="tl",
+                ),
+            )
+        finally:
+            stop_sampling.set()
+            await sampler_task
+        assert set(sd["layers"]) == set(layers)
+        # Lag ROSE while the publisher outran the slow subscriber...
+        assert max(lag_samples) > 0, lag_samples
+        # ...and SETTLED once the acquire completed.
+        assert lag_gauge.value() == 0
+        # The (trivially breachable) first-layer SLO fired and the live
+        # production gauges moved.
+        assert violations.value(slo="first_layer_ms") > base_violations
+        assert (
+            obs_metrics.get_registry()
+            .gauge("ts_stream_first_layer_seconds")
+            .value()
+            > 0
+        )
+        overlap = (
+            obs_metrics.get_registry()
+            .gauge("ts_stream_overlap_ratio")
+            .value()
+        )
+        assert 0 <= overlap <= 1
+        # Generation timeline: begin -> landings -> seal -> our ack.
+        tl = await ts.sync_timeline("tl/sd", store_name="tl")
+        assert tl is not None and tl["version"] == 1 and tl["sealed"] == 1
+        assert tl["publish_window_s"] is not None
+        assert tl["publish_window_s"] >= 0
+        assert len(tl["landings"]) == n_layers
+        assert tl["first_layer_s"] is not None
+        sub_id = obs_timeline.subscriber_id()
+        assert sub_id in tl["subscribers"], tl["subscribers"]
+        assert tl["subscribers"][sub_id]["version"] == 1
+    finally:
+        await ts.clear_faults(store_name="tl")
+        await ts.shutdown("tl")
+
+
+# --------------------------------------------------------------------------
+# fleet: flight recorder post-mortems on injected death + quarantine
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_flight_recorder_dumps_on_injected_volume_death(tmp_path):
+    """Injected volume death (die-action faultpoint) must leave the doomed
+    process's post-mortem on disk; the supervisor's quarantine must then
+    write the controller's MERGED post-mortem; and ts.flight_record()
+    must still assemble, reporting the dead volume under errors."""
+    import torchstore_tpu as ts
+    from torchstore_tpu.runtime import ActorDiedError
+
+    env = {
+        "TORCHSTORE_TPU_FLIGHT_DIR": str(tmp_path),
+        "TORCHSTORE_TPU_HEALTH_INTERVAL_S": "0.25",
+        "TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD": "2",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        await ts.initialize(store_name="fr", num_storage_volumes=2)
+        try:
+            await ts.put(
+                "fr/k", np.ones(256, np.float32), store_name="fr"
+            )
+            await ts.inject_fault(
+                "volume.put", "die", count=1, scope="volumes",
+                store_name="fr",
+            )
+            with pytest.raises(Exception):
+                await ts.put(
+                    "fr/k2", np.ones(256, np.float32), store_name="fr"
+                )
+            # The dying process flushed its ring before os._exit.
+            for _ in range(50):
+                die_dumps = glob.glob(
+                    os.path.join(str(tmp_path), "flight_fault_die_*.json")
+                )
+                if die_dumps:
+                    break
+                await asyncio.sleep(0.1)
+            assert die_dumps, os.listdir(str(tmp_path))
+            doc = json.loads(open(die_dumps[0]).read())
+            assert doc["trigger"].startswith("fault_die")
+            assert any(e["kind"] == "fault" for e in doc["events"])
+            # Supervisor quarantine -> merged controller post-mortem.
+            for _ in range(80):
+                q_dumps = glob.glob(
+                    os.path.join(str(tmp_path), "flight_quarantine_*.json")
+                )
+                if q_dumps:
+                    break
+                await asyncio.sleep(0.1)
+            assert q_dumps, os.listdir(str(tmp_path))
+            qdoc = json.loads(open(q_dumps[0]).read())
+            assert any(
+                e["kind"] == "health" and e["name"].startswith("quarantine")
+                for e in qdoc["events"]
+            )
+            # On-demand merge still works; the dead volume reports as an
+            # error instead of failing the assembly.
+            record = await ts.flight_record(store_name="fr")
+            assert record["events"]
+            procs = {e.get("process") for e in record["events"]}
+            assert "client" in procs and "controller" in procs
+        finally:
+            try:
+                await ts.shutdown("fr")
+            except (ActorDiedError, Exception):
+                pass
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# --------------------------------------------------------------------------
+# satellite: fleet_snapshot under mid-scrape volume death DURING a stream
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_fleet_snapshot_mid_scrape_death_during_active_stream():
+    """aggregate errors path x stream records: a volume dying between
+    stream layers must land in the snapshot's ``errors`` while the
+    controller's LIVE stream record (watermarks + timeline) stays
+    readable and the surviving processes' metrics/ledgers still merge."""
+    import torchstore_tpu as ts
+    from torchstore_tpu.runtime import ActorDiedError
+
+    await ts.initialize(store_name="sd2", num_storage_volumes=2)
+    try:
+        stream = ts.state_dict_stream("sd2/x", store_name="sd2")
+        await stream.begin()
+        await stream.put(
+            {"layers": {"0": np.ones(256, np.float32)}}
+        )
+        # Kill a volume mid-stream (prefer one NOT holding the layer so
+        # the stream itself could still finish; either way the scrape
+        # must tolerate it).
+        c = ts.client("sd2")
+        located = await c.controller.locate_volumes.call_one(
+            ["sd2/x/layers/0"]
+        )
+        holders = set(located["sd2/x/layers/0"])
+        handle = ts.api._stores["sd2"]
+        vmap = await c.controller.get_volume_map.call_one()
+        victim_vid = next(
+            (vid for vid in vmap if vid not in holders),
+            next(iter(vmap)),
+        )
+        target = vmap[victim_vid]["ref"]
+        for idx, ref in enumerate(handle.volume_mesh.refs):
+            if (ref.host, ref.port, ref.name) == (
+                target.host,
+                target.port,
+                target.name,
+            ):
+                proc = handle.volume_mesh._processes[idx]
+                proc.terminate()
+                proc.join(10.0)
+                break
+        doc = await ts.fleet_snapshot(store_name="sd2")
+        assert len(doc["errors"]) == 1, doc["errors"]
+        assert victim_vid in doc["errors"]
+        # Survivors still merged: metrics, hot keys, AND ledgers.
+        procs = {p.get("process") for p in doc["processes"]}
+        assert {"client", "controller", "volume"} <= procs
+        assert "client" in doc["ledgers"]
+        surviving = [k for k in doc["ledgers"] if k.startswith("volume:")]
+        assert len(surviving) == 1
+        # The ACTIVE stream record survives the scrape: watermark + the
+        # generation timeline fields are all present and consistent.
+        state = await c.stream_state("sd2/x")
+        assert state is not None and state["version"] == 1
+        assert state["watermarks"].get("sd2/x/layers/0") == 1
+        assert state["begin_ts"] is not None
+        assert state["landing_ts"].get("sd2/x/layers/0") is not None
+        assert state["seal_ts"] is None  # not sealed yet
+        tl = await ts.sync_timeline("sd2/x", store_name="sd2")
+        assert tl["first_layer_s"] is not None
+        assert tl["seal_ts"] is None
+        # The traffic matrix still folds from whatever ledgers arrived.
+        matrix = await ts.traffic_matrix(store_name="sd2")
+        assert matrix["egress"], matrix
+    finally:
+        try:
+            await ts.shutdown("sd2")
+        except (ActorDiedError, Exception):
+            pass

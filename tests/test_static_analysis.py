@@ -421,6 +421,57 @@ def test_metric_discipline_rules(tmp_path):
     assert len(msgs) == 5, msgs
 
 
+def test_metric_docs_table_drift(tmp_path):
+    """The generated docs/API.md metrics table is lint-enforced: missing
+    markers, a stale table, and an up-to-date table each behave; fixture
+    trees WITHOUT docs/API.md (every other test here) skip the rule."""
+    src = {
+        "torchstore_tpu/a.py": """
+            from torchstore_tpu.observability import metrics as m
+            _C = m.counter("ts_docs_total", "counted things")
+            _G = m.gauge("ts_docs_gauge", "gauged things")
+            """,
+    }
+    # No docs/API.md at all: rule silently skips (fixture-tree contract).
+    proj = _project(tmp_path / "nodocs", src)
+    assert _msgs(metric_discipline.check(proj)) == []
+    # docs/API.md without markers: told to regen.
+    proj = _project(
+        tmp_path / "nomark", {**src, "docs/API.md": "# api\n"}
+    )
+    msgs = _msgs(metric_discipline.check(proj))
+    assert any("markers" in m for m in msgs), msgs
+    # Stale table between markers: drift finding.
+    stale = (
+        "# api\n\n"
+        + metric_discipline.METRIC_DOCS_BEGIN
+        + "\n| Metric | Kind | Description |\n|---|---|---|\n"
+        + "| `ts_gone_total` | counter | deleted metric |\n"
+        + metric_discipline.METRIC_DOCS_END
+        + "\n"
+    )
+    proj = _project(tmp_path / "stale", {**src, "docs/API.md": stale})
+    msgs = _msgs(metric_discipline.check(proj))
+    assert any("stale" in m for m in msgs), msgs
+    # Regenerated table: clean.
+    proj = _project(tmp_path / "fresh", src)
+    fresh_table = metric_discipline.render_metric_table(
+        metric_discipline.collect_instruments(str(tmp_path / "fresh"), proj)
+    )
+    (tmp_path / "fresh" / "docs").mkdir()
+    (tmp_path / "fresh" / "docs" / "API.md").write_text(
+        "# api\n\n"
+        + metric_discipline.METRIC_DOCS_BEGIN
+        + "\n"
+        + fresh_table
+        + "\n"
+        + metric_discipline.METRIC_DOCS_END
+        + "\n"
+    )
+    assert _msgs(metric_discipline.check(proj)) == []
+    assert "ts_docs_total" in fresh_table and "counted things" in fresh_table
+
+
 # --------------------------------------------------------------------------
 # Framework: pragmas, baseline, runner
 # --------------------------------------------------------------------------

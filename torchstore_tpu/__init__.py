@@ -20,6 +20,7 @@ from torchstore_tpu.api import (
     delete_prefix,
     exists,
     fleet_snapshot,
+    flight_record,
     get,
     get_batch,
     direct_staging_buffers,
@@ -38,6 +39,8 @@ from torchstore_tpu.api import (
     repair,
     reset_client,
     shutdown,
+    sync_timeline,
+    traffic_matrix,
     volume_health,
     wait_for,
 )
@@ -96,6 +99,7 @@ __all__ = [
     "delete_prefix",
     "exists",
     "fleet_snapshot",
+    "flight_record",
     "get",
     "get_batch",
     "get_state_dict",
@@ -115,6 +119,8 @@ __all__ = [
     "reset_client",
     "shutdown",
     "span",
+    "sync_timeline",
+    "traffic_matrix",
     "volume_health",
     "wait_for",
 ]

@@ -471,6 +471,13 @@ async def repair(store_name: str = DEFAULT_STORE) -> dict:
     statuses = await handle.controller.check_volumes.call_one()
     dead = sorted(v for v, s in statuses.items() if s.startswith("dead"))
     wedged = sorted(v for v, s in statuses.items() if s.startswith("wedged"))
+    if dead or wedged:
+        # Repair is a postmortem-grade moment: capture the last seconds of
+        # local history BEFORE replacement scrambles the fleet.
+        from torchstore_tpu.observability import recorder as obs_recorder
+
+        obs_recorder.record("health", "repair", dead=dead, wedged=wedged)
+        obs_recorder.dump_postmortem("repair")
     report = {
         "replaced": [],
         "rereplicated": 0,
@@ -688,6 +695,7 @@ async def fleet_snapshot(
     ``render="prometheus"`` returns one Prometheus-text document instead;
     ``render="json"`` a JSON string."""
     from torchstore_tpu.observability import aggregate, profile
+    from torchstore_tpu.observability import ledger as obs_ledger
 
     c = client(store_name)
     stats = await c.controller.stats.call_one(include_volumes=True)
@@ -697,6 +705,12 @@ async def fleet_snapshot(
     ]
     errors: dict[str, str] = {}
     hot: dict[str, list] = {"client": profile.hot_keys(10)}
+    one_sided_hot = profile.hot_keys(10, source="one_sided")
+    if one_sided_hot:
+        # The labeled zero-RPC view: bytes these keys moved never touched
+        # any volume, so no volume's hot_keys can account for them.
+        hot["client:one_sided"] = one_sided_hot
+    ledgers: dict[str, dict] = {"client": obs_ledger.snapshot()}
     for vid, vstats in sorted((stats.get("volumes") or {}).items()):
         if "metrics" not in vstats:
             errors[vid] = str(vstats.get("error", "no metrics in stats()"))
@@ -706,12 +720,92 @@ async def fleet_snapshot(
         )
         if vstats.get("hot_keys"):
             hot[f"volume:{vid}"] = vstats["hot_keys"]
-    doc = aggregate.fleet_doc(entries, errors=errors, hot_keys=hot)
+        if vstats.get("ledger"):
+            ledgers[f"volume:{vid}"] = vstats["ledger"]
+    doc = aggregate.fleet_doc(
+        entries, errors=errors, hot_keys=hot, ledgers=ledgers
+    )
     if render == "prometheus":
         return aggregate.render_prometheus(doc["metrics"])
     if render == "json":
         return aggregate.render_json(doc)
     return doc
+
+
+async def traffic_matrix(store_name: str = DEFAULT_STORE) -> dict:
+    """Fleet traffic matrix — the placement solver's input (ROADMAP item
+    5) and the O(1)-egress measurement for broadcast trees (item 1).
+
+    Scrapes every process's traffic ledger (``fleet_snapshot`` under the
+    hood) and folds the cells into ``{"edges": {src_host: {dst_host:
+    {"bytes", "ops"}}}, "egress": {host: bytes}, "ingress": {host: bytes},
+    "volumes": {vid: {"bytes_in", "bytes_out"}}, "unattributed": ...,
+    "keys": {process: top-K rolling-window keys}}``. Every transfer is
+    counted exactly once, at the side that can attribute both endpoints
+    (see observability/ledger.py)."""
+    from torchstore_tpu.observability import ledger as obs_ledger
+
+    doc = await fleet_snapshot(store_name)
+    ledgers = doc.get("ledgers") or {}
+    matrix = obs_ledger.traffic_matrix(ledgers)
+    matrix["keys"] = {
+        label: snap.get("keys", []) for label, snap in ledgers.items()
+    }
+    return matrix
+
+
+async def flight_record(store_name: Optional[str] = DEFAULT_STORE) -> dict:
+    """The merged fleet flight-recorder timeline: this process's ring plus
+    the controller's and every reachable volume's, time-sorted — the
+    on-demand post-mortem (``store_name=None`` returns the local ring
+    only). Unreachable processes land in ``errors`` instead of failing
+    the merge. See observability/recorder.py for what gets recorded and
+    which faults auto-dump."""
+    from torchstore_tpu.observability import recorder as obs_recorder
+
+    events = [
+        {**event, "process": "client"}
+        for event in obs_recorder.snapshot()
+    ]
+    errors: dict[str, str] = {}
+    if store_name is not None:
+        try:
+            c = client(store_name)
+            await c._ensure_setup()
+        except Exception as exc:  # noqa: BLE001 - local ring still serves
+            errors["fleet"] = f"{type(exc).__name__}: {exc}"
+        else:
+            try:
+                for event in await c.controller.flight_record.call_one():
+                    events.append({**event, "process": "controller"})
+            except Exception as exc:  # noqa: BLE001 - dead controller
+                errors["controller"] = f"{type(exc).__name__}: {exc}"
+            for vid in sorted(c._volume_refs or {}):
+                try:
+                    remote = await c._volume_refs[
+                        vid
+                    ].actor.flight_record.call_one()
+                except Exception as exc:  # noqa: BLE001 - dead volume
+                    errors[f"volume:{vid}"] = f"{type(exc).__name__}: {exc}"
+                    continue
+                for event in remote:
+                    events.append({**event, "process": f"volume:{vid}"})
+    events.sort(key=lambda e: e.get("ts") or 0)
+    return {"events": events, "errors": errors}
+
+
+async def sync_timeline(
+    key: str, store_name: str = DEFAULT_STORE
+) -> Optional[dict]:
+    """One weight-sync generation's reconstructed lifecycle: stream begin
+    -> per-key watermark landings -> seal -> per-subscriber acquire
+    completions, with publish-window / first-layer / completion-lag
+    figures (observability.timeline.reconstruct). None when ``key`` was
+    never streamed (or its record was evicted)."""
+    from torchstore_tpu.observability import timeline as obs_timeline
+
+    state = await client(store_name).stream_state(key)
+    return obs_timeline.reconstruct(state)
 
 
 async def inject_fault(
@@ -879,6 +973,7 @@ __all__ = [
     "delete_prefix",
     "exists",
     "fleet_snapshot",
+    "flight_record",
     "get",
     "get_batch",
     "get_state_dict",
@@ -896,5 +991,7 @@ __all__ = [
     "reset_client",
     "shutdown",
     "state_dict_stream",
+    "sync_timeline",
+    "traffic_matrix",
     "wait_for",
 ]

@@ -26,6 +26,8 @@ from torchstore_tpu.native import copy_into
 from torchstore_tpu.observability import context as obs_context
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.runtime import ActorDiedError, ActorRef
 from torchstore_tpu.strategy import StorageVolumeRef
@@ -468,12 +470,22 @@ class LocalClient:
                     tally=False,  # per-key tallies happen in _put_batch
                     keys=len(items),
                 )
-        except BaseException:
+        except BaseException as exc:
             _OP_ERRORS.inc(op="put")
+            obs_recorder.record(
+                "error", "put", error=f"{type(exc).__name__}: {exc}"[:200]
+            )
             raise
         _OP_COUNT.inc(op="put")
         _OP_BYTES.inc(nbytes, op="put")
         _OP_SECONDS.observe(dur, op="put")
+        # Decision telemetry: rolling p50/p99 digests (+ their SLO checks)
+        # and a flight-recorder breadcrumb — one each per BATCH.
+        obs_timeline.observe_op("put", dur)
+        obs_recorder.record(
+            "op", "put", keys=len(items), nbytes=nbytes,
+            ms=round(dur * 1e3, 3),
+        )
 
     async def _put_batch(
         self,
@@ -753,12 +765,20 @@ class LocalClient:
                 sp.set(nbytes=nbytes)
                 dur = time.perf_counter() - t0
                 obs_profile.record_keys("get", sizes, t0, dur)
-        except BaseException:
+        except BaseException as exc:
             _OP_ERRORS.inc(op="get")
+            obs_recorder.record(
+                "error", "get", error=f"{type(exc).__name__}: {exc}"[:200]
+            )
             raise
         _OP_COUNT.inc(op="get")
         _OP_BYTES.inc(nbytes, op="get")
         _OP_SECONDS.observe(dur, op="get")
+        obs_timeline.observe_op("get", dur)
+        obs_recorder.record(
+            "op", "get", keys=len(items), nbytes=nbytes,
+            ms=round(dur * 1e3, 3),
+        )
         return out
 
     async def _get_batch(self, items, _seed_plan: bool = True) -> dict[str, Any]:
@@ -1675,3 +1695,12 @@ class LocalClient:
         return await self._controller.wait_for_stream.with_timeout(
             self._wait_rpc_timeout(timeout)
         ).call_one(key, version, known, timeout)
+
+    async def stream_ack(
+        self, key: str, version: int, subscriber: str
+    ) -> None:
+        """Record this subscriber's acquire completion on the stream's
+        generation timeline (telemetry for ``ts.sync_timeline``; advisory,
+        bounded controller-side)."""
+        await self._ensure_setup()
+        await self._controller.stream_ack.call_one(key, version, subscriber)

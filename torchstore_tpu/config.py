@@ -141,6 +141,45 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "Client ops / volume puts+gets slower than this many "
            "milliseconds log a warning with the trace id and count "
            "ts_slow_ops_total."),
+    EnvVar("TORCHSTORE_TPU_LEDGER", "bool", True,
+           "Traffic ledger: per-(peer host, volume, transport, direction) "
+           "byte/op accounting with per-key rolling windows, recorded at "
+           "every transport choke point (incl. the zero-RPC one-sided "
+           "paths) and merged fleet-wide by ts.traffic_matrix()."),
+    EnvVar("TORCHSTORE_TPU_LEDGER_WINDOW_S", "float", 300,
+           "Rolling per-key traffic-window width, seconds (the ledger "
+           "keeps the current + previous window; a key that stops moving "
+           "decays out within two)."),
+    EnvVar("TORCHSTORE_TPU_FLIGHT_RECORDER", "bool", True,
+           "Always-on flight recorder: a bounded per-process ring of "
+           "recent ops/transfers/faults/errors, auto-dumped as a JSON "
+           "post-mortem on quarantine, repair, wedged streams, injected "
+           "deaths, and unclean exits; merged on demand via "
+           "ts.flight_record()."),
+    EnvVar("TORCHSTORE_TPU_FLIGHT_EVENTS", "int", 4096,
+           "Flight-recorder ring capacity (events per process)."),
+    EnvVar("TORCHSTORE_TPU_FLIGHT_DIR", "path", None,
+           "Directory for flight-recorder post-mortem dumps (default: "
+           "<tmpdir>/torchstore_tpu_flight; one file per trigger per "
+           "pid, atomically replaced)."),
+    # --- SLOs (TORCHSTORE_TPU_SLO_* is a registered dynamic family:
+    # operators may add their own; these are the shipped, wired-up bars.
+    # Unset = disabled; breaches log + count ts_slo_violations_total) ----
+    EnvVar("TORCHSTORE_TPU_SLO_PUT_P99_MS", "float", None,
+           "SLO: rolling-window put p99 above this many milliseconds is "
+           "a violation."),
+    EnvVar("TORCHSTORE_TPU_SLO_GET_P99_MS", "float", None,
+           "SLO: rolling-window get p99 above this many milliseconds is "
+           "a violation."),
+    EnvVar("TORCHSTORE_TPU_SLO_VERSION_LAG", "float", None,
+           "SLO: a subscriber acquiring with more than this many "
+           "published-but-never-pulled versions behind is a violation."),
+    EnvVar("TORCHSTORE_TPU_SLO_FIRST_LAYER_MS", "float", None,
+           "SLO: stream begin to a subscriber's first served layer above "
+           "this many milliseconds is a violation."),
+    EnvVar("TORCHSTORE_TPU_SLO_OVERLAP_MIN", "float", None,
+           "SLO: a streamed acquire overlapping LESS than this fraction "
+           "of the publish window is a violation."),
     # --- runtime / fleet ----------------------------------------------------
     EnvVar("TORCHSTORE_TPU_BIND_HOST", "str", "127.0.0.1",
            "Bind address for actor, bulk, and device-transfer listeners "
@@ -208,8 +247,12 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
 )
 
 # Dynamic families: names extending these prefixes are per-instance handles
-# (one per store), not individually registrable knobs.
-ENV_PREFIXES: tuple[str, ...] = ("TORCHSTORE_TPU_STORE_",)
+# (one per store) or operator-extensible knob families (custom SLOs), not
+# individually registrable entries.
+ENV_PREFIXES: tuple[str, ...] = (
+    "TORCHSTORE_TPU_STORE_",
+    "TORCHSTORE_TPU_SLO_",
+)
 
 
 def env_registry_entry(name: str) -> EnvVar | None:

@@ -11,6 +11,7 @@ after the data plane transfer completes (two-plane invariant, SURVEY §2.2.1).
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Optional
@@ -18,6 +19,7 @@ from typing import Any, Optional
 from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
 from torchstore_tpu.runtime import Actor, ActorRef, endpoint
 from torchstore_tpu.storage_utils.trie import Trie
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
@@ -282,6 +284,9 @@ class Controller(Actor):
         for vid in self.volume_refs:
             _VOLUME_HEALTH.set(1, volume=vid)
         self._start_supervisor()
+        # Unclean-exit post-mortem: a controller dying with faults/errors
+        # in its flight ring leaves the last seconds on disk.
+        obs_recorder.recorder().arm_exit_dump()
         return {
             "volume_ids": sorted(self.volume_refs),
             "hostnames": self.volume_hostnames,
@@ -552,11 +557,17 @@ class Controller(Actor):
             await faults.afire("channel.watermark")
             stream_key, version = watermark
             rec = self._stream_rec(stream_key, int(version))
+            now = time.time()
             for meta in metas:
                 prev = rec["watermarks"].get(meta.key, 0)
                 # max(): a delayed notify from a superseded stream must
                 # never roll a key's watermark backwards.
                 rec["watermarks"][meta.key] = max(prev, int(version))
+                if int(version) == rec["version"]:
+                    # Landing timestamp for the CURRENT generation's
+                    # timeline (setdefault: the first commit of a key is
+                    # its landing; superseded late notifies don't count).
+                    rec["landing_ts"].setdefault(meta.key, now)
         await self._bump({meta.key for meta in metas})
         # The reply carries the placement epoch so publishers track it for
         # free (no extra RPC): a bump invalidates their cached plans.
@@ -929,9 +940,21 @@ class Controller(Actor):
                 "version": version or 1,
                 "sealed": 0,
                 "watermarks": {},
+                # Generation timeline (observability/timeline.py): begin ->
+                # per-key landings -> seal -> per-subscriber acquire acks.
+                "begin_ts": time.time(),
+                "seal_ts": None,
+                "landing_ts": {},
+                "acks": {},
             }
         elif version is not None and version > rec["version"]:
             rec["version"] = version
+            # A new generation restarts the timeline; the watermarks map
+            # deliberately survives (max semantics across generations).
+            rec["begin_ts"] = time.time()
+            rec["seal_ts"] = None
+            rec["landing_ts"] = {}
+            rec["acks"] = {}
         # Re-insert at the END: dict order doubles as touch recency, so a
         # steadily re-streamed key stays clear of the eviction scan.
         self._streams[key] = rec
@@ -958,6 +981,8 @@ class Controller(Actor):
         stream always has a readable barrier-path state dict too."""
         rec = self._stream_rec(key, int(version))
         rec["sealed"] = max(rec["sealed"], int(version))
+        if int(version) == rec["version"] and rec.get("seal_ts") is None:
+            rec["seal_ts"] = time.time()
         cond = self._cond()
         async with cond:
             cond.notify_all()
@@ -975,7 +1000,42 @@ class Controller(Actor):
             "version": rec["version"],
             "sealed": rec["sealed"],
             "watermarks": dict(rec["watermarks"]),
+            # Generation timeline (observability.timeline.reconstruct
+            # folds these into publish-window / first-layer / per-
+            # subscriber completion figures).
+            "begin_ts": rec.get("begin_ts"),
+            "seal_ts": rec.get("seal_ts"),
+            "landing_ts": dict(rec.get("landing_ts") or {}),
+            "acks": {
+                sub: dict(ack) for sub, ack in (rec.get("acks") or {}).items()
+            },
         }
+
+    MAX_STREAM_ACKS = 64
+
+    @endpoint
+    async def stream_ack(
+        self, key: str, version: int, subscriber: str
+    ) -> None:
+        """Record one subscriber's acquire completion on the stream's
+        timeline (``{"version", "ts"}`` per subscriber; bounded — oldest
+        entries evicted past MAX_STREAM_ACKS). Advisory: a missing record
+        (evicted / never streamed) is a no-op, never an error — acks are
+        telemetry, not protocol."""
+        rec = self._streams.get(key)
+        if rec is None:
+            return
+        acks = rec.setdefault("acks", {})
+        if subscriber not in acks and len(acks) >= self.MAX_STREAM_ACKS:
+            acks.pop(next(iter(acks)))
+        acks[subscriber] = {"version": int(version), "ts": time.time()}
+
+    @endpoint
+    async def flight_record(self) -> list:
+        """The controller process's flight-recorder ring (see
+        observability/recorder.py); ts.flight_record() merges it with the
+        client's and every volume's."""
+        return obs_recorder.snapshot()
 
     @endpoint
     async def wait_for_stream(
@@ -1275,6 +1335,7 @@ class Controller(Actor):
                     h["oks"] = 1
                     _VOLUME_HEALTH.set(0.5, volume=vid)
                     changed = True
+                    obs_recorder.record("health", f"probation/{vid}")
                     logger.warning(
                         "volume %s answered pings again: probation "
                         "(%d/%d stable rounds to reinstate)",
@@ -1286,6 +1347,7 @@ class Controller(Actor):
                         h["state"] = "ok"
                         _VOLUME_HEALTH.set(1, volume=vid)
                         changed = True
+                        obs_recorder.record("health", f"reinstated/{vid}")
                         logger.warning(
                             "volume %s reinstated after %d stable rounds",
                             vid, h["oks"],
@@ -1301,12 +1363,26 @@ class Controller(Actor):
                     _VOLUME_HEALTH.set(0, volume=vid)
                     _QUARANTINES.inc(volume=vid)
                     changed = True
+                    obs_recorder.record(
+                        "health", f"quarantine/{vid}", misses=h["misses"]
+                    )
                     logger.warning(
                         "volume %s QUARANTINED after %d missed heartbeats; "
                         "placement skips it%s",
                         vid,
                         h["misses"],
                         "; auto-repair starting" if self._auto_repair else "",
+                    )
+                    # Fault-triggered flight recorder: dump a MERGED
+                    # post-mortem (controller ring + every reachable
+                    # volume's) the moment a volume goes dark — the
+                    # "last five seconds" an operator reads first. Off
+                    # the sweep's critical path.
+                    spawn_logged(
+                        self._dump_flight(f"quarantine:{vid}"),
+                        name="controller.flight_dump",
+                        tasks=self._health_tasks,
+                        log=logger,
                     )
                     if self._auto_repair:
                         self._start_auto_repair(vid)
@@ -1316,10 +1392,35 @@ class Controller(Actor):
             # health picture on their next operation.
             self._placement_epoch += 1
 
+    async def _dump_flight(self, trigger: str) -> Optional[str]:
+        """Write a MERGED flight-recorder post-mortem: this controller's
+        ring plus every volume's that still answers (2 s budget each — the
+        volume the trigger is about is usually the one that can't). Best-
+        effort by construction: a post-mortem must never fail its fleet."""
+        import asyncio
+
+        async def one(vid: str, ref: ActorRef) -> list:
+            try:
+                events = await asyncio.wait_for(
+                    ref.flight_record.call_one(), timeout=2.0
+                )
+                for event in events:
+                    event.setdefault("process", f"volume:{vid}")
+                return events
+            except Exception:  # noqa: BLE001 - unreachable: ring lost
+                return []
+
+        gathered = await asyncio.gather(
+            *(one(vid, ref) for vid, ref in self.volume_refs.items())
+        )
+        extra = [event for events in gathered for event in events]
+        return obs_recorder.dump_postmortem(trigger, extra)
+
     def _start_auto_repair(self, volume_id: str) -> None:
         if volume_id in self._repairing:
             return
         self._repairing.add(volume_id)
+        obs_recorder.record("health", f"auto_repair/{volume_id}")
         spawn_logged(
             self._auto_repair_volume(volume_id),
             name="controller.auto_repair",

@@ -74,6 +74,7 @@ from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
 
 logger = get_logger("torchstore_tpu.faults")
 
@@ -213,6 +214,7 @@ def _take(spec: FaultSpec) -> bool:
             _armed.pop(spec.name, None)
     spec.fired += 1
     _FIRED.inc(point=spec.name, action=spec.action)
+    obs_recorder.record("fault", spec.name, action=spec.action)
     logger.warning(
         "faultpoint FIRING: %s action=%s (fire #%d) [pid %d]",
         spec.name,
@@ -225,6 +227,11 @@ def _take(spec: FaultSpec) -> bool:
 
 def _execute_sync(spec: FaultSpec) -> Optional[str]:
     if spec.action == "die":
+        # The doomed process's last act: flush its flight ring to disk.
+        # os._exit skips atexit, so this is the only post-mortem an
+        # injected death ever leaves (the acceptance path for "volume
+        # died — what were its last five seconds?").
+        obs_recorder.dump_postmortem(f"fault_die:{spec.name}")
         os._exit(17)
     if spec.action == "raise":
         raise FaultInjectedError(f"injected fault at {spec.name!r}")
@@ -241,6 +248,7 @@ async def _execute_async(spec: FaultSpec) -> Optional[str]:
     import asyncio
 
     if spec.action == "die":
+        obs_recorder.dump_postmortem(f"fault_die:{spec.name}")
         os._exit(17)
     if spec.action == "raise":
         raise FaultInjectedError(f"injected fault at {spec.name!r}")

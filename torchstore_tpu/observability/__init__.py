@@ -45,7 +45,14 @@ operable plane:
   and trace annotations.
 """
 
-from torchstore_tpu.observability import aggregate, context, profile
+from torchstore_tpu.observability import (
+    aggregate,
+    context,
+    ledger,
+    profile,
+    recorder,
+    timeline,
+)
 from torchstore_tpu.observability.http_exporter import (
     ENV_METRICS_PORT,
     MetricsHTTPExporter,
@@ -100,6 +107,7 @@ def reinit_after_fork() -> None:
     collector().reinit_after_fork()
     _metrics.reinit_dumper_after_fork()
     _http.reinit_after_fork()
+    recorder.reinit_after_fork()
 
 __all__ = [
     "ENV_METRICS_DUMP",
@@ -124,16 +132,19 @@ __all__ = [
     "get_registry",
     "histogram",
     "hot_keys",
+    "ledger",
     "maybe_start_dumper",
     "maybe_start_http_exporter",
     "merge_traces",
     "metrics_snapshot",
     "profile",
     "record_op",
+    "recorder",
     "render_prometheus_snapshot",
     "reset_metrics",
     "span",
     "start_http_exporter",
     "stop_http_exporter",
+    "timeline",
     "trace_enabled",
 ]

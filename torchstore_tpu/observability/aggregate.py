@@ -100,8 +100,11 @@ def fleet_doc(
     entries: list[tuple[dict, dict]],
     errors: Optional[dict] = None,
     hot_keys: Optional[dict] = None,
+    ledgers: Optional[dict] = None,
 ) -> dict:
-    """Assemble the standard fleet-snapshot envelope around a merge."""
+    """Assemble the standard fleet-snapshot envelope around a merge.
+    ``ledgers`` maps process labels to traffic-ledger snapshots
+    (observability/ledger.py); ``ts.traffic_matrix()`` folds them."""
     import os
     import time
 
@@ -113,5 +116,6 @@ def fleet_doc(
         "errors": dict(errors or {}),
         "conflicts": conflicts,
         "hot_keys": dict(hot_keys or {}),
+        "ledgers": dict(ledgers or {}),
         "metrics": merged,
     }

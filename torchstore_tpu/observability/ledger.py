@@ -1,0 +1,310 @@
+"""Traffic ledger: who moves which bytes where — decision-grade accounting.
+
+The metrics registry answers "how many bytes did transport X move" as one
+process-global counter; placement decisions (ROADMAP item 5) and the O(1)-
+egress acceptance of broadcast trees (item 1) need the full matrix: per
+(peer host, volume, transport, direction) byte/op cells, plus per-key
+rolling windows so "which key is hot RIGHT NOW" is answerable without a
+process-lifetime tally. This module is that ledger:
+
+- **Cells**: ``(peer_host, volume, transport, direction)`` -> [ops, bytes].
+  ``direction`` is relative to the RECORDING process (``egress`` = bytes
+  this process sent, ``ingress`` = bytes it received). Client-side choke
+  points (transport/buffers.py, the one-sided stamped-read path, the bulk
+  doorbell) know both endpoints and record with ``peer_host`` set; volume-
+  side recordings (put/get serves, doorbell packs) know only themselves
+  and record with ``peer_host=""`` — the matrix builder uses peer-aware
+  cells so every transfer is counted exactly ONCE, at the side that can
+  attribute it.
+- **Per-key rolling windows**: two rotating buckets (current/previous, each
+  ``window_s`` wide, bounded like the hot-key tracker) so the top-K view
+  decays — a key that stopped moving falls out within two windows instead
+  of dominating forever.
+
+Snapshots ride each process's ``stats()`` endpoint exactly like hot keys;
+``ts.fleet_snapshot()`` collects them fleet-wide under ``"ledgers"`` and
+``ts.traffic_matrix()`` folds them into ``{src_host: {dst_host: bytes}}``
+plus per-host egress/ingress totals — the placement solver's input.
+
+Cost: one lock acquisition per recorded transfer (a put/get BATCH is one
+record), plus one dict add per key for the rolling window. Disable with
+``TORCHSTORE_TPU_LEDGER=0``; the bench's ``ledger_overhead`` section
+measures the always-on cost on the warm many-keys legs.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Iterable, Optional
+
+ENV_LEDGER = "TORCHSTORE_TPU_LEDGER"
+ENV_LEDGER_WINDOW = "TORCHSTORE_TPU_LEDGER_WINDOW_S"
+
+EGRESS = "egress"
+INGRESS = "ingress"
+
+
+def _hostname() -> str:
+    return os.environ.get("TORCHSTORE_TPU_HOSTNAME") or socket.gethostname()
+
+
+def local_host() -> str:
+    """This process's host label (what same-host transfers record as their
+    peer: a one-sided read's 'remote' end is a volume on this machine)."""
+    return _hostname()
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_LEDGER, "1").strip().lower() not in (
+        "0", "false", "no", "off", "",
+    )
+
+
+def _env_window_s() -> float:
+    try:
+        return max(1.0, float(os.environ.get(ENV_LEDGER_WINDOW, "300")))
+    except ValueError:
+        return 300.0
+
+
+class TrafficLedger:
+    """Process-local traffic accounting (lock-light; one lock per record)."""
+
+    MAX_KEYS = 4096
+    MAX_CELLS = 4096
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.enabled = _env_enabled()
+        self.window_s = window_s if window_s is not None else _env_window_s()
+        self._lock = threading.Lock()
+        # (peer_host, volume, transport, direction) -> [ops, bytes]
+        self._cells: dict[tuple, list] = {}
+        # Rolling per-key windows: two rotating buckets, key -> [ops, bytes].
+        self._win_started = time.monotonic()
+        self._cur: dict[str, list] = {}
+        self._prev: dict[str, list] = {}
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def record(
+        self,
+        transport: str,
+        direction: str,
+        nbytes: int,
+        peer_host: str = "",
+        volume: str = "",
+        items: Optional[Iterable[tuple]] = None,
+        ops: int = 1,
+        weight: int = 1,
+    ) -> None:
+        """Account one transfer (a whole batch is ONE record). ``items`` is
+        an optional ``[(key, nbytes), ...]`` feed for the per-key rolling
+        window; keys may be None (skipped). A SAMPLED caller (the one-sided
+        path records 1-in-N large batches) passes ``weight=N`` with
+        pre-scaled ``nbytes``/``ops`` so cell totals and window tallies
+        both stay expectation-exact."""
+        if not self.enabled:
+            return
+        cell_key = (peer_host or "", str(volume or ""), transport, direction)
+        with self._lock:
+            cell = self._cells.get(cell_key)
+            if cell is None:
+                if len(self._cells) >= self.MAX_CELLS:
+                    self._cells.clear()  # unbounded peer churn: restart cheap
+                cell = self._cells[cell_key] = [0, 0]
+            cell[0] += ops
+            cell[1] += int(nbytes)
+            if items is not None:
+                self._maybe_rotate_locked()
+                cur = self._cur
+                for key, kbytes in items:
+                    if key is None:
+                        continue
+                    stat = cur.get(key)
+                    if stat is None:
+                        if len(cur) >= self.MAX_KEYS:
+                            continue  # window full: totals still account
+                        stat = cur[key] = [0, 0]
+                    stat[0] += weight
+                    stat[1] += int(kbytes) * weight
+
+    def _maybe_rotate_locked(self) -> None:
+        """Advance the rolling window (caller holds the lock). Run on both
+        writes AND reads: an idle process's snapshot must not keep serving
+        hour-old keys as "hot right now" — after one idle window the stale
+        bucket slides to previous, after two both are dropped."""
+        now = time.monotonic()
+        elapsed = now - self._win_started
+        if elapsed < self.window_s:
+            return
+        if elapsed >= 2 * self.window_s:
+            self._prev = {}
+        else:
+            self._prev = self._cur
+        self._cur = {}
+        self._win_started = now
+
+    def top_keys(self, k: int = 20) -> list[dict]:
+        """Top-K keys by bytes over the last one-to-two rolling windows."""
+        with self._lock:
+            self._maybe_rotate_locked()
+            merged: dict[str, list] = {
+                key: list(stat) for key, stat in self._prev.items()
+            }
+            for key, stat in self._cur.items():
+                agg = merged.get(key)
+                if agg is None:
+                    merged[key] = list(stat)
+                else:
+                    agg[0] += stat[0]
+                    agg[1] += stat[1]
+        items = sorted(merged.items(), key=lambda kv: kv[1][1], reverse=True)
+        return [
+            {"key": key, "ops": stat[0], "bytes": stat[1]}
+            for key, stat in items[:k]
+        ]
+
+    def snapshot(self) -> dict:
+        """JSON-serializable ledger view (rides ``stats()`` endpoints and
+        ``ts.fleet_snapshot()["ledgers"]``)."""
+        with self._lock:
+            cells = [
+                {
+                    "peer_host": peer_host,
+                    "volume": volume,
+                    "transport": transport,
+                    "direction": direction,
+                    "ops": cell[0],
+                    "bytes": cell[1],
+                }
+                for (peer_host, volume, transport, direction), cell
+                in self._cells.items()
+            ]
+        return {
+            "host": _hostname(),
+            "pid": os.getpid(),
+            "window_s": self.window_s,
+            "cells": cells,
+            "keys": self.top_keys(20),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells.clear()
+            self._cur.clear()
+            self._prev.clear()
+            self._win_started = time.monotonic()
+
+
+_ledger = TrafficLedger()
+
+
+def ledger() -> TrafficLedger:
+    return _ledger
+
+
+def record(
+    transport: str,
+    direction: str,
+    nbytes: int,
+    peer_host: str = "",
+    volume: str = "",
+    items: Optional[Iterable[tuple]] = None,
+    ops: int = 1,
+    weight: int = 1,
+) -> None:
+    """Module-level convenience over the process singleton."""
+    _ledger.record(
+        transport,
+        direction,
+        nbytes,
+        peer_host=peer_host,
+        volume=volume,
+        items=items,
+        ops=ops,
+        weight=weight,
+    )
+
+
+def snapshot() -> dict:
+    return _ledger.snapshot()
+
+
+def reset_ledger() -> None:
+    _ledger.reset()
+
+
+def traffic_matrix(ledgers: dict[str, dict]) -> dict:
+    """Fold fleet-collected ledger snapshots into the placement solver's
+    input. ``ledgers`` maps a process label (``"client"``,
+    ``"volume:<vid>"``, ...) to that process's :func:`snapshot`.
+
+    Every transfer is counted exactly once: only PEER-AWARE cells (the
+    recording side knew both endpoints — client-side choke points, which
+    see every put, get, one-sided read, and doorbell) contribute edges;
+    peer-less volume-side cells are reported under ``"unattributed"`` so
+    their bytes are visible but never double-counted against the client's
+    view of the same transfer.
+
+    Returns ``{"edges": {src_host: {dst_host: {"bytes", "ops"}}},
+    "egress": {host: bytes}, "ingress": {host: bytes},
+    "volumes": {volume_id: {"bytes_in", "bytes_out"}},
+    "unattributed": {host: {"bytes_in", "bytes_out"}}}``."""
+    edges: dict[str, dict[str, dict]] = {}
+    egress: dict[str, int] = {}
+    ingress: dict[str, int] = {}
+    volumes: dict[str, dict] = {}
+    unattributed: dict[str, dict] = {}
+
+    def _edge(src: str, dst: str, nbytes: int, ops: int) -> None:
+        cell = edges.setdefault(src, {}).setdefault(
+            dst, {"bytes": 0, "ops": 0}
+        )
+        cell["bytes"] += nbytes
+        cell["ops"] += ops
+        egress[src] = egress.get(src, 0) + nbytes
+        ingress[dst] = ingress.get(dst, 0) + nbytes
+
+    for snap in ledgers.values():
+        host = snap.get("host", "")
+        for cell in snap.get("cells", ()):
+            nbytes = int(cell.get("bytes", 0))
+            ops = int(cell.get("ops", 0))
+            peer = cell.get("peer_host") or ""
+            direction = cell.get("direction")
+            vid = cell.get("volume") or ""
+            if vid and peer:
+                # Per-volume totals from peer-aware cells ONLY (same
+                # count-once rule as the edges): an RPC get is recorded
+                # both client-side (peer-aware) and volume-side (peer-less)
+                # — counting both would double the volume's served bytes.
+                vol = volumes.setdefault(
+                    vid, {"bytes_in": 0, "bytes_out": 0}
+                )
+                if direction == EGRESS:
+                    vol["bytes_in"] += nbytes  # this process sent TO it
+                else:
+                    vol["bytes_out"] += nbytes  # it served this process
+            if peer:
+                if direction == EGRESS:
+                    _edge(host, peer, nbytes, ops)
+                else:
+                    _edge(peer, host, nbytes, ops)
+            else:
+                un = unattributed.setdefault(
+                    host, {"bytes_in": 0, "bytes_out": 0}
+                )
+                un["bytes_out" if direction == EGRESS else "bytes_in"] += (
+                    nbytes
+                )
+    return {
+        "edges": edges,
+        "egress": egress,
+        "ingress": ingress,
+        "volumes": volumes,
+        "unattributed": unattributed,
+    }

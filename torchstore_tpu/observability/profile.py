@@ -68,6 +68,25 @@ class HotKeyTracker:
             stat[0] += 1
             stat[1] += int(nbytes)
 
+    def record_many(self, items, weight: int = 1) -> None:
+        """Batch tally: ``[(key, nbytes), ...]`` under ONE lock acquisition
+        — the zero-RPC one-sided read path records thousands of keys per
+        warm batch, and a per-key lock round trip there would be the
+        single biggest telemetry cost (bench ``ledger_overhead``).
+        ``weight`` scales a SAMPLED feed back to expectation (the one-sided
+        accounting records 1-in-N large batches at weight N)."""
+        with self._lock:
+            keys = self._keys
+            for key, nbytes in items:
+                stat = keys.get(key)
+                if stat is None:
+                    if len(keys) >= self.MAX_KEYS:
+                        self._evict_cold_locked()
+                        keys = self._keys
+                    stat = keys[key] = [0, 0]
+                stat[0] += weight
+                stat[1] += int(nbytes) * weight
+
     def _evict_cold_locked(self) -> None:
         # Keep the hottest half by bytes (ops as tiebreak): the keys an
         # operator would ask about survive churn from one-shot keys.
@@ -93,19 +112,30 @@ class HotKeyTracker:
 
 
 _tracker = HotKeyTracker()
+# Labeled tracker for zero-RPC stamped reads (the PR-7 profiler blind
+# spot): one-sided serves never touch a volume, so no volume's data-plane
+# ``stats()["hot_keys"]`` can ever see them — and folding them into the
+# client's LOGICAL tally would double-count (every logical get already
+# records there). A separate labeled view keeps placement data complete
+# without inflating either.
+_one_sided_tracker = HotKeyTracker()
 
 
-def hot_key_tracker() -> HotKeyTracker:
-    return _tracker
+def hot_key_tracker(source: str = "ops") -> HotKeyTracker:
+    return _one_sided_tracker if source == "one_sided" else _tracker
 
 
-def hot_keys(k: int = 10, by: str = "bytes") -> list[dict]:
-    """This process's top-K keys (``[{"key", "ops", "bytes"}, ...]``)."""
-    return _tracker.top(k, by=by)
+def hot_keys(k: int = 10, by: str = "bytes", source: str = "ops") -> list[dict]:
+    """This process's top-K keys (``[{"key", "ops", "bytes"}, ...]``).
+    ``source="one_sided"`` returns the zero-RPC stamped-read view (bytes
+    served without any volume involvement — invisible to every volume's
+    own hot-key tally)."""
+    return hot_key_tracker(source).top(k, by=by)
 
 
 def reset_hot_keys() -> None:
     _tracker.reset()
+    _one_sided_tracker.reset()
 
 
 def record_op(

@@ -1,0 +1,227 @@
+"""Flight recorder: an always-on, bounded ring of recent ops/spans/faults.
+
+"test_repair flaked" usually means five seconds of fleet history nobody
+recorded: which ops were in flight, which faults fired, which volume went
+quiet first. Each store process keeps a bounded ring buffer
+(``collections.deque(maxlen=...)`` — appends are O(1), atomic under the
+GIL, no lock on the hot path) of recent events:
+
+    op          completed logical client ops (op, keys, bytes, ms)
+    transfer    transport-level moves (transport, volume, direction, bytes)
+    volume_op   volume-side put/get serves
+    fault       every faultpoint firing (point, action)
+    error       failures worth a post-mortem line (op errors, fallbacks)
+    stream      streamed-sync lifecycle (begin/restart/seal/ack)
+    health      supervisor transitions (quarantine/probation/reinstate)
+    slo         SLO threshold breaches
+
+**Auto-dump**: on quarantine (controller, MERGED with every reachable
+volume's ring), on ``ts.repair()``, on a wedged/mixed stream (acquire
+exhausts its retries), on a ``die``-action fault (the ring is flushed in
+the doomed process before ``os._exit``), and — via :func:`arm_exit_dump` —
+at interpreter exit IF the ring recorded errors/faults since the last dump
+(an unclean exit leaves its last seconds on disk; a clean one writes
+nothing). Dumps are atomic whole-file JSON under
+``TORCHSTORE_TPU_FLIGHT_DIR`` (default ``<tmpdir>/torchstore_tpu_flight``),
+one file per (trigger, pid) so repeats overwrite instead of accumulating.
+
+**On demand**: ``ts.flight_record()`` merges the local ring with the
+controller's and every reachable volume's (``flight_record`` endpoints)
+into one time-sorted timeline.
+
+Overhead: one deque append per recorded event; events are recorded per
+BATCH/op, never per key. ``TORCHSTORE_TPU_FLIGHT_RECORDER=0`` disables
+recording entirely; the bench's ``ledger_overhead`` section measures the
+always-on cost.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+from torchstore_tpu.observability import metrics as obs_metrics
+
+ENV_FLIGHT = "TORCHSTORE_TPU_FLIGHT_RECORDER"
+ENV_FLIGHT_EVENTS = "TORCHSTORE_TPU_FLIGHT_EVENTS"
+ENV_FLIGHT_DIR = "TORCHSTORE_TPU_FLIGHT_DIR"
+
+# Event kinds a post-mortem exists for: their presence since the last dump
+# makes an interpreter exit "unclean" (arm_exit_dump writes the ring).
+ALERT_KINDS = frozenset({"fault", "error", "health", "slo"})
+
+_DUMPS = obs_metrics.counter(
+    "ts_flight_dumps_total", "Flight-recorder post-mortems written, by reason"
+)
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_FLIGHT, "1").strip().lower() not in (
+        "0", "false", "no", "off", "",
+    )
+
+
+def _env_events() -> int:
+    try:
+        return max(64, int(os.environ.get(ENV_FLIGHT_EVENTS, "4096")))
+    except ValueError:
+        return 4096
+
+
+def flight_dir() -> str:
+    return os.environ.get(ENV_FLIGHT_DIR) or os.path.join(
+        tempfile.gettempdir(), "torchstore_tpu_flight"
+    )
+
+
+class FlightRecorder:
+    """Bounded per-process event ring. ``record`` is the hot path: build a
+    small tuple, append to a deque — no lock (GIL-atomic), no I/O."""
+
+    def __init__(self, maxlen: Optional[int] = None) -> None:
+        self.enabled = _env_enabled()
+        self._ring: collections.deque = collections.deque(
+            maxlen=maxlen or _env_events()
+        )
+        # Alert events recorded since the last dump (drives the unclean-
+        # exit heuristic); plain int updates are GIL-atomic enough for a
+        # heuristic counter.
+        self._alerts_since_dump = 0
+        self._exit_armed = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def record(self, kind: str, name: str, **detail: Any) -> None:
+        if not self.enabled:
+            return
+        self._ring.append((time.time(), kind, name, detail or None))
+        if kind in ALERT_KINDS:
+            self._alerts_since_dump += 1
+
+    def snapshot(self) -> list[dict]:
+        """The ring as JSON-serializable events, oldest first."""
+        pid = os.getpid()
+        return [
+            {
+                "ts": ts,
+                "pid": pid,
+                "kind": kind,
+                "name": name,
+                **({"detail": detail} if detail else {}),
+            }
+            for ts, kind, name, detail in list(self._ring)
+        ]
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self._alerts_since_dump = 0
+
+    def dump(
+        self, trigger: str, extra_events: Optional[list[dict]] = None
+    ) -> Optional[str]:
+        """Write an atomic post-mortem JSON (this process's ring plus any
+        ``extra_events`` a merging caller collected) and return its path;
+        None when recording is disabled, the ring is empty, or the write
+        fails (a post-mortem must never take the process down with it)."""
+        if not self.enabled:
+            return None
+        events = self.snapshot() + list(extra_events or ())
+        if not events:
+            return None
+        events.sort(key=lambda e: e.get("ts") or 0)
+        reason = trigger.split(":", 1)[0]
+        safe = "".join(
+            ch if ch.isalnum() or ch in "-_" else "_" for ch in trigger
+        )[:80]
+        path = os.path.join(
+            flight_dir(), f"flight_{safe}_{os.getpid()}.json"
+        )
+        payload = {
+            "trigger": trigger,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "host": (
+                os.environ.get("TORCHSTORE_TPU_HOSTNAME")
+                or socket.gethostname()
+            ),
+            "events": events,
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(flight_dir(), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self._alerts_since_dump = 0
+        _DUMPS.inc(reason=reason)
+        from torchstore_tpu.logging import get_logger
+
+        get_logger("torchstore_tpu.observability").warning(
+            "flight recorder post-mortem (%s): %d event(s) -> %s",
+            trigger,
+            len(events),
+            path,
+        )
+        return path
+
+    def arm_exit_dump(self) -> None:
+        """Register an atexit hook that dumps the ring IF alert events
+        (faults/errors/health/slo) were recorded since the last dump — an
+        unclean exit leaves its last seconds on disk, a clean one writes
+        nothing. Idempotent per process."""
+        if self._exit_armed:
+            return
+        self._exit_armed = True
+        import atexit
+
+        def _maybe_dump() -> None:
+            if self._alerts_since_dump:
+                self.dump("unclean_exit")
+
+        atexit.register(_maybe_dump)
+
+
+_recorder = FlightRecorder()
+_reinit_lock = threading.Lock()  # tslint: disable=fork-safety
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, name: str, **detail: Any) -> None:
+    """Module-level convenience over the process singleton."""
+    _recorder.record(kind, name, **detail)
+
+
+def snapshot() -> list[dict]:
+    return _recorder.snapshot()
+
+
+def dump_postmortem(
+    trigger: str, extra_events: Optional[list[dict]] = None
+) -> Optional[str]:
+    return _recorder.dump(trigger, extra_events)
+
+
+def reset_recorder() -> None:
+    _recorder.clear()
+
+
+def reinit_after_fork() -> None:
+    """Forked actor children inherit the parent ring's copied events and a
+    possibly stale enabled flag: start the child's history fresh from its
+    corrected env."""
+    with _reinit_lock:
+        _recorder.clear()
+        _recorder.enabled = _env_enabled()
+        _recorder._exit_armed = False

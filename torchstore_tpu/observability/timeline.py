@@ -1,0 +1,271 @@
+"""Sync-timeline telemetry: rolling op quantiles, SLO thresholds, and
+weight-sync generation reconstruction.
+
+Three facilities that turn the bench-only numbers (``overlap_ratio``,
+``first_token``) and the fixed-bucket op histograms into production
+signals:
+
+- **Rolling quantile digests** (:class:`OpQuantiles`): per-op ring of the
+  last ``WINDOW`` wall times with true p50/p99 published as gauges
+  (``ts_op_p50_seconds`` / ``ts_op_p99_seconds``, labeled ``op=``). The
+  fixed-bucket histograms stay (Prometheus-aggregatable); the digests add
+  the exact quantiles an SLO needs, refreshed lazily (every
+  ``REFRESH_EVERY`` observations) so the hot path pays one deque append.
+
+- **SLO thresholds** (``TORCHSTORE_TPU_SLO_*``): a typed family of
+  operator-set bars. On breach the violation is logged (rate-limited per
+  SLO) and counted in ``ts_slo_violations_total{slo=...}``. Shipped knobs:
+
+      TORCHSTORE_TPU_SLO_PUT_P99_MS      rolling put p99 above this
+      TORCHSTORE_TPU_SLO_GET_P99_MS      rolling get p99 above this
+      TORCHSTORE_TPU_SLO_VERSION_LAG     subscriber version lag above this
+      TORCHSTORE_TPU_SLO_FIRST_LAYER_MS  stream first-layer latency above
+      TORCHSTORE_TPU_SLO_OVERLAP_MIN     stream overlap ratio BELOW this
+
+  Unset = disabled; thresholds are re-read per check (one getenv) so live
+  operators can retune a running fleet.
+
+- **Generation reconstruction** (:func:`reconstruct`): folds a controller
+  stream record (now timestamped — ``stream_begin`` -> per-key watermark
+  landings -> ``stream_seal`` -> per-subscriber acquire acks) into one
+  readable lifecycle: publish window, first-layer latency, landing
+  timeline, and per-subscriber completion lag. ``ts.sync_timeline(key)``
+  is the public entry point.
+
+Live gauges the acquire side maintains (stream_sync.py): per-subscriber
+``ts_stream_overlap_ratio`` / ``ts_stream_first_layer_seconds`` — the
+production twins of the bench's ``overlap_ratio`` / ``first_token``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from torchstore_tpu.observability import metrics as obs_metrics
+
+# The blessed SLO knob family. Names are read via these literals (the
+# env-registry lint cross-references them against config.ENV_REGISTRY);
+# anything else under the TORCHSTORE_TPU_SLO_ prefix is accepted as an
+# operator extension (registered dynamic prefix family).
+SLO_PUT_P99_MS = "TORCHSTORE_TPU_SLO_PUT_P99_MS"
+SLO_GET_P99_MS = "TORCHSTORE_TPU_SLO_GET_P99_MS"
+SLO_VERSION_LAG = "TORCHSTORE_TPU_SLO_VERSION_LAG"
+SLO_FIRST_LAYER_MS = "TORCHSTORE_TPU_SLO_FIRST_LAYER_MS"
+SLO_OVERLAP_MIN = "TORCHSTORE_TPU_SLO_OVERLAP_MIN"
+
+_SLO_VIOLATIONS = obs_metrics.counter(
+    "ts_slo_violations_total",
+    "SLO threshold breaches (TORCHSTORE_TPU_SLO_* family), by slo",
+)
+_P50 = obs_metrics.gauge(
+    "ts_op_p50_seconds", "Rolling-window p50 wall time, by op"
+)
+_P99 = obs_metrics.gauge(
+    "ts_op_p99_seconds", "Rolling-window p99 wall time, by op"
+)
+
+
+def slo_threshold(env_name: str) -> Optional[float]:
+    """The configured threshold, or None when unset/disabled. Read per
+    check (not cached) so a live operator can retune a running process."""
+    raw = os.environ.get(env_name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+# Rate-limit state for SLO breach logs: slo name -> last log monotonic.
+# Inherited pre-fork contents only delay a child's first breach log by one
+# window — no correctness or resource impact, so no fork hook is needed.
+_last_slo_log: dict[str, float] = {}  # tslint: disable=fork-safety
+_SLO_LOG_EVERY_S = 5.0
+
+
+def check_slo(
+    env_name: str,
+    value: float,
+    worse: str = "above",
+    **context,
+) -> bool:
+    """Check ``value`` against the env-configured threshold; on breach,
+    bump ``ts_slo_violations_total{slo=...}`` and log (rate-limited).
+    ``worse="above"`` breaches when value > threshold; ``"below"`` when
+    value < threshold (e.g. overlap ratio). Returns whether it breached."""
+    threshold = slo_threshold(env_name)
+    if threshold is None:
+        return False
+    breached = value > threshold if worse == "above" else value < threshold
+    if not breached:
+        return False
+    slo = env_name.rsplit("TORCHSTORE_TPU_SLO_", 1)[-1].lower()
+    _SLO_VIOLATIONS.inc(slo=slo)
+    now = time.monotonic()
+    if now - _last_slo_log.get(slo, 0.0) >= _SLO_LOG_EVERY_S:
+        _last_slo_log[slo] = now
+        from torchstore_tpu.logging import get_logger
+
+        get_logger("torchstore_tpu.observability").warning(
+            "SLO violation: %s=%.4g %s threshold %.4g%s",
+            slo,
+            value,
+            "above" if worse == "above" else "below",
+            threshold,
+            f" ({context})" if context else "",
+        )
+    from torchstore_tpu.observability import recorder as obs_recorder
+
+    obs_recorder.record(
+        "slo", slo, value=round(float(value), 6), threshold=threshold
+    )
+    return True
+
+
+class OpQuantiles:
+    """Rolling per-op quantile digest: a bounded deque of recent wall
+    times; p50/p99 gauges refreshed every REFRESH_EVERY observations (one
+    sort of <= WINDOW samples, off the per-op critical path rhythm)."""
+
+    WINDOW = 512
+    REFRESH_EVERY = 32
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples: dict[str, collections.deque] = {}
+        self._pending: dict[str, int] = {}
+
+    def observe(self, op: str, dur_s: float) -> None:
+        with self._lock:
+            ring = self._samples.get(op)
+            if ring is None:
+                ring = self._samples[op] = collections.deque(
+                    maxlen=self.WINDOW
+                )
+            ring.append(dur_s)
+            pending = self._pending.get(op, 0) + 1
+            if pending < self.REFRESH_EVERY and len(ring) != 1:
+                self._pending[op] = pending
+                return
+            self._pending[op] = 0
+            ordered = sorted(ring)
+        p50 = ordered[len(ordered) // 2]
+        p99 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+        _P50.set(p50, op=op)
+        _P99.set(p99, op=op)
+        if op == "put":
+            check_slo(SLO_PUT_P99_MS, p99 * 1e3, op=op)
+        elif op == "get":
+            check_slo(SLO_GET_P99_MS, p99 * 1e3, op=op)
+
+    def quantiles(self, op: str, qs=(0.5, 0.99)) -> Optional[dict]:
+        with self._lock:
+            ring = self._samples.get(op)
+            if not ring:
+                return None
+            ordered = sorted(ring)
+        return {
+            repr(q): ordered[min(len(ordered) - 1, int(len(ordered) * q))]
+            for q in qs
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ops = list(self._samples)
+        return {op: self.quantiles(op) for op in ops}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._pending.clear()
+
+
+_quantiles = OpQuantiles()
+
+
+def op_quantiles() -> OpQuantiles:
+    return _quantiles
+
+
+def observe_op(op: str, dur_s: float) -> None:
+    """Feed one completed logical op into the rolling digests (and their
+    p99 SLO checks). Called from the client's op completion path."""
+    _quantiles.observe(op, dur_s)
+
+
+# --------------------------------------------------------------------------
+# generation reconstruction (controller stream records -> lifecycle)
+# --------------------------------------------------------------------------
+
+
+def reconstruct(state: Optional[dict]) -> Optional[dict]:
+    """Fold a timestamped controller stream record (``stream_state``) into
+    one generation lifecycle:
+
+    ``{"version", "sealed", "begin_ts", "seal_ts", "publish_window_s",
+    "first_layer_s", "landings": [{"key", "ts", "offset_s"}, ...],
+    "subscribers": {sub: {"version", "ts", "completion_s"}}}``
+
+    ``offset_s``/``completion_s`` are relative to ``begin_ts``. Returns
+    None for a missing record; fields are None when the record predates
+    the timestamping (controller upgrade mid-run)."""
+    if state is None:
+        return None
+    begin_ts = state.get("begin_ts")
+    seal_ts = state.get("seal_ts")
+    landing_ts: dict = state.get("landing_ts") or {}
+    landings = [
+        {
+            "key": key,
+            "ts": ts,
+            "offset_s": (
+                round(ts - begin_ts, 6) if begin_ts is not None else None
+            ),
+        }
+        for key, ts in sorted(landing_ts.items(), key=lambda kv: kv[1])
+    ]
+    first_layer_s = (
+        round(landings[0]["ts"] - begin_ts, 6)
+        if landings and begin_ts is not None
+        else None
+    )
+    subscribers = {
+        sub: {
+            "version": ack.get("version"),
+            "ts": ack.get("ts"),
+            "completion_s": (
+                round(ack["ts"] - begin_ts, 6)
+                if begin_ts is not None and ack.get("ts") is not None
+                else None
+            ),
+        }
+        for sub, ack in (state.get("acks") or {}).items()
+    }
+    return {
+        "version": state.get("version"),
+        "sealed": state.get("sealed"),
+        "begin_ts": begin_ts,
+        "seal_ts": seal_ts,
+        "publish_window_s": (
+            round(seal_ts - begin_ts, 6)
+            if begin_ts is not None and seal_ts is not None
+            else None
+        ),
+        "first_layer_s": first_layer_s,
+        "landings": landings,
+        "subscribers": subscribers,
+    }
+
+
+def subscriber_id() -> str:
+    """This process's identity in stream acquire acks (bounded: one entry
+    per process per stream record)."""
+    import socket as _socket
+
+    host = os.environ.get("TORCHSTORE_TPU_HOSTNAME") or _socket.gethostname()
+    return f"{host}:{os.getpid()}"

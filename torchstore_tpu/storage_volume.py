@@ -24,8 +24,10 @@ import numpy as np
 
 from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
+from torchstore_tpu.observability import recorder as obs_recorder
 from torchstore_tpu.runtime import Actor, endpoint
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
@@ -301,6 +303,9 @@ class StorageVolume(Actor):
         # One-sided cross-host gets: doorbell frames on the bulk socket read
         # this volume's store directly (same process, no RPC dispatch).
         self._install_doorbell_hook()
+        # Unclean-exit post-mortem: if this process dies with faults/errors
+        # in its flight ring, the last seconds land on disk at exit.
+        obs_recorder.recorder().arm_exit_dump()
 
     def _install_doorbell_hook(self) -> None:
         """Point the bulk server's doorbell at this volume's store. Eager is
@@ -448,11 +453,26 @@ class StorageVolume(Actor):
         # Data-plane profiling: this volume's own hot-key view + slow-op
         # log (the RPC-dispatch trace context is active here, so a slow put
         # annotates the client's trace).
+        items = [(meta.key, self._meta_nbytes(meta)) for meta in metas]
         obs_profile.record_keys(
             "volume_put",
-            [(meta.key, self._meta_nbytes(meta)) for meta in metas],
+            items,
             t0,
             time.perf_counter() - t0,
+        )
+        # Volume-side traffic accounting (peer unknown at this layer: the
+        # client-side choke point owns the attributable matrix edge) + a
+        # flight-recorder breadcrumb for the last-seconds timeline.
+        nbytes = sum(n for _, n in items)
+        obs_ledger.record(
+            getattr(buffer, "transport_name", "unknown"),
+            obs_ledger.INGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=items,
+        )
+        obs_recorder.record(
+            "volume_op", "put", keys=len(metas), nbytes=nbytes
         )
         return {
             "reply": buffer.put_reply(),
@@ -468,20 +488,32 @@ class StorageVolume(Actor):
         entries = [self.store.get_data(meta) for meta in metas]
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
         _GET_OPS.inc(volume=self.volume_id)
+        items = [
+            # Object entries are arbitrary user types: only count an
+            # nbytes attribute that is actually a number (same guard as
+            # the client side).
+            (
+                meta.key,
+                n if isinstance((n := getattr(entry, "nbytes", 0)), int) else 0,
+            )
+            for meta, entry in zip(metas, entries)
+        ]
         obs_profile.record_keys(
             "volume_get",
-            [
-                # Object entries are arbitrary user types: only count an
-                # nbytes attribute that is actually a number (same guard as
-                # the client side).
-                (
-                    meta.key,
-                    n if isinstance((n := getattr(entry, "nbytes", 0)), int) else 0,
-                )
-                for meta, entry in zip(metas, entries)
-            ],
+            items,
             t0,
             time.perf_counter() - t0,
+        )
+        nbytes = sum(n for _, n in items)
+        obs_ledger.record(
+            getattr(buffer, "transport_name", "unknown"),
+            obs_ledger.EGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=items,
+        )
+        obs_recorder.record(
+            "volume_op", "get", keys=len(metas), nbytes=nbytes
         )
         return buffer
 
@@ -740,6 +772,9 @@ class StorageVolume(Actor):
             # Rolling top-K keys by bytes served/stored through THIS volume
             # (ts.fleet_snapshot collects every volume's view).
             "hot_keys": obs_profile.hot_keys(10),
+            # Traffic ledger cells + rolling key windows (decision
+            # telemetry; ts.fleet_snapshot merges them under "ledgers").
+            "ledger": obs_ledger.snapshot(),
         }
         from torchstore_tpu.transport.shared_memory import ShmServerCache
 
@@ -764,6 +799,14 @@ class StorageVolume(Actor):
                 "staged": len(cache.staged),
             }
         return out
+
+    @endpoint
+    async def flight_record(self) -> list:
+        """This volume process's flight-recorder ring (recent ops/faults/
+        errors, oldest first) — ``ts.flight_record()`` merges the fleet's
+        into one timeline, and the controller pulls it when assembling a
+        quarantine post-mortem."""
+        return obs_recorder.snapshot()
 
     @endpoint
     async def reset(self) -> None:

@@ -41,6 +41,8 @@ from typing import Any, Callable, Optional
 from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.utils import maybe_await
 
@@ -66,6 +68,19 @@ _FALLBACKS = obs_metrics.counter(
 _LAG = obs_metrics.gauge(
     "ts_stream_lag_keys",
     "Watermarked-but-unserved keys in this process's streamed acquire",
+)
+# The bench-only numbers turned production signals (ISSUE 10): how much of
+# the publish window this subscriber's acquire overlapped, and how long its
+# first layer took after stream begin — both per completed streamed
+# acquire, SLO-checked against TORCHSTORE_TPU_SLO_OVERLAP_MIN /
+# _SLO_FIRST_LAYER_MS.
+_OVERLAP = obs_metrics.gauge(
+    "ts_stream_overlap_ratio",
+    "Fraction of the publish window the last streamed acquire ran inside",
+)
+_FIRST_LAYER = obs_metrics.gauge(
+    "ts_stream_first_layer_seconds",
+    "Stream begin to this subscriber's first served layer",
 )
 
 
@@ -349,6 +364,13 @@ async def get_state_dict_streamed(
         except _Restart as exc:
             _FALLBACKS.inc(reason=exc.reason)
             _LAG.set(0)
+            obs_recorder.record(
+                "error",
+                "stream_restart",
+                key=key,
+                version=target,
+                reason=exc.reason,
+            )
             logger.warning(
                 "streamed acquire of %r v%d restarting (%s; attempt %d/%d)",
                 key,
@@ -372,6 +394,11 @@ async def get_state_dict_streamed(
                     client, key, user_state_dict, strict=strict
                 )
             continue
+    # A wedged/mixed stream is a postmortem-grade event: flush the flight
+    # ring before surfacing so "what happened in the last five seconds"
+    # is on disk even if the caller dies on the raise.
+    obs_recorder.record("error", "stream_wedged", key=key)
+    obs_recorder.dump_postmortem("wedged_stream")
     raise MixedGenerationError(
         f"streamed acquire of {key!r} could not complete a consistent "
         f"single-generation serve in {retries + 1} attempts (publishers "
@@ -414,8 +441,10 @@ async def _acquire_stream(
     known = 0
     sealed = False
     poll = max(0.1, float(config.stream_poll_s))
+    first_serve_ts: Optional[float] = None
 
     async def serve(sks: list[str]) -> None:
+        nonlocal first_serve_ts
         if user_flat is not None:
             sks = [sk for sk in sks if sk in flat_of]
         if not sks:
@@ -423,6 +452,8 @@ async def _acquire_stream(
         fetched = await client.get_batch(
             {sk: targets_of.get(sk) for sk in sks}, _seed_plan=False
         )
+        if first_serve_ts is None:
+            first_serve_ts = time.time()
         for sk in sks:
             fk = flat_of.get(sk, sk[prefix_len:])
             served[fk] = fetched[sk]
@@ -553,4 +584,49 @@ async def _acquire_stream(
         )
     _LAG.set(0)
     _ACQUIRES.inc()
+    _publish_acquire_telemetry(state2, first_serve_ts, time.time())
+    obs_recorder.record(
+        "stream", "acquire", key=key, version=target, layers=len(served_sks)
+    )
+    try:
+        # Per-subscriber completion on the controller's generation
+        # timeline (ts.sync_timeline). Advisory: telemetry, not protocol.
+        await client.stream_ack(key, target, obs_timeline.subscriber_id())
+    except Exception:  # noqa: BLE001 - a lost ack must not fail the serve
+        pass
     return result
+
+
+def _publish_acquire_telemetry(
+    state: Optional[dict],
+    first_serve_ts: Optional[float],
+    done_ts: float,
+) -> None:
+    """Turn one completed streamed acquire into the live production gauges
+    + SLO checks: first-layer latency (stream begin -> this subscriber's
+    first served layer) and overlap ratio (fraction of the publish window
+    the acquire ran inside — the bench's ``overlap_ratio``, live).
+    Timestamps come from the controller's stream record (wall clock; skew
+    is a cross-host caveat, exact on the same host)."""
+    if state is None or first_serve_ts is None:
+        return
+    begin_ts = state.get("begin_ts")
+    seal_ts = state.get("seal_ts")
+    if begin_ts is None:
+        return
+    first_layer_s = max(0.0, first_serve_ts - begin_ts)
+    _FIRST_LAYER.set(first_layer_s)
+    obs_timeline.check_slo(
+        obs_timeline.SLO_FIRST_LAYER_MS, first_layer_s * 1e3
+    )
+    if seal_ts is None or seal_ts <= begin_ts:
+        return
+    window = seal_ts - begin_ts
+    overlap = max(
+        0.0, min(seal_ts, done_ts) - max(begin_ts, first_serve_ts)
+    )
+    ratio = min(1.0, overlap / window)
+    _OVERLAP.set(ratio)
+    obs_timeline.check_slo(
+        obs_timeline.SLO_OVERLAP_MIN, ratio, worse="below"
+    )

@@ -27,7 +27,9 @@ from typing import TYPE_CHECKING, Any, Optional
 import numpy as np
 
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
 from torchstore_tpu.observability import tracing
 from torchstore_tpu.transport.types import Request
 from torchstore_tpu.utils import maybe_await
@@ -176,8 +178,35 @@ class TransportBuffer(ABC):
             _OP_SECONDS.observe(
                 time.perf_counter() - t0, transport=self.transport_name, op="put"
             )
-        except BaseException:
+            # Traffic ledger + flight recorder (decision telemetry): the
+            # client side of every put knows BOTH endpoints, so this is the
+            # count-once choke point the traffic matrix is built from.
+            # The enabled check lives HERE (not just inside record) so a
+            # disabled ledger skips even the per-key items build.
+            if obs_ledger.ledger().enabled:
+                obs_ledger.record(
+                    self.transport_name,
+                    obs_ledger.EGRESS,
+                    nbytes,
+                    peer_host=volume.hostname or "",
+                    volume=volume.volume_id,
+                    items=[(r.key, r.nbytes) for r in requests],
+                )
+            obs_recorder.record(
+                "transfer",
+                f"put/{self.transport_name}",
+                volume=volume.volume_id,
+                keys=len(requests),
+                nbytes=nbytes,
+            )
+        except BaseException as exc:
             _ERRORS.inc(transport=self.transport_name, op="put")
+            obs_recorder.record(
+                "error",
+                f"put/{self.transport_name}",
+                volume=volume.volume_id,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
             raise
         finally:
             self.drop()
@@ -214,9 +243,39 @@ class TransportBuffer(ABC):
             _OP_SECONDS.observe(
                 time.perf_counter() - t0, transport=self.transport_name, op="get"
             )
+            if obs_ledger.ledger().enabled:
+                obs_ledger.record(
+                    self.transport_name,
+                    obs_ledger.INGRESS,
+                    nbytes,
+                    peer_host=volume.hostname or "",
+                    volume=volume.volume_id,
+                    items=[
+                        (
+                            m.key,
+                            m.tensor_meta.nbytes
+                            if m.tensor_meta is not None
+                            else 0,
+                        )
+                        for m in metas
+                    ],
+                )
+            obs_recorder.record(
+                "transfer",
+                f"get/{self.transport_name}",
+                volume=volume.volume_id,
+                keys=len(requests),
+                nbytes=nbytes,
+            )
             return results
-        except BaseException:
+        except BaseException as exc:
             _ERRORS.inc(transport=self.transport_name, op="get")
+            obs_recorder.record(
+                "error",
+                f"get/{self.transport_name}",
+                volume=volume.volume_id,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
             raise
         finally:
             self.drop()

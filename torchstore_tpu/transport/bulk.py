@@ -42,6 +42,7 @@ from torchstore_tpu import faults
 from torchstore_tpu.config import StoreConfig, _env_int, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import fast_copy
+from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.transport.buffers import (
     TransportBuffer,
@@ -655,6 +656,23 @@ class BulkServer:
             # when landings themselves overlapped each other.
             return await miss(3)
         view = memoryview(packed).cast("B")
+        # Volume-side egress accounting: doorbell serves never pass through
+        # the volume.get endpoint, so without this line the volume's own
+        # ledger would miss its one-sided-served bytes (peer unknown here —
+        # the client-side cell carries the attributable edge).
+        if obs_ledger.ledger().enabled:
+            obs_ledger.record(
+                "bulk",
+                obs_ledger.EGRESS,
+                view.nbytes,
+                volume=str(getattr(vol, "volume_id", "")),
+                items=[
+                    (meta.key, expect.nbytes)
+                    for meta, expect in zip(
+                        plan["metas"], plan["serve_metas"]
+                    )
+                ],
+            )
         if len(conns) > 1 and view.nbytes > STRIPE_THRESHOLD:
             # Multi-GB packed reply: stripe contiguous chunks round-robin
             # over every connection the client opened for this session
@@ -1309,6 +1327,22 @@ class BulkTransportBuffer(TransportBuffer):
                 results.append(arr)
         await landing.land_async(pairs, stage="doorbell", config=self.config)
         ONE_SIDED_READS.inc(len(results), transport="bulk")
+        # Doorbell serves bypass the transport-buffer choke point: account
+        # them here (the client knows both endpoints, so this cell feeds
+        # the traffic matrix exactly like an RPC get would). Enabled check
+        # outside so a disabled ledger skips the items build too.
+        if obs_ledger.ledger().enabled:
+            obs_ledger.record(
+                "bulk",
+                obs_ledger.INGRESS,
+                int(entry["total"]),
+                peer_host=volume.hostname or "",
+                volume=volume.volume_id,
+                items=[
+                    (req.key, meta.nbytes)
+                    for req, meta in zip(requests, entry["metas"])
+                ],
+            )
         return results
 
     async def _perform_handshake(self, volume, requests, op) -> None:

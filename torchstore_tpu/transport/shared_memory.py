@@ -70,7 +70,9 @@ import numpy as np
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import copy_into, fast_copy
+from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import profile as obs_profile
 from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.transport import landing
 from torchstore_tpu.transport.buffers import (
@@ -1093,6 +1095,12 @@ class ShmClientCache(TransportCache):
             self.one_sided.clear()
         meta = desc.meta
         self.one_sided[(req.key, slice_sig(req.tensor_slice))] = {
+            # The store key rides the plan so zero-RPC serves can feed the
+            # hot-key profiler and the traffic ledger — without it the
+            # warmest keys would be invisible to placement telemetry
+            # (the PR-7 blind spot: stamped reads never reach any volume's
+            # stats()["hot_keys"]).
+            "key": req.key,
             "volume_id": volume_id,
             "segment": desc.segment_name,
             "segment_size": desc.segment_size,
@@ -1362,6 +1370,64 @@ def segment_read_view(
     return seg.strided_view(meta, offset, strides)
 
 
+# One-sided accounting sample policy: batches above _ACCOUNT_EXACT_MAX
+# plans record 1-in-_ACCOUNT_SAMPLE at weight _ACCOUNT_SAMPLE (the warm
+# many-keys leg is the store's hottest per-key path — full per-key
+# accounting there costs ~10x the <=2% telemetry budget, and a steady
+# consumer repeats the same batch so the weighted sample converges to the
+# exact totals). Small batches (p50 1KB gets, layer serves) stay exact.
+_ACCOUNT_SAMPLE = 8
+_ACCOUNT_EXACT_MAX = 64
+_account_tick = 0
+
+
+def _account_one_sided(plans: list[dict]) -> None:
+    """Decision telemetry for zero-RPC serves (the PR-7 blind spot fix):
+    stamped reads never touch a volume, so without this the warmest keys of
+    a warm working set are invisible to every ``hot_keys`` view and the
+    traffic ledger under-counts exactly the path placement decisions care
+    about most. One batched tally (single lock) per accounted batch; keys
+    ride the plan dicts (plans recorded before the field existed are
+    skipped). Large batches are weight-scaled samples — see
+    ``_ACCOUNT_SAMPLE`` above."""
+    global _account_tick
+    weight = 1
+    if len(plans) > _ACCOUNT_EXACT_MAX:
+        _account_tick += 1
+        if _account_tick % _ACCOUNT_SAMPLE:
+            return
+        weight = _ACCOUNT_SAMPLE
+    ledger = obs_ledger.ledger()
+    if not ledger.enabled:
+        return
+    items: list[tuple] = []
+    by_volume: dict[str, list] = {}
+    for plan in plans:
+        key = plan.get("key")
+        if key is None:
+            continue
+        item = (key, plan["nbytes"])
+        items.append(item)
+        by_volume.setdefault(str(plan.get("volume_id", "")), []).append(item)
+    if not items:
+        return
+    obs_profile.hot_key_tracker("one_sided").record_many(
+        items, weight=weight
+    )
+    host = obs_ledger.local_host()
+    for vid, vitems in by_volume.items():
+        ledger.record(
+            "one_sided",
+            obs_ledger.INGRESS,
+            sum(n for _, n in vitems) * weight,
+            peer_host=host,  # same-host by construction
+            volume=vid,
+            items=vitems,
+            ops=weight,
+            weight=weight,
+        )
+
+
 def stamped_read(
     cache: "ShmClientCache",
     plan: dict,
@@ -1396,6 +1462,7 @@ def stamped_read(
         view = src.view()
         view.flags.writeable = False
         ONE_SIDED_READS.inc(transport="shm")
+        _account_one_sided([plan])
         return view, recheck
     if dest is None:
         if plan["nbytes"] > ONE_SIDED_COPY_MAX:
@@ -1414,6 +1481,7 @@ def stamped_read(
         ONE_SIDED_TORN.inc(transport="shm")
         raise OneSidedMiss("torn")
     ONE_SIDED_READS.inc(transport="shm")
+    _account_one_sided([plan])
     return dest, None
 
 
@@ -1541,6 +1609,7 @@ async def stamped_read_batch(
             ONE_SIDED_TORN.inc(transport="shm")
             raise OneSidedMiss("torn")
     ONE_SIDED_READS.inc(len(results), transport="shm")
+    _account_one_sided(plans)
     return results
 
 

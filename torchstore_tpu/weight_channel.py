@@ -27,6 +27,8 @@ from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.observability.tracing import span
 from torchstore_tpu.state_dict_utils import NoMatchingPush
 
@@ -171,6 +173,9 @@ class WeightPublisher:
         self._next_version = version + 1
         _PUBLISHES.inc(channel=self.name)
         _PUBLISHED_VERSION.set(version, channel=self.name)
+        obs_recorder.record(
+            "stream", "publish", channel=self.name, version=version
+        )
 
     async def _reclaim_partials(self, client, current: int) -> None:
         """Delete every version directory BEYOND the committed pointer
@@ -418,6 +423,11 @@ class WeightSubscriber:
                     _VERSION_LAG.set(max(0, skipped), channel=self.name)
                     if skipped > 0:
                         _SKIPPED.inc(skipped, channel=self.name)
+                    obs_timeline.check_slo(
+                        obs_timeline.SLO_VERSION_LAG,
+                        max(0, skipped),
+                        channel=self.name,
+                    )
                 with span(
                     "weight_channel.acquire",
                     channel=self.name,
@@ -495,6 +505,11 @@ class WeightSubscriber:
                 _VERSION_LAG.set(max(0, skipped), channel=self.name)
                 if skipped > 0:
                     _SKIPPED.inc(skipped, channel=self.name)
+                obs_timeline.check_slo(
+                    obs_timeline.SLO_VERSION_LAG,
+                    max(0, skipped),
+                    channel=self.name,
+                )
             with span(
                 "weight_channel.acquire",
                 channel=self.name,
