@@ -67,6 +67,12 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "heal_s": ("lower", 1.0, "rel"),
     "failover_get_s": ("lower", 1.0, "rel"),
     "ledger_overhead_pct": ("lower", 2.0, "abs"),
+    # Broadcast fan-out (ISSUE 11). The egress ratio is deterministic at a
+    # given K (1/K when every layer rides the tree), so even a small
+    # absolute drift means relay hops leaked reads back to the origin; the
+    # deep-hop overlap is timing-derived and budgeted like overlap_ratio.
+    "fanout_egress_ratio": ("lower", 0.10, "abs"),
+    "fanout_overlap_ratio": ("higher", 0.35, "rel"),
 }
 
 

@@ -254,3 +254,31 @@ async def test_bench_ledger_overhead_section_tiny():
     assert obs_ledger.ledger().enabled
     assert obs_recorder.recorder().enabled
     json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_fanout_section_tiny():
+    """The fanout section standalone (``bench.py --fanout``) at KB scale:
+    a real K-fleet broadcast against real per-"host" volumes, both legs
+    measured from the traffic matrix — the ISSUE-11 acceptance bound
+    (tree/p2p trainer-host egress <= 1.5/K) and the deep-hop overlap
+    (first layers before the seal through >= 2 relay hops) can never
+    ship broken."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.fanout_section(
+        k_fleets=4, n_layers=4, layer_kb=16, train_ms=40.0
+    )
+    assert out["p2p_trainer_egress_mb"] > 0
+    assert out["fanout_egress_ratio"] is not None
+    # O(1) trainer-host egress: the acceptance bound, not just a trend.
+    assert out["fanout_egress_ratio"] <= out["egress_bound"], out
+    # The deepest fleet sits >= 2 relay hops from the origin and still
+    # overlaps the publish window (layers flow per hop, not per version).
+    assert out["relay_hops"] >= 2, out
+    assert out["fanout_overlap_ratio"] > 0, out
+    json.dumps(out)

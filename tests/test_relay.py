@@ -1,0 +1,400 @@
+"""Broadcast weight distribution (ISSUE 11): relay trees for O(1)
+trainer-host egress.
+
+Covers the whole stack: the pure topology solver (torchstore_tpu/relay.py),
+the controller's watermark-driven fan-out (each published layer flows
+volume-to-volume down the tree via ``pull_from(relay=True)`` as its
+watermark lands), nearest-copy acquire routing (streamed reads gate on and
+serve from the subscriber's host-local relay copy), elastic membership
+(join/leave mid-run), peer-aware traffic-matrix attribution of relay hops,
+and the deterministic chaos leg: a relay node killed MID-BROADCAST via the
+``relay.forward`` faultpoint re-parents its subtree onto a healthy ancestor
+and the leaf still acquires a consistent single-generation version — with
+no ``ts.repair()`` call anywhere in this file.
+"""
+
+import asyncio
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import relay as relay_mod
+from torchstore_tpu.strategy import LocalRankStrategy
+from torchstore_tpu.weight_channel import WeightPublisher, WeightSubscriber
+
+
+@pytest.fixture
+def fast_health(monkeypatch):
+    monkeypatch.setenv("TORCHSTORE_TPU_HEALTH_INTERVAL_S", "0.25")
+    monkeypatch.setenv("TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD", "2")
+
+
+# --------------------------------------------------------------------------
+# unit: the topology solver
+# --------------------------------------------------------------------------
+
+
+def test_build_tree_root_out_degree_is_one():
+    """Trainer-host egress is O(1): the root forwards to exactly one child
+    however many members subscribe; interior nodes honor the fanout."""
+    members = [str(i) for i in range(1, 9)]
+    parents = relay_mod.build_tree("0", members, fanout=2)
+    assert set(parents) == set(members)
+    degree = Counter(parents.values())
+    assert degree["0"] == 1
+    for node, n in degree.items():
+        if node != "0":
+            assert n <= 2, (node, parents)
+    for child in parents:
+        assert relay_mod.depth_of(parents, "0", child) is not None
+
+
+def test_build_tree_chain_and_determinism():
+    parents = relay_mod.build_tree("0", ["3", "1", "2"], fanout=1)
+    # fanout=1 is a chain in sorted-id order; the solver is deterministic
+    # and excludes the root from the member set.
+    assert parents == {"1": "0", "2": "1", "3": "2"}
+    assert relay_mod.build_tree("0", ["0", "1", "2", "3"], fanout=1) == parents
+    assert relay_mod.build_tree("0", [], fanout=2) == {}
+    assert relay_mod.depth_of(parents, "0", "3") == 3
+
+
+def test_reparent_attaches_orphans_to_healthy_ancestor():
+    # 0 -> 1; 1 -> 2,3; 2 -> 4,5
+    parents = relay_mod.build_tree("0", list("12345"), fanout=2)
+    assert parents == {"1": "0", "2": "1", "3": "1", "4": "2", "5": "2"}
+    new, moved = relay_mod.reparent(parents, "0", {"1"})
+    assert "1" not in new
+    assert new["2"] == "0" and new["3"] == "0"
+    assert moved == {"2": ("1", "0"), "3": ("1", "0")}
+    assert new["4"] == "2" and new["5"] == "2"  # intact subtree untouched
+    # A whole dead chain walks all the way to the root.
+    chain = relay_mod.build_tree("0", list("123"), fanout=1)
+    new2, moved2 = relay_mod.reparent(chain, "0", {"1", "2"})
+    assert new2 == {"3": "0"}
+    assert moved2["3"] == ("2", "0")
+
+
+# --------------------------------------------------------------------------
+# integration: fan-out, local serve, topology view, traffic attribution
+# --------------------------------------------------------------------------
+
+
+def _layers(n: int, numel: int = 512, fill: float = 1.0) -> dict:
+    return {f"w{i}": np.full(numel, fill, np.float32) for i in range(n)}
+
+
+async def _wait_for_copy(client, key: str, vid: str, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        loc = await client.controller.locate_volumes.call_one(
+            [key], missing_ok=True
+        )
+        infos = loc.get(key)
+        if infos and vid in infos:
+            return
+        assert time.monotonic() < deadline, (
+            f"relay never landed {key!r} on volume {vid!r}"
+        )
+        await asyncio.sleep(0.05)
+
+
+@pytest.mark.anyio
+async def test_relay_tree_distributes_and_serves_locally(monkeypatch):
+    """One streamed publish fans out down the tree: every member volume
+    lands a full local copy, subscribers acquire a consistent version
+    routed through their OWN volume, ts.relay_topology() exposes the
+    shape, and the traffic matrix shows O(1) origin egress with relay
+    hops attributed as real src->dst host edges (never unattributed)."""
+    monkeypatch.setenv("TORCHSTORE_TPU_RELAY_FANOUT", "2")
+    await ts.initialize(
+        num_storage_volumes=4,
+        strategy=LocalRankStrategy(),
+        store_name="relay_dist",
+        volume_env_fn=lambda rank: {
+            "TORCHSTORE_TPU_HOSTNAME": f"rhost{rank}"
+        },
+    )
+    try:
+        client = ts.client("relay_dist")
+        layers = _layers(6)
+        nbytes = sum(v.nbytes for v in layers.values())
+        # Register the fleet BEFORE the publish so the very first layer
+        # already fans out (a member joining mid-version receives from its
+        # join point on; earlier layers stay point-to-point by design).
+        for vid in ("1", "2", "3"):
+            await client.relay_subscribe("pol", volume_id=vid)
+        pub = WeightPublisher("pol", store_name="relay_dist")
+        subs = [
+            WeightSubscriber(
+                "pol", store_name="relay_dist", relay=True,
+                relay_volume=str(i),
+            )
+            for i in (1, 2, 3)
+        ]
+
+        async def publish() -> int:
+            stream = pub.stream()
+            for k, v in layers.items():
+                await stream.put({k: v})
+            return await stream.seal()
+
+        async def origin_bytes_out() -> int:
+            matrix = await ts.traffic_matrix("relay_dist")
+            return int(
+                matrix["volumes"].get("0", {}).get("bytes_out", 0)
+            )
+
+        # Delta accounting: the client PROCESS's ledger is shared across
+        # the whole pytest session, and every SingletonStrategy store also
+        # has a volume "0" — absolute totals would aggregate other tests'
+        # traffic.
+        out0 = await origin_bytes_out()
+        results = await asyncio.gather(
+            publish(), *(s.acquire_streamed(timeout=60) for s in subs)
+        )
+        version = results[0]
+        for sd, v in results[1:]:
+            assert v == version
+            for k, arr in layers.items():
+                got = np.asarray(sd[k])
+                assert got.shape == arr.shape
+                assert np.unique(got).tolist() == [1.0], k
+
+        # Every member HOST holds exactly one full local copy.
+        keys = [f"pol/v{version}/{k}" for k in layers]
+        for key in keys:
+            for vid in ("1", "2", "3"):
+                await _wait_for_copy(client, key, vid)
+
+        topo = await ts.relay_topology("relay_dist")
+        assert set(topo["pol"]["members"]) == {"1", "2", "3"}
+        run = topo["pol"]["runs"][f"pol/v{version}"]
+        assert run["root"] == "0"
+        assert run["sealed"] is True
+        degree = Counter(run["parents"].values())
+        assert degree["0"] == 1  # O(1) origin egress by construction
+        # ...and by measurement: the origin volume served ~one copy (the
+        # single tree hop + the commit marker), not one per fleet.
+        matrix = await ts.traffic_matrix("relay_dist")
+        origin_out = await origin_bytes_out() - out0
+        assert origin_out >= nbytes, matrix["volumes"]
+        assert origin_out < 2 * nbytes, (
+            f"origin served {origin_out} bytes for a {nbytes}-byte dict "
+            "across 3 fleets — relay hops are not being used"
+        )
+        # Relay hops are PEER-AWARE src->dst host edges (satellite 1): the
+        # origin's single tree edge appears under its real host label.
+        first_child = next(
+            c for c, p in run["parents"].items() if p == "0"
+        )
+        edge = (
+            matrix["edges"]
+            .get("rhost0", {})
+            .get(f"rhost{first_child}", {})
+        )
+        assert edge.get("bytes", 0) >= nbytes, matrix["edges"]
+    finally:
+        await ts.shutdown("relay_dist")
+
+
+@pytest.mark.anyio
+async def test_relay_elastic_membership(monkeypatch):
+    """Generators join/leave mid-run: a member subscribed for v2 (but not
+    v1) only receives v2; an unsubscribed member stops receiving."""
+    monkeypatch.setenv("TORCHSTORE_TPU_RELAY_FANOUT", "2")
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(),
+        store_name="relay_elastic",
+    )
+    try:
+        client = ts.client("relay_elastic")
+        assert (await client.relay_subscribe("pol", volume_id="1"))[
+            "volume_id"
+        ] == "1"
+        pub = WeightPublisher("pol", store_name="relay_elastic")
+        layers = _layers(3)
+
+        async def publish() -> int:
+            stream = pub.stream()
+            for k, v in layers.items():
+                await stream.put({k: v})
+            return await stream.seal()
+
+        v1 = await publish()
+        await _wait_for_copy(client, f"pol/v{v1}/w0", "1")
+        loc = await client.controller.locate_volumes.call_one(
+            [f"pol/v{v1}/w0"]
+        )
+        assert "2" not in loc[f"pol/v{v1}/w0"]  # not yet a member
+
+        # Join: volume 2 receives the NEXT version.
+        await client.relay_subscribe("pol", volume_id="2")
+        v2 = await publish()
+        for vid in ("1", "2"):
+            await _wait_for_copy(client, f"pol/v{v2}/w0", vid)
+
+        # Leave: volume 1's member is gone, v3 flows to volume 2 only.
+        await client.relay_unsubscribe("pol", "1")
+        v3 = await publish()
+        await _wait_for_copy(client, f"pol/v{v3}/w0", "2")
+        loc = await client.controller.locate_volumes.call_one(
+            [f"pol/v{v3}/w0"]
+        )
+        assert "1" not in loc[f"pol/v{v3}/w0"], loc
+        topo = await ts.relay_topology("relay_elastic")
+        assert set(topo["pol"]["members"]) == {"2"}
+    finally:
+        await ts.shutdown("relay_elastic")
+
+
+@pytest.mark.anyio
+async def test_relay_disabled_by_env(monkeypatch):
+    """TORCHSTORE_TPU_RELAY_ENABLED=0 turns subscription into a no-op and
+    acquires fall back to plain point-to-point streamed reads."""
+    monkeypatch.setenv("TORCHSTORE_TPU_RELAY_ENABLED", "0")
+    from torchstore_tpu.config import StoreConfig
+
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(),
+        store_name="relay_off",
+        config=StoreConfig(),
+    )
+    try:
+        client = ts.client("relay_off")
+        res = await client.relay_subscribe("pol", volume_id="1")
+        assert res["volume_id"] is None and res.get("disabled")
+        pub = WeightPublisher("pol", store_name="relay_off")
+        sub = WeightSubscriber(
+            "pol", store_name="relay_off", relay=True, relay_volume="1"
+        )
+        layers = _layers(2)
+
+        async def publish() -> int:
+            stream = pub.stream()
+            for k, v in layers.items():
+                await stream.put({k: v})
+            return await stream.seal()
+
+        version, (sd, got_version) = await asyncio.gather(
+            publish(), sub.acquire_streamed(timeout=60)
+        )
+        assert got_version == version
+        assert sub._relay_home is None  # subscription stood down
+        loc = await client.controller.locate_volumes.call_one(
+            [f"pol/v{version}/w0"]
+        )
+        assert "1" not in loc[f"pol/v{version}/w0"]  # no fan-out happened
+    finally:
+        await ts.shutdown("relay_off")
+
+
+# --------------------------------------------------------------------------
+# chaos: kill a relay node mid-broadcast (satellite 3)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_relay_node_death_reparents_and_completes(
+    fast_health, monkeypatch
+):
+    """Deterministic chaos schedule: a chain 0 -> 1 -> 2 relays a streamed
+    version; the interior relay node (volume 1) is killed MID-BROADCAST by
+    the ``relay.forward`` faultpoint (action=die fires on its next
+    forwarding pull). The health supervisor quarantines it, the controller
+    re-parents the orphaned subtree (volume 2) onto the healthy ancestor
+    (the origin), forwarding resumes from volume 2's last landed watermark
+    (layers it already holds are never re-pulled), and the leaf subscriber
+    still acquires a complete, consistent single-generation version — zero
+    mixed-generation reads, and NO ts.repair() anywhere."""
+    monkeypatch.setenv("TORCHSTORE_TPU_RELAY_FANOUT", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_RELAY_REPARENT_TIMEOUT_S", "1.0")
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(),
+        store_name="relay_chaos",
+    )
+    try:
+        client = ts.client("relay_chaos")
+        await client.relay_subscribe("pol", volume_id="1")
+        await client.relay_subscribe("pol", volume_id="2")
+        pub = WeightPublisher("pol", store_name="relay_chaos")
+        sub = WeightSubscriber(
+            "pol", store_name="relay_chaos", relay=True, relay_volume="2"
+        )
+        layers = _layers(8, fill=7.0)
+        names = list(layers)
+        leaf_landed_early = asyncio.Event()
+
+        async def publish() -> int:
+            stream = pub.stream()
+            for k in names[:2]:
+                await stream.put({k: layers[k]})
+            # Wait for the chain to land the first layers on the LEAF so
+            # the kill is provably mid-broadcast (the leaf holds a partial
+            # version it must not re-pull after re-parenting).
+            await _wait_for_copy(
+                client, f"pol/v{stream.version}/{names[0]}", "2"
+            )
+            leaf_landed_early.set()
+            # Kill the interior relay node on its NEXT forwarding hop.
+            await ts.inject_fault(
+                "relay.forward",
+                "die",
+                count=1,
+                scope="1",
+                store_name="relay_chaos",
+            )
+            for k in names[2:]:
+                await stream.put({k: layers[k]})
+            return await stream.seal()
+
+        pub_task = asyncio.ensure_future(publish())
+        sd, version = await sub.acquire_streamed(timeout=120)
+        await pub_task
+        assert leaf_landed_early.is_set()
+
+        # Zero mixed-generation reads: one version's weights, complete.
+        assert set(sd) == set(layers)
+        for k in names:
+            vals = np.unique(np.asarray(sd[k]))
+            assert vals.tolist() == [7.0], f"{k} mixed generations: {vals}"
+
+        # The orphaned subtree re-parented onto the healthy ancestor and
+        # the dead node left the tree; the leaf landed the WHOLE version.
+        topo = await ts.relay_topology("relay_chaos")
+        run = topo["pol"]["runs"][f"pol/v{version}"]
+        assert run["parents"].get("2") == "0", run
+        assert "1" not in run["parents"], run
+        assert run["landed"]["2"] >= len(names), run
+        for k in names:
+            loc = await client.controller.locate_volumes.call_one(
+                [f"pol/v{version}/{k}"]
+            )
+            assert "2" in loc[f"pol/v{version}/{k}"]
+
+        # The supervisor (not any repair call) dealt with the dead node...
+        health = await ts.volume_health("relay_chaos")
+        assert health["1"]["state"] == "quarantined"
+        # ...and every re-parenting decision is on the flight recorder as
+        # a kind=health event (satellite: operators can replay the tree's
+        # history without reading controller state).
+        record = await ts.flight_record("relay_chaos")
+        reparents = [
+            e
+            for e in record["events"]
+            if e.get("kind") == "health"
+            and str(e.get("name", "")).startswith("relay_reparent/")
+        ]
+        assert reparents, "no relay_reparent decision recorded"
+        detail = reparents[-1].get("detail") or {}
+        assert detail.get("child") == "2"
+        assert detail.get("new_parent") == "0"
+    finally:
+        await ts.clear_faults(store_name="relay_chaos")
+        await ts.shutdown("relay_chaos")

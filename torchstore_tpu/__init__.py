@@ -36,6 +36,7 @@ from torchstore_tpu.api import (
     put,
     put_batch,
     put_state_dict,
+    relay_topology,
     repair,
     reset_client,
     shutdown,
@@ -115,6 +116,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "relay_topology",
     "repair",
     "reset_client",
     "shutdown",

@@ -80,12 +80,20 @@ async def initialize(
     storage_dir: Optional[str] = None,
     recover: bool = False,
     colocated: bool = False,
+    volume_env_fn: Optional[Any] = None,
 ) -> ActorRef:
     """Boot a store: spawn volume actors, the singleton controller, wire them
     (/root/reference/torchstore/api.py:33-81). With ``storage_dir`` the
     volumes persist entries to disk; ``recover=True`` additionally rebuilds
     the metadata index from what the directory already holds (crash/restart
     recovery — beyond the reference, whose store is memory-only).
+
+    ``volume_env_fn(rank) -> dict`` adds per-volume env overrides on top of
+    the store's base volume env — e.g. a distinct
+    ``TORCHSTORE_TPU_HOSTNAME`` per volume to emulate a multi-host fleet on
+    one box (the relay fanout bench / tests measure per-host egress this
+    way). Ignored for ``colocated`` stores (the single volume lives in this
+    process).
 
     ``colocated=True`` hosts the (single) storage volume IN THIS PROCESS:
     local endpoint calls become direct method invocations — no RPC hop, no
@@ -151,7 +159,10 @@ async def initialize(
             StorageVolume,
             f"ts_{store_name}_volume",
             strategy,
-            env_fn=lambda rank: volume_env,
+            env_fn=lambda rank: {
+                **volume_env,
+                **((volume_env_fn(rank) or {}) if volume_env_fn else {}),
+            },
         )
     try:
         controller = await get_or_spawn_singleton(
@@ -420,13 +431,17 @@ async def get_state_dict_streamed(
     strict: bool = True,
     timeout: Optional[float] = None,
     wait_for_stream_s: Optional[float] = None,
+    relay_volume: Optional[str] = None,
     store_name: str = DEFAULT_STORE,
 ) -> Any:
     """Acquire a streamed publish layer by layer (long-poll, no spin):
     each key is served the moment its watermark lands, in ``key_order``
     when given, with ``on_layer(flat_key, value)`` per served leaf.
     ``wait_for_stream_s`` waits for a publisher that hasn't begun yet.
-    Never mixes generations — see torchstore_tpu/stream_sync.py."""
+    ``relay_volume`` gates + routes the acquire through this host's
+    broadcast relay copy (see ``WeightSubscriber(relay=True)``, which
+    manages the subscription for you). Never mixes generations — see
+    torchstore_tpu/stream_sync.py."""
     from torchstore_tpu import stream_sync
 
     return await stream_sync.get_state_dict_streamed(
@@ -438,6 +453,7 @@ async def get_state_dict_streamed(
         strict=strict,
         timeout=timeout,
         wait_for_stream_s=wait_for_stream_s,
+        relay_volume=relay_volume,
     )
 
 
@@ -884,6 +900,18 @@ async def clear_faults(
     return cleared
 
 
+async def relay_topology(store_name: str = DEFAULT_STORE) -> dict:
+    """The current broadcast relay topology, per channel: members (with
+    subscriber refcounts), topology epoch, configured fanout, and every
+    live run's tree + per-member landed progress — the operator view of
+    the fan-out shape (each re-parenting decision is additionally recorded
+    in the flight recorder as a ``health`` event). See
+    torchstore_tpu/relay.py."""
+    c = client(store_name)
+    await c._ensure_setup()
+    return await c.controller.relay_topology.call_one()
+
+
 async def volume_health(store_name: str = DEFAULT_STORE) -> dict:
     """The health supervisor's per-volume view:
     ``{volume_id: {"state": "ok"|"probation"|"quarantined", "misses",
@@ -987,6 +1015,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "relay_topology",
     "repair",
     "reset_client",
     "shutdown",

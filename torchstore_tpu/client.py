@@ -214,6 +214,14 @@ class LocalClient:
         # on local deletes; cross-client relocations/deletes are discovered
         # by the fetch failing and retried once with a fresh locate.
         self._loc_cache: dict[str, dict[str, StorageInfo]] = {}
+        # Negative memo for nearest-copy routing: (key, prefer_volume)
+        # pairs a FRESH locate showed lacking the preferred replica.
+        # Without it, every fetch of a key that will never land on the
+        # relay volume (sharded keys stay point-to-point) would bypass
+        # the location cache and pay a locate RPC forever. Cleared with
+        # the location cache on every placement-epoch bump — relay
+        # landings are structural, so a later local copy is re-seen.
+        self._prefer_misses: set[tuple[str, str]] = set()
         # Volumes observed dead/wedged by THIS client: get ordering prefers
         # healthy replicas, so a replicated key survives a volume death
         # transparently (cleared when a later health check reports ok).
@@ -294,6 +302,7 @@ class LocalClient:
         self._seen_epoch = epoch
         if bumped:
             self._loc_cache.clear()
+            self._prefer_misses.clear()
             self._volumes_stale = True
             self._drop_one_sided()
 
@@ -737,11 +746,22 @@ class LocalClient:
         results = await self.get_batch({key: like})
         return results[key]
 
-    async def get_batch(self, items, _seed_plan: bool = True) -> dict[str, Any]:
+    async def get_batch(
+        self,
+        items,
+        _seed_plan: bool = True,
+        prefer_volume: Optional[str] = None,
+    ) -> dict[str, Any]:
         """All-or-nothing batched get (invariant 8): any missing key fails the
         whole batch before data moves (locate happens up front). ``items``
         is either a list of keys or {key: fetch_target_or_None} (reference
         signature parity, /root/reference/torchstore/api.py:242-279).
+
+        ``prefer_volume``: replica-selection preference — when a key has a
+        copy on this volume (e.g. the caller's RELAY volume, holding the
+        broadcast-distributed local copy), fetch from it; other replicas
+        stay as fallback. Never a hard pin: a key absent there serves from
+        wherever it lives.
 
         ``_seed_plan=False`` (internal): state-dict ops manage their own
         SyncPlanCache entries and epoch validation — they skip the
@@ -751,7 +771,9 @@ class LocalClient:
             with obs_context.ensure_root(), span(
                 "get_batch", keys=len(items)
             ) as sp:
-                out = await self._get_batch(items, _seed_plan=_seed_plan)
+                out = await self._get_batch(
+                    items, _seed_plan=_seed_plan, prefer_volume=prefer_volume
+                )
                 # Stored OBJECTS come back as arbitrary user types; only
                 # count an nbytes attribute that is actually a number.
                 sizes = [
@@ -781,7 +803,12 @@ class LocalClient:
         )
         return out
 
-    async def _get_batch(self, items, _seed_plan: bool = True) -> dict[str, Any]:
+    async def _get_batch(
+        self,
+        items,
+        _seed_plan: bool = True,
+        prefer_volume: Optional[str] = None,
+    ) -> dict[str, Any]:
         if isinstance(items, str):
             raise TypeError(
                 "get_batch takes a list of keys or a {key: target} dict, "
@@ -874,7 +901,7 @@ class LocalClient:
                     self._loc_cache.clear()
                 for k, infos in batch_plan["located"].items():
                     self._loc_cache.setdefault(k, infos)
-        flat_results = await self._fetch(requests)
+        flat_results = await self._fetch(requests, prefer_volume=prefer_volume)
         if batch_sig is not None and batch_plan is None:
             pc.store(
                 "get_batch",
@@ -987,7 +1014,11 @@ class LocalClient:
     # fetch pipeline
     # ------------------------------------------------------------------
 
-    async def _fetch(self, requests: list[Request]) -> list[Any]:
+    async def _fetch(
+        self,
+        requests: list[Request],
+        prefer_volume: Optional[str] = None,
+    ) -> list[Any]:
         """Fetch with two retry families layered on ``_fetch_once``:
 
         - *Stale state* (KeyError/ValueError: another client deleted or
@@ -1007,7 +1038,9 @@ class LocalClient:
             epoch = self._refresh_epoch
             try:
                 out = await self._fetch_once(
-                    requests, use_cache=attempt == 0 and not stale_retried
+                    requests,
+                    use_cache=attempt == 0 and not stale_retried,
+                    prefer_volume=prefer_volume,
                 )
                 if attempt > 0:
                     _FAILOVERS.inc(op="get")
@@ -1051,7 +1084,10 @@ class LocalClient:
                 )
 
     async def _fetch_once(
-        self, requests: list[Request], use_cache: bool
+        self,
+        requests: list[Request],
+        use_cache: bool,
+        prefer_volume: Optional[str] = None,
     ) -> list[Any]:
         # Refs may have been dropped by a stale-ref diagnosis between the
         # first attempt and this retry; rebuild them from the controller.
@@ -1065,6 +1101,21 @@ class LocalClient:
         missing = []
         for key in keys:
             cached = self._loc_cache.get(key) if use_cache else None
+            if (
+                cached is not None
+                and prefer_volume is not None
+                and prefer_volume not in cached
+                and (key, prefer_volume) not in self._prefer_misses
+            ):
+                # Nearest-copy routing: the cached locations predate the
+                # relay landing this caller's local replica (another
+                # subscriber of the same client located the key earlier) —
+                # a stale entry here would silently re-route every read
+                # back to the origin volumes. Re-locate ONCE per placement
+                # epoch; if the fresh view still lacks the preferred
+                # replica the miss is memoized and the key serves from
+                # wherever it lives.
+                cached = None
             if cached is not None:
                 located[key] = cached
             else:
@@ -1075,11 +1126,21 @@ class LocalClient:
                 self._loc_cache.clear()
             self._loc_cache.update(fresh)
             located.update(fresh)
+            if prefer_volume is not None:
+                if len(self._prefer_misses) > self.LOC_CACHE_MAX:
+                    self._prefer_misses.clear()
+                self._prefer_misses.update(
+                    (key, prefer_volume)
+                    for key, infos in fresh.items()
+                    if prefer_volume not in infos
+                )
         # volume_id -> list of (request_index, sub_request)
         by_volume: dict[str, list[tuple[int, Request]]] = {}
         inplace_ok = self._transports_support_inplace(located)
         for idx, req in enumerate(requests):
-            subs = self._build_volume_requests(req, located[req.key], inplace_ok)
+            subs = self._build_volume_requests(
+                req, located[req.key], inplace_ok, prefer_volume=prefer_volume
+            )
             for vid, sub in subs:
                 by_volume.setdefault(vid, []).append((idx, sub))
 
@@ -1405,6 +1466,7 @@ class LocalClient:
         req: Request,
         infos: dict[str, StorageInfo],
         inplace_ok: tuple[bool, bool],
+        prefer_volume: Optional[str] = None,
     ) -> list[tuple[str, Request]]:
         supports_inplace, need_contig = inplace_ok
         any_info = next(iter(infos.values()))
@@ -1413,15 +1475,17 @@ class LocalClient:
             own_id = self._strategy.get_client_id()
         except Exception:
             pass
-        # Prefer healthy volumes first (replica failover), then this
-        # client's own volume, then stable order (locality). Known-dead
-        # and supervisor-quarantined volumes stay as a last resort: if
-        # they hold the only copy the fetch still tries them and surfaces
-        # the real error.
+        # Prefer healthy volumes first (replica failover), then the
+        # caller's preferred replica (a relay-distributed local copy),
+        # then this client's own volume, then stable order (locality).
+        # Known-dead and supervisor-quarantined volumes stay as a last
+        # resort: if they hold the only copy the fetch still tries them
+        # and surfaces the real error.
         ordered = sorted(
             infos,
             key=lambda v: (
                 v in self._dead_volumes or v in self._avoid_volumes,
+                v != prefer_volume,
                 v != own_id,
                 v,
             ),
@@ -1610,6 +1674,7 @@ class LocalClient:
         drops cached locations and dead-volume marks so retries see the
         fresh fleet."""
         self._loc_cache.clear()
+        self._prefer_misses.clear()
         self._dead_volumes.clear()
         self._refresh_epoch += 1
         await self._load_volumes()
@@ -1687,14 +1752,49 @@ class LocalClient:
         version: int,
         known: int = 0,
         timeout: Optional[float] = None,
+        volume_id: Optional[str] = None,
     ) -> dict:
         """Long-poll streamed-publish progress (see
         Controller.wait_for_stream); the substrate for layer-by-layer
-        acquires — woken by the notify that commits each layer, no spin."""
+        acquires — woken by the notify that commits each layer, no spin.
+        ``volume_id`` gates readiness on this subscriber's RELAY copy: keys
+        report ready only once the broadcast tree landed them on that
+        volume (ignored when the volume is not a live relay member)."""
         await self._ensure_setup()
         return await self._controller.wait_for_stream.with_timeout(
             self._wait_rpc_timeout(timeout)
-        ).call_one(key, version, known, timeout)
+        ).call_one(key, version, known, timeout, volume_id)
+
+    # ------------------------------------------------------------------
+    # broadcast relay distribution (torchstore_tpu/relay.py)
+    # ------------------------------------------------------------------
+
+    async def relay_subscribe(
+        self, channel: str, volume_id: Optional[str] = None
+    ) -> dict:
+        """Join ``channel``'s broadcast tree: the controller assigns (or
+        adopts, via ``volume_id``) this host's relay volume — published
+        versions flow to it volume-to-volume and local acquires read the
+        one host-local copy. Returns ``{"volume_id", "epoch", "fanout"}``;
+        ``{"volume_id": None, "disabled": True}`` when
+        TORCHSTORE_TPU_RELAY_ENABLED is off."""
+        await self._ensure_setup()
+        if not self._config.relay_enabled:
+            return {"volume_id": None, "disabled": True}
+        from torchstore_tpu.observability.ledger import local_host
+
+        return await self._controller.relay_subscribe.call_one(
+            channel, local_host(), volume_id
+        )
+
+    async def relay_unsubscribe(self, channel: str, volume_id: str) -> dict:
+        """Leave ``channel``'s broadcast tree (elastic membership: the last
+        subscriber on a host removes its member and live runs re-parent
+        around it). Idempotent."""
+        await self._ensure_setup()
+        return await self._controller.relay_unsubscribe.call_one(
+            channel, volume_id
+        )
 
     async def stream_ack(
         self, key: str, version: int, subscriber: str

@@ -83,6 +83,23 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "Bulk transport payloads above this many bytes are striped "
            "across the pre-opened stripe connection set (puts, get "
            "replies, and IDX_PACKED doorbell replies)."),
+    EnvVar("TORCHSTORE_TPU_RELAY_ENABLED", "bool", True,
+           "Broadcast weight distribution: allow relay-tree fan-out of "
+           "weight_channel versions (controller-driven volume-to-volume "
+           "forwarding; subscribers opt in per channel via "
+           "WeightSubscriber(relay=True) / client.relay_subscribe). "
+           "0 disables relay subscription fleet-wide: acquires fall back "
+           "to point-to-point reads from the origin volumes."),
+    EnvVar("TORCHSTORE_TPU_RELAY_FANOUT", "int", 2,
+           "Interior out-degree of the relay tree each published version "
+           "flows down. The root (origin volume) always forwards to "
+           "exactly ONE child so trainer-host egress stays O(1) however "
+           "many fleets subscribe; 1 makes the whole tree a chain."),
+    EnvVar("TORCHSTORE_TPU_RELAY_REPARENT_TIMEOUT_S", "float", 5.0,
+           "How long a relay edge keeps retrying a failing parent before "
+           "the controller re-parents the orphaned subtree onto the "
+           "nearest healthy ancestor (the health supervisor's quarantine "
+           "re-parents immediately, independent of this window)."),
     EnvVar("TORCHSTORE_TPU_ONE_SIDED", "bool", True,
            "One-sided data plane for warm gets: same-host readers with a "
            "cached plan read stamped (seqlock-validated) bytes directly "
@@ -447,6 +464,13 @@ class StoreConfig:
     )
     stream_retries: int = field(
         default_factory=lambda: _env_int("TORCHSTORE_TPU_STREAM_RETRIES", 2)
+    )
+    # Broadcast distribution: whether this client may join relay trees
+    # (per-channel opt-in still required — WeightSubscriber(relay=True)).
+    # Fanout and the re-parent window are CONTROLLER-side knobs read from
+    # env in the controller process; they live in the registry above.
+    relay_enabled: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_RELAY_ENABLED", True)
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

@@ -17,6 +17,7 @@ from enum import Enum
 from typing import Any, Optional
 
 from torchstore_tpu import faults
+from torchstore_tpu import relay as relay_mod
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import recorder as obs_recorder
@@ -59,6 +60,14 @@ _QUARANTINES = obs_metrics.counter(
 _AUTO_REPAIRS = obs_metrics.counter(
     "ts_auto_repairs_total",
     "Keys re-replicated automatically after a quarantine",
+)
+_RELAY_FORWARDED = obs_metrics.counter(
+    "ts_relay_forwarded_keys_total",
+    "Store keys forwarded one hop down a broadcast relay tree, per channel",
+)
+_RELAY_REPARENTS = obs_metrics.counter(
+    "ts_relay_reparents_total",
+    "Relay-tree edges re-parented onto a healthy ancestor, per channel",
 )
 
 
@@ -229,6 +238,24 @@ class Controller(Actor):
         # prewarmer's reservation expires by TTL instead of pinning capacity
         # forever.
         self._prewarm_reservations: dict[str, tuple[float, dict[str, int]]] = {}
+        # Broadcast relay distribution (torchstore_tpu/relay.py): per-
+        # channel membership ({volume_id: subscriber refcount} + a topology
+        # epoch bumped on every membership/health re-shape) and per-stream-
+        # key relay RUNS — the live fan-out of one published version down
+        # its tree. Edge forwarder tasks live in _relay_tasks (cancelled at
+        # teardown); all state is controller-process-local, like streams.
+        self._relay_enabled = os.environ.get(
+            "TORCHSTORE_TPU_RELAY_ENABLED", "1"
+        ).strip().lower() not in ("0", "false", "no", "off", "")
+        self._relay_fanout = max(
+            1, int(os.environ.get("TORCHSTORE_TPU_RELAY_FANOUT", 2))
+        )
+        self._relay_reparent_s = float(
+            os.environ.get("TORCHSTORE_TPU_RELAY_REPARENT_TIMEOUT_S", 5.0)
+        )
+        self._relay_channels: dict[str, dict] = {}
+        self._relay_runs: dict[str, dict] = {}
+        self._relay_tasks: set = set()
         # Layer-streamed sync state: sd_key -> {"version", "sealed",
         # "watermarks": {store_key: version}}. ``version`` is the stream in
         # flight (or last begun), ``sealed`` the highest sealed version, and
@@ -568,6 +595,13 @@ class Controller(Actor):
                     # timeline (setdefault: the first commit of a key is
                     # its landing; superseded late notifies don't count).
                     rec["landing_ts"].setdefault(meta.key, now)
+            # Broadcast fan-out: keys that just landed on the origin
+            # volume(s) start flowing down the channel's relay tree, per
+            # layer — interior hops forward as watermarks land, never
+            # waiting for the seal.
+            await self._relay_on_landing(
+                stream_key, int(version), metas, volume_ids
+            )
         await self._bump({meta.key for meta in metas})
         # The reply carries the placement epoch so publishers track it for
         # free (no extra RPC): a bump invalidates their cached plans.
@@ -815,8 +849,10 @@ class Controller(Actor):
             # per-key watermarks are dropped with the bytes they described.
             for key in deleted:
                 self._streams.pop(key, None)
+                self._relay_stop_run(key)
                 if key.endswith("/MAPPING"):
                     self._streams.pop(key[: -len("/MAPPING")], None)
+                    self._relay_stop_run(key[: -len("/MAPPING")])
             self._placement_epoch += 1
             await self._bump(deleted)
         return by_volume
@@ -983,6 +1019,7 @@ class Controller(Actor):
         rec["sealed"] = max(rec["sealed"], int(version))
         if int(version) == rec["version"] and rec.get("seal_ts") is None:
             rec["seal_ts"] = time.time()
+        await self._relay_on_seal(key, int(version))
         cond = self._cond()
         async with cond:
             cond.notify_all()
@@ -1044,6 +1081,7 @@ class Controller(Actor):
         version: int,
         known: int = 0,
         timeout: Optional[float] = None,
+        volume_id: Optional[str] = None,
     ) -> dict[str, Any]:
         """Long-poll for streamed-publish progress (notify-woken, no spin):
         blocks until ``key``'s stream has MORE than ``known`` store keys
@@ -1051,6 +1089,15 @@ class Controller(Actor):
         or a newer stream begins (superseded), or the record disappears.
         ``known = -1`` waits for the stream record to EXIST at all (a
         consumer arriving before the publisher's first layer).
+
+        ``volume_id`` gates progress on the caller's RELAY copy: when the
+        volume is a live member of the key's broadcast tree, a store key is
+        only reported ready once it is indexed on that volume (the relay
+        hop landed the host's local copy — the acquire then reads it
+        zero-copy/locally instead of pulling from the origin), and
+        ``sealed`` additionally waits for every watermarked key to land
+        there. A volume that is not a relay member (or a channel with no
+        relay) ignores the gate entirely — fail-safe to origin reads.
 
         Returns ``{"missing", "version", "sealed", "superseded", "ready",
         "watermarks"}`` — ``ready`` lists store keys whose watermark is at
@@ -1068,10 +1115,31 @@ class Controller(Actor):
             ready = {
                 k: v for k, v in rec["watermarks"].items() if v >= version
             }
+            sealed = rec["sealed"] >= version
+            # Membership re-checked per wake: an unsubscribe/quarantine
+            # mid-poll drops the gate instead of wedging the reader. The
+            # gate covers only keys the run actually forwards — sharded
+            # keys and layers published before the first member joined
+            # pass ungated (point-to-point fail-safe, never a hang).
+            run = (
+                self._relay_gate_run(key, volume_id)
+                if volume_id is not None
+                else None
+            )
+            if run is not None:
+                forwarded = run["metas"]
+                local = {
+                    k: v
+                    for k, v in ready.items()
+                    if k not in forwarded
+                    or volume_id in (self.index.get(k) or {})
+                }
+                sealed = sealed and len(local) == len(ready)
+                ready = local
             return {
                 "missing": False,
                 "version": rec["version"],
-                "sealed": rec["sealed"] >= version,
+                "sealed": sealed,
                 "superseded": rec["version"] > version,
                 "ready": sorted(ready),
                 "watermarks": ready,
@@ -1108,6 +1176,578 @@ class Controller(Actor):
                     "watermarks": {},
                 }
             return view
+
+    # ---- broadcast relay distribution (torchstore_tpu/relay.py) ----------
+    #
+    # One published weight_channel version -> one RUN: the set of member
+    # volumes (one per subscribed host), a tree rooted at the origin volume
+    # (root out-degree 1 — O(1) trainer-host egress), and one forwarder
+    # task per edge that pulls freshly watermarked layers volume-to-volume
+    # (``pull_from(relay=True)``, bulk/striped) the moment the parent holds
+    # them — interior hops forward per LAYER, never per version, so deep
+    # trees add per-hop latency only. Children keep their landed-key sets
+    # across re-parenting, so an orphaned subtree resumes from its last
+    # landed watermark and never re-pulls layers it already holds.
+
+    MAX_RELAY_RUNS = 16
+
+    def _relay_channel_of(self, stream_key: str) -> Optional[str]:
+        """The subscribed channel a stream key publishes under (stream keys
+        are ``{channel}/v{n}``), or None when no channel matches."""
+        for channel in self._relay_channels:
+            if stream_key.startswith(channel + "/v"):
+                seg = stream_key[len(channel) + 2 :]
+                if seg.isdigit():
+                    return channel
+        return None
+
+    def _relay_healthy_members(self, channel: str) -> list[str]:
+        ch = self._relay_channels.get(channel)
+        if ch is None:
+            return []
+        quarantined = self._quarantined_ids()
+        return [
+            vid
+            for vid, subs in ch["members"].items()
+            if subs > 0 and vid in self.volume_refs and vid not in quarantined
+        ]
+
+    def _relay_gate_run(
+        self, stream_key: str, volume_id: str
+    ) -> Optional[dict]:
+        """The live relay run gating ``volume_id``'s streamed reads of
+        ``stream_key`` — None when the volume is not a subscribed member,
+        is quarantined, or no fan-out is running (fail-safe: ungated
+        readers serve from the origin volumes)."""
+        channel = self._relay_channel_of(stream_key)
+        if channel is None:
+            return None
+        ch = self._relay_channels.get(channel)
+        if not ch or ch["members"].get(volume_id, 0) <= 0:
+            return None
+        if volume_id in self._quarantined_ids():
+            return None
+        run = self._relay_runs.get(stream_key)
+        if run is None or run.get("dead"):
+            return None
+        if volume_id != run["root"] and volume_id not in run["parents"]:
+            # Member, but not in THIS run's tree — excluded at run
+            # creation (quarantined then) or dropped mid-run and later
+            # reinstated (reinstatement does not re-attach to live runs;
+            # the next version's tree picks it back up). Gating it would
+            # wedge the reader on copies no forwarder will ever land.
+            return None
+        return run
+
+    async def _relay_notify(self, run: dict) -> None:
+        async with run["cond"]:
+            run["cond"].notify_all()
+
+    def _relay_new_run(
+        self,
+        stream_key: str,
+        channel: str,
+        version: int,
+        volume_ids: list[str],
+    ) -> Optional[dict]:
+        import asyncio
+
+        members = self._relay_healthy_members(channel)
+        root = str(volume_ids[0])
+        parents = relay_mod.build_tree(root, members, self._relay_fanout)
+        if not parents:
+            return None  # nobody to relay to (or origin is the only member)
+        while len(self._relay_runs) >= self.MAX_RELAY_RUNS:
+            victim = next(
+                (
+                    k
+                    for k, r in self._relay_runs.items()
+                    if r.get("dead")
+                    or (
+                        r["sealed"]
+                        and all(
+                            r["landed"].get(c, set()) >= set(r["metas"])
+                            for c in r["parents"]
+                        )
+                    )
+                ),
+                next(iter(self._relay_runs)),
+            )
+            self._relay_stop_run(victim)
+        run = {
+            "channel": channel,
+            "version": int(version),
+            "root": root,
+            "parents": parents,
+            "landed": {root: set()},
+            "metas": {},
+            "sealed": False,
+            "cond": asyncio.Condition(),
+            "tasks": {},
+            "failing": {},
+        }
+        self._relay_runs[stream_key] = run
+        obs_recorder.record(
+            "stream",
+            f"relay_begin/{channel}",
+            key=stream_key,
+            root=root,
+            members=len(parents),
+        )
+        logger.info(
+            "relay %s: broadcasting v%d from volume %s to %d member(s) "
+            "(fanout %d)",
+            stream_key,
+            version,
+            root,
+            len(parents),
+            self._relay_fanout,
+        )
+        return run
+
+    async def _relay_on_landing(
+        self,
+        stream_key: str,
+        version: int,
+        metas: list[Request],
+        volume_ids: list[str],
+    ) -> None:
+        """Watermarked keys just landed on the origin volume(s): seed them
+        into the key's relay run (creating it on the first layer of the
+        stream's CURRENT version) and wake the edge forwarders."""
+        channel = self._relay_channel_of(stream_key)
+        if channel is None:
+            return
+        run = self._relay_runs.get(stream_key)
+        if run is None:
+            rec = self._streams.get(stream_key)
+            if rec is None or int(version) != rec["version"]:
+                return  # superseded late notify: nothing to fan out
+            run = self._relay_new_run(
+                stream_key, channel, version, [str(v) for v in volume_ids]
+            )
+            if run is None:
+                return
+        if run.get("dead") or int(version) != run["version"]:
+            return
+        if run["root"] not in {str(v) for v in volume_ids}:
+            # The batch landed off-root (a put failover re-routed it):
+            # the root's forwarders could never source these keys, so
+            # keeping them OUT of run["metas"] leaves them ungated —
+            # relay readers fetch them point-to-point instead of
+            # stalling on copies the tree cannot deliver.
+            return
+        added = False
+        for meta in metas:
+            if meta.tensor_slice is not None:
+                # Relay forwards full-tensor/object keys; sharded keys stay
+                # point-to-point (per-coord forwarding is not implemented —
+                # readers of those keys are simply not gated on them).
+                if not run.get("warned_sharded"):
+                    run["warned_sharded"] = True
+                    logger.warning(
+                        "relay %s: sharded key %r (and siblings) stay "
+                        "point-to-point",
+                        stream_key,
+                        meta.key,
+                    )
+                continue
+            run["metas"][meta.key] = meta
+            for vid in volume_ids:
+                run["landed"].setdefault(str(vid), set()).add(meta.key)
+            added = True
+        if added:
+            self._relay_sync_tasks(run)
+            await self._relay_notify(run)
+
+    async def _relay_on_seal(self, stream_key: str, version: int) -> None:
+        """The publisher sealed: mark the run terminal and forward the
+        MAPPING commit marker too, so leaf hosts finalize their acquire
+        against a LOCAL marker copy instead of a point-to-point get."""
+        run = self._relay_runs.get(stream_key)
+        if run is None or run.get("dead") or int(version) != run["version"]:
+            return
+        marker_key = f"{stream_key}/MAPPING"
+        infos = self.index.get(marker_key)
+        if infos:
+            run["metas"][marker_key] = Request(key=marker_key, is_object=True)
+            for vid in infos:
+                run["landed"].setdefault(str(vid), set()).add(marker_key)
+        run["sealed"] = True
+        self._relay_sync_tasks(run)
+        await self._relay_notify(run)
+
+    def _relay_sync_tasks(self, run: dict) -> None:
+        for child in list(run["parents"]):
+            task = run["tasks"].get(child)
+            if task is None or task.done():
+                run["tasks"][child] = spawn_logged(
+                    self._relay_edge(run, child),
+                    name="controller.relay_edge",
+                    tasks=self._relay_tasks,
+                    log=logger,
+                )
+
+    async def _relay_edge(self, run: dict, child: str) -> None:
+        """One tree edge's forwarder: pull batches of keys the parent holds
+        and this child doesn't, index the copies, wake gated readers and
+        the child's own children. Lives until the run completes for this
+        child, the child leaves the tree, or the run dies."""
+        import asyncio
+
+        from torchstore_tpu.config import RetryPolicy
+
+        stream_key = next(
+            (k for k, r in self._relay_runs.items() if r is run), "?"
+        )
+        child_ref = self.volume_refs.get(child)
+        if child_ref is None:
+            return
+        # Edge failures heal by RE-PARENTING, not by giving up, so the
+        # unified policy supplies the backoff curve only — capped so the
+        # re-parent window is actually reached within a few attempts —
+        # while the supervised loop itself runs until the run completes.
+        policy = RetryPolicy.from_env()
+        streak = 0
+        while True:
+            if run.get("dead") or child in self._quarantined_ids():
+                return
+            parent = run["parents"].get(child)
+            if parent is None:
+                return  # re-parented away / unsubscribed / quarantined
+            have = run["landed"].setdefault(child, set())
+            avail = run["landed"].get(parent, set())
+            pending = sorted(
+                k for k in avail if k not in have and k in run["metas"]
+            )
+            if not pending:
+                if run["sealed"] and have >= set(run["metas"]):
+                    return  # this subtree root is fully served
+                async with run["cond"]:
+                    try:
+                        await asyncio.wait_for(run["cond"].wait(), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        pass
+                continue
+            # Bounded batches, same cadence as auto-repair: one pull RPC
+            # moves up to 64 keys (striped on the bulk rung when the
+            # payload crosses the stripe threshold).
+            batch = pending[:64]
+            metas = [run["metas"][k] for k in batch]
+            src_ref = self.volume_refs.get(parent)
+            try:
+                if src_ref is None:
+                    raise RuntimeError(f"relay parent {parent!r} has no ref")
+                result = await child_ref.pull_from.call_one(
+                    src_ref,
+                    metas,
+                    src_hostname=self.volume_hostnames.get(parent, ""),
+                    src_volume=parent,
+                    relay=True,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - edge failures heal
+                # by re-parenting, never by surfacing
+                now = time.monotonic()
+                first = run["failing"].setdefault(child, now)
+                if now - first >= self._relay_reparent_s:
+                    run["failing"].pop(child, None)
+                    await self._relay_reparent_edge(
+                        run, stream_key, child, str(exc)
+                    )
+                await asyncio.sleep(
+                    min(
+                        policy.backoff(streak),
+                        max(0.05, self._relay_reparent_s / 4),
+                    )
+                )
+                streak += 1
+                continue
+            streak = 0
+            run["failing"].pop(child, None)
+            gens = result.get("write_gens", {})
+            touched = set()
+            for meta in metas:
+                infos = self.index.get(meta.key)
+                if infos is None:
+                    continue  # deleted mid-run: never re-index
+                info = infos.get(child)
+                if info is None:
+                    info = infos[child] = StorageInfo.from_meta(meta)
+                else:
+                    info.merge(meta)
+                info.write_gen = max(info.write_gen, gens.get(meta.key, 0))
+                touched.add(meta.key)
+            have.update(batch)
+            _RELAY_FORWARDED.inc(len(batch), channel=run["channel"])
+            if touched:
+                # New replica placement is structural (same rule as
+                # notify_put_batch); the generation bump wakes relay-gated
+                # wait_for_stream long-pollers on the child's host.
+                self._placement_epoch += 1
+                await self._bump(touched)
+            await self._relay_notify(run)
+
+    async def _relay_reparent_edge(
+        self, run: dict, stream_key: str, child: str, reason: str
+    ) -> None:
+        """An edge's parent kept failing past the re-parent window: move
+        ``child`` under the nearest healthy ancestor. Its landed set
+        survives, so it resumes from its last landed watermark."""
+        parents = run["parents"]
+        old = parents.get(child)
+        if old is None:
+            return
+        down = {old} | self._quarantined_ids()
+        anc = relay_mod.healthy_ancestor(parents, run["root"], old, down)
+        if anc == old:
+            return
+        parents[child] = anc
+        ch = self._relay_channels.get(run["channel"])
+        if ch is not None:
+            ch["epoch"] += 1
+        _RELAY_REPARENTS.inc(channel=run["channel"])
+        obs_recorder.record(
+            "health",
+            f"relay_reparent/{run['channel']}",
+            child=child,
+            old_parent=old,
+            new_parent=anc,
+            key=stream_key,
+            reason=reason[:120],
+        )
+        logger.warning(
+            "relay %s: re-parented %s from %s onto ancestor %s (%s); "
+            "resuming from %d landed key(s)",
+            stream_key,
+            child,
+            old,
+            anc,
+            reason,
+            len(run["landed"].get(child, ())),
+        )
+        await self._relay_notify(run)
+
+    async def _relay_on_quarantine(self, vid: str) -> None:
+        """The health supervisor quarantined ``vid``: every live run drops
+        it from its tree NOW — orphaned subtrees re-attach to a healthy
+        ancestor and resume from their last landed watermark — and future
+        trees exclude it until reinstated."""
+        touched_channels = set()
+        for stream_key, run in list(self._relay_runs.items()):
+            if run.get("dead"):
+                continue
+            parents = run["parents"]
+            if vid not in parents and vid not in set(parents.values()):
+                continue
+            new_parents, moved = relay_mod.reparent(
+                parents, run["root"], {vid}
+            )
+            parents.clear()
+            parents.update(new_parents)
+            task = run["tasks"].pop(vid, None)
+            if task is not None:
+                task.cancel()
+            touched_channels.add(run["channel"])
+            for child, (old, new) in moved.items():
+                _RELAY_REPARENTS.inc(channel=run["channel"])
+                obs_recorder.record(
+                    "health",
+                    f"relay_reparent/{run['channel']}",
+                    child=child,
+                    old_parent=old,
+                    new_parent=new,
+                    key=stream_key,
+                    reason=f"quarantine:{vid}",
+                )
+                logger.warning(
+                    "relay %s: quarantine of %s re-parented %s onto %s; "
+                    "resuming from %d landed key(s)",
+                    stream_key,
+                    vid,
+                    child,
+                    new,
+                    len(run["landed"].get(child, ())),
+                )
+            self._relay_sync_tasks(run)
+            await self._relay_notify(run)
+        for channel in touched_channels:
+            ch = self._relay_channels.get(channel)
+            if ch is not None:
+                ch["epoch"] += 1
+
+    def _relay_stop_run(self, stream_key: str) -> None:
+        run = self._relay_runs.pop(stream_key, None)
+        if run is None:
+            return
+        run["dead"] = True
+        for task in run["tasks"].values():
+            task.cancel()
+        run["tasks"].clear()
+
+    async def _relay_join_live_runs(self, channel: str) -> None:
+        """A member joined mid-run: attach every NEW member to live runs of
+        the channel per the fresh tree, WITHOUT moving existing children
+        (mid-version stability beats topological optimality; the next
+        version's run rebuilds the whole tree anyway)."""
+        members = self._relay_healthy_members(channel)
+        for run in self._relay_runs.values():
+            if run["channel"] != channel or run.get("dead"):
+                continue
+            fresh = relay_mod.build_tree(
+                run["root"], members, self._relay_fanout
+            )
+            added = False
+            for child, parent in fresh.items():
+                if child not in run["parents"]:
+                    run["parents"][child] = parent
+                    added = True
+            if added:
+                self._relay_sync_tasks(run)
+                await self._relay_notify(run)
+
+    @endpoint
+    async def relay_subscribe(
+        self, channel: str, host: str, volume_id: Optional[str] = None
+    ) -> dict[str, Any]:
+        """A generator (fleet) on ``host`` joins ``channel``'s broadcast
+        tree. The controller assigns the host's relay volume — the volume
+        co-located with ``host`` when one exists, else a stable healthy
+        pick — or adopts an explicit ``volume_id`` (tests/benches emulating
+        multi-host fleets). All co-located subscribers share one member
+        (refcounted): each HOST lands exactly one copy. Members joining
+        mid-version attach to live runs immediately. Returns
+        ``{"volume_id", "epoch", "fanout"}``."""
+        if not channel:
+            raise ValueError("relay_subscribe requires a channel name")
+        if not self._relay_enabled:
+            # The CONTROLLER process is where one setting is actually
+            # fleet-wide: clients launched without the knob still get a
+            # no-op subscription (same shape the client-side check
+            # returns), so no tree is ever built.
+            return {
+                "volume_id": None,
+                "disabled": True,
+                "epoch": 0,
+                "fanout": self._relay_fanout,
+            }
+        if volume_id is not None:
+            volume_id = str(volume_id)
+            if volume_id not in self.volume_refs:
+                raise ValueError(
+                    f"unknown relay volume {volume_id!r}; have "
+                    f"{sorted(self.volume_refs)}"
+                )
+        else:
+            quarantined = self._quarantined_ids()
+            healthy = sorted(
+                v for v in self.volume_refs if v not in quarantined
+            )
+            if not healthy:
+                raise RuntimeError("no healthy volume to host a relay copy")
+            same_host = [
+                v for v in healthy if self.volume_hostnames.get(v) == host
+            ]
+            if same_host:
+                volume_id = same_host[0]
+            else:
+                import zlib
+
+                volume_id = healthy[
+                    zlib.crc32(host.encode("utf-8", "replace")) % len(healthy)
+                ]
+        ch = self._relay_channels.setdefault(
+            channel, {"members": {}, "epoch": 0}
+        )
+        ch["members"][volume_id] = ch["members"].get(volume_id, 0) + 1
+        ch["epoch"] += 1
+        await self._relay_join_live_runs(channel)
+        obs_recorder.record(
+            "stream",
+            f"relay_subscribe/{channel}",
+            host=host,
+            volume=volume_id,
+        )
+        return {
+            "volume_id": volume_id,
+            "epoch": ch["epoch"],
+            "fanout": self._relay_fanout,
+        }
+
+    @endpoint
+    async def relay_unsubscribe(
+        self, channel: str, volume_id: str
+    ) -> dict[str, Any]:
+        """Drop one subscription from ``channel``'s member on
+        ``volume_id``. The last subscriber leaving a host removes the
+        member: live runs re-parent its children onto its parent and stop
+        forwarding to it (already-landed copies stay until version GC).
+        Idempotent."""
+        ch = self._relay_channels.get(channel)
+        if ch is None:
+            return {"members": 0}
+        volume_id = str(volume_id)
+        subs = ch["members"].get(volume_id, 0)
+        if subs > 1:
+            ch["members"][volume_id] = subs - 1
+        elif subs == 1:
+            ch["members"].pop(volume_id, None)
+            for stream_key, run in list(self._relay_runs.items()):
+                if run["channel"] != channel or run.get("dead"):
+                    continue
+                parents = run["parents"]
+                if volume_id not in parents and volume_id not in set(
+                    parents.values()
+                ):
+                    continue
+                new_parents, _moved = relay_mod.reparent(
+                    parents, run["root"], {volume_id}
+                )
+                parents.clear()
+                parents.update(new_parents)
+                task = run["tasks"].pop(volume_id, None)
+                if task is not None:
+                    task.cancel()
+                self._relay_sync_tasks(run)
+                await self._relay_notify(run)
+        ch["epoch"] += 1
+        if not ch["members"]:
+            self._relay_channels.pop(channel, None)
+        obs_recorder.record(
+            "stream", f"relay_unsubscribe/{channel}", volume=volume_id
+        )
+        return {"members": ch["members"].get(volume_id, 0) if ch else 0}
+
+    @endpoint
+    async def relay_topology(self) -> dict[str, Any]:
+        """Operator view of every channel's broadcast shape: members (with
+        subscriber refcounts), topology epoch, configured fanout, and each
+        live run's tree + per-member landed progress — ``ts.relay_topology()``
+        surfaces this without reading controller state."""
+        out: dict[str, Any] = {}
+        for channel, ch in self._relay_channels.items():
+            runs: dict[str, Any] = {}
+            for stream_key, run in self._relay_runs.items():
+                if run["channel"] != channel:
+                    continue
+                runs[stream_key] = {
+                    "version": run["version"],
+                    "root": run["root"],
+                    "parents": dict(run["parents"]),
+                    "sealed": bool(run["sealed"]),
+                    "keys": len(run["metas"]),
+                    "landed": {
+                        vid: len(keys) for vid, keys in run["landed"].items()
+                    },
+                }
+            out[channel] = {
+                "members": dict(ch["members"]),
+                "epoch": ch["epoch"],
+                "fanout": self._relay_fanout,
+                "runs": runs,
+            }
+        return out
 
     # ---- prewarm capacity reservations -----------------------------------
 
@@ -1384,6 +2024,10 @@ class Controller(Actor):
                         tasks=self._health_tasks,
                         log=logger,
                     )
+                    # Broadcast trees route around the dark node NOW:
+                    # orphaned subtrees re-attach to a healthy ancestor and
+                    # resume from their last landed watermark.
+                    await self._relay_on_quarantine(vid)
                     if self._auto_repair:
                         self._start_auto_repair(vid)
         if changed:
@@ -1505,7 +2149,10 @@ class Controller(Actor):
                     metas = [m for _, ms, _ in batch for m in ms]
                     try:
                         result = await tgt_ref.pull_from.call_one(
-                            src_ref, metas
+                            src_ref,
+                            metas,
+                            src_hostname=self.volume_hostnames.get(src, ""),
+                            src_volume=src,
                         )
                     except Exception as exc:  # noqa: BLE001 - per-batch
                         logger.warning(
@@ -1762,6 +2409,11 @@ class Controller(Actor):
         for task in list(self._reclaim_tasks):
             task.cancel()
         self._reclaim_tasks.clear()
+        for task in list(self._relay_tasks):
+            task.cancel()
+        self._relay_tasks.clear()
+        self._relay_runs.clear()
+        self._relay_channels.clear()
         self._prewarm_reservations.clear()
         self._expire_prewarm()  # zero the reserved-bytes gauges too
         self._streams.clear()

@@ -28,6 +28,10 @@ store's hot paths:
                           notify_put_batch — delay/wedge holds committed
                           bytes invisible to streaming readers (they keep
                           long-polling); raise fails the publisher's put
+    relay.forward         relay-node entry of every broadcast forwarding hop
+                          (StorageVolume.pull_from with relay=True): arming
+                          it inside one volume kills/wedges THAT relay node
+                          mid-broadcast — the re-parenting chaos schedule
     actor.ping            ActorServer control-ping (per process: arming it
                           inside a volume wedges THAT volume's heartbeats)
     bulk.send_frame       bulk transport frame send (client and server)
@@ -95,6 +99,7 @@ REGISTRY: frozenset[str] = frozenset(
         "shm.landing_stamp",
         "channel.publish_layer",
         "channel.watermark",
+        "relay.forward",
         "actor.ping",
         "bulk.send_frame",
         "bulk.recv_frame",

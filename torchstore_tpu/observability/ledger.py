@@ -35,7 +35,6 @@ measures the always-on cost on the warm many-keys legs.
 from __future__ import annotations
 
 import os
-import socket
 import threading
 import time
 from typing import Iterable, Optional
@@ -48,7 +47,12 @@ INGRESS = "ingress"
 
 
 def _hostname() -> str:
-    return os.environ.get("TORCHSTORE_TPU_HOSTNAME") or socket.gethostname()
+    # utils.get_hostname is THE host identity (env-overridable) shared by
+    # transports, volume registration, and relay membership — ledger host
+    # labels must never diverge from it or edges stop matching volumes.
+    from torchstore_tpu.utils import get_hostname
+
+    return get_hostname()
 
 
 def local_host() -> str:

@@ -40,7 +40,6 @@ from __future__ import annotations
 import collections
 import json
 import os
-import socket
 import tempfile
 import threading
 import time
@@ -59,6 +58,15 @@ ALERT_KINDS = frozenset({"fault", "error", "health", "slo"})
 _DUMPS = obs_metrics.counter(
     "ts_flight_dumps_total", "Flight-recorder post-mortems written, by reason"
 )
+
+
+def _hostname() -> str:
+    """The shared env-overridable host identity (utils.get_hostname) —
+    post-mortem host labels must match ledger/volume/relay labels. Lazy
+    import: the recorder loads before most of the package."""
+    from torchstore_tpu.utils import get_hostname
+
+    return get_hostname()
 
 
 def _env_enabled() -> bool:
@@ -147,10 +155,7 @@ class FlightRecorder:
             "trigger": trigger,
             "ts": time.time(),
             "pid": os.getpid(),
-            "host": (
-                os.environ.get("TORCHSTORE_TPU_HOSTNAME")
-                or socket.gethostname()
-            ),
+            "host": _hostname(),
             "events": events,
         }
         tmp = f"{path}.tmp.{os.getpid()}"

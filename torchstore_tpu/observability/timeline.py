@@ -265,7 +265,6 @@ def reconstruct(state: Optional[dict]) -> Optional[dict]:
 def subscriber_id() -> str:
     """This process's identity in stream acquire acks (bounded: one entry
     per process per stream record)."""
-    import socket as _socket
+    from torchstore_tpu.utils import get_hostname
 
-    host = os.environ.get("TORCHSTORE_TPU_HOSTNAME") or _socket.gethostname()
-    return f"{host}:{os.getpid()}"
+    return f"{get_hostname()}:{os.getpid()}"

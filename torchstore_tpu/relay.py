@@ -1,0 +1,120 @@
+"""Relay-tree topology for one-to-many broadcast weight distribution.
+
+Pure topology math for the controller's broadcast layer (controller.py's
+relay engine): given the ORIGIN volume a publisher's layers land on and the
+set of member volumes whose hosts subscribed to a channel, compute the tree
+each published version flows down — volume-to-volume ``pull_from`` hops, one
+copy per host — and re-route it when a relay node dies.
+
+Shape invariants:
+
+- **The root's out-degree is always 1.** Trainer-host egress is the scarce
+  resource the whole design exists to bound: however many generator fleets
+  subscribe, the origin volume serves exactly ONE relay copy per version
+  (O(1) trainer-host egress); interior nodes fan out at
+  ``TORCHSTORE_TPU_RELAY_FANOUT``.
+- **Deterministic.** Members are ordered by sorted volume id and assigned
+  breadth-first, so every controller (and every test) derives the same tree
+  from the same membership.
+- **Re-parenting never orphans progress.** A dead node's children re-attach
+  to its nearest healthy ancestor (ultimately the root); the relay engine
+  keeps each child's landed-key set across the move, so a re-parented
+  subtree resumes from its last landed watermark and never re-pulls layers
+  it already holds.
+
+Everything here is synchronous, side-effect-free, and unit-testable without
+a fleet; the asyncio engine that drives pulls lives in controller.py.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Optional
+
+# The root (origin volume) forwards to exactly one child regardless of the
+# configured interior fanout — see the module docstring.
+ROOT_FANOUT = 1
+
+
+def build_tree(
+    root: str, members: Iterable[str], fanout: int
+) -> dict[str, str]:
+    """Parent map ``{child: parent}`` over ``members`` rooted at ``root``.
+
+    ``root`` (the origin volume) is excluded from the member set if listed;
+    it takes :data:`ROOT_FANOUT` children, every other node up to
+    ``fanout``. Members are attached breadth-first in sorted-id order.
+    Returns ``{}`` when there is nothing to relay to.
+    """
+    fanout = max(1, int(fanout))
+    order = sorted(set(members) - {root})
+    parents: dict[str, str] = {}
+    slots: deque[list] = deque()
+    slots.append([root, ROOT_FANOUT])
+    for vid in order:
+        while slots and slots[0][1] <= 0:
+            slots.popleft()
+        if not slots:  # unreachable: every attached member adds capacity
+            slots.append([root, ROOT_FANOUT])
+        node = slots[0]
+        node[1] -= 1
+        parents[vid] = node[0]
+        slots.append([vid, fanout])
+    return parents
+
+
+def healthy_ancestor(
+    parents: dict[str, str], root: str, start: str, down: set[str]
+) -> str:
+    """First ancestor of ``start`` (inclusive) not in ``down``, walking the
+    parent chain and bottoming out at ``root`` — the node an orphaned
+    subtree re-attaches to. The root is returned even if listed down (a
+    dead origin means the publisher is gone; there is nothing better)."""
+    node = start
+    seen: set[str] = set()
+    while node in down and node != root and node not in seen:
+        seen.add(node)
+        node = parents.get(node, root)
+    return node if node not in down or node == root else root
+
+
+def reparent(
+    parents: dict[str, str], root: str, down: set[str]
+) -> tuple[dict[str, str], dict[str, tuple[str, str]]]:
+    """Drop ``down`` nodes from the tree and re-attach their orphaned
+    children to their nearest healthy ancestor. Returns ``(new_parents,
+    moved)`` where ``moved`` maps each re-parented child to its
+    ``(old_parent, new_parent)`` edge — the engine records one
+    flight-recorder decision per entry."""
+    new: dict[str, str] = {}
+    moved: dict[str, tuple[str, str]] = {}
+    for child, parent in parents.items():
+        if child in down:
+            continue  # dead nodes leave the tree entirely
+        if parent in down:
+            anc = healthy_ancestor(parents, root, parent, down)
+            new[child] = anc
+            moved[child] = (parent, anc)
+        else:
+            new[child] = parent
+    return new, moved
+
+
+def depth_of(
+    parents: dict[str, str], root: str, node: str
+) -> Optional[int]:
+    """Hops from ``root`` to ``node`` (0 for the root itself); None when
+    ``node`` is not in the tree or the chain is broken/cyclic."""
+    if node == root:
+        return 0
+    hops = 0
+    seen: set[str] = set()
+    while node in parents:
+        if node in seen:
+            return None
+        seen.add(node)
+        node = parents[node]
+        hops += 1
+        if node == root:
+            return hops
+    return None

@@ -599,30 +599,97 @@ class StorageVolume(Actor):
         }
 
     @endpoint
-    async def pull_from(self, src, metas: list[Request]) -> dict[str, Any]:
-        """Volume-to-volume re-replication (the controller's auto-repair
-        data plane): pull ``metas`` from the volume at ActorRef ``src``
-        over the RPC transport and store them locally — no client
-        involvement, works across hosts (actor RPC frames tensor bytes
-        out-of-band). Returns fresh local write generations so the
-        controller can index the new copy with a sound reclaim token."""
-        from torchstore_tpu.transport.rpc import RPCTransportBuffer
+    async def pull_from(
+        self,
+        src,
+        metas: list[Request],
+        src_hostname: str = "",
+        src_volume: str = "",
+        relay: bool = False,
+    ) -> dict[str, Any]:
+        """Volume-to-volume copy: pull ``metas`` from the volume at
+        ActorRef ``src`` and store them locally — no client involvement,
+        works across hosts. The data plane for the controller's auto-repair
+        AND every broadcast relay hop (``relay=True``, fired through the
+        ``relay.forward`` faultpoint so chaos schedules can kill/wedge a
+        relay node mid-broadcast).
 
-        buffer = RPCTransportBuffer()
-        remote = await src.get.call_one(buffer, metas)
-        values: dict[int, Any] = {}
-        for idx, meta in enumerate(metas):
-            if meta.is_object or idx in remote.objects:
-                values[idx] = remote.objects[idx]
-            else:
-                values[idx] = remote.tensors[idx]
+        Transport: the bulk rung when available (striped above
+        TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD — relay hops never pay
+        per-key RPC framing), else the RPC frames. Never SHM: this process
+        is itself an SHM *server*; mixing the client-side segment cache
+        into the same TransportContext would fight the serve path.
+
+        Landing: entries that already exist locally are overwritten
+        IN-PLACE through the shared landing pool (``transport/landing.py``
+        — copies overlap each other and this volume's event loop, large
+        tensors chunk across pool threads), preserving the put-path
+        aliasing invariant for any SHM/bulk reader of the old bytes; fresh
+        entries adopt the transport's arrays without a copy.
+
+        ``src_hostname``/``src_volume`` make the transfer PEER-AWARE in the
+        traffic ledger (the buffer records one ingress cell with both
+        endpoints), so ``ts.traffic_matrix()`` attributes relay/repair hops
+        as real src->dst host edges instead of dumping them in
+        "unattributed" — the O(1)-egress acceptance measurement.
+
+        Returns fresh local write generations so the controller can index
+        the new copy with a sound reclaim token."""
+        if relay:
+            await faults.afire("relay.forward")
+        from torchstore_tpu.config import default_config
+        from torchstore_tpu.strategy import StorageVolumeRef
+        from torchstore_tpu.transport import landing
+        from torchstore_tpu.transport.factory import (
+            TransportType,
+            bulk_available,
+            create_transport_buffer,
+        )
+
+        config = default_config()
+        src_ref = StorageVolumeRef(
+            actor=src,
+            volume_id=src_volume or "",
+            transport_context=self.ctx,
+            hostname=src_hostname,
+        )
+        rung = (
+            TransportType.BULK
+            if bulk_available(src_ref, config)
+            else TransportType.RPC
+        )
+        buffer = create_transport_buffer(src_ref, config, force=rung)
+        requests = [meta.meta_only() for meta in metas]
+        results = await buffer.get_from_storage_volume(src_ref, requests)
+        values: dict[int, Any] = dict(enumerate(results))
         affected = {meta.key for meta in metas}
         before = sum(self._entry_nbytes(k) for k in affected)
-        # Repair pull is a landing like any put: bracket it so one-sided
-        # readers of entries it replaces fall back instead of tearing.
+        # A pull is a landing like any put: bracket it so one-sided readers
+        # of entries it replaces fall back instead of tearing.
         pairs = self._stamp_pairs(metas)
         await self._begin_landing(pairs)
         try:
+            existing = self.store.extract_existing(metas)
+            copy_pairs = []
+            for idx, meta in enumerate(metas):
+                dst = existing.get(idx)
+                val = values[idx]
+                if (
+                    dst is not None
+                    and not meta.is_object
+                    and isinstance(val, np.ndarray)
+                    and dst.shape == val.shape
+                    and dst.dtype == val.dtype
+                    and dst is not val
+                ):
+                    # In-place overwrite: SHM/bulk readers aliasing the old
+                    # segment observe the update, exactly like a put.
+                    copy_pairs.append((dst, val))
+                    values[idx] = dst
+            if copy_pairs:
+                await landing.land_async(
+                    copy_pairs, stage="pull_from", config=config
+                )
             self.store.store(metas, values)
         finally:
             self._end_landing(pairs)

@@ -294,8 +294,16 @@ async def get_state_dict_streamed(
     strict: bool = True,
     timeout: Optional[float] = None,
     wait_for_stream_s: Optional[float] = None,
+    relay_volume: Optional[str] = None,
 ) -> Any:
     """Acquire a streamed state dict layer by layer.
+
+    ``relay_volume`` routes the acquire through this host's BROADCAST
+    RELAY copy (see torchstore_tpu/relay.py): the long-poll reports a
+    layer ready only once the tree has landed it on that volume, and the
+    fetch prefers that replica — so K fleets cost O(1) trainer-host
+    egress instead of K×. Fail-safe: when the volume is not a live relay
+    member the gate is ignored and reads serve from the origin volumes.
 
     Each store key is fetched the moment its watermark lands (long-poll on
     the controller — notify-woken, no spin; warm layers are served by the
@@ -360,6 +368,7 @@ async def get_state_dict_streamed(
                 strict,
                 deadline,
                 config,
+                relay_volume=relay_volume,
             )
         except _Restart as exc:
             _FALLBACKS.inc(reason=exc.reason)
@@ -416,6 +425,7 @@ async def _acquire_stream(
     strict: bool,
     deadline: Optional[float],
     config,
+    relay_volume: Optional[str] = None,
 ) -> Any:
     from torchstore_tpu import state_dict_utils as sdu
 
@@ -450,7 +460,11 @@ async def _acquire_stream(
         if not sks:
             return
         fetched = await client.get_batch(
-            {sk: targets_of.get(sk) for sk in sks}, _seed_plan=False
+            {sk: targets_of.get(sk) for sk in sks},
+            _seed_plan=False,
+            # Nearest-copy routing: the relay tree landed this host's own
+            # replica — read it instead of the origin volumes.
+            prefer_volume=relay_volume,
         )
         if first_serve_ts is None:
             first_serve_ts = time.time()
@@ -475,7 +489,7 @@ async def _acquire_stream(
             chunk = poll if remaining is None else min(poll, remaining)
             try:
                 res = await client.wait_for_stream(
-                    key, target, known, timeout=chunk
+                    key, target, known, timeout=chunk, volume_id=relay_volume
                 )
             except TimeoutError:
                 continue  # re-poll (refreshes lag + deadline accounting)
@@ -527,8 +541,18 @@ async def _acquire_stream(
             _LAG.set(known - len(served_sks))
 
         # ---- finalize: seal record + structure + consistency re-check ----
+        marker_sk = sdu._store_key(key, sdu.MAPPING_KEY)
         try:
-            marker = await client.get(sdu._store_key(key, sdu.MAPPING_KEY))
+            # Same nearest-copy preference as the layers: the relay tree
+            # forwards the commit marker at seal, so a leaf host finalizes
+            # against its local copy too.
+            marker = (
+                await client.get_batch(
+                    {marker_sk: None},
+                    _seed_plan=False,
+                    prefer_volume=relay_volume,
+                )
+            )[marker_sk]
         except KeyError as exc:
             raise _Restart("marker_gone") from exc
         if (marker.get("stream") or {}).get("version") != target:

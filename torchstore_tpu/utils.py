@@ -10,6 +10,7 @@ converted to host views at the client boundary (see ``sharding.py``).
 
 from __future__ import annotations
 
+import os
 import socket
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -266,7 +267,13 @@ def get_free_port() -> int:
 
 
 def get_hostname() -> str:
-    return socket.gethostname()
+    """THE host identity every layer keys on — same-host transport
+    selection, volume hostnames, ledger host labels, relay membership.
+    ``TORCHSTORE_TPU_HOSTNAME`` overrides it (tests/benches emulating a
+    multi-host fleet on one box); keeping every consumer on one source
+    means an emulated host is consistently 'remote' everywhere instead of
+    same-host for transports but cross-host for traffic attribution."""
+    return os.environ.get("TORCHSTORE_TPU_HOSTNAME") or socket.gethostname()
 
 
 # jax platform names that mean "a real accelerator is attached". On this

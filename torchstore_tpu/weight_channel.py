@@ -340,10 +340,25 @@ class ChannelStream:
 
 
 class WeightSubscriber:
-    """Consumer side: blocks for fresh versions instead of polling."""
+    """Consumer side: blocks for fresh versions instead of polling.
+
+    ``relay=True`` joins the channel's BROADCAST tree (torchstore_tpu/
+    relay.py): the controller assigns this host's relay volume, published
+    versions flow to it volume-to-volume, and streamed acquires are gated
+    on + routed to that one host-local copy — K generator fleets cost O(1)
+    trainer-host egress instead of K×. ``relay_volume`` pins an explicit
+    member volume (tests/benches emulating multi-host fleets). Membership
+    is elastic: the subscription happens lazily on the first streamed
+    acquire and ``unsubscribe_relay()`` leaves mid-run (the tree re-parents
+    around the departed host)."""
 
     def __init__(
-        self, name: str, store_name: str = "default", client: Any = None
+        self,
+        name: str,
+        store_name: str = "default",
+        client: Any = None,
+        relay: bool = False,
+        relay_volume: Optional[str] = None,
     ) -> None:
         self.name = name
         self._store_name = store_name
@@ -352,6 +367,9 @@ class WeightSubscriber:
         self._last_stream_gen = 0
         self.last_version: Optional[int] = None
         self._last_epoch: Optional[int] = None
+        self._relay = relay or relay_volume is not None
+        self._relay_volume = relay_volume
+        self._relay_home: Optional[str] = None
 
     def _resolve_client(self):
         if self._client is None:
@@ -359,6 +377,38 @@ class WeightSubscriber:
 
             self._client = api.client(self._store_name)
         return self._client
+
+    async def _ensure_relay(self, client) -> Optional[str]:
+        """Join the channel's relay tree once (lazy, idempotent); returns
+        the assigned home volume id, or None when relay is off/disabled."""
+        if not self._relay:
+            return None
+        if self._relay_home is None:
+            res = await client.relay_subscribe(
+                self.name, volume_id=self._relay_volume
+            )
+            self._relay_home = res.get("volume_id")
+            if self._relay_home is None:
+                # Disabled fleet-wide (TORCHSTORE_TPU_RELAY_ENABLED=0):
+                # stop retrying the control RPC on every acquire.
+                self._relay = False
+            else:
+                obs_recorder.record(
+                    "stream",
+                    "relay_join",
+                    channel=self.name,
+                    volume=self._relay_home,
+                )
+        return self._relay_home
+
+    async def unsubscribe_relay(self) -> None:
+        """Elastic leave: drop this subscriber from the channel's broadcast
+        tree (live runs re-parent around the host). Idempotent."""
+        if self._relay_home is None:
+            return
+        client = self._resolve_client()
+        await client.relay_unsubscribe(self.name, self._relay_home)
+        self._relay_home = None
 
     async def acquire(
         self,
@@ -481,6 +531,7 @@ class WeightSubscriber:
         from torchstore_tpu import stream_sync
 
         client = self._resolve_client()
+        relay_home = await self._ensure_relay(client)
         pointer = f"{self.name}/{_STREAM_PTR}"
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -529,6 +580,7 @@ class WeightSubscriber:
                             if deadline is None
                             else max(0.0, deadline - time.monotonic())
                         ),
+                        relay_volume=relay_home,
                     )
                 except (NoMatchingPush, KeyError):
                     # The announced version vanished before the pull (GC'd
