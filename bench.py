@@ -1110,6 +1110,186 @@ async def fanout_section(
         await ts.shutdown("bench_fanout")
 
 
+async def capacity_section(
+    n_versions: int = 8,
+    n_keys: int = 16,
+    key_kb: float = 256,
+    hot_version: int = 1,
+    warm_reps: int = 8,
+) -> dict:
+    """Tiered capacity (ISSUE 12): the working set exceeds the memory-tier
+    pool budget 2x, one version is pinned hot by a cohort lease, and the
+    spill writer demotes the cold rest to disk.
+
+    Its own fleet with the tier knobs set so ``n_versions`` published
+    channel versions total exactly TWICE the configured pool budget. After
+    a deterministic ``ts.tier_sweep()``:
+
+    - ``warm_get_after_spill_us``: per-key warm get of the LEASED version
+      (min-of-reps, one-sided stamped reads) — the acceptance is that warm
+      leased-version latency is unchanged by the spill tier, measured with
+      ``warm_get_rpcs`` (volume get-RPC delta across the warm reps; 0 =
+      the warm path stayed zero-RPC);
+    - ``fault_in_p50_ms``: per-key first-get latency of cold SPILLED
+      versions — the disk->memory promotion through the normal transport
+      ladder (no new per-get RPC: the fault-in rides the same get the
+      one-sided miss path already falls back to);
+    - ``spilled_bytes_ratio``: spilled / (resident + spilled) volume bytes
+      after the sweep (> 0.5 by construction when the policy works).
+    """
+    import os as _os
+    import shutil as _shutil
+    import statistics
+    import tempfile as _tempfile
+
+    import torchstore_tpu as ts
+
+    n_elem = max(1, int(key_kb * 1024 // 4))
+    version_bytes = n_keys * n_elem * 4
+    # Working set (n_versions x version_bytes) = 2x the pool budget.
+    budget = max(1, n_versions * version_bytes // 2)
+    tier_dir = _tempfile.mkdtemp(prefix="ts_bench_tier_")
+    knobs = {
+        "TORCHSTORE_TPU_TIER_ENABLED": "1",
+        "TORCHSTORE_TPU_TIER_DIR": tier_dir,
+        "TORCHSTORE_TPU_TIER_BUDGET_BYTES": str(budget),
+        "TORCHSTORE_TPU_TIER_HIGH_PCT": "0.70",
+        "TORCHSTORE_TPU_TIER_LOW_PCT": "0.40",
+        # Deterministic: the section triggers its own sweep.
+        "TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S": "0",
+    }
+    saved = {k: _os.environ.get(k) for k in knobs}
+    _os.environ.update(knobs)
+    try:
+        await ts.initialize(
+            store_name="bench_capacity",
+            strategy=ts.SingletonStrategy(default_transport_type="shm"),
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    lease = None
+    client = ts.client("bench_capacity")
+    try:
+        pub = ts.WeightPublisher(
+            "cap", store_name="bench_capacity", keep=n_versions + 1
+        )
+        for v in range(n_versions):
+            await pub.publish(
+                {
+                    f"w{i}": np.full(n_elem, float(v), np.float32)
+                    for i in range(n_keys)
+                }
+            )
+        lease = await client.lease_acquire(
+            "bench-hot", "cap", hot_version, ttl_s=600
+        )
+        assert lease["resident_keys"] > 0, lease
+        await client.tier_sweep()
+        vid = sorted(client._volume_refs)[0]
+        vstats = await client._volume_refs[vid].actor.stats.call_one()
+        tier = vstats.get("tier") or {}
+        resident = int(tier.get("resident_bytes", 0))
+        spilled = int(tier.get("spilled_bytes", 0))
+        spilled_ratio = spilled / max(1, resident + spilled)
+        catalog = await ts.version_catalog("cap", store_name="bench_capacity")
+        hot_rec = catalog["cap"][hot_version]
+        assert hot_rec["spilled_keys"] == 0, (
+            f"leased-hot v{hot_version} was demoted: {hot_rec}"
+        )
+
+        def _get_rpcs(stats: dict) -> float:
+            series = (
+                (stats.get("metrics") or {})
+                .get("ts_volume_get_ops_total", {})
+                .get("series", [])
+            )
+            return sum(s["value"] for s in series)
+
+        # Warm leg: the leased-hot version through reused destinations —
+        # one recording get re-records the one-sided plans, then every
+        # timed rep is a zero-RPC stamped read.
+        hot_keys = [f"cap/v{hot_version}/w{i}" for i in range(n_keys)]
+        dests = {sk: np.empty(n_elem, np.float32) for sk in hot_keys}
+        await ts.get_batch(dict(dests), store_name="bench_capacity")
+        rpcs0 = _get_rpcs(
+            await client._volume_refs[vid].actor.stats.call_one()
+        )
+        warm = []
+        for _ in range(max(2, warm_reps)):
+            t0 = time.perf_counter()
+            await ts.get_batch(dict(dests), store_name="bench_capacity")
+            warm.append(time.perf_counter() - t0)
+        assert float(next(iter(dests.values()))[0]) == float(hot_version)
+        warm_rpcs = (
+            _get_rpcs(await client._volume_refs[vid].actor.stats.call_one())
+            - rpcs0
+        )
+        # Fault-in leg: first gets of cold SPILLED versions promote each
+        # key from disk through the normal get path.
+        cold = sorted(
+            v
+            for v, rec in catalog["cap"].items()
+            if rec["keys"] and rec["spilled_keys"] == rec["keys"]
+        )
+        fault_ms: list[float] = []
+        for v in cold[:2]:
+            for i in range(n_keys):
+                t0 = time.perf_counter()
+                arr = await ts.get(
+                    f"cap/v{v}/w{i}", store_name="bench_capacity"
+                )
+                fault_ms.append((time.perf_counter() - t0) * 1e3)
+                assert float(np.asarray(arr)[0]) == float(v), (
+                    f"fault-in served wrong generation for v{v}/w{i}"
+                )
+        out = {
+            "n_versions": n_versions,
+            "n_keys": n_keys,
+            "key_kb": key_kb,
+            "working_set_mb": round(n_versions * version_bytes / 1e6, 2),
+            "budget_mb": round(budget / 1e6, 2),
+            "resident_bytes": resident,
+            "spilled_bytes": spilled,
+            "spilled_bytes_ratio": round(spilled_ratio, 3),
+            "warm_get_after_spill_us": round(
+                min(warm) / n_keys * 1e6, 2
+            ),
+            "warm_get_rpcs": warm_rpcs,
+            "fault_in_p50_ms": round(statistics.median(fault_ms), 3),
+            "fault_in_keys": len(fault_ms),
+            "cold_versions_measured": cold[:2],
+        }
+        print(
+            f"# capacity ({out['working_set_mb']:.1f} MB working set vs "
+            f"{out['budget_mb']:.1f} MB budget): spilled ratio "
+            f"{out['spilled_bytes_ratio']:.2f}, warm leased get "
+            f"{out['warm_get_after_spill_us']:.1f} us/key "
+            f"({warm_rpcs:+.0f} get RPCs across warm reps), fault-in p50 "
+            f"{out['fault_in_p50_ms']:.2f} ms/key over {len(fault_ms)} "
+            "cold key(s)",
+            file=sys.stderr,
+        )
+        if warm_rpcs:
+            print(
+                "# capacity WARN: warm leased-version reps issued get "
+                "RPCs — the zero-RPC one-sided path regressed",
+                file=sys.stderr,
+            )
+        return out
+    finally:
+        if lease is not None:
+            try:
+                await client.lease_release(lease["lease_id"])
+            except Exception:  # noqa: BLE001 - teardown clears leases too
+                pass
+        await ts.shutdown("bench_capacity")
+        _shutil.rmtree(tier_dir, ignore_errors=True)
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -1132,6 +1312,9 @@ async def run(
     fanout_layers: int = 8,
     fanout_layer_kb: float = 128,
     fanout_train_ms: float = 10.0,
+    capacity_versions: int = 8,
+    capacity_keys: int = 16,
+    capacity_key_kb: float = 256,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -1388,6 +1571,13 @@ async def run(
         layer_kb=fanout_layer_kb,
         train_ms=fanout_train_ms,
     )
+    # Capacity section (ISSUE 12): working set 2x the tier budget, one
+    # leased-hot version, spill + fault-in measured on its own fleet.
+    capacity = await capacity_section(
+        n_versions=capacity_versions,
+        n_keys=capacity_keys,
+        key_kb=capacity_key_kb,
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -1456,6 +1646,16 @@ async def run(
         "fanout_egress_ratio": fanout["fanout_egress_ratio"],
         "fanout_overlap_ratio": fanout["fanout_overlap_ratio"],
         "fanout": fanout,
+        # ISSUE-12 headline stats at top level: warm leased-version get
+        # cost after the spill writer ran (acceptance: unchanged within
+        # bench_compare thresholds, zero warm get RPCs), cold-version
+        # fault-in latency through the transport ladder, and how much of
+        # the over-budget working set the policy demoted; full section
+        # under "capacity".
+        "warm_get_after_spill_us": capacity["warm_get_after_spill_us"],
+        "fault_in_p50_ms": capacity["fault_in_p50_ms"],
+        "spilled_bytes_ratio": capacity["spilled_bytes_ratio"],
+        "capacity": capacity,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -1494,6 +1694,11 @@ if __name__ == "__main__":
         # Standalone fan-out run: one JSON line with the tree vs
         # point-to-point trainer-host egress and deep-hop overlap.
         print(json.dumps(asyncio.run(fanout_section())))
+        sys.exit(0)
+    if "--capacity" in sys.argv:
+        # Standalone tiered-capacity run: one JSON line with the
+        # spill/fault-in/warm-leased-get numbers.
+        print(json.dumps(asyncio.run(capacity_section())))
         sys.exit(0)
     result = asyncio.run(run())
     # The headline JSON lands BEFORE the device section: a wedged TPU

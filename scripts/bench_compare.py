@@ -73,6 +73,15 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     # deep-hop overlap is timing-derived and budgeted like overlap_ratio.
     "fanout_egress_ratio": ("lower", 0.10, "abs"),
     "fanout_overlap_ratio": ("higher", 0.35, "rel"),
+    # Tiered capacity (ISSUE 12). The warm leased-version get after the
+    # spill writer ran must stay in the one-sided per-key-us regime
+    # (budgeted like per_key_get_us); fault-in is disk I/O + a landing
+    # copy, budgeted loosely against host weather; the spilled ratio is
+    # structural at a fixed working-set/budget shape, so a drop means the
+    # watermark policy stopped demoting.
+    "warm_get_after_spill_us": ("lower", 0.60, "rel"),
+    "fault_in_p50_ms": ("lower", 1.00, "rel"),
+    "spilled_bytes_ratio": ("higher", 0.30, "rel"),
 }
 
 

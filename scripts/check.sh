@@ -15,10 +15,12 @@
 #                               entry point; same checker as tslint)
 #   3. bench + trajectory smoke pytest over test_bench_smoke.py (the REAL
 #                               bench.py code path at KB scale, incl. the
-#                               ledger_overhead telemetry-cost section and
+#                               ledger_overhead telemetry-cost section,
 #                               the relay fanout section's O(1)-egress
-#                               bound) and test_bench_compare.py (the
-#                               BENCH_r* regression gate itself)
+#                               bound, and the tiered-capacity section's
+#                               spill/fault-in/warm-leased-get gates) and
+#                               test_bench_compare.py (the BENCH_r*
+#                               regression gate itself)
 #
 # The full tier-1 suite stays `python -m pytest tests/ -q -m 'not slow'`.
 set -u

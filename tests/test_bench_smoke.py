@@ -39,6 +39,9 @@ async def test_bench_run_tiny(capsys):
         streamed_train_ms=5.0,
         streamed_decode_ms=5.0,
         streamed_iters=1,
+        capacity_versions=4,
+        capacity_keys=4,
+        capacity_key_kb=4,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -134,6 +137,20 @@ async def test_bench_run_tiny(capsys):
     rec = result["recovery"]
     assert rec["detect_s"] > 0 and rec["rereplicate_s"] > 0
     assert rec["victim_keys"] > 0
+
+    # Tiered-capacity section (ISSUE 12): headline keys at top level, the
+    # full section under "capacity". KB-scale TIMES are noise — structure,
+    # positivity, and the structural invariants (working set over budget,
+    # bytes actually spilled, zero warm get RPCs) are asserted; the
+    # latency bars are the full-scale run's bench_compare contract.
+    assert result["warm_get_after_spill_us"] > 0
+    assert result["fault_in_p50_ms"] > 0
+    assert result["spilled_bytes_ratio"] > 0
+    cap = result["capacity"]
+    assert cap["working_set_mb"] >= 2 * cap["budget_mb"]
+    assert cap["spilled_bytes"] > 0
+    assert cap["warm_get_rpcs"] == 0
+    assert cap["fault_in_keys"] > 0
 
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
@@ -253,6 +270,32 @@ async def test_bench_ledger_overhead_section_tiny():
     assert "overhead_pct" in out
     assert obs_ledger.ledger().enabled
     assert obs_recorder.recorder().enabled
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_capacity_section_tiny():
+    """The capacity section standalone (``bench.py --capacity``) at KB
+    scale: a real tier-enabled fleet whose working set is 2x the pool
+    budget with one leased-hot version — the spill writer demotes the
+    cold rest, the warm leased leg stays zero-RPC, and cold versions
+    fault back in with the right bytes. The ISSUE-12 acceptance shape can
+    never ship broken."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.capacity_section(n_versions=4, n_keys=4, key_kb=4)
+    assert out["working_set_mb"] >= 2 * out["budget_mb"]
+    assert out["spilled_bytes"] > 0 and out["spilled_bytes_ratio"] > 0
+    # Warm leased-version reps issued ZERO get RPCs: the one-sided path
+    # survived the spill sweep (the "unchanged warm latency" acceptance).
+    assert out["warm_get_rpcs"] == 0, out
+    assert out["warm_get_after_spill_us"] > 0
+    assert out["fault_in_p50_ms"] > 0 and out["fault_in_keys"] > 0
+    assert out["cold_versions_measured"], out
     json.dumps(out)
 
 

@@ -353,6 +353,148 @@ async def test_chaos_wedged_stream_publisher_never_mixes(fast_health):
         await ts.shutdown("chaos_wedge")
 
 
+async def test_chaos_tiered_cohorts_kill_mid_spill_and_fault_in(
+    fast_health, monkeypatch, tmp_path
+):
+    """ISSUE 12 acceptance: 3 cohorts pinned to 3 different versions read
+    concurrently while the publisher advances LATEST and the spill writer
+    runs — zero mixed-generation reads, no pinned version GC'd or
+    spilled-then-lost while leased. The chaos schedule kills a volume
+    MID-SPILL (``volume.spill`` die) and injects ``volume.fault_in``
+    raises mid-promotion: pinned cohorts reconverge through replica
+    failover + auto-repair with NO ``ts.repair()`` anywhere."""
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_ENABLED", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_DIR", str(tmp_path / "tier"))
+    # Tiny budget: the working set crosses the HIGH watermark after a few
+    # versions, so every sweep below actually demotes.
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_BUDGET_BYTES", str(48 * 1024))
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_HIGH_PCT", "0.5")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_LOW_PCT", "0.25")
+    # Deterministic: the test drives its own sweeps.
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S", "0")
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="chaos_tier",
+    )
+    pins = {"rollout-v0": 0, "eval-v1": 1, "canary-v2": 2}
+    report = {"pinned_reads": 0, "pinned_errors": [], "sweep_rounds": 0}
+    stop = asyncio.Event()
+    victim = {}
+    try:
+        client = ts.client("chaos_tier")
+        await client._ensure_setup()
+        pub = ts.WeightPublisher("chaos", store_name="chaos_tier", keep=3)
+        for v in range(3):
+            await pub.publish(_state_dict(v))
+        leases = {
+            cohort: await client.lease_acquire(
+                cohort, "chaos", v, ttl_s=300
+            )
+            for cohort, v in pins.items()
+        }
+        assert all(le["resident_keys"] > 0 for le in leases.values())
+
+        async def cohort_loop(cohort: str, version: int):
+            sub = ts.WeightSubscriber(
+                "chaos", store_name="chaos_tier", cohort=cohort
+            )
+            try:
+                while not stop.is_set():
+                    sd, got = await sub.acquire(version=version)
+                    assert got == version
+                    _assert_consistent(sd, version)
+                    report["pinned_reads"] += 1
+                    await asyncio.sleep(0.05)
+            except BaseException as exc:  # noqa: BLE001 - reported below
+                report["pinned_errors"].append(f"{cohort}: {exc!r}")
+                raise
+
+        async def sweep_loop():
+            while not stop.is_set():
+                await ts.tier_sweep("chaos_tier")
+                report["sweep_rounds"] += 1
+                await asyncio.sleep(0.15)
+
+        async def publish_loop():
+            try:
+                for v in range(3, 11):
+                    if v == 5:
+                        # Kill ONE data-holding volume mid-spill: the die
+                        # fires inside the next sweep's spill pass, after
+                        # the demotion decision, before the crash-safe
+                        # disk write commits.
+                        located = await client.controller.locate_volumes.call_one(
+                            ["chaos/v3/w0"]
+                        )
+                        victim["vid"] = sorted(located["chaos/v3/w0"])[0]
+                        await ts.inject_fault(
+                            "volume.spill", "die", count=1,
+                            scope=victim["vid"], store_name="chaos_tier",
+                        )
+                    if v == 8:
+                        # Fault-in raises mid-promotion: pinned reads of
+                        # spilled versions retry/fail over, never error.
+                        # Armed per SURVIVING volume (the mid-spill victim
+                        # is already dead and cannot answer the inject).
+                        for vid in sorted(client._volume_refs):
+                            if vid == victim.get("vid"):
+                                continue
+                            await ts.inject_fault(
+                                "volume.fault_in", "raise", count=2,
+                                scope=vid, store_name="chaos_tier",
+                            )
+                    await pub.publish(_state_dict(v))
+                    await asyncio.sleep(0.1)
+            finally:
+                stop.set()
+
+        tasks = [
+            asyncio.ensure_future(cohort_loop(c, v))
+            for c, v in pins.items()
+        ]
+        tasks.append(asyncio.ensure_future(sweep_loop()))
+        pub_task = asyncio.ensure_future(publish_loop())
+        await asyncio.wait_for(pub_task, timeout=120.0)
+        await asyncio.wait_for(
+            asyncio.gather(*tasks, return_exceptions=False), timeout=60.0
+        )
+        assert report["pinned_errors"] == []
+        assert report["pinned_reads"] >= 3 * 3  # every cohort read repeatedly
+        assert report["sweep_rounds"] > 0
+        # No pinned version was GC'd while leased (keep=3 advanced the
+        # cutoff far past all three), and every pinned read still serves.
+        for cohort, v in pins.items():
+            assert await client.keys(f"chaos/v{v}") != [], f"v{v} reaped"
+            sd, _ = await ts.WeightSubscriber(
+                "chaos", store_name="chaos_tier", cohort=cohort
+            ).acquire(version=v)
+            _assert_consistent(sd, v)
+        # An UNLEASED mid-run version was reaped as usual (leases pin,
+        # they don't disable GC).
+        assert await client.keys("chaos/v4") == []
+        catalog = await ts.version_catalog("chaos", store_name="chaos_tier")
+        for cohort, v in pins.items():
+            assert cohort in [
+                le["cohort"] for le in catalog["chaos"][v]["leases"]
+            ]
+        # The mid-spill kill was detected and the fleet self-healed — the
+        # dead volume is quarantined, no ts.repair() anywhere in this test.
+        deadline = time.monotonic() + 30.0
+        while True:
+            vh = await ts.volume_health("chaos_tier")
+            if vh[victim["vid"]]["state"] == "quarantined":
+                break
+            assert time.monotonic() < deadline, f"never quarantined: {vh}"
+            await asyncio.sleep(0.1)
+        for cohort, lease in leases.items():
+            await client.lease_release(lease["lease_id"])
+    finally:
+        stop.set()
+        await ts.clear_faults(store_name="chaos_tier")
+        await ts.shutdown("chaos_tier")
+
+
 @pytest.mark.slow
 async def test_chaos_soak_randomized(fast_health):
     """Long randomized soak: probabilistic raise/delay faults armed across

@@ -66,6 +66,52 @@ class TestFileBackedStoreUnit:
             store2.get_data(Request.meta_request("k")), np.full(8, 7.0)
         )
 
+    def test_persist_commits_atomically_no_tmp_left(self, tmp_path):
+        """Crash-safe persist (the spill-tier contract): a completed store
+        leaves NO temp files behind — data committed via write-temp +
+        fsync + rename, meta via its own atomic replace."""
+        store = FileBackedStore(str(tmp_path))
+        x = np.random.rand(64).astype(np.float32)
+        store.store([Request.from_tensor("k", x).meta_only()], {0: x})
+        leftovers = [
+            os.path.join(dirpath, f)
+            for dirpath, _dirs, files in os.walk(str(tmp_path))
+            for f in files
+            if f.endswith(".tmp")
+        ]
+        assert leftovers == []
+        np.testing.assert_array_equal(
+            store.get_data(Request.meta_request("k")), x
+        )
+
+    def test_torn_tmp_from_mid_write_death_never_trusted(self, tmp_path):
+        """A process killed mid-spill leaves at worst ``*.tmp`` garbage
+        (the rename never committed): a reload must neither surface an
+        entry from it nor corrupt committed siblings — and must sweep it."""
+        store = FileBackedStore(str(tmp_path))
+        x = np.random.rand(16).astype(np.float32)
+        store.store([Request.from_tensor("good", x).meta_only()], {0: x})
+        # Simulate two death points: (a) a torn data temp beside a
+        # committed entry; (b) an aborted FIRST persist — dir with only a
+        # torn temp, meta never written.
+        good_dir = os.path.dirname(
+            os.path.join(str(tmp_path), os.listdir(str(tmp_path))[0], "x")
+        )
+        with open(os.path.join(good_dir, "data.bin.tmp"), "wb") as f:
+            f.write(b"\x00garbage\x00" * 3)
+        aborted = os.path.join(str(tmp_path), "YWJvcnRlZA")  # "aborted"
+        os.makedirs(aborted)
+        with open(os.path.join(aborted, "data.bin.tmp"), "wb") as f:
+            f.write(b"torn")
+        store2 = FileBackedStore(str(tmp_path))
+        assert set(store2.kv) == {"good"}
+        np.testing.assert_array_equal(
+            store2.get_data(Request.meta_request("good")), x
+        )
+        # The torn temps were swept at load, not left to accumulate.
+        assert not os.path.exists(os.path.join(good_dir, "data.bin.tmp"))
+        assert not os.path.exists(os.path.join(aborted, "data.bin.tmp"))
+
     def test_delete_removes_files(self, tmp_path):
         store = FileBackedStore(str(tmp_path))
         store.store([Request.from_tensor("k", np.ones(4)).meta_only()], {0: np.ones(4)})

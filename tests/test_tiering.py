@@ -1,0 +1,335 @@
+"""Tiered capacity & multi-version serving (torchstore_tpu/tiering/).
+
+Covers the ISSUE-12 subsystem: cohort retention leases (TTL lifecycle, the
+controller's delete guard, lease-aware publisher GC), the per-volume spill
+tier (watermark demotion, leased-hot exemption, fault-in through the normal
+get path, crash-safe abort), version-pinned acquires, and the
+``ts.version_catalog()`` operator view. The chaos-scheduled cohort test
+(kill mid-spill / mid-fault-in) lives in tests/test_chaos.py.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import tiering
+from torchstore_tpu.tiering.leases import LeaseRegistry
+
+
+# ---------------------------------------------------------------------------
+# unit: version grouping + lease registry
+# ---------------------------------------------------------------------------
+
+
+class TestVersionGroup:
+    def test_channel_version_keys(self):
+        assert tiering.version_group("chan/v7/w0") == ("chan", 7)
+        assert tiering.version_group("a/b/v12/MAPPING") == ("a/b", 12)
+        assert tiering.version_group("chan/v7") == ("chan", 7)
+
+    def test_non_version_keys(self):
+        assert tiering.version_group("chan/LATEST") is None
+        assert tiering.version_group("plain_key") is None
+        assert tiering.version_group("chan/vx/w0") is None
+        # A bare leading v-segment has no channel in front of it.
+        assert tiering.version_group("v3/w0") is None
+
+    def test_first_version_segment_wins(self):
+        assert tiering.version_group("a/v1/b/v2/c") == ("a", 1)
+
+
+class TestLeaseRegistry:
+    def test_acquire_renew_release(self):
+        reg = LeaseRegistry(ttl_s=30)
+        lease = reg.acquire("eval", "chan", 3)
+        assert reg.is_pinned("chan", 3) and not reg.is_pinned("chan", 4)
+        assert reg.pinned_groups() == {"chan/v3"}
+        assert reg.blocks_delete("chan/v3/w0")
+        assert not reg.blocks_delete("chan/v4/w0")
+        assert not reg.blocks_delete("chan/LATEST")
+        renewed = reg.renew(lease["lease_id"], ttl_s=60)
+        assert renewed["ttl_s"] == 60
+        assert reg.release(lease["lease_id"]) is True
+        assert reg.release(lease["lease_id"]) is False  # idempotent
+        assert not reg.is_pinned("chan", 3)
+
+    def test_ttl_expiry(self):
+        reg = LeaseRegistry(ttl_s=0.05)
+        lease = reg.acquire("eval", "chan", 1)
+        assert reg.is_pinned("chan", 1)
+        time.sleep(0.08)
+        assert not reg.is_pinned("chan", 1)  # lazy expiry on every query
+        with pytest.raises(KeyError):
+            reg.renew(lease["lease_id"])  # expired: re-acquire instead
+
+    def test_reacquire_renews_instead_of_stacking(self):
+        reg = LeaseRegistry(ttl_s=30)
+        a = reg.acquire("eval", "chan", 1)
+        b = reg.acquire("eval", "chan", 1, ttl_s=90)
+        assert a["lease_id"] == b["lease_id"] and len(reg) == 1
+        # A DIFFERENT cohort's pin on the same version is its own lease.
+        reg.acquire("canary", "chan", 1)
+        assert len(reg) == 2
+        assert sorted(reg.pins("chan")["chan"][1]) == ["canary", "eval"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: spill + fault-in + leases end to end
+# ---------------------------------------------------------------------------
+
+N_KEYS = 4
+N_ELEM = 1024  # 4 KB per tensor
+
+
+def _sd(version: int) -> dict:
+    return {
+        f"w{i}": np.full(N_ELEM, float(version), np.float32)
+        for i in range(N_KEYS)
+    }
+
+
+def _assert_version(sd: dict, version: int) -> None:
+    for key, arr in sd.items():
+        vals = np.unique(np.asarray(arr))
+        assert vals.size == 1 and vals[0] == float(version), (
+            f"{key}: {vals} != v{version}"
+        )
+
+
+@pytest.fixture
+def tiered_store(monkeypatch, tmp_path):
+    """Env for a spill-enabled fleet: budget sized so ~2 versions fit
+    resident (high 0.5 / low 0.25 of 32 KB), background sweeper off —
+    tests drive deterministic ts.tier_sweep() calls."""
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_ENABLED", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_DIR", str(tmp_path / "tier"))
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_BUDGET_BYTES", str(32 * 1024))
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_HIGH_PCT", "0.5")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_LOW_PCT", "0.25")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S", "0")
+
+
+async def test_spill_faults_in_and_exempts_leased(tiered_store):
+    await ts.initialize(store_name="tier1")
+    try:
+        pub = ts.WeightPublisher("cap", store_name="tier1", keep=10)
+        for v in range(4):
+            assert await pub.publish(_sd(v)) == v
+        client = ts.client("tier1")
+        lease = await client.lease_acquire("hot-cohort", "cap", 1)
+        assert lease["resident_keys"] == N_KEYS + 1  # tensors + MAPPING
+        report = await ts.tier_sweep("tier1")
+        (vid,) = report
+        assert report[vid]["spilled"] > 0
+        catalog = await ts.version_catalog("cap", store_name="tier1")
+        # The leased version is exempt: fully resident; cold versions
+        # demoted to disk (budget only fits ~2 versions of 4).
+        assert catalog["cap"][1]["spilled_keys"] == 0
+        assert [le["cohort"] for le in catalog["cap"][1]["leases"]] == [
+            "hot-cohort"
+        ]
+        spilled_versions = [
+            v
+            for v, rec in catalog["cap"].items()
+            if rec["keys"] and rec["spilled_keys"] == rec["keys"]
+        ]
+        assert spilled_versions, catalog
+        # Fault-in: a get of a spilled version serves the CORRECT bytes
+        # through the normal get path (no new API, no repair).
+        v = spilled_versions[0]
+        sd = await ts.get_state_dict(f"cap/v{v}", store_name="tier1")
+        _assert_version(sd, v)
+        # The next sweep reports the promotions and the catalog flips the
+        # faulted keys back to resident.
+        await ts.tier_sweep("tier1")
+        catalog = await ts.version_catalog("cap", store_name="tier1")
+        assert catalog["cap"][v]["spilled_keys"] == 0
+        # Disk-tier traffic is its own matrix section, never a wire edge.
+        matrix = await ts.traffic_matrix("tier1")
+        assert matrix["disk"][vid]["spill_bytes"] > 0
+        assert matrix["disk"][vid]["fault_in_bytes"] > 0
+        await client.lease_release(lease["lease_id"])
+    finally:
+        await ts.shutdown("tier1")
+
+
+async def test_delete_guard_and_lease_aware_gc(tiered_store):
+    await ts.initialize(store_name="tier2")
+    try:
+        client = ts.client("tier2")
+        pub = ts.WeightPublisher("gc", store_name="tier2", keep=2)
+        for v in range(3):
+            await pub.publish(_sd(v))
+        # Pin v1 (still retained under keep=2), then advance LATEST far
+        # enough that an unleased v1 would have been GC'd.
+        lease = await client.lease_acquire("eval", "gc", 1, ttl_s=120)
+        for v in range(3, 6):
+            await pub.publish(_sd(v))
+        sd, version = await ts.WeightSubscriber(
+            "gc", store_name="tier2", cohort="eval"
+        ).acquire(version=1)
+        assert version == 1
+        _assert_version(sd, 1)
+        # Unleased old versions were reaped as usual.
+        assert await client.keys("gc/v0") == []
+        assert await client.keys("gc/v2") == []
+        # A raw delete against the leased version is refused at the
+        # controller (the hard guard, independent of the GC's courtesy).
+        await client.delete_prefix("gc/v1")
+        assert len(await client.keys("gc/v1")) == N_KEYS + 1
+        # Released -> the next publish's GC reaps it.
+        await client.lease_release(lease["lease_id"])
+        await pub.publish(_sd(6))
+        assert await client.keys("gc/v1") == []
+        with pytest.raises(KeyError, match="does not retain"):
+            await ts.WeightSubscriber("gc", store_name="tier2").acquire(
+                version=1
+            )
+    finally:
+        await ts.shutdown("tier2")
+
+
+async def test_pinned_streamed_acquire(tiered_store):
+    await ts.initialize(store_name="tier3")
+    try:
+        pub = ts.WeightPublisher("st", store_name="tier3", keep=10)
+        for v in range(2):
+            cs = pub.stream()
+            for key, arr in _sd(v).items():
+                await cs.put({key: arr})
+            assert await cs.seal() == v
+        client = ts.client("tier3")
+        lease = await client.lease_acquire("replay", "st", 0, ttl_s=120)
+        await ts.tier_sweep("tier3")
+        served = []
+        sub = ts.WeightSubscriber("st", store_name="tier3", cohort="replay")
+        sd, version = await sub.acquire_streamed(
+            version=0,
+            key_order=[f"w{i}" for i in range(N_KEYS)],
+            on_layer=lambda fk, val: served.append(fk),
+            timeout=30,
+        )
+        assert version == 0
+        _assert_version(sd, 0)
+        assert served == [f"w{i}" for i in range(N_KEYS)]
+        # The read-scoped lease released; only the explicit pin remains.
+        catalog = await ts.version_catalog("st", store_name="tier3")
+        assert [le["cohort"] for le in catalog["st"][0]["leases"]] == [
+            "replay"
+        ]
+        await client.lease_release(lease["lease_id"])
+    finally:
+        await ts.shutdown("tier3")
+
+
+async def test_expired_lease_unpins(tiered_store):
+    await ts.initialize(store_name="tier4")
+    try:
+        client = ts.client("tier4")
+        pub = ts.WeightPublisher("ttl", store_name="tier4", keep=2)
+        for v in range(3):
+            await pub.publish(_sd(v))
+        await client.lease_acquire("flaky", "ttl", 1, ttl_s=0.2)
+        await asyncio.sleep(0.3)
+        # The pin lapsed: the next publish's GC reaps v1 (cutoff = 2).
+        await pub.publish(_sd(3))
+        await pub.publish(_sd(4))
+        assert await client.keys("ttl/v1") == []
+        catalog = await ts.version_catalog("ttl", store_name="tier4")
+        assert 1 not in catalog.get("ttl", {})
+    finally:
+        await ts.shutdown("tier4")
+
+
+async def test_failed_spill_leaves_entry_resident(tiered_store):
+    """A spill aborted mid-write (volume.spill raise) must leave the entry
+    fully resident and served — no half-demoted state, no spill record."""
+    await ts.initialize(store_name="tier5")
+    try:
+        pub = ts.WeightPublisher("ab", store_name="tier5", keep=10)
+        for v in range(4):
+            await pub.publish(_sd(v))
+        # Every spill attempt this sweep raises at the faultpoint.
+        await ts.inject_fault(
+            "volume.spill", "raise", count=100, scope="volumes",
+            store_name="tier5",
+        )
+        report = await ts.tier_sweep("tier5")
+        (vid,) = report
+        assert report[vid]["spilled"] == 0
+        assert report[vid]["spilled_keys"] == 0
+        await ts.clear_faults(store_name="tier5")
+        for v in range(4):
+            sd = await ts.get_state_dict(f"ab/v{v}", store_name="tier5")
+            _assert_version(sd, v)
+        # With the fault cleared the policy proceeds normally.
+        report = await ts.tier_sweep("tier5")
+        assert report[vid]["spilled"] > 0
+    finally:
+        await ts.clear_faults(store_name="tier5")
+        await ts.shutdown("tier5")
+
+
+async def test_overwrite_discards_stale_disk_copy(tiered_store):
+    """Re-publishing a spilled key lands fresh resident bytes and drops
+    the stale disk copy — a later sweep+get must serve the NEW bytes."""
+    await ts.initialize(store_name="tier6")
+    try:
+        client = ts.client("tier6")
+        items = {
+            f"ow/v0/w{i}": np.full(N_ELEM, 1.0, np.float32)
+            for i in range(N_KEYS)
+        }
+        await ts.put_batch(items, store_name="tier6")
+        # Fill well past the watermark with other versions, then spill.
+        for v in range(1, 4):
+            await ts.put_batch(
+                {
+                    f"ow/v{v}/w{i}": np.full(N_ELEM, float(v + 1), np.float32)
+                    for i in range(N_KEYS)
+                },
+                store_name="tier6",
+            )
+        await client.tier_sweep()
+        catalog = await ts.version_catalog("ow", store_name="tier6")
+        assert catalog["ow"][0]["spilled_keys"] == catalog["ow"][0]["keys"]
+        # Overwrite the spilled version with fresh bytes.
+        await ts.put_batch(
+            {k: np.full(N_ELEM, 9.0, np.float32) for k in items},
+            store_name="tier6",
+        )
+        out = await ts.get("ow/v0/w0", store_name="tier6")
+        assert float(np.asarray(out)[0]) == 9.0
+        # Spill + fault back in: still the fresh bytes, never the stale
+        # disk copy.
+        await client.tier_sweep()
+        out = await ts.get("ow/v0/w1", store_name="tier6")
+        assert float(np.asarray(out)[0]) == 9.0
+    finally:
+        await ts.shutdown("tier6")
+
+
+async def test_tier_disabled_is_inert():
+    """Without TORCHSTORE_TPU_TIER_ENABLED nothing spills, sweeps report
+    disabled, and the new surface stays queryable (empty catalog tiers)."""
+    await ts.initialize(store_name="tier7")
+    try:
+        pub = ts.WeightPublisher("off", store_name="tier7", keep=10)
+        for v in range(3):
+            await pub.publish(_sd(v))
+        report = await ts.tier_sweep("tier7")
+        assert all(rep.get("enabled") is False for rep in report.values())
+        catalog = await ts.version_catalog("off", store_name="tier7")
+        assert all(
+            rec["spilled_keys"] == 0 for rec in catalog["off"].values()
+        )
+        sd, version = await ts.WeightSubscriber(
+            "off", store_name="tier7"
+        ).acquire(version=1)
+        assert version == 1
+        _assert_version(sd, 1)
+    finally:
+        await ts.shutdown("tier7")

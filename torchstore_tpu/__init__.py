@@ -31,6 +31,10 @@ from torchstore_tpu.api import (
     initialize_spmd,
     inject_fault,
     keys,
+    lease_acquire,
+    lease_list,
+    lease_release,
+    lease_renew,
     metrics_snapshot,
     prewarm,
     put,
@@ -41,7 +45,9 @@ from torchstore_tpu.api import (
     reset_client,
     shutdown,
     sync_timeline,
+    tier_sweep,
     traffic_matrix,
+    version_catalog,
     volume_health,
     wait_for,
 )
@@ -110,6 +116,10 @@ __all__ = [
     "initialize_spmd",
     "inject_fault",
     "keys",
+    "lease_acquire",
+    "lease_list",
+    "lease_release",
+    "lease_renew",
     "metrics_snapshot",
     "prewarm",
     "put",
@@ -122,7 +132,9 @@ __all__ = [
     "shutdown",
     "span",
     "sync_timeline",
+    "tier_sweep",
     "traffic_matrix",
+    "version_catalog",
     "volume_health",
     "wait_for",
 ]

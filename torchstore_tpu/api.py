@@ -921,6 +921,70 @@ async def volume_health(store_name: str = DEFAULT_STORE) -> dict:
     return await c.controller.volume_health.call_one()
 
 
+async def version_catalog(
+    channel: Optional[str] = None, store_name: str = DEFAULT_STORE
+) -> dict:
+    """Per-channel version inventory (torchstore_tpu/tiering/): for every
+    ``{channel}/v{n}`` group the store holds — keys, logical bytes, replica
+    volumes, tier split (resident vs spilled-to-disk), and the live cohort
+    leases pinning it. The operator's answer to "which cohort is holding
+    which version where, and what is it costing"."""
+    return await client(store_name).version_catalog(channel)
+
+
+async def lease_acquire(
+    cohort: str,
+    channel: str,
+    version: int,
+    ttl_s: Optional[float] = None,
+    store_name: str = DEFAULT_STORE,
+) -> dict:
+    """Pin ``(channel, version)`` for ``cohort``: the version is exempt
+    from the publisher's GC (and the controller refuses deletes under it)
+    and from the spill tier's demotion while the lease lives. TTL'd
+    (default ``TORCHSTORE_TPU_LEASE_TTL_S``) — renew to keep. Returns the
+    lease description; pass its ``lease_id`` to renew/release.
+    ``WeightSubscriber.acquire(version=...)`` manages a read-scoped lease
+    for you; use this directly for long-lived cohort pins."""
+    return await client(store_name).lease_acquire(
+        cohort, channel, version, ttl_s
+    )
+
+
+async def lease_renew(
+    lease_id: str,
+    ttl_s: Optional[float] = None,
+    store_name: str = DEFAULT_STORE,
+) -> dict:
+    """Extend a live lease; raises KeyError when it already expired (the
+    cohort must re-acquire and re-validate the version still exists)."""
+    return await client(store_name).lease_renew(lease_id, ttl_s)
+
+
+async def lease_release(
+    lease_id: str, store_name: str = DEFAULT_STORE
+) -> bool:
+    """Drop a lease (idempotent). The version becomes GC- and
+    spill-eligible again once its LAST lease is gone."""
+    return await client(store_name).lease_release(lease_id)
+
+
+async def lease_list(
+    channel: Optional[str] = None, store_name: str = DEFAULT_STORE
+) -> dict:
+    """Live pins as ``{channel: {version: [cohort, ...]}}``."""
+    return await client(store_name).lease_list(channel)
+
+
+async def tier_sweep(store_name: str = DEFAULT_STORE) -> dict:
+    """Run one spill pass across the fleet NOW (instead of waiting for the
+    background ``TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S`` cadence); returns
+    per-volume ``{spilled, fault_ins, resident_bytes, spilled_bytes}``
+    summaries. A no-op reporting ``enabled: False`` per volume when
+    ``TORCHSTORE_TPU_TIER_ENABLED`` is unset."""
+    return await client(store_name).tier_sweep()
+
+
 def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
     """Merge every process's Chrome-trace file (``TORCHSTORE_TPU_TRACE``
     base + pid-suffixed siblings) into ONE Perfetto-loadable timeline with
@@ -1009,6 +1073,10 @@ __all__ = [
     "initialize",
     "initialize_spmd",
     "keys",
+    "lease_acquire",
+    "lease_list",
+    "lease_release",
+    "lease_renew",
     "metrics_snapshot",
     "prewarm",
     "put",
@@ -1021,6 +1089,8 @@ __all__ = [
     "shutdown",
     "state_dict_stream",
     "sync_timeline",
+    "tier_sweep",
     "traffic_matrix",
+    "version_catalog",
     "wait_for",
 ]

@@ -1804,3 +1804,52 @@ class LocalClient:
         bounded controller-side)."""
         await self._ensure_setup()
         await self._controller.stream_ack.call_one(key, version, subscriber)
+
+    # ------------------------------------------------------------------
+    # tiered capacity & multi-version serving (torchstore_tpu/tiering/)
+    # ------------------------------------------------------------------
+
+    async def lease_acquire(
+        self,
+        cohort: str,
+        channel: str,
+        version: int,
+        ttl_s: Optional[float] = None,
+    ) -> dict:
+        """Pin (channel, version) for ``cohort`` against GC and spill
+        (TTL'd; renew to keep it past the TTL). Returns the lease
+        description — carry ``lease_id`` to renew/release."""
+        await self._ensure_setup()
+        return await self._controller.lease_acquire.call_one(
+            cohort, channel, version, ttl_s
+        )
+
+    async def lease_renew(
+        self, lease_id: str, ttl_s: Optional[float] = None
+    ) -> dict:
+        await self._ensure_setup()
+        return await self._controller.lease_renew.call_one(lease_id, ttl_s)
+
+    async def lease_release(self, lease_id: str) -> bool:
+        await self._ensure_setup()
+        return await self._controller.lease_release.call_one(lease_id)
+
+    async def lease_list(
+        self, channel: Optional[str] = None
+    ) -> dict[str, dict[int, list[str]]]:
+        """{channel: {version: [cohort, ...]}} over live leases."""
+        await self._ensure_setup()
+        return await self._controller.lease_list.call_one(channel)
+
+    async def version_catalog(
+        self, channel: Optional[str] = None
+    ) -> dict[str, dict[int, dict]]:
+        """Per-channel versions × tier × leases × bytes (see
+        Controller.version_catalog)."""
+        await self._ensure_setup()
+        return await self._controller.version_catalog.call_one(channel)
+
+    async def tier_sweep(self) -> dict[str, dict]:
+        """Run one fleet spill pass now; returns per-volume summaries."""
+        await self._ensure_setup()
+        return await self._controller.tier_sweep.call_one()

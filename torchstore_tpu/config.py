@@ -107,6 +107,40 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "readers ring a bulk doorbell frame against a volume-cached "
            "get plan instead of issuing the get RPC. Torn/stale reads "
            "fall back loudly to the RPC path."),
+    # --- tiered capacity & multi-version serving (torchstore_tpu/tiering) ---
+    EnvVar("TORCHSTORE_TPU_TIER_ENABLED", "bool", False,
+           "Enable the disk spill tier: per-volume spill writers demote "
+           "cold version groups from the memory/tmpfs tier to disk under "
+           "the watermark policy, and gets on spilled keys fault back in "
+           "through the normal transport ladder. Off: the store is "
+           "memory-capacity-bound exactly as before (warm path pays one "
+           "attribute check)."),
+    EnvVar("TORCHSTORE_TPU_TIER_DIR", "path", None,
+           "Root directory for the disk spill tier (one subdirectory per "
+           "volume id). Default: <tmpdir>/torchstore_tpu_tier. Spill "
+           "writes are crash-safe (write-temp, fsync, rename)."),
+    EnvVar("TORCHSTORE_TPU_TIER_BUDGET_BYTES", "int", None,
+           "Memory-tier pool budget, bytes, the spill watermarks apply "
+           "to. Default: the SHM pool cap "
+           "(TORCHSTORE_TPU_SHM_POOL_MAX_BYTES or its derived default)."),
+    EnvVar("TORCHSTORE_TPU_TIER_HIGH_PCT", "float", 0.85,
+           "Spill HIGH watermark: a volume whose resident bytes exceed "
+           "this fraction of the pool budget starts demoting cold "
+           "version groups (LRU by access; leased versions exempt)."),
+    EnvVar("TORCHSTORE_TPU_TIER_LOW_PCT", "float", 0.65,
+           "Spill LOW watermark: demotion stops once resident bytes drop "
+           "under this fraction of the pool budget."),
+    EnvVar("TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S", "float", 2.0,
+           "Controller tier-sweep period, seconds: every interval the "
+           "controller runs each volume's spill pass with the current "
+           "lease pins and folds tier transitions into the index. <= 0 "
+           "disables the background sweeper (ts.tier_sweep() still runs "
+           "one on demand)."),
+    EnvVar("TORCHSTORE_TPU_LEASE_TTL_S", "float", 30.0,
+           "Default TTL, seconds, for cohort retention leases "
+           "(lease_acquire without an explicit ttl_s; renew to keep a "
+           "version pinned past it). A crashed cohort's pin expires "
+           "instead of retaining capacity forever."),
     # --- cold-start provisioning (prewarm) ----------------------------------
     EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
            "put_state_dict derives a manifest and provisions pools/dials "

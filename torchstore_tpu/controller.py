@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 from torchstore_tpu import faults
 from torchstore_tpu import relay as relay_mod
+from torchstore_tpu import tiering
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import recorder as obs_recorder
@@ -69,6 +70,10 @@ _RELAY_REPARENTS = obs_metrics.counter(
     "ts_relay_reparents_total",
     "Relay-tree edges re-parented onto a healthy ancestor, per channel",
 )
+_LEASE_BLOCKED_DELETES = obs_metrics.counter(
+    "ts_lease_blocked_deletes_total",
+    "Delete requests refused because a cohort lease pins the version",
+)
 
 
 class ObjectType(Enum):
@@ -108,6 +113,11 @@ class StorageInfo:
     # the volume's generation hasn't moved past this — an acknowledged put
     # racing the reclaim can never lose its bytes (ADVICE r3).
     write_gen: int = 0
+    # Capacity tier of this replica's bytes: ``tiering.RESIDENT`` (memory/
+    # tmpfs — the zero-copy warm path) or ``tiering.TIERED`` (demoted to
+    # the volume's disk spill tier; the next get faults it back in).
+    # Metadata only: placement and transports are tier-agnostic.
+    tier: str = tiering.RESIDENT
 
     def merge(self, meta: Request) -> None:
         incoming = _object_type(meta)
@@ -256,6 +266,26 @@ class Controller(Actor):
         self._relay_channels: dict[str, dict] = {}
         self._relay_runs: dict[str, dict] = {}
         self._relay_tasks: set = set()
+        # Cohort retention leases (torchstore_tpu/tiering/leases.py): the
+        # authority on which (channel, version) pairs are pinned.
+        # notify_delete_batch refuses to reap a pinned version's keys, the
+        # tier sweeper passes the pinned groups to every volume's spill
+        # writer, and WeightSubscriber.acquire(version=...) holds a lease
+        # for the read's duration.
+        self._leases = tiering.LeaseRegistry()
+        # Background tier sweeper: every interval, run each volume's spill
+        # pass (with current pins) and fold the reported transitions into
+        # the index's tier states. Disabled when tiering is off or the
+        # interval is <= 0 (ts.tier_sweep() still works on demand). ONE
+        # parse of the enable knob, shared with the volumes' SpillTier —
+        # the two sides must never disagree about whether tiering is on.
+        from torchstore_tpu.tiering import spill as tiering_spill
+
+        self._tier_enabled = tiering_spill.enabled()
+        self._tier_interval = float(
+            os.environ.get("TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S", 2.0)
+        )
+        self._tier_task = None
         # Layer-streamed sync state: sd_key -> {"version", "sealed",
         # "watermarks": {store_key: version}}. ``version`` is the stream in
         # flight (or last begun), ``sealed`` the highest sealed version, and
@@ -311,6 +341,7 @@ class Controller(Actor):
         for vid in self.volume_refs:
             _VOLUME_HEALTH.set(1, volume=vid)
         self._start_supervisor()
+        self._start_tier_sweeper()
         # Unclean-exit post-mortem: a controller dying with faults/errors
         # in its flight ring leaves the last seconds on disk.
         obs_recorder.recorder().arm_exit_dump()
@@ -516,6 +547,9 @@ class Controller(Actor):
                         # against the old meta would land wrong bytes.
                         structural = True
                     info.merge(meta)
+                # Fresh bytes always land in the memory tier (the volume
+                # discards any stale disk-tier copy in the same put).
+                info.tier = tiering.RESIDENT
                 if write_gens:
                     info.write_gen = max(
                         info.write_gen,
@@ -831,6 +865,41 @@ class Controller(Actor):
         volumes held each key so the client can clear the data plane."""
         self.counters["deletes"] += len(keys)
         _DELETES.inc(len(keys))
+        # Retention-lease guard (tiering/): keys under a PINNED
+        # (channel, version) stay indexed whoever issued the delete — the
+        # publisher's GC, close(delete=True), a raw delete_prefix. This is
+        # the hard "never reaped mid-read" guarantee; lease-aware callers
+        # (WeightPublisher._gc) skip pinned versions before ever asking,
+        # and reap a retained version on a LATER publish's GC once its
+        # last lease lapses. One pinned-groups snapshot serves the whole
+        # batch (a per-key lease-table scan would be O(keys x leases) on
+        # the controller loop).
+        pinned = self._leases.pinned_groups()
+        if pinned:
+            blocked = []
+            passed = []
+            for key in keys:
+                group = tiering.version_group(key)
+                if group is not None and tiering.group_key(*group) in pinned:
+                    blocked.append(key)
+                else:
+                    passed.append(key)
+            if blocked:
+                _LEASE_BLOCKED_DELETES.inc(len(blocked))
+                obs_recorder.record(
+                    "tier",
+                    "delete_blocked",
+                    keys=len(blocked),
+                    sample=blocked[0],
+                )
+                logger.warning(
+                    "refusing to delete %d key(s) under leased version(s) "
+                    "(e.g. %s); release or let the cohort leases expire "
+                    "first",
+                    len(blocked),
+                    blocked[0],
+                )
+                keys = passed
         by_volume: dict[str, list[str]] = {}
         for key in keys:
             infos = self.index.pop(key, None)
@@ -1749,6 +1818,197 @@ class Controller(Actor):
             }
         return out
 
+    # ---- tiered capacity & multi-version serving (torchstore_tpu/tiering)
+
+    def _start_tier_sweeper(self) -> None:
+        """(Re)start the background tier sweeper — called from init();
+        idempotent across re-inits. Off unless tiering is enabled AND the
+        interval is positive (manual ``tier_sweep`` still serves)."""
+        if self._tier_task is not None:
+            self._tier_task.cancel()
+            self._tier_task = None
+        if not self._tier_enabled or self._tier_interval <= 0:
+            return
+        self._tier_task = spawn_logged(
+            self._tier_loop(),
+            name="controller.tier_sweep",
+            tasks=self._health_tasks,
+            log=logger,
+        )
+
+    async def _tier_loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self._tier_interval)
+            try:
+                await self._tier_sweep_once()
+            except Exception:  # noqa: BLE001 - one bad sweep must not
+                # kill the sweeper (volumes may be mid-repair)
+                logger.exception("tier sweep failed; retrying next round")
+
+    async def _tier_sweep_once(self) -> dict[str, dict]:
+        """One fleet spill pass: push the current pinned groups to every
+        healthy volume's spill writer, fold the reported spill/fault-in
+        transitions into the index's tier states, and leave a flight-
+        recorder breadcrumb per demotion batch. Tier flips are metadata
+        only — NOT structural: cached plans keep serving the resident hot
+        set, and readers of demoted keys fall back through the normal
+        ladder (which is where the fault-in lives)."""
+        self._leases.expire()
+        pins = sorted(self._leases.pinned_groups())
+        quarantined = self._quarantined_ids()
+        reports: dict[str, dict] = {}
+        for vid, ref in list(self.volume_refs.items()):
+            if vid in quarantined:
+                continue
+            try:
+                rep = await ref.tier_sweep.call_one(pins)
+            except Exception as exc:  # noqa: BLE001 - a dead/wedged volume
+                # is the supervisor's problem, not the sweeper's
+                reports[vid] = {"error": f"{type(exc).__name__}: {exc}"}
+                continue
+            if not rep.get("enabled"):
+                reports[vid] = rep
+                continue
+            for key in rep.get("spilled", ()):
+                infos = self.index.get(key)
+                if infos is not None and vid in infos:
+                    infos[vid].tier = tiering.TIERED
+            for key in rep.get("fault_ins", ()):
+                infos = self.index.get(key)
+                if infos is not None and vid in infos:
+                    infos[vid].tier = tiering.RESIDENT
+            if rep.get("spilled"):
+                obs_recorder.record(
+                    "tier",
+                    f"sweep/{vid}",
+                    spilled=len(rep["spilled"]),
+                    resident_bytes=rep.get("resident_bytes"),
+                    spilled_bytes=rep.get("spilled_bytes"),
+                    pins=len(pins),
+                )
+            reports[vid] = {
+                "spilled": len(rep.get("spilled", ())),
+                "fault_ins": len(rep.get("fault_ins", ())),
+                "resident_bytes": rep.get("resident_bytes"),
+                "spilled_bytes": rep.get("spilled_bytes"),
+                "spilled_keys": rep.get("spilled_keys"),
+            }
+        return reports
+
+    @endpoint
+    async def tier_sweep(self) -> dict[str, dict]:
+        """Run one fleet spill pass NOW (``ts.tier_sweep()``) — the
+        deterministic entry the benches/tests use instead of waiting out
+        the background interval. Returns a per-volume summary."""
+        return await self._tier_sweep_once()
+
+    @endpoint
+    async def lease_acquire(
+        self,
+        cohort: str,
+        channel: str,
+        version: int,
+        ttl_s: Optional[float] = None,
+    ) -> dict:
+        """Pin (channel, version) for a cohort (TTL'd — renew to keep).
+        Returns the lease description; carry its ``lease_id`` to
+        renew/release. Pinning a version whose keys are already gone is
+        allowed (pre-pinning before a publish) but reported via
+        ``resident_keys=0`` so the caller can fail fast if it expected
+        retained data."""
+        lease = self._leases.acquire(cohort, channel, version, ttl_s)
+        # Segment-bounded prefix: "chan/v1" matches "chan/v1/..." but
+        # never "chan/v10/..." (trie path-wise semantics).
+        prefix = tiering.group_key(channel, version)
+        lease["resident_keys"] = sum(
+            1 for _ in self.index.keys().filter_by_prefix(prefix)
+        )
+        return lease
+
+    @endpoint
+    async def lease_renew(
+        self, lease_id: str, ttl_s: Optional[float] = None
+    ) -> dict:
+        return self._leases.renew(lease_id, ttl_s)
+
+    @endpoint
+    async def lease_release(self, lease_id: str) -> bool:
+        return self._leases.release(lease_id)
+
+    @endpoint
+    async def lease_list(
+        self, channel: Optional[str] = None
+    ) -> dict[str, dict[int, list[str]]]:
+        """{channel: {version: [cohort, ...]}} over live leases — what
+        ``WeightPublisher._gc`` consults before reaping old versions."""
+        return self._leases.pins(channel)
+
+    @endpoint
+    async def version_catalog(
+        self, channel: Optional[str] = None
+    ) -> dict[str, dict[int, dict]]:
+        """Per-channel version inventory: for every ``{channel}/v{n}``
+        group in the index, its key count, logical bytes (one replica's),
+        replica volumes, tier split (a key counts resident while ANY
+        replica still serves it from memory), and the live leases pinning
+        it (including pre-pins on versions with no keys yet)."""
+        self._leases.expire()
+        out: dict[str, dict[int, dict]] = {}
+
+        def _rec(chan: str, ver: int) -> dict:
+            return out.setdefault(chan, {}).setdefault(
+                ver,
+                {
+                    "keys": 0,
+                    "bytes": 0,
+                    "resident_keys": 0,
+                    "spilled_keys": 0,
+                    "volumes": set(),
+                    "leases": [],
+                },
+            )
+
+        for key in self.index:
+            group = tiering.version_group(key)
+            if group is None:
+                continue
+            chan, ver = group
+            if channel is not None and chan != channel:
+                continue
+            infos = self.index.get(key)
+            if not infos:
+                continue
+            rec = _rec(chan, ver)
+            rec["keys"] += 1
+            info = next(iter(infos.values()))
+            if info.object_type == ObjectType.TENSOR_SLICE:
+                itemsize = (
+                    info.tensor_meta.np_dtype.itemsize
+                    if info.tensor_meta is not None
+                    else 4
+                )
+                rec["bytes"] += sum(
+                    ts.nelements * itemsize
+                    for ts in info.tensor_slices.values()
+                )
+            elif info.tensor_meta is not None:
+                rec["bytes"] += info.tensor_meta.nbytes
+            if any(i.tier != tiering.TIERED for i in infos.values()):
+                rec["resident_keys"] += 1
+            else:
+                rec["spilled_keys"] += 1
+            rec["volumes"].update(infos)
+        for lease in self._leases.describe():
+            if channel is not None and lease["channel"] != channel:
+                continue
+            _rec(lease["channel"], lease["version"])["leases"].append(lease)
+        for versions in out.values():
+            for rec in versions.values():
+                rec["volumes"] = sorted(rec["volumes"])
+        return out
+
     # ---- prewarm capacity reservations -----------------------------------
 
     def _expire_prewarm(self) -> None:
@@ -2375,6 +2635,9 @@ class Controller(Actor):
             "volume_health": {
                 vid: dict(h) for vid, h in self._vol_health.items()
             },
+            # Live cohort retention leases (tiering/): how many versions
+            # are pinned against GC/spill right now.
+            "active_leases": len(self._leases),
             # The controller process's own registry — metrics are
             # process-local, so remote clients reach these through stats().
             "metrics": obs_metrics.metrics_snapshot(),
@@ -2403,6 +2666,10 @@ class Controller(Actor):
         if self._health_task is not None:
             self._health_task.cancel()
             self._health_task = None
+        if self._tier_task is not None:
+            self._tier_task.cancel()
+            self._tier_task = None
+        self._leases.clear()
         for task in list(self._health_tasks):
             task.cancel()
         self._health_tasks.clear()

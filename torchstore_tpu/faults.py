@@ -28,6 +28,18 @@ store's hot paths:
                           notify_put_batch — delay/wedge holds committed
                           bytes invisible to streaming readers (they keep
                           long-polling); raise fails the publisher's put
+    volume.spill          spill-writer entry per demoted entry, fired after
+                          the demotion decision and before the crash-safe
+                          disk write (tiering/spill.py): die kills the
+                          volume mid-spill — the committed version must
+                          survive on replicas and the write-temp→rename
+                          protocol must never leave a torn spill file
+    volume.fault_in       volume-side entry of every spilled-entry
+                          promotion (the first get of a cold key): raise
+                          fails that get (clients fail over / retry),
+                          delay/wedge holds the fault-in open so readers
+                          observe the landing bracket, die kills the
+                          volume mid-fault-in
     relay.forward         relay-node entry of every broadcast forwarding hop
                           (StorageVolume.pull_from with relay=True): arming
                           it inside one volume kills/wedges THAT relay node
@@ -95,6 +107,8 @@ REGISTRY: frozenset[str] = frozenset(
         "volume.put",
         "volume.get",
         "volume.handshake",
+        "volume.spill",
+        "volume.fault_in",
         "shm.handshake",
         "shm.landing_stamp",
         "channel.publish_layer",

@@ -45,6 +45,12 @@ ENV_LEDGER_WINDOW = "TORCHSTORE_TPU_LEDGER_WINDOW_S"
 EGRESS = "egress"
 INGRESS = "ingress"
 
+# Disk spill-tier transfers (torchstore_tpu/tiering/spill.py) ride this
+# transport label: they are local I/O, not wire traffic, so the matrix
+# builder folds them into their own "disk" section — a placement solver
+# reading "edges" must never mistake spill churn for network load.
+DISK = "disk"
+
 
 def _hostname() -> str:
     # utils.get_hostname is THE host identity (env-overridable) shared by
@@ -254,14 +260,20 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
     their bytes are visible but never double-counted against the client's
     view of the same transfer.
 
+    Disk spill-tier cells (``transport == DISK``) are folded into their
+    own ``"disk"`` section per volume — spill/fault-in I/O stays visible
+    without ever being mistaken for wire bytes on an edge.
+
     Returns ``{"edges": {src_host: {dst_host: {"bytes", "ops"}}},
     "egress": {host: bytes}, "ingress": {host: bytes},
     "volumes": {volume_id: {"bytes_in", "bytes_out"}},
+    "disk": {volume_id: {"spill_bytes", "fault_in_bytes"}},
     "unattributed": {host: {"bytes_in", "bytes_out"}}}``."""
     edges: dict[str, dict[str, dict]] = {}
     egress: dict[str, int] = {}
     ingress: dict[str, int] = {}
     volumes: dict[str, dict] = {}
+    disk: dict[str, dict] = {}
     unattributed: dict[str, dict] = {}
 
     def _edge(src: str, dst: str, nbytes: int, ops: int) -> None:
@@ -281,6 +293,14 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
             peer = cell.get("peer_host") or ""
             direction = cell.get("direction")
             vid = cell.get("volume") or ""
+            if cell.get("transport") == DISK:
+                d = disk.setdefault(
+                    vid or host, {"spill_bytes": 0, "fault_in_bytes": 0}
+                )
+                d[
+                    "spill_bytes" if direction == EGRESS else "fault_in_bytes"
+                ] += nbytes
+                continue
             if vid and peer:
                 # Per-volume totals from peer-aware cells ONLY (same
                 # count-once rule as the edges): an RPC get is recorded
@@ -310,5 +330,6 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
         "egress": egress,
         "ingress": ingress,
         "volumes": volumes,
+        "disk": disk,
         "unattributed": unattributed,
     }

@@ -13,6 +13,16 @@ persists entries under a directory and serves tensors as writable
 
 Layout: ``<root>/<urlsafe(key)>/meta.pkl`` + ``data.bin`` (tensor) or
 ``shard_<i>.bin`` (sharded, coords in meta) or inline object in meta.
+
+Crash safety (the spill-tier contract, torchstore_tpu/tiering/spill.py):
+every FRESH persist is write-temp → flush+fsync → rename, and meta.pkl is
+fsynced before its atomic replace — a process killed at ANY instant leaves
+either no entry (meta absent / still the old one) or a complete one, never
+a torn data file a later fault-in would trust. Leftover ``*.tmp`` files
+from a mid-write death are swept at load. In-place overwrites through a
+served memmap (invariant 6) deliberately keep writing the committed file —
+aliasing readers must observe them — so their durability is page-cache
+best-effort, exactly as before.
 """
 
 from __future__ import annotations
@@ -85,6 +95,17 @@ class FileBackedStore(StorageImpl):
     def _load_all(self) -> None:
         for name in os.listdir(self.root):
             path = os.path.join(self.root, name)
+            if os.path.isdir(path):
+                # Sweep torn temp files a mid-write death left behind: the
+                # rename never committed them, so they are garbage bytes no
+                # entry references — and an entry dir holding ONLY a .tmp
+                # (no meta.pkl) is an aborted first persist, skipped below.
+                for fname in os.listdir(path):
+                    if fname.endswith(".tmp"):
+                        try:
+                            os.unlink(os.path.join(path, fname))
+                        except OSError:
+                            pass
             meta_path = os.path.join(path, _META)
             if not os.path.isfile(meta_path):
                 continue
@@ -124,16 +145,35 @@ class FileBackedStore(StorageImpl):
         tmp = os.path.join(path, _META + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(path, _META))  # atomic commit
+
+    def _persist_file(self, path: str, fname: str, arr: np.ndarray) -> np.ndarray:
+        """Crash-safe fresh persist of one array: write a temp sibling,
+        flush + fsync it, atomically rename into place, then serve a memmap
+        of the COMMITTED file. A death at any point leaves at worst a .tmp
+        the loader sweeps — never a torn ``fname`` (the spill tier's
+        fault-in path trusts every committed data file unconditionally)."""
+        from torchstore_tpu.native import fast_copy
+
+        if arr.size == 0:
+            return np.empty(arr.shape, dtype=arr.dtype)
+        final = os.path.join(path, fname)
+        tmp = final + ".tmp"
+        mm = _map_file(tmp, arr.dtype, arr.shape, "w+")
+        fast_copy(mm, np.ascontiguousarray(arr))
+        mm.flush()  # msync the mapping before fsyncing the inode
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
+        del mm  # release the temp mapping before the rename commits
+        os.replace(tmp, final)
+        return _map_file(final, arr.dtype, arr.shape, "r+")
 
     def _persist_tensor(self, key: str, arr: np.ndarray) -> np.ndarray:
         path = _keydir(self.root, key)
         os.makedirs(path, exist_ok=True)
-        mm = _map_file(os.path.join(path, "data.bin"), arr.dtype, arr.shape, "w+")
-        from torchstore_tpu.native import fast_copy
-
-        if arr.size:
-            fast_copy(mm, np.ascontiguousarray(arr))
+        mm = self._persist_file(path, "data.bin", arr)
         self._write_meta(
             path, {"type": "tensor", "meta": TensorMeta.of(arr)}
         )
@@ -144,16 +184,7 @@ class FileBackedStore(StorageImpl):
     ) -> np.ndarray:
         path = _keydir(self.root, key)
         os.makedirs(path, exist_ok=True)
-        mm = _map_file(
-            os.path.join(path, _shard_file(ts.coordinates)),
-            arr.dtype,
-            arr.shape,
-            "w+",
-        )
-        from torchstore_tpu.native import fast_copy
-
-        if arr.size:
-            fast_copy(mm, np.ascontiguousarray(arr))
+        mm = self._persist_file(path, _shard_file(ts.coordinates), arr)
         self._write_meta(
             path,
             {"type": "sharded", "slices": slices, "dtype": str(arr.dtype)},

@@ -291,6 +291,22 @@ class StorageVolume(Actor):
         self._resident_bytes = sum(
             self._entry_nbytes(key) for key in getattr(self.store, "kv", {})
         )
+        # Spill tier (torchstore_tpu/tiering/spill.py): cold version groups
+        # demote to disk under the watermark policy, gets on spilled keys
+        # fault back in through this volume's normal serve path. None when
+        # TORCHSTORE_TPU_TIER_ENABLED is unset — the warm path then pays
+        # exactly one attribute check.
+        self._tier = None
+        from torchstore_tpu.tiering import spill as tiering_spill
+
+        if tiering_spill.enabled():
+            self._tier = tiering_spill.SpillTier(self.volume_id)
+        # Serializes spill/fault-in mutations of the tier bookkeeping
+        # across endpoint tasks (both are cold-path; the warm path never
+        # touches the lock).
+        import asyncio
+
+        self._tier_lock = asyncio.Lock()
         self._publish_residency()
         from torchstore_tpu import native
         from torchstore_tpu.transport import shared_memory
@@ -347,6 +363,8 @@ class StorageVolume(Actor):
     def _publish_residency(self) -> None:
         _RESIDENT_BYTES.set(self._resident_bytes, volume=self.volume_id)
         _ENTRIES.set(len(getattr(self.store, "kv", {})), volume=self.volume_id)
+        if self._tier is not None:
+            self._tier.publish_gauges(self._resident_bytes)
 
     def _apply_residency_delta(self, keys, before: int) -> None:
         after = sum(self._entry_nbytes(k) for k in keys)
@@ -432,10 +450,153 @@ class StorageVolume(Actor):
             cache.end_writes(pairs)
         self._landing_close()
 
+    # ---- spill tier (torchstore_tpu/tiering/spill.py) --------------------
+
+    async def _tier_fault_in(self, metas: list[Request], reason: str) -> None:
+        """Promote any SPILLED keys among ``metas`` back into the memory
+        tier before they are served: load the crash-safe disk copy, land it
+        through the shared landing pool bracketed by the volume's landing
+        stamps (one-sided readers and doorbells racing the promotion see a
+        busy/moved bracket and fall back to the RPC path — never a torn or
+        half-faulted tensor), store it, then drop the disk copy. The warm
+        path exits on the first check: one attribute + one dict read."""
+        tier = self._tier
+        if tier is None or not tier.spilled:
+            return
+        keys = [meta.key for meta in metas if meta.key in tier.spilled]
+        if not keys:
+            return
+        from torchstore_tpu.transport import landing as landing_mod
+
+        async with self._tier_lock:
+            for key in dict.fromkeys(keys):
+                if key not in tier.spilled:
+                    continue  # a concurrent fault-in already promoted it
+                await faults.afire("volume.fault_in")
+                try:
+                    dmetas, dvalues = tier.load(key)
+                except KeyError:
+                    continue
+                values: dict[int, Any] = {}
+                copy_pairs = []
+                for idx, dmeta in enumerate(dmetas):
+                    val = dvalues[idx]
+                    if isinstance(val, np.ndarray) and val.size:
+                        dst = np.empty_like(val)
+                        copy_pairs.append((dst, val))
+                        values[idx] = dst
+                    else:
+                        values[idx] = val
+                stamp_pairs = self._stamp_pairs(dmetas)
+                before = self._entry_nbytes(key)
+                await self._begin_landing(stamp_pairs)
+                try:
+                    if copy_pairs:
+                        await landing_mod.land_async(
+                            copy_pairs, stage="fault_in"
+                        )
+                    self.store.store(dmetas, values)
+                finally:
+                    self._end_landing(stamp_pairs)
+                self._apply_residency_delta([key], before)
+                tier.faulted_in(key, reason)
+        self._publish_residency()
+
+    def _tier_after_put(self, keys) -> None:
+        """Post-landing tier bookkeeping for fresh writes: a stale disk
+        copy is garbage the moment new bytes land resident, and the write
+        refreshes the version group's LRU clock."""
+        if self._tier is None:
+            return
+        for key in keys:
+            self._tier.discard(key)
+        self._tier.touch(keys)
+
+    @endpoint
+    async def tier_sweep(self, pins: Optional[list[str]] = None) -> dict:
+        """Run one spill pass: when resident bytes exceed the HIGH
+        watermark, demote cold version groups (LRU by access; ``pins`` —
+        leased ``channel/vN`` groups — are exempt) until under LOW. Also
+        drains the fault-in feedback list so the controller can flip index
+        tier states back to resident. Called by the controller's background
+        sweeper and by ``ts.tier_sweep()`` on demand."""
+        import asyncio
+
+        tier = self._tier
+        if tier is None:
+            return {"enabled": False, "spilled": [], "fault_ins": []}
+        spilled: list[str] = []
+        async with self._tier_lock:
+            fault_ins = tier.drain_faulted()
+            if self._resident_bytes > tier.high_bytes:
+                kv = getattr(self.store, "kv", {})
+                for _group, keys in tier.cold_groups(kv, pins or ()):
+                    if self._resident_bytes <= tier.low_bytes:
+                        break
+                    for key in keys:
+                        entry = kv.get(key)
+                        if entry is None:
+                            continue
+                        before = self._entry_nbytes(key)
+                        try:
+                            # The faultpoint fires INSIDE the failure
+                            # domain: a raise (or a crash-safe write
+                            # failure) aborts THIS key's demotion only —
+                            # the entry stays fully resident and served.
+                            await faults.afire("volume.spill")
+                            tier.spill(key, entry)
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:  # noqa: BLE001 - a failed spill
+                            # must leave the entry fully resident + served
+                            logger.exception(
+                                "spill of %r failed; entry stays resident",
+                                key,
+                            )
+                            continue
+                        # Drop the memory copy under the landing bracket:
+                        # one-sided readers of the retired entry fall back
+                        # (stamps tombstone) instead of tearing.
+                        self._landing_open()
+                        try:
+                            self.store.delete(key)
+                            self.ctx.delete_key(key)
+                        finally:
+                            self._landing_close()
+                        self._apply_residency_delta([key], before)
+                        spilled.append(key)
+        if spilled:
+            logger.info(
+                "volume %s spilled %d key(s) to the disk tier "
+                "(resident %d B, spilled %d B, budget %d B)",
+                self.volume_id,
+                len(spilled),
+                self._resident_bytes,
+                tier.spilled_bytes,
+                tier.budget_bytes,
+            )
+        self._publish_residency()
+        return {
+            "enabled": True,
+            "spilled": spilled,
+            "fault_ins": fault_ins,
+            "resident_bytes": self._resident_bytes,
+            "spilled_bytes": tier.spilled_bytes,
+            "spilled_keys": len(tier.spilled),
+            "budget_bytes": tier.budget_bytes,
+        }
+
     @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
         await faults.afire("volume.put")
         t0 = time.perf_counter()
+        if self._tier is not None:
+            # Sharded overwrites land shard-by-shard: promote a spilled
+            # entry FIRST so sibling shards survive the partial overwrite
+            # (whole-entry puts below simply discard the stale disk copy).
+            await self._tier_fault_in(
+                [m for m in metas if m.tensor_slice is not None], "put"
+            )
         pairs = self._stamp_pairs(metas)
         await self._begin_landing(pairs)
         try:
@@ -449,6 +610,7 @@ class StorageVolume(Actor):
         finally:
             self._end_landing(pairs)
         self._apply_residency_delta(affected, before)
+        self._tier_after_put(affected)
         _PUT_OPS.inc(volume=self.volume_id)
         # Data-plane profiling: this volume's own hot-key view + slow-op
         # log (the RPC-dispatch trace context is active here, so a slow put
@@ -485,6 +647,13 @@ class StorageVolume(Actor):
     ) -> TransportBuffer:
         await faults.afire("volume.get")
         t0 = time.perf_counter()
+        if self._tier is not None:
+            # Cold keys fault back in from the disk tier HERE — inside the
+            # existing transport ladder (this get RPC is exactly where the
+            # one-sided/doorbell paths already fall back to), never via a
+            # new per-get RPC. Resident keys pay one dict check.
+            await self._tier_fault_in(metas, "get")
+            self._tier.touch([meta.key for meta in metas])
         entries = [self.store.get_data(meta) for meta in metas]
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
         _GET_OPS.inc(volume=self.volume_id)
@@ -519,6 +688,8 @@ class StorageVolume(Actor):
 
     @endpoint
     async def get_meta(self, metas: list[Request]) -> list[Any]:
+        if self._tier is not None:
+            await self._tier_fault_in(metas, "get_meta")
         return [self.store.get_meta(meta) for meta in metas]
 
     @endpoint
@@ -536,6 +707,8 @@ class StorageVolume(Actor):
                 if self.store.delete(key):
                     self.ctx.delete_key(key)
                     deleted += 1
+                elif self._tier is not None and self._tier.discard(key):
+                    deleted += 1  # spilled-only copy: the disk tier held it
                 self._write_gens.pop(key, None)
         finally:
             self._landing_close()
@@ -576,6 +749,8 @@ class StorageVolume(Actor):
                 if self.store.delete(key):
                     self.ctx.delete_key(key)
                     removed.append(key)
+                elif self._tier is not None and self._tier.discard(key):
+                    removed.append(key)  # stale copy lived in the disk tier
                 self._write_gens.pop(key, None)
         finally:
             self._landing_close()
@@ -647,6 +822,12 @@ class StorageVolume(Actor):
         )
 
         config = default_config()
+        if self._tier is not None:
+            # Same rule as put: sharded pulls overwrite per shard, so a
+            # spilled local copy must promote first to keep its siblings.
+            await self._tier_fault_in(
+                [m for m in metas if m.tensor_slice is not None], "pull"
+            )
         src_ref = StorageVolumeRef(
             actor=src,
             volume_id=src_volume or "",
@@ -694,6 +875,7 @@ class StorageVolume(Actor):
         finally:
             self._end_landing(pairs)
         self._apply_residency_delta(affected, before)
+        self._tier_after_put(affected)
         return {"write_gens": self._bump_write_gens(metas)}
 
     # ---- fault injection (test/chaos control plane) ----------------------
@@ -732,9 +914,11 @@ class StorageVolume(Actor):
         it every recovered copy would carry gen 0 and no reclaim could
         ever fire (any real generation compares newer)."""
         fn = getattr(self.store, "manifest", None)
-        if fn is None:
-            return []
-        items = fn()
+        items = list(fn()) if fn is not None else []
+        if self._tier is not None:
+            # Spilled entries' bytes live ONLY in the disk tier: an index
+            # rebuild that skipped them would silently lose cold versions.
+            items.extend(self._tier.manifest())
         for item in items:
             if isinstance(item, dict):
                 gen = self._write_gens.get(item["meta"].key)
@@ -843,6 +1027,15 @@ class StorageVolume(Actor):
             # telemetry; ts.fleet_snapshot merges them under "ledgers").
             "ledger": obs_ledger.snapshot(),
         }
+        if self._tier is not None:
+            out["tier"] = {
+                "resident_bytes": self._resident_bytes,
+                "spilled_bytes": self._tier.spilled_bytes,
+                "spilled_keys": len(self._tier.spilled),
+                "budget_bytes": self._tier.budget_bytes,
+                "high_bytes": self._tier.high_bytes,
+                "low_bytes": self._tier.low_bytes,
+            }
         from torchstore_tpu.transport.shared_memory import ShmServerCache
 
         cache = self.ctx.peek(ShmServerCache)
@@ -882,6 +1075,8 @@ class StorageVolume(Actor):
             self.store.reset()
             self.ctx.clear()  # tombstones + unlinks the stamp table
             self._write_gens.clear()
+            if self._tier is not None:
+                self._tier.reset()
         finally:
             self._landing_close()
         self._install_doorbell_hook()

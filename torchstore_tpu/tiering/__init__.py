@@ -1,0 +1,78 @@
+"""Tiered capacity & multi-version serving (ROADMAP item 5, ISSUE 12).
+
+The weight channel historically served LATEST and GC'd everything ``keep``
+versions behind, and store capacity was hard-capped by tmpfs. Production RL
+fleets run mixed cohorts — rollout generators on v_t, evaluation on v_{t−k},
+canaries on an experimental branch, replay/debug on arbitrary history — so
+this subsystem adds a version-retention and capacity layer between the data
+plane and the channel protocol:
+
+- **Cohort retention leases** (:mod:`torchstore_tpu.tiering.leases`): a
+  controller-side TTL'd registry pinning ``(channel, version)`` pairs per
+  cohort id. ``WeightPublisher._gc`` / the partial-reclaim path skip pinned
+  versions, the controller's ``notify_delete_batch`` REFUSES to de-index a
+  leased version's keys (the hard guarantee — a pinned version is never
+  reaped mid-read, whoever issues the delete), and
+  ``WeightSubscriber.acquire(version=...)`` holds a lease for the read's
+  duration.
+
+- **Spill tier** (:mod:`torchstore_tpu.tiering.spill`): a per-volume spill
+  writer demotes cold versions' entries from the memory/tmpfs tier to disk
+  (crash-safe write-temp → fsync → rename via ``storage_utils/file_store``)
+  under a watermark policy (``TORCHSTORE_TPU_TIER_HIGH/LOW_PCT`` of the pool
+  budget, LRU by version access, leased-hot versions exempt). Gets on
+  spilled keys FAULT BACK IN through the existing transport ladder: the
+  volume re-lands the entry from disk bracketed by the landing stamps
+  (one-sided readers and doorbells observe a torn/busy bracket and fall
+  back to the RPC get, exactly like any other landing), then serves — the
+  warm path pays nothing beyond one dict lookup.
+
+- **Catalog & observability**: ``ts.version_catalog()`` (per-channel
+  versions × tier × leases × bytes), ``ts_tier_{resident,spilled}_bytes`` /
+  ``ts_spills_total`` / ``ts_fault_ins_total{reason}`` instruments,
+  spill/fault-in decisions on the flight recorder, and ``"disk"`` ledger
+  cells so ``ts.traffic_matrix()`` separates spill I/O from wire bytes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+# Tier states carried per (key, volume) in the controller index
+# (``controller.StorageInfo.tier``) and reported by ``ts.version_catalog``.
+RESIDENT = "resident"
+TIERED = "spilled"
+
+# A channel version's keys look like "{channel}/v{n}/{leaf...}" (including
+# the "{channel}/v{n}/MAPPING" commit marker). The group is the
+# "{channel}/v{n}" prefix — the unit of spill LRU and lease pinning.
+_VERSION_SEG = re.compile(r"^v(\d+)$")
+
+
+def version_group(key: str) -> Optional[tuple[str, int]]:
+    """``(channel, version)`` for a channel-version-shaped key, else None.
+    The FIRST ``v<digits>`` path segment wins (channels may nest slashes;
+    a version directory never does)."""
+    segs = key.split("/")
+    for i in range(1, len(segs)):
+        m = _VERSION_SEG.match(segs[i])
+        if m is not None:
+            return "/".join(segs[:i]), int(m.group(1))
+    return None
+
+
+def group_key(channel: str, version: int) -> str:
+    return f"{channel}/v{int(version)}"
+
+
+from torchstore_tpu.tiering.leases import Lease, LeaseRegistry  # noqa: E402
+
+__all__ = [
+    "Lease",
+    "LeaseRegistry",
+    "RESIDENT",
+    "TIERED",
+    "group_key",
+    "version_group",
+]

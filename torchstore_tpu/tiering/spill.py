@@ -1,0 +1,300 @@
+"""Per-volume spill tier: demote cold versions from memory/tmpfs to disk.
+
+One ``SpillTier`` lives inside each ``StorageVolume`` process (built at
+init when ``TORCHSTORE_TPU_TIER_ENABLED`` is set). It owns:
+
+- a crash-safe disk store (``storage_utils.file_store.FileBackedStore``
+  under ``TORCHSTORE_TPU_TIER_DIR/<volume_id>`` — every fresh persist is
+  write-temp → fsync → rename, so a volume killed mid-spill never leaves a
+  torn file the fault-in path would trust);
+- the watermark policy: when the volume's resident bytes exceed
+  ``TIER_HIGH_PCT`` of the pool budget, whole version groups
+  (``{channel}/v{n}``) are demoted coldest-first (LRU by access) until
+  resident bytes drop under ``TIER_LOW_PCT`` — pinned (leased) groups are
+  exempt, as are keys outside any version group (pointers, ad-hoc keys);
+- the spilled-set bookkeeping the volume's fault-in path consults (one
+  dict lookup on the warm path, nothing else).
+
+The spill/fault-in MECHANICS — landing-stamp brackets, residency deltas,
+faultpoints — stay in ``storage_volume.py`` next to the other landings;
+this module is the policy + disk half.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.tiering import version_group
+from torchstore_tpu.transport.types import Request, TensorMeta
+
+logger = get_logger("torchstore_tpu.tiering.spill")
+
+# Disk-tier ledger cells ride the ledger's DISK transport label — the SAME
+# constant traffic_matrix folds on, so spill I/O can never silently drift
+# into "unattributed" through a one-sided rename.
+DISK_TRANSPORT = obs_ledger.DISK
+
+_SPILLS = obs_metrics.counter(
+    "ts_spills_total", "Entries demoted from the memory tier to disk"
+)
+_FAULT_INS = obs_metrics.counter(
+    "ts_fault_ins_total",
+    "Spilled entries faulted back into the memory tier, by reason",
+)
+_TIER_RESIDENT = obs_metrics.gauge(
+    "ts_tier_resident_bytes",
+    "Bytes resident in this volume's memory (tmpfs) tier",
+)
+_TIER_SPILLED = obs_metrics.gauge(
+    "ts_tier_spilled_bytes",
+    "Bytes demoted to this volume's disk spill tier",
+)
+
+
+def enabled() -> bool:
+    return os.environ.get(
+        "TORCHSTORE_TPU_TIER_ENABLED", "0"
+    ).strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _default_budget() -> int:
+    from torchstore_tpu.config import default_config
+
+    return int(default_config().shm_pool_max_bytes)
+
+
+class SpillTier:
+    """Policy + disk half of one volume's spill tier (see module doc)."""
+
+    def __init__(
+        self,
+        volume_id: str,
+        root: Optional[str] = None,
+        budget_bytes: Optional[int] = None,
+        high_pct: Optional[float] = None,
+        low_pct: Optional[float] = None,
+    ) -> None:
+        from torchstore_tpu.storage_utils.file_store import FileBackedStore
+
+        if root is None:
+            root = os.environ.get("TORCHSTORE_TPU_TIER_DIR") or os.path.join(
+                tempfile.gettempdir(), "torchstore_tpu_tier"
+            )
+        if budget_bytes is None:
+            env = os.environ.get("TORCHSTORE_TPU_TIER_BUDGET_BYTES")
+            budget_bytes = int(env) if env else _default_budget()
+        if high_pct is None:
+            high_pct = float(
+                os.environ.get("TORCHSTORE_TPU_TIER_HIGH_PCT", "0.85")
+            )
+        if low_pct is None:
+            low_pct = float(
+                os.environ.get("TORCHSTORE_TPU_TIER_LOW_PCT", "0.65")
+            )
+        if not (0.0 < low_pct <= high_pct):
+            raise ValueError(
+                f"tier watermarks must satisfy 0 < low <= high "
+                f"(got low={low_pct}, high={high_pct})"
+            )
+        self.volume_id = str(volume_id)
+        self.budget_bytes = max(1, int(budget_bytes))
+        self.high_pct = high_pct
+        self.low_pct = low_pct
+        self.disk = FileBackedStore(os.path.join(root, self.volume_id))
+        # key -> spilled bytes; the ONE structure the warm path consults
+        # (``key in tier.spilled`` — a dict membership test). Seeded from
+        # whatever the disk store already holds: a restarted volume pointed
+        # at the same tier dir resumes serving its spilled set.
+        self.spilled: dict[str, int] = {
+            key: self._disk_entry_nbytes(entry)
+            for key, entry in self.disk.kv.items()
+        }
+        # Version-group LRU clock: group -> last access (monotonic).
+        self.access: dict[str, float] = {}
+        # Fault-ins since the last sweep drained them (tier-state feedback
+        # to the controller's index).
+        self._faulted: list[str] = []
+        self.publish_gauges(resident_bytes=0)
+
+    # ---- accounting ------------------------------------------------------
+
+    @staticmethod
+    def _disk_entry_nbytes(entry: dict) -> int:
+        if entry.get("type") == "tensor":
+            return int(getattr(entry.get("tensor"), "nbytes", 0))
+        if entry.get("type") == "sharded":
+            return sum(
+                int(getattr(s.get("tensor"), "nbytes", 0))
+                for s in entry.get("shards", {}).values()
+            )
+        return 0
+
+    @property
+    def spilled_bytes(self) -> int:
+        return sum(self.spilled.values())
+
+    @property
+    def high_bytes(self) -> int:
+        return int(self.budget_bytes * self.high_pct)
+
+    @property
+    def low_bytes(self) -> int:
+        return int(self.budget_bytes * self.low_pct)
+
+    def publish_gauges(self, resident_bytes: int) -> None:
+        _TIER_RESIDENT.set(resident_bytes, volume=self.volume_id)
+        _TIER_SPILLED.set(self.spilled_bytes, volume=self.volume_id)
+
+    def touch(self, keys: Iterable[str]) -> None:
+        """Refresh the LRU clock for every version group these keys live
+        in (called per put/get batch — only when tiering is enabled).
+
+        The clock sees VOLUME-SIDE access only: zero-RPC one-sided reads
+        never reach this process, so a version read exclusively warm can
+        look cold here. That is by contract, not accident — a cohort that
+        wants its version exempt from demotion holds a retention LEASE
+        (the explicit, attributable pin); recency is only the tiebreak
+        among unpinned versions, and a mistaken demotion costs one
+        fault-in, never correctness."""
+        now = time.monotonic()
+        for key in keys:
+            group = version_group(key)
+            if group is not None:
+                self.access[f"{group[0]}/v{group[1]}"] = now
+
+    def drain_faulted(self) -> list[str]:
+        out, self._faulted = self._faulted, []
+        return out
+
+    # ---- policy ----------------------------------------------------------
+
+    def cold_groups(
+        self, kv: dict[str, dict], pins: Iterable[str]
+    ) -> list[tuple[str, list[str]]]:
+        """Version groups eligible for demotion, coldest-first:
+        ``[(group, [keys...]), ...]``. Pinned (leased) groups and keys
+        outside any version group never appear."""
+        pinned = set(pins or ())
+        groups: dict[str, list[str]] = {}
+        for key in kv:
+            vg = version_group(key)
+            if vg is None:
+                continue
+            group = f"{vg[0]}/v{vg[1]}"
+            if group in pinned:
+                continue
+            groups.setdefault(group, []).append(key)
+        return sorted(
+            groups.items(), key=lambda kv_: self.access.get(kv_[0], 0.0)
+        )
+
+    # ---- disk half -------------------------------------------------------
+
+    @staticmethod
+    def entry_requests(
+        key: str, entry: dict
+    ) -> tuple[list[Request], dict[int, Any]]:
+        """(metas, values) in the StorageImpl.store shape for one in-memory
+        entry — the same dict layout FileBackedStore persists and recovers."""
+        if entry["type"] == "object":
+            return [Request(key=key, is_object=True)], {0: entry["obj"]}
+        if entry["type"] == "tensor":
+            arr = np.ascontiguousarray(entry["tensor"])
+            return (
+                [Request(key=key, tensor_meta=TensorMeta.of(arr))],
+                {0: arr},
+            )
+        metas: list[Request] = []
+        values: dict[int, Any] = {}
+        for idx, shard in enumerate(entry["shards"].values()):
+            arr = np.ascontiguousarray(shard["tensor"])
+            metas.append(
+                Request(
+                    key=key,
+                    tensor_slice=shard["slice"],
+                    tensor_meta=TensorMeta.of(arr),
+                )
+            )
+            values[idx] = arr
+        return metas, values
+
+    def spill(self, key: str, entry: dict) -> int:
+        """Persist one in-memory entry to the disk tier (crash-safe);
+        returns the spilled byte count. The caller drops the memory copy
+        (under its landing bracket) only AFTER this returns — a failure
+        here leaves the entry fully resident and served as before."""
+        metas, values = self.entry_requests(key, entry)
+        self.disk.store(metas, values)
+        nbytes = self._disk_entry_nbytes(self.disk.kv.get(key, {}))
+        self.spilled[key] = nbytes
+        _SPILLS.inc(volume=self.volume_id)
+        obs_ledger.record(
+            DISK_TRANSPORT,
+            obs_ledger.EGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=[(key, nbytes)],
+        )
+        obs_recorder.record(
+            "tier", "spill", key=key, nbytes=nbytes, volume=self.volume_id
+        )
+        return nbytes
+
+    def load(self, key: str) -> tuple[list[Request], dict[int, Any]]:
+        """(metas, memmap values) for a spilled entry, ready to re-land
+        into the memory tier. Raises KeyError when not spilled (e.g. a
+        concurrent fault-in already promoted it)."""
+        entry = self.disk.kv[key]
+        return self.entry_requests(key, entry)
+
+    def faulted_in(self, key: str, reason: str) -> None:
+        """Bookkeeping after the volume re-landed ``key``: drop the disk
+        copy and record the promotion."""
+        nbytes = self.spilled.pop(key, 0)
+        self.disk.delete(key)
+        self._faulted.append(key)
+        _FAULT_INS.inc(reason=reason)
+        obs_ledger.record(
+            DISK_TRANSPORT,
+            obs_ledger.INGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=[(key, nbytes)],
+        )
+        obs_recorder.record(
+            "tier",
+            "fault_in",
+            key=key,
+            nbytes=nbytes,
+            volume=self.volume_id,
+            reason=reason,
+        )
+
+    def discard(self, key: str) -> bool:
+        """Drop a stale disk copy (the key was overwritten or deleted in
+        the memory tier); idempotent."""
+        existed = self.spilled.pop(key, None) is not None
+        if existed:
+            self.disk.delete(key)
+        return existed
+
+    def manifest(self) -> list[dict]:
+        """Spilled entries' meta-only manifest (controller index rebuilds
+        must see the disk tier too — spilled bytes are the only copy)."""
+        return self.disk.manifest()
+
+    def reset(self) -> None:
+        self.spilled.clear()
+        self.access.clear()
+        self._faulted.clear()
+        self.disk.reset()
+        self.publish_gauges(resident_bytes=0)
