@@ -55,6 +55,10 @@ _SKIPPED = obs_metrics.counter(
     "ts_weight_channel_versions_skipped_total",
     "Published versions a subscriber never pulled (lagged past)",
 )
+_PINNED_ACQUIRES = obs_metrics.counter(
+    "ts_weight_channel_pinned_acquires_total",
+    "Version-pinned acquires served under a cohort lease, per channel",
+)
 
 _LATEST = "LATEST"
 # In-flight streamed-publish announce: written when a ChannelStream's first
@@ -177,15 +181,38 @@ class WeightPublisher:
             "stream", "publish", channel=self.name, version=version
         )
 
+    async def _leased_versions(self, client) -> set[int]:
+        """Versions of this channel pinned by live cohort leases — GC and
+        partial-reclaim skip them. Advisory here (a skip avoids pointless
+        delete RPCs): the HARD guarantee is the controller's
+        notify_delete_batch lease guard, which refuses the delete however
+        it is issued, so a lease-plane hiccup degrades to noise, never to
+        a reaped pinned version."""
+        try:
+            pins = await client.lease_list(self.name)
+        except Exception:  # noqa: BLE001 - advisory; the controller guard
+            # still enforces retention
+            logger.warning(
+                "channel %s: lease_list failed; relying on the "
+                "controller's delete guard for pinned versions",
+                self.name,
+            )
+            return set()
+        return {int(v) for v in pins.get(self.name, {})}
+
     async def _reclaim_partials(self, client, current: int) -> None:
         """Delete every version directory BEYOND the committed pointer
         (keys a crashed publisher streamed but never sealed). Runs once per
-        publisher lifetime, on resume."""
+        publisher lifetime, on resume. LEASED versions survive — a canary
+        cohort may legitimately pin an experimental version published past
+        the main pointer."""
         stale: set[int] = set()
         for key in await client.keys(self.name):
             seg = key[len(self.name) + 1 :].split("/", 1)[0]
             if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) > current:
                 stale.add(int(seg[1:]))
+        if stale:
+            stale -= await self._leased_versions(client)
         for v in sorted(stale):
             removed = await client.delete_prefix(_version_key(self.name, v))
             if removed:
@@ -256,7 +283,14 @@ class WeightPublisher:
         """Delete EVERY version <= version-keep still present — not just the
         one this publish expires — so versions orphaned by a crash between
         pointer write and GC, or by restarting with a smaller ``keep``, are
-        reclaimed on the next publish rather than leaking forever."""
+        reclaimed on the next publish rather than leaking forever.
+
+        Lease-aware (torchstore_tpu/tiering/): versions pinned by live
+        cohort leases are skipped — an evaluation cohort on v_{t−k} keeps
+        its weights however far LATEST advances — and reaped by a later
+        publish's GC once the last lease expires or is released. Old
+        retained versions cost tmpfs nothing in a tiered store: the spill
+        writer demotes them to disk and reads fault them back in."""
         cutoff = version - self.keep
         if cutoff < 0:
             return
@@ -267,13 +301,27 @@ class WeightPublisher:
             seg = key[len(self.name) + 1 :].split("/", 1)[0]
             if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) <= cutoff:
                 stale.add(int(seg[1:]))
+        if stale:
+            leased = await self._leased_versions(client) & stale
+            if leased:
+                stale -= leased
+                logger.debug(
+                    "channel %s: GC retaining leased version(s) %s",
+                    self.name,
+                    sorted(leased),
+                )
         for v in sorted(stale):
             removed = await client.delete_prefix(_version_key(self.name, v))
             if removed:
                 logger.debug("channel %s: GC'd v%d (%d keys)", self.name, v, removed)
 
     async def close(self, delete: bool = False) -> None:
-        """Optionally remove every key the channel owns."""
+        """Optionally remove every key the channel owns. Versions pinned
+        by live cohort leases SURVIVE this delete (the controller's lease
+        guard refuses them) and are reaped by a future publisher's GC on
+        this channel once the leases lapse — a close racing a pinned read
+        must never win; if the channel is truly done, release the leases
+        (or let their TTLs expire) and close again."""
         if delete:
             client = self._resolve_client()
             await client.delete_prefix(self.name)
@@ -359,10 +407,21 @@ class WeightSubscriber:
         client: Any = None,
         relay: bool = False,
         relay_volume: Optional[str] = None,
+        cohort: Optional[str] = None,
     ) -> None:
+        import os as _os
+
         self.name = name
         self._store_name = store_name
         self._client = client
+        # Cohort identity for version-pinned acquires: the lease owner in
+        # ts.version_catalog() / the flight recorder. Defaults to a
+        # process-unique id; name it (e.g. "eval-fleet-2") so retention is
+        # attributable.
+        self.cohort = cohort or f"sub-{_os.getpid()}-{id(self):x}"
+        # Monotonic per-subscriber read counter: each pinned acquire's
+        # lease owner is "{cohort}:r{n}" (see _pinned_lease).
+        self._read_seq = 0
         self._last_gen = 0
         self._last_stream_gen = 0
         self.last_version: Optional[int] = None
@@ -410,24 +469,93 @@ class WeightSubscriber:
         await client.relay_unsubscribe(self.name, self._relay_home)
         self._relay_home = None
 
+    async def _pinned_lease(self, client, version: int):
+        """Acquire the read-scoped retention lease for a pinned acquire:
+        while it lives, the version can be neither GC'd (controller delete
+        guard) nor demoted off the warm path by the next spill sweep. The
+        lease TTL bounds a crashed reader's pin; long reads are fine — the
+        guard checks liveness at delete time, and a read that outlives its
+        lease degrades to best-effort exactly like a store without leases.
+
+        The lease owner is a per-READ identity (``{cohort}:r{n}``), never
+        the bare cohort: the registry coalesces same-owner pins, so a
+        read under the bare name would RENEW — and its release DROP — a
+        long-lived pin the cohort holds, and two concurrent same-cohort
+        reads would share one lease the first finisher releases under the
+        second. Unique owners make every read's pin independent."""
+        self._read_seq += 1
+        owner = f"{self.cohort}:r{self._read_seq}"
+        lease = await client.lease_acquire(owner, self.name, version)
+        if lease.get("resident_keys") == 0:
+            # Nothing indexed under this version: GC'd or never published.
+            # Fail BEFORE the pull with a precise error (the pull's
+            # NoMatchingPush would be indistinguishable from a torn push).
+            await client.lease_release(lease["lease_id"])
+            raise KeyError(
+                f"channel {self.name!r} does not retain v{version} (GC'd "
+                "or never published); pin versions with a cohort lease "
+                "before LATEST advances past keep"
+            )
+        return lease
+
     async def acquire(
         self,
         user_state_dict: Any = None,
         timeout: Optional[float] = None,
         direct: bool = False,
         strict: bool = True,
+        version: Optional[int] = None,
     ) -> tuple[Any, int]:
         """Block until a version is published that this subscriber has not
         yet acquired, pull it, and return (state_dict, version). The first
         call returns the channel's current version immediately when one
         exists; each publish is delivered at most once (a deleted-then-
         recreated channel restarts numbering and delivers its v0). Raises
-        TimeoutError if nothing new arrives in ``timeout`` seconds."""
+        TimeoutError if nothing new arrives in ``timeout`` seconds.
+
+        ``version=N`` PINS the read instead (multi-version serving,
+        torchstore_tpu/tiering/): a cohort retention lease is held for the
+        read's duration — the version cannot be GC'd mid-read, and spilled
+        segments fault back in through the normal transport ladder — and
+        ``(state_dict, N)`` returns immediately without touching this
+        subscriber's LATEST tracking. Raises KeyError when the channel no
+        longer retains ``N``."""
         import time
 
         from torchstore_tpu import state_dict_utils
 
         client = self._resolve_client()
+        if version is not None:
+            if direct:
+                raise ValueError(
+                    "acquire(version=...) is incompatible with direct=True "
+                    "(the direct path serves one stable key, not versions)"
+                )
+            version = int(version)
+            lease = await self._pinned_lease(client, version)
+            try:
+                with span(
+                    "weight_channel.acquire_pinned",
+                    channel=self.name,
+                    version=version,
+                ):
+                    sd = await state_dict_utils.get_state_dict(
+                        client,
+                        _version_key(self.name, version),
+                        user_state_dict=user_state_dict,
+                        strict=strict,
+                    )
+            finally:
+                await client.lease_release(lease["lease_id"])
+            _PINNED_ACQUIRES.inc(channel=self.name)
+            obs_recorder.record(
+                "tier",
+                "pinned_acquire",
+                channel=self.name,
+                version=version,
+                cohort=self.cohort,
+            )
+            return sd, version
         pointer = f"{self.name}/{_LATEST}"
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -514,6 +642,7 @@ class WeightSubscriber:
         on_layer: Any = None,
         timeout: Optional[float] = None,
         strict: bool = True,
+        version: Optional[int] = None,
     ) -> tuple[Any, int]:
         """Like :meth:`acquire`, but against layer-streamed publishes
         (:meth:`WeightPublisher.stream`): wakes on the channel's IN-FLIGHT
@@ -525,12 +654,48 @@ class WeightSubscriber:
         always a single version's weights (stream_sync's watermark
         consistency ladder), and versions are delivered at most once.
         Requires streamed publishes; raises TimeoutError when nothing is
-        announced within ``timeout``."""
+        announced within ``timeout``.
+
+        ``version=N`` PINS the acquire to a retained historical version
+        under a read-scoped cohort lease (see :meth:`acquire`); a sealed
+        stream serves its layers immediately (in ``key_order`` when
+        given), and a version whose stream record is gone falls back to
+        the barrier read inside stream_sync."""
         import time
 
         from torchstore_tpu import stream_sync
 
         client = self._resolve_client()
+        if version is not None:
+            version = int(version)
+            lease = await self._pinned_lease(client, version)
+            try:
+                with span(
+                    "weight_channel.acquire_pinned",
+                    channel=self.name,
+                    version=version,
+                    streamed=True,
+                ):
+                    sd = await stream_sync.get_state_dict_streamed(
+                        client,
+                        _version_key(self.name, version),
+                        user_state_dict=user_state_dict,
+                        key_order=key_order,
+                        on_layer=on_layer,
+                        strict=strict,
+                        timeout=timeout,
+                    )
+            finally:
+                await client.lease_release(lease["lease_id"])
+            _PINNED_ACQUIRES.inc(channel=self.name)
+            obs_recorder.record(
+                "tier",
+                "pinned_acquire",
+                channel=self.name,
+                version=version,
+                cohort=self.cohort,
+            )
+            return sd, version
         relay_home = await self._ensure_relay(client)
         pointer = f"{self.name}/{_STREAM_PTR}"
         deadline = None if timeout is None else time.monotonic() + timeout
