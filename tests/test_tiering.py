@@ -70,6 +70,9 @@ class TestLeaseRegistry:
         a = reg.acquire("eval", "chan", 1)
         b = reg.acquire("eval", "chan", 1, ttl_s=90)
         assert a["lease_id"] == b["lease_id"] and len(reg) == 1
+        # The coalesce is reported: a read-scoped caller must not release
+        # a pin it merely refreshed.
+        assert a["renewed"] is False and b["renewed"] is True
         # A DIFFERENT cohort's pin on the same version is its own lease.
         reg.acquire("canary", "chan", 1)
         assert len(reg) == 2
@@ -310,6 +313,251 @@ async def test_overwrite_discards_stale_disk_copy(tiered_store):
         assert float(np.asarray(out)[0]) == 9.0
     finally:
         await ts.shutdown("tier6")
+
+
+async def test_shared_cohort_pinned_reads_hold_independent_leases(
+    tiered_store,
+):
+    """Two fleet members sharing a NAMED cohort (the documented fleet
+    pattern) must hold independent read-scoped leases: same cohort, same
+    (channel, version), same read ordinal must NOT coalesce into one
+    lease the first finisher's release drops under the other's
+    mid-flight read."""
+    await ts.initialize(store_name="tier8")
+    try:
+        client = ts.client("tier8")
+        pub = ts.WeightPublisher("fleet", store_name="tier8", keep=10)
+        for v in range(2):
+            await pub.publish(_sd(v))
+        a = ts.WeightSubscriber(
+            "fleet", store_name="tier8", cohort="eval-fleet-2"
+        )
+        b = ts.WeightSubscriber(
+            "fleet", store_name="tier8", cohort="eval-fleet-2"
+        )
+        lease_a = await a._pinned_lease(client, 1)
+        lease_b = await b._pinned_lease(client, 1)
+        assert lease_a["lease_id"] != lease_b["lease_id"]
+        assert not lease_a["renewed"] and not lease_b["renewed"]
+        # The first finisher's release leaves the other's pin live, and
+        # the owner keeps the cohort prefix for catalog attribution.
+        await client.lease_release(lease_a["lease_id"])
+        catalog = await ts.version_catalog("fleet", store_name="tier8")
+        owners = [le["cohort"] for le in catalog["fleet"][1]["leases"]]
+        assert len(owners) == 1 and owners[0].startswith("eval-fleet-2:")
+        await client.lease_release(lease_b["lease_id"])
+        # End to end: concurrent same-cohort pinned reads both succeed
+        # and leak no leases.
+        for sd, version in await asyncio.gather(
+            a.acquire(version=1), b.acquire(version=1)
+        ):
+            assert version == 1
+            _assert_version(sd, 1)
+        catalog = await ts.version_catalog("fleet", store_name="tier8")
+        assert catalog["fleet"][1]["leases"] == []
+    finally:
+        await ts.shutdown("tier8")
+
+
+async def test_resumed_publisher_skips_leased_survivor(tiered_store):
+    """A leased version beyond the committed pointer survives partial
+    reclaim — and the resumed publisher's numbering must skip PAST it,
+    never publishing fresh keys into the survivor's directory (where
+    they would mix with its stale keys into a two-generation dict)."""
+    await ts.initialize(store_name="tier9")
+    try:
+        client = ts.client("tier9")
+        pub = ts.WeightPublisher("res", store_name="tier9", keep=10)
+        for v in range(3):
+            await pub.publish(_sd(v))  # LATEST = 2
+        # A crashed publisher's un-sealed stream left keys at v5, pinned
+        # by a canary cohort before the crash.
+        await ts.put_batch(
+            {
+                f"res/v5/w{i}": np.full(N_ELEM, 5.0, np.float32)
+                for i in range(N_KEYS)
+            },
+            store_name="tier9",
+        )
+        lease = await client.lease_acquire("canary", "res", 5, ttl_s=120)
+        pub2 = ts.WeightPublisher("res", store_name="tier9", keep=10)
+        assert await pub2.publish(_sd(6)) == 6  # past the survivor
+        assert len(await client.keys("res/v5")) == N_KEYS
+        survivor = await ts.get("res/v5/w0", store_name="tier9")
+        assert float(np.asarray(survivor)[0]) == 5.0
+        await client.lease_release(lease["lease_id"])
+        # With the lease gone the skipped partial is NOT a leak: numbering
+        # moved past it, so a later publish's GC cutoff reaps it.
+        for v in range(7, 7 + 10):
+            await pub2.publish(_sd(v))
+        assert await client.keys("res/v5") == []
+    finally:
+        await ts.shutdown("tier9")
+
+
+async def test_resume_jump_keeps_gc_window(tiered_store):
+    """The GC retention window counts EXISTING versions: a publisher that
+    resumed past a leased survivor (numbering gap) must not let its first
+    publish's GC leap across the gap and reap the previous LATEST out
+    from under a mid-pull subscriber."""
+    await ts.initialize(store_name="tier13")
+    try:
+        client = ts.client("tier13")
+        pub = ts.WeightPublisher("gap", store_name="tier13", keep=2)
+        for v in range(3):
+            await pub.publish(_sd(v))  # LATEST = 2, v1+v2 retained
+        # A crashed publisher's partial far beyond the pointer, leased.
+        await ts.put_batch(
+            {
+                f"gap/v6/w{i}": np.full(N_ELEM, 6.0, np.float32)
+                for i in range(N_KEYS)
+            },
+            store_name="tier13",
+        )
+        lease = await client.lease_acquire("canary", "gap", 6, ttl_s=120)
+        pub2 = ts.WeightPublisher("gap", store_name="tier13", keep=2)
+        assert await pub2.publish(_sd(7)) == 7
+        # keep=2 of the EXISTING window {1, 2, 7}: v2 (the previous
+        # LATEST a subscriber may still be pulling) survives; a numeric
+        # cutoff (7 - 2 = 5) would have reaped it.
+        assert len(await client.keys("gap/v2")) == N_KEYS + 1
+        assert await client.keys("gap/v1") == []
+        sd = await ts.get_state_dict("gap/v2", store_name="tier13")
+        _assert_version(sd, 2)
+        # The next publish rolls the window forward as usual.
+        assert await pub2.publish(_sd(8)) == 8
+        assert await client.keys("gap/v2") == []
+        await client.lease_release(lease["lease_id"])
+    finally:
+        await ts.shutdown("tier13")
+
+
+async def test_guard_refused_reclaim_still_advances_numbering(
+    tiered_store,
+):
+    """A lease-plane hiccup (lease_list failing) must not let a resumed
+    publisher publish into a guard-retained version: survivors are also
+    derived from keys still present after the refused delete."""
+    await ts.initialize(store_name="tier14")
+    try:
+        client = ts.client("tier14")
+        pub = ts.WeightPublisher("hic", store_name="tier14", keep=10)
+        for v in range(3):
+            await pub.publish(_sd(v))  # LATEST = 2
+        await ts.put_batch(
+            {
+                f"hic/v5/w{i}": np.full(N_ELEM, 5.0, np.float32)
+                for i in range(N_KEYS)
+            },
+            store_name="tier14",
+        )
+        lease = await client.lease_acquire("canary", "hic", 5, ttl_s=120)
+
+        async def broken_lease_list(channel=None):
+            raise RuntimeError("lease plane unavailable")
+
+        real_lease_list = client.lease_list
+        client.lease_list = broken_lease_list
+        try:
+            pub2 = ts.WeightPublisher("hic", store_name="tier14", keep=10)
+            # The reclaim's delete of v5 is refused by the controller's
+            # lease guard; numbering must still skip past the survivor.
+            assert await pub2.publish(_sd(6)) == 6
+        finally:
+            client.lease_list = real_lease_list
+        assert len(await client.keys("hic/v5")) == N_KEYS
+        survivor = await ts.get("hic/v5/w0", store_name="tier14")
+        assert float(np.asarray(survivor)[0]) == 5.0
+        await client.lease_release(lease["lease_id"])
+    finally:
+        await ts.shutdown("tier14")
+
+
+async def test_recreated_channel_numbering_skips_leased_survivor(
+    tiered_store,
+):
+    """close(delete=True) leaves leased versions behind; the recreated
+    channel's fresh-epoch numbering (restarting at 0) must skip past
+    them instead of eventually publishing into the retained directory."""
+    await ts.initialize(store_name="tier10")
+    try:
+        client = ts.client("tier10")
+        pub = ts.WeightPublisher("re", store_name="tier10", keep=10)
+        for v in range(3):
+            await pub.publish(_sd(v))
+        lease = await client.lease_acquire("replay", "re", 1, ttl_s=120)
+        await pub.close(delete=True)
+        assert len(await client.keys("re/v1")) == N_KEYS + 1  # survived
+        pub2 = ts.WeightPublisher("re", store_name="tier10", keep=10)
+        assert await pub2.publish(_sd(9)) == 2  # fresh epoch, past v1
+        sd, version = await ts.WeightSubscriber(
+            "re", store_name="tier10", cohort="replay"
+        ).acquire(version=1)
+        assert version == 1
+        _assert_version(sd, 1)
+        await client.lease_release(lease["lease_id"])
+    finally:
+        await ts.shutdown("tier10")
+
+
+async def test_pinned_acquire_timeout_enforced(tiered_store, monkeypatch):
+    """acquire(version=..., timeout=...) bounds the pull itself — and a
+    timed-out pinned read releases its lease on the way out."""
+    from torchstore_tpu import state_dict_utils
+
+    await ts.initialize(store_name="tier11")
+    try:
+        pub = ts.WeightPublisher("to", store_name="tier11", keep=10)
+        await pub.publish(_sd(0))
+        real = state_dict_utils.get_state_dict
+
+        async def slow_get(*args, **kwargs):
+            await asyncio.sleep(5.0)
+            return await real(*args, **kwargs)
+
+        monkeypatch.setattr(state_dict_utils, "get_state_dict", slow_get)
+        sub = ts.WeightSubscriber("to", store_name="tier11")
+        with pytest.raises(TimeoutError):
+            await sub.acquire(version=0, timeout=0.2)
+        catalog = await ts.version_catalog("to", store_name="tier11")
+        assert catalog["to"][0]["leases"] == []
+    finally:
+        await ts.shutdown("tier11")
+
+
+async def test_pinned_read_outlives_lease_ttl(tiered_store, monkeypatch):
+    """A pull longer than the lease TTL stays protected: the read-scoped
+    lease is heartbeat-renewed, so GC under publish pressure cannot reap
+    the pinned version mid-read."""
+    from torchstore_tpu import state_dict_utils
+
+    monkeypatch.setenv("TORCHSTORE_TPU_LEASE_TTL_S", "0.3")
+    await ts.initialize(store_name="tier12")
+    try:
+        pub = ts.WeightPublisher("slow", store_name="tier12", keep=1)
+        await pub.publish(_sd(0))
+        real = state_dict_utils.get_state_dict
+
+        async def slow_get(*args, **kwargs):
+            # 3x the TTL: without renewal the lease lapses mid-read and
+            # the publishes below reap v0 under the pull.
+            await asyncio.sleep(0.9)
+            return await real(*args, **kwargs)
+
+        monkeypatch.setattr(state_dict_utils, "get_state_dict", slow_get)
+        sub = ts.WeightSubscriber(
+            "slow", store_name="tier12", cohort="reader"
+        )
+        read = asyncio.ensure_future(sub.acquire(version=0))
+        # keep=1 makes v0 GC-eligible the moment its lease lapses.
+        for v in range(1, 4):
+            await asyncio.sleep(0.2)
+            await pub.publish(_sd(v))
+        sd, version = await read
+        assert version == 0
+        _assert_version(sd, 0)
+    finally:
+        await ts.shutdown("tier12")
 
 
 async def test_tier_disabled_is_inert():

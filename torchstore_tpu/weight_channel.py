@@ -23,6 +23,8 @@ version n is safe while n+1 publishes (keep >= 2).
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 from typing import Any, Optional
 
 from torchstore_tpu.logging import get_logger
@@ -150,7 +152,16 @@ class WeightPublisher:
         must not clobber live versions) — and reclaim any PARTIAL version a
         crashed predecessor left beyond the pointer: an abandoned stream's
         layer keys (never sealed, so never pointed at) would otherwise leak
-        until their version number is reused and GC'd."""
+        until their version number is reused and GC'd.
+
+        Versions that SURVIVE the reclaim (pinned by live cohort leases —
+        including versions of a closed-and-recreated channel, whose fresh
+        epoch restarts numbering at 0) advance the counter past them: a
+        publish must never land in a retained version's directory, where
+        its keys would mix with the survivor's into a two-generation dict.
+        Skipping the numbers also routes the survivors into ``_gc``'s
+        retention window once their leases lapse, so a skipped partial is
+        reclaimed by a later publish instead of leaking forever."""
         if self._next_version is None:
             try:
                 current, epoch = _parse_pointer(
@@ -164,7 +175,11 @@ class WeightPublisher:
                 self._next_version = 0
                 self._epoch = secrets.randbits(62) or 1
                 current = -1
-            await self._reclaim_partials(client, current)
+            survivors = await self._reclaim_partials(client, current)
+            if survivors:
+                self._next_version = max(
+                    self._next_version - 1, max(survivors)
+                ) + 1
         return self._next_version
 
     async def _commit(self, client, version: int) -> None:
@@ -181,13 +196,15 @@ class WeightPublisher:
             "stream", "publish", channel=self.name, version=version
         )
 
-    async def _leased_versions(self, client) -> set[int]:
+    async def _leased_versions(self, client) -> Optional[set[int]]:
         """Versions of this channel pinned by live cohort leases — GC and
         partial-reclaim skip them. Advisory here (a skip avoids pointless
         delete RPCs): the HARD guarantee is the controller's
         notify_delete_batch lease guard, which refuses the delete however
         it is issued, so a lease-plane hiccup degrades to noise, never to
-        a reaped pinned version."""
+        a reaped pinned version. Returns None when the lease plane is
+        unreachable — callers fall back to the guard and, where it
+        matters, verify their deletes actually removed keys."""
         try:
             pins = await client.lease_list(self.name)
         except Exception:  # noqa: BLE001 - advisory; the controller guard
@@ -197,25 +214,41 @@ class WeightPublisher:
                 "controller's delete guard for pinned versions",
                 self.name,
             )
-            return set()
+            return None
         return {int(v) for v in pins.get(self.name, {})}
 
-    async def _reclaim_partials(self, client, current: int) -> None:
+    async def _reclaim_partials(self, client, current: int) -> set[int]:
         """Delete every version directory BEYOND the committed pointer
         (keys a crashed publisher streamed but never sealed). Runs once per
         publisher lifetime, on resume. LEASED versions survive — a canary
         cohort may legitimately pin an experimental version published past
-        the main pointer."""
+        the main pointer — and are returned so the caller can advance the
+        version counter past them instead of publishing into them."""
         stale: set[int] = set()
         for key in await client.keys(self.name):
             seg = key[len(self.name) + 1 :].split("/", 1)[0]
             if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) > current:
                 stale.add(int(seg[1:]))
+        survivors: set[int] = set()
         if stale:
-            stale -= await self._leased_versions(client)
+            survivors = (await self._leased_versions(client) or set()) & stale
+            stale -= survivors
         for v in sorted(stale):
             removed = await client.delete_prefix(_version_key(self.name, v))
-            if removed:
+            if await client.keys(_version_key(self.name, v)):
+                # Keys remain after the delete: the controller's lease
+                # guard refused it (the version is pinned, but lease_list
+                # failed above so we did not know). A survivor is a
+                # survivor however we learn of it — numbering must still
+                # advance past it, never publish into its directory.
+                survivors.add(v)
+                logger.warning(
+                    "channel %s: v%d survived reclaim (lease-guarded "
+                    "delete refused); resuming numbering past it",
+                    self.name,
+                    v,
+                )
+            elif removed:
                 logger.warning(
                     "channel %s: reclaimed partial v%d (%d keys) left by a "
                     "crashed publisher",
@@ -223,6 +256,7 @@ class WeightPublisher:
                     v,
                     removed,
                 )
+        return survivors
 
     def stream(self, transfer_dtype=None) -> "ChannelStream":
         """Open a LAYER-STREAMED publish of the next version: push
@@ -280,10 +314,18 @@ class WeightPublisher:
         return version
 
     async def _gc(self, client, version: int) -> None:
-        """Delete EVERY version <= version-keep still present — not just the
-        one this publish expires — so versions orphaned by a crash between
-        pointer write and GC, or by restarting with a smaller ``keep``, are
-        reclaimed on the next publish rather than leaking forever.
+        """Retain the newest ``keep`` versions at or below the one just
+        published and delete EVERY other version still present — not just
+        the one this publish expires — so versions orphaned by a crash
+        between pointer write and GC, or by restarting with a smaller
+        ``keep``, are reclaimed on the next publish rather than leaking
+        forever. The window counts EXISTING versions, not ``version -
+        keep`` arithmetic: a publisher that resumed past a leased
+        survivor publishes with a numbering gap, and a numeric cutoff
+        would leap across it and reap the previous LATEST out from under
+        a mid-pull subscriber. Versions beyond ``version`` (beyond-pointer
+        partials a lease retained) are never touched here — they fall
+        into the window once numbering passes them.
 
         Lease-aware (torchstore_tpu/tiering/): versions pinned by live
         cohort leases are skipped — an evaluation cohort on v_{t−k} keeps
@@ -291,20 +333,28 @@ class WeightPublisher:
         publish's GC once the last lease expires or is released. Old
         retained versions cost tmpfs nothing in a tiered store: the spill
         writer demotes them to disk and reads fault them back in."""
-        cutoff = version - self.keep
-        if cutoff < 0:
-            return
-        stale: set[int] = set()
+        present: set[int] = set()
         for key in await client.keys(self.name):
             # Keys look like "{name}/v{n}/..." — prefix filtering is
             # segment-bounded, so list the channel root and parse.
             seg = key[len(self.name) + 1 :].split("/", 1)[0]
-            if seg.startswith("v") and seg[1:].isdigit() and int(seg[1:]) <= cutoff:
-                stale.add(int(seg[1:]))
+            if seg.startswith("v") and seg[1:].isdigit():
+                present.add(int(seg[1:]))
+        window = sorted(v for v in present if v <= version)
+        stale = set(window[: -self.keep])
+        lease_plane_ok = True
         if stale:
-            leased = await self._leased_versions(client) & stale
+            leased = await self._leased_versions(client)
+            lease_plane_ok = leased is not None
+            leased = (leased or set()) & set(window)
             if leased:
-                stale -= leased
+                # Leased versions are exempt AND excluded from the window:
+                # a pinned survivor must neither be reaped nor consume a
+                # retention slot (pushing the previous LATEST out of the
+                # keep window while a subscriber may still be pulling it).
+                stale = set(
+                    [v for v in window if v not in leased][: -self.keep]
+                )
                 logger.debug(
                     "channel %s: GC retaining leased version(s) %s",
                     self.name,
@@ -312,8 +362,16 @@ class WeightPublisher:
                 )
         for v in sorted(stale):
             removed = await client.delete_prefix(_version_key(self.name, v))
-            if removed:
-                logger.debug("channel %s: GC'd v%d (%d keys)", self.name, v, removed)
+            if not removed:
+                continue
+            if not lease_plane_ok and await client.keys(
+                _version_key(self.name, v)
+            ):
+                # With lease_list down we could not exempt pinned
+                # versions up front; the controller guard refused this
+                # delete — retained, not GC'd.
+                continue
+            logger.debug("channel %s: GC'd v%d (%d keys)", self.name, v, removed)
 
     async def close(self, delete: bool = False) -> None:
         """Optionally remove every key the channel owns. Versions pinned
@@ -419,8 +477,15 @@ class WeightSubscriber:
         # process-unique id; name it (e.g. "eval-fleet-2") so retention is
         # attributable.
         self.cohort = cohort or f"sub-{_os.getpid()}-{id(self):x}"
+        # Lease-owner prefix for pinned acquires: ALWAYS process- and
+        # instance-unique, even under a shared named cohort ("eval-fleet-2"
+        # across a fleet) — the registry coalesces same-owner pins, so two
+        # subscribers reusing an owner string would share one lease the
+        # first finisher releases under the second. The cohort stays the
+        # prefix for attribution in ts.version_catalog()/telemetry.
+        self._lease_owner = f"{self.cohort}:{_os.getpid()}:{id(self):x}"
         # Monotonic per-subscriber read counter: each pinned acquire's
-        # lease owner is "{cohort}:r{n}" (see _pinned_lease).
+        # lease owner is "{_lease_owner}:r{n}" (see _pinned_lease).
         self._read_seq = 0
         self._last_gen = 0
         self._last_stream_gen = 0
@@ -472,31 +537,118 @@ class WeightSubscriber:
     async def _pinned_lease(self, client, version: int):
         """Acquire the read-scoped retention lease for a pinned acquire:
         while it lives, the version can be neither GC'd (controller delete
-        guard) nor demoted off the warm path by the next spill sweep. The
-        lease TTL bounds a crashed reader's pin; long reads are fine — the
-        guard checks liveness at delete time, and a read that outlives its
-        lease degrades to best-effort exactly like a store without leases.
+        guard) nor demoted off the warm path by the next spill sweep.
 
-        The lease owner is a per-READ identity (``{cohort}:r{n}``), never
-        the bare cohort: the registry coalesces same-owner pins, so a
-        read under the bare name would RENEW — and its release DROP — a
-        long-lived pin the cohort holds, and two concurrent same-cohort
-        reads would share one lease the first finisher releases under the
-        second. Unique owners make every read's pin independent."""
+        The lease owner is a per-READ identity
+        (``{cohort}:{pid}:{instance}:r{n}``), never the bare cohort: the
+        registry coalesces same-owner pins, so a read under a shared name
+        would RENEW — and its release DROP — a pin another read (or a
+        long-lived cohort lease) still depends on. The pid/instance parts
+        keep owners unique across subscribers SHARING a named cohort and
+        across a restarted process whose read counter resets within a
+        live lease's TTL; should an acquire still coalesce
+        (``renewed: True``), :meth:`_pinned_read` leaves the shared pin
+        live instead of releasing it under the other holder."""
         self._read_seq += 1
-        owner = f"{self.cohort}:r{self._read_seq}"
+        owner = f"{self._lease_owner}:r{self._read_seq}"
         lease = await client.lease_acquire(owner, self.name, version)
         if lease.get("resident_keys") == 0:
             # Nothing indexed under this version: GC'd or never published.
             # Fail BEFORE the pull with a precise error (the pull's
             # NoMatchingPush would be indistinguishable from a torn push).
-            await client.lease_release(lease["lease_id"])
+            if not lease.get("renewed"):
+                await client.lease_release(lease["lease_id"])
             raise KeyError(
                 f"channel {self.name!r} does not retain v{version} (GC'd "
                 "or never published); pin versions with a cohort lease "
                 "before LATEST advances past keep"
             )
         return lease
+
+    async def _renew_pinned(self, client, lease: dict) -> None:
+        """Heartbeat a pinned read's lease while the pull is in flight:
+        state dicts routinely take longer than the default 30 s TTL to
+        transfer, and a lease that lapses mid-read would hand the version
+        back to GC/spill. Renews at a third of the TTL; a failed renewal
+        (transient RPC blip, controller restart, lease expired under a
+        long stall) falls back to RE-ACQUIRING the same owner's pin — one
+        hiccup must not strip a long pull's protection for its remaining
+        duration. Only when the re-acquire also fails does the heartbeat
+        stop: the read degrades to best-effort, it never errors."""
+        interval = max(0.1, float(lease.get("ttl_s") or 1.0) / 3.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await client.lease_renew(lease["lease_id"])
+            except Exception as renew_exc:  # noqa: BLE001 - degrade,
+                # never fail the read: the pin is advisory protection,
+                # the pull is the deliverable.
+                try:
+                    fresh = await client.lease_acquire(
+                        lease["cohort"],
+                        lease["channel"],
+                        lease["version"],
+                        lease.get("ttl_s"),
+                    )
+                    # Same owner: the registry coalesces onto the live
+                    # lease when it still exists, or mints a replacement.
+                    # Keep the ORIGINAL "renewed" flag — whether release
+                    # is ours to do was decided at the first acquire.
+                    lease["lease_id"] = fresh["lease_id"]
+                    logger.info(
+                        "channel %s: pinned-read lease renewal failed "
+                        "(%s); re-acquired as %s",
+                        self.name,
+                        renew_exc,
+                        fresh["lease_id"],
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning(
+                        "channel %s: pinned-read lease %s renewal and "
+                        "re-acquire both failed (%s); read continues "
+                        "without GC/spill protection",
+                        self.name,
+                        lease["lease_id"],
+                        exc,
+                    )
+                    return
+
+    @contextlib.asynccontextmanager
+    async def _pinned_read(self, client, version: int):
+        """Hold the read-scoped lease for the duration of a pinned pull:
+        acquires it, renews it in the background (long pulls stay
+        protected past the TTL), and on exit releases it — unless the
+        acquire merely coalesced with an existing same-owner pin
+        (``renewed: True``), which must survive for its other holder."""
+        lease = await self._pinned_lease(client, version)
+        renewer = asyncio.ensure_future(self._renew_pinned(client, lease))
+        try:
+            yield lease
+        finally:
+            renewer.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await renewer
+            if lease.get("renewed"):
+                logger.warning(
+                    "channel %s: pinned-read lease owner collided with a "
+                    "live pin (lease %s); leaving the shared lease to its "
+                    "other holder",
+                    self.name,
+                    lease["lease_id"],
+                )
+            else:
+                try:
+                    await client.lease_release(lease["lease_id"])
+                except Exception as exc:  # noqa: BLE001 - best-effort:
+                    # the pull already succeeded (or raised its own
+                    # error); the TTL reaps an unreleased pin anyway.
+                    logger.warning(
+                        "channel %s: pinned-read lease %s release failed "
+                        "(%s); its TTL will expire it",
+                        self.name,
+                        lease["lease_id"],
+                        exc,
+                    )
 
     async def acquire(
         self,
@@ -514,12 +666,17 @@ class WeightSubscriber:
         TimeoutError if nothing new arrives in ``timeout`` seconds.
 
         ``version=N`` PINS the read instead (multi-version serving,
-        torchstore_tpu/tiering/): a cohort retention lease is held for the
-        read's duration — the version cannot be GC'd mid-read, and spilled
-        segments fault back in through the normal transport ladder — and
-        ``(state_dict, N)`` returns immediately without touching this
-        subscriber's LATEST tracking. Raises KeyError when the channel no
-        longer retains ``N``."""
+        torchstore_tpu/tiering/): a cohort retention lease is held — and
+        renewed in the background, so pulls longer than the lease TTL stay
+        protected — for the read's duration: the version cannot be GC'd
+        mid-read, and spilled segments fault back in through the normal
+        transport ladder. ``(state_dict, N)`` returns without touching
+        this subscriber's LATEST tracking; ``timeout`` bounds the pull
+        itself (there is no wait phase) and raises TimeoutError —
+        cancelling a pull mid-flight, so after a timeout an IN-PLACE
+        ``user_state_dict`` may hold a mix of its old leaves and
+        already-landed v``N`` leaves: treat its contents as undefined.
+        Raises KeyError when the channel no longer retains ``N``."""
         import time
 
         from torchstore_tpu import state_dict_utils
@@ -532,21 +689,28 @@ class WeightSubscriber:
                     "(the direct path serves one stable key, not versions)"
                 )
             version = int(version)
-            lease = await self._pinned_lease(client, version)
-            try:
+            async with self._pinned_read(client, version):
                 with span(
                     "weight_channel.acquire_pinned",
                     channel=self.name,
                     version=version,
                 ):
-                    sd = await state_dict_utils.get_state_dict(
+                    pull = state_dict_utils.get_state_dict(
                         client,
                         _version_key(self.name, version),
                         user_state_dict=user_state_dict,
                         strict=strict,
                     )
-            finally:
-                await client.lease_release(lease["lease_id"])
+                    if timeout is None:
+                        sd = await pull
+                    else:
+                        try:
+                            sd = await asyncio.wait_for(pull, timeout)
+                        except asyncio.TimeoutError:
+                            raise TimeoutError(
+                                f"pinned acquire of {self.name}/v{version} "
+                                f"did not complete within {timeout}s"
+                            ) from None
             _PINNED_ACQUIRES.inc(channel=self.name)
             obs_recorder.record(
                 "tier",
@@ -668,8 +832,7 @@ class WeightSubscriber:
         client = self._resolve_client()
         if version is not None:
             version = int(version)
-            lease = await self._pinned_lease(client, version)
-            try:
+            async with self._pinned_read(client, version):
                 with span(
                     "weight_channel.acquire_pinned",
                     channel=self.name,
@@ -685,8 +848,6 @@ class WeightSubscriber:
                         strict=strict,
                         timeout=timeout,
                     )
-            finally:
-                await client.lease_release(lease["lease_id"])
             _PINNED_ACQUIRES.inc(channel=self.name)
             obs_recorder.record(
                 "tier",
