@@ -750,6 +750,169 @@ async def streamed_sync_section(
         await ts.shutdown("bench_stream")
 
 
+async def delta_sync_section(
+    n_tensors: int = 8,
+    tensor_kb: float = 4096,
+    versions: int = 6,
+    churn_frac: float = 0.125,
+    dcn_gbps: float = 0.2,
+) -> dict:
+    """Quantized + delta wire tier (ISSUE 13): a steady-state RL publish
+    loop at none / int8_block / int4_block+delta over the BULK (DCN) path,
+    low-churn workload (``churn_frac`` of tensors move per step, the rest
+    are frozen — the regime delta encoding exists for).
+
+    ``dcn_gbps`` emulates the cross-host link this transport targets
+    (TORCHSTORE_TPU_BULK_EMULATE_GBPS pacing on every payload frame, both
+    directions): on loopback the wire is memcpy-fast and NOTHING would be
+    wire-bound, so the tier's whole effect would vanish into codec CPU
+    noise. 0.2 GB/s ~ 1.6 Gbit/s, a conservative per-flow DCN share;
+    0 disables the emulation (raw loopback numbers).
+
+    Per leg: ``effective_gbps`` (full-precision dict bytes delivered per
+    wall second through publish+acquire — the quantized legs move the same
+    LOGICAL bytes over fewer wire bytes), ``wire_compression_ratio``
+    (logical/wire from the quant metrics), and ``max_dequant_abs_err``
+    (measured against the true weights and ASSERTED under the analytic
+    bound: one keyframe step per block — the tier's whole contract)."""
+    import os as _os
+    import statistics
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.observability import metrics as obs_metrics
+    from torchstore_tpu.transport import bulk as _bulk
+
+    n_elem = max(1, int(tensor_kb * 1024 // 4))
+    churn = max(1, int(round(n_tensors * churn_frac)))
+    prev_env = _os.environ.get("TORCHSTORE_TPU_BULK_EMULATE_GBPS")
+    prev_pace = None
+    if dcn_gbps > 0:
+        # Children (volumes) read the env at spawn; this process's sender
+        # side adopts it directly.
+        _os.environ["TORCHSTORE_TPU_BULK_EMULATE_GBPS"] = str(dcn_gbps)
+        prev_pace = _bulk.set_emulated_gbps(dcn_gbps)
+    await ts.initialize(
+        store_name="bench_delta",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+
+    def _quant_counters() -> tuple[float, float]:
+        snap = obs_metrics.metrics_snapshot()
+        def total(name):
+            m = snap.get(name) or {"series": []}
+            return float(sum(s["value"] for s in m["series"]))
+        return total("ts_quant_bytes_in_total"), total(
+            "ts_quant_bytes_wire_total"
+        )
+
+    try:
+        src = {
+            str(i): np.random.randn(n_elem).astype(np.float32)
+            for i in range(n_tensors)
+        }
+        total_bytes = sum(v.nbytes for v in src.values())
+        legs = [
+            ("none", None, False),
+            ("int8_block", "int8_block", False),
+            ("int4_delta", "int4_block", True),
+        ]
+        out: dict = {
+            "n_tensors": n_tensors,
+            "tensor_kb": tensor_kb,
+            "versions": versions,
+            "churn_frac": churn_frac,
+        }
+        gbps_of: dict[str, float] = {}
+        for label, quant, delta in legs:
+            pub = ts.WeightPublisher(
+                f"ds_{label}",
+                store_name="bench_delta",
+                keep=5,
+                transfer_quant=quant,
+                delta=delta,
+                keyframe_every=4,
+            )
+            sub = ts.WeightSubscriber(f"ds_{label}", store_name="bench_delta")
+            user = {
+                str(i): np.zeros(n_elem, np.float32) for i in range(n_tensors)
+            }
+            walls: list[float] = []
+            in0, wire0 = _quant_counters()
+            for v in range(versions):
+                for i in range(churn):
+                    src[str(i)][: n_elem // 4] += np.float32(0.01)
+                t0 = time.perf_counter()
+                await pub.publish(src)
+                sd, _ = await sub.acquire(
+                    user_state_dict=user, timeout=120.0
+                )
+                walls.append(time.perf_counter() - t0)
+            in1, wire1 = _quant_counters()
+            # Warm median (iter 0 carries plan building + pool warmup).
+            warm = walls[1:] or walls
+            wall = statistics.median(warm)
+            # One publish + one acquire move the dict twice per iteration.
+            gbps = 2 * total_bytes / 1e9 / wall
+            gbps_of[label] = gbps
+            err = max(
+                float(np.max(np.abs(user[str(i)] - src[str(i)])))
+                for i in range(n_tensors)
+            )
+            if quant is not None:
+                from torchstore_tpu import state_dict_utils as sdu
+
+                qmax = sdu._QMAX[quant]
+                # Analytic contract: within one keyframe-step per block
+                # (delta skip threshold is HALF a step; shipped residuals
+                # add at most half a residual step on top).
+                bound = max(
+                    float(np.max(np.abs(src[str(i)]))) for i in range(n_tensors)
+                ) / qmax + 1e-6
+                assert err <= bound, (
+                    f"delta_sync[{label}]: dequant err {err} exceeds the "
+                    f"analytic bound {bound}"
+                )
+                compression = (in1 - in0) / max(1.0, wire1 - wire0)
+            else:
+                assert err == 0.0, f"delta_sync[none]: lossless leg drifted ({err})"
+                compression = 1.0
+            out[f"delta_{label}_gbps"] = round(gbps, 3)
+            out[f"delta_wire_compression_{label}"] = round(compression, 2)
+            out[f"delta_max_abs_err_{label}"] = float(err)
+            print(
+                f"# delta_sync[{label}]: effective {gbps:.2f} GB/s, "
+                f"wire compression {compression:.1f}x, max abs err {err:.5f}",
+                file=sys.stderr,
+            )
+        out["delta_speedup_int8_block"] = round(
+            gbps_of["int8_block"] / gbps_of["none"], 3
+        )
+        out["delta_speedup_delta"] = round(
+            gbps_of["int4_delta"] / gbps_of["none"], 3
+        )
+        out["delta_max_abs_err"] = out["delta_max_abs_err_int4_delta"]
+        out["dcn_gbps_emulated"] = dcn_gbps
+        print(
+            f"# delta_sync ({n_tensors} x {tensor_kb:.0f} KB, "
+            f"{versions} versions, churn {churn}/{n_tensors}, emulated DCN "
+            f"{dcn_gbps} GB/s): none "
+            f"{gbps_of['none']:.2f} -> int8_block {gbps_of['int8_block']:.2f} "
+            f"({out['delta_speedup_int8_block']}x) -> int4+delta "
+            f"{gbps_of['int4_delta']:.2f} GB/s "
+            f"({out['delta_speedup_delta']}x)",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        await ts.shutdown("bench_delta")
+        if dcn_gbps > 0:
+            if prev_env is None:
+                _os.environ.pop("TORCHSTORE_TPU_BULK_EMULATE_GBPS", None)
+            else:
+                _os.environ["TORCHSTORE_TPU_BULK_EMULATE_GBPS"] = prev_env
+            _bulk.set_emulated_gbps(prev_pace)
+
+
 async def recovery_section(
     n_keys: int = 64,
     key_kb: float = 256,
@@ -1315,6 +1478,9 @@ async def run(
     capacity_versions: int = 8,
     capacity_keys: int = 16,
     capacity_key_kb: float = 256,
+    delta_tensors: int = 8,
+    delta_tensor_kb: float = 4096,
+    delta_versions: int = 6,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -1578,6 +1744,14 @@ async def run(
         n_keys=capacity_keys,
         key_kb=capacity_key_kb,
     )
+
+    # Delta-sync section (ISSUE 13): steady-state publish loop at
+    # none / int8_block / int4_block+delta over the bulk/DCN path.
+    delta_sync = await delta_sync_section(
+        n_tensors=delta_tensors,
+        tensor_kb=delta_tensor_kb,
+        versions=delta_versions,
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -1656,6 +1830,17 @@ async def run(
         "fault_in_p50_ms": capacity["fault_in_p50_ms"],
         "spilled_bytes_ratio": capacity["spilled_bytes_ratio"],
         "capacity": capacity,
+        # ISSUE-13 headline stats at top level: quantized/delta wire-tier
+        # speedups over the unquantized bulk path, the delta leg's wire
+        # compression, and the measured (bound-asserted) dequant error;
+        # full section under "delta_sync".
+        "delta_speedup_int8_block": delta_sync["delta_speedup_int8_block"],
+        "delta_speedup_delta": delta_sync["delta_speedup_delta"],
+        "delta_wire_compression_delta": delta_sync[
+            "delta_wire_compression_int4_delta"
+        ],
+        "delta_max_abs_err": delta_sync["delta_max_abs_err"],
+        "delta_sync": delta_sync,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -1699,6 +1884,11 @@ if __name__ == "__main__":
         # Standalone tiered-capacity run: one JSON line with the
         # spill/fault-in/warm-leased-get numbers.
         print(json.dumps(asyncio.run(capacity_section())))
+        sys.exit(0)
+    if "--delta-sync" in sys.argv:
+        # Standalone quantized/delta wire-tier run: one JSON line with the
+        # per-mode effective GB/s, compression, and dequant error.
+        print(json.dumps(asyncio.run(delta_sync_section())))
         sys.exit(0)
     result = asyncio.run(run())
     # The headline JSON lands BEFORE the device section: a wedged TPU

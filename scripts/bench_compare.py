@@ -82,6 +82,16 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "warm_get_after_spill_us": ("lower", 0.60, "rel"),
     "fault_in_p50_ms": ("lower", 1.00, "rel"),
     "spilled_bytes_ratio": ("higher", 0.30, "rel"),
+    # Quantized + delta wire tier (ISSUE 13). The speedups are measured at
+    # a fixed emulated DCN bandwidth, so they are near-structural (wire
+    # bytes dominate by construction) — a drop means the codec got slower
+    # or the wire tier leaked full-precision bytes; the delta leg's wire
+    # compression is deterministic at fixed churn; the dequant error is
+    # analytic (bounded by one keyframe step) and budgeted absolutely.
+    "delta_speedup_int8_block": ("higher", 0.25, "rel"),
+    "delta_speedup_delta": ("higher", 0.25, "rel"),
+    "delta_wire_compression_delta": ("higher", 0.25, "rel"),
+    "delta_max_abs_err": ("lower", 0.10, "abs"),
 }
 
 

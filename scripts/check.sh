@@ -6,7 +6,7 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (11 rules,
+#   1. tslint --fail-on-new     repo-specific static analysis (12 rules,
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
 #                               --regen-metric-docs after editing knobs or
@@ -17,8 +17,10 @@
 #                               bench.py code path at KB scale, incl. the
 #                               ledger_overhead telemetry-cost section,
 #                               the relay fanout section's O(1)-egress
-#                               bound, and the tiered-capacity section's
-#                               spill/fault-in/warm-leased-get gates) and
+#                               bound, the tiered-capacity section's
+#                               spill/fault-in/warm-leased-get gates, and
+#                               the delta_sync quant/delta wire-tier
+#                               section's compression + error bounds) and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

@@ -42,6 +42,9 @@ async def test_bench_run_tiny(capsys):
         capacity_versions=4,
         capacity_keys=4,
         capacity_key_kb=4,
+        delta_tensors=4,
+        delta_tensor_kb=16,
+        delta_versions=3,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -151,6 +154,18 @@ async def test_bench_run_tiny(capsys):
     assert cap["spilled_bytes"] > 0
     assert cap["warm_get_rpcs"] == 0
     assert cap["fault_in_keys"] > 0
+
+    # Quantized + delta wire tier (ISSUE 13): headline keys at top level,
+    # the full section under "delta_sync". KB-scale SPEEDUPS are noise —
+    # structure plus the structural compression/error invariants only; the
+    # >=2x / >=3x bars are the full-scale run's bench_compare contract.
+    assert result["delta_speedup_int8_block"] > 0
+    assert result["delta_speedup_delta"] > 0
+    assert result["delta_wire_compression_delta"] > 5.0
+    assert result["delta_max_abs_err"] >= 0
+    ds = result["delta_sync"]
+    assert ds["delta_wire_compression_int8_block"] > 3.0
+    assert ds["delta_max_abs_err_none"] == 0.0
 
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
@@ -296,6 +311,38 @@ async def test_bench_capacity_section_tiny():
     assert out["warm_get_after_spill_us"] > 0
     assert out["fault_in_p50_ms"] > 0 and out["fault_in_keys"] > 0
     assert out["cold_versions_measured"], out
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_delta_sync_section_tiny():
+    """The delta_sync section standalone (``bench.py --delta-sync``) at KB
+    scale: a real bulk-path fleet publishing at none / int8_block /
+    int4_block+delta through the weight channel. Wire compression and the
+    analytic dequant-error bound are structural (asserted inside the
+    section too) — the ISSUE-13 acceptance shape can never ship broken.
+    Speedups are not asserted here: at KB scale fixed costs dominate; the
+    full-scale run + bench_compare own those numbers."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.delta_sync_section(
+        n_tensors=4, tensor_kb=16, versions=4, dcn_gbps=0.05
+    )
+    assert out["delta_none_gbps"] > 0
+    assert out["delta_max_abs_err_none"] == 0.0
+    # Structural: int8 blobs are ~4x smaller than f32 (minus header/scale
+    # overhead), the low-churn delta leg far smaller still.
+    assert out["delta_wire_compression_int8_block"] > 3.0, out
+    assert out["delta_wire_compression_int4_delta"] > 5.0, out
+    # The in-section analytic bound already asserted; keep the headline
+    # fields present and finite for bench_compare.
+    for k in ("delta_speedup_int8_block", "delta_speedup_delta",
+              "delta_max_abs_err"):
+        assert isinstance(out[k], float) and out[k] >= 0, (k, out[k])
     json.dumps(out)
 
 

@@ -353,6 +353,132 @@ async def test_chaos_wedged_stream_publisher_never_mixes(fast_health):
         await ts.shutdown("chaos_wedge")
 
 
+async def test_chaos_wedged_delta_publisher_never_mixes_or_drifts(fast_health):
+    """ISSUE-13 chaos fold-in: a DELTA publisher wedged mid-version
+    (channel.publish_layer) leaves barrier readers on the previous sealed
+    version; the resumed publisher (fresh process = no baselines)
+    re-KEYFRAMES, and readers converge on bit-exact weights — zero
+    mixed-generation or drifted reads, asserted through the stream
+    record's watermarks (inconsistent_keys) and a byte-level compare
+    against the publisher's baseline. A scheduled channel.delta_baseline
+    raise also proves baseline loss surfaces loudly mid-traffic."""
+    from torchstore_tpu import stream_sync
+
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="chaos_delta",
+    )
+    try:
+        pub = ts.WeightPublisher(
+            "dchan", store_name="chaos_delta", keep=5,
+            transfer_quant="int8_block", delta=True, keyframe_every=4,
+        )
+        sub = ts.WeightSubscriber("dchan", store_name="chaos_delta")
+        w = {f"w{i}": np.random.randn(512).astype(np.float32) for i in range(4)}
+
+        async def stream_publish():
+            cs = pub.stream()
+            for key in sorted(w):
+                await cs.put({key: w[key]})
+            return await cs.seal()
+
+        def assert_exact(sd):
+            for key in w:
+                base = pub._codec.entries[key]["baseline"]
+                got = sub._delta_decoder().state[key]["blocks"]
+                np.testing.assert_array_equal(got, base)
+                tol = np.abs(w[key]).max() / 127 + 1e-6
+                np.testing.assert_allclose(sd[key], w[key], atol=tol)
+
+        # Healthy streamed delta v0 (keyframes) + v1 (sparse deltas).
+        assert await stream_publish() == 0
+        sd, v = await sub.acquire(timeout=30)
+        assert v == 0
+        assert_exact(sd)
+        for key in list(w)[:1]:
+            w[key][:64] += 0.1
+        assert await stream_publish() == 1
+        sd, v = await sub.acquire(timeout=30)
+        assert v == 1
+        assert_exact(sd)
+
+        # v2 wedges after two layers (client-scope: publisher is local).
+        keys = sorted(w)
+        w[keys[0]][:64] += 0.1
+        cs2 = pub.stream()
+        await cs2.put({keys[0]: w[keys[0]]})
+        await cs2.put({keys[1]: w[keys[1]]})
+        await ts.inject_fault(
+            "channel.publish_layer", "wedge", count=1, scope="client",
+            store_name="chaos_delta",
+        )
+
+        async def wedged_rest():
+            for key in keys[2:]:
+                await cs2.put({key: w[key]})
+            await cs2.seal()
+
+        wedged = asyncio.ensure_future(wedged_rest())
+        await asyncio.sleep(0.3)
+        assert not wedged.done()
+        # Barrier join mid-wedge: previous sealed version, consistent
+        # watermarks for everything it serves.
+        sub2 = ts.WeightSubscriber("dchan", store_name="chaos_delta")
+        sd2, v2 = await sub2.acquire(timeout=15)
+        assert v2 == 1
+        state1 = await ts.client("chaos_delta").stream_state("dchan/v1")
+        served_sks = [f"dchan/v1/{k}" for k in keys]
+        assert stream_sync.inconsistent_keys(
+            state1, served_sks, state1["version"]
+        ) == []
+        # Crash the wedged publisher; a RESUMED publisher has no baselines
+        # and must re-keyframe (never delta over a lost baseline).
+        wedged.cancel()
+        await asyncio.gather(wedged, return_exceptions=True)
+        await ts.clear_faults(store_name="chaos_delta")
+        pub2 = ts.WeightPublisher(
+            "dchan", store_name="chaos_delta", keep=5,
+            transfer_quant="int8_block", delta=True, keyframe_every=4,
+        )
+        version = await pub2.publish(w)
+        assert version == 2  # partial v2 reclaimed, number reused
+        info = ts.state_dict_utils.parse_quant_blob(
+            await ts.client("chaos_delta").get(f"dchan/v2/{keys[0]}")
+        )
+        assert info["flags"] & ts.state_dict_utils._FLAG_KEYFRAME
+        sd, v = await sub.acquire(timeout=30)
+        assert v == 2
+        for key in w:
+            tol = np.abs(w[key]).max() / 127 + 1e-6
+            np.testing.assert_allclose(sd[key], w[key], atol=tol)
+            np.testing.assert_array_equal(
+                sub._delta_decoder().state[key]["blocks"],
+                pub2._codec.entries[key]["baseline"],
+            )
+        # Scheduled baseline-loss injection: the next delta publish fails
+        # LOUDLY at the faultpoint instead of shipping anything stale.
+        await ts.inject_fault(
+            "channel.delta_baseline", "raise", count=1, scope="client",
+            store_name="chaos_delta",
+        )
+        w[keys[0]][:64] += 0.1
+        from torchstore_tpu.faults import FaultInjectedError
+
+        with pytest.raises(FaultInjectedError):
+            await pub2.publish(w)
+        await ts.clear_faults(store_name="chaos_delta")
+        version = await pub2.publish(w)
+        sd, v = await sub.acquire(timeout=30)
+        assert v == version
+        for key in w:
+            tol = np.abs(w[key]).max() / 127 + 1e-6
+            np.testing.assert_allclose(sd[key], w[key], atol=tol)
+    finally:
+        await ts.clear_faults(store_name="chaos_delta")
+        await ts.shutdown("chaos_delta")
+
+
 async def test_chaos_tiered_cohorts_kill_mid_spill_and_fault_in(
     fast_health, monkeypatch, tmp_path
 ):

@@ -1,0 +1,467 @@
+"""Blockwise int8/int4 + delta wire tier (ISSUE 13).
+
+Covers the fused-blob codec (scales packed in the same segment as the
+payload via the arena layout's scale slots), the delta encoder/decoder
+(bit-identical publisher baseline vs reader accumulation, keyframe
+cadence, chain walks), the unchanged-watermark protocol (streamed reads of
+unchanged keys served from v-1 bytes with ZERO re-transfer, seal re-check
+consistent), plan-cache integration (quantized publishes hit the cache —
+no exclusion branch), loud-failure paths (NaN block naming, broken delta
+chains, the channel.delta_baseline faultpoint), and the provisioning
+manifest's scale-bearing blob sizes.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import faults
+from torchstore_tpu import state_dict_utils as sdu
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture
+async def store():
+    await ts.initialize(store_name="qd")
+    yield "qd"
+    await ts.shutdown("qd")
+
+
+def _metric(name: str) -> float:
+    snap = ts.metrics_snapshot()
+    m = snap.get(name) or {"series": []}
+    return float(sum(s["value"] for s in m["series"]))
+
+
+def _tol(arr, qmax=127.0):
+    # One keyframe step per block bounds the tier's error (the skip rule's
+    # threshold is half a step; shipped residuals re-center).
+    return float(np.max(np.abs(arr))) / qmax + 1e-6
+
+
+# --------------------------------------------------------------------------
+# codec
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["int8_block", "int4_block"])
+async def test_blockwise_roundtrip(store, fmt):
+    sd = {
+        "w": np.random.randn(300, 17).astype(np.float32),  # ragged tail block
+        "b": np.random.randn(5).astype(np.float32) * 0.01,
+        "step": 7,
+    }
+    await ts.put_state_dict("m", sd, transfer_quant=fmt, store_name="qd")
+    out = await ts.get_state_dict("m", store_name="qd")
+    qmax = sdu._QMAX[fmt]
+    assert out["w"].dtype == np.float32 and out["w"].shape == (300, 17)
+    np.testing.assert_allclose(out["w"], sd["w"], atol=_tol(sd["w"], qmax))
+    np.testing.assert_allclose(out["b"], sd["b"], atol=_tol(sd["b"], qmax))
+    assert out["step"] == 7
+
+
+async def test_blockwise_inplace_and_jax_targets(store):
+    sd = {"w": np.random.randn(64, 8).astype(np.float32)}
+    await ts.put_state_dict(
+        "mi", sd, transfer_quant="int8_block", store_name="qd"
+    )
+    user = {"w": np.zeros((64, 8), np.float32)}
+    out = await ts.get_state_dict("mi", user_state_dict=user, store_name="qd")
+    assert out["w"] is user["w"]  # decoded into the caller's memory
+    np.testing.assert_allclose(user["w"], sd["w"], atol=_tol(sd["w"]))
+    # jax spec target: decoded host-side, device_put with the target dtype.
+    spec = jax.ShapeDtypeStruct(
+        (64, 8),
+        jnp.float32,
+        sharding=jax.sharding.SingleDeviceSharding(jax.devices()[0]),
+    )
+    out = await ts.get_state_dict(
+        "mi", user_state_dict={"w": spec}, store_name="qd"
+    )
+    assert out["w"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), sd["w"], atol=_tol(sd["w"])
+    )
+
+
+async def test_scales_ride_the_payload_segment(store):
+    """The wire/store artifact is ONE uint8 blob per tensor whose layout
+    (landing.quant_blob_layout) fuses the scale table after the payload —
+    stored bytes are ~N + scales, never a separate scales object."""
+    from torchstore_tpu.transport import landing
+
+    n = 256 * 256
+    sd = {"w": np.random.randn(256, 256).astype(np.float32)}
+    await ts.put_state_dict(
+        "ms", sd, transfer_quant="int8_block", store_name="qd"
+    )
+    stats = await ts.client("qd").controller.stats.call_one(
+        include_volumes=True
+    )
+    (vstats,) = stats["volumes"].values()
+    expect = landing.quant_wire_nbytes("int8_block", 256, n, 2)
+    # Stored bytes ~= one fused blob (+ the marker object), far under 4N.
+    assert vstats["stored_bytes"] < expect + 4096
+    assert expect < n * 1.05  # scale slots cost ~1.6% at block 256
+
+
+async def test_nonfinite_block_names_key_and_block(store):
+    bad = np.random.randn(1024).astype(np.float32)
+    bad[700] = np.nan  # block 2 at block size 256
+    with pytest.raises(ValueError, match=r"'w'.*block 2.*non-finite") as ei:
+        await ts.put_state_dict(
+            "nf", {"w": bad}, transfer_quant="int8_block", store_name="qd"
+        )
+    assert "block 2" in str(ei.value)
+    # Per-tensor int8 still raises (no block index: one block per tensor).
+    with pytest.raises(ValueError, match="non-finite"):
+        await ts.put_state_dict(
+            "nf", {"w": bad}, transfer_quant="int8", store_name="qd"
+        )
+
+
+def test_cross_backend_dequantize_bit_equivalence():
+    """Satellite: the blessed _dequantize produces BIT-identical bytes on
+    numpy and jax-cpu (one f32 code x f32 scale path, no
+    numpy-rounds-the-scale-but-jax-does-not seam)."""
+    q = np.random.randint(-127, 128, 4096).astype(np.int8)
+    for scale in (0.0123456789, 1.0, 3.7e-5):
+        a = sdu._dequantize(q, scale, "float32")
+        b = np.asarray(sdu._dequantize(jnp.asarray(q), scale, "float32"))
+        assert a.tobytes() == b.tobytes()
+    # The vector path (blockwise scales) through the same core:
+    codes = np.random.randint(-127, 128, (16, 64)).astype(np.int8)
+    scales = np.abs(np.random.randn(16, 1)).astype(np.float32) + 1e-3
+    a = sdu._dequant_codes(codes, scales)
+    b = np.asarray(sdu._dequant_codes(jnp.asarray(codes), scales))
+    assert a.tobytes() == b.tobytes()
+
+
+async def test_env_default_mode(store):
+    """TORCHSTORE_TPU_TRANSFER_QUANT selects the wire tier without call-site
+    changes (config-resolved per client)."""
+    client = ts.client("qd")
+    orig = client._config
+    client._config = orig.merged(transfer_quant="int8_block")
+    try:
+        sd = {"w": np.random.randn(128).astype(np.float32)}
+        await ts.put_state_dict("me", sd, store_name="qd")
+        marker = await client.get("me/MAPPING")
+        assert marker["quant"]["fmt"] == "int8_block"
+        out = await ts.get_state_dict("me", store_name="qd")
+        np.testing.assert_allclose(out["w"], sd["w"], atol=_tol(sd["w"]))
+    finally:
+        client._config = orig
+
+
+# --------------------------------------------------------------------------
+# plan cache (acceptance: no cache-exclusion branch remains)
+# --------------------------------------------------------------------------
+
+
+async def test_quantized_publishes_hit_plan_cache(store):
+    sd = {
+        "w": np.random.randn(1024).astype(np.float32),
+        "b": np.random.randn(32).astype(np.float32),
+    }
+    user = {"w": np.zeros(1024, np.float32), "b": np.zeros(32, np.float32)}
+    hits0 = _metric("ts_plan_cache_hits_total")
+    for it in range(3):
+        sd["w"][0] = float(it)
+        await ts.put_state_dict(
+            "pc", sd, transfer_quant="int8_block", store_name="qd"
+        )
+        await ts.get_state_dict("pc", user_state_dict=user, store_name="qd")
+    hits = _metric("ts_plan_cache_hits_total") - hits0
+    # Warm iterations hit on BOTH the put and the get plan.
+    assert hits >= 4, hits
+    np.testing.assert_allclose(user["w"], sd["w"], atol=_tol(sd["w"]))
+
+
+# --------------------------------------------------------------------------
+# delta tier: channel publishes
+# --------------------------------------------------------------------------
+
+
+async def test_delta_channel_accuracy_and_unchanged(store):
+    pub = ts.WeightPublisher(
+        "dc", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    sub = ts.WeightSubscriber("dc", store_name="qd")
+    w = {
+        "hot": np.random.randn(600).astype(np.float32),
+        "frozen": np.random.randn(600).astype(np.float32),
+    }
+    unchanged0 = _metric("ts_delta_unchanged_keys_total")
+    kf0 = _metric("ts_delta_keyframes_total")
+    for v in range(4):
+        if v:
+            w["hot"][:100] += 0.05
+        ver = await pub.publish(w)
+        sd, got = await sub.acquire(timeout=30)
+        assert got == ver == v
+        for k in w:
+            np.testing.assert_allclose(sd[k], w[k], atol=_tol(w[k]))
+        # Reader accumulation is BIT-identical to the publisher baseline.
+        st = sub._delta_decoder().state[k]
+        np.testing.assert_array_equal(
+            st["blocks"], pub._codec.entries[k]["baseline"]
+        )
+    # The frozen key went unchanged (zero bytes shipped) after its first
+    # delta round; keyframes fired once per key at v0.
+    assert _metric("ts_delta_unchanged_keys_total") - unchanged0 >= 2
+    assert _metric("ts_delta_keyframes_total") - kf0 >= 2
+    # A fresh (joining) barrier reader chain-walks to the same bytes.
+    sub2 = ts.WeightSubscriber("dc", store_name="qd")
+    sd2, v2 = await sub2.acquire(timeout=30)
+    assert v2 == ver
+    for k in w:
+        np.testing.assert_array_equal(np.asarray(sd2[k]), np.asarray(sd[k]))
+
+
+async def test_delta_keyframe_cadence_bounds_chain(store):
+    pub = ts.WeightPublisher(
+        "kc", store_name="qd", keep=4, transfer_quant="int8_block",
+        delta=True, keyframe_every=3,
+    )
+    sub = ts.WeightSubscriber("kc", store_name="qd")
+    w = {"w": np.random.randn(512).astype(np.float32)}
+    kf0 = _metric("ts_delta_keyframes_total")
+    for v in range(7):
+        w["w"][:64] += 0.01
+        await pub.publish(w)
+        await sub.acquire(timeout=30)
+    # Keyframes at v0, v3, v6 — cadence 3.
+    assert _metric("ts_delta_keyframes_total") - kf0 == 3
+
+
+async def test_delta_requires_blockwise_and_retained_chain(store):
+    with pytest.raises(ValueError, match="blockwise"):
+        await ts.WeightPublisher(
+            "dv", store_name="qd", transfer_quant="int8", delta=True
+        ).publish({"w": np.ones(8, np.float32)})
+    with pytest.raises(ValueError, match="keep >= keyframe"):
+        await ts.WeightPublisher(
+            "dv2", store_name="qd", keep=2, transfer_quant="int8_block",
+            delta=True, keyframe_every=8,
+        ).publish({"w": np.ones(8, np.float32)})
+
+
+async def test_delta_broken_chain_fails_loudly(store):
+    """A delta whose baseline version was evicted must raise — never
+    silently serve a drifted accumulation."""
+    pub = ts.WeightPublisher(
+        "bc", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    w = {"w": np.random.randn(512).astype(np.float32)}
+    await pub.publish(w)          # v0 keyframe
+    w["w"][:64] += 0.5
+    await pub.publish(w)          # v1 delta on v0
+    client = ts.client("qd")
+    # Simulate retention violation: the keyframe's bytes vanish.
+    await client.delete_prefix("bc/v0")
+    fresh = ts.WeightSubscriber("bc", store_name="qd")
+    with pytest.raises(RuntimeError, match="delta chain broken"):
+        await fresh.acquire(version=1, timeout=30)
+
+
+async def test_delta_baseline_faultpoint_raises_loudly(store):
+    """channel.delta_baseline armed with raise: both the publisher's
+    baseline reuse and the reader's accumulation fail LOUDLY (never a
+    silent re-keyframe over stale bytes), and recovery works after
+    clearing."""
+    pub = ts.WeightPublisher(
+        "fb", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    sub = ts.WeightSubscriber("fb", store_name="qd")
+    w = {"w": np.random.randn(512).astype(np.float32)}
+    await pub.publish(w)
+    await sub.acquire(timeout=30)
+    faults.arm("channel.delta_baseline", "raise", count=1)
+    try:
+        w["w"][:64] += 0.1
+        with pytest.raises(faults.FaultInjectedError):
+            await pub.publish(w)
+    finally:
+        faults.disarm("channel.delta_baseline")
+    # Cleared: the interrupted version number was consumed or not, either
+    # way the next publish + acquire converge on correct bytes.
+    ver = await pub.publish(w)
+    sd, got = await sub.acquire(timeout=30)
+    assert got == ver
+    np.testing.assert_allclose(sd["w"], w["w"], atol=_tol(w["w"]))
+
+
+# --------------------------------------------------------------------------
+# unchanged-watermark protocol (streamed)
+# --------------------------------------------------------------------------
+
+
+async def test_streamed_unchanged_served_from_v1_bytes_zero_retransfer(store):
+    """Acceptance: a streamed delta publish of unchanged keys watermarks
+    them as aliases; a warm streaming subscriber serves them from its
+    accumulated v-1 state with ZERO re-transfer, and the final seal
+    re-check passes (no restarts, no MixedGenerationError)."""
+    pub = ts.WeightPublisher(
+        "su", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    sub = ts.WeightSubscriber("su", store_name="qd")
+    layers = {
+        str(i): np.random.randn(256).astype(np.float32) for i in range(3)
+    }
+    order = [f"layers/{i}" for i in range(3)]
+
+    async def publish(churn: bool):
+        cs = pub.stream()
+        for i in range(3):
+            if churn and i == 0:
+                layers["0"][:32] += 0.1
+            await cs.put({"layers": {str(i): layers[str(i)]}})
+        return await cs.seal()
+
+    async def acquire():
+        served = []
+        task = asyncio.ensure_future(
+            sub.acquire_streamed(
+                key_order=order,
+                on_layer=lambda fk, v: served.append(fk),
+                timeout=30,
+            )
+        )
+        sd, ver = await task
+        assert served == order
+        return sd, ver
+
+    falls0 = _metric("ts_stream_fallbacks_total")
+    served0 = _metric("ts_delta_unchanged_served_total")
+    # v0 keyframes; v1 and v2: layers 1-2 frozen -> unchanged aliases.
+    for v in range(3):
+        pt = asyncio.ensure_future(publish(churn=v > 0))
+        sd, ver = await acquire()
+        await pt
+        assert ver == v
+        for i in range(3):
+            np.testing.assert_allclose(
+                sd["layers"][str(i)], layers[str(i)],
+                atol=_tol(layers[str(i)]),
+            )
+    # Frozen layers at v1/v2 were served locally (4 = 2 layers x 2
+    # versions), with zero stream restarts — the seal re-check treated the
+    # unchanged watermarks as consistent.
+    assert _metric("ts_delta_unchanged_served_total") - served0 >= 4
+    assert _metric("ts_stream_fallbacks_total") - falls0 == 0
+    # Controller-side: the stream record carries the aliases, watermarked
+    # at the stream version (inconsistent_keys == []).
+    from torchstore_tpu import stream_sync
+
+    state = await ts.client("qd").stream_state("su/v2")
+    aliased = [k for k in state["aliases"]]
+    assert aliased, state
+    assert (
+        stream_sync.inconsistent_keys(state, aliased, state["version"]) == []
+    )
+
+
+async def test_unchanged_alias_to_missing_base_fails_publish(store):
+    """The controller validates alias targets are committed: an alias to
+    GC'd bytes fails the PUBLISHER loudly instead of handing readers an
+    unservable key."""
+    client = ts.client("qd")
+    await client.stream_begin("ghost/v3")
+    with pytest.raises(Exception, match="not committed"):
+        await client.stream_mark_unchanged(
+            "ghost/v3", 1, {"ghost/v3/w": ("ghost/v2/w", 2)}
+        )
+
+
+async def test_recreated_channel_resets_delta_decoder(store):
+    """Review hardening: a deleted-then-recreated channel restarts version
+    numbering under a fresh epoch — a subscriber's accumulated state from
+    the OLD epoch must never satisfy the new epoch's delta bases (same
+    version ints, different weights)."""
+    pub = ts.WeightPublisher(
+        "re", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    sub = ts.WeightSubscriber("re", store_name="qd")
+    old = {"w": np.random.randn(512).astype(np.float32)}
+    await pub.publish(old)  # old-epoch v0 keyframe
+    sd, v = await sub.acquire(timeout=30)
+    assert v == 0
+    await pub.close(delete=True)
+    # Fresh epoch, numbering restarts; DIFFERENT weights. Publish v0 AND
+    # v1 before the subscriber wakes, so it jumps straight to v1 — a
+    # delta whose base (v0) matches the stale state's version int.
+    pub2 = ts.WeightPublisher(
+        "re", store_name="qd", keep=5, transfer_quant="int8_block",
+        delta=True, keyframe_every=4,
+    )
+    new = {"w": np.random.randn(512).astype(np.float32)}
+    assert await pub2.publish(new) == 0
+    new["w"][:64] += 0.1
+    assert await pub2.publish(new) == 1
+    sd, v = await sub.acquire(timeout=30)
+    assert v == 1
+    np.testing.assert_allclose(sd["w"], new["w"], atol=_tol(new["w"]))
+    np.testing.assert_array_equal(
+        sub._delta_decoder().state["w"]["blocks"],
+        pub2._codec.entries["w"]["baseline"],
+    )
+
+
+async def test_stream_record_reuse_drops_stale_quant_meta(store):
+    """Review hardening: an unquantized stream over a key that previously
+    streamed QUANTIZED must not inherit the old record's quant meta —
+    readers would skip in-place landings and misdecode raw tensors."""
+    client = ts.client("qd")
+    x1 = np.random.randn(64).astype(np.float32)
+    s = ts.state_dict_stream("rq", transfer_quant="int8_block", store_name="qd")
+    await s.put({"w": x1})
+    await s.seal()
+    out = await ts.get_state_dict("rq", stream=True, store_name="qd")
+    np.testing.assert_allclose(out["w"], x1, atol=_tol(x1))
+    # Same key, now unquantized: the record must carry quant=None and the
+    # streamed read must land IN PLACE into the user target.
+    x2 = np.random.randn(64).astype(np.float32)
+    s2 = ts.state_dict_stream("rq", store_name="qd")
+    await s2.put({"w": x2})
+    await s2.seal()
+    assert (await client.stream_state("rq"))["quant"] is None
+    user = {"w": np.zeros(64, np.float32)}
+    out = await ts.get_state_dict(
+        "rq", user_state_dict=user, stream=True, store_name="qd"
+    )
+    assert out["w"] is user["w"]
+    np.testing.assert_array_equal(user["w"], x2)
+
+
+# --------------------------------------------------------------------------
+# provisioning manifest
+# --------------------------------------------------------------------------
+
+
+def test_manifest_sizes_quant_blobs():
+    from torchstore_tpu.provision.manifest import StateDictManifest
+    from torchstore_tpu.transport.landing import quant_wire_nbytes
+
+    sd = {
+        "w": np.zeros((1000, 32), np.float32),
+        "idx": np.zeros(100, np.int64),  # non-floating: uncompressed
+    }
+    man = StateDictManifest.from_state_dict(
+        sd, transfer_quant="int4_block", quant_block=256
+    )
+    by_key = {e.key: e for e in man.entries}
+    assert by_key["w"].request_nbytes == (
+        quant_wire_nbytes("int4_block", 256, 32000, 2),
+    )
+    assert by_key["w"].nbytes < sd["w"].nbytes / 6  # ~8x minus overhead
+    assert by_key["idx"].nbytes == sd["idx"].nbytes

@@ -885,6 +885,69 @@ def test_stream_discipline_live_tree_clean():
     assert _msgs(result.findings, "stream-discipline") == []
 
 
+def test_quant_discipline_flags_raw_scale_access(tmp_path):
+    """quant-discipline: raw ``["scales"]`` subscripts / ``.get("scales")``
+    in data-plane modules are flagged; the codec's home
+    (state_dict_utils.py) and the arena-layout module (landing.py) pass."""
+    from torchstore_tpu.analysis.checkers import quant_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/weight_channel.py": """
+                def bad(marker, key):
+                    s = marker["quant"]["scales"][key]  # seeded defect
+                    t = marker.get("scales")  # seeded defect
+                    return s, t
+            """,
+            "torchstore_tpu/transport/bulk.py": """
+                def also_bad(blob_meta):
+                    return blob_meta["scales"]  # seeded defect
+            """,
+            "torchstore_tpu/state_dict_utils.py": """
+                def codec_home(info):
+                    return info["scales"]  # the blessed home
+            """,
+            "torchstore_tpu/transport/landing.py": """
+                def layout_home(layout):
+                    return layout["scales"]  # the layout module
+            """,
+        },
+    )
+    findings = quant_discipline.check(project)
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, 0)
+        by_path[f.path] += 1
+    assert by_path == {
+        "torchstore_tpu/weight_channel.py": 2,
+        "torchstore_tpu/transport/bulk.py": 1,
+    }, by_path
+
+
+def test_quant_discipline_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/client.py": """
+                def debug_dump(info):
+                    return dict(info["scales"])  # tslint: disable=quant-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["quant-discipline"])
+    assert result.new == []
+
+
+def test_quant_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays
+    empty): scale tables are only ever touched by the codec in
+    state_dict_utils and the layout math in transport/landing.py."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["quant-discipline"])
+    assert _msgs(result.findings, "quant-discipline") == []
+
+
 def test_one_sided_discipline_live_tree_clean():
     """The live tree stays clean under the new rule (baseline stays empty):
     every client/direct segment read goes through the stamped helpers, and

@@ -18,6 +18,7 @@ from torchstore_tpu.analysis.checkers import (
     metric_discipline,
     one_sided,
     orphan_task,
+    quant_discipline,
     retry_discipline,
     stream_discipline,
 )
@@ -34,4 +35,5 @@ CHECKERS = {
     retry_discipline.RULE: retry_discipline.check,
     one_sided.RULE: one_sided.check,
     stream_discipline.RULE: stream_discipline.check,
+    quant_discipline.RULE: quant_discipline.check,
 }

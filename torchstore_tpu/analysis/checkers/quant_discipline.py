@@ -1,0 +1,104 @@
+"""quant-discipline: scale tables live with the payload, nowhere else.
+
+The blockwise quant wire tier (state_dict_utils + the arena layout in
+transport/landing.py) is only sound because scales travel IN the fused blob
+— the same segment as the codes they decode (compute_arena_layout's
+scale-slot mode), parsed and applied by the one blessed codec. Code
+elsewhere that reads or writes a scale table by hand (a ``["scales"]``
+subscript on a blob section, a marker meta, or a stream record) re-derives
+the layout — and the first drift (a stale offset after a block-size change,
+scales fetched over a different RPC than their payload) silently decodes
+weights with the WRONG scales, the exact corruption the fused format
+exists to kill.
+
+Rule: outside the codec's home (``state_dict_utils.py``) and the layout
+module (``transport/landing.py``), any subscript or ``.get(...)`` whose key
+is the string literal ``"scales"`` is a finding in the data-plane modules
+(transport/, client, controller, storage_volume, weight_channel,
+stream_sync, direct_weight_sync, api, provision/). Tests and scripts are
+out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project
+
+RULE = "quant-discipline"
+
+_BLESSED = (
+    "torchstore_tpu/state_dict_utils.py",
+    "torchstore_tpu/transport/landing.py",
+)
+
+_SCOPED_PREFIXES = (
+    "torchstore_tpu/transport/",
+    "torchstore_tpu/provision/",
+)
+
+_SCOPED_FILES = (
+    "torchstore_tpu/client.py",
+    "torchstore_tpu/controller.py",
+    "torchstore_tpu/storage_volume.py",
+    "torchstore_tpu/weight_channel.py",
+    "torchstore_tpu/stream_sync.py",
+    "torchstore_tpu/direct_weight_sync.py",
+    "torchstore_tpu/api.py",
+)
+
+_MESSAGE = (
+    "raw scale-table access outside the quant codec: scales are part of "
+    "the fused blob layout owned by state_dict_utils + "
+    "transport/landing.py (compute_arena_layout scale slots) — reading or "
+    "writing them by hand can silently decode weights with the wrong "
+    "scales; route through parse_quant_blob / the DeltaDecoder"
+)
+
+
+def _in_scope(path: str) -> bool:
+    if path in _BLESSED:
+        return False
+    if path in _SCOPED_FILES:
+        return True
+    return any(path.startswith(p) for p in _SCOPED_PREFIXES)
+
+
+def _is_scales_literal(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value == "scales"
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not _in_scope(sf.path):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Subscript) and _is_scales_literal(
+                node.slice
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+                continue
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and _is_scales_literal(node.args[0])
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+    return findings

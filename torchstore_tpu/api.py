@@ -407,19 +407,28 @@ async def get_state_dict(
 
 
 def state_dict_stream(
-    key: str, transfer_dtype=None, store_name: str = DEFAULT_STORE
+    key: str,
+    transfer_dtype=None,
+    transfer_quant: Optional[str] = None,
+    store_name: str = DEFAULT_STORE,
 ):
     """Open an incremental (layer-streamed) publish of ``key``: push
     fragments with ``await stream.put(...)`` as tensors become ready, then
     ``await stream.seal()`` — each batch is watermarked per key so
     streaming consumers (``get_state_dict(stream=True)`` /
     ``WeightSubscriber.acquire_streamed``) serve it immediately, while
-    barrier readers still wake only on the sealed, complete dict. See
+    barrier readers still wake only on the sealed, complete dict.
+    ``transfer_quant`` ships floating layers as fused blockwise blobs
+    (delta encoding is a weight_channel feature — see
+    ``WeightPublisher(delta=True)``). See
     :mod:`torchstore_tpu.stream_sync`."""
     from torchstore_tpu import state_dict_utils
 
     return state_dict_utils.stream_state_dict(
-        client(store_name), key, transfer_dtype=transfer_dtype
+        client(store_name),
+        key,
+        transfer_dtype=transfer_dtype,
+        transfer_quant=transfer_quant,
     )
 
 

@@ -457,6 +457,7 @@ class LocalClient:
         items: dict[str, Any],
         plan_hint: Optional[dict] = None,
         watermark: Optional[tuple] = None,
+        unchanged: Optional[dict] = None,
     ) -> None:
         t0 = time.perf_counter()
         try:
@@ -468,7 +469,9 @@ class LocalClient:
                 keys=len(items),
                 key=next(iter(items), None),
             ) as sp:
-                nbytes = await self._put_batch(items, sp, plan_hint, watermark)
+                nbytes = await self._put_batch(
+                    items, sp, plan_hint, watermark, unchanged
+                )
                 dur = time.perf_counter() - t0
                 obs_profile.record_op(
                     "put",
@@ -502,6 +505,7 @@ class LocalClient:
         sp,
         plan_hint: Optional[dict] = None,
         watermark: Optional[tuple] = None,
+        unchanged: Optional[dict] = None,
     ) -> int:
         await self._ensure_setup()
         if self._volumes_stale:
@@ -677,6 +681,8 @@ class LocalClient:
             # stream version in the same indexing step — the watermark is
             # only ever visible once its bytes are committed.
             watermark=watermark,
+            # Unchanged-key aliases (delta tier) ride the same step.
+            unchanged=unchanged,
         )
         # The notify reply carries the placement epoch for free: a bump
         # (structural change anywhere in the fleet) drops cached plans.
@@ -1729,15 +1735,27 @@ class LocalClient:
     # layer-streamed sync (see torchstore_tpu/stream_sync.py)
     # ------------------------------------------------------------------
 
-    async def stream_begin(self, key: str) -> int:
+    async def stream_begin(self, key: str, quant: Optional[dict] = None) -> int:
         """Open the next streamed publish of ``key``; returns the assigned
-        stream version."""
+        stream version. ``quant`` registers static quantization meta on the
+        record so readers can decode layer blobs before the seal."""
         await self._ensure_setup()
-        return await self._controller.stream_begin.call_one(key)
+        return await self._controller.stream_begin.call_one(key, quant)
 
     async def stream_seal(self, key: str, version: int) -> None:
         await self._ensure_setup()
         await self._controller.stream_seal.call_one(key, version)
+
+    async def stream_mark_unchanged(
+        self, key: str, version: int, aliases: dict
+    ) -> None:
+        """Watermark unchanged keys of a streamed delta publish whose
+        fragment landed no bytes (every key aliased to the previous
+        version's committed bytes)."""
+        await self._ensure_setup()
+        await self._controller.stream_mark_unchanged.call_one(
+            key, version, aliases
+        )
 
     async def stream_state(self, key: str) -> Optional[dict]:
         """Snapshot of ``key``'s stream record, or None when never

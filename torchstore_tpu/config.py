@@ -64,6 +64,33 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "volume-side index pass instead of per-key segments); the bulk "
            "transport packs the same set into a single framed payload. "
            "0 disables packing."),
+    EnvVar("TORCHSTORE_TPU_TRANSFER_QUANT", "str", "none",
+           "Default wire quantization for state-dict publishes "
+           "(none|int8|int8_block|int4_block): floating leaves ship as "
+           "fused blockwise blobs (packed codes + f32 scale table in the "
+           "SAME arena segment) instead of full-precision tensors. An "
+           "explicit transfer_quant/transfer_dtype argument overrides "
+           "this default per call."),
+    EnvVar("TORCHSTORE_TPU_TRANSFER_QUANT_BLOCK", "int", 256,
+           "Elements per quantization block for the blockwise modes "
+           "(finer blocks: better accuracy, proportionally more scale "
+           "bytes — 256 costs ~1.6% overhead at int8). Must be even for "
+           "int4_block. Part of the plan signature: changing it is a "
+           "restructure."),
+    EnvVar("TORCHSTORE_TPU_DELTA_KEYFRAME", "int", 8,
+           "Delta wire tier (WeightPublisher delta publishes): a full "
+           "keyframe ships every this-many versions per key, bounding the "
+           "chain a joining/lagging reader must walk. The publisher "
+           "enforces channel keep >= this cadence so the chain is always "
+           "retained."),
+    EnvVar("TORCHSTORE_TPU_DELTA_SKIP_EPS", "float", 0.0,
+           "Delta wire tier: extra absolute slack on the per-block skip "
+           "threshold. A block skips (ships nothing) when its residual "
+           "max|w_t - baseline| is at or below half the block's keyframe "
+           "scale step (the representation's own noise floor) plus this "
+           "slack. Residuals are measured against the live weights, so "
+           "skipped error never compounds: served weights stay within "
+           "~half a keyframe step of the true ones at every version."),
     EnvVar("TORCHSTORE_TPU_PLAN_CACHE", "bool", True,
            "Cache put/get_state_dict transfer plans per (store, size "
            "signature), invalidated by the controller's placement epoch, "
@@ -79,6 +106,13 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "restarts after observing a superseded or mixed-generation "
            "stream (a newer publish overwrote keys mid-acquire) before "
            "failing loudly."),
+    EnvVar("TORCHSTORE_TPU_BULK_EMULATE_GBPS", "float", 0,
+           "Bench/test DCN emulation: when > 0, every bulk payload frame "
+           "send adds the wall time a link of this bandwidth (GB/s) would "
+           "need on top of the real transfer, so single-host benches "
+           "measure the cross-host regime the bulk transport targets "
+           "(bench.py delta_sync uses it). 0 (production default) "
+           "disables pacing entirely."),
     EnvVar("TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD", "int", 67108864,
            "Bulk transport payloads above this many bytes are striped "
            "across the pre-opened stripe connection set (puts, get "
@@ -479,6 +513,25 @@ class StoreConfig:
         default_factory=lambda: _env_int(
             "TORCHSTORE_TPU_ARENA_MAX_BYTES", 256 << 10
         )
+    )
+    # Default wire quantization for state-dict publishes (none|int8|
+    # int8_block|int4_block) and the blockwise scale granularity. See
+    # state_dict_utils' quant tier: fused blobs, scales in the payload's
+    # arena segment, plan-cacheable.
+    transfer_quant: str = field(
+        default_factory=lambda: _env_str("TORCHSTORE_TPU_TRANSFER_QUANT", "none")
+    )
+    quant_block: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_TRANSFER_QUANT_BLOCK", 256
+        )
+    )
+    # Delta wire tier cadence/threshold (weight_channel delta publishes).
+    delta_keyframe: int = field(
+        default_factory=lambda: _env_int("TORCHSTORE_TPU_DELTA_KEYFRAME", 8)
+    )
+    delta_skip_eps: float = field(
+        default_factory=lambda: _env_float("TORCHSTORE_TPU_DELTA_SKIP_EPS", 0.0)
     )
     # Iteration-stable transfer-plan cache for put/get_state_dict.
     plan_cache: bool = field(

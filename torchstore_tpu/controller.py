@@ -462,6 +462,7 @@ class Controller(Actor):
         write_gens: Optional[dict[str, dict[str, int]]] = None,
         supersede: bool = False,
         watermark: Optional[tuple] = None,
+        unchanged: Optional[dict] = None,
     ) -> None:
         """Index ``metas`` as stored on ``volume_id`` — a single id, or a
         LIST of ids for replicated puts (one RPC, one generation bump, and
@@ -491,7 +492,15 @@ class Controller(Actor):
         publish — every meta in this batch records ``version`` as its
         per-key stream watermark IN THE SAME INDEXING STEP as the metadata
         (no RPC between bytes-committed and watermark-visible), and the
-        generation bump below wakes ``wait_for_stream`` long-pollers."""
+        generation bump below wakes ``wait_for_stream`` long-pollers.
+
+        ``unchanged``: ``{new_store_key: (base_store_key, base_version)}``
+        — unchanged-key aliases of the SAME streamed publish (delta wire
+        tier): each alias watermarks ``new_store_key`` at the stream
+        version, pointing readers at the base key's already-committed
+        bytes, in the same watermark step as this batch's metas (requires
+        ``watermark``). The base keys are validated committed — a GC'd
+        base fails the publish loudly instead of wedging every reader."""
         await faults.afire("controller.notify")
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         stale_gens: dict[str, dict[str, int]] = {}
@@ -629,12 +638,22 @@ class Controller(Actor):
                     # timeline (setdefault: the first commit of a key is
                     # its landing; superseded late notifies don't count).
                     rec["landing_ts"].setdefault(meta.key, now)
+            if unchanged:
+                # Unchanged-key aliases ride the SAME watermark step as
+                # the batch's metas: readers woken by this notify see the
+                # aliased keys ready together with the landed ones.
+                self._record_unchanged(rec, unchanged, int(version), now)
             # Broadcast fan-out: keys that just landed on the origin
             # volume(s) start flowing down the channel's relay tree, per
             # layer — interior hops forward as watermarks land, never
             # waiting for the seal.
             await self._relay_on_landing(
                 stream_key, int(version), metas, volume_ids
+            )
+        elif unchanged:
+            raise ValueError(
+                "notify_put_batch(unchanged=...) requires watermark=: "
+                "unchanged-key aliases are a streamed-publish protocol"
             )
         await self._bump({meta.key for meta in metas})
         # The reply carries the placement epoch so publishers track it for
@@ -1045,6 +1064,17 @@ class Controller(Actor):
                 "version": version or 1,
                 "sealed": 0,
                 "watermarks": {},
+                # Unchanged-watermark aliases (delta wire tier):
+                # store_key -> (base_store_key, base_channel_version). A
+                # delta publish whose key is fully unchanged records its
+                # watermark HERE pointing at the previous version's bytes,
+                # so streamed readers deliberately serve bit-identical
+                # v/v-1 layers with zero re-transfer.
+                "aliases": {},
+                # Static quantization meta the publisher registered at
+                # stream_begin (readers decode per-layer blobs before the
+                # seal's marker exists).
+                "quant": None,
                 # Generation timeline (observability/timeline.py): begin ->
                 # per-key landings -> seal -> per-subscriber acquire acks.
                 "begin_ts": time.time(),
@@ -1066,14 +1096,22 @@ class Controller(Actor):
         return rec
 
     @endpoint
-    async def stream_begin(self, key: str) -> int:
+    async def stream_begin(self, key: str, quant: Optional[dict] = None) -> int:
         """Open the next streamed publish of ``key``; returns the assigned
         version (monotonic per key per controller lifetime). Long-pollers
         waiting for a stream to appear are woken (they observe the new
-        in-flight version and can start acquiring layer by layer)."""
+        in-flight version and can start acquiring layer by layer).
+
+        ``quant``: static quantization meta (fmt/block/delta context) for
+        a quantized streamed publish — readers need it to decode layer
+        blobs BEFORE the seal writes the commit marker."""
         rec = self._streams.get(key)
         version = (max(rec["version"], rec["sealed"]) + 1) if rec else 1
-        self._stream_rec(key, version)
+        rec = self._stream_rec(key, version)
+        # Unconditional: a reused record must not keep a PREVIOUS
+        # generation's quant meta when this stream publishes unquantized
+        # (readers would skip in-place landings and misdecode).
+        rec["quant"] = quant
         cond = self._cond()
         async with cond:
             cond.notify_all()
@@ -1093,6 +1131,46 @@ class Controller(Actor):
         async with cond:
             cond.notify_all()
 
+    def _record_unchanged(
+        self, rec: dict, aliases: dict, version: int, now: float
+    ) -> None:
+        """Record unchanged-key watermark aliases on one stream record:
+        each ``new_store_key`` is watermarked at ``version`` with its bytes
+        aliased to an already-committed base store key. Validated HERE so a
+        publish aliasing GC'd bytes fails the publisher loudly instead of
+        handing readers a key they can never fetch."""
+        for new_sk, alias in aliases.items():
+            base_sk, base_version = alias[0], int(alias[1])
+            infos = self.index.get(base_sk)
+            if not infos or self._committed_state(infos) != "committed":
+                raise ValueError(
+                    f"unchanged-watermark alias {new_sk!r} -> {base_sk!r}: "
+                    "base bytes are not committed (GC'd, spilled out of the "
+                    "index, or never landed) — readers could never serve "
+                    "this key; publish a keyframe instead"
+                )
+            prev = rec["watermarks"].get(new_sk, 0)
+            rec["watermarks"][new_sk] = max(prev, version)
+            rec.setdefault("aliases", {})[new_sk] = (base_sk, base_version)
+            if version == rec["version"]:
+                rec["landing_ts"].setdefault(new_sk, now)
+
+    @endpoint
+    async def stream_mark_unchanged(
+        self, key: str, version: int, aliases: dict
+    ) -> None:
+        """Watermark unchanged keys of a streamed publish whose fragment
+        carried NO landed bytes at all (every key aliased): the standalone
+        counterpart of ``notify_put_batch(unchanged=...)``. Safe as its own
+        RPC — the aliased bytes committed with a previous version's notify,
+        so there is no bytes-before-watermark window to close. Wakes
+        ``wait_for_stream`` long-pollers like any landing."""
+        rec = self._stream_rec(key, int(version))
+        self._record_unchanged(rec, aliases, int(version), time.time())
+        cond = self._cond()
+        async with cond:
+            cond.notify_all()
+
     @endpoint
     async def stream_state(self, key: str) -> Optional[dict]:
         """Snapshot of a stream record ({"version", "sealed", "watermarks"})
@@ -1106,6 +1184,8 @@ class Controller(Actor):
             "version": rec["version"],
             "sealed": rec["sealed"],
             "watermarks": dict(rec["watermarks"]),
+            "aliases": dict(rec.get("aliases") or {}),
+            "quant": rec.get("quant"),
             # Generation timeline (observability.timeline.reconstruct
             # folds these into publish-window / first-layer / per-
             # subscriber completion figures).
@@ -1205,6 +1285,7 @@ class Controller(Actor):
                 }
                 sealed = sealed and len(local) == len(ready)
                 ready = local
+            rec_aliases = rec.get("aliases") or {}
             return {
                 "missing": False,
                 "version": rec["version"],
@@ -1212,6 +1293,13 @@ class Controller(Actor):
                 "superseded": rec["version"] > version,
                 "ready": sorted(ready),
                 "watermarks": ready,
+                # Unchanged-watermark aliases for the ready keys: the
+                # reader serves these from the aliased (v-1) bytes — or
+                # from its own accumulated state with zero re-transfer.
+                "aliases": {
+                    k: rec_aliases[k] for k in ready if k in rec_aliases
+                },
+                "quant": rec.get("quant"),
             }
 
         def _changed() -> bool:
@@ -1243,6 +1331,8 @@ class Controller(Actor):
                     "superseded": False,
                     "ready": [],
                     "watermarks": {},
+                    "aliases": {},
+                    "quant": None,
                 }
             return view
 

@@ -113,6 +113,7 @@ REGISTRY: frozenset[str] = frozenset(
         "shm.landing_stamp",
         "channel.publish_layer",
         "channel.watermark",
+        "channel.delta_baseline",
         "relay.forward",
         "actor.ping",
         "bulk.send_frame",

@@ -51,6 +51,13 @@ INGRESS = "ingress"
 # reading "edges" must never mistake spill churn for network load.
 DISK = "disk"
 
+# Quantized wire-tier accounting (state_dict_utils): direction "logical"
+# carries the full-precision bytes a publish REPRESENTS, "wire" the fused
+# blob bytes that actually shipped. The matrix folds them into a "quant"
+# section with the effective compression ratio — never into edges (the
+# wire bytes are already counted there by the transports).
+QUANT = "quant"
+
 
 def _hostname() -> str:
     # utils.get_hostname is THE host identity (env-overridable) shared by
@@ -274,6 +281,7 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
     ingress: dict[str, int] = {}
     volumes: dict[str, dict] = {}
     disk: dict[str, dict] = {}
+    quant = {"bytes_logical": 0, "bytes_wire": 0}
     unattributed: dict[str, dict] = {}
 
     def _edge(src: str, dst: str, nbytes: int, ops: int) -> None:
@@ -301,6 +309,11 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
                     "spill_bytes" if direction == EGRESS else "fault_in_bytes"
                 ] += nbytes
                 continue
+            if cell.get("transport") == QUANT:
+                quant[
+                    "bytes_wire" if direction == "wire" else "bytes_logical"
+                ] += nbytes
+                continue
             if vid and peer:
                 # Per-volume totals from peer-aware cells ONLY (same
                 # count-once rule as the edges): an RPC get is recorded
@@ -325,11 +338,16 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
                 un["bytes_out" if direction == EGRESS else "bytes_in"] += (
                     nbytes
                 )
+    if quant["bytes_wire"]:
+        quant["compression_ratio"] = round(
+            quant["bytes_logical"] / quant["bytes_wire"], 3
+        )
     return {
         "edges": edges,
         "egress": egress,
         "ingress": ingress,
         "volumes": volumes,
         "disk": disk,
+        "quant": quant,
         "unattributed": unattributed,
     }

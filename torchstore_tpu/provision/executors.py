@@ -67,11 +67,19 @@ def _fail(report: dict, stage: str, exc: BaseException) -> None:
     )
 
 
-def as_manifest(state_dict_or_manifest: Any, transfer_dtype=None) -> StateDictManifest:
+def as_manifest(
+    state_dict_or_manifest: Any,
+    transfer_dtype=None,
+    transfer_quant: Optional[str] = None,
+    quant_block: int = 256,
+) -> StateDictManifest:
     if isinstance(state_dict_or_manifest, StateDictManifest):
         return state_dict_or_manifest
     return StateDictManifest.from_state_dict(
-        state_dict_or_manifest, transfer_dtype=transfer_dtype
+        state_dict_or_manifest,
+        transfer_dtype=transfer_dtype,
+        transfer_quant=transfer_quant,
+        quant_block=quant_block,
     )
 
 

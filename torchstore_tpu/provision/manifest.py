@@ -125,20 +125,34 @@ class StateDictManifest:
 
     @classmethod
     def from_state_dict(
-        cls, state_dict: Any, transfer_dtype=None
+        cls,
+        state_dict: Any,
+        transfer_dtype=None,
+        transfer_quant: Optional[str] = None,
+        quant_block: int = 256,
     ) -> "StateDictManifest":
         """Derive a manifest from a (possibly nested) state dict without
         moving any bytes. Tensor-ish leaves (numpy, torch, jax arrays and
         ShapeDtypeStructs, ``Shard`` wrappers) become entries; everything
         else (scalars, configs, opaque objects) is skipped — object puts ride
-        the RPC codec and need no provisioning."""
+        the RPC codec and need no provisioning.
+
+        ``transfer_quant`` sizes floating leaves as fused quant blobs
+        (header + bitmap + packed codes + SCALE SLOT, via the shared
+        ``landing.quant_wire_nbytes`` layout), so prewarmed pools hold
+        exactly the scale-bearing arena segment a quantized first publish
+        asks for."""
         from torchstore_tpu.state_dict_utils import flatten_state_dict
 
+        if transfer_quant in (None, "none", ""):
+            transfer_quant = None
         flat, _ = flatten_state_dict(state_dict)
         entries: list[ManifestEntry] = []
         device = False
         for key, value in sorted(flat.items()):
-            entry, on_device = _entry_of(key, value, transfer_dtype)
+            entry, on_device = _entry_of(
+                key, value, transfer_dtype, transfer_quant, quant_block
+            )
             if entry is not None:
                 entries.append(entry)
                 device = device or on_device
@@ -173,8 +187,31 @@ def _transfer_itemsize(dtype_name: str, transfer_dtype) -> int:
     return _itemsize(dtype_name)
 
 
+def _quant_entry(
+    key: str,
+    shape: tuple,
+    dtype: str,
+    transfer_quant: str,
+    quant_block: int,
+) -> ManifestEntry:
+    """One floating leaf under wire quantization: a SINGLE fused-blob
+    request (the blob is host-assembled whatever the source sharding),
+    sized by the arena-layout module's quant_wire_nbytes so the scale slot
+    is accounted for."""
+    from torchstore_tpu.transport.landing import quant_wire_nbytes
+
+    nelems = int(np.prod(shape)) if shape else 1
+    block = quant_block if transfer_quant != "int8" else max(1, nelems)
+    nbytes = quant_wire_nbytes(transfer_quant, block, nelems, len(shape))
+    return ManifestEntry(key, shape, dtype, (nbytes,))
+
+
 def _entry_of(
-    key: str, value: Any, transfer_dtype
+    key: str,
+    value: Any,
+    transfer_dtype,
+    transfer_quant: Optional[str] = None,
+    quant_block: int = 256,
 ) -> tuple[Optional[ManifestEntry], bool]:
     """(entry, is_device_resident) for one flat leaf; (None, False) for
     non-tensor leaves."""
@@ -182,6 +219,16 @@ def _entry_of(
     from torchstore_tpu import torch_interop
     from torchstore_tpu.client import Shard
 
+    if transfer_quant is not None:
+        entry, on_device = _entry_of(key, value, None)
+        if entry is not None and _is_floating_name(entry.dtype):
+            return (
+                _quant_entry(
+                    key, entry.shape, entry.dtype, transfer_quant, quant_block
+                ),
+                on_device,
+            )
+        return entry, on_device
     if isinstance(value, Shard):
         ts = value.tensor_slice
         shape = tuple(ts.local_shape)

@@ -624,6 +624,11 @@ def _child_main(pipe, actor_cls, name: str, args: tuple, kwargs: dict, env: dict
     from torchstore_tpu import faults as _faults
 
     _faults.reinit_after_fork()
+    # Re-read the bulk transport's emulated-bandwidth knob (bench/test DCN
+    # emulation) from the corrected env for the same reason.
+    from torchstore_tpu.transport import bulk as _bulk
+
+    _bulk.reinit_after_fork()
     try:
         asyncio.run(_child_async(pipe, actor_cls, name, args, kwargs))
     except KeyboardInterrupt:

@@ -164,11 +164,41 @@ class StreamedPut:
     and barrier readers key on the absent/old marker.
     """
 
-    def __init__(self, client, key: str, transfer_dtype=None) -> None:
+    def __init__(
+        self,
+        client,
+        key: str,
+        transfer_dtype=None,
+        transfer_quant: Optional[str] = None,
+        delta_ctx: Optional[dict] = None,
+    ) -> None:
+        from torchstore_tpu import state_dict_utils as sdu
+
         self._client = client
         self.key = key
         self.version: Optional[int] = None
         self._transfer_dtype = transfer_dtype
+        config = getattr(client, "_config", None)
+        self._quant = sdu.resolve_transfer_quant(
+            transfer_quant, transfer_dtype, config
+        )
+        if self._quant is not None and transfer_dtype is not None:
+            raise ValueError(
+                "transfer_quant and transfer_dtype are mutually exclusive "
+                "(quantization defines the wire format)"
+            )
+        if delta_ctx is not None and self._quant not in (
+            "int8_block", "int4_block"
+        ):
+            raise ValueError(
+                "delta streaming requires transfer_quant "
+                f"int8_block/int4_block (got {self._quant!r})"
+            )
+        self._qblock = getattr(config, "quant_block", 256) if config else 256
+        self._delta_ctx = delta_ctx
+        self._qkeys: list[str] = []
+        self._qdtypes: dict[str, str] = {}
+        self._aliases: dict[str, int] = {}  # flat key -> base channel version
         self._mapping: Optional[dict] = None
         self._leaf_sigs: dict[str, tuple] = {}
         self._sealed = False
@@ -178,7 +208,26 @@ class StreamedPut:
         Eager ``begin()`` lets consumers start their long-poll before the
         first layer is even trained."""
         if self.version is None:
-            self.version = await self._client.stream_begin(self.key)
+            quant = None
+            if self._quant is not None:
+                # Static decode meta readers need BEFORE the seal's commit
+                # marker exists: which wire format, and — for delta — the
+                # channel whose version directory the chain walks.
+                quant = {
+                    "fmt": self._quant,
+                    "block": self._qblock,
+                    "delta": (
+                        {
+                            "channel": self._delta_ctx["channel"],
+                            "version": int(self._delta_ctx["version"]),
+                        }
+                        if self._delta_ctx is not None
+                        else None
+                    ),
+                }
+            self.version = await self._client.stream_begin(
+                self.key, quant=quant
+            )
         return self.version
 
     @property
@@ -219,18 +268,78 @@ class StreamedPut:
             self._leaf_sigs[k] = sdu._leaf_signature(v)
         if self._transfer_dtype is not None:
             flat = sdu.cast_floating_tensors(flat, self._transfer_dtype)
+        fragment_aliases: dict[str, tuple] = {}
+        if self._quant is not None:
+            flat, fragment_aliases = await self._encode_quant(flat, sdu)
+        n_keys = len(flat) + len(fragment_aliases)
         with span(
             "stream.publish_layer",
             key=self.key,
             version=version,
-            keys=len(flat),
+            keys=n_keys,
         ):
-            await self._client.put_batch(
-                {sdu._store_key(self.key, k): v for k, v in flat.items()},
-                watermark=(self.key, version),
-            )
+            if flat:
+                await self._client.put_batch(
+                    {sdu._store_key(self.key, k): v for k, v in flat.items()},
+                    watermark=(self.key, version),
+                    unchanged=fragment_aliases or None,
+                )
+            elif fragment_aliases:
+                # Every key of this fragment is unchanged: no bytes land,
+                # the aliases alone watermark the keys (their base bytes
+                # committed with a previous version's notify).
+                await self._client.stream_mark_unchanged(
+                    self.key, version, fragment_aliases
+                )
         _LAYER_BATCHES.inc()
-        return len(flat)
+        return n_keys
+
+    async def _encode_quant(
+        self, flat: dict, sdu
+    ) -> tuple[dict, dict[str, tuple]]:
+        """Quantize one fragment's floating leaves into wire blobs.
+        Returns (flat_to_put, unchanged_aliases): delta-unchanged keys ship
+        NOTHING — they are aliased (new store key -> base store key) for
+        the same watermark step."""
+        from torchstore_tpu import torch_interop
+
+        out: dict = {}
+        aliases: dict[str, tuple] = {}
+        codec = (self._delta_ctx or {}).get("codec")
+        for fk, value in flat.items():
+            if torch_interop.is_torch_tensor(value):
+                value = torch_interop.to_numpy_view(value)
+            if not sdu._is_floating(value):
+                out[fk] = value
+                continue
+            sdu._guard_quantizable(fk, value)
+            self._qkeys.append(fk)
+            self._qdtypes[fk] = str(value.dtype)
+            if codec is not None:
+                version = int(self._delta_ctx["version"])
+                blob, base = await codec.encode(fk, value, version)
+                if blob is None:
+                    self._aliases[fk] = int(base)
+                    new_sk = sdu._store_key(self.key, fk)
+                    base_sk = sdu._store_key(
+                        sdu._delta_version_key(
+                            self._delta_ctx["channel"], base
+                        ),
+                        fk,
+                    )
+                    aliases[new_sk] = (base_sk, int(base))
+                    continue
+                out[fk] = blob
+            else:
+                blob, _, _, _ = sdu._encode_keyframe_blob(
+                    fk, value, self._quant,
+                    sdu._quant_leaf_block(self._quant, self._qblock, value),
+                )
+                sdu._record_quant_bytes(
+                    self._quant, getattr(value, "nbytes", 0), blob.nbytes
+                )
+                out[fk] = blob
+        return out, aliases
 
     async def seal(self) -> int:
         """Write the terminal records: the MAPPING commit marker (barrier
@@ -248,7 +357,7 @@ class StreamedPut:
         # consumers' cached get plans never serve the old structure.
         cache = getattr(self._client, "plan_cache", None)
         signature = tuple(sorted(self._leaf_sigs.items())) + (
-            ("cast", str(self._transfer_dtype), None),
+            ("cast", str(self._transfer_dtype), self._quant, self._qblock),
         )
         if cache is not None:
             if cache.last_put_sig.get(self.key) != signature:
@@ -260,6 +369,20 @@ class StreamedPut:
             "mapping": self._mapping,
             "stream": {"version": self.version},
         }
+        if self._quant is not None:
+            quant_meta: dict = {
+                "fmt": self._quant,
+                "block": self._qblock,
+                "keys": self._qkeys,
+                "dtypes": self._qdtypes,
+            }
+            if self._delta_ctx is not None:
+                quant_meta["delta"] = {
+                    "channel": self._delta_ctx["channel"],
+                    "version": int(self._delta_ctx["version"]),
+                    "aliases": dict(self._aliases),
+                }
+            marker["quant"] = quant_meta
         with span(
             "stream.seal",
             key=self.key,
@@ -275,9 +398,21 @@ class StreamedPut:
         return self.version
 
 
-def stream_state_dict(client, key: str, transfer_dtype=None) -> StreamedPut:
+def stream_state_dict(
+    client,
+    key: str,
+    transfer_dtype=None,
+    transfer_quant: Optional[str] = None,
+    delta_ctx: Optional[dict] = None,
+) -> StreamedPut:
     """Open an incremental (layer-streamed) publish of ``key``."""
-    return StreamedPut(client, key, transfer_dtype=transfer_dtype)
+    return StreamedPut(
+        client,
+        key,
+        transfer_dtype=transfer_dtype,
+        transfer_quant=transfer_quant,
+        delta_ctx=delta_ctx,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -295,8 +430,15 @@ async def get_state_dict_streamed(
     timeout: Optional[float] = None,
     wait_for_stream_s: Optional[float] = None,
     relay_volume: Optional[str] = None,
+    delta_state: Any = None,
 ) -> Any:
     """Acquire a streamed state dict layer by layer.
+
+    ``delta_state`` (a ``state_dict_utils.DeltaDecoder``) is this reader's
+    accumulated delta-tier state: quantized layers decode through it, and
+    unchanged-key layers (aliased to v-1 bytes) are served straight from
+    the accumulation with ZERO re-transfer. Without it, an ephemeral
+    decoder chain-fetches baselines as needed (fresh-joiner semantics).
 
     ``relay_volume`` routes the acquire through this host's BROADCAST
     RELAY copy (see torchstore_tpu/relay.py): the long-poll reports a
@@ -354,7 +496,8 @@ async def get_state_dict_streamed(
             # the loud NoMatchingPush when nothing was pushed at all.
             _FALLBACKS.inc(reason="no_stream")
             return await get_state_dict(
-                client, key, user_state_dict, strict=strict
+                client, key, user_state_dict, strict=strict,
+                delta_state=delta_state,
             )
         target = int(state["version"])
         try:
@@ -369,6 +512,7 @@ async def get_state_dict_streamed(
                 deadline,
                 config,
                 relay_volume=relay_volume,
+                delta_state=delta_state,
             )
         except _Restart as exc:
             _FALLBACKS.inc(reason=exc.reason)
@@ -400,7 +544,8 @@ async def get_state_dict_streamed(
                 # identically on every attempt. The barrier path serves
                 # the dict as of the commit marker, classic semantics.
                 return await get_state_dict(
-                    client, key, user_state_dict, strict=strict
+                    client, key, user_state_dict, strict=strict,
+                    delta_state=delta_state,
                 )
             continue
     # A wedged/mixed stream is a postmortem-grade event: flush the flight
@@ -426,6 +571,7 @@ async def _acquire_stream(
     deadline: Optional[float],
     config,
     relay_volume: Optional[str] = None,
+    delta_state: Any = None,
 ) -> Any:
     from torchstore_tpu import state_dict_utils as sdu
 
@@ -452,6 +598,36 @@ async def _acquire_stream(
     sealed = False
     poll = max(0.1, float(config.stream_poll_s))
     first_serve_ts: Optional[float] = None
+    # Quantized stream: the record's static meta (registered at
+    # stream_begin) drives per-layer blob decode BEFORE the seal's marker
+    # exists; the reader's decoder accumulates delta state and serves
+    # unchanged-alias keys with zero re-transfer.
+    qmeta: Optional[dict] = None
+    decoder = None
+    qchannel: Optional[str] = None
+    alias_of: dict[str, tuple] = {}  # new store key -> (base sk, base ver)
+
+    def _adopt_quant(meta: Optional[dict]) -> None:
+        nonlocal qmeta, decoder, qchannel
+        if meta is None or qmeta is not None:
+            return
+        qmeta = meta
+        decoder = delta_state if delta_state is not None else sdu.DeltaDecoder()
+        qchannel = (meta.get("delta") or {}).get("channel")
+
+    async def _decode_one(fk: str, raw: Any):
+        """Raw fetched value -> user-facing value (quant streams only).
+        Non-blob values (non-floating leaves) pass through untouched."""
+        info = sdu.parse_quant_blob(raw)
+        if info is None:
+            return raw
+        st = await decoder.decode(
+            fk, info, fetch_base=sdu._chain_fetcher(client, qchannel, fk)
+        )
+        user_leaf = user_flat.get(fk) if user_flat is not None else None
+        return sdu._quant_result(
+            st, user_leaf if sdu._is_fetch_target(user_leaf) else None
+        )
 
     async def serve(sks: list[str]) -> None:
         nonlocal first_serve_ts
@@ -459,22 +635,62 @@ async def _acquire_stream(
             sks = [sk for sk in sks if sk in flat_of]
         if not sks:
             return
-        fetched = await client.get_batch(
-            {sk: targets_of.get(sk) for sk in sks},
-            _seed_plan=False,
-            # Nearest-copy routing: the relay tree landed this host's own
-            # replica — read it instead of the origin volumes.
-            prefer_volume=relay_volume,
-        )
+        to_fetch: dict[str, tuple] = {}  # sk -> (fetch key, target)
+        local_vals: dict[str, Any] = {}
+        for sk in sks:
+            fk = flat_of.get(sk, sk[prefix_len:])
+            alias = alias_of.get(sk) if qmeta is not None else None
+            if alias is not None:
+                st = decoder.serve_unchanged(fk, alias[1])
+                if st is not None:
+                    # Bit-identical v-1 bytes already accumulated: serve
+                    # from local state, ZERO re-transfer.
+                    user_leaf = (
+                        user_flat.get(fk) if user_flat is not None else None
+                    )
+                    local_vals[sk] = sdu._quant_result(
+                        st,
+                        user_leaf
+                        if sdu._is_fetch_target(user_leaf)
+                        else None,
+                    )
+                    continue
+                to_fetch[sk] = (alias[0], None)
+            elif qmeta is not None:
+                # Floating leaves of a quant stream are blobs: fetch raw,
+                # decode lands in place. Non-floating leaves ship raw and
+                # keep their in-place targets.
+                tgt = targets_of.get(sk)
+                if tgt is not None and not sdu._is_floating(tgt):
+                    to_fetch[sk] = (sk, tgt)
+                else:
+                    to_fetch[sk] = (sk, None)
+            else:
+                to_fetch[sk] = (sk, targets_of.get(sk))
+        fetched = {}
+        if to_fetch:
+            fetched = await client.get_batch(
+                {src: tgt for src, tgt in to_fetch.values()},
+                _seed_plan=False,
+                # Nearest-copy routing: the relay tree landed this host's
+                # own replica — read it instead of the origin volumes.
+                prefer_volume=relay_volume,
+            )
         if first_serve_ts is None:
             first_serve_ts = time.time()
         for sk in sks:
             fk = flat_of.get(sk, sk[prefix_len:])
-            served[fk] = fetched[sk]
+            if sk in local_vals:
+                value = local_vals[sk]
+            else:
+                value = fetched[to_fetch[sk][0]]
+                if qmeta is not None:
+                    value = await _decode_one(fk, value)
+            served[fk] = value
             served_sks.append(sk)
             served_set.add(sk)
             if on_layer is not None:
-                await maybe_await(on_layer(fk, fetched[sk]))
+                await maybe_await(on_layer(fk, value))
 
     with span("stream.acquire", key=key, version=target):
         while not sealed:
@@ -499,6 +715,8 @@ async def _acquire_stream(
                 raise _Restart("stream_gone")
             if res["superseded"]:
                 raise _Restart("superseded")
+            _adopt_quant(res.get("quant"))
+            alias_of.update(res.get("aliases") or {})
             ready = res["ready"]
             known = len(ready)
             drift = inconsistent_keys(res, ready, target)

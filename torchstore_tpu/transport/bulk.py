@@ -55,6 +55,48 @@ from torchstore_tpu.transport.types import Request, TensorMeta
 
 logger = get_logger("torchstore_tpu.transport.bulk")
 
+
+def _env_emulate_gbps() -> float:
+    import os
+
+    try:
+        return float(os.environ.get("TORCHSTORE_TPU_BULK_EMULATE_GBPS", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+# Emulated link bandwidth (GB/s) for benches/tests: when > 0, every payload
+# frame send adds the wall time a link of that bandwidth would need on top
+# of the real (loopback) transfer — so a single-host bench measures the
+# cross-host DCN regime this transport actually targets (where the
+# quantized/delta wire tier earns its keep). Production: leave unset —
+# the pace check is one float compare per frame. Parsed at import and
+# re-read after fork (actor children apply their corrected env first);
+# same-process benches call set_emulated_gbps().
+_EMULATE_GBPS = _env_emulate_gbps()
+
+
+def set_emulated_gbps(gbps: Optional[float]) -> float:
+    """Set (or, with None, re-read from env) the emulated link bandwidth
+    for THIS process; returns the previous value so benches can restore."""
+    global _EMULATE_GBPS
+    prev = _EMULATE_GBPS
+    _EMULATE_GBPS = _env_emulate_gbps() if gbps is None else float(gbps)
+    return prev
+
+
+def reinit_after_fork() -> None:
+    """Re-read the emulated-bandwidth knob from the child's corrected env
+    (the forkserver's module state carries the spawner's value)."""
+    set_emulated_gbps(None)
+
+
+async def _pace(nbytes: int) -> None:
+    """Emulated-DCN pacing for one payload frame (no-op when disabled)."""
+    if _EMULATE_GBPS > 0 and nbytes > 0:
+        await asyncio.sleep(nbytes / (_EMULATE_GBPS * 1e9))
+
+
 _FRAME = struct.Struct("<QIQ")
 IDX_HELLO = 0xFFFFFFFF
 IDX_ABORT = 0xFFFFFFFE
@@ -178,6 +220,7 @@ async def _send_frame(
         await loop.sock_sendall(sock, _FRAME.pack(session, idx, nbytes))
         if payload is not None:
             await loop.sock_sendall(sock, payload)
+            await _pace(nbytes)
 
 
 async def _send_frame_raw(
@@ -194,6 +237,7 @@ async def _send_frame_raw(
     )
     await loop.sock_sendall(sock, subheader)
     await loop.sock_sendall(sock, payload)
+    await _pace(payload.nbytes)
 
 
 def _shutdown_sock(sock: socket.socket) -> None:

@@ -299,14 +299,97 @@ def align_up(n: int, align: int = ARENA_ALIGN) -> int:
     return (n + align - 1) // align * align
 
 
-def compute_arena_layout(sizes: list[int]) -> tuple[list[int], int]:
+# Scale tables are f32: the slot fused after each payload only needs 4-byte
+# alignment (64B between MEMBERS stays — the payload start dominates cache
+# behavior; padding a 4-byte-aligned scale run to 64B would waste more than
+# the whole table for small tensors).
+SCALE_ALIGN = 4
+
+
+def compute_arena_layout(
+    sizes: list[int], scale_sizes: Optional[list[int]] = None
+):
     """Offsets + total for packing ``sizes`` byte payloads back-to-back at
     ARENA_ALIGN boundaries. THE arena layout function: the SHM transport,
     the bulk packed frame, and the provisioning manifest all call this, so
-    a prewarmed pool segment is exactly the size the first put asks for."""
+    a prewarmed pool segment is exactly the size the first put asks for.
+
+    ``scale_sizes`` (quantized wire tier) fuses a per-member SCALE SLOT
+    into the SAME layout: member ``i``'s slot holds its payload at
+    ``offsets[i]`` and its f32 scale table at ``scale_offsets[i]``
+    (4-byte-aligned immediately after the payload) — one segment, one
+    handshake, and the scales can never ride a separate RPC from the
+    bytes they decode. Returns ``(offsets, scale_offsets, total)`` in
+    that mode, ``(offsets, total)`` classically."""
     offsets: list[int] = []
+    scale_offsets: list[int] = []
     off = 0
-    for nbytes in sizes:
+    for i, nbytes in enumerate(sizes):
         offsets.append(off)
-        off = align_up(off + int(nbytes))
-    return offsets, max(off, 1)
+        end = off + int(nbytes)
+        if scale_sizes is not None:
+            s_off = align_up(end, SCALE_ALIGN)
+            scale_offsets.append(s_off)
+            end = s_off + int(scale_sizes[i])
+        off = align_up(end)
+    total = max(off, 1)
+    if scale_sizes is not None:
+        return offsets, scale_offsets, total
+    return offsets, total
+
+
+# ---------------------------------------------------------------------------
+# fused quant-blob layout (blockwise int8/int4 wire tier)
+# ---------------------------------------------------------------------------
+#
+# A blockwise-quantized tensor crosses the wire as ONE self-describing
+# uint8 blob: [header+shape | changed-block bitmap | packed codes | f32
+# scale table]. The scale slot rides compute_arena_layout's scale_sizes
+# mode, so payload and scales share a segment by construction — the
+# transport, the bulk packed frame, and the provisioning manifest all see
+# a single ordinary byte payload. Layout math lives HERE (the arena
+# layout module); encode/decode live in state_dict_utils (the only other
+# module allowed to touch scale tables, per the tslint quant-discipline
+# rule).
+
+QUANT_HEADER_BYTES = 64
+
+
+def quant_payload_nbytes(fmt: str, block: int, changed: int) -> int:
+    """Packed-code bytes for ``changed`` blocks of ``block`` elements:
+    int8_block stores one byte per element; int4_block packs two 4-bit
+    codes per byte (blocks are whole slots — the tail block zero-pads)."""
+    if fmt == "int4_block":
+        return changed * ((block + 1) // 2)
+    return changed * block
+
+
+def quant_blob_layout(
+    rank: int, nblocks: int, changed: int, fmt: str, block: int
+) -> dict:
+    """Section offsets + total size of one fused quant blob. The payload/
+    scale pair goes through compute_arena_layout's scale-slot mode, so the
+    scale table provably occupies the same segment as the codes it
+    decodes."""
+    head = QUANT_HEADER_BYTES + 8 * rank
+    bitmap = (nblocks + 7) // 8
+    offsets, scale_offsets, total = compute_arena_layout(
+        [head, bitmap, quant_payload_nbytes(fmt, block, changed)],
+        scale_sizes=[0, 0, 4 * changed],
+    )
+    return {
+        "header": offsets[0],
+        "bitmap": offsets[1],
+        "payload": offsets[2],
+        "scales": scale_offsets[2],
+        "total": total,
+    }
+
+
+def quant_wire_nbytes(fmt: str, block: int, nelems: int, rank: int) -> int:
+    """Full-keyframe wire size of an ``nelems``-element tensor under
+    blockwise quantization — what the provisioning manifest sizes pools
+    with, so a prewarmed pool holds the scale-bearing arena segment the
+    first quantized publish asks for."""
+    nblocks = max(1, -(-int(nelems) // max(1, block)))
+    return quant_blob_layout(rank, nblocks, nblocks, fmt, block)["total"]

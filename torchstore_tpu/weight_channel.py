@@ -89,6 +89,9 @@ class WeightPublisher:
         store_name: str = "default",
         keep: int = 2,
         client: Any = None,
+        transfer_quant: Optional[str] = None,
+        delta: bool = False,
+        keyframe_every: Optional[int] = None,
     ) -> None:
         if keep < 1:
             raise ValueError("keep must be >= 1 (the latest version must live)")
@@ -97,6 +100,16 @@ class WeightPublisher:
         self._store_name = store_name
         self._client = client
         self._next_version: Optional[int] = None
+        # Wire-tier defaults for this publisher: ``transfer_quant`` (None =
+        # the TORCHSTORE_TPU_TRANSFER_QUANT default) and ``delta=True`` for
+        # delta encoding between consecutive versions (requires a blockwise
+        # mode; the publisher keeps the last-shipped baseline per key and
+        # ships sparse residuals, re-keyframing every ``keyframe_every``
+        # versions — default TORCHSTORE_TPU_DELTA_KEYFRAME).
+        self._transfer_quant = transfer_quant
+        self._delta = delta
+        self._keyframe_every = keyframe_every
+        self._codec = None
         # Channel epoch: minted when this publisher CREATES the channel,
         # inherited when it resumes one. Lets subscribers distinguish a
         # deleted-then-recreated channel (fresh epoch, numbering restarts)
@@ -126,8 +139,23 @@ class WeightPublisher:
 
         try:
             client = self._resolve_client()
+            from torchstore_tpu.config import default_config
+
+            cfg = getattr(client, "_config", None) or default_config()
+            # Quantized channels prewarm pools sized for the fused blobs
+            # (scale-bearing arena segments), not full-precision tensors.
+            # The "none" sentinel (explicitly disabled) must not reach the
+            # manifest, which treats any non-None value as a quant format.
+            quant = None
+            if transfer_dtype is None:
+                quant = self._resolve_quant(client, None)
+                if quant == "none":
+                    quant = None
             manifest = provision.as_manifest(
-                state_dict, transfer_dtype=transfer_dtype
+                state_dict,
+                transfer_dtype=transfer_dtype,
+                transfer_quant=quant,
+                quant_block=cfg.quant_block,
             )
         except Exception as exc:  # noqa: BLE001 - advisory: the first
             # publish surfaces real problems loudly; register never does.
@@ -258,15 +286,85 @@ class WeightPublisher:
                 )
         return survivors
 
-    def stream(self, transfer_dtype=None) -> "ChannelStream":
+    def _resolve_quant(self, client, override: Optional[str]) -> Optional[str]:
+        from torchstore_tpu import state_dict_utils as sdu
+
+        explicit = override if override is not None else self._transfer_quant
+        mode = sdu.resolve_transfer_quant(
+            explicit, None, getattr(client, "_config", None)
+        )
+        if mode is None and explicit is not None:
+            # Explicitly disabled ("none") at the publisher/call level:
+            # keep the sentinel so put_state_dict does not re-apply the
+            # TORCHSTORE_TPU_TRANSFER_QUANT default.
+            return "none"
+        return mode
+
+    def _ensure_codec(self, client, mode: str):
+        """The publisher's DeltaEncoder (lazy; one per publisher lifetime —
+        a restarted publisher has no baselines and re-keyframes naturally).
+        Enforces keep >= keyframe cadence: a fresh reader chain-walks back
+        to the newest keyframe, which must still be retained."""
+        from torchstore_tpu import state_dict_utils as sdu
+        from torchstore_tpu.config import default_config
+
+        if self._codec is None:
+            cfg = getattr(client, "_config", None) or default_config()
+            kf = int(self._keyframe_every or cfg.delta_keyframe)
+            if kf > self.keep:
+                raise ValueError(
+                    f"delta publishing on channel {self.name!r} needs "
+                    f"keep >= keyframe cadence ({kf}): readers chain-walk "
+                    "deltas back to the newest keyframe, which must still "
+                    "be retained — raise keep or lower keyframe_every / "
+                    "TORCHSTORE_TPU_DELTA_KEYFRAME"
+                )
+            self._codec = sdu.DeltaEncoder(
+                mode, cfg.quant_block, kf, cfg.delta_skip_eps
+            )
+        return self._codec
+
+    def _delta_ctx_for(
+        self, client, version: int, transfer_quant: Optional[str],
+        delta: Optional[bool],
+    ) -> tuple[Optional[str], Optional[dict]]:
+        """(effective quant mode, delta_ctx) for one publish."""
+        mode = self._resolve_quant(client, transfer_quant)
+        use_delta = self._delta if delta is None else delta
+        if not use_delta:
+            return mode, None
+        if mode not in ("int8_block", "int4_block"):
+            raise ValueError(
+                "delta publishing requires a blockwise transfer_quant "
+                f"(int8_block/int4_block), got {mode!r}"
+            )
+        return mode, {
+            "codec": self._ensure_codec(client, mode),
+            "version": int(version),
+            "channel": self.name,
+        }
+
+    def stream(
+        self,
+        transfer_dtype=None,
+        transfer_quant: Optional[str] = None,
+        delta: Optional[bool] = None,
+    ) -> "ChannelStream":
         """Open a LAYER-STREAMED publish of the next version: push
         fragments with ``await cs.put(...)`` as the trainer produces them,
         then ``await cs.seal()`` to advance LATEST/GC exactly like
         ``publish``. Streaming subscribers (``acquire_streamed``) wake on
         the in-flight announce and start pulling layers before the seal;
         barrier subscribers (``acquire``) still wake only on the sealed
-        pointer. See torchstore_tpu/stream_sync.py."""
-        return ChannelStream(self, transfer_dtype=transfer_dtype)
+        pointer. ``transfer_quant``/``delta`` override the publisher's
+        wire-tier defaults for this version. See
+        torchstore_tpu/stream_sync.py."""
+        return ChannelStream(
+            self,
+            transfer_dtype=transfer_dtype,
+            transfer_quant=transfer_quant,
+            delta=delta,
+        )
 
     async def publish(
         self,
@@ -274,6 +372,7 @@ class WeightPublisher:
         transfer_dtype=None,
         transfer_quant: Optional[str] = None,
         direct: bool = False,
+        delta: Optional[bool] = None,
     ) -> int:
         """Write the next version, advance LATEST, GC old versions. Returns
         the published version number. A restarted publisher resumes after
@@ -293,6 +392,12 @@ class WeightPublisher:
         data_key = (
             f"{self.name}/direct" if direct else _version_key(self.name, version)
         )
+        if direct:
+            quant_mode, delta_ctx = None, None
+        else:
+            quant_mode, delta_ctx = self._delta_ctx_for(
+                client, version, transfer_quant, delta
+            )
         with span(
             "weight_channel.publish",
             channel=self.name,
@@ -304,8 +409,9 @@ class WeightPublisher:
                 data_key,
                 state_dict,
                 transfer_dtype=transfer_dtype,
-                transfer_quant=transfer_quant,
+                transfer_quant=quant_mode if not direct else transfer_quant,
                 direct=direct,
+                delta_ctx=delta_ctx,
             )
             # Pointer write LAST: subscribers woken by it see a committed dict.
             await self._commit(client, version)
@@ -396,9 +502,17 @@ class ChannelStream:
     version stays fully acquirable, and the next publisher's resume
     reclaims the partial keys."""
 
-    def __init__(self, publisher: WeightPublisher, transfer_dtype=None) -> None:
+    def __init__(
+        self,
+        publisher: WeightPublisher,
+        transfer_dtype=None,
+        transfer_quant: Optional[str] = None,
+        delta: Optional[bool] = None,
+    ) -> None:
         self._pub = publisher
         self._transfer_dtype = transfer_dtype
+        self._transfer_quant = transfer_quant
+        self._delta = delta
         self._stream = None
         self.version: Optional[int] = None
 
@@ -409,10 +523,15 @@ class ChannelStream:
             pub = self._pub
             client = pub._resolve_client()
             self.version = await pub._resolve_next_version(client)
+            quant_mode, delta_ctx = pub._delta_ctx_for(
+                client, self.version, self._transfer_quant, self._delta
+            )
             self._stream = stream_sync.stream_state_dict(
                 client,
                 _version_key(pub.name, self.version),
                 transfer_dtype=self._transfer_dtype,
+                transfer_quant=quant_mode,
+                delta_ctx=delta_ctx,
             )
             await self._stream.begin()
             # Announce the IN-FLIGHT version before any layer lands:
@@ -494,6 +613,29 @@ class WeightSubscriber:
         self._relay = relay or relay_volume is not None
         self._relay_volume = relay_volume
         self._relay_home: Optional[str] = None
+        # Delta wire tier: this subscriber's accumulated per-key state.
+        # Lazily built, shared across acquires so consecutive versions
+        # accumulate (and unchanged-key layers serve with zero
+        # re-transfer); empty-cost for unquantized channels.
+        self._decoder = None
+        self._decoder_epoch: Optional[int] = None
+
+    def _delta_decoder(self, epoch: Optional[int] = None):
+        from torchstore_tpu import state_dict_utils as sdu
+
+        if self._decoder is None:
+            self._decoder = sdu.DeltaDecoder()
+            self._decoder_epoch = epoch
+        elif epoch is not None and epoch != self._decoder_epoch:
+            # A deleted-then-recreated channel restarts version numbering
+            # under a fresh epoch: accumulated state from the OLD epoch
+            # could collide with the new numbering (same version ints,
+            # different weights) and silently serve stale accumulations —
+            # drop it so the new epoch's first acquire re-keyframes/
+            # chain-walks from real bytes.
+            self._decoder.drop()
+            self._decoder_epoch = epoch
+        return self._decoder
 
     def _resolve_client(self):
         if self._client is None:
@@ -700,6 +842,7 @@ class WeightSubscriber:
                         _version_key(self.name, version),
                         user_state_dict=user_state_dict,
                         strict=strict,
+                        delta_state=self._delta_decoder(),
                     )
                     if timeout is None:
                         sd = await pull
@@ -782,6 +925,9 @@ class WeightSubscriber:
                         user_state_dict=user_state_dict,
                         direct=direct,
                         strict=strict,
+                        delta_state=(
+                            None if direct else self._delta_decoder(epoch)
+                        ),
                     )
             except (NoMatchingPush, KeyError):
                 # The pointer or version vanished between wakeup and pull
@@ -847,6 +993,7 @@ class WeightSubscriber:
                         on_layer=on_layer,
                         strict=strict,
                         timeout=timeout,
+                        delta_state=self._delta_decoder(),
                     )
             _PINNED_ACQUIRES.inc(channel=self.name)
             obs_recorder.record(
@@ -907,6 +1054,7 @@ class WeightSubscriber:
                             else max(0.0, deadline - time.monotonic())
                         ),
                         relay_volume=relay_home,
+                        delta_state=self._delta_decoder(epoch),
                     )
                 except (NoMatchingPush, KeyError):
                     # The announced version vanished before the pull (GC'd
