@@ -92,6 +92,16 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "delta_speedup_delta": ("higher", 0.25, "rel"),
     "delta_wire_compression_delta": ("higher", 0.25, "rel"),
     "delta_max_abs_err": ("lower", 0.10, "abs"),
+    # Scale-out metadata plane (ISSUE 14). The 1 -> 4 shard throughput
+    # factor is near-structural at fixed driver load (acceptance >= 2.5x;
+    # measured 2.6-3.0x on this 24-core host, where the sharded leg is
+    # client-CPU-bound — the shards themselves have headroom) — a drop
+    # means shard routing started
+    # serializing somewhere (a new coordinator hop on the warm path, a
+    # fan-out regression); the sharded leg's absolute rate is host-
+    # weather-budgeted like the other throughput legs.
+    "metadata_scale_x": ("higher", 0.30, "rel"),
+    "metadata_ops_per_s_sharded": ("higher", 0.40, "rel"),
 }
 
 

@@ -20,7 +20,9 @@
 #                               bound, the tiered-capacity section's
 #                               spill/fault-in/warm-leased-get gates, and
 #                               the delta_sync quant/delta wire-tier
-#                               section's compression + error bounds) and
+#                               section's compression + error bounds, and
+#                               the metadata_scale section's 1-vs-N-shard
+#                               controller throughput scaling) and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

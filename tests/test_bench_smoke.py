@@ -45,6 +45,10 @@ async def test_bench_run_tiny(capsys):
         delta_tensors=4,
         delta_tensor_kb=16,
         delta_versions=3,
+        meta_shard_counts=(1, 2),
+        meta_drivers=2,
+        meta_logical=2,
+        meta_duration_s=0.5,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -371,4 +375,32 @@ async def test_bench_fanout_section_tiny():
     # overlaps the publish window (layers flow per hop, not per version).
     assert out["relay_hops"] >= 2, out
     assert out["fanout_overlap_ratio"] > 0, out
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_metadata_scale_section_tiny():
+    """The metadata_scale section standalone (``bench.py
+    --metadata-scale``) at tiny load: real multi-process drivers against a
+    real 1-shard and 2-shard fleet — the fan-out spawn/drive/merge
+    machinery behind the ISSUE-14 acceptance (>= 2.5x locate/notify
+    throughput at 4 shards, measured at full scale) can never ship
+    broken. At smoke scale the load is driver-bound, so only positivity
+    and shape are asserted, never the scaling factor itself."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.metadata_scale_section(
+        shard_counts=(1, 2), n_drivers=2, n_logical=2, duration_s=0.5
+    )
+    assert out["metadata_ops_per_s_1shard"] > 0, out
+    assert out["metadata_ops_per_s_sharded"] > 0, out
+    assert out["metadata_scale_x"] > 0, out
+    for leg in out["legs"].values():
+        assert leg["failed_drivers"] == 0, leg
+        assert leg["mix"]["locate"] > 0 and leg["mix"]["notify"] > 0, leg
+        assert leg["mix"]["poll"] > 0, leg
     json.dumps(out)

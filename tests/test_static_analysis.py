@@ -955,3 +955,78 @@ def test_one_sided_discipline_live_tree_clean():
     root = str(pathlib.Path(__file__).resolve().parents[1])
     result = run_checks(root, rules=["one-sided-discipline"])
     assert _msgs(result.findings, "one-sided-discipline") == []
+
+
+# --------------------------------------------------------------------------
+# shard-discipline (ISSUE 14)
+# --------------------------------------------------------------------------
+
+
+def test_shard_discipline_flags_raw_index_access(tmp_path):
+    """shard-discipline: raw ``.index`` / ``._key_gens`` touches in the
+    scoped modules (controller.py, client.py) are flagged; the metadata
+    package (the state's home) and str/list ``.index(...)`` method calls
+    pass."""
+    from torchstore_tpu.analysis.checkers import shard_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def peek(self, key):
+                        infos = self.index.get(key)  # seeded defect
+                        gen = self._key_gens.get(key, 0)  # seeded defect
+                        return infos, gen
+
+                    def fine(self, keys):
+                        return keys.index("a")  # list.index: a CALL, exempt
+            """,
+            "torchstore_tpu/client.py": """
+                def bad(core):
+                    return core.index["k"]  # seeded defect
+            """,
+            "torchstore_tpu/metadata/index_core.py": """
+                class IndexCore:
+                    def get(self, key):
+                        return self.index.get(key)  # the state's home
+            """,
+            "torchstore_tpu/storage_volume.py": """
+                def unscoped(store):
+                    return store.index  # outside the metadata plane
+            """,
+        },
+    )
+    findings = shard_discipline.check(project)
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, 0)
+        by_path[f.path] += 1
+    assert by_path == {
+        "torchstore_tpu/controller.py": 2,
+        "torchstore_tpu/client.py": 1,
+    }, by_path
+
+
+def test_shard_discipline_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/controller.py": """
+                def debug_dump(core):
+                    return dict(core.index)  # tslint: disable=shard-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["shard-discipline"])
+    assert result.new == []
+
+
+def test_shard_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays
+    empty): after the metadata-plane refactor, controller.py reaches the
+    index only through ``self.idx`` (IndexCore locally, the RemoteIndex
+    fan-out when sharded) — the property that makes shards=N safe."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["shard-discipline"])
+    assert result.new == [], [str(f) for f in result.new]

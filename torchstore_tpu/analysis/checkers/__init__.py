@@ -20,6 +20,7 @@ from torchstore_tpu.analysis.checkers import (
     orphan_task,
     quant_discipline,
     retry_discipline,
+    shard_discipline,
     stream_discipline,
 )
 
@@ -36,4 +37,5 @@ CHECKERS = {
     one_sided.RULE: one_sided.check,
     stream_discipline.RULE: stream_discipline.check,
     quant_discipline.RULE: quant_discipline.check,
+    shard_discipline.RULE: shard_discipline.check,
 }

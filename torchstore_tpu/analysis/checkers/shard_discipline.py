@@ -1,0 +1,73 @@
+"""shard-discipline: index-owning state is touched only inside metadata/.
+
+The scale-out metadata plane (torchstore_tpu/metadata/) partitions the
+key -> {volume_id: StorageInfo} index across controller shards; exactly
+ONE process owns any key's entry, and every engine — relay forwarding,
+auto-repair, tier sweeps, catalogs, rebuild — reaches the index through
+the shard-routed authority surface (``IndexCore`` methods locally, their
+``RemoteIndex`` fan-out twins when sharded). A direct ``.index`` /
+``._key_gens`` touch in controller.py (or the client) re-creates the
+single-writer assumption the sharding removed: code that "just reads the
+dict" works at shards=1 and silently sees an EMPTY index — or worse,
+writes one the fleet never reads — the moment the plane is sharded.
+
+Rule: in the scoped modules (controller.py, client.py), any attribute
+access or subscript whose attribute name is ``index`` or ``_key_gens``
+is forbidden — route it through ``self.idx`` / the core's methods. The
+metadata package itself (the state's home) is out of scope, as is any
+module outside the metadata plane (``.index(...)`` the str/list method
+is exempted by call-shape: the rule skips attribute CALLS whose name is
+``index``, which the forbidden state never is).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project
+
+RULE = "shard-discipline"
+
+_SCOPED_FILES = (
+    "torchstore_tpu/controller.py",
+    "torchstore_tpu/client.py",
+)
+
+_FORBIDDEN_ATTRS = {"index", "_key_gens"}
+
+_MESSAGE = (
+    "direct index-owning state access outside torchstore_tpu/metadata/: "
+    "route through the shard-routed authority (self.idx / IndexCore "
+    "methods) — a raw .index/._key_gens touch reads an empty dict (or "
+    "writes an unread one) the moment the metadata plane is sharded"
+)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path not in _SCOPED_FILES:
+            continue
+        # Attribute nodes that are the FUNCTION of a call are method
+        # lookups (str.index/list.index), never the state this rule
+        # guards — collect them first so the walk can skip them.
+        call_funcs = {
+            id(node.func)
+            for node in ast.walk(sf.tree)
+            if isinstance(node, ast.Call)
+        }
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _FORBIDDEN_ATTRS
+                and id(node) not in call_funcs
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+    return findings

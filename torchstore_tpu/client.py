@@ -203,7 +203,18 @@ class LocalClient:
         controller: ActorRef,
         config: Optional[StoreConfig] = None,
     ) -> None:
-        self._controller = controller
+        from torchstore_tpu.metadata.router import MetadataRouter
+
+        # Every controller RPC routes through the metadata router: it fans
+        # index ops out per controller shard (when the store is sharded),
+        # counts every metadata RPC into the traffic ledger, and serves
+        # the warm-path reads (locate / plan validation / stream polling)
+        # from same-host stamped segments with zero RPCs. Coordinator-
+        # scoped ops — including the health diagnosis fan-out — pass
+        # through to the one coordinator actor unchanged.
+        if isinstance(controller, MetadataRouter):
+            controller = controller.coordinator
+        self._controller = MetadataRouter(controller)
         self._config = config or default_config()
         self._strategy = None
         self._volume_refs: Optional[dict[str, StorageVolumeRef]] = None
@@ -266,6 +277,12 @@ class LocalClient:
         (possibly stale but structurally valid) map mid-await — they fail
         and retry rather than crash on a half-built state."""
         self._controller.rpc_timeout = self._config.rpc_timeout
+        # Metadata-plane topology first: shard refs make every index op
+        # below routable, and same-host stamped segments arm the zero-RPC
+        # warm paths (advisory — a topology-less controller still serves).
+        await self._controller.load_topology(
+            meta_stamped=self._config.meta_stamped
+        )
         strategy = await self._controller.get_strategy.call_one()
         vmap = await self._controller.get_volume_map.call_one()
         forced = strategy.default_transport_type if strategy else None
@@ -352,9 +369,27 @@ class LocalClient:
         }
 
     async def placement_epoch(self) -> int:
-        """Fetch + adopt the controller's current placement epoch (one
-        cheap RPC — what a cached-plan get pays instead of a commit-marker
-        fetch plus per-key locates)."""
+        """Fetch + adopt the controller's current placement epoch — the
+        warm plan-validation read. Served from the coordinator's stamped
+        header with ZERO RPCs whenever it CONFIRMS the epoch this client
+        already holds (the steady-state case: nothing changed, plans stay
+        valid). Any other stamped value — older (publish lag) or newer —
+        falls back to the RPC for the authoritative answer: adopting a
+        lagging epoch would spuriously invalidate every cached plan
+        (observe_epoch keys on inequality), costing a rebuild storm for
+        nothing."""
+        from torchstore_tpu.metadata import router as meta_router
+
+        known = (
+            self.plan_cache.epoch
+            if self.plan_cache is not None
+            else self._seen_epoch
+        )
+        if known is not None:
+            stamped = self._controller.stamped_epoch()
+            if stamped is not None and stamped == known:
+                meta_router.count_stamped("placement_epoch")
+                return stamped
         epoch = await self._controller.placement_epoch.call_one()
         self._observe_epoch(epoch)
         return epoch
@@ -1126,6 +1161,20 @@ class LocalClient:
                 located[key] = cached
             else:
                 missing.append(key)
+        if missing and use_cache and prefer_volume is None:
+            # One-sided warm locate: committed locations from the stamped
+            # metadata segments (zero RPCs), filling the location cache so
+            # the staleness ladder below them is EXACTLY the warm-cache
+            # one — a lingering deleted key fails at the volume and the
+            # fetch retries with use_cache=False, which skips this path
+            # and pays the authoritative RPC locate.
+            hits = self._controller.stamped_locate(missing)
+            if hits:
+                if len(self._loc_cache) + len(hits) > self.LOC_CACHE_MAX:
+                    self._loc_cache.clear()
+                self._loc_cache.update(hits)
+                located.update(hits)
+                missing = [k for k in missing if k not in hits]
         if missing:
             fresh = await self._controller.locate_volumes.call_one(missing)
             if len(self._loc_cache) + len(fresh) > self.LOC_CACHE_MAX:
@@ -1777,8 +1826,20 @@ class LocalClient:
         acquires — woken by the notify that commits each layer, no spin.
         ``volume_id`` gates readiness on this subscriber's RELAY copy: keys
         report ready only once the broadcast tree landed them on that
-        volume (ignored when the volume is not a live relay member)."""
+        volume (ignored when the volume is not a live relay member).
+
+        Gate-less polls (``volume_id=None`` — the common streamed-acquire
+        shape) serve from the coordinator's stamped stream snapshot with
+        ZERO controller RPCs when it is attached same-host; relay-gated
+        polls need the coordinator's live run state and stay on the RPC
+        long-poll."""
         await self._ensure_setup()
+        if volume_id is None:
+            served = await self._controller.stamped_wait_stream(
+                key, version, known, timeout
+            )
+            if served is not None:
+                return served
         return await self._controller.wait_for_stream.with_timeout(
             self._wait_rpc_timeout(timeout)
         ).call_one(key, version, known, timeout, volume_id)

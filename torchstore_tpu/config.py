@@ -141,6 +141,30 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "readers ring a bulk doorbell frame against a volume-cached "
            "get plan instead of issuing the get RPC. Torn/stale reads "
            "fall back loudly to the RPC path."),
+    # --- scale-out metadata plane (torchstore_tpu/metadata/) ----------------
+    EnvVar("TORCHSTORE_TPU_CONTROLLER_SHARDS", "int", 1,
+           "Partition the controller's key->volume index across this many "
+           "ControllerShard actors by stable key hash (1 = the classic "
+           "single controller). Fleet-scoped state (placement epoch, "
+           "health, streams, relay, leases) stays on the coordinator; "
+           "clients fan batched metadata ops out per shard. An explicit "
+           "ts.initialize(controller_shards=) overrides this default."),
+    EnvVar("TORCHSTORE_TPU_META_STAMPED", "bool", True,
+           "One-sided metadata reads: every index host publishes its "
+           "committed index (and the coordinator its stream watermarks + "
+           "placement epoch) into seqlock-stamped shm segments, so "
+           "same-host clients resolve locations, validate cached plans, "
+           "and poll streamed publishes with ZERO controller RPCs. "
+           "Torn/stale reads fall back loudly to the RPC path."),
+    EnvVar("TORCHSTORE_TPU_META_PUBLISH_MS", "float", 10,
+           "Debounce interval for stamped metadata publishes, "
+           "milliseconds: index/stream changes coalesce to at most one "
+           "segment rewrite per interval (staleness is bounded by it; "
+           "readers under-see progress, never the reverse)."),
+    EnvVar("TORCHSTORE_TPU_META_SEGMENT_BYTES", "int", 8388608,
+           "Size of each stamped metadata segment. A pickled view that "
+           "outgrows it tombstones the segment (readers fall back to "
+           "RPCs, loudly) rather than growing under attached readers."),
     # --- tiered capacity & multi-version serving (torchstore_tpu/tiering) ---
     EnvVar("TORCHSTORE_TPU_TIER_ENABLED", "bool", False,
            "Enable the disk spill tier: per-volume spill writers demote "
@@ -558,6 +582,18 @@ class StoreConfig:
     # env in the controller process; they live in the registry above.
     relay_enabled: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_RELAY_ENABLED", True)
+    )
+    # Scale-out metadata plane: controller shard count (1 = classic single
+    # controller; initialize(controller_shards=) overrides) and whether
+    # this client attaches same-host stamped metadata segments for
+    # zero-RPC warm locates / plan validation / stream polling.
+    controller_shards: int = field(
+        default_factory=lambda: max(
+            1, _env_int("TORCHSTORE_TPU_CONTROLLER_SHARDS", 1)
+        )
+    )
+    meta_stamped: bool = field(
+        default_factory=lambda: _env_bool("TORCHSTORE_TPU_META_STAMPED", True)
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

@@ -1,51 +1,45 @@
-"""Metadata plane: the ``Controller`` actor.
+"""Metadata plane: the ``Controller`` actor — now the COORDINATOR.
 
 TPU-native equivalent of /root/reference/torchstore/controller.py:22-293.
-Holds the key -> {volume_id -> StorageInfo} index in a prefix trie, tracks
-sharded-commit progress (a sharded key is readable only once every mesh
-coordinate has landed), and answers locate/notify/delete/keys. The controller
+The key -> {volume_id -> StorageInfo} index itself lives in
+:mod:`torchstore_tpu.metadata.index_core` (tslint ``shard-discipline``
+enforces that boundary): an unsharded store hosts one ``IndexCore`` right
+here, while ``ts.initialize(controller_shards=N)`` partitions it across N
+``ControllerShard`` actors by stable key hash and this actor keeps only
+fleet-scoped state — placement epoch, health supervisor, streams, relay
+trees, leases, strategy — reached through ``self.idx`` (a local core or
+the RemoteIndex fan-out; one engine code path either way). The controller
 never carries tensor bytes — clients notify it with ``meta_only`` requests
 after the data plane transfer completes (two-plane invariant, SURVEY §2.2.1).
 """
 
 from __future__ import annotations
 
-import math
 import time
-from dataclasses import dataclass, field
-from enum import Enum
 from typing import Any, Optional
 
 from torchstore_tpu import faults
 from torchstore_tpu import relay as relay_mod
 from torchstore_tpu import tiering
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.metadata.index_core import (  # noqa: F401 - re-exported
+    IndexCore,
+    ObjectType,
+    PartiallyCommittedError,
+    StorageInfo,
+    StoreKeyError,
+    resolve_manifests,
+)
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import recorder as obs_recorder
 from torchstore_tpu.runtime import Actor, ActorRef, endpoint
-from torchstore_tpu.storage_utils.trie import Trie
-from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+from torchstore_tpu.transport.types import Request
 from torchstore_tpu.utils import spawn_logged
 
 logger = get_logger("torchstore_tpu.controller")
 
-# Metadata-plane instruments (live in the controller's process; surfaced to
-# clients through the ``stats()`` endpoint).
-_PUTS = obs_metrics.counter("ts_controller_puts_total", "Logical puts indexed")
-_PUT_BYTES = obs_metrics.counter(
-    "ts_controller_put_bytes_total", "Logical bytes indexed by puts"
-)
-_LOCATES = obs_metrics.counter("ts_controller_locates_total", "Keys located")
-_DELETES = obs_metrics.counter("ts_controller_deletes_total", "Keys deleted")
-_KEYS = obs_metrics.gauge("ts_controller_keys", "Keys currently indexed")
-_PENDING_RECLAIMS = obs_metrics.gauge(
-    "ts_controller_pending_reclaims",
-    "Stale-replica reclaims not yet drained, per volume",
-)
-_RECLAIMED = obs_metrics.counter(
-    "ts_controller_reclaimed_keys_total",
-    "Stale copies deleted by the background reclaim",
-)
+# Coordinator-scoped instruments (index-op counters live with the index —
+# torchstore_tpu/metadata/index_core.py; surfaced through ``stats()``).
 _PREWARM_RESERVED = obs_metrics.gauge(
     "ts_prewarm_reserved_bytes",
     "tmpfs bytes held by live prewarm reservations, per volume",
@@ -57,10 +51,6 @@ _VOLUME_HEALTH = obs_metrics.gauge(
 _QUARANTINES = obs_metrics.counter(
     "ts_quarantines_total",
     "Volumes moved to quarantine by the health supervisor",
-)
-_AUTO_REPAIRS = obs_metrics.counter(
-    "ts_auto_repairs_total",
-    "Keys re-replicated automatically after a quarantine",
 )
 _RELAY_FORWARDED = obs_metrics.counter(
     "ts_relay_forwarded_keys_total",
@@ -76,131 +66,20 @@ _LEASE_BLOCKED_DELETES = obs_metrics.counter(
 )
 
 
-class ObjectType(Enum):
-    OBJECT = "object"
-    TENSOR = "tensor"
-    TENSOR_SLICE = "tensor_slice"
-
-
-def _object_type(meta: Request) -> ObjectType:
-    if meta.is_object:
-        return ObjectType.OBJECT
-    if meta.tensor_slice is not None:
-        return ObjectType.TENSOR_SLICE
-    return ObjectType.TENSOR
-
-
-class PartiallyCommittedError(KeyError):
-    pass
-
-
-class StoreKeyError(KeyError):
-    pass
-
-
-@dataclass
-class StorageInfo:
-    """What one volume holds for one key
-    (/root/reference/torchstore/controller.py:36-64)."""
-
-    object_type: ObjectType
-    tensor_meta: Optional[TensorMeta] = None
-    # coords -> TensorSlice, for TENSOR_SLICE keys.
-    tensor_slices: dict[tuple, TensorSlice] = field(default_factory=dict)
-    # The volume-assigned write generation of the newest put indexed here
-    # (volume-local timestamp; see StorageVolume._bump_write_gens). When
-    # this replica is later detached, the reclaim deletes its copy only if
-    # the volume's generation hasn't moved past this — an acknowledged put
-    # racing the reclaim can never lose its bytes (ADVICE r3).
-    write_gen: int = 0
-    # Capacity tier of this replica's bytes: ``tiering.RESIDENT`` (memory/
-    # tmpfs — the zero-copy warm path) or ``tiering.TIERED`` (demoted to
-    # the volume's disk spill tier; the next get faults it back in).
-    # Metadata only: placement and transports are tier-agnostic.
-    tier: str = tiering.RESIDENT
-
-    def merge(self, meta: Request) -> None:
-        incoming = _object_type(meta)
-        if incoming != self.object_type:
-            raise ValueError(
-                f"type confusion: stored {self.object_type} vs incoming {incoming}"
-            )
-        if meta.tensor_slice is not None:
-            self.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
-        if meta.tensor_meta is not None:
-            self.tensor_meta = meta.tensor_meta
-
-    @classmethod
-    def from_meta(cls, meta: Request) -> "StorageInfo":
-        info = cls(object_type=_object_type(meta), tensor_meta=meta.tensor_meta)
-        if meta.tensor_slice is not None:
-            info.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
-        return info
-
-
-def resolve_manifests(
-    per_volume: list[tuple[str, list]],
-) -> tuple[list[tuple[str, Request, int]], int]:
-    """Resolve volume manifests into (volume_id, meta, write_gen) entries to
-    index, keeping only the NEWEST shard layout (by file mtime) when a key
-    carries mixed mesh/global shapes — see ``Controller.rebuild_index``.
-    Returns (survivors, dropped_count). Accepts bare ``Request`` items from
-    backends without mtimes (treated as mtime 0, write_gen 0)."""
-    entries: list[tuple[str, Request, Optional[tuple], int]] = []
-    layouts: dict[str, dict[tuple, float]] = {}  # key -> sig -> max mtime
-    for vid, manifest in per_volume:
-        for item in manifest:
-            if isinstance(item, dict):
-                meta, mtime = item["meta"], item.get("mtime", 0.0)
-                gen = item.get("write_gen", 0)
-            else:
-                meta, mtime, gen = item, 0.0, 0
-            sig = None
-            if meta.tensor_slice is not None:
-                ts = meta.tensor_slice
-                sig = (
-                    ts.mesh_shape,
-                    ts.global_shape,
-                    meta.tensor_meta.dtype if meta.tensor_meta else None,
-                )
-                sigs = layouts.setdefault(meta.key, {})
-                sigs[sig] = max(sigs.get(sig, 0.0), mtime)
-            entries.append((vid, meta, sig, gen))
-    winners = {
-        key: max(sigs, key=sigs.get)
-        for key, sigs in layouts.items()
-        if len(sigs) > 1
-    }
-    survivors: list[tuple[str, Request, int]] = []
-    dropped = 0
-    for vid, meta, sig, gen in entries:
-        if sig is not None and meta.key in winners and sig != winners[meta.key]:
-            dropped += 1
-            continue
-        survivors.append((vid, meta, gen))
-    return survivors, dropped
-
-
 class Controller(Actor):
     def __init__(self) -> None:
-        self.index = Trie()  # key -> {volume_id: StorageInfo}
+        # The index-owning state machine (torchstore_tpu/metadata/): an
+        # unsharded store's whole index lives in this core; attach_shards
+        # swaps ``self.idx`` to the RemoteIndex fan-out and the core goes
+        # idle. Every engine below reaches the index ONLY through
+        # ``self.idx`` (tslint shard-discipline).
+        self.core = IndexCore(self)
+        self.idx = self.core
+        self._shard_refs: list[ActorRef] = []
+        self._shard_stamped: list = []
         self.strategy = None
         self.volume_refs: dict[str, ActorRef] = {}
         self.volume_hostnames: dict[str, str] = {}
-        # Observability counters (the reference has none — SURVEY §5 "no
-        # counters/prometheus"); cheap to keep, exposed via stats().
-        self.counters = {
-            "puts": 0,
-            "put_bytes": 0,
-            "locates": 0,
-            "deletes": 0,
-        }
-        # Per-key update generation + a condition notified on every index
-        # change: the substrate for wait_for_committed / wait_for_change
-        # (blocking weight-sync subscriptions — the reference leaves
-        # consumers to poll get_state_dict in a try/except loop).
-        self._key_gens: dict[str, int] = {}
-        self._update_cond: Optional[Any] = None  # lazily created on its loop
         # Placement epoch: bumped ONLY on structural metadata changes (a
         # key appearing/disappearing, a shape/dtype/layout change, a
         # replica detach, volume replacement, index rebuild) — NOT on
@@ -208,15 +87,13 @@ class Controller(Actor):
         # (client.SyncPlanCache) validates against it: an RL loop's steady
         # re-publish keeps the epoch still, so iteration N+1's plans stay
         # hot, while any change that could re-route or re-shape a fetch
-        # invalidates every cached plan fleet-wide.
+        # invalidates every cached plan fleet-wide. Shards report their
+        # structural changes through ONE bump_placement_epoch RPC before
+        # acking — the epoch stays the fleet's single clock.
         self._placement_epoch = 1
-        # Best-effort reclaims of stale copies on detached replicas:
-        # {key: stale write gen} pending per volume, ONE drainer task per
-        # volume (a publisher hammering a wedged replica must not spawn a
-        # task per put), all cancelled at teardown.
-        self._pending_reclaims: dict[str, dict[str, int]] = {}
-        self._reclaim_running: set = set()
-        self._reclaim_tasks: set = set()
+        # Stamped stream/epoch segment (metadata/stamped.py): same-host
+        # clients validate plans and poll streamed publishes one-sided.
+        self._meta_writer = None
         # Health supervisor state: per-volume heartbeat bookkeeping. A
         # volume is 'ok' | 'probation' (answered pings again after a
         # quarantine; not yet trusted) | 'quarantined' (missed
@@ -300,19 +177,71 @@ class Controller(Actor):
     MAX_STREAMS = 256
 
     def _cond(self):
-        import asyncio
+        # ONE condition serves the whole process: the core notifies it on
+        # every index change (wait_for_committed/wait_for_change) and the
+        # stream machinery on every watermark/seal — unsharded, they are
+        # the same wakeup, exactly as before the split.
+        return self.core.cond()
 
-        if self._update_cond is None:
-            self._update_cond = asyncio.Condition()
-        return self._update_cond
+    # ---- IndexCore host surface + test-visible reclaim state -------------
 
-    async def _bump(self, keys) -> None:
-        cond = self._cond()
-        async with cond:
-            for key in keys:
-                self._key_gens[key] = self._key_gens.get(key, 0) + 1
-            cond.notify_all()
-        _KEYS.set(len(self.index))
+    def quarantined_ids(self) -> set:
+        return self._quarantined_ids()
+
+    async def on_structural(self) -> int:
+        return self._bump_epoch()
+
+    def _bump_epoch(self) -> int:
+        """The ONE way the placement epoch moves: every structural change
+        site routes here. The stamped header is republished IMMEDIATELY —
+        not debounced — because the client's zero-RPC plan validation
+        treats "stamped epoch == epoch I hold" as a CONFIRMATION: a
+        debounce here would let a reader confirm stale plans (and read a
+        supersede-detached replica's old bytes) for the whole publish
+        window. Bumps are structural-only (rare in steady state), so the
+        synchronous publish costs one small stream-snapshot pickle."""
+        self._placement_epoch += 1
+        if self._meta_writer is not None:
+            self._meta_writer.publish_now()
+        return self._placement_epoch
+
+    def _touch_streams(self) -> None:
+        """A stream record changed: republish the stamped stream snapshot
+        (debounced) so one-sided pollers see it."""
+        if self._meta_writer is not None:
+            self._meta_writer.mark_dirty()
+
+    def _streams_payload(self) -> dict:
+        """The one-sided stream view: per record, exactly what a gate-less
+        ``wait_for_stream`` needs (version/sealed/watermarks/aliases/
+        quant). Published AFTER the watermark step commits, so a reader
+        can only under-see progress — never a watermark before its bytes."""
+        return {
+            "streams": {
+                key: {
+                    "version": rec["version"],
+                    "sealed": rec["sealed"],
+                    "watermarks": dict(rec["watermarks"]),
+                    "aliases": dict(rec.get("aliases") or {}),
+                    "quant": rec.get("quant"),
+                }
+                for key, rec in self._streams.items()
+            }
+        }
+
+    # Direct-instantiation test compatibility: the reclaim machinery moved
+    # into the core; these views keep white-box assertions working.
+    @property
+    def _pending_reclaims(self):
+        return self.core._pending_reclaims
+
+    @property
+    def _reclaim_tasks(self):
+        return self.core._reclaim_tasks
+
+    @property
+    def _reclaim_running(self):
+        return self.core._reclaim_running
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -342,12 +271,84 @@ class Controller(Actor):
             _VOLUME_HEALTH.set(1, volume=vid)
         self._start_supervisor()
         self._start_tier_sweeper()
+        from torchstore_tpu.metadata import stamped as stamped_mod
+
+        if stamped_mod.enabled():
+            # Coordinator segment: stream snapshot + placement epoch. The
+            # unsharded core publishes its own index segment alongside;
+            # attach_shards leaves index publication to the shards.
+            if self._meta_writer is None:
+                self._meta_writer = stamped_mod.MetaStampWriter(
+                    self._streams_payload,
+                    epoch_fn=lambda: self._placement_epoch,
+                )
+                self._meta_writer.mark_dirty()
+            if self.core.meta_writer is None and not self._shard_refs:
+                self.core.meta_writer = stamped_mod.MetaStampWriter(
+                    self.core.meta_payload
+                )
         # Unclean-exit post-mortem: a controller dying with faults/errors
         # in its flight ring leaves the last seconds on disk.
         obs_recorder.recorder().arm_exit_dump()
         return {
             "volume_ids": sorted(self.volume_refs),
             "hostnames": self.volume_hostnames,
+        }
+
+    @endpoint
+    async def attach_shards(
+        self, coordinator: ActorRef, shard_refs: list[ActorRef]
+    ) -> dict[str, Any]:
+        """Partition the metadata plane: hand each ControllerShard its
+        slot (id, fleet refs, current quarantine picture) and swap this
+        actor's index authority to the RemoteIndex fan-out. Runs at
+        bootstrap, before any key is indexed — the coordinator's own core
+        goes idle (its stamped index segment is never created sharded)."""
+        from torchstore_tpu.metadata.shards import RemoteIndex
+
+        self._shard_refs = list(shard_refs)
+        self._shard_stamped = []
+        quarantined = sorted(self._quarantined_ids())
+        for i, ref in enumerate(shard_refs):
+            res = await ref.shard_init.call_one(
+                i,
+                len(shard_refs),
+                coordinator,
+                self.volume_refs,
+                self.volume_hostnames,
+                quarantined,
+            )
+            self._shard_stamped.append(res.get("stamped"))
+        self.idx = RemoteIndex(self._shard_refs)
+        if self.core.meta_writer is not None:
+            self.core.meta_writer.close()
+            self.core.meta_writer = None
+        self._bump_epoch()
+        return {"shards": len(self._shard_refs)}
+
+    @endpoint
+    async def metadata_topology(self) -> dict[str, Any]:
+        """What a client's MetadataRouter needs: shard refs for fan-out
+        routing and stamped-segment descriptors for the one-sided path
+        (attached only by same-host clients)."""
+        if self._shard_refs:
+            index_descs = list(self._shard_stamped)
+        else:
+            index_descs = [
+                self.core.meta_writer.describe()
+                if self.core.meta_writer is not None
+                else None
+            ]
+        return {
+            "shards": list(self._shard_refs),
+            "stamped": {
+                "coordinator": (
+                    self._meta_writer.describe()
+                    if self._meta_writer is not None
+                    else None
+                ),
+                "index": index_descs,
+            },
         }
 
     @endpoint
@@ -365,43 +366,6 @@ class Controller(Actor):
     async def get_strategy(self):
         return self.strategy
 
-    # ---- commit tracking -------------------------------------------------
-
-    def _committed_state(self, volume_infos: dict[str, StorageInfo]) -> str:
-        """'committed' | 'partial' for one key. A sharded key is fully
-        committed when stored coords across all volumes cover
-        product(mesh_shape) (/root/reference/torchstore/controller.py:66-104)."""
-        any_info = next(iter(volume_infos.values()))
-        if any_info.object_type != ObjectType.TENSOR_SLICE:
-            return "committed"
-        coords: set[tuple] = set()
-        mesh_shape: Optional[tuple] = None
-        for info in volume_infos.values():
-            coords.update(info.tensor_slices.keys())
-            for ts in info.tensor_slices.values():
-                mesh_shape = ts.mesh_shape
-        expected = math.prod(mesh_shape) if mesh_shape else 0
-        return "committed" if len(coords) >= expected else "partial"
-
-    def _covers(
-        self,
-        subset: dict[str, StorageInfo],
-        full: dict[str, StorageInfo],
-    ) -> bool:
-        """Whether ``subset``'s replicas serve everything ``full``'s do.
-        Non-sharded entries are full copies, so any surviving replica
-        covers; sharded keys compare the UNION of stored coordinates."""
-        any_info = next(iter(full.values()))
-        if any_info.object_type != ObjectType.TENSOR_SLICE:
-            return True
-        sub_coords: set[tuple] = set()
-        for info in subset.values():
-            sub_coords.update(info.tensor_slices.keys())
-        full_coords: set[tuple] = set()
-        for info in full.values():
-            full_coords.update(info.tensor_slices.keys())
-        return sub_coords >= full_coords
-
     # ---- endpoints -------------------------------------------------------
 
     @endpoint
@@ -411,47 +375,11 @@ class Controller(Actor):
         missing_ok: bool = False,
         require_fully_committed: bool = True,
     ) -> dict[str, dict[str, StorageInfo]]:
-        await faults.afire("controller.locate")
-        self.counters["locates"] += len(keys)
-        _LOCATES.inc(len(keys))
-        quarantined = self._quarantined_ids()
-        out: dict[str, dict[str, StorageInfo]] = {}
-        for key in keys:
-            infos = self.index.get(key)
-            if infos is None:
-                if missing_ok:
-                    continue
-                raise StoreKeyError(f"Key {key!r} not found in store")
-            if require_fully_committed and self._committed_state(infos) == "partial":
-                raise PartiallyCommittedError(
-                    f"Key {key!r} is only partially committed; not all mesh "
-                    "coordinates have been stored yet"
-                )
-            if quarantined and any(vid in quarantined for vid in infos):
-                # Readers skip quarantined replicas whenever the healthy
-                # subset alone still serves everything the full set does
-                # (shard-coordinate coverage, not just the coarse
-                # committed/partial label — a quarantined volume holding
-                # the only copy of SOME shard of a partially-committed key
-                # must stay listed). A quarantined volume holding the ONLY
-                # copy stays listed: the client tries it and surfaces the
-                # real failure rather than a bogus missing-key.
-                healthy = {
-                    vid: info
-                    for vid, info in infos.items()
-                    if vid not in quarantined
-                }
-                if healthy and self._covers(healthy, infos):
-                    infos = healthy
-            out[key] = infos
-        return out
+        return await self.idx.locate(keys, missing_ok, require_fully_committed)
 
     @endpoint
     async def contains(self, key: str) -> str:
-        infos = self.index.get(key)
-        if infos is None:
-            return "missing"
-        return self._committed_state(infos)
+        return await self.idx.contains(key)
 
     @endpoint
     async def notify_put_batch(
@@ -500,471 +428,219 @@ class Controller(Actor):
         version, pointing readers at the base key's already-committed
         bytes, in the same watermark step as this batch's metas (requires
         ``watermark``). The base keys are validated committed — a GC'd
-        base fails the publish loudly instead of wedging every reader."""
+        base fails the publish loudly instead of wedging every reader.
+
+        Under a SHARDED metadata plane clients never call this endpoint:
+        the router fans the batch to the owning shards and records the
+        watermark here afterwards (``stream_watermark``)."""
+        if self._shard_refs:
+            raise RuntimeError(
+                "this store's metadata plane is sharded: notify_put_batch "
+                "routes through the client-side shard router, not the "
+                "coordinator (stale store handle?)"
+            )
         await faults.afire("controller.notify")
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
-        stale_gens: dict[str, dict[str, int]] = {}
-        structural = bool(detach_volume_ids)
-        for meta in metas:
-            if meta.tensor_val is not None or meta.objects is not None:
-                raise ValueError(
-                    "controller must never receive data payloads; send "
-                    "meta_only() requests"
-                )
-            infos = self.index.get(meta.key)
-            # Generations of copies indexed BEFORE this notify — the
-            # layout-invalidation wipe below must not erase them, or a
-            # detached replica's reclaim would never be scheduled and its
-            # stale old-layout bytes would stay readable via warm caches.
-            pre_gens = (
-                {vid: info.write_gen for vid, info in infos.items()}
-                if infos is not None
-                else {}
-            )
-            if infos is not None and meta.tensor_slice is not None:
-                # Re-publishing a key under a different layout (mesh shape or
-                # global shape changed) invalidates every previously indexed
-                # shard — otherwise stale old-layout shards would satisfy the
-                # commit check and be served alongside new data.
-                stale = False
-                for prev in infos.values():
-                    for ts in prev.tensor_slices.values():
-                        if (
-                            ts.mesh_shape != meta.tensor_slice.mesh_shape
-                            or ts.global_shape != meta.tensor_slice.global_shape
-                        ):
-                            stale = True
-                if stale:
-                    infos = None
-                    structural = True  # layout change re-routes every fetch
-            if infos is None:
-                infos = {}
-                self.index[meta.key] = infos
-                structural = True  # key newly (re)appears in the index
-            for vid in volume_ids:
-                info = infos.get(vid)
-                if info is None:
-                    info = infos[vid] = StorageInfo.from_meta(meta)
-                    structural = True  # new replica placement
-                else:
-                    if (
-                        meta.tensor_meta is not None
-                        and info.tensor_meta is not None
-                        and info.tensor_meta != meta.tensor_meta
-                    ):
-                        # Same key, different shape/dtype: any plan built
-                        # against the old meta would land wrong bytes.
-                        structural = True
-                    info.merge(meta)
-                # Fresh bytes always land in the memory tier (the volume
-                # discards any stale disk-tier copy in the same put).
-                info.tier = tiering.RESIDENT
-                if write_gens:
-                    info.write_gen = max(
-                        info.write_gen,
-                        write_gens.get(vid, {}).get(meta.key, 0),
-                    )
-            # Count as each entry indexes, so a mid-batch rejection leaves
-            # counters consistent with what actually landed in the index.
-            self.counters["puts"] += 1
-            _PUTS.inc()
-            if meta.tensor_meta is not None:
-                self.counters["put_bytes"] += meta.tensor_meta.nbytes
-                _PUT_BYTES.inc(meta.tensor_meta.nbytes)
-            for vid in detach_volume_ids or ():
-                # Capture the generation of the copy being detached BEFORE
-                # removing it — the reclaim may delete the replica's bytes
-                # only while its generation hasn't moved past this.
-                # pre_gens covers entries the layout-invalidation wipe
-                # already dropped from `infos`. A volume with NO prior
-                # indexed copy may still hold bytes from a PARTIAL batch
-                # landing (some requests landed before one failed): -1
-                # marks "generation unknown — resolve volume-side" so the
-                # reclaim's two-phase delete can still collect them.
-                prev = infos.get(vid)
-                if prev is not None:
-                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
-                elif vid in pre_gens:
-                    stale_gens.setdefault(vid, {})[meta.key] = pre_gens[vid]
-                else:
-                    stale_gens.setdefault(vid, {}).setdefault(meta.key, -1)
-                self._detach_meta(meta, vid)
-            if supersede:
-                # Full overwrite: volumes outside this put's replica set
-                # that still hold THIS meta (same coordinates for shards,
-                # the whole entry otherwise) now carry superseded bytes —
-                # detach them here, reclaim their bytes in the background.
-                for vid in [v for v in list(infos) if v not in volume_ids]:
-                    prev = infos.get(vid)
-                    if prev is None:
-                        continue
-                    if meta.tensor_slice is not None and (
-                        prev.object_type != ObjectType.TENSOR_SLICE
-                        or meta.tensor_slice.coordinates
-                        not in prev.tensor_slices
-                    ):
-                        continue  # holds other shards only: not superseded
-                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
-                    self._detach_meta(meta, vid)
-                    structural = True
-        if stale_gens:
-            # The detached replica may be wedged-but-ALIVE and still holding
-            # the old bytes: clients with warm location caches would read
-            # the stale value from it, and delete_batch fans out by index
-            # (which no longer lists it) so the bytes would never be
-            # reclaimed. Best-effort background conditional delete once
-            # it's reachable.
-            for vid, keys in stale_gens.items():
-                self._schedule_reclaim(vid, keys)
-        if structural:
-            self._placement_epoch += 1
+        await self.core.apply_put_batch(
+            metas,
+            volume_ids,
+            detach_volume_ids=detach_volume_ids,
+            write_gens=write_gens,
+            supersede=supersede,
+        )
         if watermark is not None:
-            # Faultpoint INSIDE the watermark step: a delay/wedge here holds
-            # committed bytes invisible to streaming readers (they keep
-            # long-polling — never serve unwatermarked keys); a raise fails
-            # the whole notify, so the publisher sees the error before any
-            # reader could have trusted the partial version.
-            await faults.afire("channel.watermark")
             stream_key, version = watermark
-            rec = self._stream_rec(stream_key, int(version))
-            now = time.time()
-            for meta in metas:
-                prev = rec["watermarks"].get(meta.key, 0)
-                # max(): a delayed notify from a superseded stream must
-                # never roll a key's watermark backwards.
-                rec["watermarks"][meta.key] = max(prev, int(version))
-                if int(version) == rec["version"]:
-                    # Landing timestamp for the CURRENT generation's
-                    # timeline (setdefault: the first commit of a key is
-                    # its landing; superseded late notifies don't count).
-                    rec["landing_ts"].setdefault(meta.key, now)
-            if unchanged:
-                # Unchanged-key aliases ride the SAME watermark step as
-                # the batch's metas: readers woken by this notify see the
-                # aliased keys ready together with the landed ones.
-                self._record_unchanged(rec, unchanged, int(version), now)
-            # Broadcast fan-out: keys that just landed on the origin
-            # volume(s) start flowing down the channel's relay tree, per
-            # layer — interior hops forward as watermarks land, never
-            # waiting for the seal.
-            await self._relay_on_landing(
-                stream_key, int(version), metas, volume_ids
+            await self._apply_watermark(
+                stream_key, int(version), metas, volume_ids, unchanged
             )
         elif unchanged:
             raise ValueError(
                 "notify_put_batch(unchanged=...) requires watermark=: "
                 "unchanged-key aliases are a streamed-publish protocol"
             )
-        await self._bump({meta.key for meta in metas})
+        await self.core.bump({meta.key for meta in metas})
         # The reply carries the placement epoch so publishers track it for
         # free (no extra RPC): a bump invalidates their cached plans.
         return self._placement_epoch
 
-    def _reclaim_policy(self):
-        """The drainer's backoff schedule as a RetryPolicy (the unified
-        retry vocabulary — config.RetryPolicy). TORCHSTORE_TPU_RECLAIM_DELAYS
-        overrides the default 1,5,15,60 schedule; malformed values fall back
-        (a parse error must not kill the drainer — it would leave the
-        volume's running-flag set and wedge reclaims forever)."""
-        import os
-
-        from torchstore_tpu.config import RetryPolicy
-
-        # deadline_s=inf: the schedule length IS the attempt budget (the
-        # pre-policy drainer always ran every entry). A wall-clock deadline
-        # here would skip the long tail exactly when a slow-recovering
-        # volume makes each attempt's RPCs block until their own timeout —
-        # the case the 60 s entry exists for.
-        env = os.environ.get("TORCHSTORE_TPU_RECLAIM_DELAYS")
-        if env:
-            try:
-                return RetryPolicy.from_delays(
-                    env.split(","), deadline_s=float("inf")
-                )
-            except ValueError:
-                logger.warning(
-                    "ignoring malformed TORCHSTORE_TPU_RECLAIM_DELAYS=%r", env
-                )
-        return RetryPolicy.from_delays(
-            (1.0, 5.0, 15.0, 60.0), deadline_s=float("inf")
-        )
-
-    def _schedule_reclaim(self, volume_id: str, keys: dict[str, int]) -> None:
-        """``keys``: {key: stale write generation} — the generation of the
-        copy that was just detached (the newest bytes the reclaim is
-        allowed to delete)."""
-        pending = self._pending_reclaims.setdefault(volume_id, {})
-        for key, gen in keys.items():
-            # -1 = unknown generation (resolved volume-side at drain time);
-            # a known generation always wins over unknown.
-            pending[key] = max(pending[key], gen) if key in pending else gen
-        _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
-        if volume_id in self._reclaim_running:
-            return  # the volume's drainer picks the new keys up
-        self._reclaim_running.add(volume_id)
-        # A drainer that dies on an unexpected exception must be LOUD: the
-        # volume's running-flag was cleared in its finally, but the stale
-        # bytes stay resident until the next detach — spawn_logged retains
-        # the task and logs + counts the failure instead of dropping it.
-        spawn_logged(
-            self._reclaim_detached(volume_id),
-            name="controller.reclaim",
-            tasks=self._reclaim_tasks,
-            log=logger,
-        )
-
-    async def _reclaim_detached(self, volume_id: str) -> None:
-        """Drain the volume's pending stale keys once it recovers (ADVICE
-        r2). Keys re-indexed on the volume in the meantime are skipped (a
-        later put/repair re-replicated fresh bytes there). The delete is
-        CONDITIONAL on the stale write generation (ADVICE r3): a put
-        landing any time after the detach bumped the volume's generation,
-        so the volume keeps its bytes and reports them fresh — an
-        acknowledged overwrite can never be destroyed by a racing reclaim,
-        even at replication factor 1.
-
-        Keys scheduled with generation -1 (partial batch landings the
-        controller never saw a generation for) resolve in two phases: the
-        volume reports its CURRENT generation first, then the conditional
-        delete targets exactly the observed bytes — anything fresher that
-        lands during the RPC is kept. As the safety net for the residual
-        race (a delete landing while the bytes' notify is still in
-        flight), every completed delete is reconciled against the index:
-        if the index meanwhile claims this volume holds a deleted key, the
-        entry is detached loudly (degraded redundancy, healed by the next
-        publish) instead of pointing readers at missing bytes."""
-        import asyncio
-
-        try:
-            policy = self._reclaim_policy()
-            deadline = policy.start()
-            attempt = 0
-            while policy.should_retry(attempt, deadline):
-                await asyncio.sleep(policy.backoff(attempt))
-                attempt += 1
-                ref = self.volume_refs.get(volume_id)
-                pending = self._pending_reclaims.get(volume_id)
-                if ref is None or not pending:
-                    return
-                batch = {
-                    k: g
-                    for k, g in pending.items()
-                    if volume_id not in self.index.get(k, {})
-                }
-                for key in list(pending):
-                    if key not in batch:
-                        del pending[key]  # re-indexed keys: done
-                if not batch:
-                    return
-                unknown = sorted(k for k, g in batch.items() if g < 0)
-                try:
-                    if unknown:
-                        observed = await ref.write_gens.call_one(unknown)
-                        for key in unknown:
-                            if key in observed:
-                                batch[key] = observed[key]
-                            # Keys ABSENT from the reply stay in the batch at
-                            # gen -1: on a durable backend after a volume
-                            # restart, stale partial-landing bytes can exist
-                            # with no in-memory generation — dropping them
-                            # here would leave them readable via warm
-                            # location caches forever. delete_batch_if
-                            # deletes keys with no recorded generation, and
-                            # a put racing in records one and is kept
-                            # (ADVICE r4 carried fix).
-                        # Keys indexed on this volume while we fetched gens
-                        # are fresh again — drop them before deleting.
-                        for key in list(batch):
-                            if volume_id in self.index.get(key, {}):
-                                del batch[key]
-                        if not batch:
-                            continue
-                    result = await ref.delete_batch_if.call_one(
-                        sorted(batch.items())
-                    )
-                except Exception:  # noqa: BLE001 - still wedged/dead; retry
-                    continue
-                for key, sent_gen in batch.items():
-                    # A NEWER stale generation scheduled while the RPC was
-                    # in flight must survive for the next round — pop only
-                    # what this delete actually covered.
-                    if pending.get(key) in (sent_gen, -1):
-                        pending.pop(key, None)
-                for key, gen in result.get("kept_gens", {}).items():
-                    # Fresh bytes raced the reclaim. Normally the racing
-                    # put's notify (re)indexes this volume and the next
-                    # round filters the key out; if that notify never
-                    # arrives (client died between data-plane ack and
-                    # notify), the requeued generation reclaims the
-                    # orphaned bytes on a later round.
-                    pending[key] = max(pending.get(key, 0), gen)
-                if result["kept_fresh"]:
-                    logger.info(
-                        "reclaim on volume %s kept %d key(s) with fresh "
-                        "bytes (%s); re-verifying next round",
-                        volume_id,
-                        len(result["kept_fresh"]),
-                        result["kept_fresh"][:3],
-                    )
-                await self._reconcile_clobbered(volume_id, result["removed"])
-                _RECLAIMED.inc(len(result["removed"]))
-                _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
-                logger.info(
-                    "reclaimed %d stale key(s) on detached volume %s",
-                    len(result["removed"]),
-                    volume_id,
-                )
-                if not pending:
-                    return
-            left = self._pending_reclaims.get(volume_id) or ()
-            if left:
-                logger.warning(
-                    "gave up reclaiming %d stale key(s) on volume %s "
-                    "(unreachable)",
-                    len(left),
-                    volume_id,
-                )
-        finally:
-            self._reclaim_running.discard(volume_id)
-            self._pending_reclaims.pop(volume_id, None)
-            _PENDING_RECLAIMS.set(0, volume=volume_id)
-
-    async def _reconcile_clobbered(
-        self, volume_id: str, removed_keys: list[str]
+    async def _apply_watermark(
+        self,
+        stream_key: str,
+        version: int,
+        metas: list[Request],
+        volume_ids: list,
+        unchanged: Optional[dict],
     ) -> None:
-        """A reclaim delete whose key the index NOW claims this volume
-        holds means a racing put's bytes were destroyed before its notify
-        indexed them (the conditional delete narrows this to the
-        gen-read/delete window of two-phase unknown-generation reclaims).
-        Detach the entry so readers fail over / fail loudly instead of
-        routing to missing bytes; the next publish restores redundancy."""
-        clobbered = []
-        for key in removed_keys:
-            infos = self.index.get(key)
-            if infos is not None and volume_id in infos:
-                infos.pop(volume_id, None)
-                if not infos:
-                    self.index.pop(key, None)
-                clobbered.append(key)
-        if clobbered:
-            logger.warning(
-                "reclaim raced a fresh put on volume %s: detached %d "
-                "re-indexed key(s) it deleted (%s); redundancy degraded "
-                "until the next publish",
-                volume_id,
-                len(clobbered),
-                clobbered[:3],
-            )
-            await self._bump(set(clobbered))
+        """The watermark step of a streamed publish (see notify_put_batch):
+        shared verbatim by the unsharded notify and the sharded router's
+        ``stream_watermark`` follow-up — in both, it runs strictly AFTER
+        the batch's metadata committed to the owning index."""
+        # Faultpoint INSIDE the watermark step: a delay/wedge here holds
+        # committed bytes invisible to streaming readers (they keep
+        # long-polling — never serve unwatermarked keys); a raise fails
+        # the whole notify, so the publisher sees the error before any
+        # reader could have trusted the partial version.
+        await faults.afire("channel.watermark")
+        rec = self._stream_rec(stream_key, int(version))
+        now = time.time()
+        for meta in metas:
+            prev = rec["watermarks"].get(meta.key, 0)
+            # max(): a delayed notify from a superseded stream must
+            # never roll a key's watermark backwards.
+            rec["watermarks"][meta.key] = max(prev, int(version))
+            if int(version) == rec["version"]:
+                # Landing timestamp for the CURRENT generation's
+                # timeline (setdefault: the first commit of a key is
+                # its landing; superseded late notifies don't count).
+                rec["landing_ts"].setdefault(meta.key, now)
+        if unchanged:
+            # Unchanged-key aliases ride the SAME watermark step as
+            # the batch's metas: readers woken by this notify see the
+            # aliased keys ready together with the landed ones.
+            await self._record_unchanged(rec, unchanged, int(version), now)
+        # Broadcast fan-out: keys that just landed on the origin
+        # volume(s) start flowing down the channel's relay tree, per
+        # layer — interior hops forward as watermarks land, never
+        # waiting for the seal.
+        await self._relay_on_landing(
+            stream_key, int(version), metas, volume_ids
+        )
+        self._touch_streams()
 
-    def _detach_meta(self, meta: Request, volume_id: str) -> None:
-        """Remove ONE meta's footprint on ``volume_id``: the exact shard
-        coords for sharded keys (sibling shards on the volume survive), the
-        whole entry for tensors/objects. A key with no volumes left
-        disappears; a sharded key missing coords reads as partial (loud)."""
-        infos = self.index.get(meta.key)
-        if infos is None or volume_id not in infos:
-            return
-        info = infos[volume_id]
-        if (
-            meta.tensor_slice is not None
-            and info.object_type == ObjectType.TENSOR_SLICE
-        ):
-            info.tensor_slices.pop(meta.tensor_slice.coordinates, None)
-            if info.tensor_slices:
-                return
-        del infos[volume_id]
-        if not infos:
-            self.index.pop(meta.key, None)
+    @endpoint
+    async def stream_watermark(
+        self,
+        stream_key: str,
+        version: int,
+        metas: list[Request],
+        volume_ids: list,
+        unchanged: Optional[dict] = None,
+    ) -> None:
+        """Sharded-notify follow-up: record the batch's stream watermarks
+        AFTER every owning shard indexed its slice (the router orders the
+        two), preserving bytes-committed-before-watermark-visible across
+        the partition. Wakes this coordinator's ``wait_for_stream``
+        long-pollers — per-key generations live on the shards."""
+        await self._apply_watermark(
+            stream_key, int(version), metas, volume_ids, unchanged
+        )
+        cond = self._cond()
+        async with cond:
+            cond.notify_all()
+
+    def _lease_guard(self, keys: list[str]) -> list[str]:
+        """Retention-lease guard (tiering/): filter out keys under a PINNED
+        (channel, version) — they stay indexed whoever issued the delete
+        (the publisher's GC, close(delete=True), a raw delete_prefix).
+        This is the hard "never reaped mid-read" guarantee; lease-aware
+        callers (WeightPublisher._gc) skip pinned versions before ever
+        asking, and reap a retained version on a LATER publish's GC once
+        its last lease lapses. One pinned-groups snapshot serves the whole
+        batch (a per-key lease-table scan would be O(keys x leases) on the
+        controller loop)."""
+        pinned = self._leases.pinned_groups()
+        if not pinned:
+            return keys
+        blocked = []
+        passed = []
+        for key in keys:
+            group = tiering.version_group(key)
+            if group is not None and tiering.group_key(*group) in pinned:
+                blocked.append(key)
+            else:
+                passed.append(key)
+        if blocked:
+            _LEASE_BLOCKED_DELETES.inc(len(blocked))
+            obs_recorder.record(
+                "tier",
+                "delete_blocked",
+                keys=len(blocked),
+                sample=blocked[0],
+            )
+            logger.warning(
+                "refusing to delete %d key(s) under leased version(s) "
+                "(e.g. %s); release or let the cohort leases expire "
+                "first",
+                len(blocked),
+                blocked[0],
+            )
+        return passed
+
+    def _retire_stream_records(self, deleted) -> None:
+        """Deleting a streamed state dict's commit marker retires its
+        stream record too (delete_prefix of a version directory takes the
+        marker with it): established wait_for_stream pollers wake and
+        observe the record gone instead of blocking forever, and per-key
+        watermarks are dropped with the bytes they described."""
+        for key in deleted:
+            self._streams.pop(key, None)
+            self._relay_stop_run(key)
+            if key.endswith("/MAPPING"):
+                self._streams.pop(key[: -len("/MAPPING")], None)
+                self._relay_stop_run(key[: -len("/MAPPING")])
+        self._touch_streams()
 
     @endpoint
     async def notify_delete_batch(self, keys: list[str]) -> dict[str, list[str]]:
         """Remove keys from the index FIRST (notify-before-delete ordering,
         /root/reference/torchstore/client.py:408-411) and return which
-        volumes held each key so the client can clear the data plane."""
-        self.counters["deletes"] += len(keys)
-        _DELETES.inc(len(keys))
-        # Retention-lease guard (tiering/): keys under a PINNED
-        # (channel, version) stay indexed whoever issued the delete — the
-        # publisher's GC, close(delete=True), a raw delete_prefix. This is
-        # the hard "never reaped mid-read" guarantee; lease-aware callers
-        # (WeightPublisher._gc) skip pinned versions before ever asking,
-        # and reap a retained version on a LATER publish's GC once its
-        # last lease lapses. One pinned-groups snapshot serves the whole
-        # batch (a per-key lease-table scan would be O(keys x leases) on
-        # the controller loop).
-        pinned = self._leases.pinned_groups()
-        if pinned:
-            blocked = []
-            passed = []
-            for key in keys:
-                group = tiering.version_group(key)
-                if group is not None and tiering.group_key(*group) in pinned:
-                    blocked.append(key)
-                else:
-                    passed.append(key)
-            if blocked:
-                _LEASE_BLOCKED_DELETES.inc(len(blocked))
-                obs_recorder.record(
-                    "tier",
-                    "delete_blocked",
-                    keys=len(blocked),
-                    sample=blocked[0],
-                )
-                logger.warning(
-                    "refusing to delete %d key(s) under leased version(s) "
-                    "(e.g. %s); release or let the cohort leases expire "
-                    "first",
-                    len(blocked),
-                    blocked[0],
-                )
-                keys = passed
-        by_volume: dict[str, list[str]] = {}
-        for key in keys:
-            infos = self.index.pop(key, None)
-            if infos is None:
-                continue  # idempotent delete
-            for vid in infos:
-                by_volume.setdefault(vid, []).append(key)
+        volumes held each key so the client can clear the data plane.
+        Sharded stores route through delete_guard -> shard delete_keys ->
+        delete_finish instead (the router owns the ordering)."""
+        if self._shard_refs:
+            raise RuntimeError(
+                "this store's metadata plane is sharded: deletes route "
+                "through the client-side shard router, not the coordinator"
+            )
+        self.core.count_deletes(len(keys))
+        keys = self._lease_guard(keys)
+        by_volume = self.core.delete_keys(keys)
         # A delete is an observable change: wake wait_for_change waiters
         # (they re-check state and see 'missing').
         deleted = {k for vkeys in by_volume.values() for k in vkeys}
         if deleted:
-            # Deleting a streamed state dict's commit marker retires its
-            # stream record too (delete_prefix of a version directory takes
-            # the marker with it): established wait_for_stream pollers wake
-            # and observe the record gone instead of blocking forever, and
-            # per-key watermarks are dropped with the bytes they described.
-            for key in deleted:
-                self._streams.pop(key, None)
-                self._relay_stop_run(key)
-                if key.endswith("/MAPPING"):
-                    self._streams.pop(key[: -len("/MAPPING")], None)
-                    self._relay_stop_run(key[: -len("/MAPPING")])
-            self._placement_epoch += 1
-            await self._bump(deleted)
+            self._retire_stream_records(deleted)
+            self._bump_epoch()
+            await self.core.bump(deleted)
         return by_volume
+
+    @endpoint
+    async def delete_guard(self, keys: list[str]) -> list[str]:
+        """Sharded delete, phase 1: the fleet-scoped lease guard. Returns
+        the keys the owning shards may actually drop."""
+        return self._lease_guard(keys)
+
+    @endpoint
+    async def delete_finish(self, deleted: list[str]) -> None:
+        """Sharded delete, phase 3: retire stream records for what the
+        shards actually removed, invalidate plans, wake stream pollers."""
+        if not deleted:
+            return
+        self._retire_stream_records(deleted)
+        self._bump_epoch()
+        cond = self._cond()
+        async with cond:
+            cond.notify_all()
 
     @endpoint
     async def placement_epoch(self) -> int:
         """Current placement epoch (see __init__): ONE cheap RPC that lets a
         consumer validate a whole cached transfer plan instead of
-        re-fetching the commit marker and re-locating every key."""
+        re-fetching the commit marker and re-locating every key (and the
+        stamped header serves the same answer with ZERO RPCs same-host)."""
         return self._placement_epoch
 
     @endpoint
     async def bump_placement_epoch(self) -> int:
         """Force-invalidate every cached transfer plan fleet-wide. Called by
         publishers that restructure a state dict in a way the index cannot
-        see (e.g. dropping keys from a push without deleting them)."""
-        self._placement_epoch += 1
-        return self._placement_epoch
+        see (e.g. dropping keys from a push without deleting them), and by
+        every ControllerShard reporting a structural index change."""
+        return self._bump_epoch()
 
     @endpoint
     async def keys(self, prefix: Optional[str] = None) -> list[str]:
-        if prefix is None:
-            return sorted(self.index)
-        return sorted(self.index.keys().filter_by_prefix(prefix))
+        return await self.idx.keys_list(prefix)
 
     # ---- blocking waits --------------------------------------------------
 
@@ -977,31 +653,7 @@ class Controller(Actor):
         reference has no wait primitive — consumers poll get_state_dict in
         try/except loops; this replaces the poll with a single blocking RPC
         woken by the notify that commits the key."""
-        import asyncio
-
-        cond = self._cond()
-
-        def ready() -> bool:
-            for key in keys:
-                infos = self.index.get(key)
-                if infos is None or self._committed_state(infos) == "partial":
-                    return False
-            return True
-
-        async with cond:
-            try:
-                await asyncio.wait_for(cond.wait_for(ready), timeout)
-            except asyncio.TimeoutError:
-                missing = [
-                    k
-                    for k in keys
-                    if self.index.get(k) is None
-                    or self._committed_state(self.index.get(k)) == "partial"
-                ]
-                raise TimeoutError(
-                    f"wait_for_committed timed out after {timeout}s; still "
-                    f"missing/partial: {missing[:5]}"
-                ) from None
+        await self.idx.wait_for_committed(keys, timeout)
 
     @endpoint
     async def wait_for_change(
@@ -1017,27 +669,7 @@ class Controller(Actor):
         (rebuild_index), so a subscriber holding a larger pre-restart gen
         must wake immediately and resync rather than block through every
         subsequent publish (ADVICE r2)."""
-        import asyncio
-
-        cond = self._cond()
-        async with cond:
-            try:
-                await asyncio.wait_for(
-                    cond.wait_for(
-                        lambda: self._key_gens.get(key, 0) != last_gen
-                    ),
-                    timeout,
-                )
-            except asyncio.TimeoutError:
-                raise TimeoutError(
-                    f"wait_for_change({key!r}) timed out after {timeout}s at "
-                    f"generation {self._key_gens.get(key, 0)}"
-                ) from None
-            infos = self.index.get(key)
-            state = (
-                "missing" if infos is None else self._committed_state(infos)
-            )
-            return {"gen": self._key_gens.get(key, 0), "state": state}
+        return await self.idx.wait_for_change(key, last_gen, timeout)
 
     # ---- layer-streamed sync (watermark protocol) ------------------------
 
@@ -1112,6 +744,7 @@ class Controller(Actor):
         # generation's quant meta when this stream publishes unquantized
         # (readers would skip in-place landings and misdecode).
         rec["quant"] = quant
+        self._touch_streams()
         cond = self._cond()
         async with cond:
             cond.notify_all()
@@ -1127,22 +760,30 @@ class Controller(Actor):
         if int(version) == rec["version"] and rec.get("seal_ts") is None:
             rec["seal_ts"] = time.time()
         await self._relay_on_seal(key, int(version))
+        self._touch_streams()
         cond = self._cond()
         async with cond:
             cond.notify_all()
 
-    def _record_unchanged(
+    async def _record_unchanged(
         self, rec: dict, aliases: dict, version: int, now: float
     ) -> None:
         """Record unchanged-key watermark aliases on one stream record:
         each ``new_store_key`` is watermarked at ``version`` with its bytes
         aliased to an already-committed base store key. Validated HERE so a
         publish aliasing GC'd bytes fails the publisher loudly instead of
-        handing readers a key they can never fetch."""
+        handing readers a key they can never fetch. ONE batched locate
+        validates every base key (the delta tier's target case is MOST of
+        a state dict unchanged — a per-alias round trip would put O(keys)
+        shard RPCs on the publish critical path)."""
+        base_keys = sorted({alias[0] for alias in aliases.values()})
+        located = await self.idx.locate(
+            base_keys, missing_ok=True, require_fully_committed=False
+        )
         for new_sk, alias in aliases.items():
             base_sk, base_version = alias[0], int(alias[1])
-            infos = self.index.get(base_sk)
-            if not infos or self._committed_state(infos) != "committed":
+            infos = located.get(base_sk)
+            if not infos or self.core.committed_state(infos) != "committed":
                 raise ValueError(
                     f"unchanged-watermark alias {new_sk!r} -> {base_sk!r}: "
                     "base bytes are not committed (GC'd, spilled out of the "
@@ -1166,7 +807,8 @@ class Controller(Actor):
         so there is no bytes-before-watermark window to close. Wakes
         ``wait_for_stream`` long-pollers like any landing."""
         rec = self._stream_rec(key, int(version))
-        self._record_unchanged(rec, aliases, int(version), time.time())
+        await self._record_unchanged(rec, aliases, int(version), time.time())
+        self._touch_streams()
         cond = self._cond()
         async with cond:
             cond.notify_all()
@@ -1277,11 +919,15 @@ class Controller(Actor):
             )
             if run is not None:
                 forwarded = run["metas"]
+                # The run's landed sets are the gate (updated in the same
+                # step a relay hop's copies are indexed): a sync predicate
+                # can't fan out to the sharded index, and the landed view
+                # is authoritative for exactly the keys the run forwards.
+                landed = run["landed"].get(volume_id, ())
                 local = {
                     k: v
                     for k, v in ready.items()
-                    if k not in forwarded
-                    or volume_id in (self.index.get(k) or {})
+                    if k not in forwarded or k in landed
                 }
                 sealed = sealed and len(local) == len(ready)
                 ready = local
@@ -1527,7 +1173,7 @@ class Controller(Actor):
         if run is None or run.get("dead") or int(version) != run["version"]:
             return
         marker_key = f"{stream_key}/MAPPING"
-        infos = self.index.get(marker_key)
+        infos = await self.idx.get_entry(marker_key)
         if infos:
             run["metas"][marker_key] = Request(key=marker_key, is_object=True)
             for vid in infos:
@@ -1626,26 +1272,20 @@ class Controller(Actor):
             streak = 0
             run["failing"].pop(child, None)
             gens = result.get("write_gens", {})
-            touched = set()
-            for meta in metas:
-                infos = self.index.get(meta.key)
-                if infos is None:
-                    continue  # deleted mid-run: never re-index
-                info = infos.get(child)
-                if info is None:
-                    info = infos[child] = StorageInfo.from_meta(meta)
-                else:
-                    info.merge(meta)
-                info.write_gen = max(info.write_gen, gens.get(meta.key, 0))
-                touched.add(meta.key)
+            # Index the pulled copies through the authority (the owning
+            # shard, when sharded): new replica placement is structural
+            # (same rule as notify_put_batch) and the merge's bump wakes
+            # per-key waiters; keys deleted mid-run are never re-indexed.
+            touched = await self.idx.merge_copies(child, metas, gens)
             have.update(batch)
             _RELAY_FORWARDED.inc(len(batch), channel=run["channel"])
             if touched:
-                # New replica placement is structural (same rule as
-                # notify_put_batch); the generation bump wakes relay-gated
-                # wait_for_stream long-pollers on the child's host.
-                self._placement_epoch += 1
-                await self._bump(touched)
+                # Relay-gated wait_for_stream long-pollers wait on THIS
+                # process's condition; wake them now that the child's
+                # landed set moved (the shard's own bump can't reach it).
+                cond = self._cond()
+                async with cond:
+                    cond.notify_all()
             await self._relay_notify(run)
 
     async def _relay_reparent_edge(
@@ -1961,14 +1601,11 @@ class Controller(Actor):
             if not rep.get("enabled"):
                 reports[vid] = rep
                 continue
-            for key in rep.get("spilled", ()):
-                infos = self.index.get(key)
-                if infos is not None and vid in infos:
-                    infos[vid].tier = tiering.TIERED
-            for key in rep.get("fault_ins", ()):
-                infos = self.index.get(key)
-                if infos is not None and vid in infos:
-                    infos[vid].tier = tiering.RESIDENT
+            await self.idx.set_tiers(
+                vid,
+                list(rep.get("spilled", ())),
+                list(rep.get("fault_ins", ())),
+            )
             if rep.get("spilled"):
                 obs_recorder.record(
                     "tier",
@@ -2012,9 +1649,7 @@ class Controller(Actor):
         # Segment-bounded prefix: "chan/v1" matches "chan/v1/..." but
         # never "chan/v10/..." (trie path-wise semantics).
         prefix = tiering.group_key(channel, version)
-        lease["resident_keys"] = sum(
-            1 for _ in self.index.keys().filter_by_prefix(prefix)
-        )
+        lease["resident_keys"] = await self.idx.count_prefix(prefix)
         return lease
 
     @endpoint
@@ -2045,7 +1680,10 @@ class Controller(Actor):
         replica still serves it from memory), and the live leases pinning
         it (including pre-pins on versions with no keys yet)."""
         self._leases.expire()
-        out: dict[str, dict[int, dict]] = {}
+        # The per-key walk lives with the index (IndexCore.catalog; the
+        # sharded authority merges per-shard slices); leases are
+        # coordinator state and fold in here.
+        out = await self.idx.catalog(channel)
 
         def _rec(chan: str, ver: int) -> dict:
             return out.setdefault(chan, {}).setdefault(
@@ -2060,36 +1698,6 @@ class Controller(Actor):
                 },
             )
 
-        for key in self.index:
-            group = tiering.version_group(key)
-            if group is None:
-                continue
-            chan, ver = group
-            if channel is not None and chan != channel:
-                continue
-            infos = self.index.get(key)
-            if not infos:
-                continue
-            rec = _rec(chan, ver)
-            rec["keys"] += 1
-            info = next(iter(infos.values()))
-            if info.object_type == ObjectType.TENSOR_SLICE:
-                itemsize = (
-                    info.tensor_meta.np_dtype.itemsize
-                    if info.tensor_meta is not None
-                    else 4
-                )
-                rec["bytes"] += sum(
-                    ts.nelements * itemsize
-                    for ts in info.tensor_slices.values()
-                )
-            elif info.tensor_meta is not None:
-                rec["bytes"] += info.tensor_meta.nbytes
-            if any(i.tier != tiering.TIERED for i in infos.values()):
-                rec["resident_keys"] += 1
-            else:
-                rec["spilled_keys"] += 1
-            rec["volumes"].update(infos)
         for lease in self._leases.describe():
             if channel is not None and lease["channel"] != channel:
                 continue
@@ -2384,7 +1992,36 @@ class Controller(Actor):
             # One bump per sweep however many volumes transitioned: clients
             # drop cached plans/locations and re-resolve against the new
             # health picture on their next operation.
-            self._placement_epoch += 1
+            self._bump_epoch()
+            self._push_health()
+
+    def _push_health(self) -> None:
+        """Propagate the quarantine picture to every index host: shards
+        re-filter their locates immediately (best-effort — a shard that
+        misses the push serves slightly stale health until the next one),
+        and the local core republishes its stamped index filtered."""
+        self.core.mark_meta_dirty()
+        if not self._shard_refs:
+            return
+        quarantined = sorted(self._quarantined_ids())
+
+        async def push() -> None:
+            import asyncio
+
+            await asyncio.gather(
+                *(
+                    ref.set_quarantined.call_one(quarantined)
+                    for ref in self._shard_refs
+                ),
+                return_exceptions=True,
+            )
+
+        spawn_logged(
+            push(),
+            name="controller.health_push",
+            tasks=self._health_tasks,
+            log=logger,
+        )
 
     async def _dump_flight(self, trigger: str) -> Optional[str]:
         """Write a MERGED flight-recorder post-mortem: this controller's
@@ -2424,121 +2061,17 @@ class Controller(Actor):
 
     async def _auto_repair_volume(self, volume_id: str) -> None:
         """Re-replicate every key the quarantined volume held that still
-        has a healthy copy onto healthy volumes (volume-to-volume over the
-        RPC transport — no client involvement), restoring redundancy
-        without ts.repair(). Keys whose only copy lived on the quarantined
-        volume are skipped (nothing to copy from; ts.repair()/recover
-        remains the story for those). Raced overwrites are detected by
-        write-generation snapshot and the extra copy is reclaimed instead
-        of indexed, so a repaired replica can never serve stale bytes
-        under fresh metadata."""
-        import asyncio
-
+        has a healthy copy onto healthy volumes — the plan/pull/index pass
+        lives with the index (IndexCore.auto_repair_pass; each shard runs
+        its own slice when sharded). See the core method for the raced-
+        overwrite and shard-coverage rules."""
         try:
             healthy = [
                 vid
                 for vid, h in self._vol_health.items()
                 if h["state"] == "ok" and vid in self.volume_refs
             ]
-            if not healthy:
-                return
-            # Plan: (src, tgt) -> list of (key, meta-only Requests, src_gen).
-            plan: dict[tuple[str, str], list] = {}
-            rr = 0
-            for key in list(self.index):
-                infos = self.index.get(key)
-                if infos is None or volume_id not in infos:
-                    continue
-                lost = infos[volume_id]
-                sources = [v for v in healthy if v in infos]
-                src = None
-                for cand in sources:
-                    have = infos[cand]
-                    if lost.object_type != have.object_type:
-                        continue
-                    if lost.object_type == ObjectType.TENSOR_SLICE and not (
-                        set(lost.tensor_slices) <= set(have.tensor_slices)
-                    ):
-                        continue  # survivor lacks some of the lost shards
-                    src = cand
-                    break
-                if src is None:
-                    continue
-                targets = [v for v in healthy if v not in infos]
-                if not targets:
-                    continue  # every healthy volume already holds a copy
-                tgt = sorted(targets)[rr % len(targets)]
-                rr += 1
-                if lost.object_type == ObjectType.OBJECT:
-                    metas = [Request(key=key, is_object=True)]
-                elif lost.object_type == ObjectType.TENSOR:
-                    metas = [Request(key=key, tensor_meta=lost.tensor_meta)]
-                else:
-                    metas = [
-                        Request(
-                            key=key,
-                            tensor_slice=ts,
-                            tensor_meta=lost.tensor_meta,
-                        )
-                        for ts in lost.tensor_slices.values()
-                    ]
-                plan.setdefault((src, tgt), []).append(
-                    (key, metas, self.index[key][src].write_gen)
-                )
-            if not plan:
-                return
-            repaired = 0
-            for (src, tgt), items in plan.items():
-                src_ref = self.volume_refs.get(src)
-                tgt_ref = self.volume_refs.get(tgt)
-                if src_ref is None or tgt_ref is None:
-                    continue
-                # Bounded batches: one pull RPC moves up to 64 keys.
-                for i in range(0, len(items), 64):
-                    batch = items[i : i + 64]
-                    metas = [m for _, ms, _ in batch for m in ms]
-                    try:
-                        result = await tgt_ref.pull_from.call_one(
-                            src_ref,
-                            metas,
-                            src_hostname=self.volume_hostnames.get(src, ""),
-                            src_volume=src,
-                        )
-                    except Exception as exc:  # noqa: BLE001 - per-batch
-                        logger.warning(
-                            "auto-repair pull %s -> %s failed for %d "
-                            "key(s): %s",
-                            src, tgt, len(batch), exc,
-                        )
-                        continue
-                    gens = result.get("write_gens", {})
-                    touched = set()
-                    for key, kmetas, src_gen in batch:
-                        infos = self.index.get(key)
-                        cur = infos.get(src) if infos else None
-                        if cur is None or cur.write_gen != src_gen:
-                            # The key was overwritten/deleted while the
-                            # copy was in flight: the pulled bytes may be
-                            # stale — reclaim them on the target instead
-                            # of indexing (gen -1: resolve target-side).
-                            self._schedule_reclaim(tgt, {key: -1})
-                            continue
-                        info = infos.get(tgt)
-                        for m in kmetas:
-                            if info is None:
-                                info = infos[tgt] = StorageInfo.from_meta(m)
-                            else:
-                                info.merge(m)
-                        info.write_gen = max(
-                            info.write_gen, gens.get(key, 0)
-                        )
-                        touched.add(key)
-                        repaired += 1
-                    if touched:
-                        _AUTO_REPAIRS.inc(len(touched))
-                        self._placement_epoch += 1
-                        await self._bump(touched)
-                    await asyncio.sleep(0)  # yield between batches
+            repaired = await self.idx.auto_repair_pass(volume_id, healthy)
             if repaired:
                 logger.warning(
                     "auto-repair for quarantined volume %s: re-replicated "
@@ -2600,28 +2133,22 @@ class Controller(Actor):
             raise ValueError(f"unknown volume {volume_id!r}")
         self.volume_refs[volume_id] = new_ref
         self.volume_hostnames[volume_id] = hostname
-        recoverable: dict[str, Any] = {}
-        lost: list[str] = []
-        changed = set()
-        for key in list(self.index):
-            infos = self.index[key]
-            info = infos.pop(volume_id, None)
-            if info is None:
-                continue
-            changed.add(key)
-            if infos:
-                recoverable[key] = (
-                    list(info.tensor_slices.values())
-                    if info.object_type == ObjectType.TENSOR_SLICE
-                    else None
+        if self._shard_refs:
+            # Shards hold their own ref tables (reclaims, repair pulls):
+            # swap the replacement in everywhere before detaching entries.
+            import asyncio
+
+            await asyncio.gather(
+                *(
+                    ref.update_volume_ref.call_one(
+                        volume_id, new_ref, hostname
+                    )
+                    for ref in self._shard_refs
                 )
-            else:
-                lost.append(key)
-                self.index.pop(key, None)
-        self._placement_epoch += 1
-        if changed:
-            await self._bump(changed)
-        return {"recoverable": recoverable, "lost": lost}
+            )
+        result = await self.idx.detach_volume(volume_id)
+        self._bump_epoch()
+        return result
 
     @endpoint
     async def rebuild_index(self) -> int:
@@ -2644,44 +2171,18 @@ class Controller(Actor):
         survivors, dropped = resolve_manifests(
             list(zip(self.volume_refs.keys(), manifests))
         )
-        count = 0
-        for vid, meta, gen in survivors:
-            infos = self.index.get(meta.key)
-            if infos is None:
-                infos = {}
-                self.index[meta.key] = infos
-            info = infos.get(vid)
-            if info is None:
-                info = infos[vid] = StorageInfo.from_meta(meta)
-            else:
-                info.merge(meta)
-            # Live volumes report their in-memory write generation; keep it
-            # so conditional reclaims stay sound across controller
-            # restarts (a gen-0 entry could never be reclaimed).
-            info.write_gen = max(info.write_gen, gen)
-            count += 1
+        # Indexing + generation seeding live with the index (IndexCore.
+        # reindex seeds recovered keys at a random epoch offset so a
+        # surviving subscriber's pre-restart gen can never collide; the
+        # sharded authority partitions survivors to their owning shards).
+        count = await self.idx.reindex(survivors)
         if dropped:
             logger.warning(
                 "rebuild_index dropped %d superseded-layout shard(s); the "
                 "surviving layout may be partially committed until re-pushed",
                 dropped,
             )
-        # Seed update generations for every recovered key: a subscriber
-        # calling wait_for_change(key, 0) on a freshly-recovered store must
-        # see the existing version immediately, exactly as on a live store.
-        # Seeded at a RANDOM epoch offset, not 1: a surviving subscriber
-        # holds a pre-restart gen, and wait_for_change wakes on gen !=
-        # last_gen — seeding at small integers could collide with exactly
-        # the gen it last saw and block it through recovered versions.
-        import secrets
-
-        offset = secrets.randbits(46) | (1 << 45)
-        cond = self._cond()
-        async with cond:
-            for key in self.index:
-                self._key_gens[key] = offset
-            cond.notify_all()
-        self._placement_epoch += 1  # rebuilt routing invalidates all plans
+        self._bump_epoch()  # rebuilt routing invalidates all plans
         return count
 
     @endpoint
@@ -2690,36 +2191,13 @@ class Controller(Actor):
         ``include_volumes=True`` additionally fans out to every volume for
         its data-plane view (entries, stored bytes, SHM segment economics);
         unreachable volumes report an ``error`` string instead."""
-        indexed_bytes = 0
-        sharded_keys = 0
-        for infos in self.index.values():
-            key_is_sharded = False
-            for info in infos.values():
-                if info.object_type == ObjectType.TENSOR_SLICE:
-                    key_is_sharded = True
-                    itemsize = (
-                        info.tensor_meta.np_dtype.itemsize
-                        if info.tensor_meta is not None
-                        else 4
-                    )
-                    indexed_bytes += sum(
-                        ts.nelements * itemsize
-                        for ts in info.tensor_slices.values()
-                    )
-                elif info.tensor_meta is not None:
-                    indexed_bytes += info.tensor_meta.nbytes
-            sharded_keys += int(key_is_sharded)
+        # Index rollup (op counters, key/byte totals, pending reclaims)
+        # comes from the authority — summed across shards when sharded.
+        summary = await self.idx.summary()
         out = {
-            **self.counters,
-            "num_keys": len(self.index),
-            "sharded_keys": sharded_keys,
+            **summary,
             "num_volumes": len(self.volume_refs),
-            "indexed_bytes_approx": indexed_bytes,
-            "pending_reclaims": {
-                vid: len(keys)
-                for vid, keys in self._pending_reclaims.items()
-                if keys
-            },
+            "metadata_shards": len(self._shard_refs) or 1,
             # Health supervisor view (state/misses/oks per volume) — the
             # same data volume_health() serves, embedded for fleet scrapes.
             "volume_health": {
@@ -2774,7 +2252,24 @@ class Controller(Actor):
         self._prewarm_reservations.clear()
         self._expire_prewarm()  # zero the reserved-bytes gauges too
         self._streams.clear()
-        self.index = Trie()
+        if self._shard_refs:
+            # Shards unlink their stamped segments and cancel reclaim
+            # drainers; best-effort — a dead shard's segments are reaped
+            # with its process.
+            from torchstore_tpu.metadata.shards import RemoteIndex
+
+            if isinstance(self.idx, RemoteIndex):
+                await self.idx.teardown()
+            self._shard_refs = []
+            self._shard_stamped = []
+        if self._meta_writer is not None:
+            self._meta_writer.close()
+            self._meta_writer = None
+        if self.core.meta_writer is not None:
+            self.core.meta_writer.close()
+            self.core.meta_writer = None
+        self.core.teardown()
+        self.idx = self.core
         await asyncio.gather(
             *(ref.reset.call_one() for ref in self.volume_refs.values()),
             return_exceptions=True,

@@ -104,6 +104,7 @@ REGISTRY: frozenset[str] = frozenset(
     {
         "controller.notify",
         "controller.locate",
+        "controller.shard_dispatch",
         "volume.put",
         "volume.get",
         "volume.handshake",

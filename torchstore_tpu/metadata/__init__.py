@@ -1,0 +1,45 @@
+"""Scale-out metadata plane (ROADMAP item 4).
+
+The key -> volume index that used to live inline in the ``Controller``
+actor is owned here, in three pieces:
+
+- :mod:`index_core` — ``IndexCore``, the index-owning state machine
+  (StorageInfo maps, commit tracking, update generations, conditional
+  stale-replica reclaims). Exactly ONE process owns any given key's
+  entry: the classic single controller (shards=1), or one of N
+  ``ControllerShard`` actors partitioned by stable key hash.
+- :mod:`shards` — the ``ControllerShard`` actor hosting one partition,
+  plus ``RemoteIndex``, the coordinator-side fan-out authority whose
+  method surface matches ``IndexCore`` so every coordinator engine
+  (relay forwarding, auto-repair, tier sweeps, catalogs) runs unchanged
+  against local or sharded indexes.
+- :mod:`router` / :mod:`stamped` — the client side: a shard router that
+  fans batched metadata ops out per shard and merges replies, and the
+  one-sided stamped-segment readers that resolve warm-path metadata
+  (locate, plan validation, stream polling) with ZERO controller RPCs.
+
+The tslint ``shard-discipline`` rule enforces the ownership boundary:
+index-owning state is only ever touched inside this package.
+"""
+
+from torchstore_tpu.metadata.index_core import (  # noqa: F401
+    IndexCore,
+    ObjectType,
+    PartiallyCommittedError,
+    StorageInfo,
+    StoreKeyError,
+    resolve_manifests,
+    shard_of,
+)
+
+INDEX_OPS = frozenset(
+    {
+        "locate_volumes",
+        "contains",
+        "notify_put_batch",
+        "notify_delete_batch",
+        "keys",
+        "wait_for_committed",
+        "wait_for_change",
+    }
+)

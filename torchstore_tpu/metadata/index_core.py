@@ -1,0 +1,1091 @@
+"""The index-owning half of the metadata plane: ``IndexCore``.
+
+Everything that reads or writes the key -> {volume_id: StorageInfo} index
+lives HERE — commit tracking, update generations, layout invalidation,
+detach/supersede semantics, and the conditional stale-replica reclaim
+drainers. The classic single ``Controller`` hosts one core; a sharded
+metadata plane hosts one core per ``ControllerShard`` actor, partitioned
+by :func:`shard_of`. Either way, exactly one process owns a key's entry,
+so none of the single-writer invariants change with the topology.
+
+The host (Controller or ControllerShard) provides fleet context through a
+tiny duck-typed surface:
+
+- ``host.volume_refs`` / ``host.volume_hostnames``: live volume handles
+  (read dynamically — repair swaps refs underneath).
+- ``host.quarantined_ids()``: the health supervisor's current verdict
+  (pushed to shards on every transition).
+- ``await host.on_structural()``: a structural metadata change happened —
+  bump the placement epoch (locally on the coordinator, one RPC from a
+  shard). Returns the new epoch when known.
+
+tslint's ``shard-discipline`` rule forbids touching ``.index`` /
+``._key_gens`` outside this package: controller.py engines reach the
+index only through these methods (or their RemoteIndex fan-out twins).
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu import tiering
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.storage_utils.trie import Trie
+from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
+from torchstore_tpu.utils import spawn_logged
+
+logger = get_logger("torchstore_tpu.metadata")
+
+# Metadata-plane instruments (live in whichever process hosts the core —
+# the controller, or each shard; surfaced through ``stats()``/``summary``).
+_PUTS = obs_metrics.counter("ts_controller_puts_total", "Logical puts indexed")
+_PUT_BYTES = obs_metrics.counter(
+    "ts_controller_put_bytes_total", "Logical bytes indexed by puts"
+)
+_LOCATES = obs_metrics.counter("ts_controller_locates_total", "Keys located")
+_DELETES = obs_metrics.counter("ts_controller_deletes_total", "Keys deleted")
+_KEYS = obs_metrics.gauge("ts_controller_keys", "Keys currently indexed")
+_PENDING_RECLAIMS = obs_metrics.gauge(
+    "ts_controller_pending_reclaims",
+    "Stale-replica reclaims not yet drained, per volume",
+)
+_RECLAIMED = obs_metrics.counter(
+    "ts_controller_reclaimed_keys_total",
+    "Stale copies deleted by the background reclaim",
+)
+_AUTO_REPAIRS = obs_metrics.counter(
+    "ts_auto_repairs_total",
+    "Keys re-replicated automatically after a quarantine",
+)
+
+
+def shard_of(key: str, n_shards: int) -> int:
+    """Stable key -> shard assignment (crc32, not Python hash: every
+    process — clients, coordinator, shards — must agree across runs and
+    interpreters)."""
+    if n_shards <= 1:
+        return 0
+    return zlib.crc32(key.encode("utf-8", "replace")) % n_shards
+
+
+class ObjectType(Enum):
+    OBJECT = "object"
+    TENSOR = "tensor"
+    TENSOR_SLICE = "tensor_slice"
+
+
+def _object_type(meta: Request) -> ObjectType:
+    if meta.is_object:
+        return ObjectType.OBJECT
+    if meta.tensor_slice is not None:
+        return ObjectType.TENSOR_SLICE
+    return ObjectType.TENSOR
+
+
+class PartiallyCommittedError(KeyError):
+    pass
+
+
+class StoreKeyError(KeyError):
+    pass
+
+
+@dataclass
+class StorageInfo:
+    """What one volume holds for one key
+    (/root/reference/torchstore/controller.py:36-64)."""
+
+    object_type: ObjectType
+    tensor_meta: Optional[TensorMeta] = None
+    # coords -> TensorSlice, for TENSOR_SLICE keys.
+    tensor_slices: dict[tuple, TensorSlice] = field(default_factory=dict)
+    # The volume-assigned write generation of the newest put indexed here
+    # (volume-local timestamp; see StorageVolume._bump_write_gens). When
+    # this replica is later detached, the reclaim deletes its copy only if
+    # the volume's generation hasn't moved past this — an acknowledged put
+    # racing the reclaim can never lose its bytes (ADVICE r3).
+    write_gen: int = 0
+    # Capacity tier of this replica's bytes: ``tiering.RESIDENT`` (memory/
+    # tmpfs — the zero-copy warm path) or ``tiering.TIERED`` (demoted to
+    # the volume's disk spill tier; the next get faults it back in).
+    # Metadata only: placement and transports are tier-agnostic.
+    tier: str = tiering.RESIDENT
+
+    def merge(self, meta: Request) -> None:
+        incoming = _object_type(meta)
+        if incoming != self.object_type:
+            raise ValueError(
+                f"type confusion: stored {self.object_type} vs incoming {incoming}"
+            )
+        if meta.tensor_slice is not None:
+            self.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
+        if meta.tensor_meta is not None:
+            self.tensor_meta = meta.tensor_meta
+
+    @classmethod
+    def from_meta(cls, meta: Request) -> "StorageInfo":
+        info = cls(object_type=_object_type(meta), tensor_meta=meta.tensor_meta)
+        if meta.tensor_slice is not None:
+            info.tensor_slices[meta.tensor_slice.coordinates] = meta.tensor_slice
+        return info
+
+
+def resolve_manifests(
+    per_volume: list[tuple[str, list]],
+) -> tuple[list[tuple[str, Request, int]], int]:
+    """Resolve volume manifests into (volume_id, meta, write_gen) entries to
+    index, keeping only the NEWEST shard layout (by file mtime) when a key
+    carries mixed mesh/global shapes — see ``Controller.rebuild_index``.
+    Returns (survivors, dropped_count). Accepts bare ``Request`` items from
+    backends without mtimes (treated as mtime 0, write_gen 0)."""
+    entries: list[tuple[str, Request, Optional[tuple], int]] = []
+    layouts: dict[str, dict[tuple, float]] = {}  # key -> sig -> max mtime
+    for vid, manifest in per_volume:
+        for item in manifest:
+            if isinstance(item, dict):
+                meta, mtime = item["meta"], item.get("mtime", 0.0)
+                gen = item.get("write_gen", 0)
+            else:
+                meta, mtime, gen = item, 0.0, 0
+            sig = None
+            if meta.tensor_slice is not None:
+                ts = meta.tensor_slice
+                sig = (
+                    ts.mesh_shape,
+                    ts.global_shape,
+                    meta.tensor_meta.dtype if meta.tensor_meta else None,
+                )
+                sigs = layouts.setdefault(meta.key, {})
+                sigs[sig] = max(sigs.get(sig, 0.0), mtime)
+            entries.append((vid, meta, sig, gen))
+    winners = {
+        key: max(sigs, key=sigs.get)
+        for key, sigs in layouts.items()
+        if len(sigs) > 1
+    }
+    survivors: list[tuple[str, Request, int]] = []
+    dropped = 0
+    for vid, meta, sig, gen in entries:
+        if sig is not None and meta.key in winners and sig != winners[meta.key]:
+            dropped += 1
+            continue
+        survivors.append((vid, meta, gen))
+    return survivors, dropped
+
+
+class IndexCore:
+    def __init__(self, host) -> None:
+        self.host = host
+        self.index = Trie()  # key -> {volume_id: StorageInfo}
+        self.counters = {
+            "puts": 0,
+            "put_bytes": 0,
+            "locates": 0,
+            "deletes": 0,
+        }
+        # Per-key update generation + a condition notified on every index
+        # change: the substrate for wait_for_committed / wait_for_change.
+        self._key_gens: dict[str, int] = {}
+        self._update_cond: Optional[Any] = None  # lazily created on its loop
+        # Best-effort reclaims of stale copies on detached replicas:
+        # {key: stale write gen} pending per volume, ONE drainer task per
+        # volume, all cancelled at teardown.
+        self._pending_reclaims: dict[str, dict[str, int]] = {}
+        self._reclaim_running: set = set()
+        self._reclaim_tasks: set = set()
+        # One-sided stamped metadata publisher (metadata/stamped.py);
+        # attached by the host when enabled. Every index change marks it
+        # dirty; it republishes the committed view on a debounced cadence.
+        self.meta_writer = None
+
+    # ---- conditions / generations ---------------------------------------
+
+    def cond(self):
+        import asyncio
+
+        if self._update_cond is None:
+            self._update_cond = asyncio.Condition()
+        return self._update_cond
+
+    async def bump(self, keys) -> None:
+        cond = self.cond()
+        async with cond:
+            for key in keys:
+                self._key_gens[key] = self._key_gens.get(key, 0) + 1
+            cond.notify_all()
+        _KEYS.set(len(self.index))
+        self.mark_meta_dirty()
+
+    def mark_meta_dirty(self) -> None:
+        if self.meta_writer is not None:
+            self.meta_writer.mark_dirty()
+
+    # ---- commit tracking -------------------------------------------------
+
+    def committed_state(self, volume_infos: dict[str, StorageInfo]) -> str:
+        """'committed' | 'partial' for one key. A sharded key is fully
+        committed when stored coords across all volumes cover
+        product(mesh_shape) (/root/reference/torchstore/controller.py:66-104)."""
+        any_info = next(iter(volume_infos.values()))
+        if any_info.object_type != ObjectType.TENSOR_SLICE:
+            return "committed"
+        coords: set[tuple] = set()
+        mesh_shape: Optional[tuple] = None
+        for info in volume_infos.values():
+            coords.update(info.tensor_slices.keys())
+            for ts in info.tensor_slices.values():
+                mesh_shape = ts.mesh_shape
+        expected = math.prod(mesh_shape) if mesh_shape else 0
+        return "committed" if len(coords) >= expected else "partial"
+
+    def covers(
+        self,
+        subset: dict[str, StorageInfo],
+        full: dict[str, StorageInfo],
+    ) -> bool:
+        """Whether ``subset``'s replicas serve everything ``full``'s do.
+        Non-sharded entries are full copies, so any surviving replica
+        covers; sharded keys compare the UNION of stored coordinates."""
+        any_info = next(iter(full.values()))
+        if any_info.object_type != ObjectType.TENSOR_SLICE:
+            return True
+        sub_coords: set[tuple] = set()
+        for info in subset.values():
+            sub_coords.update(info.tensor_slices.keys())
+        full_coords: set[tuple] = set()
+        for info in full.values():
+            full_coords.update(info.tensor_slices.keys())
+        return sub_coords >= full_coords
+
+    def _serving_infos(
+        self, infos: dict[str, StorageInfo], quarantined: set
+    ) -> dict[str, StorageInfo]:
+        """The replica set a locate reports: quarantined replicas are
+        omitted whenever the healthy subset alone still serves everything
+        the full set does (shard-coordinate coverage, not just the coarse
+        committed/partial label). A quarantined volume holding the ONLY
+        copy stays listed: the client tries it and surfaces the real
+        failure rather than a bogus missing-key."""
+        if quarantined and any(vid in quarantined for vid in infos):
+            healthy = {
+                vid: info for vid, info in infos.items() if vid not in quarantined
+            }
+            if healthy and self.covers(healthy, infos):
+                return healthy
+        return infos
+
+    # ---- core ops --------------------------------------------------------
+
+    async def locate(
+        self,
+        keys: list[str],
+        missing_ok: bool = False,
+        require_fully_committed: bool = True,
+    ) -> dict[str, dict[str, StorageInfo]]:
+        await faults.afire("controller.locate")
+        self.counters["locates"] += len(keys)
+        _LOCATES.inc(len(keys))
+        quarantined = self.host.quarantined_ids()
+        out: dict[str, dict[str, StorageInfo]] = {}
+        for key in keys:
+            infos = self.index.get(key)
+            if infos is None:
+                if missing_ok:
+                    continue
+                raise StoreKeyError(f"Key {key!r} not found in store")
+            if require_fully_committed and self.committed_state(infos) == "partial":
+                raise PartiallyCommittedError(
+                    f"Key {key!r} is only partially committed; not all mesh "
+                    "coordinates have been stored yet"
+                )
+            out[key] = self._serving_infos(infos, quarantined)
+        return out
+
+    async def contains(self, key: str) -> str:
+        infos = self.index.get(key)
+        if infos is None:
+            return "missing"
+        return self.committed_state(infos)
+
+    async def keys_list(self, prefix: Optional[str] = None) -> list[str]:
+        if prefix is None:
+            return sorted(self.index)
+        return sorted(self.index.keys().filter_by_prefix(prefix))
+
+    async def count_prefix(self, prefix: str) -> int:
+        return sum(1 for _ in self.index.keys().filter_by_prefix(prefix))
+
+    async def apply_put_batch(
+        self,
+        metas: list[Request],
+        volume_ids: list[str],
+        detach_volume_ids: Optional[list[str]] = None,
+        write_gens: Optional[dict[str, dict[str, int]]] = None,
+        supersede: bool = False,
+    ) -> bool:
+        """Index ``metas`` as stored on every id in ``volume_ids`` — the
+        index half of ``notify_put_batch`` (see Controller.notify_put_batch
+        for the full contract). Detaches failed/superseded replicas in the
+        same step, schedules their conditional reclaims, and reports a
+        structural change through ``host.on_structural()``. The caller owns
+        the generation bump (the coordinator records stream watermarks
+        between indexing and the bump so no reader wakes early)."""
+        stale_gens: dict[str, dict[str, int]] = {}
+        structural = bool(detach_volume_ids)
+        for meta in metas:
+            if meta.tensor_val is not None or meta.objects is not None:
+                raise ValueError(
+                    "controller must never receive data payloads; send "
+                    "meta_only() requests"
+                )
+            infos = self.index.get(meta.key)
+            # Generations of copies indexed BEFORE this notify — the
+            # layout-invalidation wipe below must not erase them, or a
+            # detached replica's reclaim would never be scheduled and its
+            # stale old-layout bytes would stay readable via warm caches.
+            pre_gens = (
+                {vid: info.write_gen for vid, info in infos.items()}
+                if infos is not None
+                else {}
+            )
+            if infos is not None and meta.tensor_slice is not None:
+                # Re-publishing a key under a different layout (mesh shape or
+                # global shape changed) invalidates every previously indexed
+                # shard — otherwise stale old-layout shards would satisfy the
+                # commit check and be served alongside new data.
+                stale = False
+                for prev in infos.values():
+                    for ts in prev.tensor_slices.values():
+                        if (
+                            ts.mesh_shape != meta.tensor_slice.mesh_shape
+                            or ts.global_shape != meta.tensor_slice.global_shape
+                        ):
+                            stale = True
+                if stale:
+                    infos = None
+                    structural = True  # layout change re-routes every fetch
+            if infos is None:
+                infos = {}
+                self.index[meta.key] = infos
+                structural = True  # key newly (re)appears in the index
+            for vid in volume_ids:
+                info = infos.get(vid)
+                if info is None:
+                    info = infos[vid] = StorageInfo.from_meta(meta)
+                    structural = True  # new replica placement
+                else:
+                    if (
+                        meta.tensor_meta is not None
+                        and info.tensor_meta is not None
+                        and info.tensor_meta != meta.tensor_meta
+                    ):
+                        # Same key, different shape/dtype: any plan built
+                        # against the old meta would land wrong bytes.
+                        structural = True
+                    info.merge(meta)
+                # Fresh bytes always land in the memory tier (the volume
+                # discards any stale disk-tier copy in the same put).
+                info.tier = tiering.RESIDENT
+                if write_gens:
+                    info.write_gen = max(
+                        info.write_gen,
+                        write_gens.get(vid, {}).get(meta.key, 0),
+                    )
+            # Count as each entry indexes, so a mid-batch rejection leaves
+            # counters consistent with what actually landed in the index.
+            self.counters["puts"] += 1
+            _PUTS.inc()
+            if meta.tensor_meta is not None:
+                self.counters["put_bytes"] += meta.tensor_meta.nbytes
+                _PUT_BYTES.inc(meta.tensor_meta.nbytes)
+            for vid in detach_volume_ids or ():
+                # Capture the generation of the copy being detached BEFORE
+                # removing it — the reclaim may delete the replica's bytes
+                # only while its generation hasn't moved past this.
+                # pre_gens covers entries the layout-invalidation wipe
+                # already dropped from `infos`. A volume with NO prior
+                # indexed copy may still hold bytes from a PARTIAL batch
+                # landing (some requests landed before one failed): -1
+                # marks "generation unknown — resolve volume-side" so the
+                # reclaim's two-phase delete can still collect them.
+                prev = infos.get(vid)
+                if prev is not None:
+                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
+                elif vid in pre_gens:
+                    stale_gens.setdefault(vid, {})[meta.key] = pre_gens[vid]
+                else:
+                    stale_gens.setdefault(vid, {}).setdefault(meta.key, -1)
+                self.detach_meta(meta, vid)
+            if supersede:
+                # Full overwrite: volumes outside this put's replica set
+                # that still hold THIS meta (same coordinates for shards,
+                # the whole entry otherwise) now carry superseded bytes —
+                # detach them here, reclaim their bytes in the background.
+                for vid in [v for v in list(infos) if v not in volume_ids]:
+                    prev = infos.get(vid)
+                    if prev is None:
+                        continue
+                    if meta.tensor_slice is not None and (
+                        prev.object_type != ObjectType.TENSOR_SLICE
+                        or meta.tensor_slice.coordinates
+                        not in prev.tensor_slices
+                    ):
+                        continue  # holds other shards only: not superseded
+                    stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
+                    self.detach_meta(meta, vid)
+                    structural = True
+        if stale_gens:
+            # The detached replica may be wedged-but-ALIVE and still holding
+            # the old bytes: clients with warm location caches would read
+            # the stale value from it, and delete_batch fans out by index
+            # (which no longer lists it) so the bytes would never be
+            # reclaimed. Best-effort background conditional delete once
+            # it's reachable.
+            for vid, keys in stale_gens.items():
+                self.schedule_reclaim(vid, keys)
+        if structural:
+            await self.host.on_structural()
+        return structural
+
+    def count_deletes(self, n: int) -> None:
+        self.counters["deletes"] += n
+        _DELETES.inc(n)
+
+    def delete_keys(self, keys: list[str]) -> dict[str, list[str]]:
+        """Remove keys from the index; returns which volumes held each key
+        so the caller can clear the data plane. Idempotent. The caller
+        owns the structural report + generation bump (the coordinator
+        retires stream records between the two)."""
+        by_volume: dict[str, list[str]] = {}
+        for key in keys:
+            infos = self.index.pop(key, None)
+            if infos is None:
+                continue  # idempotent delete
+            for vid in infos:
+                by_volume.setdefault(vid, []).append(key)
+        return by_volume
+
+    # ---- blocking waits --------------------------------------------------
+
+    async def wait_for_committed(
+        self, keys: list[str], timeout: Optional[float] = None
+    ) -> None:
+        import asyncio
+
+        cond = self.cond()
+
+        def ready() -> bool:
+            for key in keys:
+                infos = self.index.get(key)
+                if infos is None or self.committed_state(infos) == "partial":
+                    return False
+            return True
+
+        async with cond:
+            try:
+                await asyncio.wait_for(cond.wait_for(ready), timeout)
+            except asyncio.TimeoutError:
+                missing = [
+                    k
+                    for k in keys
+                    if self.index.get(k) is None
+                    or self.committed_state(self.index.get(k)) == "partial"
+                ]
+                raise TimeoutError(
+                    f"wait_for_committed timed out after {timeout}s; still "
+                    f"missing/partial: {missing[:5]}"
+                ) from None
+
+    async def wait_for_change(
+        self, key: str, last_gen: int = 0, timeout: Optional[float] = None
+    ) -> dict[str, Any]:
+        import asyncio
+
+        cond = self.cond()
+        async with cond:
+            try:
+                await asyncio.wait_for(
+                    cond.wait_for(
+                        lambda: self._key_gens.get(key, 0) != last_gen
+                    ),
+                    timeout,
+                )
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"wait_for_change({key!r}) timed out after {timeout}s at "
+                    f"generation {self._key_gens.get(key, 0)}"
+                ) from None
+            infos = self.index.get(key)
+            state = (
+                "missing" if infos is None else self.committed_state(infos)
+            )
+            return {"gen": self._key_gens.get(key, 0), "state": state}
+
+    # ---- reclaims --------------------------------------------------------
+
+    def _reclaim_policy(self):
+        """The drainer's backoff schedule as a RetryPolicy (the unified
+        retry vocabulary — config.RetryPolicy). TORCHSTORE_TPU_RECLAIM_DELAYS
+        overrides the default 1,5,15,60 schedule; malformed values fall back
+        (a parse error must not kill the drainer — it would leave the
+        volume's running-flag set and wedge reclaims forever)."""
+        import os
+
+        from torchstore_tpu.config import RetryPolicy
+
+        # deadline_s=inf: the schedule length IS the attempt budget (the
+        # pre-policy drainer always ran every entry). A wall-clock deadline
+        # here would skip the long tail exactly when a slow-recovering
+        # volume makes each attempt's RPCs block until their own timeout —
+        # the case the 60 s entry exists for.
+        env = os.environ.get("TORCHSTORE_TPU_RECLAIM_DELAYS")
+        if env:
+            try:
+                return RetryPolicy.from_delays(
+                    env.split(","), deadline_s=float("inf")
+                )
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed TORCHSTORE_TPU_RECLAIM_DELAYS=%r", env
+                )
+        return RetryPolicy.from_delays(
+            (1.0, 5.0, 15.0, 60.0), deadline_s=float("inf")
+        )
+
+    def schedule_reclaim(self, volume_id: str, keys: dict[str, int]) -> None:
+        """``keys``: {key: stale write generation} — the generation of the
+        copy that was just detached (the newest bytes the reclaim is
+        allowed to delete)."""
+        pending = self._pending_reclaims.setdefault(volume_id, {})
+        for key, gen in keys.items():
+            # -1 = unknown generation (resolved volume-side at drain time);
+            # a known generation always wins over unknown.
+            pending[key] = max(pending[key], gen) if key in pending else gen
+        _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
+        if volume_id in self._reclaim_running:
+            return  # the volume's drainer picks the new keys up
+        self._reclaim_running.add(volume_id)
+        # A drainer that dies on an unexpected exception must be LOUD: the
+        # volume's running-flag was cleared in its finally, but the stale
+        # bytes stay resident until the next detach — spawn_logged retains
+        # the task and logs + counts the failure instead of dropping it.
+        spawn_logged(
+            self._reclaim_detached(volume_id),
+            name="controller.reclaim",
+            tasks=self._reclaim_tasks,
+            log=logger,
+        )
+
+    async def _reclaim_detached(self, volume_id: str) -> None:
+        """Drain the volume's pending stale keys once it recovers (ADVICE
+        r2). Keys re-indexed on the volume in the meantime are skipped (a
+        later put/repair re-replicated fresh bytes there). The delete is
+        CONDITIONAL on the stale write generation (ADVICE r3): a put
+        landing any time after the detach bumped the volume's generation,
+        so the volume keeps its bytes and reports them fresh — an
+        acknowledged overwrite can never be destroyed by a racing reclaim,
+        even at replication factor 1.
+
+        Keys scheduled with generation -1 (partial batch landings the
+        controller never saw a generation for) resolve in two phases: the
+        volume reports its CURRENT generation first, then the conditional
+        delete targets exactly the observed bytes — anything fresher that
+        lands during the RPC is kept. As the safety net for the residual
+        race (a delete landing while the bytes' notify is still in
+        flight), every completed delete is reconciled against the index:
+        if the index meanwhile claims this volume holds a deleted key, the
+        entry is detached loudly (degraded redundancy, healed by the next
+        publish) instead of pointing readers at missing bytes."""
+        import asyncio
+
+        try:
+            policy = self._reclaim_policy()
+            deadline = policy.start()
+            attempt = 0
+            while policy.should_retry(attempt, deadline):
+                await asyncio.sleep(policy.backoff(attempt))
+                attempt += 1
+                ref = self.host.volume_refs.get(volume_id)
+                pending = self._pending_reclaims.get(volume_id)
+                if ref is None or not pending:
+                    return
+                batch = {
+                    k: g
+                    for k, g in pending.items()
+                    if volume_id not in self.index.get(k, {})
+                }
+                for key in list(pending):
+                    if key not in batch:
+                        del pending[key]  # re-indexed keys: done
+                if not batch:
+                    return
+                unknown = sorted(k for k, g in batch.items() if g < 0)
+                try:
+                    if unknown:
+                        observed = await ref.write_gens.call_one(unknown)
+                        for key in unknown:
+                            if key in observed:
+                                batch[key] = observed[key]
+                            # Keys ABSENT from the reply stay in the batch at
+                            # gen -1: on a durable backend after a volume
+                            # restart, stale partial-landing bytes can exist
+                            # with no in-memory generation — dropping them
+                            # here would leave them readable via warm
+                            # location caches forever. delete_batch_if
+                            # deletes keys with no recorded generation, and
+                            # a put racing in records one and is kept
+                            # (ADVICE r4 carried fix).
+                        # Keys indexed on this volume while we fetched gens
+                        # are fresh again — drop them before deleting.
+                        for key in list(batch):
+                            if volume_id in self.index.get(key, {}):
+                                del batch[key]
+                        if not batch:
+                            continue
+                    result = await ref.delete_batch_if.call_one(
+                        sorted(batch.items())
+                    )
+                except Exception:  # noqa: BLE001 - still wedged/dead; retry
+                    continue
+                for key, sent_gen in batch.items():
+                    # A NEWER stale generation scheduled while the RPC was
+                    # in flight must survive for the next round — pop only
+                    # what this delete actually covered.
+                    if pending.get(key) in (sent_gen, -1):
+                        pending.pop(key, None)
+                for key, gen in result.get("kept_gens", {}).items():
+                    # Fresh bytes raced the reclaim. Normally the racing
+                    # put's notify (re)indexes this volume and the next
+                    # round filters the key out; if that notify never
+                    # arrives (client died between data-plane ack and
+                    # notify), the requeued generation reclaims the
+                    # orphaned bytes on a later round.
+                    pending[key] = max(pending.get(key, 0), gen)
+                if result["kept_fresh"]:
+                    logger.info(
+                        "reclaim on volume %s kept %d key(s) with fresh "
+                        "bytes (%s); re-verifying next round",
+                        volume_id,
+                        len(result["kept_fresh"]),
+                        result["kept_fresh"][:3],
+                    )
+                await self._reconcile_clobbered(volume_id, result["removed"])
+                _RECLAIMED.inc(len(result["removed"]))
+                _PENDING_RECLAIMS.set(len(pending), volume=volume_id)
+                logger.info(
+                    "reclaimed %d stale key(s) on detached volume %s",
+                    len(result["removed"]),
+                    volume_id,
+                )
+                if not pending:
+                    return
+            left = self._pending_reclaims.get(volume_id) or ()
+            if left:
+                logger.warning(
+                    "gave up reclaiming %d stale key(s) on volume %s "
+                    "(unreachable)",
+                    len(left),
+                    volume_id,
+                )
+        finally:
+            self._reclaim_running.discard(volume_id)
+            self._pending_reclaims.pop(volume_id, None)
+            _PENDING_RECLAIMS.set(0, volume=volume_id)
+
+    async def _reconcile_clobbered(
+        self, volume_id: str, removed_keys: list[str]
+    ) -> None:
+        """A reclaim delete whose key the index NOW claims this volume
+        holds means a racing put's bytes were destroyed before its notify
+        indexed them (the conditional delete narrows this to the
+        gen-read/delete window of two-phase unknown-generation reclaims).
+        Detach the entry so readers fail over / fail loudly instead of
+        routing to missing bytes; the next publish restores redundancy."""
+        clobbered = []
+        for key in removed_keys:
+            infos = self.index.get(key)
+            if infos is not None and volume_id in infos:
+                infos.pop(volume_id, None)
+                if not infos:
+                    self.index.pop(key, None)
+                clobbered.append(key)
+        if clobbered:
+            logger.warning(
+                "reclaim raced a fresh put on volume %s: detached %d "
+                "re-indexed key(s) it deleted (%s); redundancy degraded "
+                "until the next publish",
+                volume_id,
+                len(clobbered),
+                clobbered[:3],
+            )
+            await self.bump(set(clobbered))
+
+    def detach_meta(self, meta: Request, volume_id: str) -> None:
+        """Remove ONE meta's footprint on ``volume_id``: the exact shard
+        coords for sharded keys (sibling shards on the volume survive), the
+        whole entry for tensors/objects. A key with no volumes left
+        disappears; a sharded key missing coords reads as partial (loud)."""
+        infos = self.index.get(meta.key)
+        if infos is None or volume_id not in infos:
+            return
+        info = infos[volume_id]
+        if (
+            meta.tensor_slice is not None
+            and info.object_type == ObjectType.TENSOR_SLICE
+        ):
+            info.tensor_slices.pop(meta.tensor_slice.coordinates, None)
+            if info.tensor_slices:
+                return
+        del infos[volume_id]
+        if not infos:
+            self.index.pop(meta.key, None)
+
+    # ---- coordinator-engine services -------------------------------------
+    # The same surface RemoteIndex fans out over shards: relay forwarding,
+    # auto-repair, volume replacement, durable rebuild, tier sweeps, and
+    # the observability rollups all reach the index ONLY through these.
+
+    async def get_entry(self, key: str) -> Optional[dict[str, StorageInfo]]:
+        return self.index.get(key)
+
+    async def merge_copies(
+        self,
+        volume_id: str,
+        metas: list[Request],
+        write_gens: dict[str, int],
+    ) -> set:
+        """Index freshly pulled copies of ``metas`` on ``volume_id`` (relay
+        forwarding / targeted re-replication). Keys deleted mid-pull are
+        never re-indexed. New replica placement is structural, same rule as
+        apply_put_batch; the bump wakes relay-gated long-pollers."""
+        touched = set()
+        for meta in metas:
+            infos = self.index.get(meta.key)
+            if infos is None:
+                continue  # deleted mid-run: never re-index
+            info = infos.get(volume_id)
+            if info is None:
+                info = infos[volume_id] = StorageInfo.from_meta(meta)
+            else:
+                info.merge(meta)
+            info.write_gen = max(info.write_gen, write_gens.get(meta.key, 0))
+            touched.add(meta.key)
+        if touched:
+            await self.host.on_structural()
+            await self.bump(touched)
+        return touched
+
+    async def auto_repair_pass(
+        self, volume_id: str, healthy: list[str]
+    ) -> int:
+        """Re-replicate every key the quarantined volume held that still
+        has a healthy copy onto healthy volumes (volume-to-volume over the
+        RPC transport — no client involvement), restoring redundancy
+        without ts.repair(). Keys whose only copy lived on the quarantined
+        volume are skipped (nothing to copy from; ts.repair()/recover
+        remains the story for those). Raced overwrites are detected by
+        write-generation snapshot and the extra copy is reclaimed instead
+        of indexed, so a repaired replica can never serve stale bytes
+        under fresh metadata."""
+        import asyncio
+
+        if not healthy:
+            return 0
+        # Plan: (src, tgt) -> list of (key, meta-only Requests, src_gen).
+        plan: dict[tuple[str, str], list] = {}
+        rr = 0
+        for key in list(self.index):
+            infos = self.index.get(key)
+            if infos is None or volume_id not in infos:
+                continue
+            lost = infos[volume_id]
+            sources = [v for v in healthy if v in infos]
+            src = None
+            for cand in sources:
+                have = infos[cand]
+                if lost.object_type != have.object_type:
+                    continue
+                if lost.object_type == ObjectType.TENSOR_SLICE and not (
+                    set(lost.tensor_slices) <= set(have.tensor_slices)
+                ):
+                    continue  # survivor lacks some of the lost shards
+                src = cand
+                break
+            if src is None:
+                continue
+            targets = [v for v in healthy if v not in infos]
+            if not targets:
+                continue  # every healthy volume already holds a copy
+            tgt = sorted(targets)[rr % len(targets)]
+            rr += 1
+            if lost.object_type == ObjectType.OBJECT:
+                metas = [Request(key=key, is_object=True)]
+            elif lost.object_type == ObjectType.TENSOR:
+                metas = [Request(key=key, tensor_meta=lost.tensor_meta)]
+            else:
+                metas = [
+                    Request(
+                        key=key,
+                        tensor_slice=ts,
+                        tensor_meta=lost.tensor_meta,
+                    )
+                    for ts in lost.tensor_slices.values()
+                ]
+            plan.setdefault((src, tgt), []).append(
+                (key, metas, self.index[key][src].write_gen)
+            )
+        if not plan:
+            return 0
+        repaired = 0
+        for (src, tgt), items in plan.items():
+            src_ref = self.host.volume_refs.get(src)
+            tgt_ref = self.host.volume_refs.get(tgt)
+            if src_ref is None or tgt_ref is None:
+                continue
+            # Bounded batches: one pull RPC moves up to 64 keys.
+            for i in range(0, len(items), 64):
+                batch = items[i : i + 64]
+                metas = [m for _, ms, _ in batch for m in ms]
+                try:
+                    result = await tgt_ref.pull_from.call_one(
+                        src_ref,
+                        metas,
+                        src_hostname=self.host.volume_hostnames.get(src, ""),
+                        src_volume=src,
+                    )
+                except Exception as exc:  # noqa: BLE001 - per-batch
+                    logger.warning(
+                        "auto-repair pull %s -> %s failed for %d "
+                        "key(s): %s",
+                        src, tgt, len(batch), exc,
+                    )
+                    continue
+                gens = result.get("write_gens", {})
+                touched = set()
+                for key, kmetas, src_gen in batch:
+                    infos = self.index.get(key)
+                    cur = infos.get(src) if infos else None
+                    if cur is None or cur.write_gen != src_gen:
+                        # The key was overwritten/deleted while the
+                        # copy was in flight: the pulled bytes may be
+                        # stale — reclaim them on the target instead
+                        # of indexing (gen -1: resolve target-side).
+                        self.schedule_reclaim(tgt, {key: -1})
+                        continue
+                    info = infos.get(tgt)
+                    for m in kmetas:
+                        if info is None:
+                            info = infos[tgt] = StorageInfo.from_meta(m)
+                        else:
+                            info.merge(m)
+                    info.write_gen = max(
+                        info.write_gen, gens.get(key, 0)
+                    )
+                    touched.add(key)
+                    repaired += 1
+                if touched:
+                    _AUTO_REPAIRS.inc(len(touched))
+                    await self.host.on_structural()
+                    await self.bump(touched)
+                await asyncio.sleep(0)  # yield between batches
+        return repaired
+
+    async def detach_volume(self, volume_id: str) -> dict[str, Any]:
+        """Drop every index entry on ``volume_id`` (volume replacement).
+        Returns what it held so the repairer can re-replicate: see
+        Controller.replace_volume. The caller owns the structural report
+        (it also swaps the actor ref in the same step)."""
+        recoverable: dict[str, Any] = {}
+        lost: list[str] = []
+        changed = set()
+        for key in list(self.index):
+            infos = self.index[key]
+            info = infos.pop(volume_id, None)
+            if info is None:
+                continue
+            changed.add(key)
+            if infos:
+                recoverable[key] = (
+                    list(info.tensor_slices.values())
+                    if info.object_type == ObjectType.TENSOR_SLICE
+                    else None
+                )
+            else:
+                lost.append(key)
+                self.index.pop(key, None)
+        if changed:
+            await self.bump(changed)
+        return {"recoverable": recoverable, "lost": lost}
+
+    async def set_tiers(
+        self,
+        volume_id: str,
+        spilled: list[str],
+        fault_ins: list[str],
+    ) -> None:
+        """Fold one volume's reported spill/fault-in transitions into the
+        index's tier states. Metadata only — NOT structural: cached plans
+        keep serving the resident hot set."""
+        for key in spilled:
+            infos = self.index.get(key)
+            if infos is not None and volume_id in infos:
+                infos[volume_id].tier = tiering.TIERED
+        for key in fault_ins:
+            infos = self.index.get(key)
+            if infos is not None and volume_id in infos:
+                infos[volume_id].tier = tiering.RESIDENT
+
+    async def reindex(
+        self, survivors: list[tuple[str, Request, int]]
+    ) -> int:
+        """Rebuild this core's slice of the index from resolved volume
+        manifests (durable recovery). Seeds every recovered key's update
+        generation at a RANDOM epoch offset — a surviving subscriber holds
+        a pre-restart gen, and wait_for_change wakes on gen != last_gen,
+        so seeding at small integers could collide with exactly the gen it
+        last saw and block it through recovered versions."""
+        count = 0
+        for vid, meta, gen in survivors:
+            infos = self.index.get(meta.key)
+            if infos is None:
+                infos = {}
+                self.index[meta.key] = infos
+            info = infos.get(vid)
+            if info is None:
+                info = infos[vid] = StorageInfo.from_meta(meta)
+            else:
+                info.merge(meta)
+            # Live volumes report their in-memory write generation; keep it
+            # so conditional reclaims stay sound across controller
+            # restarts (a gen-0 entry could never be reclaimed).
+            info.write_gen = max(info.write_gen, gen)
+            count += 1
+        import secrets
+
+        offset = secrets.randbits(46) | (1 << 45)
+        cond = self.cond()
+        async with cond:
+            for key in self.index:
+                self._key_gens[key] = offset
+            cond.notify_all()
+        self.mark_meta_dirty()
+        return count
+
+    async def summary(self) -> dict:
+        """The index half of ``stats()``: op counters + index rollup.
+        Merged across shards by RemoteIndex.summary()."""
+        indexed_bytes = 0
+        sharded_keys = 0
+        for infos in self.index.values():
+            key_is_sharded = False
+            for info in infos.values():
+                if info.object_type == ObjectType.TENSOR_SLICE:
+                    key_is_sharded = True
+                    itemsize = (
+                        info.tensor_meta.np_dtype.itemsize
+                        if info.tensor_meta is not None
+                        else 4
+                    )
+                    indexed_bytes += sum(
+                        ts.nelements * itemsize
+                        for ts in info.tensor_slices.values()
+                    )
+                elif info.tensor_meta is not None:
+                    indexed_bytes += info.tensor_meta.nbytes
+            sharded_keys += int(key_is_sharded)
+        return {
+            **self.counters,
+            "num_keys": len(self.index),
+            "sharded_keys": sharded_keys,
+            "indexed_bytes_approx": indexed_bytes,
+            "pending_reclaims": {
+                vid: len(keys)
+                for vid, keys in self._pending_reclaims.items()
+                if keys
+            },
+        }
+
+    async def catalog(self, channel: Optional[str] = None) -> dict:
+        """This core's slice of the per-channel version inventory (see
+        Controller.version_catalog — leases are coordinator state and are
+        folded in there). ``volumes`` are sets here; the coordinator
+        normalizes after the cross-shard merge."""
+        out: dict[str, dict[int, dict]] = {}
+        for key in self.index:
+            group = tiering.version_group(key)
+            if group is None:
+                continue
+            chan, ver = group
+            if channel is not None and chan != channel:
+                continue
+            infos = self.index.get(key)
+            if not infos:
+                continue
+            rec = out.setdefault(chan, {}).setdefault(
+                ver,
+                {
+                    "keys": 0,
+                    "bytes": 0,
+                    "resident_keys": 0,
+                    "spilled_keys": 0,
+                    "volumes": set(),
+                    "leases": [],
+                },
+            )
+            rec["keys"] += 1
+            info = next(iter(infos.values()))
+            if info.object_type == ObjectType.TENSOR_SLICE:
+                itemsize = (
+                    info.tensor_meta.np_dtype.itemsize
+                    if info.tensor_meta is not None
+                    else 4
+                )
+                rec["bytes"] += sum(
+                    ts.nelements * itemsize
+                    for ts in info.tensor_slices.values()
+                )
+            elif info.tensor_meta is not None:
+                rec["bytes"] += info.tensor_meta.nbytes
+            if any(i.tier != tiering.TIERED for i in infos.values()):
+                rec["resident_keys"] += 1
+            else:
+                rec["spilled_keys"] += 1
+            rec["volumes"].update(infos)
+        return out
+
+    # ---- stamped metadata publication ------------------------------------
+
+    def meta_payload(self) -> dict:
+        """The one-sided view of this core's COMMITTED index: what a
+        same-host client needs to resolve locations with zero RPCs —
+        exactly what ``locate`` would answer (committed keys only,
+        quarantined replicas filtered under the same coverage rule).
+        Staleness is safe by construction: a missing key falls back to the
+        RPC locate, and a deleted key's stale entry fails at the volume
+        and retries through a fresh RPC locate — the same ladder a warm
+        client-side location cache already rides."""
+        quarantined = self.host.quarantined_ids()
+        out: dict[str, dict[str, StorageInfo]] = {}
+        for key in self.index:
+            infos = self.index.get(key)
+            if not infos or self.committed_state(infos) == "partial":
+                continue
+            out[key] = self._serving_infos(infos, quarantined)
+        return out
+
+    def teardown(self) -> None:
+        for task in list(self._reclaim_tasks):
+            task.cancel()
+        self._reclaim_tasks.clear()
+        self._reclaim_running.clear()
+        self._pending_reclaims.clear()
+        self._key_gens.clear()
+        self.index = Trie()
+        if self.meta_writer is not None:
+            self.meta_writer.mark_dirty()

@@ -1,0 +1,509 @@
+"""Client-side metadata router: shard fan-out + one-sided stamped reads.
+
+``MetadataRouter`` wraps the coordinator's ``ActorRef`` and presents the
+SAME endpoint-attribute surface (``router.locate_volumes.call_one(...)``),
+so every existing controller call site routes through it unchanged:
+
+- **Coordinator-scoped ops** (streams, leases, relay, health, epoch,
+  prewarm, stats, ...) pass straight through to the coordinator.
+- **Index-scoped ops** (``locate_volumes``/``notify_put_batch``/
+  ``notify_delete_batch``/``keys``/``contains`` and the blocking waits)
+  partition by stable key hash across the controller shards and merge the
+  replies. Stream watermarks are recorded on the coordinator strictly
+  AFTER every owning shard indexed its slice of the batch, and deletes
+  run the coordinator's lease guard first — cross-shard invariants always
+  route through the coordinator.
+- **Every controller RPC is counted** into the traffic ledger's metadata
+  cells (per op, per shard) so ``ts.traffic_matrix()["metadata"]`` makes
+  "zero metadata RPCs on the warm path" a measured assertion.
+
+The router also owns the client ends of the stamped metadata segments
+(metadata/stamped.py): same-host warm locates, placement-epoch
+confirmation, and streamed-publish polling serve from shared memory with
+zero controller RPCs, falling back loudly to the RPC path on torn/stale
+reads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.metadata import INDEX_OPS, shard_of
+from torchstore_tpu.metadata import stamped as stamped_mod
+from torchstore_tpu.metadata.shards import (
+    partition_keys,
+    partition_metas,
+    slice_write_gens,
+)
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.runtime import ActorRef
+
+logger = get_logger("torchstore_tpu.metadata.router")
+
+_META_RPCS = obs_metrics.counter(
+    "ts_meta_rpcs_total",
+    "Controller metadata RPCs issued by this client, by op",
+)
+
+COORD = "coord"
+
+
+def _count_rpc(op: str, shard: str = COORD) -> None:
+    _META_RPCS.inc(op=op)
+    ledger = obs_ledger.ledger()
+    if ledger.enabled:
+        ledger.record(obs_ledger.METADATA, "rpc", 0, peer_host=op, volume=shard)
+
+
+def count_stamped(op: str, shard: str = COORD) -> None:
+    stamped_mod.STAMPED_READS.inc(op=op)
+    ledger = obs_ledger.ledger()
+    if ledger.enabled:
+        ledger.record(
+            obs_ledger.METADATA, "stamped", 0, peer_host=op, volume=shard
+        )
+
+
+class _RoutedOp:
+    """One endpoint handle off the router — the ``ActorEndpointRef``
+    surface (``call_one``/``call``/``with_timeout``) over routed dispatch."""
+
+    __slots__ = ("_router", "_op", "_timeout")
+
+    def __init__(self, router: "MetadataRouter", op: str, timeout=None):
+        self._router = router
+        self._op = op
+        self._timeout = timeout
+
+    def with_timeout(self, timeout) -> "_RoutedOp":
+        return _RoutedOp(self._router, self._op, timeout)
+
+    async def call_one(self, *args, **kwargs) -> Any:
+        return await self._router._dispatch(
+            self._op, self._timeout, args, kwargs
+        )
+
+    async def call(self, *args, **kwargs) -> Any:
+        return await self.call_one(*args, **kwargs)
+
+
+class MetadataRouter:
+    """See module docstring. Construct over the coordinator ref; call
+    ``load_topology()`` once per volume-map (re)load to discover shards
+    and attach same-host stamped segments."""
+
+    def __init__(self, coordinator: ActorRef) -> None:
+        self._coordinator = coordinator
+        self.shard_refs: list[ActorRef] = []
+        self.n_shards = 1
+        self._rpc_timeout: Optional[float] = None
+        # Stamped same-host attachments (None until load_topology finds a
+        # co-located publisher): per-index-host readers + the coordinator's
+        # stream/epoch segment.
+        self._index_readers: list[Optional[stamped_mod.MetaStampReader]] = []
+        self._stream_reader: Optional[stamped_mod.MetaStampReader] = None
+
+    # -- ActorRef compatibility -------------------------------------------
+
+    @property
+    def coordinator(self) -> ActorRef:
+        return self._coordinator
+
+    # ActorRef introspection passthroughs (tests/tools read the
+    # coordinator's address off the client's controller handle).
+    @property
+    def host(self) -> str:
+        return self._coordinator.host
+
+    @property
+    def port(self) -> int:
+        return self._coordinator.port
+
+    @property
+    def name(self) -> str:
+        return self._coordinator.name
+
+    @property
+    def rpc_timeout(self) -> Optional[float]:
+        return self._rpc_timeout
+
+    @rpc_timeout.setter
+    def rpc_timeout(self, value) -> None:
+        self._rpc_timeout = value
+        self._coordinator.rpc_timeout = value
+        for ref in self.shard_refs:
+            ref.rpc_timeout = value
+
+    def __getattr__(self, op: str) -> _RoutedOp:
+        if op.startswith("_"):
+            raise AttributeError(op)
+        return _RoutedOp(self, op)
+
+    async def ping(self) -> bool:
+        return await self._coordinator.ping()
+
+    # -- topology ----------------------------------------------------------
+
+    async def load_topology(self, meta_stamped: bool = True) -> None:
+        """Fetch the metadata-plane topology from the coordinator: shard
+        refs for fan-out routing, and stamped-segment descriptors for the
+        one-sided path (attached only when the publisher is on THIS
+        host). Safe to call repeatedly (volume-map refreshes)."""
+        topo = await self._coordinator.metadata_topology.call_one()
+        self.shard_refs = list(topo.get("shards") or [])
+        self.n_shards = max(1, len(self.shard_refs))
+        if self._rpc_timeout is not None:
+            for ref in self.shard_refs:
+                ref.rpc_timeout = self._rpc_timeout
+        for reader in self._index_readers:
+            if reader is not None:
+                reader.close()
+        self._index_readers = []
+        if self._stream_reader is not None:
+            self._stream_reader.close()
+        self._stream_reader = None
+        if not (meta_stamped and stamped_mod.enabled()):
+            return
+        from torchstore_tpu.utils import get_hostname
+
+        local = get_hostname()
+
+        def _attach(desc) -> Optional[stamped_mod.MetaStampReader]:
+            if not desc or desc.get("hostname") != local:
+                return None
+            try:
+                return stamped_mod.MetaStampReader(
+                    desc["segment"], desc["size"]
+                )
+            except OSError:
+                return None  # publisher gone / cross-mount: RPC serves
+
+        st = topo.get("stamped") or {}
+        self._stream_reader = _attach(st.get("coordinator"))
+        self._index_readers = [_attach(d) for d in st.get("index") or []]
+
+    def _index_reader(
+        self, key: str
+    ) -> Optional[stamped_mod.MetaStampReader]:
+        if not self._index_readers:
+            return None
+        idx = shard_of(key, len(self._index_readers))
+        return self._index_readers[idx]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _coord_ep(self, op: str, timeout):
+        ep = getattr(self._coordinator, op)
+        if timeout is not None:
+            ep = ep.with_timeout(timeout)
+        return ep
+
+    def _shard_ep(self, idx: int, op: str, timeout):
+        ep = getattr(self.shard_refs[idx], op)
+        if timeout is not None:
+            ep = ep.with_timeout(timeout)
+        return ep
+
+    async def _dispatch(self, op: str, timeout, args, kwargs) -> Any:
+        if self.shard_refs and op in INDEX_OPS:
+            return await self._dispatch_sharded(op, timeout, args, kwargs)
+        _count_rpc(op)
+        return await self._coord_ep(op, timeout).call_one(*args, **kwargs)
+
+    async def _dispatch_sharded(self, op: str, timeout, args, kwargs) -> Any:
+        if op == "locate_volumes":
+            keys = args[0] if args else kwargs.pop("keys")
+            parts = partition_keys(keys, self.n_shards)
+            calls = []
+            for i, ks in parts.items():
+                _count_rpc(op, f"s{i}")
+                calls.append(
+                    self._shard_ep(i, "locate_volumes", timeout).call_one(
+                        ks, *args[1:], **kwargs
+                    )
+                )
+            merged: dict = {}
+            for part in await asyncio.gather(*calls):
+                merged.update(part)
+            return merged
+        if op == "contains":
+            key = args[0] if args else kwargs["key"]
+            i = shard_of(key, self.n_shards)
+            _count_rpc(op, f"s{i}")
+            return await self._shard_ep(i, "contains", timeout).call_one(
+                *args, **kwargs
+            )
+        if op == "keys":
+            calls = []
+            for i in range(self.n_shards):
+                _count_rpc(op, f"s{i}")
+                calls.append(
+                    self._shard_ep(i, "keys", timeout).call_one(
+                        *args, **kwargs
+                    )
+                )
+            results = await asyncio.gather(*calls)
+            return sorted(k for part in results for k in part)
+        if op == "wait_for_committed":
+            keys = args[0] if args else kwargs.pop("keys")
+            rest = args[1:]
+            parts = partition_keys(keys, self.n_shards)
+            calls = []
+            for i, ks in parts.items():
+                _count_rpc(op, f"s{i}")
+                calls.append(
+                    self._shard_ep(i, "wait_for_committed", timeout).call_one(
+                        ks, *rest, **kwargs
+                    )
+                )
+            await asyncio.gather(*calls)
+            return None
+        if op == "wait_for_change":
+            key = args[0] if args else kwargs["key"]
+            i = shard_of(key, self.n_shards)
+            _count_rpc(op, f"s{i}")
+            return await self._shard_ep(i, "wait_for_change", timeout).call_one(
+                *args, **kwargs
+            )
+        if op == "notify_put_batch":
+            return await self._notify_sharded(timeout, *args, **kwargs)
+        if op == "notify_delete_batch":
+            return await self._delete_sharded(timeout, *args, **kwargs)
+        raise RuntimeError(f"unrouted sharded metadata op {op!r}")
+
+    async def _notify_sharded(
+        self,
+        timeout,
+        metas,
+        volume_id,
+        detach_volume_ids=None,
+        write_gens=None,
+        supersede: bool = False,
+        watermark=None,
+        unchanged=None,
+    ) -> Optional[int]:
+        """Sharded notify: each owning shard indexes its slice (and runs
+        the detach/supersede/reclaim machinery for it); the stream
+        watermark is recorded on the coordinator ONLY after every shard
+        acked — same bytes-committed-before-watermark-visible ordering as
+        the single-actor step, with the indexing now parallel."""
+        if unchanged and watermark is None:
+            raise ValueError(
+                "notify_put_batch(unchanged=...) requires watermark=: "
+                "unchanged-key aliases are a streamed-publish protocol"
+            )
+        parts = partition_metas(metas, self.n_shards)
+        calls = []
+        for i, ms in parts.items():
+            _count_rpc("notify_put_batch", f"s{i}")
+            calls.append(
+                self._shard_ep(i, "notify_put_batch", timeout).call_one(
+                    ms,
+                    volume_id,
+                    detach_volume_ids=detach_volume_ids,
+                    write_gens=slice_write_gens(
+                        write_gens, {m.key for m in ms}
+                    ),
+                    supersede=supersede,
+                )
+            )
+        epochs = [e for e in await asyncio.gather(*calls) if e is not None]
+        if watermark is not None:
+            stream_key, version = watermark
+            volume_ids = (
+                [volume_id] if isinstance(volume_id, str) else list(volume_id)
+            )
+            _count_rpc("stream_watermark")
+            await self._coord_ep("stream_watermark", timeout).call_one(
+                stream_key,
+                int(version),
+                metas,
+                volume_ids,
+                unchanged,
+            )
+        return max(epochs) if epochs else None
+
+    async def _delete_sharded(self, timeout, keys) -> dict[str, list[str]]:
+        """Sharded delete: coordinator lease guard FIRST (the never-reaped-
+        mid-read guarantee is fleet-scoped), then each owning shard drops
+        its slice, then the coordinator retires stream records for what
+        actually disappeared."""
+        _count_rpc("delete_guard")
+        passed = await self._coord_ep("delete_guard", timeout).call_one(keys)
+        parts = partition_keys(passed, self.n_shards)
+        calls = []
+        for i, ks in parts.items():
+            _count_rpc("notify_delete_batch", f"s{i}")
+            calls.append(
+                self._shard_ep(i, "delete_keys", timeout).call_one(ks)
+            )
+        merged: dict[str, list[str]] = {}
+        for part in await asyncio.gather(*calls):
+            for vid, vkeys in part.items():
+                merged.setdefault(vid, []).extend(vkeys)
+        deleted = sorted({k for vkeys in merged.values() for k in vkeys})
+        if deleted:
+            _count_rpc("delete_finish")
+            await self._coord_ep("delete_finish", timeout).call_one(deleted)
+        return merged
+
+    # -- one-sided stamped reads ------------------------------------------
+
+    def stamped_locate(
+        self, keys: list[str]
+    ) -> Optional[dict[str, dict]]:
+        """Resolve committed locations for ``keys`` from the stamped index
+        segments — zero RPCs. Returns {key: infos} for the subset found
+        (missing keys fall back to the RPC locate), or None when no
+        stamped index is attached. Staleness rides the exact ladder the
+        warm location cache already does: a deleted key's lingering entry
+        fails at the volume and the fetch retries with a fresh RPC locate."""
+        if not self._index_readers or not any(self._index_readers):
+            return None
+        out: dict[str, dict] = {}
+        payloads: dict[int, Any] = {}
+        n = len(self._index_readers)
+        for key in keys:
+            idx = shard_of(key, n)
+            reader = self._index_readers[idx]
+            if reader is None:
+                continue
+            if idx not in payloads:
+                try:
+                    _, payload, _ = reader.read()
+                except stamped_mod.MetaUnavailable as exc:
+                    stamped_mod.STAMPED_FALLBACKS.inc(reason=exc.reason)
+                    if exc.reason in ("tombstone", "gone"):
+                        self._index_readers[idx] = None
+                    payloads[idx] = None
+                    continue
+                payloads[idx] = payload
+            payload = payloads[idx]
+            if payload is None:
+                continue
+            infos = payload.get(key)
+            if infos is not None:
+                out[key] = infos
+                count_stamped(
+                    "locate_volumes", f"s{idx}" if self.shard_refs else COORD
+                )
+        return out or None
+
+    def stamped_epoch(self) -> Optional[int]:
+        """The placement epoch from the coordinator's stamped header —
+        the zero-RPC half of warm plan validation. None when unattached
+        or torn (the caller pays the RPC)."""
+        if self._stream_reader is None:
+            return None
+        try:
+            return self._stream_reader.epoch()
+        except stamped_mod.MetaUnavailable as exc:
+            stamped_mod.STAMPED_FALLBACKS.inc(reason=exc.reason)
+            if exc.reason in ("tombstone", "gone"):
+                self._stream_reader = None
+            return None
+
+    async def stamped_wait_stream(
+        self,
+        key: str,
+        version: int,
+        known: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Optional[dict]:
+        """One-sided ``wait_for_stream``: poll the coordinator's stamped
+        stream snapshot until progress (same view shape and timeout
+        semantics as the RPC long-poll). Returns None when no stamped
+        segment is attached — the caller long-polls over RPC. Staleness is
+        one-directional (the snapshot can only lag), so ``superseded``/
+        ``ready`` are never reported spuriously; a record the caller KNOWS
+        exists but the snapshot hasn't caught up with is polled through a
+        short grace window before reporting missing."""
+        reader = self._stream_reader
+        if reader is None:
+            return None
+        version = int(version)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Missing-record grace: the caller usually confirmed the record
+        # exists via stream_state (RPC) — a missing entry here is almost
+        # always publish lag, worth a few intervals before giving up. The
+        # writer's debounce is ADAPTIVE (duty-cycle capped), so lag can
+        # exceed any fixed window: past the grace, report missing ONLY
+        # when the snapshot demonstrably refreshed since entry (its
+        # publish generation moved) and STILL lacks the record; a snapshot
+        # that never refreshed may simply be stale — stand down to the
+        # RPC long-poll for the authoritative answer instead of burning a
+        # restart attempt on a healthy stream.
+        grace = time.monotonic() + max(
+            0.05, 4 * stamped_mod.publish_interval_s()
+        )
+        entry_gen = reader.generation()
+        sleep_s = 0.001
+        served_once = False
+        while True:
+            try:
+                gen, payload, _ = reader.read()
+            except stamped_mod.MetaUnavailable as exc:
+                stamped_mod.STAMPED_FALLBACKS.inc(reason=exc.reason)
+                if exc.reason in ("tombstone", "gone"):
+                    if self._stream_reader is reader:
+                        self._stream_reader = None
+                return None
+            rec = (payload.get("streams") or {}).get(key)
+            if rec is None:
+                if known < 0 or time.monotonic() < grace:
+                    pass  # keep polling: awaited record / publish lag
+                elif entry_gen is None or gen == entry_gen:
+                    stamped_mod.STAMPED_FALLBACKS.inc(reason="stale_snapshot")
+                    return None  # possibly stale: the RPC owns the verdict
+                else:
+                    count_stamped("wait_for_stream")
+                    return {
+                        "missing": True,
+                        "version": 0,
+                        "sealed": False,
+                        "superseded": False,
+                        "ready": [],
+                        "watermarks": {},
+                        "aliases": {},
+                        "quant": None,
+                    }
+            else:
+                if known < 0:
+                    served_once = True
+                view = self._stream_view(rec, version)
+                if (
+                    served_once
+                    or len(view["ready"]) > known
+                    or view["sealed"]
+                    or view["superseded"]
+                ):
+                    count_stamped("wait_for_stream")
+                    return view
+            if deadline is not None and time.monotonic() >= deadline:
+                count_stamped("wait_for_stream")
+                raise TimeoutError(
+                    f"wait_for_stream({key!r}, v{version}) timed out after "
+                    f"{timeout}s with {known} key(s) already served"
+                )
+            await asyncio.sleep(sleep_s)
+            sleep_s = min(0.02, sleep_s * 1.6)
+
+    @staticmethod
+    def _stream_view(rec: dict, version: int) -> dict:
+        marks = rec.get("watermarks") or {}
+        ready = {k: v for k, v in marks.items() if v >= version}
+        rec_aliases = rec.get("aliases") or {}
+        return {
+            "missing": False,
+            "version": rec["version"],
+            "sealed": rec["sealed"] >= version,
+            "superseded": rec["version"] > version,
+            "ready": sorted(ready),
+            "watermarks": ready,
+            "aliases": {k: rec_aliases[k] for k in ready if k in rec_aliases},
+            "quant": rec.get("quant"),
+        }

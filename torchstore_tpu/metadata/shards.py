@@ -1,0 +1,515 @@
+"""Controller shard actors + the coordinator's fan-out index authority.
+
+``ControllerShard`` hosts one hash partition of the key -> volume index
+(an :class:`~torchstore_tpu.metadata.index_core.IndexCore`): clients route
+``locate/notify/delete/keys/contains`` and the blocking waits straight to
+the owning shard (see metadata/router.py), so metadata throughput scales
+with shard count instead of funneling through one actor queue. Fleet-
+scoped state (placement epoch, health supervisor, streams/relay/leases,
+strategy) stays on the tiny coordinator — cross-shard invariants route
+through it: a shard reports every STRUCTURAL index change with one
+``bump_placement_epoch`` RPC before acking its notify, the coordinator
+pushes quarantine transitions back down, and stream watermarks are
+recorded by the coordinator strictly AFTER the owning shards indexed the
+batch (so a watermark is never visible before its bytes' metadata).
+
+``RemoteIndex`` gives the coordinator's engines (relay forwarding,
+auto-repair, tier sweeps, catalogs, rebuild) the same method surface as a
+local ``IndexCore``, fanned out over the shard fleet — one code path
+whatever the topology.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.metadata.index_core import IndexCore, shard_of
+from torchstore_tpu.runtime import Actor, ActorRef, endpoint
+from torchstore_tpu.transport.types import Request
+
+logger = get_logger("torchstore_tpu.metadata.shards")
+
+
+def partition_keys(keys, n_shards: int) -> dict[int, list]:
+    out: dict[int, list] = {}
+    for key in keys:
+        out.setdefault(shard_of(key, n_shards), []).append(key)
+    return out
+
+
+def partition_metas(metas: list[Request], n_shards: int) -> dict[int, list]:
+    out: dict[int, list] = {}
+    for meta in metas:
+        out.setdefault(shard_of(meta.key, n_shards), []).append(meta)
+    return out
+
+
+def slice_write_gens(
+    write_gens: Optional[dict[str, dict[str, int]]], keys: set
+) -> Optional[dict[str, dict[str, int]]]:
+    """Restrict {volume_id: {key: gen}} to one shard's keys."""
+    if not write_gens:
+        return write_gens
+    return {
+        vid: {k: g for k, g in gens.items() if k in keys}
+        for vid, gens in write_gens.items()
+    }
+
+
+class ControllerShard(Actor):
+    """One partition of the metadata index. Spawned by ``ts.initialize(
+    controller_shards=N)``; wired by the coordinator's ``attach_shards``."""
+
+    def __init__(self) -> None:
+        self.core = IndexCore(self)
+        self.shard_id = 0
+        self.n_shards = 1
+        self.coordinator: Optional[ActorRef] = None
+        self.volume_refs: dict[str, ActorRef] = {}
+        self.volume_hostnames: dict[str, str] = {}
+        self._quarantined: set = set()
+        self._last_epoch: Optional[int] = None
+
+    # ---- IndexCore host surface ------------------------------------------
+
+    def quarantined_ids(self) -> set:
+        return set(self._quarantined)
+
+    async def on_structural(self) -> Optional[int]:
+        """A structural index change on this shard invalidates fleet-wide
+        plans: report it to the coordinator BEFORE acking the client, so
+        by the time a publisher sees its notify reply the epoch has moved.
+        A dead coordinator fails the notify loudly — indexing without the
+        epoch bump would let stale plans validate forever."""
+        if self.coordinator is None:
+            return None
+        self._last_epoch = await self.coordinator.bump_placement_epoch.call_one()
+        return self._last_epoch
+
+    # ---- bootstrap -------------------------------------------------------
+
+    @endpoint
+    async def shard_init(
+        self,
+        shard_id: int,
+        n_shards: int,
+        coordinator: ActorRef,
+        volume_refs: dict[str, ActorRef],
+        volume_hostnames: dict[str, str],
+        quarantined: Optional[list[str]] = None,
+    ) -> dict[str, Any]:
+        """Adopt this shard's slot in the fleet; idempotent across store
+        re-initialization (the core resets with it). Returns the shard's
+        stamped-segment descriptor for the coordinator's topology."""
+        self.core.teardown()
+        self.core = IndexCore(self)
+        self.shard_id = int(shard_id)
+        self.n_shards = int(n_shards)
+        self.coordinator = coordinator
+        self.volume_refs = dict(volume_refs)
+        self.volume_hostnames = dict(volume_hostnames)
+        self._quarantined = set(quarantined or ())
+        from torchstore_tpu.metadata import stamped as stamped_mod
+
+        desc = None
+        if stamped_mod.enabled():
+            self.core.meta_writer = stamped_mod.MetaStampWriter(
+                self.core.meta_payload
+            )
+            desc = self.core.meta_writer.describe()
+        from torchstore_tpu.observability import recorder as obs_recorder
+
+        obs_recorder.recorder().arm_exit_dump()
+        return {"shard_id": self.shard_id, "stamped": desc}
+
+    @endpoint
+    async def set_quarantined(self, volume_ids: list[str]) -> None:
+        """Health-supervisor push from the coordinator: locates filter the
+        new quarantine picture immediately, and the stamped index
+        republishes so one-sided readers see it too."""
+        self._quarantined = set(volume_ids)
+        self.core.mark_meta_dirty()
+
+    @endpoint
+    async def update_volume_ref(
+        self, volume_id: str, ref: ActorRef, hostname: str
+    ) -> None:
+        self.volume_refs[volume_id] = ref
+        self.volume_hostnames[volume_id] = hostname
+
+    # ---- client-routed index ops -----------------------------------------
+
+    @endpoint
+    async def locate_volumes(
+        self,
+        keys: list[str],
+        missing_ok: bool = False,
+        require_fully_committed: bool = True,
+    ):
+        await faults.afire("controller.shard_dispatch")
+        return await self.core.locate(keys, missing_ok, require_fully_committed)
+
+    @endpoint
+    async def contains(self, key: str) -> str:
+        await faults.afire("controller.shard_dispatch")
+        return await self.core.contains(key)
+
+    @endpoint
+    async def notify_put_batch(
+        self,
+        metas: list[Request],
+        volume_id,
+        detach_volume_ids: Optional[list[str]] = None,
+        write_gens: Optional[dict[str, dict[str, int]]] = None,
+        supersede: bool = False,
+    ) -> Optional[int]:
+        """The shard half of a notify: index + detach + reclaim scheduling
+        for THIS shard's keys. Stream watermarks never reach a shard — the
+        router records them on the coordinator after every owning shard
+        acked (bytes-committed before watermark-visible, as ever). Returns
+        the fresh placement epoch after a structural change (learned from
+        the coordinator in the same dispatch), else None."""
+        await faults.afire("controller.shard_dispatch")
+        await faults.afire("controller.notify")
+        volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
+        structural = await self.core.apply_put_batch(
+            metas,
+            volume_ids,
+            detach_volume_ids=detach_volume_ids,
+            write_gens=write_gens,
+            supersede=supersede,
+        )
+        await self.core.bump({meta.key for meta in metas})
+        return self._last_epoch if structural else None
+
+    @endpoint
+    async def delete_keys(self, keys: list[str]) -> dict[str, list[str]]:
+        """Index-drop for this shard's keys (the router already ran the
+        coordinator's lease guard). Deletions are structural."""
+        await faults.afire("controller.shard_dispatch")
+        self.core.count_deletes(len(keys))
+        by_volume = self.core.delete_keys(keys)
+        deleted = {k for vkeys in by_volume.values() for k in vkeys}
+        if deleted:
+            await self.on_structural()
+            await self.core.bump(deleted)
+        return by_volume
+
+    @endpoint
+    async def keys(self, prefix: Optional[str] = None) -> list[str]:
+        await faults.afire("controller.shard_dispatch")
+        return await self.core.keys_list(prefix)
+
+    @endpoint
+    async def count_prefix(self, prefix: str) -> int:
+        return await self.core.count_prefix(prefix)
+
+    @endpoint
+    async def wait_for_committed(
+        self, keys: list[str], timeout: Optional[float] = None
+    ) -> None:
+        await self.core.wait_for_committed(keys, timeout)
+
+    @endpoint
+    async def wait_for_change(
+        self, key: str, last_gen: int = 0, timeout: Optional[float] = None
+    ) -> dict[str, Any]:
+        return await self.core.wait_for_change(key, last_gen, timeout)
+
+    # ---- coordinator-engine services -------------------------------------
+
+    @endpoint
+    async def index_get(self, key: str):
+        return await self.core.get_entry(key)
+
+    @endpoint
+    async def merge_copies(
+        self, volume_id: str, metas: list[Request], write_gens: dict[str, int]
+    ) -> list[str]:
+        return sorted(await self.core.merge_copies(volume_id, metas, write_gens))
+
+    @endpoint
+    async def auto_repair(self, volume_id: str, healthy: list[str]) -> int:
+        return await self.core.auto_repair_pass(volume_id, healthy)
+
+    @endpoint
+    async def detach_volume(self, volume_id: str) -> dict[str, Any]:
+        result = await self.core.detach_volume(volume_id)
+        await self.on_structural()
+        return result
+
+    @endpoint
+    async def set_tiers(
+        self, volume_id: str, spilled: list[str], fault_ins: list[str]
+    ) -> None:
+        await self.core.set_tiers(volume_id, spilled, fault_ins)
+
+    @endpoint
+    async def reindex(self, survivors: list) -> int:
+        count = await self.core.reindex(survivors)
+        await self.on_structural()
+        return count
+
+    @endpoint
+    async def summary(self) -> dict:
+        return await self.core.summary()
+
+    @endpoint
+    async def catalog(self, channel: Optional[str] = None) -> dict:
+        return await self.core.catalog(channel)
+
+    @endpoint
+    async def meta_flush(self) -> None:
+        """Publish the stamped index NOW (tests/benches pin down 'the
+        one-sided view is current' without sleeping out the debounce)."""
+        if self.core.meta_writer is not None:
+            self.core.meta_writer.publish_now()
+
+    # ---- fault injection / teardown --------------------------------------
+
+    @endpoint
+    async def inject_fault(
+        self,
+        name: str,
+        action: str,
+        count: Optional[int] = None,
+        prob: Optional[float] = None,
+        delay_ms: Optional[float] = None,
+    ) -> dict:
+        return faults.arm(name, action, count=count, prob=prob, delay_ms=delay_ms)
+
+    @endpoint
+    async def clear_faults(self, name: Optional[str] = None) -> int:
+        return faults.disarm(name)
+
+    @endpoint
+    async def list_faults(self) -> list:
+        return faults.armed()
+
+    @endpoint
+    async def flight_record(self) -> list:
+        from torchstore_tpu.observability import recorder as obs_recorder
+
+        return obs_recorder.snapshot()
+
+    @endpoint
+    async def shard_teardown(self) -> None:
+        if self.core.meta_writer is not None:
+            self.core.meta_writer.close()
+            self.core.meta_writer = None
+        self.core.teardown()
+
+
+class RemoteIndex:
+    """Coordinator-side index authority over a shard fleet: the same
+    method names as :class:`IndexCore`, implemented as per-shard fan-out.
+    Engines written against the core run unchanged against this."""
+
+    def __init__(self, shard_refs: list[ActorRef]) -> None:
+        self.shard_refs = list(shard_refs)
+        self.n = len(shard_refs)
+
+    def _ref(self, key: str) -> ActorRef:
+        return self.shard_refs[shard_of(key, self.n)]
+
+    async def locate(
+        self,
+        keys: list[str],
+        missing_ok: bool = False,
+        require_fully_committed: bool = True,
+    ) -> dict:
+        parts = partition_keys(keys, self.n)
+        results = await asyncio.gather(
+            *(
+                self.shard_refs[i].locate_volumes.call_one(
+                    ks, missing_ok, require_fully_committed
+                )
+                for i, ks in parts.items()
+            )
+        )
+        merged: dict = {}
+        for part in results:
+            merged.update(part)
+        return merged
+
+    async def contains(self, key: str) -> str:
+        return await self._ref(key).contains.call_one(key)
+
+    async def keys_list(self, prefix: Optional[str] = None) -> list[str]:
+        results = await asyncio.gather(
+            *(ref.keys.call_one(prefix) for ref in self.shard_refs)
+        )
+        return sorted(k for part in results for k in part)
+
+    async def count_prefix(self, prefix: str) -> int:
+        return sum(
+            await asyncio.gather(
+                *(ref.count_prefix.call_one(prefix) for ref in self.shard_refs)
+            )
+        )
+
+    async def get_entry(self, key: str):
+        return await self._ref(key).index_get.call_one(key)
+
+    async def merge_copies(
+        self, volume_id: str, metas: list[Request], write_gens: dict[str, int]
+    ) -> set:
+        parts = partition_metas(metas, self.n)
+        results = await asyncio.gather(
+            *(
+                self.shard_refs[i].merge_copies.call_one(
+                    volume_id,
+                    ms,
+                    {m.key: write_gens.get(m.key, 0) for m in ms},
+                )
+                for i, ms in parts.items()
+            )
+        )
+        return {k for part in results for k in part}
+
+    async def auto_repair_pass(self, volume_id: str, healthy: list[str]) -> int:
+        return sum(
+            await asyncio.gather(
+                *(
+                    ref.auto_repair.call_one(volume_id, healthy)
+                    for ref in self.shard_refs
+                )
+            )
+        )
+
+    async def detach_volume(self, volume_id: str) -> dict[str, Any]:
+        results = await asyncio.gather(
+            *(ref.detach_volume.call_one(volume_id) for ref in self.shard_refs)
+        )
+        merged = {"recoverable": {}, "lost": []}
+        for part in results:
+            merged["recoverable"].update(part["recoverable"])
+            merged["lost"].extend(part["lost"])
+        return merged
+
+    async def set_tiers(
+        self, volume_id: str, spilled: list[str], fault_ins: list[str]
+    ) -> None:
+        # Every shard ignores keys it doesn't own: the per-sweep lists are
+        # small, so a broadcast beats client-side partitioning here.
+        await asyncio.gather(
+            *(
+                ref.set_tiers.call_one(volume_id, spilled, fault_ins)
+                for ref in self.shard_refs
+            )
+        )
+
+    async def reindex(self, survivors: list) -> int:
+        parts: dict[int, list] = {}
+        for vid, meta, gen in survivors:
+            parts.setdefault(shard_of(meta.key, self.n), []).append(
+                (vid, meta, gen)
+            )
+        return sum(
+            await asyncio.gather(
+                *(
+                    self.shard_refs[i].reindex.call_one(entries)
+                    for i, entries in parts.items()
+                )
+            )
+        )
+
+    async def summary(self) -> dict:
+        parts = await asyncio.gather(
+            *(ref.summary.call_one() for ref in self.shard_refs)
+        )
+        merged: dict[str, Any] = {
+            "puts": 0,
+            "put_bytes": 0,
+            "locates": 0,
+            "deletes": 0,
+            "num_keys": 0,
+            "sharded_keys": 0,
+            "indexed_bytes_approx": 0,
+            "pending_reclaims": {},
+        }
+        for part in parts:
+            for field in (
+                "puts",
+                "put_bytes",
+                "locates",
+                "deletes",
+                "num_keys",
+                "sharded_keys",
+                "indexed_bytes_approx",
+            ):
+                merged[field] += part.get(field, 0)
+            for vid, n in (part.get("pending_reclaims") or {}).items():
+                merged["pending_reclaims"][vid] = (
+                    merged["pending_reclaims"].get(vid, 0) + n
+                )
+        return merged
+
+    async def catalog(self, channel: Optional[str] = None) -> dict:
+        parts = await asyncio.gather(
+            *(ref.catalog.call_one(channel) for ref in self.shard_refs)
+        )
+        merged: dict = {}
+        for part in parts:
+            for chan, versions in part.items():
+                for ver, rec in versions.items():
+                    agg = merged.setdefault(chan, {}).setdefault(
+                        ver,
+                        {
+                            "keys": 0,
+                            "bytes": 0,
+                            "resident_keys": 0,
+                            "spilled_keys": 0,
+                            "volumes": set(),
+                            "leases": [],
+                        },
+                    )
+                    for field in (
+                        "keys",
+                        "bytes",
+                        "resident_keys",
+                        "spilled_keys",
+                    ):
+                        agg[field] += rec.get(field, 0)
+                    agg["volumes"].update(rec.get("volumes") or ())
+        return merged
+
+    async def wait_for_committed(
+        self, keys: list[str], timeout: Optional[float] = None
+    ) -> None:
+        parts = partition_keys(keys, self.n)
+        await asyncio.gather(
+            *(
+                self.shard_refs[i].wait_for_committed.with_timeout(
+                    0 if timeout is None else timeout + 10.0
+                ).call_one(ks, timeout)
+                for i, ks in parts.items()
+            )
+        )
+
+    async def wait_for_change(
+        self, key: str, last_gen: int = 0, timeout: Optional[float] = None
+    ) -> dict[str, Any]:
+        return await self._ref(key).wait_for_change.with_timeout(
+            0 if timeout is None else timeout + 10.0
+        ).call_one(key, last_gen, timeout)
+
+    async def teardown(self) -> None:
+        await asyncio.gather(
+            *(ref.shard_teardown.call_one() for ref in self.shard_refs),
+            return_exceptions=True,
+        )
+
+
+# Re-exported for the router's use (one partitioning vocabulary).
+__all__ = [
+    "ControllerShard",
+    "RemoteIndex",
+    "partition_keys",
+    "partition_metas",
+    "slice_write_gens",
+    "shard_of",
+]

@@ -1,0 +1,340 @@
+"""One-sided stamped metadata segments: PR 7's seqlock idiom, for METADATA.
+
+Each index host (the classic controller, or every ControllerShard)
+publishes its COMMITTED index into a shared-memory segment bracketed by a
+writer seqlock; the coordinator publishes stream watermark/seal state and
+the placement epoch the same way. Same-host clients then resolve
+locations, validate cached plans, and poll streamed-publish progress by
+READING SHARED MEMORY — zero controller RPCs on the warm path, which is
+what removes client count from every controller queue (ROADMAP item 4,
+"RPC Considered Harmful").
+
+Layout (all little-endian uint64, 8-byte aligned):
+
+    [0] seq     seqlock word: odd = publish in flight, even = stable
+    [1] gen     monotonically increasing publish generation
+    [2] len     payload byte length; TOMBSTONE marks a retired segment
+    [3] epoch   the writer's placement epoch at publish time
+    [4..]       pickled payload
+
+Reader protocol: read seq (must be even), snapshot gen/len/epoch, copy the
+payload, re-read seq — any movement is a torn read and falls back LOUDLY
+to the RPC path (``ts_meta_stamped_fallbacks_total``). Generations only
+increase, so a reader caches the decoded payload per generation and a
+header-only re-read (32 bytes) answers "anything new?" — the poll a
+streamed acquire runs per layer costs a few loads, not an RPC.
+
+Staleness is one-directional by construction: the writer publishes AFTER
+the index/stream change commits, so a reader can only UNDER-see progress
+(it falls back or keeps polling), never observe a watermark before its
+bytes landed. Deleted keys may linger one debounce interval — exactly the
+client-side location-cache staleness the fetch ladder already retries
+through.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+
+logger = get_logger("torchstore_tpu.metadata.stamped")
+
+HEADER_BYTES = 32
+# len-word sentinel: the writer retired this segment (payload outgrew it,
+# or the host shut down). Readers treat it as a permanent miss for this
+# attachment and stand down to the RPC path.
+TOMBSTONE = (1 << 63) - 1
+
+ENV_META_STAMPED = "TORCHSTORE_TPU_META_STAMPED"
+ENV_META_PUBLISH_MS = "TORCHSTORE_TPU_META_PUBLISH_MS"
+ENV_META_SEGMENT_BYTES = "TORCHSTORE_TPU_META_SEGMENT_BYTES"
+
+STAMPED_READS = obs_metrics.counter(
+    "ts_meta_stamped_total",
+    "Warm-path metadata reads served from stamped segments (zero RPCs), "
+    "by op",
+)
+STAMPED_FALLBACKS = obs_metrics.counter(
+    "ts_meta_stamped_fallbacks_total",
+    "Stamped metadata reads that fell back to the RPC path, by reason",
+)
+_PUBLISHES = obs_metrics.counter(
+    "ts_meta_publishes_total",
+    "Stamped metadata segment publishes (debounced; one per dirty window)",
+)
+_PUBLISH_BYTES = obs_metrics.gauge(
+    "ts_meta_publish_bytes",
+    "Payload bytes of the newest stamped metadata publish",
+)
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_META_STAMPED, "1").strip().lower() not in (
+        "0", "false", "no", "off", "",
+    )
+
+
+def publish_interval_s() -> float:
+    try:
+        return max(0.001, float(os.environ.get(ENV_META_PUBLISH_MS, "10")) / 1e3)
+    except ValueError:
+        return 0.01
+
+
+def segment_bytes() -> int:
+    try:
+        return max(64 << 10, int(os.environ.get(ENV_META_SEGMENT_BYTES, 8 << 20)))
+    except ValueError:
+        return 8 << 20
+
+
+class MetaUnavailable(Exception):
+    """This attachment can no longer serve (tombstoned / unmapped /
+    persistent tears): the caller stands down to the RPC path."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
+class MetaStampWriter:
+    """Debounced seqlock publisher for one metadata view.
+
+    ``payload_fn`` builds the current view (must run on the host's event
+    loop — index state is single-writer there); ``epoch_fn`` supplies the
+    placement epoch stamped into the header. ``mark_dirty()`` is cheap and
+    idempotent: publishes coalesce to at most one per interval."""
+
+    def __init__(
+        self,
+        payload_fn: Callable[[], Any],
+        epoch_fn: Optional[Callable[[], int]] = None,
+        size: Optional[int] = None,
+        interval_s: Optional[float] = None,
+    ) -> None:
+        from torchstore_tpu.transport.shared_memory import ShmSegment
+
+        self.payload_fn = payload_fn
+        self.epoch_fn = epoch_fn or (lambda: 0)
+        self.size = size or segment_bytes()
+        self.interval_s = (
+            publish_interval_s() if interval_s is None else interval_s
+        )
+        # count=False: protocol metadata, not pool economics (same rule as
+        # the data plane's stamp tables).
+        self.seg = ShmSegment.create(self.size, count=False)
+        self.words = np.frombuffer(
+            self.seg.mmap, dtype=np.uint64, count=4
+        )
+        self._gen = 0
+        self._dirty = False
+        self._scheduled = False
+        self._last_pub = 0.0
+        # Adaptive debounce: building + pickling the view runs ON the
+        # host's event loop, so the effective interval grows with the
+        # measured publish cost to cap the duty cycle at ~DUTY_CYCLE of
+        # loop time (a huge index publishes less often; a small stream
+        # snapshot keeps the configured cadence). Staleness stays safe —
+        # readers only ever UNDER-see progress and fall back to RPCs.
+        self._effective_interval = self.interval_s
+        self._dead = False
+
+    DUTY_CYCLE = 0.05
+
+    def describe(self) -> dict:
+        from torchstore_tpu.utils import get_hostname
+
+        return {
+            "segment": self.seg.name,
+            "size": self.size,
+            "hostname": get_hostname(),
+        }
+
+    def mark_dirty(self) -> None:
+        if self._dead:
+            return
+        self._dirty = True
+        if self._scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # No loop (direct unit-test construction): publish inline.
+            self.publish_now()
+            return
+        self._scheduled = True
+        delay = max(
+            0.0, self._last_pub + self._effective_interval - time.monotonic()
+        )
+        loop.call_later(delay, self._scheduled_publish)
+
+    def _scheduled_publish(self) -> None:
+        self._scheduled = False
+        if self._dirty:
+            self.publish_now()
+
+    def publish_now(self) -> None:
+        """One seqlock-bracketed publish of the current payload. Payloads
+        that outgrow the segment tombstone it permanently (readers fall
+        back to RPC; loud log once) — growing in place would orphan every
+        attached reader silently."""
+        if self._dead:
+            return
+        self._dirty = False
+        t0 = time.monotonic()
+        self._last_pub = t0
+        try:
+            blob = pickle.dumps(self.payload_fn(), protocol=4)
+        except Exception:  # noqa: BLE001 - a publish must never kill the
+            # host endpoint that marked it dirty; RPC path still serves
+            logger.exception("stamped metadata publish failed; RPC serves")
+            return
+        if len(blob) > self.size - HEADER_BYTES:
+            logger.warning(
+                "stamped metadata payload (%d bytes) outgrew its segment "
+                "(%d); tombstoning — same-host readers fall back to RPCs "
+                "(raise TORCHSTORE_TPU_META_SEGMENT_BYTES to restore "
+                "one-sided metadata at this scale)",
+                len(blob),
+                self.size,
+            )
+            self._tombstone()
+            return
+        words = self.words
+        words[0] = seq = int(words[0]) + 1  # odd: publish in flight
+        self._gen += 1
+        self.seg.mmap[HEADER_BYTES : HEADER_BYTES + len(blob)] = blob
+        words[1] = self._gen
+        words[2] = len(blob)
+        words[3] = int(self.epoch_fn())
+        words[0] = seq + 1  # even: stable
+        _PUBLISHES.inc()
+        _PUBLISH_BYTES.set(len(blob))
+        # Duty-cycle cap: the next publish waits at least cost/DUTY_CYCLE,
+        # so view building can never consume more than ~5% of the loop.
+        cost = time.monotonic() - t0
+        self._effective_interval = max(
+            self.interval_s, cost / self.DUTY_CYCLE
+        )
+
+    def _tombstone(self) -> None:
+        words = self.words
+        words[0] = int(words[0]) + 1
+        words[2] = TOMBSTONE
+        words[0] = int(words[0]) + 1
+        self._dead = True
+
+    def close(self) -> None:
+        if not self._dead:
+            self._tombstone()
+        self.seg.unlink()
+
+
+class MetaStampReader:
+    """Same-host attachment to one writer's segment, with per-generation
+    decode caching: a header-only read answers "unchanged?", a changed
+    generation pays one payload copy + unpickle."""
+
+    MAX_TORN_RETRIES = 16
+
+    def __init__(self, name: str, size: int) -> None:
+        from torchstore_tpu.transport.shared_memory import ShmSegment
+
+        self.seg = ShmSegment.attach(name, size)
+        self.words = np.frombuffer(self.seg.mmap, dtype=np.uint64, count=4)
+        self._cached_gen: Optional[int] = None
+        self._cached: Any = None
+        self._dead = False
+
+    def read(self) -> tuple[int, Any, int]:
+        """(generation, payload, epoch) of the newest stable publish.
+        Raises MetaUnavailable on tombstones / never-published segments /
+        persistent tears — the caller falls back to the RPC path."""
+        if self._dead:
+            raise MetaUnavailable("gone")
+        words = self.words
+        for _ in range(self.MAX_TORN_RETRIES):
+            s1 = int(words[0])
+            if s1 & 1:
+                continue  # publish in flight: the writer is fast; spin
+            gen = int(words[1])
+            ln = int(words[2])
+            epoch = int(words[3])
+            if ln == TOMBSTONE:
+                self._dead = True
+                raise MetaUnavailable("tombstone")
+            if gen == 0:
+                raise MetaUnavailable("never_published")
+            if gen == self._cached_gen and int(words[0]) == s1:
+                return gen, self._cached, epoch
+            blob = bytes(self.seg.mmap[HEADER_BYTES : HEADER_BYTES + ln])
+            if int(words[0]) != s1:
+                continue  # torn: a publish raced the copy
+            try:
+                obj = pickle.loads(blob)
+            except Exception as exc:  # noqa: BLE001 - torn beyond the
+                # seqlock's detection window (should not happen; be loud)
+                raise MetaUnavailable(f"undecodable: {exc}") from exc
+            self._cached_gen = gen
+            self._cached = obj
+            return gen, obj, epoch
+        raise MetaUnavailable("torn")
+
+    def epoch(self) -> int:
+        """Header-only read of the stamped placement epoch (the zero-RPC
+        plan-validation primitive). Raises MetaUnavailable like read()."""
+        if self._dead:
+            raise MetaUnavailable("gone")
+        words = self.words
+        for _ in range(self.MAX_TORN_RETRIES):
+            s1 = int(words[0])
+            if s1 & 1:
+                continue
+            gen = int(words[1])
+            ln = int(words[2])
+            epoch = int(words[3])
+            if ln == TOMBSTONE:
+                self._dead = True
+                raise MetaUnavailable("tombstone")
+            if gen == 0:
+                raise MetaUnavailable("never_published")
+            if int(words[0]) == s1:
+                return epoch
+        raise MetaUnavailable("torn")
+
+    def generation(self) -> Optional[int]:
+        """Header-only publish generation (None while torn/unpublished) —
+        the cheap "anything new?" probe the stream poll loop spins on."""
+        if self._dead:
+            return None
+        try:
+            words = self.words
+            s1 = int(words[0])
+            if s1 & 1:
+                return None
+            gen = int(words[1])
+            if int(words[2]) == TOMBSTONE:
+                self._dead = True
+                return None
+            return gen if int(words[0]) == s1 and gen else None
+        except (ValueError, OSError):
+            return None
+
+    def close(self) -> None:
+        """Detach: further reads raise MetaUnavailable("gone") and the
+        cached decode + header view are dropped so the mapping's pages
+        release as soon as the last borrower lets go (a long-lived client
+        re-attaches on every topology reload — dropped readers must not
+        pin retired 8MB segments until a lucky GC)."""
+        self._dead = True
+        self._cached = None
+        self._cached_gen = None
+        self.words = None

@@ -51,6 +51,14 @@ INGRESS = "ingress"
 # reading "edges" must never mistake spill churn for network load.
 DISK = "disk"
 
+# Metadata-plane accounting (torchstore_tpu/metadata/router.py): cells
+# whose transport is METADATA count controller RPCs (direction "rpc") and
+# one-sided stamped reads (direction "stamped") per op — ``peer_host``
+# carries the OP name and ``volume`` the shard label ("coord"/"s<i>").
+# The matrix folds them into a "metadata" section, never into edges: the
+# acceptance "zero metadata RPCs on the warm path" is read right off it.
+METADATA = "metadata"
+
 # Quantized wire-tier accounting (state_dict_utils): direction "logical"
 # carries the full-precision bytes a publish REPRESENTS, "wire" the fused
 # blob bytes that actually shipped. The matrix folds them into a "quant"
@@ -269,7 +277,10 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
 
     Disk spill-tier cells (``transport == DISK``) are folded into their
     own ``"disk"`` section per volume — spill/fault-in I/O stays visible
-    without ever being mistaken for wire bytes on an edge.
+    without ever being mistaken for wire bytes on an edge. Metadata cells
+    (``transport == METADATA``) fold into a ``"metadata"`` section:
+    controller RPC counts per op (plus per shard) next to the stamped
+    zero-RPC reads that replaced them on the warm path.
 
     Returns ``{"edges": {src_host: {dst_host: {"bytes", "ops"}}},
     "egress": {host: bytes}, "ingress": {host: bytes},
@@ -282,6 +293,7 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
     volumes: dict[str, dict] = {}
     disk: dict[str, dict] = {}
     quant = {"bytes_logical": 0, "bytes_wire": 0}
+    metadata: dict[str, dict] = {"rpcs": {}, "stamped": {}, "rpcs_by_shard": {}}
     unattributed: dict[str, dict] = {}
 
     def _edge(src: str, dst: str, nbytes: int, ops: int) -> None:
@@ -308,6 +320,19 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
                 d[
                     "spill_bytes" if direction == EGRESS else "fault_in_bytes"
                 ] += nbytes
+                continue
+            if cell.get("transport") == METADATA:
+                op = peer or "?"
+                if direction == "stamped":
+                    metadata["stamped"][op] = (
+                        metadata["stamped"].get(op, 0) + ops
+                    )
+                else:
+                    metadata["rpcs"][op] = metadata["rpcs"].get(op, 0) + ops
+                    shard = vid or "coord"
+                    metadata["rpcs_by_shard"][shard] = (
+                        metadata["rpcs_by_shard"].get(shard, 0) + ops
+                    )
                 continue
             if cell.get("transport") == QUANT:
                 quant[
@@ -349,5 +374,6 @@ def traffic_matrix(ledgers: dict[str, dict]) -> dict:
         "volumes": volumes,
         "disk": disk,
         "quant": quant,
+        "metadata": metadata,
         "unattributed": unattributed,
     }
