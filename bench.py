@@ -1668,6 +1668,260 @@ async def metadata_scale_section(
     }
 
 
+async def fleet_scale_section(
+    n_drivers: int = 8,
+    n_logical: int = 128,
+    duration_s: float = 4.0,
+    n_volumes: int = 4,
+    value_kb: float = 4.0,
+    shared_keys: int = 128,
+    # Per-client baseline rate. Production generators poll weights at
+    # ~single-digit Hz; 1 Hz x 1024 clients (bursting to 4x) sustains
+    # ~1.5k ops/s on this box with p99 ~110-240 ms (the spread is the
+    # parent's concurrent under-load telemetry measurement contending
+    # for the same cores). Driving every client at RPC-benchmark rates
+    # would measure event-loop saturation collapse, not the store.
+    rate_hz: float = 1.0,
+    # The pass/fail SLO: sub-second p99 while 1k clients hammer one
+    # shared box, with headroom for host weather (measured p99 110-242
+    # ms across runs; collapses land far past this line).
+    get_p99_gate_ms: float = 500.0,
+    overhead_reps: int = 16,
+    overhead_keys: int = 1024,
+    overhead_budget_pct: float = 2.0,
+    violation_duration_s: float = 1.5,
+) -> dict:
+    """Fleet-scale load harness (ISSUE 15 / ROADMAP item 6): sustained
+    ops/s with p99 under the SLO gate at >= 1k logical clients, asserted.
+
+    Three legs against one multi-volume fleet:
+
+    1. **Gate leg** — ``n_drivers`` OS processes x ``n_logical`` asyncio
+       clients (defaults: 8 x 128 = 1024 logical clients) drive a
+       bursty get/put mix (``loadgen`` burst pattern) for ``duration_s``;
+       the merged report must show ZERO failed drivers, zero op errors,
+       and fleet get p99 under ``get_p99_gate_ms`` — the pass/fail line.
+       While the storm runs, the PARENT process re-measures the
+       ledger+recorder cost on its own warm one-sided get leg
+       (interleaved min-of-reps, the ledger_overhead methodology) — the
+       <= 2% telemetry budget re-verified UNDER load, asserted.
+    2. **Violation leg** — a short rerun with ``shm.landing_stamp``
+       armed as a client-scope delay in every driver (the landing-copy
+       window of the warm one-sided get) under a deliberately tight GET
+       p99 SLO: the merged scoreboard must show the violated SLO naming
+       ``landing`` as its dominant stage — the stage-attribution
+       acceptance, asserted.
+
+    Emits ``fleet_ops_per_s`` / ``fleet_get_p99_ms`` /
+    ``fleet_ledger_overhead_pct`` headline keys (gated by
+    bench_compare)."""
+    import asyncio as _asyncio
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.loadgen import LoadSpec, run_fleet_load
+    from torchstore_tpu.observability import ledger as obs_ledger
+    from torchstore_tpu.observability import recorder as obs_recorder
+
+    store = "bench_fleet"
+    await ts.initialize(num_storage_volumes=n_volumes, store_name=store)
+    led = obs_ledger.ledger()
+    rec = obs_recorder.recorder()
+    led_was, rec_was = led.enabled, rec.enabled
+    try:
+        gate_spec = LoadSpec(
+            store_name=store,
+            duration_s=duration_s,
+            processes=n_drivers,
+            clients_per_process=n_logical,
+            pattern={
+                "kind": "burst",
+                "rate_hz": rate_hz,
+                "peak_rate_hz": rate_hz * 4,
+                "period_s": max(1.0, duration_s / 3),
+                "burst_frac": 0.25,
+            },
+            rate_hz=rate_hz,
+            mix={"get": 0.85, "put": 0.15},
+            value_kb=value_kb,
+            shared_keys=shared_keys,
+            slow_reader_frac=0.05,
+            slow_reader_ms=2.0,
+            seed=15,
+            env={"TORCHSTORE_TPU_SLO_GET_P99_MS": str(get_p99_gate_ms)},
+        )
+        # The telemetry-budget re-measurement rides INSIDE the load storm:
+        # the parent's own warm one-sided leg, ledger+recorder on vs off,
+        # interleaved min-of-reps (both modes see the same storm). The
+        # working set matches the ledger_overhead section's shape — the
+        # <= 2% budget is a per-key amortized figure; the fixed per-batch
+        # cost would read as tens of percent on a tiny batch.
+        n_elem = max(1, int(value_kb * 1024 // 4))
+        own = {
+            f"{store}/ov/{i}": np.random.rand(n_elem).astype(np.float32)
+            for i in range(overhead_keys)
+        }
+        await ts.put_batch(own, store_name=store)
+        dests = {k: np.empty_like(v) for k, v in own.items()}
+        await ts.get_batch(dict(dests), store_name=store)  # record plans
+
+        async def one_rep() -> float:
+            t0 = time.perf_counter()
+            await ts.get_batch(dict(dests), store_name=store)
+            return time.perf_counter() - t0
+
+        async def overhead_under_load() -> dict:
+            # Drift-cancelling triples: each rep measures OFF -> ON -> OFF
+            # back-to-back (min-of-2 per slot trims upper-tail jitter) and
+            # scores the ON slot against the mean of its OFF neighbors, so
+            # slow host/storm drift cancels within the triple. The SAME
+            # triples yield a NULL contrast (off2 vs off1 — two identical
+            # configurations) whose median deviation IS this run's
+            # measurement-noise floor: the budget assert widens by exactly
+            # that demonstrated noise, so a quiet box enforces the bare
+            # <= 2% budget while a storming shared box can't flake the
+            # gate — and a real telemetry regression (tens of percent)
+            # still fails loudly on either.
+            import statistics as _stats
+
+            def toggle(enabled: bool) -> None:
+                led.set_enabled(enabled)
+                rec.set_enabled(enabled)
+
+            ratios: list[float] = []
+            nulls: list[float] = []
+            on_times: list[float] = []
+            off_times: list[float] = []
+
+            async def slot(enabled: bool) -> float:
+                toggle(enabled)
+                return min([await one_rep(), await one_rep()])
+
+            toggle(True)
+            await one_rep()  # cold rep: plan re-records, pages warm
+            for _ in range(max(4, overhead_reps)):
+                off1 = await slot(False)
+                on_s = await slot(True)
+                off2 = await slot(False)
+                on_times.append(on_s)
+                off_times.extend((off1, off2))
+                base = (off1 + off2) / 2
+                if base > 0:
+                    ratios.append(on_s / base)
+                if off1 > 0:
+                    nulls.append(off2 / off1)
+                await _asyncio.sleep(0.02)  # let driver traffic breathe
+            toggle(True)
+            overhead_pct = (
+                (_stats.median(ratios) - 1.0) * 100.0 if ratios else 0.0
+            )
+            noise_floor_pct = (
+                abs(_stats.median(nulls) - 1.0) * 100.0 if nulls else 0.0
+            )
+            return {
+                "on_us_per_key": round(min(on_times) / len(own) * 1e6, 3),
+                "off_us_per_key": round(
+                    min(off_times) / len(own) * 1e6, 3
+                ),
+                "overhead_pct": round(overhead_pct, 2),
+                "noise_floor_pct": round(noise_floor_pct, 2),
+                "reps": max(4, overhead_reps),
+            }
+
+        load_task = _asyncio.ensure_future(run_fleet_load(gate_spec))
+        # Let the drivers boot + warm their plans before measuring.
+        await _asyncio.sleep(min(1.0, duration_s / 4))
+        overhead = await overhead_under_load()
+        gate = await load_task
+        get_row = gate["by_op"].get("get") or {}
+        gate_p99 = get_row.get("p99_ms")
+        assert gate["failed_drivers"] == 0, gate.get("driver_errors")
+        assert gate["errors"] == 0, gate["by_op"]
+        assert gate["logical_clients"] == n_drivers * n_logical
+        assert gate_p99 is not None and gate_p99 < get_p99_gate_ms, (
+            f"fleet get p99 {gate_p99} ms >= SLO gate {get_p99_gate_ms} ms"
+        )
+        effective_budget = overhead_budget_pct + overhead["noise_floor_pct"]
+        assert overhead["overhead_pct"] <= effective_budget, (
+            f"telemetry overhead under load {overhead['overhead_pct']}% > "
+            f"{overhead_budget_pct}% budget + {overhead['noise_floor_pct']}% "
+            "demonstrated measurement noise"
+        )
+        print(
+            f"# fleet_scale gate: {gate['logical_clients']} logical clients "
+            f"/ {n_drivers} drivers -> {gate['ops_per_s']:.0f} ops/s, get "
+            f"p50 {get_row.get('p50_ms'):.2f} ms p99 {gate_p99:.2f} ms "
+            f"(gate {get_p99_gate_ms:.0f} ms); telemetry overhead "
+            f"{overhead['overhead_pct']:+.2f}% (budget <= "
+            f"{overhead_budget_pct}% + {overhead['noise_floor_pct']:.2f}% "
+            "noise floor)",
+            file=sys.stderr,
+        )
+
+        # Violation leg: hold the landing-copy window open (client-scope
+        # delay) under a deliberately tight GET p99 SLO — the scoreboard
+        # must blame the landing stage.
+        tight_ms = 5.0
+        violation_spec = LoadSpec(
+            store_name=store,
+            duration_s=violation_duration_s,
+            processes=2,
+            clients_per_process=max(4, n_logical // 8),
+            pattern="poisson",
+            rate_hz=max(8.0, rate_hz * 2),
+            mix={"get": 1.0},
+            value_kb=value_kb,
+            shared_keys=min(shared_keys, 32),
+            seed=16,
+            env={
+                "TORCHSTORE_TPU_SLO_GET_P99_MS": str(tight_ms),
+                "TORCHSTORE_TPU_FAULTPOINTS": (
+                    "shm.landing_stamp=delay:delay_ms=25"
+                ),
+            },
+        )
+        violation = await run_fleet_load(violation_spec)
+        board = (violation.get("slo") or {}).get("slos") or {}
+        row = board.get("get_p99_ms") or {}
+        assert violation["failed_drivers"] == 0, violation.get(
+            "driver_errors"
+        )
+        assert row.get("violations", 0) > 0, board
+        assert row.get("dominant_stage") == "landing", row
+        print(
+            f"# fleet_scale violation leg: get_p99_ms violated "
+            f"{row['violations']}x under a {tight_ms} ms SLO with injected "
+            f"landing delays; dominant stage = {row['dominant_stage']} "
+            "(stage attribution confirmed)",
+            file=sys.stderr,
+        )
+        return {
+            "drivers": n_drivers,
+            "logical_clients": gate["logical_clients"],
+            "duration_s": duration_s,
+            "value_kb": value_kb,
+            "fleet_ops_per_s": gate["ops_per_s"],
+            "fleet_get_p50_ms": round(get_row.get("p50_ms") or 0.0, 3),
+            "fleet_get_p99_ms": round(gate_p99, 3),
+            "get_p99_gate_ms": get_p99_gate_ms,
+            "by_op": gate["by_op"],
+            "window_s": gate["window_s"],
+            "fleet_ledger_overhead_pct": overhead["overhead_pct"],
+            "ledger_overhead_under_load": overhead,
+            "scoreboard": gate.get("slo"),
+            "violation": {
+                "slo": "get_p99_ms",
+                "threshold_ms": tight_ms,
+                "violations": row.get("violations", 0),
+                "dominant_stage": row.get("dominant_stage"),
+                "stages": row.get("stages"),
+            },
+        }
+    finally:
+        led.set_enabled(led_was)
+        rec.set_enabled(rec_was)
+        await ts.shutdown(store)
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -1700,6 +1954,11 @@ async def run(
     meta_drivers: int = 16,
     meta_logical: int = 6,
     meta_duration_s: float = 3.0,
+    fleet_drivers: int = 8,
+    fleet_logical: int = 128,
+    fleet_duration_s: float = 4.0,
+    fleet_volumes: int = 4,
+    fleet_gate_ms: float = 500.0,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -1980,6 +2239,18 @@ async def run(
         n_logical=meta_logical,
         duration_s=meta_duration_s,
     )
+    # Fleet-scale section (ISSUE 15): >= 1k logical clients over >= 8
+    # driver processes against a multi-volume fleet — sustained ops/s
+    # with p99 under the SLO gate, the telemetry budget re-verified under
+    # load, and a deliberately induced violation whose dominant stage the
+    # scoreboard must name. All asserted inside the section.
+    fleet_scale = await fleet_scale_section(
+        n_drivers=fleet_drivers,
+        n_logical=fleet_logical,
+        duration_s=fleet_duration_s,
+        n_volumes=fleet_volumes,
+        get_p99_gate_ms=fleet_gate_ms,
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -2078,6 +2349,16 @@ async def run(
             "metadata_ops_per_s_sharded"
         ],
         "metadata_scale": metadata_scale,
+        # ISSUE-15 headline stats at top level: sustained fleet ops/s at
+        # >= 1k logical clients with get p99 under the SLO gate, and the
+        # telemetry budget re-measured under that load; the full section
+        # (scoreboard, induced-violation attribution) under "fleet_scale".
+        "fleet_ops_per_s": fleet_scale["fleet_ops_per_s"],
+        "fleet_get_p99_ms": fleet_scale["fleet_get_p99_ms"],
+        "fleet_ledger_overhead_pct": fleet_scale[
+            "fleet_ledger_overhead_pct"
+        ],
+        "fleet_scale": fleet_scale,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -2126,6 +2407,12 @@ if __name__ == "__main__":
         # Standalone metadata-plane run: one JSON line with per-shard-count
         # metadata ops/s and the 1 -> N scaling factor.
         print(json.dumps(asyncio.run(metadata_scale_section())))
+        sys.exit(0)
+    if "--fleet-scale" in sys.argv:
+        # Standalone fleet-scale run: one JSON line with sustained ops/s,
+        # the p99-vs-SLO gate, the under-load telemetry overhead, and the
+        # induced-violation stage attribution.
+        print(json.dumps(asyncio.run(fleet_scale_section())))
         sys.exit(0)
     if "--delta-sync" in sys.argv:
         # Standalone quantized/delta wire-tier run: one JSON line with the

@@ -102,6 +102,16 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     # weather-budgeted like the other throughput legs.
     "metadata_scale_x": ("higher", 0.30, "rel"),
     "metadata_ops_per_s_sharded": ("higher", 0.40, "rel"),
+    # Fleet-scale load harness (ISSUE 15). Sustained ops/s is arrival-
+    # paced (open-loop clients), so big swings mean drivers died or the
+    # fleet stopped keeping up, not host weather; the p99 gate is already
+    # asserted inside the section, so the trajectory budget only needs to
+    # catch creep; the under-load telemetry overhead carries its own
+    # measured noise floor and is budgeted absolutely like
+    # ledger_overhead_pct, a bit wider for the storm.
+    "fleet_ops_per_s": ("higher", 0.40, "rel"),
+    "fleet_get_p99_ms": ("lower", 1.00, "rel"),
+    "fleet_ledger_overhead_pct": ("lower", 4.0, "abs"),
 }
 
 

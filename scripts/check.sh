@@ -6,7 +6,7 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (12 rules,
+#   1. tslint --fail-on-new     repo-specific static analysis (14 rules,
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
 #                               --regen-metric-docs after editing knobs or
@@ -18,11 +18,14 @@
 #                               ledger_overhead telemetry-cost section,
 #                               the relay fanout section's O(1)-egress
 #                               bound, the tiered-capacity section's
-#                               spill/fault-in/warm-leased-get gates, and
+#                               spill/fault-in/warm-leased-get gates,
 #                               the delta_sync quant/delta wire-tier
-#                               section's compression + error bounds, and
+#                               section's compression + error bounds,
 #                               the metadata_scale section's 1-vs-N-shard
-#                               controller throughput scaling) and
+#                               controller throughput scaling, and the
+#                               fleet_scale loadgen section's p99-vs-SLO
+#                               gate + under-load telemetry budget +
+#                               induced-violation stage attribution) and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

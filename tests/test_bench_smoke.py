@@ -49,6 +49,11 @@ async def test_bench_run_tiny(capsys):
         meta_drivers=2,
         meta_logical=2,
         meta_duration_s=0.5,
+        fleet_drivers=2,
+        fleet_logical=4,
+        fleet_duration_s=1.2,
+        fleet_volumes=2,
+        fleet_gate_ms=2000.0,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -170,6 +175,18 @@ async def test_bench_run_tiny(capsys):
     ds = result["delta_sync"]
     assert ds["delta_wire_compression_int8_block"] > 3.0
     assert ds["delta_max_abs_err_none"] == 0.0
+
+    # Fleet-scale section (ISSUE 15): the section ASSERTS its own gates
+    # (p99 under the SLO, telemetry budget under load, induced-violation
+    # stage attribution) — reaching here means they held at smoke scale;
+    # the headline keys must still ride the record.
+    assert result["fleet_ops_per_s"] > 0
+    assert result["fleet_get_p99_ms"] > 0
+    assert isinstance(result["fleet_ledger_overhead_pct"], float)
+    fs = result["fleet_scale"]
+    assert fs["logical_clients"] == 8 and fs["drivers"] == 2
+    assert fs["violation"]["dominant_stage"] == "landing"
+    assert fs["violation"]["violations"] > 0
 
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
@@ -403,4 +420,42 @@ async def test_bench_metadata_scale_section_tiny():
         assert leg["failed_drivers"] == 0, leg
         assert leg["mix"]["locate"] > 0 and leg["mix"]["notify"] > 0, leg
         assert leg["mix"]["poll"] > 0, leg
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_fleet_scale_section_tiny():
+    """The fleet_scale section standalone (``bench.py --fleet-scale``) at
+    tiny load: real loadgen driver processes against a real 2-volume
+    fleet. The section asserts its own acceptance gates internally — p99
+    under the SLO gate, the under-load telemetry budget (<= 2% plus the
+    run's own demonstrated measurement-noise floor), zero failed drivers
+    / op errors, and the induced ``shm.landing_stamp`` violation naming
+    the landing stage — so this smoke proves the assertions themselves
+    can never ship broken. The >= 1k-clients-over->=8-drivers bar is the
+    full-scale run's contract (its defaults: 8 x 128)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.fleet_scale_section(
+        n_drivers=2,
+        n_logical=4,
+        duration_s=1.2,
+        n_volumes=2,
+        shared_keys=16,
+        rate_hz=10.0,
+        get_p99_gate_ms=2000.0,
+        overhead_reps=8,
+        violation_duration_s=1.0,
+    )
+    assert out["fleet_ops_per_s"] > 0, out
+    assert 0 < out["fleet_get_p99_ms"] < out["get_p99_gate_ms"], out
+    assert out["by_op"]["get"]["count"] > 0, out
+    assert out["by_op"]["put"]["count"] > 0, out
+    assert out["violation"]["dominant_stage"] == "landing", out["violation"]
+    assert out["violation"]["violations"] > 0, out["violation"]
+    assert "noise_floor_pct" in out["ledger_overhead_under_load"], out
     json.dumps(out)

@@ -1,0 +1,542 @@
+"""Fleet-scale load harness + stage-attribution scoreboard (ISSUE 15).
+
+Covers the loadgen package (arrival patterns, churn schedules, the
+multi-process driver, report merging), the stage-attribution layer
+(``observe_stage`` / ``ts.slo_report()`` naming the dominant stage of a
+violated SLO under an injected ``shm.landing_stamp`` delay), the new
+overload-signal gauges, the flight-recorder dump rate limit, and the
+chaos leg: a volume killed mid-loadgen-run with zero committed loss and
+the kill visible in the scoreboard's violation counts.
+"""
+
+import asyncio
+import json
+import os
+import random
+import statistics
+import time
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import faults
+from torchstore_tpu.loadgen import (
+    LoadSpec,
+    churn_sessions,
+    make_pattern,
+    merge_driver_reports,
+    merge_slo_reports,
+    run_fleet_load,
+)
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
+from torchstore_tpu.strategy import LocalRankStrategy
+
+
+@pytest.fixture
+def fast_health(monkeypatch):
+    monkeypatch.setenv("TORCHSTORE_TPU_HEALTH_INTERVAL_S", "0.25")
+    monkeypatch.setenv("TORCHSTORE_TPU_HEALTH_MISS_THRESHOLD", "2")
+
+
+@pytest.fixture
+def fresh_digests():
+    """Isolate the rolling op/stage digests and the SLO violation counter
+    from whatever earlier tests in this process observed."""
+    obs_timeline.op_quantiles().reset()
+    obs_timeline.stage_quantiles().reset()
+    violations = obs_metrics.get_registry().get("ts_slo_violations_total")
+    if violations is not None:
+        violations.clear()
+    yield
+    obs_timeline.op_quantiles().reset()
+    obs_timeline.stage_quantiles().reset()
+
+
+# --------------------------------------------------------------------------
+# arrival patterns + churn schedules (pure units)
+# --------------------------------------------------------------------------
+
+
+class TestArrivals:
+    def test_poisson_mean_gap_matches_rate(self):
+        pattern = make_pattern({"kind": "poisson", "rate_hz": 50.0})
+        rng = random.Random(7)
+        gaps = [pattern.next_gap(0.0, rng) for _ in range(4000)]
+        assert statistics.mean(gaps) == pytest.approx(1 / 50.0, rel=0.1)
+
+    def test_steady_is_a_metronome(self):
+        pattern = make_pattern({"kind": "steady", "rate_hz": 10.0})
+        rng = random.Random(1)
+        assert pattern.next_gap(3.0, rng) == pytest.approx(0.1)
+
+    def test_burst_rate_modulates_square_wave(self):
+        pattern = make_pattern(
+            {
+                "kind": "burst",
+                "rate_hz": 10.0,
+                "peak_rate_hz": 100.0,
+                "period_s": 1.0,
+                "burst_frac": 0.25,
+            }
+        )
+        assert pattern.rate_at(0.1) == 100.0  # inside the burst window
+        assert pattern.rate_at(0.9) == 10.0  # baseline
+        assert pattern.rate_at(1.2) == 100.0  # next period's burst
+
+    def test_diurnal_stays_between_base_and_peak(self):
+        pattern = make_pattern(
+            {
+                "kind": "diurnal",
+                "rate_hz": 5.0,
+                "peak_rate_hz": 50.0,
+                "period_s": 4.0,
+            }
+        )
+        rates = [pattern.rate_at(t / 10) for t in range(80)]
+        assert min(rates) >= 5.0 - 1e-9 and max(rates) <= 50.0 + 1e-9
+        assert max(rates) > 40 and min(rates) < 15  # actually swings
+
+    def test_unknown_pattern_rejected(self):
+        with pytest.raises(ValueError, match="unknown arrival pattern"):
+            make_pattern("lunar")
+
+    def test_determinism_per_seed(self):
+        pattern = make_pattern("poisson")
+        a = [pattern.next_gap(0.0, random.Random(42)) for _ in range(1)]
+        b = [pattern.next_gap(0.0, random.Random(42)) for _ in range(1)]
+        assert a == b
+
+    def test_churn_sessions_cover_run_without_overlap(self):
+        rng = random.Random(3)
+        sessions = churn_sessions(30.0, churn_rate_hz=0.5, rng=rng)
+        assert sessions, "churn produced no sessions"
+        prev_leave = -1.0
+        for join_t, leave_t in sessions:
+            assert 0.0 <= join_t < leave_t <= 30.0
+            assert join_t > prev_leave  # ordered, non-overlapping
+            prev_leave = leave_t
+        assert len(sessions) >= 2, "0.5 Hz churn over 30 s should cycle"
+
+    def test_no_churn_is_one_full_session(self):
+        assert churn_sessions(5.0, 0.0, random.Random(0)) == [(0.0, 5.0)]
+
+
+# --------------------------------------------------------------------------
+# stage digests + scoreboard (process-local units)
+# --------------------------------------------------------------------------
+
+
+class TestStageAttribution:
+    def test_unregistered_stage_raises(self):
+        with pytest.raises(ValueError, match="unregistered stage"):
+            obs_timeline.observe_stage("get", "warp_drive", 0.01)
+
+    def test_dominant_stage_tracks_largest_total(self):
+        digests = obs_timeline.StageQuantiles()
+        for _ in range(20):
+            digests.observe("get", "transport", 0.001)
+            digests.observe("get", "landing", 0.010)
+        assert digests.dominant("get") == "landing"
+        rows = digests.breakdown("get")
+        assert rows["landing"]["share"] > 0.8
+        assert rows["transport"]["samples"] == 20
+
+    def test_stage_totals_sum_true_wall_time_across_ring_wraps(self):
+        """Regression (review finding): totals must decay in WALL TIME,
+        never per-stage sample count — a count-triggered halving
+        normalizes the sample rate away and votes by mean segment
+        duration instead of aggregate wall time. Over a sub-second run
+        the decayed totals must equal the true sums even though the
+        sample ring wrapped multiple times."""
+        digests = obs_timeline.StageQuantiles()
+        for _ in range(2000):  # ~4x the 512 ring: the old code halved 3x
+            digests.observe("get", "transport", 0.001)  # 2.0 s aggregate
+        for _ in range(100):
+            digests.observe("get", "landing", 0.005)  # 0.5 s aggregate
+        rows = digests.breakdown("get")
+        assert rows["transport"]["total_s"] == pytest.approx(2.0, rel=0.05)
+        assert rows["landing"]["total_s"] == pytest.approx(0.5, rel=0.05)
+        assert digests.dominant("get") == "transport"
+
+    def test_slo_report_reads_thresholds_and_current(
+        self, monkeypatch, fresh_digests
+    ):
+        monkeypatch.setenv("TORCHSTORE_TPU_SLO_PUT_P99_MS", "1.0")
+        monkeypatch.setenv("TORCHSTORE_TPU_SLO_CUSTOM_BAR", "7")
+        for _ in range(4):
+            obs_timeline.observe_op("put", 0.005)  # 5 ms > 1 ms SLO
+            obs_timeline.observe_stage("put", "notify", 0.004)
+        report = obs_timeline.slo_report()
+        row = report["slos"]["put_p99_ms"]
+        assert row["threshold"] == 1.0
+        assert row["current"] > 1.0 and row["violated"]
+        assert row["violations"] >= 1
+        assert row["dominant_stage"] == "notify"
+        # Operator-extension knobs under the prefix appear on the board.
+        assert report["slos"]["custom_bar"]["threshold"] == 7.0
+        json.dumps(report)
+
+
+# --------------------------------------------------------------------------
+# report merging (pure units)
+# --------------------------------------------------------------------------
+
+
+class TestReportMerge:
+    def test_merge_concatenates_samples_and_uses_max_window(self):
+        reports = [
+            {
+                "counts": {"get": 3},
+                "errors": {},
+                "samples": {"get": [0.001, 0.002, 0.003]},
+                "window_s": 2.0,
+                "slo": None,
+            },
+            {
+                "counts": {"get": 1, "put": 2},
+                "errors": {"put": 1},
+                "samples": {"get": [0.100], "put": [0.004, 0.005]},
+                "window_s": 1.0,
+                "slo": None,
+            },
+        ]
+        merged = merge_driver_reports(reports)
+        assert merged["ops"] == 6 and merged["errors"] == 1
+        assert merged["ops_per_s"] == pytest.approx(3.0)
+        # p99 over the CONCATENATED samples sees driver 2's 100 ms tail.
+        assert merged["by_op"]["get"]["p99_ms"] == pytest.approx(100.0)
+        assert merged["by_op"]["put"]["errors"] == 1
+
+    def test_slo_merge_recomputes_dominant_from_summed_stage_time(self):
+        def board(landing_s, transport_s, violations):
+            return {
+                "slos": {
+                    "get_p99_ms": {
+                        "env": "TORCHSTORE_TPU_SLO_GET_P99_MS",
+                        "threshold": 5.0,
+                        "worse": "above",
+                        "op": "get",
+                        "current": 6.0,
+                        "violations": violations,
+                        "violated": violations > 0,
+                    }
+                },
+                "stages": {
+                    "get": {
+                        "landing": {
+                            "samples": 10,
+                            "total_s": landing_s,
+                            "p99_s": 0.02,
+                        },
+                        "transport": {
+                            "samples": 10,
+                            "total_s": transport_s,
+                            "p99_s": 0.01,
+                        },
+                    }
+                },
+            }
+
+        # One driver (mis)votes transport; the fleet's summed wall time
+        # still lands on landing.
+        merged = merge_slo_reports(
+            [board(0.9, 0.1, 2), board(0.2, 0.3, 1)]
+        )
+        row = merged["slos"]["get_p99_ms"]
+        assert row["violations"] == 3 and row["violated"]
+        assert row["dominant_stage"] == "landing"
+        assert merged["stages"]["get"]["landing"]["total_s"] == pytest.approx(
+            1.1
+        )
+
+
+# --------------------------------------------------------------------------
+# flight-recorder dump rate limit (satellite)
+# --------------------------------------------------------------------------
+
+
+class TestFlightDumpRateLimit:
+    def test_one_dump_per_kind_per_interval(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S", "60")
+        dropped = obs_metrics.get_registry().get(
+            "ts_flight_dumps_dropped_total"
+        )
+        rec = obs_recorder.FlightRecorder(maxlen=16)
+        rec.record("fault", "volume.put", action="die")
+        assert rec.dump("storm:1") is not None
+        before = dropped.value(reason="storm")
+        # Same kind inside the interval: suppressed + counted.
+        assert rec.dump("storm:2") is None
+        assert dropped.value(reason="storm") == before + 1
+        # A DIFFERENT kind is never shadowed by the storm.
+        assert rec.dump("quarantine:v1") is not None
+
+    def test_interval_zero_disables_and_reinit_clears(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S", "0")
+        rec = obs_recorder.FlightRecorder(maxlen=16)
+        rec.record("error", "x")
+        assert rec.dump("storm:a") is not None
+        assert rec.dump("storm:b") is not None  # limit disabled
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S", "60")
+        assert rec.dump("storm:c") is None
+        rec._last_dump["storm"] = time.monotonic() - 120
+        assert rec.dump("storm:d") is not None  # interval elapsed
+
+
+# --------------------------------------------------------------------------
+# fleet: injected landing delay -> scoreboard names the landing stage
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_slo_report_names_landing_dominant_under_injected_fault(
+    monkeypatch, fresh_digests
+):
+    """ISSUE-15 acceptance: a ``shm.landing_stamp`` delay (held inside the
+    one-sided landing-copy window) must blow the GET p99 SLO with the
+    LANDING stage dominant in ``ts.slo_report()`` — stage attribution, not
+    just an end-to-end timer."""
+    await ts.initialize(
+        store_name="slo_fault",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        items = {
+            f"sf/{i}": np.random.rand(1024).astype(np.float32)
+            for i in range(8)
+        }
+        await ts.put_batch(items, store_name="slo_fault")
+        dests = {k: np.empty_like(v) for k, v in items.items()}
+        # Record the one-sided plans BEFORE arming (recording gets ride
+        # the RPC path, which the faultpoint does not cover).
+        await ts.get_batch(dict(dests), store_name="slo_fault")
+        obs_timeline.op_quantiles().reset()
+        obs_timeline.stage_quantiles().reset()
+        monkeypatch.setenv("TORCHSTORE_TPU_SLO_GET_P99_MS", "5")
+        faults.arm("shm.landing_stamp", "delay", delay_ms=15)
+        try:
+            for _ in range(6):
+                await ts.get_batch(dict(dests), store_name="slo_fault")
+        finally:
+            faults.disarm("shm.landing_stamp")
+        report = await ts.slo_report(store_name="slo_fault")
+        row = report["slos"]["get_p99_ms"]
+        assert row["violations"] >= 1, report["slos"]
+        assert row["dominant_stage"] == "landing", row
+        assert row["stages"]["landing"]["share"] > 0.5, row["stages"]
+        # Overload signals ride the same report, per volume.
+        vols = report["overload"]["volumes"]
+        assert vols, report["overload"]
+        for signals in vols.values():
+            assert signals["landing_inflight"] == 0  # settled fleet
+            assert "doorbell_plans" in signals
+            assert signals["window_ops"] >= 0
+        json.dumps(report)
+    finally:
+        await ts.shutdown("slo_fault")
+
+
+@pytest.mark.anyio
+async def test_landing_inflight_gauge_settles_to_zero():
+    """Satellite: the volume publishes ``ts_landing_inflight`` from its
+    landing bracket — present after traffic and settled back to 0."""
+    await ts.initialize(
+        store_name="gauge_t",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        await ts.put(
+            "g/x", np.random.rand(512).astype(np.float32),
+            store_name="gauge_t",
+        )
+        client = ts.client("gauge_t")
+        vid = next(iter(client._volume_refs))
+        stats = await client._volume_refs[vid].actor.stats.call_one()
+        series = stats["metrics"]["ts_landing_inflight"]["series"]
+        assert series and all(s["value"] == 0 for s in series), series
+        assert stats["overload"]["landing_inflight"] == 0
+        # The volume's own stage digests rode stats() too.
+        assert "landing" in (stats["stages"].get("put") or {}), stats[
+            "stages"
+        ]
+    finally:
+        await ts.shutdown("gauge_t")
+
+
+# --------------------------------------------------------------------------
+# loadgen: multi-process run + chaos kill mid-run
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_loadgen_run_mixed_ops_and_scoreboard():
+    """A small but real loadgen run: 2 driver processes x 4 logical
+    clients with a bursty get/put/stream mix, slow readers, and churn.
+    The merged report carries every op kind, zero errors, and the
+    configured SLO on the scoreboard."""
+    await ts.initialize(num_storage_volumes=2, store_name="lg_run")
+    try:
+        # Seed + seal a streamed publish for the "stream" op.
+        stream = ts.state_dict_stream("lg_run/sd", store_name="lg_run")
+        await stream.put(
+            {"w": {str(i): np.random.rand(256).astype(np.float32)
+                   for i in range(3)}}
+        )
+        await stream.seal()
+        spec = LoadSpec(
+            store_name="lg_run",
+            duration_s=1.5,
+            processes=2,
+            clients_per_process=4,
+            pattern={
+                "kind": "burst",
+                "rate_hz": 10.0,
+                "peak_rate_hz": 40.0,
+                "period_s": 0.5,
+                "burst_frac": 0.3,
+            },
+            mix={"get": 0.6, "put": 0.2, "stream": 0.2},
+            stream_key="lg_run/sd",
+            shared_keys=8,
+            value_kb=2.0,
+            slow_reader_frac=0.25,
+            slow_reader_ms=2.0,
+            churn_rate_hz=1.0,
+            seed=11,
+            env={"TORCHSTORE_TPU_SLO_GET_P99_MS": "10000"},
+        )
+        merged = await run_fleet_load(spec)
+        assert merged["failed_drivers"] == 0, merged.get("driver_errors")
+        assert merged["errors"] == 0, merged["by_op"]
+        assert merged["ops"] > 0 and merged["ops_per_s"] > 0
+        assert merged["logical_clients"] == 8
+        for op in ("get", "put", "stream"):
+            assert merged["by_op"].get(op, {}).get("count", 0) > 0, (
+                merged["by_op"]
+            )
+            assert merged["by_op"][op]["p99_ms"] is not None
+        board = merged["slo"]["slos"]
+        assert "get_p99_ms" in board and not board["get_p99_ms"]["violated"]
+        json.dumps(merged)
+    finally:
+        await ts.shutdown("lg_run")
+
+
+async def _kill_volume(store_name: str, volume_id: str) -> None:
+    from torchstore_tpu import api
+
+    client = ts.client(store_name)
+    vmap = await client.controller.get_volume_map.call_one()
+    target = vmap[volume_id]["ref"]
+    handle = api._stores[store_name]
+    for mesh in [handle.volume_mesh, *(handle.repair_meshes or [])]:
+        if mesh is None:
+            continue
+        for idx, ref in enumerate(mesh.refs):
+            if (ref.host, ref.port, ref.name) == (
+                target.host,
+                target.port,
+                target.name,
+            ):
+                proc = mesh._processes[idx]
+                proc.kill()
+                proc.join(5)
+                return
+    raise AssertionError(f"no process found for volume {volume_id!r}")
+
+
+@pytest.mark.anyio
+async def test_loadgen_chaos_kill_zero_loss_and_scoreboard_violations(
+    fast_health,
+):
+    """ISSUE-15 chaos leg: kill one volume mid-loadgen-run (replicated
+    fleet, churning clients). The run must finish with zero failed
+    drivers and zero client-visible op errors (failover owns the
+    transients), every committed shared key must still be readable with
+    its exact seeded bytes (zero committed-generation loss), and the kill
+    must be visible in the merged scoreboard's violation counts (the
+    failover latency spike breaches the GET p99 SLO)."""
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="lg_chaos",
+    )
+    try:
+        spec = LoadSpec(
+            store_name="lg_chaos",
+            duration_s=4.0,
+            processes=2,
+            clients_per_process=6,
+            pattern="poisson",
+            rate_hz=15.0,
+            mix={"get": 0.8, "put": 0.2},
+            shared_keys=12,
+            value_kb=2.0,
+            churn_rate_hz=0.5,
+            seed=23,
+            # One-sided reads are kill-RESILIENT (stamped reads serve from
+            # the dead volume's still-mapped segments, so warm gets never
+            # even notice — a deliberate property). This chaos leg is
+            # about the RPC plane's failover, so drivers run with the
+            # one-sided path off: gets that hit the dead volume pay the
+            # retry/failover spike the SLO then catches.
+            env={"TORCHSTORE_TPU_SLO_GET_P99_MS": "40"},
+            config_overrides={"one_sided": False},
+        )
+        load = asyncio.ensure_future(run_fleet_load(spec))
+        client = ts.client("lg_chaos")
+        await client._ensure_setup()
+        # Kill only once every driver's measured window is OPEN (the
+        # ready markers): driver boot costs seconds of import — a
+        # wall-clock sleep would kill before any measured op, and the
+        # supervisor would route everything around the corpse before a
+        # single get could spike.
+        deadline = time.monotonic() + 30
+        for d in range(spec.processes):
+            while not await ts.exists(
+                f"lg_chaos/ctl/ready/{d}", store_name="lg_chaos"
+            ):
+                assert time.monotonic() < deadline, (
+                    f"driver {d} never opened its window"
+                )
+                await asyncio.sleep(0.1)
+        await asyncio.sleep(0.5)  # well inside every window
+        located = await client.controller.locate_volumes.call_one(
+            ["lg_chaos/shared/0"]
+        )
+        victim = sorted(located["lg_chaos/shared/0"])[0]
+        await _kill_volume("lg_chaos", victim)
+        merged = await load
+        assert merged["failed_drivers"] == 0, merged.get("driver_errors")
+        assert merged["errors"] == 0, merged["by_op"]
+        # Kill visible on the scoreboard: failover spikes breached the SLO.
+        row = merged["slo"]["slos"].get("get_p99_ms") or {}
+        assert row.get("violations", 0) > 0, merged["slo"]
+        # Zero committed-generation loss: every seeded shared key still
+        # serves its exact bytes (replication + supervisor failover).
+        n_elem = max(1, int(spec.value_kb * 1024 // 4))
+        seed_rng = np.random.default_rng(spec.seed)
+        expect = {
+            f"lg_chaos/shared/{i}": seed_rng.standard_normal(
+                n_elem, dtype=np.float32
+            )
+            for i in range(spec.shared_keys)
+        }
+        got = await ts.get_batch(list(expect), store_name="lg_chaos")
+        for key, want in expect.items():
+            np.testing.assert_array_equal(got[key], want)
+        # The dead volume surfaces in the fleet overload scrape.
+        report = await ts.slo_report(store_name="lg_chaos")
+        assert victim in report["overload"]["errors"] or (
+            victim not in report["overload"]["volumes"]
+        ), report["overload"]
+    finally:
+        await ts.shutdown("lg_chaos")

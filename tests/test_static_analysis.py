@@ -1030,3 +1030,84 @@ def test_shard_discipline_live_tree_clean():
     root = str(pathlib.Path(__file__).resolve().parents[1])
     result = run_checks(root, rules=["shard-discipline"])
     assert result.new == [], [str(f) for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# 14. stage-discipline
+# --------------------------------------------------------------------------
+
+
+def test_stage_discipline_flags_uncataloged_and_nonliteral_stages(tmp_path):
+    """stage-discipline: an ``observe_stage`` call with a literal stage
+    outside STAGE_CATALOG is drift; a non-literal stage defeats the
+    static guarantee; catalog entries pass; timeline.py itself (the
+    catalog's home) is exempt."""
+    from torchstore_tpu.analysis.checkers import stage_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu.observability import timeline as obs_timeline
+                def fine(dur):
+                    obs_timeline.observe_stage("get", "landing", dur)
+                def drifted(dur):
+                    obs_timeline.observe_stage("get", "landing_copy", dur)
+                def laundered(stage, dur):
+                    obs_timeline.observe_stage("get", stage, dur)
+            """,
+            "torchstore_tpu/observability/timeline.py": """
+                def observe_stage(op, stage, dur_s):
+                    _stages.observe(op, stage, dur_s)
+            """,
+        },
+    )
+    findings = stage_discipline.check(project)
+    assert len(findings) == 2, [str(f) for f in findings]
+    assert all(f.path == "torchstore_tpu/client.py" for f in findings)
+    drift, nonliteral = sorted(findings, key=lambda f: f.line)
+    assert "landing_copy" in drift.message
+    assert "non-literal" in nonliteral.message
+
+
+def test_stage_discipline_keyword_stage_checked(tmp_path):
+    from torchstore_tpu.analysis.checkers import stage_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/storage_volume.py": """
+                from torchstore_tpu.observability.timeline import observe_stage
+                def serve(dur):
+                    observe_stage("put", stage="stamp_verfy", dur_s=dur)
+            """,
+        },
+    )
+    findings = stage_discipline.check(project)
+    assert len(findings) == 1
+    assert "stamp_verfy" in findings[0].message
+
+
+def test_stage_discipline_pragma(tmp_path):
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu.observability import timeline as obs_timeline
+                def experimental(dur):
+                    obs_timeline.observe_stage("get", "prototype", dur)  # tslint: disable=stage-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["stage-discipline"])
+    assert result.new == []
+
+
+def test_stage_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays
+    empty): every client- and volume-side stage segment records under a
+    STAGE_CATALOG name, so the dominant-stage attribution in
+    ``ts.slo_report()`` folds both sides into one taxonomy."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["stage-discipline"])
+    assert _msgs(result.findings, "stage-discipline") == []

@@ -44,6 +44,7 @@ from torchstore_tpu.api import (
     repair,
     reset_client,
     shutdown,
+    slo_report,
     sync_timeline,
     tier_sweep,
     traffic_matrix,
@@ -130,6 +131,7 @@ __all__ = [
     "repair",
     "reset_client",
     "shutdown",
+    "slo_report",
     "span",
     "sync_timeline",
     "tier_sweep",

@@ -21,6 +21,7 @@ from torchstore_tpu.analysis.checkers import (
     quant_discipline,
     retry_discipline,
     shard_discipline,
+    stage_discipline,
     stream_discipline,
 )
 
@@ -38,4 +39,5 @@ CHECKERS = {
     stream_discipline.RULE: stream_discipline.check,
     quant_discipline.RULE: quant_discipline.check,
     shard_discipline.RULE: shard_discipline.check,
+    stage_discipline.RULE: stage_discipline.check,
 }

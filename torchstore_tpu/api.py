@@ -11,6 +11,7 @@ reference (/root/reference/torchstore/api.py:118-123).
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import os
 import pickle
@@ -865,6 +866,76 @@ async def sync_timeline(
     return obs_timeline.reconstruct(state)
 
 
+async def slo_report(store_name: Optional[str] = DEFAULT_STORE) -> dict:
+    """The live SLO scoreboard: every configured ``TORCHSTORE_TPU_SLO_*``
+    threshold with its current value, violation count, violated flag, and
+    — per violated SLO — the dominant stage (plan / transport / landing /
+    stamp_verify / watermark_wait / notify) with the full per-stage
+    wall-time breakdown, so "p99 blew the budget" comes with "and THIS
+    stage ate it".
+
+    With a ``store_name`` (default store when omitted) the report also
+    carries fleet ``overload`` signals — per-volume inflight landings,
+    resident doorbell plans, rolling-window transfer totals, each
+    volume's OWN per-stage digests (its landing bracket / serve legs:
+    read these next to the client's dominant stage — a client
+    "transport" verdict whose wall time is rivaled by a volume's
+    "landing" row means the landing pool, not the wire, is the stall),
+    and this client's per-shard metadata-RPC inflight — the inputs
+    admission control (ROADMAP item 3) consumes. ``store_name=None``
+    returns the process-local scoreboard only (what loadgen drivers ship
+    home; see ``loadgen.report.merge_slo_reports`` for the fleet fold).
+
+    Returns ``{"slos": {name: {"env", "threshold", "current",
+    "violations", "violated", "op", "dominant_stage"?, "stages"?}},
+    "stages": {op: {stage: {...}}}, "overload": {"volumes": {vid: {...}},
+    "metadata_rpc_inflight": {...}, "errors": {...}}, "generated_ts"}``."""
+    from torchstore_tpu.observability import timeline as obs_timeline
+
+    report = obs_timeline.slo_report()
+    if store_name is None:
+        return report
+    overload: dict = {
+        "volumes": {},
+        "metadata_rpc_inflight": {},
+        "errors": {},
+    }
+    report["overload"] = overload
+    try:
+        c = client(store_name)
+        await c._ensure_setup()
+    except Exception as exc:  # noqa: BLE001 - no fleet: local view serves
+        overload["errors"]["fleet"] = f"{type(exc).__name__}: {exc}"
+        return report
+    snapshot_fn = getattr(c.controller, "inflight_snapshot", None)
+    if snapshot_fn is not None:
+        overload["metadata_rpc_inflight"] = snapshot_fn()
+
+    async def scrape(vid: str) -> None:
+        try:
+            st = await c._volume_refs[vid].actor.stats.call_one()
+        except Exception as exc:  # noqa: BLE001 - dead volume: report it
+            overload["errors"][vid] = f"{type(exc).__name__}: {exc}"[:200]
+            return
+        entry = dict(st.get("overload") or {})
+        window = (st.get("ledger") or {}).get("window") or {}
+        entry["window_ops"] = window.get("ops", 0)
+        entry["window_bytes"] = window.get("bytes", 0)
+        # The volume's OWN per-stage digests ride the report next to the
+        # client-side attribution. They are NOT summed into the client's
+        # stage table: the client's "transport" span CONTAINS the
+        # volume's "landing" bracket (nested wall time — summing would
+        # double-count and can never flip the vote), so a wedged landing
+        # pool is diagnosed by reading the volume rows — e.g. put.landing
+        # p99 here rivaling the client's put.transport p99.
+        if st.get("stages"):
+            entry["stages"] = st["stages"]
+        overload["volumes"][vid] = entry
+
+    await asyncio.gather(*(scrape(vid) for vid in sorted(c._volume_refs or {})))
+    return report
+
+
 async def inject_fault(
     name: str,
     action: str,
@@ -1158,6 +1229,7 @@ __all__ = [
     "reset_client",
     "shutdown",
     "state_dict_stream",
+    "slo_report",
     "sync_timeline",
     "tier_sweep",
     "traffic_matrix",

@@ -557,6 +557,9 @@ class LocalClient:
         for key, value in items.items():
             requests.extend(self._value_to_requests(key, value))
         volumes = self._put_volumes()
+        # Stage attribution: everything before the first byte moves is the
+        # planning leg (setup, D2H kicks, request building, placement).
+        obs_timeline.observe_stage("put", "plan", tracker.elapsed)
         nbytes = sum(r.nbytes for r in requests)
         sp.set(nbytes=nbytes, replicas=len(volumes))
         hot = obs_profile.hot_key_tracker()
@@ -684,6 +687,8 @@ class LocalClient:
                         )
         if not landed:
             raise failed[0][1]
+        # The wire legs themselves record the "transport" stage per volume
+        # (transport/buffers.py) — the tracker only logs the wall span here.
         tracker.track_step("data_plane", nbytes)
         for volume, exc in failed:
             # Partial replication failure on an OVERWRITE would leave the
@@ -722,7 +727,7 @@ class LocalClient:
         # The notify reply carries the placement epoch for free: a bump
         # (structural change anywhere in the fleet) drops cached plans.
         self._observe_epoch(epoch)
-        tracker.track_step("notify")
+        obs_timeline.observe_stage("put", "notify", tracker.track_step("notify"))
         tracker.log_summary()
         return nbytes
 
@@ -1137,6 +1142,7 @@ class LocalClient:
             served = await self._fetch_all_one_sided(requests)
             if served is not None:
                 return served
+        t_plan = time.perf_counter()
         keys = list({r.key for r in requests})
         located: dict[str, dict[str, StorageInfo]] = {}
         missing = []
@@ -1189,6 +1195,11 @@ class LocalClient:
                     for key, infos in fresh.items()
                     if prefer_volume not in infos
                 )
+        # Stage attribution: location resolve (cache / stamped segments /
+        # RPC locate) + request partitioning is the get's planning leg.
+        obs_timeline.observe_stage(
+            "get", "plan", time.perf_counter() - t_plan
+        )
         # volume_id -> list of (request_index, sub_request)
         by_volume: dict[str, list[tuple[int, Request]]] = {}
         inplace_ok = self._transports_support_inplace(located)

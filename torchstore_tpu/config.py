@@ -271,6 +271,11 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "Directory for flight-recorder post-mortem dumps (default: "
            "<tmpdir>/torchstore_tpu_flight; one file per trigger per "
            "pid, atomically replaced)."),
+    EnvVar("TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S", "float", 30,
+           "Per-trigger-kind flight-dump rate limit: under a sustained "
+           "fault storm at most one post-mortem per kind per this many "
+           "seconds is written (the rest are counted in "
+           "ts_flight_dumps_dropped_total). 0 disables the limit."),
     # --- SLOs (TORCHSTORE_TPU_SLO_* is a registered dynamic family:
     # operators may add their own; these are the shipped, wired-up bars.
     # Unset = disabled; breaches log + count ts_slo_violations_total) ----

@@ -15,11 +15,19 @@ store's hot paths:
     volume.get            StorageVolume.get entry
     volume.handshake      StorageVolume.handshake entry (all transports)
     shm.handshake         SHM server-side recv_handshake (volume process)
-    shm.landing_stamp     volume-side entry-stamp bracket: fires after the
-                          per-entry seqlock goes odd, before the landing is
-                          applied — delay/wedge holds entries visibly
-                          write-in-flight so one-sided readers observe the
-                          odd stamp and fall back
+    shm.landing_stamp     TWO fire sites bracketing landing copies.
+                          Volume-side (storage_volume._begin_landing):
+                          fires after the per-entry seqlock goes odd,
+                          before the landing is applied — delay/wedge
+                          holds entries visibly write-in-flight so
+                          one-sided readers observe the odd stamp and
+                          fall back. Client-side (shared_memory.
+                          stamped_read_batch): fires inside the warm
+                          one-sided read's landing-copy window (between
+                          stamp check and memcpy) — arm with
+                          scope="client" to slow the get's landing stage
+                          without touching any volume (the fleet-scale
+                          stage-attribution legs)
     channel.publish_layer publisher-side entry of every streamed layer
                           batch (stream_sync.StreamedPut.put) — wedge/delay
                           freezes a publisher mid-stream; readers must keep

@@ -1,0 +1,54 @@
+"""Fleet-scale load harness: prove "millions of users" arithmetic on one box.
+
+A scale-model load generator (ROADMAP item 6): ``processes`` OS driver
+processes x ``clients_per_process`` logical asyncio clients — hundreds of
+simulated generator processes, thousands of logical clients — driving
+puts, warm one-sided gets, streamed acquires, and pinned-version reads
+against a live multi-volume fleet under composable arrival patterns:
+
+- **arrivals** (:mod:`torchstore_tpu.loadgen.arrivals`): Poisson
+  steady-state, square-wave bursts, diurnal (time-compressed sinusoid)
+  skew — all deterministic per seed — plus per-client churn schedules
+  (sessions that join/leave mid-run, riding relay membership when a
+  relay channel is configured) and slow-reader pacing.
+- **harness** (:mod:`torchstore_tpu.loadgen.harness`): :class:`LoadSpec`
+  describes one run; :func:`run_fleet_load` spawns the driver processes
+  (the ``metadata_scale`` bench's multi-process pattern), each driver
+  runs its logical clients to the spec and ships home per-op latency
+  samples, error counts, and its process-local ``slo_report()``.
+- **report** (:mod:`torchstore_tpu.loadgen.report`): folds driver reports
+  into the fleet view — sustained ops/s over the drivers' own measured
+  windows, exact merged p50/p99 per op, and the merged SLO scoreboard
+  (violation counts summed, dominant stage recomputed from summed
+  per-stage wall time) the ``fleet_scale`` bench gates on.
+
+The harness is also the chaos vehicle: pair a spec with armed faultpoints
+(``ts.inject_fault`` / ``TORCHSTORE_TPU_FAULTPOINTS`` in ``spec.env``) or
+kill a volume mid-run, and the merged scoreboard shows the blast radius —
+which SLO blew, how often, and which stage ate the budget.
+"""
+
+from torchstore_tpu.loadgen.arrivals import (
+    PATTERNS,
+    ArrivalPattern,
+    churn_sessions,
+    make_pattern,
+)
+from torchstore_tpu.loadgen.harness import LoadSpec, run_fleet_load
+from torchstore_tpu.loadgen.report import (
+    merge_driver_reports,
+    merge_slo_reports,
+    quantile_ms,
+)
+
+__all__ = [
+    "ArrivalPattern",
+    "LoadSpec",
+    "PATTERNS",
+    "churn_sessions",
+    "make_pattern",
+    "merge_driver_reports",
+    "merge_slo_reports",
+    "quantile_ms",
+    "run_fleet_load",
+]

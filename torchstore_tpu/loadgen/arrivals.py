@@ -1,0 +1,131 @@
+"""Composable arrival processes for the fleet-scale load harness.
+
+Real serving fleets (TensorHub, PAPERS.md) never see uniform load: they
+see Poisson steady-state with bursts riding on diurnal swings, readers of
+wildly different speeds, and membership churn. Each pattern here is a
+time-varying rate function ``rate_at(t)`` plus an inter-arrival sampler —
+everything is driven off a caller-owned ``random.Random`` so a (seed,
+pattern) pair replays the exact same schedule in every driver process.
+
+Patterns (``make_pattern`` accepts the name or a ``{"kind": ...}`` dict
+overriding the defaults):
+
+    steady    fixed gaps at ``rate_hz`` (a metronome, the control case)
+    poisson   exponential gaps at ``rate_hz`` (memoryless steady state)
+    burst     square wave: ``rate_hz`` baseline, ``peak_rate_hz`` during
+              the first ``burst_frac`` of every ``period_s`` window
+    diurnal   sinusoid between ``rate_hz`` and ``peak_rate_hz`` over
+              ``period_s`` (a day, time-compressed to the run length)
+
+Churn (:func:`churn_sessions`) turns one logical client into alternating
+live/offline sessions: live spans are exponential around
+``1 / churn_rate_hz``, offline gaps a quarter of that — so at any instant
+~80% of clients are up, and joins/leaves land all through the run instead
+of at its edges.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Union
+
+PATTERNS = ("steady", "poisson", "burst", "diurnal")
+
+# A pattern's instantaneous rate never falls below this (a zero-rate
+# trough would make next_gap infinite and wedge the client loop).
+_MIN_RATE_HZ = 0.01
+
+
+class ArrivalPattern:
+    """One arrival process: ``rate_at(t)`` in ops/s and ``next_gap(t,
+    rng)`` in seconds. ``t`` is seconds since the run's start."""
+
+    def __init__(
+        self,
+        kind: str = "poisson",
+        rate_hz: float = 20.0,
+        peak_rate_hz: float = 0.0,
+        period_s: float = 1.0,
+        burst_frac: float = 0.25,
+    ) -> None:
+        if kind not in PATTERNS:
+            raise ValueError(
+                f"unknown arrival pattern {kind!r}; choose from {PATTERNS}"
+            )
+        self.kind = kind
+        self.rate_hz = max(_MIN_RATE_HZ, float(rate_hz))
+        self.peak_rate_hz = max(float(peak_rate_hz), self.rate_hz)
+        self.period_s = max(1e-3, float(period_s))
+        self.burst_frac = min(1.0, max(0.0, float(burst_frac)))
+
+    def rate_at(self, t: float) -> float:
+        if self.kind in ("steady", "poisson"):
+            return self.rate_hz
+        phase = (t % self.period_s) / self.period_s
+        if self.kind == "burst":
+            return (
+                self.peak_rate_hz
+                if phase < self.burst_frac
+                else self.rate_hz
+            )
+        # diurnal: sinusoid between base and peak, trough at t=3/4 period.
+        mid = (self.rate_hz + self.peak_rate_hz) / 2.0
+        amp = (self.peak_rate_hz - self.rate_hz) / 2.0
+        return max(
+            _MIN_RATE_HZ, mid + amp * math.sin(2.0 * math.pi * phase)
+        )
+
+    def next_gap(self, t: float, rng: random.Random) -> float:
+        """Seconds until this client's next op, sampled at the CURRENT
+        rate (piecewise-stationary approximation of the non-homogeneous
+        process — exact for steady/poisson, faithful at harness scale for
+        the modulated shapes)."""
+        rate = self.rate_at(t)
+        if self.kind == "steady":
+            return 1.0 / rate
+        return rng.expovariate(rate)
+
+    def spec(self) -> dict:
+        return {
+            "kind": self.kind,
+            "rate_hz": self.rate_hz,
+            "peak_rate_hz": self.peak_rate_hz,
+            "period_s": self.period_s,
+            "burst_frac": self.burst_frac,
+        }
+
+
+def make_pattern(spec: Union[str, dict, ArrivalPattern]) -> ArrivalPattern:
+    """``"poisson"`` | ``{"kind": "burst", "peak_rate_hz": 200, ...}`` |
+    an already-built pattern (passed through)."""
+    if isinstance(spec, ArrivalPattern):
+        return spec
+    if isinstance(spec, str):
+        return ArrivalPattern(kind=spec)
+    return ArrivalPattern(**spec)
+
+
+def churn_sessions(
+    duration_s: float, churn_rate_hz: float, rng: random.Random
+) -> list[tuple[float, float]]:
+    """One client's ``[(join_t, leave_t), ...]`` schedule over the run.
+
+    ``churn_rate_hz <= 0`` means no churn: one session spanning the whole
+    run. Otherwise live spans draw from an exponential with mean
+    ``1 / churn_rate_hz`` and offline gaps from one a quarter as long
+    (~80% duty cycle), with the first join jittered into the first live
+    span so a thousand churning clients don't all (re)join at t=0."""
+    if churn_rate_hz <= 0:
+        return [(0.0, duration_s)]
+    mean_up = 1.0 / churn_rate_hz
+    mean_down = mean_up / 4.0
+    sessions: list[tuple[float, float]] = []
+    t = rng.uniform(0.0, mean_up / 2.0)
+    while t < duration_s:
+        up = rng.expovariate(1.0 / mean_up)
+        leave = min(duration_s, t + up)
+        if leave - t > 1e-3:
+            sessions.append((t, leave))
+        t = leave + rng.expovariate(1.0 / mean_down)
+    return sessions or [(0.0, duration_s)]

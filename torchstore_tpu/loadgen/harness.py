@@ -1,0 +1,430 @@
+"""The loadgen driver: LoadSpec in, merged fleet report out.
+
+One :func:`run_fleet_load` call spawns ``spec.processes`` OS driver
+processes (the ``metadata_scale`` bench's multi-process pattern: complete
+env snapshot, per-driver pipe, measured windows that exclude boot), each
+running ``spec.clients_per_process`` logical asyncio clients. Every
+logical client replays a deterministic schedule derived from
+``spec.seed``: its arrival pattern gaps, its op draws from ``spec.mix``,
+its churn sessions, and whether it is a slow reader.
+
+Op kinds (weights in ``spec.mix``):
+
+    get     warm get of a pre-seeded shared key into a per-client
+            destination array — the one-sided zero-RPC path once plans
+            record (the fleet's dominant op, as in production serving)
+    put     put_batch of the client's OWN key (no cross-client stamp
+            churn on the shared working set)
+    stream  streamed state-dict acquire of ``spec.stream_key`` (the
+            harness seeds + seals it before drivers launch) — exercises
+            watermark waits and the final consistency re-check
+    pinned  barrier get_state_dict of ``spec.pinned_key`` (a historical
+            channel version the harness holds a retention lease on)
+
+Churn sessions re-enter through a FRESH ``reset_client`` boundary only at
+the process level (clients share the process's LocalClient — per-session
+actor re-dials at thousand-client scale would measure connection setup,
+not the store); joining/leaving rides relay membership instead when
+``spec.relay_channel`` is set, which is the membership signal the relay
+trees actually consume.
+
+Each driver ships home: per-op counts/errors, bounded latency samples
+(decimated past ``spec.max_samples`` — quantiles stay exact to sampling),
+its own measured window, and its process-local ``timeline.slo_report()``
+(merged fleet-side by :mod:`torchstore_tpu.loadgen.report`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from torchstore_tpu.loadgen import report as report_mod
+from torchstore_tpu.loadgen.arrivals import churn_sessions, make_pattern
+
+_OPS = ("get", "put", "stream", "pinned")
+
+
+@dataclass
+class LoadSpec:
+    """One fleet-scale load run. Everything is plain data (JSON round-trip
+    via ``to_json``/``from_json``): the spec crosses the process boundary
+    as a string, never a pickle."""
+
+    store_name: str = "loadgen"
+    duration_s: float = 3.0
+    processes: int = 8
+    clients_per_process: int = 128
+    # Arrival pattern: a PATTERNS name or a full spec dict
+    # ({"kind", "rate_hz", "peak_rate_hz", "period_s", "burst_frac"}).
+    pattern: Any = "poisson"
+    rate_hz: float = 10.0  # per logical client, baseline
+    # Op mix weights; ops absent (or zero) are never drawn. stream/pinned
+    # require stream_key/pinned_key (seeded by the caller).
+    mix: dict = field(default_factory=lambda: {"get": 0.8, "put": 0.2})
+    value_kb: float = 4.0
+    shared_keys: int = 64
+    # Churn: per-client session turnover rate (0 = stable membership);
+    # joins/leaves ride relay membership when relay_channel is set.
+    churn_rate_hz: float = 0.0
+    relay_channel: Optional[str] = None
+    # Slow readers: this fraction of clients pauses slow_reader_ms after
+    # every get (and per streamed layer) — consumption pacing, the
+    # "straggler subscriber" shape.
+    slow_reader_frac: float = 0.0
+    slow_reader_ms: float = 5.0
+    stream_key: Optional[str] = None
+    pinned_key: Optional[str] = None
+    seed: int = 0
+    max_samples: int = 20000
+    # Extra TORCHSTORE_TPU_* env for the DRIVER processes (SLO thresholds,
+    # faultpoints, ledger toggles): overlaid on the parent's snapshot.
+    # NOTE: StoreConfig-derived flags (one_sided, transports, retry) ride
+    # the store handle's PICKLED config from the initializing process —
+    # env overrides here cannot reach them; use config_overrides.
+    env: dict = field(default_factory=dict)
+    # StoreConfig field overrides applied to each driver's client config
+    # (dataclasses.replace) — e.g. {"one_sided": False} to force every
+    # get onto the RPC plane (chaos legs measuring failover, which the
+    # kill-resilient one-sided path deliberately hides).
+    config_overrides: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        spec = dataclasses.asdict(self)
+        if not isinstance(spec["pattern"], (str, dict)):
+            spec["pattern"] = self.pattern.spec()
+        return json.dumps(spec)
+
+    @classmethod
+    def from_json(cls, text: str) -> "LoadSpec":
+        return cls(**json.loads(text))
+
+
+def _client_rng(spec: LoadSpec, driver_idx: int, client_idx: int):
+    import random
+
+    return random.Random(
+        (spec.seed * 1000003 + driver_idx * 1009 + client_idx) & 0x7FFFFFFF
+    )
+
+
+def _driver_main(env: dict, spec_json: str, driver_idx: int, conn) -> None:
+    """Driver PROCESS entry (multiprocessing target — must stay
+    module-level importable). Scrubs the forkserver's stale
+    TORCHSTORE_TPU_* snapshot exactly like runtime.actors._child_main,
+    overlays the spec's env, then runs the async drive."""
+    import asyncio as _asyncio
+    import os as _os
+
+    for key in list(_os.environ):
+        if key.startswith("TORCHSTORE_TPU_") and key not in env:
+            del _os.environ[key]
+    _os.environ.update(env)
+    _os.environ.setdefault("TORCHSTORE_TPU_LOG_LEVEL", "ERROR")
+    from torchstore_tpu import config as _config_mod
+    from torchstore_tpu import faults as _faults
+
+    _config_mod._default_config = None
+    _faults.reinit_after_fork()
+    spec = LoadSpec.from_json(spec_json)
+    try:
+        out = _asyncio.run(_drive(spec, driver_idx))
+    except BaseException as exc:  # noqa: BLE001 - ship the failure home
+        out = {"driver_error": f"{type(exc).__name__}: {exc}"[:500]}
+    try:
+        conn.send(out)
+    finally:
+        conn.close()
+
+
+async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
+    import asyncio
+    import time
+
+    import numpy as np
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.observability import timeline as obs_timeline
+    from torchstore_tpu.utils import get_hostname
+
+    client = ts.client(spec.store_name)
+    await client._ensure_setup()
+    if spec.config_overrides:
+        client._config = dataclasses.replace(
+            client._config, **spec.config_overrides
+        )
+    pattern = make_pattern(spec.pattern)
+    if pattern.rate_hz != spec.rate_hz and isinstance(spec.pattern, str):
+        # Bare pattern names take the spec's baseline rate; dict specs own
+        # their rates explicitly.
+        pattern = make_pattern({**pattern.spec(), "rate_hz": spec.rate_hz})
+    ops = [op for op in _OPS if spec.mix.get(op)]
+    weights = [float(spec.mix[op]) for op in ops]
+    if not ops:
+        raise ValueError(f"LoadSpec.mix selects no ops: {spec.mix!r}")
+    shared = [f"{spec.store_name}/shared/{i}" for i in range(spec.shared_keys)]
+    n_elem = max(1, int(spec.value_kb * 1024 // 4))
+
+    # Warmup BEFORE the measured window: create every client's own key
+    # now (a first put of a NEW key is a structural placement-epoch bump
+    # that invalidates plans fleet-wide — 1k clients doing that inside
+    # the window would measure epoch churn, not steady state) and touch
+    # the shared working set once so locates/one-sided plans are warm.
+    # Real fleets run for hours; the measured window models their steady
+    # state, and the cold start is visible in the window_s vs duration_s
+    # gap, not buried in the p99.
+    own_keys = {
+        i: f"{spec.store_name}/own/{driver_idx}/{i}"
+        for i in range(spec.clients_per_process)
+    }
+    if "put" in ops:
+        warm_val = np.zeros(n_elem, np.float32)
+        for start in range(0, spec.clients_per_process, 64):
+            await client.put_batch(
+                {
+                    own_keys[i]: warm_val
+                    for i in range(
+                        start, min(start + 64, spec.clients_per_process)
+                    )
+                }
+            )
+    if "get" in ops:
+        warm_dests = {key: np.zeros(n_elem, np.float32) for key in shared}
+        await client.get_batch(warm_dests)  # locate + record plans
+        await client.get_batch(warm_dests)  # warm one-sided pass
+
+    counts = {op: 0 for op in ops}
+    errors: dict[str, int] = {}
+    samples: dict[str, list[float]] = {op: [] for op in ops}
+
+    def observe(op: str, dur_s: float) -> None:
+        counts[op] += 1
+        bucket = samples[op]
+        if len(bucket) >= spec.max_samples:
+            # Decimate in place (drop every other sample) — a uniform
+            # thinning that keeps quantiles representative while bounding
+            # what crosses the pipe home.
+            del bucket[::2]
+        bucket.append(dur_s)
+
+    async def one_client(client_idx: int, stop_at: float) -> None:
+        rng = _client_rng(spec, driver_idx, client_idx)
+        slow = rng.random() < spec.slow_reader_frac
+        own_key = own_keys[client_idx]
+        own_val = np.random.default_rng(client_idx).standard_normal(
+            n_elem, dtype=np.float32
+        )
+        dests = {}
+        t0 = time.monotonic()
+        sessions = churn_sessions(
+            spec.duration_s, spec.churn_rate_hz, rng
+        )
+
+        async def run_session(leave_t: float) -> None:
+            subscribed = None
+            if spec.relay_channel:
+                try:
+                    sub = await client.controller.relay_subscribe.call_one(
+                        spec.relay_channel, get_hostname()
+                    )
+                    subscribed = sub.get("volume_id")
+                except Exception:  # noqa: BLE001 - membership is advisory
+                    subscribed = None
+            try:
+                while True:
+                    now = time.monotonic() - t0
+                    if now >= leave_t or time.monotonic() >= stop_at:
+                        return
+                    gap = pattern.next_gap(now, rng)
+                    await asyncio.sleep(
+                        min(gap, max(0.0, leave_t - now))
+                    )
+                    if time.monotonic() >= stop_at:
+                        return
+                    if time.monotonic() - t0 >= leave_t:
+                        # The session ended before this gap elapsed: the
+                        # arrival pattern never scheduled an op here —
+                        # firing one anyway would cluster unscheduled ops
+                        # at every session boundary (at high churn, far
+                        # MORE load than the configured rate).
+                        return
+                    op = rng.choices(ops, weights=weights)[0]
+                    t_op = time.perf_counter()
+                    try:
+                        if op == "get":
+                            key = shared[rng.randrange(len(shared))]
+                            dest = dests.get(key)
+                            if dest is None:
+                                dest = dests[key] = np.zeros(
+                                    n_elem, np.float32
+                                )
+                            await client.get_batch({key: dest})
+                        elif op == "put":
+                            own_val[0] = counts["put"]
+                            await client.put_batch({own_key: own_val})
+                        elif op == "stream":
+                            on_layer = None
+                            if slow:
+                                async def on_layer(fk, value):  # noqa: ARG001
+                                    await asyncio.sleep(
+                                        spec.slow_reader_ms / 1e3
+                                    )
+                            await ts.get_state_dict(
+                                spec.stream_key,
+                                stream=True,
+                                on_layer=on_layer,
+                                store_name=spec.store_name,
+                            )
+                        elif op == "pinned":
+                            await ts.get_state_dict(
+                                spec.pinned_key,
+                                store_name=spec.store_name,
+                            )
+                    except Exception:  # noqa: BLE001 - counted, run goes on
+                        errors[op] = errors.get(op, 0) + 1
+                    else:
+                        observe(op, time.perf_counter() - t_op)
+                        if slow and op == "get":
+                            await asyncio.sleep(spec.slow_reader_ms / 1e3)
+            finally:
+                if subscribed is not None:
+                    try:
+                        await client.controller.relay_unsubscribe.call_one(
+                            spec.relay_channel, subscribed
+                        )
+                    except Exception:  # noqa: BLE001 - leaving is advisory
+                        pass
+
+        for join_t, leave_t in sessions:
+            now = time.monotonic() - t0
+            if now < join_t:
+                await asyncio.sleep(join_t - now)
+            if time.monotonic() >= stop_at:
+                return
+            await run_session(leave_t)
+
+    # Ready marker: chaos harnesses (kill-mid-run tests) need to know the
+    # measured window is OPEN before they strike — wall-clock sleeps race
+    # the seconds of driver boot/import and land their chaos on an idle
+    # fleet. One put per driver, BEFORE the window opens so its
+    # structural epoch bump never pollutes the first samples.
+    await client.put_batch(
+        {
+            f"{spec.store_name}/ctl/ready/{driver_idx}": np.zeros(
+                1, np.float32
+            )
+        }
+    )
+    # The measured window opens AFTER boot/attach: sustained ops/s divides
+    # by what the drivers actually drove, never spawn/import time.
+    t_start = time.monotonic()
+    stop_at = t_start + spec.duration_s
+    await asyncio.gather(
+        *(one_client(i, stop_at) for i in range(spec.clients_per_process))
+    )
+    return {
+        "driver": driver_idx,
+        "counts": counts,
+        "errors": errors,
+        "samples": samples,
+        "window_s": time.monotonic() - t_start,
+        "slo": obs_timeline.slo_report(),
+    }
+
+
+async def run_fleet_load(spec: LoadSpec) -> dict:
+    """Run one loadgen spec against an ALREADY-INITIALIZED store fleet
+    (the caller owns initialize/seed/shutdown — the bench and the chaos
+    tests both reuse fleets across legs). Seeds the shared get working
+    set, spawns the driver processes, and folds their reports.
+
+    Returns the merged report (see ``report.merge_driver_reports``) plus
+    ``{"logical_clients", "failed_drivers", "driver_errors"}``."""
+    import os
+
+    import numpy as np
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.runtime.actors import _mp_context
+
+    client = ts.client(spec.store_name)
+    await client._ensure_setup()
+    n_elem = max(1, int(spec.value_kb * 1024 // 4))
+    seed_rng = np.random.default_rng(spec.seed)
+    await client.put_batch(
+        {
+            f"{spec.store_name}/shared/{i}": seed_rng.standard_normal(
+                n_elem, dtype=np.float32
+            )
+            for i in range(spec.shared_keys)
+        }
+    )
+    env = {
+        k: v for k, v in os.environ.items() if k.startswith("TORCHSTORE_TPU_")
+    }
+    env.update({k: str(v) for k, v in (spec.env or {}).items()})
+    ctx = _mp_context()
+    procs = []
+    spec_json = spec.to_json()
+    for d in range(spec.processes):
+        parent, child = ctx.Pipe()
+        proc = ctx.Process(
+            target=_driver_main,
+            args=(env, spec_json, d, child),
+            daemon=True,
+            name=f"ts-loadgen-{d}",
+        )
+        proc.start()
+        child.close()
+        procs.append((proc, parent))
+    reports: list[dict] = []
+    failed = 0
+    driver_errors: list[str] = []
+    loop = asyncio.get_running_loop()
+
+    def _recv(parent) -> Optional[dict]:
+        # Blocking pipe wait — MUST run on an executor thread: a bare
+        # parent.poll() here would freeze the caller's whole event loop
+        # for the run's duration, silently serializing "concurrent" work
+        # (the bench's under-load measurement, a chaos harness's
+        # kill-timing) until the drivers finish.
+        if parent.poll(spec.duration_s + 120):
+            return parent.recv()
+        return None
+
+    async def _collect(parent) -> None:
+        nonlocal failed
+        try:
+            rep = await loop.run_in_executor(None, _recv, parent)
+        except (EOFError, OSError):
+            failed += 1
+            driver_errors.append("driver pipe broke (process died?)")
+            return
+        if rep is None:
+            failed += 1
+            driver_errors.append("driver timed out")
+        elif "driver_error" in rep:
+            failed += 1
+            driver_errors.append(rep["driver_error"])
+        else:
+            reports.append(rep)
+
+    await asyncio.gather(*(_collect(parent) for _, parent in procs))
+    for proc, _ in procs:
+        proc.join(10)
+        if proc.is_alive():
+            proc.terminate()
+    merged = report_mod.merge_driver_reports(reports)
+    merged["logical_clients"] = spec.processes * spec.clients_per_process
+    merged["failed_drivers"] = failed
+    if driver_errors:
+        merged["driver_errors"] = driver_errors[:8]
+        print(
+            f"# loadgen: {failed} driver(s) failed: {driver_errors[:3]}",
+            file=sys.stderr,
+        )
+    return merged

@@ -1,0 +1,145 @@
+"""Fold per-driver loadgen reports into the fleet view the bench gates on.
+
+Each driver process ships home (harness._drive): per-op latency sample
+lists (bounded, decimated past the cap), op/error counts, its own measured
+op window, and its process-local ``timeline.slo_report()``. The merges
+here are exact where it matters:
+
+- latency quantiles are computed over the CONCATENATED samples (never an
+  average of per-driver quantiles — that underestimates the tail the SLO
+  gate is about);
+- ops/s divides by the MAX driver window (drivers run concurrently; boot
+  and spawn time never deflate the sustained rate — the metadata_scale
+  lesson);
+- scoreboard violation counts SUM across drivers, and the dominant stage
+  per violated SLO is recomputed from the SUMMED per-stage wall time, so
+  one driver's noisy attribution can't outvote the fleet's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def quantile_ms(samples: list[float], q: float) -> Optional[float]:
+    """Exact q-quantile of a seconds-sample list, in milliseconds."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, int(len(ordered) * q))
+    return ordered[idx] * 1e3
+
+
+def merge_driver_reports(reports: list[dict]) -> dict:
+    """Fleet fold of ``harness._drive`` reports (drivers that died or
+    timed out are simply absent — the caller tracks ``failed_drivers``).
+
+    Returns ``{"ops", "ops_per_s", "window_s", "by_op": {op: {"count",
+    "errors", "p50_ms", "p99_ms"}}, "errors", "slo": merged scoreboard,
+    "drivers"}``."""
+    by_op: dict[str, dict] = {}
+    samples: dict[str, list[float]] = {}
+    windows: list[float] = []
+    total_ops = 0
+    total_errors = 0
+    for rep in reports:
+        windows.append(float(rep.get("window_s") or 0.0))
+        for op, count in (rep.get("counts") or {}).items():
+            row = by_op.setdefault(op, {"count": 0, "errors": 0})
+            row["count"] += int(count)
+            total_ops += int(count)
+        for op, errs in (rep.get("errors") or {}).items():
+            row = by_op.setdefault(op, {"count": 0, "errors": 0})
+            row["errors"] += int(errs)
+            total_errors += int(errs)
+        for op, vals in (rep.get("samples") or {}).items():
+            samples.setdefault(op, []).extend(vals)
+    for op, row in by_op.items():
+        row["p50_ms"] = quantile_ms(samples.get(op, []), 0.5)
+        row["p99_ms"] = quantile_ms(samples.get(op, []), 0.99)
+        vals = samples.get(op)
+        row["max_ms"] = round(max(vals) * 1e3, 3) if vals else None
+    window = max(windows) if windows else 0.0
+    return {
+        "ops": total_ops,
+        "errors": total_errors,
+        "ops_per_s": round(total_ops / window, 1) if window > 0 else 0.0,
+        "window_s": round(window, 3),
+        "by_op": by_op,
+        "slo": merge_slo_reports(
+            [rep["slo"] for rep in reports if rep.get("slo")]
+        ),
+        "drivers": len(reports),
+    }
+
+
+def _merge_stage_tables(tables: list[dict]) -> dict:
+    """Sum per-(op, stage) totals/samples across processes; p99 is the max
+    (a conservative fleet tail — exact merging would need the rings)."""
+    merged: dict[str, dict] = {}
+    for table in tables:
+        for op, stages in (table or {}).items():
+            dst_op = merged.setdefault(op, {})
+            for stage, row in stages.items():
+                dst = dst_op.setdefault(
+                    stage, {"samples": 0, "total_s": 0.0, "p99_s": None}
+                )
+                dst["samples"] += int(row.get("samples") or 0)
+                dst["total_s"] = round(
+                    dst["total_s"] + float(row.get("total_s") or 0.0), 6
+                )
+                p99 = row.get("p99_s")
+                if p99 is not None and (
+                    dst["p99_s"] is None or p99 > dst["p99_s"]
+                ):
+                    dst["p99_s"] = p99
+    for stages in merged.values():
+        grand = sum(row["total_s"] for row in stages.values()) or 0.0
+        for row in stages.values():
+            row["share"] = (
+                round(row["total_s"] / grand, 4) if grand > 0 else 0.0
+            )
+    return merged
+
+
+def merge_slo_reports(reports: list[dict]) -> dict:
+    """Fold per-process ``timeline.slo_report()`` scoreboards into one:
+    violations sum, ``current`` is the worst across processes, and each
+    SLO's dominant stage is recomputed from the SUMMED stage time of its
+    op."""
+    stages = _merge_stage_tables([rep.get("stages") or {} for rep in reports])
+    slos: dict[str, dict] = {}
+    for rep in reports:
+        for name, row in (rep.get("slos") or {}).items():
+            dst = slos.get(name)
+            if dst is None:
+                dst = slos[name] = {
+                    "env": row.get("env"),
+                    "threshold": row.get("threshold"),
+                    "worse": row.get("worse", "above"),
+                    "op": row.get("op"),
+                    "current": None,
+                    "violations": 0,
+                    "violated": False,
+                }
+            dst["violations"] += int(row.get("violations") or 0)
+            dst["violated"] = dst["violated"] or bool(row.get("violated"))
+            current = row.get("current")
+            if current is not None:
+                worst = dst["current"]
+                worse_dir = dst["worse"]
+                if worst is None or (
+                    current > worst
+                    if worse_dir == "above"
+                    else current < worst
+                ):
+                    dst["current"] = current
+    for name, row in slos.items():
+        op = row.get("op")
+        if op and op in stages and (row["violated"] or row["violations"]):
+            op_stages = stages[op]
+            row["stages"] = op_stages
+            row["dominant_stage"] = max(
+                op_stages.items(), key=lambda kv: kv[1]["total_s"]
+            )[0] if op_stages else None
+    return {"slos": slos, "stages": stages, "processes": len(reports)}

@@ -48,8 +48,24 @@ _META_RPCS = obs_metrics.counter(
     "ts_meta_rpcs_total",
     "Controller metadata RPCs issued by this client, by op",
 )
+# Overload signal (ts.slo_report): metadata RPCs this client has issued and
+# not yet heard back, per shard ("coord"/"s<i>") — the client-observed
+# proxy for each controller actor's service-queue depth. LONG_POLL_OPS are
+# excluded: a parked wait occupies a connection, not service capacity.
+_META_INFLIGHT = obs_metrics.gauge(
+    "ts_meta_rpc_inflight",
+    "Metadata RPCs awaiting a reply from this client, by shard",
+)
 
 COORD = "coord"
+
+# Ops that PARK on the controller by design (notify-woken long-polls).
+# They occupy a connection, not service capacity — counting them as
+# inflight would read N idle subscribers as sustained controller backlog
+# and trip admission control on a quiet fleet.
+LONG_POLL_OPS = frozenset(
+    {"wait_for_stream", "wait_for_change", "wait_for_committed"}
+)
 
 
 def _count_rpc(op: str, shard: str = COORD) -> None:
@@ -101,6 +117,9 @@ class MetadataRouter:
         self.shard_refs: list[ActorRef] = []
         self.n_shards = 1
         self._rpc_timeout: Optional[float] = None
+        # shard label -> RPCs awaiting replies (single event loop: plain
+        # int bookkeeping; mirrored into ts_meta_rpc_inflight).
+        self._inflight: dict[str, int] = {}
         # Stamped same-host attachments (None until load_topology finds a
         # co-located publisher): per-index-host readers + the coordinator's
         # stream/epoch segment.
@@ -196,6 +215,23 @@ class MetadataRouter:
 
     # -- dispatch ----------------------------------------------------------
 
+    async def _tracked(self, shard: str, coro):
+        """Await ``coro`` with the per-shard inflight gauge held up — the
+        queue-depth overload signal ``ts.slo_report()`` reads."""
+        n = self._inflight.get(shard, 0) + 1
+        self._inflight[shard] = n
+        _META_INFLIGHT.set(n, shard=shard)
+        try:
+            return await coro
+        finally:
+            n = max(0, self._inflight.get(shard, 1) - 1)
+            self._inflight[shard] = n
+            _META_INFLIGHT.set(n, shard=shard)
+
+    def inflight_snapshot(self) -> dict[str, int]:
+        """Current metadata RPCs awaiting replies, per shard label."""
+        return {k: v for k, v in self._inflight.items() if v}
+
     def _coord_ep(self, op: str, timeout):
         ep = getattr(self._coordinator, op)
         if timeout is not None:
@@ -212,7 +248,10 @@ class MetadataRouter:
         if self.shard_refs and op in INDEX_OPS:
             return await self._dispatch_sharded(op, timeout, args, kwargs)
         _count_rpc(op)
-        return await self._coord_ep(op, timeout).call_one(*args, **kwargs)
+        call = self._coord_ep(op, timeout).call_one(*args, **kwargs)
+        if op in LONG_POLL_OPS:
+            return await call
+        return await self._tracked(COORD, call)
 
     async def _dispatch_sharded(self, op: str, timeout, args, kwargs) -> Any:
         if op == "locate_volumes":
@@ -222,8 +261,11 @@ class MetadataRouter:
             for i, ks in parts.items():
                 _count_rpc(op, f"s{i}")
                 calls.append(
-                    self._shard_ep(i, "locate_volumes", timeout).call_one(
-                        ks, *args[1:], **kwargs
+                    self._tracked(
+                        f"s{i}",
+                        self._shard_ep(i, "locate_volumes", timeout).call_one(
+                            ks, *args[1:], **kwargs
+                        ),
                     )
                 )
             merged: dict = {}
@@ -234,16 +276,22 @@ class MetadataRouter:
             key = args[0] if args else kwargs["key"]
             i = shard_of(key, self.n_shards)
             _count_rpc(op, f"s{i}")
-            return await self._shard_ep(i, "contains", timeout).call_one(
-                *args, **kwargs
+            return await self._tracked(
+                f"s{i}",
+                self._shard_ep(i, "contains", timeout).call_one(
+                    *args, **kwargs
+                ),
             )
         if op == "keys":
             calls = []
             for i in range(self.n_shards):
                 _count_rpc(op, f"s{i}")
                 calls.append(
-                    self._shard_ep(i, "keys", timeout).call_one(
-                        *args, **kwargs
+                    self._tracked(
+                        f"s{i}",
+                        self._shard_ep(i, "keys", timeout).call_one(
+                            *args, **kwargs
+                        ),
                     )
                 )
             results = await asyncio.gather(*calls)
@@ -255,6 +303,7 @@ class MetadataRouter:
             calls = []
             for i, ks in parts.items():
                 _count_rpc(op, f"s{i}")
+                # Long-poll: parked, not queued — never inflight-tracked.
                 calls.append(
                     self._shard_ep(i, "wait_for_committed", timeout).call_one(
                         ks, *rest, **kwargs
@@ -266,6 +315,7 @@ class MetadataRouter:
             key = args[0] if args else kwargs["key"]
             i = shard_of(key, self.n_shards)
             _count_rpc(op, f"s{i}")
+            # Long-poll: parked, not queued — never inflight-tracked.
             return await self._shard_ep(i, "wait_for_change", timeout).call_one(
                 *args, **kwargs
             )
@@ -301,14 +351,17 @@ class MetadataRouter:
         for i, ms in parts.items():
             _count_rpc("notify_put_batch", f"s{i}")
             calls.append(
-                self._shard_ep(i, "notify_put_batch", timeout).call_one(
-                    ms,
-                    volume_id,
-                    detach_volume_ids=detach_volume_ids,
-                    write_gens=slice_write_gens(
-                        write_gens, {m.key for m in ms}
+                self._tracked(
+                    f"s{i}",
+                    self._shard_ep(i, "notify_put_batch", timeout).call_one(
+                        ms,
+                        volume_id,
+                        detach_volume_ids=detach_volume_ids,
+                        write_gens=slice_write_gens(
+                            write_gens, {m.key for m in ms}
+                        ),
+                        supersede=supersede,
                     ),
-                    supersede=supersede,
                 )
             )
         epochs = [e for e in await asyncio.gather(*calls) if e is not None]
@@ -318,12 +371,15 @@ class MetadataRouter:
                 [volume_id] if isinstance(volume_id, str) else list(volume_id)
             )
             _count_rpc("stream_watermark")
-            await self._coord_ep("stream_watermark", timeout).call_one(
-                stream_key,
-                int(version),
-                metas,
-                volume_ids,
-                unchanged,
+            await self._tracked(
+                COORD,
+                self._coord_ep("stream_watermark", timeout).call_one(
+                    stream_key,
+                    int(version),
+                    metas,
+                    volume_ids,
+                    unchanged,
+                ),
             )
         return max(epochs) if epochs else None
 
@@ -333,13 +389,18 @@ class MetadataRouter:
         its slice, then the coordinator retires stream records for what
         actually disappeared."""
         _count_rpc("delete_guard")
-        passed = await self._coord_ep("delete_guard", timeout).call_one(keys)
+        passed = await self._tracked(
+            COORD, self._coord_ep("delete_guard", timeout).call_one(keys)
+        )
         parts = partition_keys(passed, self.n_shards)
         calls = []
         for i, ks in parts.items():
             _count_rpc("notify_delete_batch", f"s{i}")
             calls.append(
-                self._shard_ep(i, "delete_keys", timeout).call_one(ks)
+                self._tracked(
+                    f"s{i}",
+                    self._shard_ep(i, "delete_keys", timeout).call_one(ks),
+                )
             )
         merged: dict[str, list[str]] = {}
         for part in await asyncio.gather(*calls):
@@ -348,7 +409,10 @@ class MetadataRouter:
         deleted = sorted({k for vkeys in merged.values() for k in vkeys})
         if deleted:
             _count_rpc("delete_finish")
-            await self._coord_ep("delete_finish", timeout).call_one(deleted)
+            await self._tracked(
+                COORD,
+                self._coord_ep("delete_finish", timeout).call_one(deleted),
+            )
         return merged
 
     # -- one-sided stamped reads ------------------------------------------

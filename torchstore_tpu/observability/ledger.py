@@ -111,6 +111,12 @@ class TrafficLedger:
         self._win_started = time.monotonic()
         self._cur: dict[str, list] = {}
         self._prev: dict[str, list] = {}
+        # Whole-process rolling totals (every record, keyed or not), same
+        # two-bucket rotation: the "how loaded is this process RIGHT NOW"
+        # signal ts.slo_report folds per volume — a lifetime cell total
+        # can't answer that.
+        self._cur_totals = [0, 0]
+        self._prev_totals = [0, 0]
 
     def set_enabled(self, enabled: bool) -> None:
         self.enabled = bool(enabled)
@@ -143,8 +149,10 @@ class TrafficLedger:
                 cell = self._cells[cell_key] = [0, 0]
             cell[0] += ops
             cell[1] += int(nbytes)
+            self._maybe_rotate_locked()
+            self._cur_totals[0] += ops
+            self._cur_totals[1] += int(nbytes)
             if items is not None:
-                self._maybe_rotate_locked()
                 cur = self._cur
                 for key, kbytes in items:
                     if key is None:
@@ -168,9 +176,12 @@ class TrafficLedger:
             return
         if elapsed >= 2 * self.window_s:
             self._prev = {}
+            self._prev_totals = [0, 0]
         else:
             self._prev = self._cur
+            self._prev_totals = self._cur_totals
         self._cur = {}
+        self._cur_totals = [0, 0]
         self._win_started = now
 
     def top_keys(self, k: int = 20) -> list[dict]:
@@ -209,11 +220,20 @@ class TrafficLedger:
                 for (peer_host, volume, transport, direction), cell
                 in self._cells.items()
             ]
+            self._maybe_rotate_locked()
+            window = {
+                "ops": self._cur_totals[0] + self._prev_totals[0],
+                "bytes": self._cur_totals[1] + self._prev_totals[1],
+            }
         return {
             "host": _hostname(),
             "pid": os.getpid(),
             "window_s": self.window_s,
             "cells": cells,
+            # Transfers this process accounted over the last one-to-two
+            # rolling windows (decays like the per-key view): the recent-
+            # throughput overload signal, vs the lifetime cell totals.
+            "window": window,
             "keys": self.top_keys(20),
         }
 
@@ -222,6 +242,8 @@ class TrafficLedger:
             self._cells.clear()
             self._cur.clear()
             self._prev.clear()
+            self._cur_totals = [0, 0]
+            self._prev_totals = [0, 0]
             self._win_started = time.monotonic()
 
 

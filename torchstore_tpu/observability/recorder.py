@@ -50,6 +50,7 @@ from torchstore_tpu.observability import metrics as obs_metrics
 ENV_FLIGHT = "TORCHSTORE_TPU_FLIGHT_RECORDER"
 ENV_FLIGHT_EVENTS = "TORCHSTORE_TPU_FLIGHT_EVENTS"
 ENV_FLIGHT_DIR = "TORCHSTORE_TPU_FLIGHT_DIR"
+ENV_FLIGHT_MIN_INTERVAL = "TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S"
 
 # Event kinds a post-mortem exists for: their presence since the last dump
 # makes an interpreter exit "unclean" (arm_exit_dump writes the ring).
@@ -57,6 +58,10 @@ ALERT_KINDS = frozenset({"fault", "error", "health", "slo"})
 
 _DUMPS = obs_metrics.counter(
     "ts_flight_dumps_total", "Flight-recorder post-mortems written, by reason"
+)
+_DROPPED = obs_metrics.counter(
+    "ts_flight_dumps_dropped_total",
+    "Post-mortems suppressed by the per-kind rate limit, by reason",
 )
 
 
@@ -88,6 +93,21 @@ def flight_dir() -> str:
     )
 
 
+def _min_interval_s() -> float:
+    """Per-trigger-kind dump rate limit (seconds). A sustained fault storm
+    (a chaos-heavy loadgen run: every die-fault, wedge, and quarantine
+    wants a post-mortem) must not fill ``TORCHSTORE_TPU_FLIGHT_DIR`` —
+    one dump per kind per interval keeps the freshest history on disk and
+    counts the rest in ``ts_flight_dumps_dropped_total``. 0 disables the
+    limit."""
+    try:
+        return max(
+            0.0, float(os.environ.get(ENV_FLIGHT_MIN_INTERVAL, "30"))
+        )
+    except ValueError:
+        return 30.0
+
+
 class FlightRecorder:
     """Bounded per-process event ring. ``record`` is the hot path: build a
     small tuple, append to a deque — no lock (GIL-atomic), no I/O."""
@@ -102,6 +122,9 @@ class FlightRecorder:
         # heuristic counter.
         self._alerts_since_dump = 0
         self._exit_armed = False
+        # trigger kind -> monotonic ts of its last WRITTEN dump (the
+        # per-kind rate-limit state; see _min_interval_s).
+        self._last_dump: dict[str, float] = {}
 
     def set_enabled(self, enabled: bool) -> None:
         self.enabled = bool(enabled)
@@ -140,11 +163,25 @@ class FlightRecorder:
         fails (a post-mortem must never take the process down with it)."""
         if not self.enabled:
             return None
+        # Per-kind rate limit FIRST — before the ring is even copied:
+        # under a fault storm every die/quarantine/wedge wants its own
+        # post-mortem, and a suppressed trigger must cost O(1), not an
+        # O(ring) collect+sort on the victim's event loop. One dump per
+        # kind per TORCHSTORE_TPU_FLIGHT_MIN_INTERVAL_S; the rest are
+        # counted. Distinct kinds never shadow each other (a quarantine
+        # still dumps while die-faults are storming).
+        reason = trigger.split(":", 1)[0]
+        interval = _min_interval_s()
+        now = time.monotonic()
+        if interval > 0:
+            last = self._last_dump.get(reason)
+            if last is not None and now - last < interval:
+                _DROPPED.inc(reason=reason)
+                return None
         events = self.snapshot() + list(extra_events or ())
         if not events:
             return None
         events.sort(key=lambda e: e.get("ts") or 0)
-        reason = trigger.split(":", 1)[0]
         safe = "".join(
             ch if ch.isalnum() or ch in "-_" else "_" for ch in trigger
         )[:80]
@@ -167,6 +204,7 @@ class FlightRecorder:
         except OSError:
             return None
         self._alerts_since_dump = 0
+        self._last_dump[reason] = now
         _DUMPS.inc(reason=reason)
         from torchstore_tpu.logging import get_logger
 
@@ -230,3 +268,4 @@ def reinit_after_fork() -> None:
         _recorder.clear()
         _recorder.enabled = _env_enabled()
         _recorder._exit_armed = False
+        _recorder._last_dump.clear()

@@ -1,7 +1,7 @@
-"""Sync-timeline telemetry: rolling op quantiles, SLO thresholds, and
-weight-sync generation reconstruction.
+"""Sync-timeline telemetry: rolling op quantiles, stage attribution, SLO
+thresholds + scoreboard, and weight-sync generation reconstruction.
 
-Three facilities that turn the bench-only numbers (``overlap_ratio``,
+Facilities that turn the bench-only numbers (``overlap_ratio``,
 ``first_token``) and the fixed-bucket op histograms into production
 signals:
 
@@ -11,6 +11,16 @@ signals:
   fixed-bucket histograms stay (Prometheus-aggregatable); the digests add
   the exact quantiles an SLO needs, refreshed lazily (every
   ``REFRESH_EVERY`` observations) so the hot path pays one deque append.
+
+- **Stage attribution** (:class:`StageQuantiles`, :func:`observe_stage`):
+  client and volume ops record per-stage wall-clock segments — metadata
+  resolve, transport wire, landing copy, stamp verify, watermark wait —
+  into per-(op, stage) digests (``ts_op_stage_p50/p99_seconds{op,stage}``)
+  plus rolling per-stage time totals. When an SLO blows, the totals answer
+  the question an end-to-end timer can't: *which stage ate the budget*
+  (:func:`dominant_stage`). Stage names MUST come from :data:`STAGE_CATALOG`
+  — the ``stage-discipline`` tslint rule holds client and volume sites to
+  the same taxonomy so digests from both sides fold together.
 
 - **SLO thresholds** (``TORCHSTORE_TPU_SLO_*``): a typed family of
   operator-set bars. On breach the violation is logged (rate-limited per
@@ -24,6 +34,14 @@ signals:
 
   Unset = disabled; thresholds are re-read per check (one getenv) so live
   operators can retune a running fleet.
+
+- **SLO scoreboard** (:func:`slo_report`): the live fold of all of the
+  above — every configured ``TORCHSTORE_TPU_SLO_*`` threshold with its
+  current value, violation count, violated flag, and (per violated SLO)
+  the dominant stage with the per-stage breakdown. ``ts.slo_report()``
+  wraps it with fleet overload signals (per-volume inflight landings,
+  resident doorbell plans, metadata RPC inflight) — the inputs item 3's
+  admission control consumes.
 
 - **Generation reconstruction** (:func:`reconstruct`): folds a controller
   stream record (now timestamped — ``stream_begin`` -> per-key watermark
@@ -57,6 +75,31 @@ SLO_VERSION_LAG = "TORCHSTORE_TPU_SLO_VERSION_LAG"
 SLO_FIRST_LAYER_MS = "TORCHSTORE_TPU_SLO_FIRST_LAYER_MS"
 SLO_OVERLAP_MIN = "TORCHSTORE_TPU_SLO_OVERLAP_MIN"
 
+# The registered stage catalog. Every wall-clock segment recorded into the
+# stage digests — client-side or volume-side — names one of these, so
+# digests from both ends of a transfer fold into the same taxonomy (the
+# ``stage-discipline`` tslint rule rejects free-string stage labels):
+#
+#   plan            metadata resolve: locate (RPC or stamped), plan/epoch
+#                   validation, request building, placement selection
+#   transport       the wire leg: handshake + frames + RPC data movement
+#   landing         landing copies: bytes into store/destination memory
+#   stamp_verify    one-sided seqlock checks (pre-copy match + post-copy
+#                   re-gather) proving a read raced no landing
+#   watermark_wait  streamed acquires blocked on per-key watermarks
+#                   (wait_for_stream long-polls, stamped or RPC)
+#   notify          the metadata commit: notify_put_batch / watermark step
+STAGE_CATALOG = frozenset(
+    {
+        "plan",
+        "transport",
+        "landing",
+        "stamp_verify",
+        "watermark_wait",
+        "notify",
+    }
+)
+
 _SLO_VIOLATIONS = obs_metrics.counter(
     "ts_slo_violations_total",
     "SLO threshold breaches (TORCHSTORE_TPU_SLO_* family), by slo",
@@ -66,6 +109,14 @@ _P50 = obs_metrics.gauge(
 )
 _P99 = obs_metrics.gauge(
     "ts_op_p99_seconds", "Rolling-window p99 wall time, by op"
+)
+_STAGE_P50 = obs_metrics.gauge(
+    "ts_op_stage_p50_seconds",
+    "Rolling-window p50 stage wall time, by op and stage",
+)
+_STAGE_P99 = obs_metrics.gauge(
+    "ts_op_stage_p99_seconds",
+    "Rolling-window p99 stage wall time, by op and stage",
 )
 
 
@@ -104,7 +155,10 @@ def check_slo(
     breached = value > threshold if worse == "above" else value < threshold
     if not breached:
         return False
-    slo = env_name.rsplit("TORCHSTORE_TPU_SLO_", 1)[-1].lower()
+    # slo_name() is THE label derivation: the violation counter's label
+    # here and slo_report's lookup key must never diverge, or every
+    # scoreboard violation count silently reads zero.
+    slo = slo_name(env_name)
     _SLO_VIOLATIONS.inc(slo=slo)
     now = time.monotonic()
     if now - _last_slo_log.get(slo, 0.0) >= _SLO_LOG_EVERY_S:
@@ -196,6 +250,238 @@ def observe_op(op: str, dur_s: float) -> None:
     """Feed one completed logical op into the rolling digests (and their
     p99 SLO checks). Called from the client's op completion path."""
     _quantiles.observe(op, dur_s)
+
+
+# --------------------------------------------------------------------------
+# stage attribution (per-(op, stage) digests + dominant-stage totals)
+# --------------------------------------------------------------------------
+
+
+class StageQuantiles:
+    """Rolling per-(op, stage) wall-time digests plus decaying per-stage
+    time totals. The digests publish ``ts_op_stage_p50/p99_seconds`` on the
+    same lazy-refresh rhythm as :class:`OpQuantiles`; the totals are the
+    attribution input: when an op's SLO blows, the stage holding the
+    largest share of recent wall time is the *dominant* stage — the answer
+    ``ts.slo_report()`` surfaces next to each violated threshold.
+
+    Totals decay exponentially in WALL TIME (half-life ``HALF_LIFE_S``),
+    applied lazily at each touch, so a stage that dominated an hour ago
+    cannot outvote the stage dominating NOW. The decay must be time-based,
+    not sample-count-based: stages record at different RATES (put's
+    transport leg records once per replica, its plan leg once per batch) —
+    a per-stage count-triggered decay would normalize the rate away and
+    make steady-state totals proportional to mean segment duration instead
+    of aggregate wall time, inverting the dominant-stage vote exactly on
+    the long-running fleets this exists for."""
+
+    WINDOW = 512
+    REFRESH_EVERY = 32
+    HALF_LIFE_S = 60.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (op, stage) -> [ring, pending, total_s, last_decay_monotonic]
+        self._state: dict[tuple, list] = {}
+
+    @classmethod
+    def _decay_locked(cls, state: list, now: float) -> None:
+        dt = now - state[3]
+        if dt > 0:
+            state[2] *= 0.5 ** (dt / cls.HALF_LIFE_S)
+            state[3] = now
+
+    def observe(self, op: str, stage: str, dur_s: float) -> None:
+        if stage not in STAGE_CATALOG:
+            raise ValueError(
+                f"unregistered stage {stage!r} (catalog: "
+                f"{sorted(STAGE_CATALOG)}); register it in "
+                "observability.timeline.STAGE_CATALOG"
+            )
+        now = time.monotonic()
+        with self._lock:
+            state = self._state.get((op, stage))
+            if state is None:
+                state = self._state[(op, stage)] = [
+                    collections.deque(maxlen=self.WINDOW), 0, 0.0, now,
+                ]
+            ring, pending, _, _ = state
+            ring.append(dur_s)
+            self._decay_locked(state, now)
+            state[2] += dur_s
+            state[1] = pending + 1
+            if state[1] < self.REFRESH_EVERY and len(ring) != 1:
+                return
+            state[1] = 0
+            ordered = sorted(ring)
+        p50 = ordered[len(ordered) // 2]
+        p99 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+        _STAGE_P50.set(p50, op=op, stage=stage)
+        _STAGE_P99.set(p99, op=op, stage=stage)
+
+    def breakdown(self, op: str) -> dict[str, dict]:
+        """Per-stage view for one op: ``{stage: {"samples", "total_s",
+        "p99_s", "share"}}`` with ``share`` the stage's fraction of the
+        op's summed (decayed) stage time."""
+        now = time.monotonic()
+        with self._lock:
+            rows = {}
+            for (o, stage), state in self._state.items():
+                if o != op:
+                    continue
+                # Decay every stage to the SAME instant before comparing:
+                # an idle stage must not keep a stale (undecayed) total.
+                self._decay_locked(state, now)
+                rows[stage] = (list(state[0]), state[2])
+        out: dict[str, dict] = {}
+        grand = sum(total for _, total in rows.values()) or 0.0
+        for stage, (samples, total) in rows.items():
+            ordered = sorted(samples)
+            out[stage] = {
+                "samples": len(samples),
+                "total_s": round(total, 6),
+                "p99_s": (
+                    ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+                    if ordered
+                    else None
+                ),
+                "share": round(total / grand, 4) if grand > 0 else 0.0,
+            }
+        return out
+
+    def dominant(self, op: str) -> Optional[str]:
+        """The stage holding the largest share of ``op``'s recent wall
+        time, or None when nothing was recorded."""
+        rows = self.breakdown(op)
+        if not rows:
+            return None
+        return max(rows.items(), key=lambda kv: kv[1]["total_s"])[0]
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            ops = sorted({op for op, _ in self._state})
+        return {op: self.breakdown(op) for op in ops}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+
+
+_stages = StageQuantiles()
+
+
+def stage_quantiles() -> StageQuantiles:
+    return _stages
+
+
+def observe_stage(op: str, stage: str, dur_s: float) -> None:
+    """Record one wall-clock stage segment of a logical op. ``stage`` MUST
+    name a :data:`STAGE_CATALOG` entry (raises ValueError otherwise — the
+    ``stage-discipline`` tslint rule catches drift statically; this is the
+    loud runtime backstop)."""
+    _stages.observe(op, stage, dur_s)
+
+
+def dominant_stage(op: str) -> Optional[str]:
+    """Which stage of ``op`` recent wall time concentrated in."""
+    return _stages.dominant(op)
+
+
+# --------------------------------------------------------------------------
+# SLO scoreboard
+# --------------------------------------------------------------------------
+
+# env knob -> (worse direction, the op whose stage digests attribute a
+# breach, a callable producing the CURRENT value in threshold units).
+def _p99_ms(op: str):
+    def current() -> Optional[float]:
+        qs = _quantiles.quantiles(op, qs=(0.99,))
+        return None if qs is None else qs["0.99"] * 1e3
+
+    return current
+
+
+def _gauge_value(name: str, scale: float = 1.0):
+    def current() -> Optional[float]:
+        metric = obs_metrics.get_registry().get(name)
+        if metric is None:
+            return None
+        series = metric.snapshot().get("series") or []
+        if not series:
+            return None
+        # Labeled gauges (channel=...): the scoreboard reports the worst
+        # series — an SLO is about the worst-off consumer.
+        return max(float(s["value"]) for s in series) * scale
+
+    return current
+
+
+_SLO_TABLE: dict[str, tuple[str, Optional[str], Any]] = {
+    SLO_PUT_P99_MS: ("above", "put", _p99_ms("put")),
+    SLO_GET_P99_MS: ("above", "get", _p99_ms("get")),
+    SLO_VERSION_LAG: (
+        "above", None, _gauge_value("ts_weight_channel_version_lag"),
+    ),
+    SLO_FIRST_LAYER_MS: (
+        "above", "stream", _gauge_value("ts_stream_first_layer_seconds", 1e3),
+    ),
+    SLO_OVERLAP_MIN: (
+        "below", "stream", _gauge_value("ts_stream_overlap_ratio"),
+    ),
+}
+
+_SLO_PREFIX = "TORCHSTORE_TPU_SLO_"
+
+
+def slo_name(env_name: str) -> str:
+    return env_name.rsplit(_SLO_PREFIX, 1)[-1].lower()
+
+
+def slo_report() -> dict:
+    """This process's live SLO scoreboard: every configured
+    ``TORCHSTORE_TPU_SLO_*`` threshold (the blessed family plus any
+    operator-extension knobs set under the prefix) with its current value,
+    lifetime violation count, violated flag, and — for SLOs whose op has
+    stage digests — the dominant stage with the full per-stage breakdown.
+
+    Returns ``{"slos": {name: {...}}, "stages": {op: breakdown},
+    "generated_ts": wall_ts}``. ``ts.slo_report()`` wraps this with fleet
+    overload signals; loadgen drivers ship it home per process and
+    ``loadgen.report.merge_slo_reports`` folds driver scoreboards into the
+    fleet view."""
+    names = dict(_SLO_TABLE)
+    for env_name in os.environ:
+        if env_name.startswith(_SLO_PREFIX) and env_name not in names:
+            names[env_name] = ("above", None, lambda: None)
+    slos: dict[str, dict] = {}
+    for env_name, (worse, op, current_fn) in names.items():
+        threshold = slo_threshold(env_name)
+        if threshold is None:
+            continue
+        name = slo_name(env_name)
+        current = current_fn()
+        violations = int(_SLO_VIOLATIONS.value(slo=name))
+        violated = current is not None and (
+            current > threshold if worse == "above" else current < threshold
+        )
+        entry: dict[str, Any] = {
+            "env": env_name,
+            "threshold": threshold,
+            "worse": worse,
+            "current": None if current is None else round(current, 4),
+            "violations": violations,
+            "violated": bool(violated),
+            "op": op,
+        }
+        if op is not None and (violated or violations):
+            entry["dominant_stage"] = _stages.dominant(op)
+            entry["stages"] = _stages.breakdown(op)
+        slos[name] = entry
+    return {
+        "slos": slos,
+        "stages": _stages.snapshot(),
+        "generated_ts": time.time(),
+    }
 
 
 # --------------------------------------------------------------------------

@@ -28,6 +28,7 @@ from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
 from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.runtime import Actor, endpoint
 from torchstore_tpu.transport.buffers import TransportBuffer, TransportContext
 from torchstore_tpu.transport.types import Request, TensorMeta, TensorSlice
@@ -48,6 +49,12 @@ _PUT_OPS = obs_metrics.counter(
 )
 _GET_OPS = obs_metrics.counter(
     "ts_volume_get_ops_total", "Get RPCs served by this volume"
+)
+# Overload signal (ts.slo_report): landings currently holding the volume-
+# wide write bracket open. A sustained non-zero floor means the landing
+# pool (or a wedged faultpoint) is the queue building up.
+_LANDING_INFLIGHT = obs_metrics.gauge(
+    "ts_landing_inflight", "Open landing brackets on this volume"
 )
 
 
@@ -409,10 +416,12 @@ class StorageVolume(Actor):
         this landing see inflight != 0 (busy) or a moved stamp (torn)."""
         self._landing_inflight += 1
         self._landing_stamp += 1
+        _LANDING_INFLIGHT.set(self._landing_inflight, volume=self.volume_id)
 
     def _landing_close(self) -> None:
         self._landing_inflight -= 1
         self._landing_stamp += 1
+        _LANDING_INFLIGHT.set(self._landing_inflight, volume=self.volume_id)
 
     async def _begin_landing(self, pairs: list[tuple]) -> None:
         """Open the one-sided write bracket: per-entry seqlock stamps go odd
@@ -598,6 +607,7 @@ class StorageVolume(Actor):
                 [m for m in metas if m.tensor_slice is not None], "put"
             )
         pairs = self._stamp_pairs(metas)
+        t_land = time.perf_counter()
         await self._begin_landing(pairs)
         try:
             existing = self.store.extract_existing(metas)
@@ -609,6 +619,12 @@ class StorageVolume(Actor):
             self.store.store(metas, values)
         finally:
             self._end_landing(pairs)
+            # Stage attribution (volume side): the landing bracket — copies
+            # into store memory, including any shm.landing_stamp hold — is
+            # this process's "landing" segment of the put.
+            obs_timeline.observe_stage(
+                "put", "landing", time.perf_counter() - t_land
+            )
         self._apply_residency_delta(affected, before)
         self._tier_after_put(affected)
         _PUT_OPS.inc(volume=self.volume_id)
@@ -655,7 +671,13 @@ class StorageVolume(Actor):
             await self._tier_fault_in(metas, "get")
             self._tier.touch([meta.key for meta in metas])
         entries = [self.store.get_data(meta) for meta in metas]
+        t_land = time.perf_counter()
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
+        # Stage attribution (volume side): loading entries into the reply
+        # buffer (segment copies / frame sends) is the serve's landing leg.
+        obs_timeline.observe_stage(
+            "get", "landing", time.perf_counter() - t_land
+        )
         _GET_OPS.inc(volume=self.volume_id)
         items = [
             # Object entries are arbitrary user types: only count an
@@ -1026,6 +1048,11 @@ class StorageVolume(Actor):
             # Traffic ledger cells + rolling key windows (decision
             # telemetry; ts.fleet_snapshot merges them under "ledgers").
             "ledger": obs_ledger.snapshot(),
+            # Overload signals (ts.slo_report folds these per volume): open
+            # landing brackets, resident one-sided doorbell plans, and this
+            # process's per-stage wall-time digests.
+            "overload": self._overload_signals(),
+            "stages": obs_timeline.stage_quantiles().snapshot(),
         }
         if self._tier is not None:
             out["tier"] = {
@@ -1059,6 +1086,21 @@ class StorageVolume(Actor):
                 "staged": len(cache.staged),
             }
         return out
+
+    def _overload_signals(self) -> dict:
+        """Per-volume overload signals (rides ``stats()``; ``ts.slo_report``
+        folds them fleet-wide): how backed up this volume's landing bracket
+        and doorbell plan table are right now — the inputs admission
+        control (ROADMAP item 3) will trigger on."""
+        from torchstore_tpu.transport.bulk import BulkServerCache
+
+        bulk = self.ctx.peek(BulkServerCache)
+        return {
+            "landing_inflight": self._landing_inflight,
+            "doorbell_plans": (
+                len(bulk.server.get_plans) if bulk is not None else 0
+            ),
+        }
 
     @endpoint
     async def flight_record(self) -> list:

@@ -703,12 +703,20 @@ async def _acquire_stream(
                     f"{len(served_sks)} layer(s) served"
                 )
             chunk = poll if remaining is None else min(poll, remaining)
+            t_wait = time.perf_counter()
             try:
                 res = await client.wait_for_stream(
                     key, target, known, timeout=chunk, volume_id=relay_volume
                 )
             except TimeoutError:
                 continue  # re-poll (refreshes lag + deadline accounting)
+            finally:
+                # Stage attribution: time this acquire spent blocked on
+                # per-key watermarks (stamped poll or RPC long-poll) — the
+                # dominant stage of a starved subscriber.
+                obs_timeline.observe_stage(
+                    "stream", "watermark_wait", time.perf_counter() - t_wait
+                )
             if res.get("missing"):
                 # Record evicted/reset mid-acquire: restart; the outer loop
                 # re-reads the state and falls back to the barrier path.

@@ -30,6 +30,7 @@ from torchstore_tpu.logging import get_logger
 from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.observability import tracing
 from torchstore_tpu.transport.types import Request
 from torchstore_tpu.utils import maybe_await
@@ -175,9 +176,15 @@ class TransportBuffer(ABC):
                 self._post_request_success(volume)
             _OPS.inc(transport=self.transport_name, op="put")
             _BYTES.inc(nbytes, transport=self.transport_name, op="put")
+            dur = time.perf_counter() - t0
             _OP_SECONDS.observe(
-                time.perf_counter() - t0, transport=self.transport_name, op="put"
+                dur, transport=self.transport_name, op="put"
             )
+            # Stage attribution: this lifecycle (handshake -> frames/RPC ->
+            # reply) IS the wire leg of a put; replicated puts record one
+            # segment per replica, so the stage total carries the real
+            # aggregate wire time.
+            obs_timeline.observe_stage("put", "transport", dur)
             # Traffic ledger + flight recorder (decision telemetry): the
             # client side of every put knows BOTH endpoints, so this is the
             # count-once choke point the traffic matrix is built from.
@@ -240,9 +247,11 @@ class TransportBuffer(ABC):
                 self._post_request_success(volume)
             _OPS.inc(transport=self.transport_name, op="get")
             _BYTES.inc(nbytes, transport=self.transport_name, op="get")
+            dur = time.perf_counter() - t0
             _OP_SECONDS.observe(
-                time.perf_counter() - t0, transport=self.transport_name, op="get"
+                dur, transport=self.transport_name, op="get"
             )
+            obs_timeline.observe_stage("get", "transport", dur)
             if obs_ledger.ledger().enabled:
                 obs_ledger.record(
                     self.transport_name,

@@ -155,6 +155,13 @@ _STRIPED = obs_metrics.counter(
     "ts_bulk_striped_transfers_total",
     "Payloads striped across parallel connections, by direction",
 )
+# Overload signal (ts.slo_report): doorbell plans resident in this server's
+# table. Pinned near DOORBELL_PLANS_MAX means wholesale clears are churning
+# warm clients back onto the RPC path.
+_DOORBELL_PLANS = obs_metrics.gauge(
+    "ts_doorbell_plans_resident",
+    "One-sided doorbell get plans resident in this bulk server",
+)
 
 # Volume-side session state (landed put bytes, abort markers) is purged after
 # this long without the matching RPC arriving — a crashed client must not
@@ -629,6 +636,7 @@ class BulkServer:
             "metas": list(metas),
             "serve_metas": list(serve_metas),
         }
+        _DOORBELL_PLANS.set(len(self.get_plans))
         return plan_id
 
     async def _serve_doorbell(
@@ -674,10 +682,12 @@ class BulkServer:
                     # Shape/dtype drift since registration: the client's
                     # cached unpack layout no longer matches.
                     del self.get_plans[plan_id]
+                    _DOORBELL_PLANS.set(len(self.get_plans))
                     return await miss(2)
                 arrays.append(arr)
         except KeyError:
             del self.get_plans[plan_id]
+            _DOORBELL_PLANS.set(len(self.get_plans))
             return await miss(1)
         offsets, total = landing.compute_arena_layout(
             [a.nbytes for a in arrays]

@@ -67,12 +67,14 @@ from typing import Any, Optional
 
 import numpy as np
 
+from torchstore_tpu import faults
 from torchstore_tpu.config import StoreConfig, default_config
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.native import copy_into, fast_copy
 from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
+from torchstore_tpu.observability import timeline as obs_timeline
 from torchstore_tpu.utils import spawn_logged
 from torchstore_tpu.transport import landing
 from torchstore_tpu.transport.buffers import (
@@ -1560,6 +1562,7 @@ async def stamped_read_batch(
     src_addrs: list[int] = []
     lens: list[int] = []
     batch_ok = True
+    t_verify = time.perf_counter()
     for plan, dest in zip(plans, dests):
         src, words, slot, gen = _stamped_source(cache, plan)
         nbytes = plan["nbytes"]
@@ -1580,6 +1583,14 @@ async def stamped_read_batch(
                 dst_addrs.append(dest.__array_interface__["data"][0])
                 src_addrs.append(src_addr)
                 lens.append(nbytes)
+    t_copy = time.perf_counter()
+    verify_s = t_copy - t_verify
+    # ``shm.landing_stamp`` fires inside the landing-copy window of the
+    # one-sided read too (client scope) — a delay/wedge here lands squarely
+    # in the get's "landing" stage, exactly how a slow landing pool under
+    # overload presents, which is what the stage-attribution tests (and
+    # fleet-scale chaos legs) lean on.
+    await faults.afire("shm.landing_stamp")
     copied = batch_ok and await landing.land_batch_async(
         dst_addrs, src_addrs, lens, stage="one_sided", config=config
     )
@@ -1592,6 +1603,8 @@ async def stamped_read_batch(
             stage="one_sided",
             config=config,
         )
+    t_recheck = time.perf_counter()
+    obs_timeline.observe_stage("get", "landing", t_recheck - t_copy)
     # Post-copy recheck, vectorized per stamp table: one fancy-indexed
     # gather + compare replaces a per-member int() round trip.
     by_table: dict[int, tuple[np.ndarray, list, list]] = {}
@@ -1602,12 +1615,22 @@ async def stamped_read_batch(
             entry = by_table[id(words)] = (words, [], [])
         entry[1].append(plan["slot"])
         entry[2].append(plan["gen"])
-    for words, slots, gens in by_table.values():
-        if not np.array_equal(
-            words[np.asarray(slots)], np.asarray(gens, dtype=np.uint64)
-        ):
-            ONE_SIDED_TORN.inc(transport="shm")
-            raise OneSidedMiss("torn")
+    try:
+        for words, slots, gens in by_table.values():
+            if not np.array_equal(
+                words[np.asarray(slots)], np.asarray(gens, dtype=np.uint64)
+            ):
+                ONE_SIDED_TORN.inc(transport="shm")
+                raise OneSidedMiss("torn")
+    finally:
+        # Stage attribution: pre-copy stamp matching + post-copy re-gather
+        # are the seqlock-verify cost of the zero-RPC path (torn included —
+        # a discarded read still paid its verify).
+        obs_timeline.observe_stage(
+            "get",
+            "stamp_verify",
+            verify_s + (time.perf_counter() - t_recheck),
+        )
     ONE_SIDED_READS.inc(len(results), transport="shm")
     _account_one_sided(plans)
     return results
