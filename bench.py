@@ -1922,6 +1922,191 @@ async def fleet_scale_section(
         await ts.shutdown(store)
 
 
+async def placement_section(
+    n_drivers: int = 4,
+    n_logical: int = 64,
+    duration_s: float = 3.0,
+    n_volumes: int = 4,
+    value_kb: float = 16.0,
+    shared_keys: int = 32,
+    rate_hz: float = 4.0,
+    tenants: int = 4,
+    zipf_alpha: float = 1.5,
+    rebalance_rounds: int = 3,
+) -> dict:
+    """Traffic-aware placement section (ISSUE 16): the control plane's
+    closed loop, measured. Three loadgen legs against one multi-volume
+    fleet, all on the RPC plane (``one_sided=False``) so every get lands
+    in a volume ledger the control engine can actually see:
+
+    1. **Uniform leg** — poisson arrivals, uniform key pick: the
+       throughput and per-tenant get-p99 baseline.
+    2. **Skewed leg, engine idle** — Zipf key popularity (a few keys soak
+       most reads) plus one bursting tenant cohort (t0). Afterward,
+       ``ts.control_plan()`` (the dry run) MUST name at least one action
+       — the solver sees the skew even when nothing acts on it, asserted.
+    3. **Rebalance + skewed leg, engine acting** — ``ts.rebalance()``
+       rounds apply the plan (migrations/splits through the index
+       authority, every one a ``decision`` event), then the skewed leg
+       reruns WITH a mid-leg rebalance riding inside it: zero failed
+       drivers and zero op errors while keys migrate under load,
+       asserted.
+
+    Emits ``rebalance_recovery_ratio`` (skewed-with-engine ops/s over the
+    uniform baseline), ``tenant_isolation_p99_ratio`` (worst non-bursting
+    tenant's get p99 vs the uniform baseline — what admission control
+    buys the quiet tenants), and ``migration_bytes`` (the controller's
+    ``ts_control_migration_bytes_total``) — gated by bench_compare."""
+    import asyncio as _asyncio
+    import os as _os
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.loadgen import LoadSpec, run_fleet_load
+
+    store = "bench_placement"
+    # Bench-scale policy thresholds: the defaults are sized for fleets
+    # moving MBs per window; this section moves KBs. Set BEFORE
+    # initialize (the controller's engine reads them at spawn) and
+    # inherited by every driver (admission control on fleet-wide).
+    ctl_env = {
+        "TORCHSTORE_TPU_CONTROL_MIN_WINDOW_BYTES": "4096",
+        "TORCHSTORE_TPU_CONTROL_HOT_KEY_MIN_BYTES": "8192",
+        "TORCHSTORE_TPU_CONTROL_MIN_EDGE_BYTES": "8192",
+        "TORCHSTORE_TPU_CONTROL_COOLDOWN_S": "0.5",
+        "TORCHSTORE_TPU_CONTROL_ADMISSION": "1",
+    }
+    saved = {k: _os.environ.get(k) for k in ctl_env}
+    _os.environ.update(ctl_env)
+
+    def leg_spec(pattern, seed: int) -> LoadSpec:
+        return LoadSpec(
+            store_name=store,
+            duration_s=duration_s,
+            processes=n_drivers,
+            clients_per_process=n_logical,
+            pattern=pattern,
+            rate_hz=rate_hz,
+            mix={"get": 0.9, "put": 0.1},
+            value_kb=value_kb,
+            shared_keys=shared_keys,
+            tenants=tenants,
+            seed=seed,
+            config_overrides={"one_sided": False},
+        )
+
+    def leg_ok(label: str, rep: dict) -> None:
+        assert rep["failed_drivers"] == 0, (label, rep.get("driver_errors"))
+        assert rep["errors"] == 0, (label, rep["by_op"])
+
+    skew_pattern = {
+        "kind": "skewed",
+        "rate_hz": rate_hz,
+        "peak_rate_hz": rate_hz * 4,
+        "period_s": max(1.0, duration_s / 3),
+        "burst_frac": 0.3,
+        "zipf_alpha": zipf_alpha,
+    }
+    try:
+        await ts.initialize(num_storage_volumes=n_volumes, store_name=store)
+        uniform = await run_fleet_load(leg_spec("poisson", 160))
+        leg_ok("uniform", uniform)
+        skewed_off = await run_fleet_load(leg_spec(skew_pattern, 161))
+        leg_ok("skewed_off", skewed_off)
+        plan = await ts.control_plan(store)
+        assert plan["actions"], (
+            "control_plan saw a skewed workload but planned nothing: "
+            f"{plan['snapshot']}"
+        )
+        print(
+            f"# placement plan (engine idle): "
+            f"{[a['kind'] for a in plan['actions']]}",
+            file=sys.stderr,
+        )
+        decisions: list[dict] = []
+        for _ in range(rebalance_rounds):
+            rep = await ts.rebalance(store)
+            decisions.extend(rep.get("actions") or [])
+            await _asyncio.sleep(0.6)  # let the shortened cooldown lapse
+        acted = [
+            d
+            for d in decisions
+            if str(d.get("outcome", "")).startswith(("applied", "deferred"))
+        ]
+        assert acted, (
+            f"no decision landed across {rebalance_rounds} rebalance "
+            f"rounds: {decisions}"
+        )
+        # The engine-on leg, with a live migration riding inside it: the
+        # zero-failed-gets-during-migration acceptance.
+        load_task = _asyncio.ensure_future(
+            run_fleet_load(leg_spec(skew_pattern, 162))
+        )
+        await _asyncio.sleep(min(1.0, duration_s / 3))
+        mid = await ts.rebalance(store)
+        decisions.extend(mid.get("actions") or [])
+        skewed_on = await load_task
+        leg_ok("skewed_on", skewed_on)
+
+        fleet = await ts.fleet_snapshot(store_name=store)
+        series = (
+            (fleet.get("metrics") or {}).get(
+                "ts_control_migration_bytes_total"
+            )
+            or {}
+        ).get("series") or []
+        migration_bytes = int(sum(s.get("value") or 0 for s in series))
+
+        uniform_get = uniform["by_op"].get("get") or {}
+        baseline_p99 = uniform_get.get("p99_ms") or 0.0
+        worst_quiet_p99 = 0.0
+        for tenant, row in (skewed_on.get("by_tenant") or {}).items():
+            if tenant == "t0":  # the bursting cohort pays for itself
+                continue
+            p99 = ((row.get("by_op") or {}).get("get") or {}).get("p99_ms")
+            if p99:
+                worst_quiet_p99 = max(worst_quiet_p99, p99)
+        isolation = (
+            round(worst_quiet_p99 / baseline_p99, 3)
+            if baseline_p99 > 0 and worst_quiet_p99 > 0
+            else None
+        )
+        recovery = round(
+            skewed_on["ops_per_s"] / max(uniform["ops_per_s"], 1e-9), 3
+        )
+        print(
+            f"# placement: uniform {uniform['ops_per_s']:.0f} ops/s, "
+            f"skewed idle {skewed_off['ops_per_s']:.0f}, skewed+engine "
+            f"{skewed_on['ops_per_s']:.0f} (recovery {recovery:.2f}); "
+            f"{len(acted)} decision(s) acted, {migration_bytes}B migrated; "
+            f"quiet-tenant p99 ratio {isolation}",
+            file=sys.stderr,
+        )
+        return {
+            "drivers": n_drivers,
+            "logical_clients": n_drivers * n_logical,
+            "tenants": tenants,
+            "zipf_alpha": zipf_alpha,
+            "uniform_ops_per_s": uniform["ops_per_s"],
+            "skewed_off_ops_per_s": skewed_off["ops_per_s"],
+            "skewed_on_ops_per_s": skewed_on["ops_per_s"],
+            "rebalance_recovery_ratio": recovery,
+            "tenant_isolation_p99_ratio": isolation,
+            "migration_bytes": migration_bytes,
+            "uniform_get_p99_ms": round(baseline_p99, 3),
+            "worst_quiet_tenant_p99_ms": round(worst_quiet_p99, 3),
+            "plan_actions": plan["actions"],
+            "decisions": decisions,
+            "by_tenant_skewed_on": skewed_on.get("by_tenant"),
+        }
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                _os.environ.pop(key, None)
+            else:
+                _os.environ[key] = val
+        await ts.shutdown(store)
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -1959,6 +2144,10 @@ async def run(
     fleet_duration_s: float = 4.0,
     fleet_volumes: int = 4,
     fleet_gate_ms: float = 500.0,
+    placement_drivers: int = 4,
+    placement_logical: int = 64,
+    placement_duration_s: float = 3.0,
+    placement_volumes: int = 4,
 ) -> dict:
     """Host benchmark sections. Parameters exist so the tier-1 smoke test
     (tests/test_bench_smoke.py) can execute the REAL code path on KB-scale
@@ -2251,6 +2440,16 @@ async def run(
         n_volumes=fleet_volumes,
         get_p99_gate_ms=fleet_gate_ms,
     )
+    # Placement section (ISSUE 16): skewed loadgen with the control
+    # engine idle vs acting — plan non-empty on skew, decisions applied,
+    # zero failed gets while keys migrate under load. All asserted
+    # inside the section.
+    placement = await placement_section(
+        n_drivers=placement_drivers,
+        n_logical=placement_logical,
+        duration_s=placement_duration_s,
+        n_volumes=placement_volumes,
+    )
     # ADVICE r5 fix: timed_loop/measured_section return stats DICTS — the
     # headline compares their median GB/s scalars, never the dicts.
     med_buffered = stats_buffered["median"]
@@ -2359,6 +2558,17 @@ async def run(
             "fleet_ledger_overhead_pct"
         ],
         "fleet_scale": fleet_scale,
+        # ISSUE-16 headline stats at top level: skewed-traffic throughput
+        # recovery once the control engine rebalances, the quiet tenants'
+        # get-p99 ratio under one bursting cohort, and the bytes the
+        # engine's migrations moved; the full section (plan, decisions,
+        # per-tenant scoreboard) under "placement".
+        "rebalance_recovery_ratio": placement["rebalance_recovery_ratio"],
+        "tenant_isolation_p99_ratio": placement[
+            "tenant_isolation_p99_ratio"
+        ],
+        "migration_bytes": placement["migration_bytes"],
+        "placement": placement,
         "metrics": metrics,
         "fleet": fleet,
     }
@@ -2413,6 +2623,11 @@ if __name__ == "__main__":
         # the p99-vs-SLO gate, the under-load telemetry overhead, and the
         # induced-violation stage attribution.
         print(json.dumps(asyncio.run(fleet_scale_section())))
+        sys.exit(0)
+    if "--placement" in sys.argv:
+        # Standalone placement run: one JSON line with the skewed-traffic
+        # recovery ratio, tenant isolation, and migrated bytes.
+        print(json.dumps(asyncio.run(placement_section())))
         sys.exit(0)
     if "--delta-sync" in sys.argv:
         # Standalone quantized/delta wire-tier run: one JSON line with the

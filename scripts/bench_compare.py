@@ -112,6 +112,16 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "fleet_ops_per_s": ("higher", 0.40, "rel"),
     "fleet_get_p99_ms": ("lower", 1.00, "rel"),
     "fleet_ledger_overhead_pct": ("lower", 4.0, "abs"),
+    # Traffic-aware placement (ISSUE 16). The recovery ratio divides two
+    # ops/s figures from the SAME run (skewed-with-engine over uniform
+    # baseline), so host weather largely cancels — a real drop means the
+    # engine stopped recovering the skew; the quiet-tenant p99 ratio is
+    # tail-over-tail and budgeted loosely; migrated bytes are workload-
+    # shaped, so the budget only catches the engine going dark (bytes
+    # collapsing toward zero), not round-to-round variation.
+    "rebalance_recovery_ratio": ("higher", 0.30, "rel"),
+    "tenant_isolation_p99_ratio": ("lower", 1.00, "rel"),
+    "migration_bytes": ("higher", 0.90, "rel"),
 }
 
 

@@ -1,8 +1,11 @@
 #!/usr/bin/env python
 """Drive a small store round trip and capture the decision-telemetry plane:
-prints the traffic matrix JSON to stdout and writes the merged flight
-record to /tmp/ts_flight_record.json (tpu_watch.sh moves both into its
-OUTDIR during a device capture). Safe to run anywhere a store can boot."""
+prints one JSON doc to stdout holding the traffic matrix, the SLO
+scoreboard (``ts.slo_report()``), and the control plane's dry-run view
+(``ts.control_plan()`` — what the policy engine WOULD do over this
+traffic), and writes the merged flight record to
+/tmp/ts_flight_record.json (tpu_watch.sh moves both into its OUTDIR
+during a device capture). Safe to run anywhere a store can boot."""
 
 import asyncio
 import json
@@ -28,15 +31,22 @@ async def main() -> int:
         await ts.get_batch(dict(dests), store_name="telemetry_capture")
         await ts.get_batch(dict(dests), store_name="telemetry_capture")
         matrix = await ts.traffic_matrix(store_name="telemetry_capture")
+        slo = await ts.slo_report(store_name="telemetry_capture")
+        plan = await ts.control_plan(store_name="telemetry_capture")
         record = await ts.flight_record(store_name="telemetry_capture")
-        print(json.dumps(matrix))
+        print(
+            json.dumps(
+                {"traffic": matrix, "slo": slo, "control_plan": plan}
+            )
+        )
         # One-shot CLI at capture end: nothing else runs on this loop, so
         # a synchronous write cannot stall concurrent work.
         with open("/tmp/ts_flight_record.json", "w") as f:  # tslint: disable=async-blocking
             json.dump(record, f)
         print(
             f"# captured {len(record['events'])} flight event(s), "
-            f"{len(matrix['edges'])} matrix source host(s)",
+            f"{len(matrix['edges'])} matrix source host(s), "
+            f"{len(plan.get('actions') or ())} planned control action(s)",
             file=sys.stderr,
         )
         return 0

@@ -6,7 +6,7 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (14 rules,
+#   1. tslint --fail-on-new     repo-specific static analysis (15 rules,
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
 #                               --regen-metric-docs after editing knobs or
@@ -25,7 +25,11 @@
 #                               controller throughput scaling, and the
 #                               fleet_scale loadgen section's p99-vs-SLO
 #                               gate + under-load telemetry budget +
-#                               induced-violation stage attribution) and
+#                               induced-violation stage attribution, and
+#                               the placement section's skewed-loadgen
+#                               control loop: plan non-empty on skew,
+#                               decisions applied, zero failed gets
+#                               mid-migration) and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

@@ -54,6 +54,10 @@ async def test_bench_run_tiny(capsys):
         fleet_duration_s=1.2,
         fleet_volumes=2,
         fleet_gate_ms=2000.0,
+        placement_drivers=2,
+        placement_logical=4,
+        placement_duration_s=1.2,
+        placement_volumes=2,
     )
 
     # The headline record: the exact contract the driver parses.
@@ -187,6 +191,19 @@ async def test_bench_run_tiny(capsys):
     assert fs["logical_clients"] == 8 and fs["drivers"] == 2
     assert fs["violation"]["dominant_stage"] == "landing"
     assert fs["violation"]["violations"] > 0
+
+    # Placement section (ISSUE 16): the section asserts its own gates
+    # (control_plan non-empty on the skewed workload, decisions applied,
+    # zero failed drivers / op errors while keys migrate mid-leg) —
+    # reaching here means they held at smoke scale; the headline keys
+    # must still ride the record. The >=70%-recovery / <=1.5x-isolation
+    # bars are the full-scale run's bench_compare contract.
+    assert result["rebalance_recovery_ratio"] > 0
+    assert result["migration_bytes"] >= 0
+    pl = result["placement"]
+    assert pl["plan_actions"], pl
+    assert pl["decisions"], pl
+    assert pl["by_tenant_skewed_on"], pl
 
     # The whole record (what bench prints as its one stdout JSON line)
     # must serialize.
@@ -458,4 +475,53 @@ async def test_bench_fleet_scale_section_tiny():
     assert out["violation"]["dominant_stage"] == "landing", out["violation"]
     assert out["violation"]["violations"] > 0, out["violation"]
     assert "noise_floor_pct" in out["ledger_overhead_under_load"], out
+    json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_placement_section_tiny():
+    """The placement section standalone (``bench.py --placement``) at
+    tiny load: real loadgen driver processes with tenant cohorts and a
+    Zipf-skewed key pick against a real 2-volume fleet, the control
+    engine planning and acting through ``ts.control_plan`` /
+    ``ts.rebalance``. The section asserts its own acceptance internally
+    — non-empty plan on skew, at least one decision applied, zero failed
+    drivers / op errors while a rebalance rides inside the skewed leg —
+    so this smoke proves those assertions can never ship broken. The
+    >= 70% recovery / <= 1.5x isolation bars are the full-scale run's
+    bench_compare contract."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.placement_section(
+        n_drivers=2,
+        n_logical=4,
+        duration_s=1.2,
+        n_volumes=2,
+        value_kb=8.0,
+        shared_keys=16,
+        rate_hz=10.0,
+        tenants=2,
+        zipf_alpha=1.6,
+        rebalance_rounds=2,
+    )
+    assert out["uniform_ops_per_s"] > 0, out
+    assert out["skewed_on_ops_per_s"] > 0, out
+    assert out["rebalance_recovery_ratio"] > 0, out
+    assert out["plan_actions"], out
+    acted = [
+        d
+        for d in out["decisions"]
+        if str(d.get("outcome", "")).startswith(("applied", "deferred"))
+    ]
+    assert acted, out["decisions"]
+    # Tenant labels flow through to the merged scoreboard: both cohorts
+    # observed ops, and the quiet tenant carries its own get p99.
+    tenants = out["by_tenant_skewed_on"]
+    assert set(tenants) == {"t0", "t1"}, tenants
+    assert all(row["count"] > 0 for row in tenants.values()), tenants
+    assert out["migration_bytes"] >= 0, out
     json.dumps(out)

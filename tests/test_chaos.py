@@ -664,3 +664,179 @@ async def test_chaos_soak_randomized(fast_health):
     finally:
         await ts.clear_faults(store_name="chaos_soak")
         await ts.shutdown("chaos_soak")
+
+
+# --------------------------------------------------------------------------
+# control plane (ISSUE 16): volume dies mid-migration; reshard under traffic
+# --------------------------------------------------------------------------
+
+
+async def _seed_hot_key(store_name: str, rng_fill: float = 1.0) -> dict:
+    """Committed baseline: one 32 KB key re-put hot plus four quiet 2 KB
+    keys; returns ``{key: expected array}`` for loss checks."""
+    expected = {}
+    hot = np.full(8192, rng_fill, np.float32)  # 32 KB
+    for _ in range(8):
+        await ts.put("ctl/hot", hot, store_name=store_name)
+    expected["ctl/hot"] = hot
+    for i in range(4):
+        arr = np.full(512, float(i), np.float32)  # 2 KB
+        await ts.put(f"ctl/quiet{i}", arr, store_name=store_name)
+        expected[f"ctl/quiet{i}"] = arr
+    return expected
+
+
+async def _assert_no_loss(store_name: str, expected: dict) -> None:
+    for key, want in expected.items():
+        got = await ts.get(key, store_name=store_name)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+async def test_chaos_volume_dies_mid_migration(fast_health, monkeypatch):
+    """A volume dies while an engine-driven migration is copying onto it
+    (``control.migrate`` delay faultpoint holds the copy open): the
+    action fails LOUDLY — an ``error``/``abandoned`` decision outcome,
+    never a silent half-move — no committed generation is lost, and
+    concurrent reads stay consistent throughout. A plain injected raise
+    at the same faultpoint is checked first (the cheap determinism)."""
+    monkeypatch.setenv("TORCHSTORE_TPU_CONTROL_MIN_WINDOW_BYTES", "1024")
+    monkeypatch.setenv("TORCHSTORE_TPU_CONTROL_HOT_KEY_MIN_BYTES", "4096")
+    monkeypatch.setenv("TORCHSTORE_TPU_CONTROL_COOLDOWN_S", "0.2")
+    await ts.initialize(
+        num_storage_volumes=3,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="chaos_ctl",
+    )
+    try:
+        expected = await _seed_hot_key("chaos_ctl")
+        plan = await ts.control_plan("chaos_ctl")
+        moves = [
+            a
+            for a in plan["actions"]
+            if a["kind"] in ("migrate_key", "split_hot_key")
+        ]
+        assert moves, f"policy saw no hot key: {plan}"
+        assert moves[0]["subject"] == "ctl/hot"
+
+        # Leg 1: the copy path raises at the faultpoint — the round
+        # continues, the outcome says error, nothing is lost.
+        await ts.inject_fault(
+            "control.migrate", "raise", count=1, scope="controller",
+            store_name="chaos_ctl",
+        )
+        rep = await ts.rebalance("chaos_ctl")
+        outcomes = [a["outcome"] for a in rep["actions"]]
+        assert any(o.startswith("error:") for o in outcomes), outcomes
+        await _assert_no_loss("chaos_ctl", expected)
+
+        # Leg 2: hold the NEXT migration open long enough to kill its
+        # destination volume under it, with a live read loop running.
+        await asyncio.sleep(0.3)  # let the failed subject's cooldown lapse
+        for _ in range(4):  # refresh the rolling window
+            await ts.put("ctl/hot", expected["ctl/hot"], store_name="chaos_ctl")
+        plan = await ts.control_plan("chaos_ctl")
+        moves = [
+            a
+            for a in plan["actions"]
+            if a["kind"] in ("migrate_key", "split_hot_key")
+        ]
+        assert moves, f"policy went quiet after the failed round: {plan}"
+        dst = moves[0]["dst_volume"]
+        await ts.inject_fault(
+            "control.migrate", "delay", count=1, delay_ms=1200,
+            scope="controller", store_name="chaos_ctl",
+        )
+        reb_task = asyncio.ensure_future(ts.rebalance("chaos_ctl"))
+        await asyncio.sleep(0.3)
+        await _kill_volume("chaos_ctl", dst)
+        read_errors = []
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                await _assert_no_loss("chaos_ctl", expected)
+            except Exception as exc:  # noqa: BLE001 - asserted below
+                read_errors.append(repr(exc))
+                break
+            await asyncio.sleep(0.1)
+        rep = await asyncio.wait_for(reb_task, timeout=60.0)
+        assert read_errors == []
+        by_subject = {
+            a["subject"]: a["outcome"]
+            for a in rep["actions"]
+            if a["kind"] in ("migrate_key", "split_hot_key")
+        }
+        # The move onto the dead volume must NOT report applied — it
+        # failed loudly and the decision audit says so.
+        assert "ctl/hot" in by_subject, rep["actions"]
+        assert not by_subject["ctl/hot"].startswith("applied"), by_subject
+        assert by_subject["ctl/hot"].split(":")[0] in ("error", "abandoned")
+        # Zero committed-generation loss once the dust settles (the dead
+        # volume only ever held a second replica or the aborted copy).
+        await _assert_no_loss("chaos_ctl", expected)
+
+        # The reconcile-entry faultpoint is live too: an injected raise
+        # fails the manual trigger LOUDLY (no silent empty round).
+        await ts.inject_fault(
+            "control.reconcile", "raise", count=1, scope="controller",
+            store_name="chaos_ctl",
+        )
+        with pytest.raises(Exception, match="control.reconcile"):
+            await ts.rebalance("chaos_ctl")
+    finally:
+        await ts.clear_faults(store_name="chaos_ctl")
+        await ts.shutdown("chaos_ctl")
+
+
+async def test_chaos_reshard_under_live_traffic(fast_health):
+    """Runtime elastic resharding (``ts.rebalance(shards=2)``) under a
+    concurrent get loop: zero lost keys, zero failed client ops — stale-
+    topology errors are absorbed by the metadata router's reload+retry."""
+    await ts.initialize(
+        num_storage_volumes=2,
+        store_name="chaos_reshard",
+    )
+    try:
+        expected = {}
+        for i in range(24):
+            arr = np.full(256, float(i), np.float32)
+            await ts.put(f"rk/{i:02d}", arr, store_name="chaos_reshard")
+            expected[f"rk/{i:02d}"] = arr
+        stop = asyncio.Event()
+        read_errors: list[str] = []
+        reads = {"n": 0}
+
+        async def read_loop():
+            keys = sorted(expected)
+            while not stop.is_set():
+                key = keys[reads["n"] % len(keys)]
+                try:
+                    got = await ts.get(key, store_name="chaos_reshard")
+                    np.testing.assert_array_equal(
+                        np.asarray(got), expected[key]
+                    )
+                except Exception as exc:  # noqa: BLE001 - asserted below
+                    read_errors.append(f"{key}: {exc!r}")
+                    return
+                reads["n"] += 1
+                await asyncio.sleep(0)
+
+        reader = asyncio.ensure_future(read_loop())
+        try:
+            summary = await asyncio.wait_for(
+                ts.rebalance("chaos_reshard", shards=2), timeout=120.0
+            )
+            assert summary["shards"] == 2 and summary["was"] == 1
+            assert summary["keys"] == len(expected), summary
+            # Writes keep landing on the NEW plane too.
+            extra = np.full(256, 99.0, np.float32)
+            await ts.put("rk/post", extra, store_name="chaos_reshard")
+            expected["rk/post"] = extra
+            await asyncio.sleep(0.2)
+        finally:
+            stop.set()
+            await asyncio.wait_for(reader, timeout=30.0)
+        assert read_errors == []
+        assert reads["n"] > 0  # the loop demonstrably overlapped the swap
+        await _assert_no_loss("chaos_reshard", expected)
+    finally:
+        await ts.shutdown("chaos_reshard")

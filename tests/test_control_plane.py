@@ -1,0 +1,468 @@
+"""Unit tests for the control plane's pure core (ISSUE 16).
+
+The solver is a pure function over a frozen :class:`TelemetrySnapshot` —
+every policy behavior is pinned here over hand-built snapshots, no fleet,
+no clock:
+
+- skew -> co-locate: an overloaded volume's single-replica hot keys
+  migrate onto the least-loaded volume on the dominant CONSUMER host;
+- hot key -> split: one key dominating its volume's window gains a
+  replica instead of moving;
+- balanced / settling fleet -> empty plan (the hysteresis band);
+- damping: cooldown suppresses same-subject re-decisions and a reversal
+  of a remembered migration is dropped even past the cooldown window;
+- demote / relay / reshard families and the max_actions budget.
+
+Plus the other two pure pieces: the token-bucket admission math over an
+injected clock, and ``build_snapshot``'s fold of raw telemetry dicts.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from torchstore_tpu.control.admission import AdmissionController, TokenBucket
+from torchstore_tpu.control.snapshot import (
+    KeyStat,
+    RelayView,
+    TelemetrySnapshot,
+    VolumeLoad,
+    build_snapshot,
+)
+from torchstore_tpu.control.solver import (
+    DEMOTE,
+    MIGRATE,
+    RELAY_ORDER,
+    RESHARD,
+    SPLIT,
+    Action,
+    ActionRecord,
+    ControlPolicy,
+    solve,
+)
+from torchstore_tpu.observability import recorder as obs_recorder
+
+NOW = 1000.0
+
+# Small-number policy so fixtures stay readable: thresholds in KB, not MB.
+POLICY = ControlPolicy(
+    min_window_bytes=1000,
+    hot_key_min_bytes=1000,
+    min_edge_bytes=1000,
+)
+
+
+def _vol(vid, host, window, stored=0, tier_resident=0, tier_budget=0):
+    return VolumeLoad(
+        volume_id=vid,
+        host=host,
+        window_bytes=window,
+        stored_bytes=stored,
+        tier_resident_bytes=tier_resident,
+        tier_budget_bytes=tier_budget,
+    )
+
+
+def _skewed_snapshot():
+    """v0 (host A) runs 10000B against a 4000B fleet mean; its traffic
+    flows dominantly to host B, where v1 sits nearly idle."""
+    return TelemetrySnapshot(
+        generated_ts=NOW,
+        volumes={
+            "v0": _vol("v0", "hostA", 10000),
+            "v1": _vol("v1", "hostB", 1000),
+            "v2": _vol("v2", "hostC", 1000),
+        },
+        edges={"hostA": {"hostB": 5000, "hostC": 100}},
+        hot_keys=(
+            KeyStat(key="k_hot", ops=50, bytes=6000, volumes=("v0",)),
+            KeyStat(key="k_warm", ops=20, bytes=3000, volumes=("v0",)),
+        ),
+    )
+
+
+class TestSolverMigration:
+    def test_skew_migrates_to_dominant_consumer_host(self):
+        actions = solve(_skewed_snapshot(), POLICY)
+        assert [a.kind for a in actions] == [MIGRATE]
+        (a,) = actions
+        # Co-location: v1 (host B, the heaviest outgoing edge), not v2
+        # (host C) which is equally idle but off the traffic path.
+        assert (a.subject, a.src_volume, a.dst_volume) == (
+            "k_hot",
+            "v0",
+            "v1",
+        )
+        # Moving k_hot (6000B) clears the settle excess (10000 - 1.5 *
+        # 4000 = 4000B), so k_warm stays put.
+        assert a.keys == ("k_hot",)
+
+    def test_multi_replica_keys_stay_put(self):
+        snap = _skewed_snapshot()
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes=snap.volumes,
+            edges=snap.edges,
+            hot_keys=(
+                KeyStat(key="k_hot", bytes=6000, volumes=("v0", "v2")),
+                KeyStat(key="k_warm", bytes=3000, volumes=("v0",)),
+            ),
+        )
+        actions = [a for a in solve(snap, POLICY) if a.kind == MIGRATE]
+        # k_hot already has a second serving replica: migration skips it
+        # (a split would spread it); k_warm is the mover.
+        assert [a.subject for a in actions] == ["k_warm"]
+
+    def test_single_volume_fleet_never_migrates(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={"v0": _vol("v0", "hostA", 50000)},
+            hot_keys=(KeyStat(key="k", bytes=40000, volumes=("v0",)),),
+        )
+        assert [a.kind for a in solve(snap, POLICY)] == []
+
+
+class TestSolverHotKeySplit:
+    def test_dominant_key_gains_replica(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                # mean 5250; 10000 < 2.0x mean, so migration stays quiet
+                # and the split family owns this fixture.
+                "v0": _vol("v0", "hostA", 10000),
+                "v1": _vol("v1", "hostB", 500),
+            },
+            hot_keys=(KeyStat(key="k_hot", bytes=6000, volumes=("v0",)),),
+        )
+        actions = solve(snap, POLICY)
+        assert [a.kind for a in actions] == [SPLIT]
+        (a,) = actions
+        assert (a.subject, a.src_volume, a.dst_volume) == (
+            "k_hot",
+            "v0",
+            "v1",
+        )
+
+    def test_replica_cap_stops_splitting(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol("v0", "hostA", 10000),
+                "v1": _vol("v1", "hostB", 500),
+                "v2": _vol("v2", "hostC", 500),
+                "v3": _vol("v3", "hostD", 500),
+            },
+            hot_keys=(
+                KeyStat(
+                    key="k_hot", bytes=9000, volumes=("v0", "v1", "v2")
+                ),
+            ),
+        )
+        assert solve(snap, POLICY) == []  # at max_replicas=3 already
+
+
+class TestSolverHysteresis:
+    def test_balanced_fleet_solves_to_empty_plan(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol("v0", "hostA", 5000),
+                "v1": _vol("v1", "hostB", 5000),
+            },
+            hot_keys=(KeyStat(key="k", bytes=400, volumes=("v0",)),),
+        )
+        assert solve(snap, POLICY) == []
+
+    def test_settling_band_is_left_alone(self):
+        # 8500B vs a 5000B mean = 1.7x: past settle (1.5) but under the
+        # enter threshold (2.0) — the fleet is settling, no new plan.
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol("v0", "hostA", 8500),
+                "v1": _vol("v1", "hostB", 1500),
+            },
+            hot_keys=(KeyStat(key="k", bytes=900, volumes=("v0",)),),
+        )
+        assert solve(snap, POLICY) == []
+
+    def test_cooldown_suppresses_recent_subject(self):
+        history = [
+            ActionRecord(
+                ts=NOW - 5.0,
+                kind=MIGRATE,
+                subject="k_hot",
+                src_volume="v0",
+                dst_volume="v1",
+            )
+        ]
+        actions = solve(_skewed_snapshot(), POLICY, history)
+        # k_hot is inside cooldown_s=30: the solver falls through to the
+        # next-hottest single-replica key.
+        assert [a.subject for a in actions if a.kind == MIGRATE] == [
+            "k_warm"
+        ]
+
+    def test_cooldown_expires(self):
+        history = [
+            ActionRecord(
+                ts=NOW - 500.0,
+                kind=MIGRATE,
+                subject="k_hot",
+                src_volume="v0",
+                dst_volume="v1",
+            )
+        ]
+        actions = solve(_skewed_snapshot(), POLICY, history)
+        assert [a.subject for a in actions if a.kind == MIGRATE] == [
+            "k_hot"
+        ]
+
+    def test_reversal_dropped_even_past_cooldown(self):
+        # The remembered move went v1 -> v0 long ago; proposing v0 -> v1
+        # for the same key would oscillate — dropped regardless of age.
+        history = [
+            ActionRecord(
+                ts=NOW - 10_000.0,
+                kind=MIGRATE,
+                subject="k_hot",
+                src_volume="v1",
+                dst_volume="v0",
+            )
+        ]
+        actions = solve(_skewed_snapshot(), POLICY, history)
+        assert [a.subject for a in actions if a.kind == MIGRATE] == [
+            "k_warm"
+        ]
+
+
+class TestSolverOtherFamilies:
+    def test_tier_pressure_demotes_cold_keys(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol(
+                    "v0", "hostA", 500, tier_resident=900, tier_budget=1000
+                ),
+                "v1": _vol("v1", "hostB", 500),
+            },
+            cold_keys={"v0": ("idle_a", "idle_b")},
+        )
+        actions = solve(snap, POLICY)
+        assert [a.kind for a in actions] == [DEMOTE]
+        assert actions[0].subject == "v0"
+        assert actions[0].keys == ("idle_a", "idle_b")
+
+    def test_relay_reorders_by_measured_proximity(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol("v0", "hostA", 100),
+                "v1": _vol("v1", "hostB", 100),
+                "v2": _vol("v2", "hostC", 100),
+            },
+            edges={"hostA": {"hostC": 5000}},
+            relays=(
+                RelayView(
+                    channel="ch0", root="v0", members=("v0", "v1", "v2")
+                ),
+            ),
+        )
+        actions = solve(snap, POLICY)
+        assert [a.kind for a in actions] == [RELAY_ORDER]
+        # v2 (host C) carries the measured origin edge: it attaches
+        # nearest the root, displacing the sorted-id default (v1, v2).
+        assert actions[0].order == ("v2", "v1")
+
+    def test_quiet_relay_keeps_default_order(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={
+                "v0": _vol("v0", "hostA", 100),
+                "v1": _vol("v1", "hostB", 100),
+                "v2": _vol("v2", "hostC", 100),
+            },
+            edges={"hostA": {"hostC": 10}},  # under min_edge_bytes
+            relays=(
+                RelayView(
+                    channel="ch0", root="v0", members=("v0", "v1", "v2")
+                ),
+            ),
+        )
+        assert solve(snap, POLICY) == []
+
+    def test_meta_pressure_doubles_shards(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={"v0": _vol("v0", "hostA", 0)},
+            meta_inflight={"coord": 40},
+            n_shards=1,
+        )
+        actions = solve(snap, POLICY)
+        assert [a.kind for a in actions] == [RESHARD]
+        assert actions[0].shards == 2
+
+    def test_reshard_capped_at_max_shards(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes={"v0": _vol("v0", "hostA", 0)},
+            meta_inflight={"s0": 100, "s1": 100},
+            n_shards=8,
+        )
+        assert solve(snap, POLICY) == []
+
+    def test_max_actions_budget_keeps_highest_priority(self):
+        snap = TelemetrySnapshot(
+            generated_ts=NOW,
+            volumes=_skewed_snapshot().volumes,
+            edges=_skewed_snapshot().edges,
+            hot_keys=_skewed_snapshot().hot_keys,
+            meta_inflight={"coord": 100},
+            n_shards=1,
+        )
+        policy = ControlPolicy(
+            min_window_bytes=1000,
+            hot_key_min_bytes=1000,
+            min_edge_bytes=1000,
+            max_actions=1,
+        )
+        actions = solve(snap, policy)
+        # Both the migrate and the reshard qualify; the budget keeps the
+        # higher-priority family.
+        assert [a.kind for a in actions] == [MIGRATE]
+
+    def test_action_describe_is_json_shaped(self):
+        (a,) = solve(_skewed_snapshot(), POLICY)
+        doc = a.describe()
+        assert doc["kind"] == MIGRATE and doc["keys"] == ["k_hot"]
+        assert isinstance(doc["reason"], str) and doc["reason"]
+
+
+class TestTokenBucket:
+    def test_burst_then_deficit_then_refill(self):
+        bucket = TokenBucket(rate_hz=10.0, burst=5.0)
+        assert bucket.reserve(0.0, 5.0) == 0.0  # burst covers it
+        assert bucket.reserve(0.0, 1.0) == pytest.approx(0.1)  # 1 token short
+        # One second later the refill (10 tokens, capped at burst) has
+        # cleared the deficit.
+        assert bucket.reserve(1.0, 1.0) == 0.0
+
+    def test_deficits_queue_fairly(self):
+        bucket = TokenBucket(rate_hz=1.0, burst=1.0)
+        assert bucket.reserve(0.0, 1.0) == 0.0
+        assert bucket.reserve(0.0, 1.0) == pytest.approx(1.0)
+        # The next reserver waits behind the first deficit, not beside it.
+        assert bucket.reserve(0.0, 1.0) == pytest.approx(2.0)
+
+    def test_set_rate_rescales_waits(self):
+        bucket = TokenBucket(rate_hz=10.0, burst=1.0)
+        bucket.reserve(0.0, 1.0)
+        bucket.set_rate(1.0)
+        assert bucket.reserve(0.0, 1.0) == pytest.approx(1.0)
+
+
+class TestAdmissionController:
+    def test_unthrottled_fast_path(self):
+        ctl = AdmissionController(rate_hz=100.0, tenant="t1")
+        assert ctl.admit(1, now=0.0) == 0.0
+        assert ctl.factor == 1.0 and not ctl.describe()["throttling"]
+
+    def test_overload_scales_rate_down_and_back(self):
+        obs_recorder.reset_recorder()
+        ctl = AdmissionController(
+            rate_hz=100.0, tenant="t1", overload_inflight=16
+        )
+        factor = ctl.refresh(
+            {"volumes": {"v0": {"landing_inflight": 64}}}
+        )
+        assert factor == pytest.approx(16 / 64)
+        assert ctl.bucket.rate_hz == pytest.approx(100.0 * 16 / 64)
+        # Releasing the pressure restores the base rate.
+        assert ctl.refresh({}) == 1.0
+        assert ctl.bucket.rate_hz == pytest.approx(100.0)
+        # Only the two TRANSITIONS hit the flight ring, as decision
+        # events — not one event per admitted op.
+        names = [
+            e["name"]
+            for e in obs_recorder.snapshot()
+            if e["kind"] == "decision"
+        ]
+        assert names == ["admission_throttle", "admission_release"]
+
+    def test_floor_factor(self):
+        ctl = AdmissionController(
+            rate_hz=10.0, tenant="t1", overload_inflight=4, min_factor=0.25
+        )
+        assert ctl.refresh(
+            {"metadata_rpc_inflight": {"s0": 10_000}}
+        ) == pytest.approx(0.25)
+
+    def test_local_signal_feeds_refresh(self):
+        ctl = AdmissionController(
+            rate_hz=10.0, tenant="t1", overload_inflight=8
+        )
+        ctl.bind_local_signal(lambda: {"coord": 32})
+        assert ctl.refresh() == pytest.approx(8 / 32)
+
+
+class TestBuildSnapshot:
+    def test_folds_ledger_windows_and_hot_keys(self):
+        snap = build_snapshot(
+            volume_stats={
+                "v0": {
+                    "entries": 3,
+                    "stored_bytes": 4096,
+                    "ledger": {"window": {"ops": 7, "bytes": 9000}},
+                    "hot_keys": [{"key": "k", "ops": 5, "bytes": 6000}],
+                    "tier": {"resident_bytes": 10, "budget_bytes": 100},
+                },
+            },
+            traffic={
+                "edges": {"hostA": {"hostB": {"bytes": 1234}}},
+                # One-sided serves only the CLIENT ledgers saw: they fold
+                # into the same per-key stat.
+                "keys": {"client": [{"key": "k", "ops": 2, "bytes": 500}]},
+            },
+            placement={"v0": "hostA", "v1": "hostB"},
+            key_placement={"k": ["v0"]},
+            cold_keys={"v0": ["idle"]},
+            n_shards=2,
+            relays={"ch0": ("v0", ["v0", "v1"])},
+            generated_ts=NOW,
+        )
+        v0 = snap.volumes["v0"]
+        assert (v0.window_bytes, v0.window_ops) == (9000, 7)
+        assert (v0.host, v0.tier_budget_bytes) == ("hostA", 100)
+        # Placement-only volumes still appear (they are migration
+        # targets even when idle).
+        assert snap.volumes["v1"].window_bytes == 0
+        (k,) = snap.hot_keys
+        assert (k.key, k.ops, k.bytes, k.volumes) == ("k", 7, 6500, ("v0",))
+        assert snap.edges == {"hostA": {"hostB": 1234}}
+        assert snap.cold_keys == {"v0": ("idle",)}
+        assert snap.n_shards == 2
+        assert snap.relays[0].members == ("v0", "v1")
+
+    def test_overload_view_max_merges(self):
+        snap = build_snapshot(
+            volume_stats={
+                "v0": {"ledger": {"window": {"ops": 1, "bytes": 100}}}
+            },
+            overload={
+                "volumes": {
+                    "v0": {"window_bytes": 9999, "landing_inflight": 3},
+                    "v9": {"window_bytes": 50},
+                },
+                "metadata_rpc_inflight": {"coord": 7},
+            },
+        )
+        # The fleet-side fold refines the local view upward, never down.
+        assert snap.volumes["v0"].window_bytes == 9999
+        assert snap.volumes["v0"].landing_inflight == 3
+        assert snap.volumes["v9"].window_bytes == 50
+        assert snap.meta_inflight == {"coord": 7}
+
+    def test_empty_inputs_build_empty_snapshot(self):
+        snap = build_snapshot()
+        assert snap.volumes == {} and snap.hot_keys == ()
+        assert solve(snap) == []  # and the solver no-ops over it

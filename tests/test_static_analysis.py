@@ -1111,3 +1111,116 @@ def test_stage_discipline_live_tree_clean():
     root = str(pathlib.Path(__file__).resolve().parents[1])
     result = run_checks(root, rules=["stage-discipline"])
     assert _msgs(result.findings, "stage-discipline") == []
+
+
+# --------------------------------------------------------------------------
+# 15. control-discipline
+# --------------------------------------------------------------------------
+
+
+def test_control_discipline_flags_silent_actuation(tmp_path):
+    """control-discipline: an actuator call (``migrate_key``, a
+    ``tier_sweep`` endpoint wrapper, a ``_relay_prefer`` re-parent)
+    inside ``control/`` with no decision-audit call in the same function
+    is flagged; functions routing through ``self._decision(...)`` or
+    ``record("decision", ...)`` pass, as do the same primitives outside
+    the control package (auto-repair owns its own event discipline)."""
+    from torchstore_tpu.analysis.checkers import control_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def silent_move(self, key, src, dst):
+                        return await self.host.idx.migrate_key(
+                            key, src, dst, drop_src=True
+                        )  # seeded defect: no decision event
+
+                    async def silent_demote(self, ref, keys):
+                        await ref.tier_sweep.call_one({}, keys)  # seeded defect
+
+                    def silent_reparent(self, host, channel, order):
+                        host._relay_prefer[channel] = tuple(order)  # seeded defect
+
+                    async def audited_move(self, snap, action):
+                        await self.host.idx.migrate_key(
+                            action.subject, action.src, action.dst, drop_src=True
+                        )
+                        return self._decision(snap, action, "applied")
+
+                    def audited_reparent(self, host, channel, order, recorder):
+                        host._relay_prefer[channel] = tuple(order)
+                        recorder.record("decision", "control/relay", order=order)
+            """,
+            "torchstore_tpu/metadata/index_core.py": """
+                async def migrate_key(self, key, src, dst, drop_src):
+                    return await self._do_migrate(key, src, dst, drop_src)
+            """,
+            "torchstore_tpu/controller.py": """
+                async def auto_repair(idx, key, src, dst):
+                    return await idx.migrate_key(key, src, dst, drop_src=False)
+            """,
+        },
+    )
+    findings = control_discipline.check(project)
+    assert all(f.path == "torchstore_tpu/control/engine.py" for f in findings)
+    flagged = sorted(
+        (msg.split("'")[1], msg.split("'")[3])
+        for msg in _msgs(findings, "control-discipline")
+    )
+    assert flagged == [
+        ("_relay_prefer", "silent_reparent"),
+        ("migrate_key", "silent_move"),
+        ("tier_sweep", "silent_demote"),
+    ], flagged
+
+
+def test_control_discipline_nested_scope_not_credited(tmp_path):
+    """The audit call must live in the SAME function scope as the
+    actuation — a ``_decision`` call inside a nested closure does not
+    license the enclosing function's silent actuation."""
+    from torchstore_tpu.analysis.checkers import control_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/control/engine.py": """
+                async def outer(idx, key, src, dst, snap, action):
+                    def audit_later():
+                        return _decision(snap, action, "applied")
+                    await idx.migrate_key(key, src, dst, drop_src=True)
+                    return audit_later
+            """,
+        },
+    )
+    findings = control_discipline.check(project)
+    assert len(findings) == 1, _msgs(findings)
+    assert "'outer'" in findings[0].message
+
+
+def test_control_discipline_pragma(tmp_path):
+    from torchstore_tpu.analysis.checkers import control_discipline  # noqa: F401
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/control/engine.py": """
+                async def bootstrap_copy(idx, key, src, dst):
+                    # Bootstrap pre-seeding, not a policy action.
+                    return await idx.migrate_key(key, src, dst, drop_src=False)  # tslint: disable=control-discipline
+            """,
+        },
+    )
+    result = run_checks(str(tmp_path), rules=["control-discipline"])
+    assert result.new == []
+
+
+def test_control_discipline_live_tree_clean():
+    """The live tree stays clean under the new rule (baseline stays
+    empty): every engine actuator path returns through ``_decision()``,
+    the single chokepoint that stamps ``ts_control_decisions_total`` and
+    the ``decision`` flight-recorder event."""
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    result = run_checks(root, rules=["control-discipline"])
+    assert result.new == [], [str(f) for f in result.new]

@@ -15,6 +15,7 @@ from torchstore_tpu.api import (
     clear_faults,
     client,
     collect_trace,
+    control_plan,
     delete,
     delete_batch,
     delete_prefix,
@@ -40,6 +41,7 @@ from torchstore_tpu.api import (
     put,
     put_batch,
     put_state_dict,
+    rebalance,
     relay_topology,
     repair,
     reset_client,
@@ -102,6 +104,7 @@ __all__ = [
     "clear_faults",
     "client",
     "collect_trace",
+    "control_plan",
     "delete",
     "delete_batch",
     "delete_prefix",
@@ -127,6 +130,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "rebalance",
     "relay_topology",
     "repair",
     "reset_client",

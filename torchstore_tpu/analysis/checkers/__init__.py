@@ -11,6 +11,7 @@ docs/ARCHITECTURE.md.
 from torchstore_tpu.analysis.checkers import (
     async_blocking,
     cancellation,
+    control_discipline,
     endpoint_drift,
     env_registry,
     fork_safety,
@@ -40,4 +41,5 @@ CHECKERS = {
     quant_discipline.RULE: quant_discipline.check,
     shard_discipline.RULE: shard_discipline.check,
     stage_discipline.RULE: stage_discipline.check,
+    control_discipline.RULE: control_discipline.check,
 }

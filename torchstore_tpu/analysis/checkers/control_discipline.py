@@ -1,0 +1,129 @@
+"""control-discipline: every actuator call site in ``torchstore_tpu/control/``
+must record a flight-recorder ``decision`` event in the same function.
+
+The control plane's whole audit story (ISSUE 16) is that *no* placement
+mutation happens silently: the engine funnels every applied/deferred/
+abandoned action through ``_decision()``, which increments
+``ts_control_decisions_total`` and records a ``decision`` flight-recorder
+event. A new actuator call site that skips the funnel would mutate
+placement invisibly — exactly the regression this rule pins.
+
+Mechanics: for each function scope in a ``control/`` module, if the scope
+calls an actuator — ``migrate_key``, ``pull_from``, ``tier_sweep``,
+``set_tiers`` (directly or through an endpoint wrapper like
+``ref.tier_sweep.call_one``), or re-parents a relay by assigning into
+``_relay_prefer`` — the same scope must also contain a decision-audit
+call: a call to ``_decision``/``record_decision``, or a ``record(...)``
+whose first argument is the literal ``"decision"``. Nested function
+bodies are separate scopes (the audit must live where the actuation
+lives, not in a sibling closure).
+
+Modules outside ``control/`` are out of scope: the storage/metadata
+planes call these same primitives on their own authority (auto-repair,
+reclaim) with their own event discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import (
+    Finding,
+    Project,
+    call_tail,
+    dotted_name,
+    iter_function_scopes,
+    walk_scope,
+)
+
+RULE = "control-discipline"
+
+_SCOPE_PREFIX = "torchstore_tpu/control/"
+
+# Attribute names that mutate placement/tier/relay state when called.
+_ACTUATORS = {"migrate_key", "pull_from", "tier_sweep", "set_tiers"}
+
+# Endpoint-invocation wrappers: ``ref.tier_sweep.call_one(...)`` actuates
+# tier_sweep even though the call tail is ``call_one``.
+_ENDPOINT_WRAPPERS = {"call_one", "call", "broadcast", "choose"}
+
+# Assigning into this mapping re-parents a relay tree — an actuation with
+# no call involved.
+_RELAY_STATE = "_relay_prefer"
+
+_AUDIT_CALLS = {"_decision", "record_decision"}
+
+
+def _actuator_name(node: ast.Call) -> str | None:
+    """The actuator a call invokes, or None."""
+    tail = call_tail(node)
+    if tail in _ACTUATORS:
+        return tail
+    if tail in _ENDPOINT_WRAPPERS:
+        dotted = dotted_name(node.func)
+        if dotted:
+            hits = _ACTUATORS.intersection(dotted.split("."))
+            if hits:
+                return sorted(hits)[0]
+    return None
+
+
+def _is_audit_call(node: ast.Call) -> bool:
+    tail = call_tail(node)
+    if tail in _AUDIT_CALLS:
+        return True
+    if tail == "record" and node.args:
+        first = node.args[0]
+        return isinstance(first, ast.Constant) and first.value == "decision"
+    return False
+
+
+def _relay_assign_target(node: ast.AST) -> bool:
+    """True for ``<expr>._relay_prefer[...] = ...`` style targets."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return isinstance(node, ast.Attribute) and node.attr == _RELAY_STATE
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith(_SCOPE_PREFIX):
+            continue
+        for func, body in iter_function_scopes(sf.tree):
+            actuations: list[tuple[int, str]] = []  # (line, actuator)
+            audited = False
+            for node in walk_scope(body):
+                if isinstance(node, ast.Call):
+                    name = _actuator_name(node)
+                    if name is not None:
+                        actuations.append((node.lineno, name))
+                    elif _is_audit_call(node):
+                        audited = True
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    if any(_relay_assign_target(t) for t in targets):
+                        actuations.append((node.lineno, _RELAY_STATE))
+            if not actuations or audited:
+                continue
+            where = func.name if func is not None else "<module>"
+            for line, name in actuations:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=line,
+                        message=(
+                            f"control actuator '{name}' in '{where}' "
+                            "without a flight-recorder decision event — "
+                            "route it through the engine's _decision() "
+                            "(or record('decision', ...)) so the action "
+                            "is auditable"
+                        ),
+                    )
+                )
+    return findings

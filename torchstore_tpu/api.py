@@ -54,6 +54,7 @@ class _StoreHandle:
     volume_env: dict = None  # env the volumes were spawned with (repair)
     repair_meshes: list = None  # replacement volumes spawned by repair()
     shard_mesh: Any = None  # ControllerShard actors (sharded metadata plane)
+    retired_shard_meshes: list = None  # pre-reshard meshes (stopped at shutdown)
 
 
 # Per-process store registry: forked actor children never reuse the parent's
@@ -225,6 +226,7 @@ async def initialize(
         volume_env=dict(volume_env),
         repair_meshes=[],
         shard_mesh=shard_mesh,
+        retired_shard_meshes=[],
     )
     return controller
 
@@ -1124,6 +1126,108 @@ async def tier_sweep(store_name: str = DEFAULT_STORE) -> dict:
     return await client(store_name).tier_sweep()
 
 
+async def _control_signals(
+    store_name: str,
+) -> tuple[Optional[dict], Optional[dict]]:
+    """Fleet-wide signals only a client can fully assemble — the traffic
+    matrix (every process's ledger) and the SLO overload view — shipped to
+    the controller's policy engine alongside its own volume scrape. Either
+    half degrades to None on scrape failure: the engine solves on what it
+    has rather than refusing to plan."""
+    traffic = overload = None
+    try:
+        traffic = await traffic_matrix(store_name)
+    except Exception as exc:  # noqa: BLE001 - partial signals still solve
+        logger.warning("control signals: traffic matrix scrape failed: %s", exc)
+    try:
+        overload = (await slo_report(store_name)).get("overload")
+    except Exception as exc:  # noqa: BLE001 - partial signals still solve
+        logger.warning("control signals: slo report scrape failed: %s", exc)
+    return traffic, overload
+
+
+async def control_plan(store_name: str = DEFAULT_STORE) -> dict:
+    """Dry run of the placement policy engine: assemble the same telemetry
+    snapshot a reconcile round would (fleet traffic matrix + SLO overload
+    signals + per-volume stats), run the pure solver, and return the
+    actions it WOULD take — applying nothing, recording nothing. The
+    inspection surface for "what does the control plane think right now":
+    ``{"actions": [{kind, subject, reason, ...}], "snapshot": {...}}``."""
+    c = client(store_name)
+    await c._ensure_setup()
+    traffic, overload = await _control_signals(store_name)
+    return await c.controller.control_plan.call_one(
+        traffic=traffic, overload=overload
+    )
+
+
+async def rebalance(
+    store_name: str = DEFAULT_STORE, shards: Optional[int] = None
+) -> dict:
+    """Manual control-plane trigger.
+
+    Without ``shards``: run ONE reconcile round now — snapshot, solve,
+    apply, audit — and return ``{"actions": [...], "applied": N}``. Safe
+    alongside the periodic loop (``TORCHSTORE_TPU_CONTROL_INTERVAL_S``):
+    per-subject cooldowns keep back-to-back rounds from thrashing.
+
+    With ``shards=N``: elastically reshard the metadata plane at runtime —
+    spawn a new ControllerShard mesh (N==1 merges back onto the
+    coordinator), freeze-export-replay the whole index onto it, bump the
+    placement epoch, retire the old mesh. Zero lost keys, zero failed
+    client ops: in-flight mutations park during the swap and stale-topology
+    errors are retried by the metadata router after a topology reload.
+    Must run in the process that initialized the store (it owns actor
+    spawning). Returns the controller's reshard summary
+    ``{"shards", "was", "keys", "reindexed", "epoch"}``."""
+    c = client(store_name)
+    await c._ensure_setup()
+    if shards is None:
+        traffic, overload = await _control_signals(store_name)
+        return await c.controller.control_reconcile.call_one(
+            traffic=traffic, overload=overload
+        )
+    shards = int(shards)
+    if shards < 1:
+        raise ValueError(f"rebalance(shards={shards}): need >= 1")
+    handle = _stores.get(store_name)
+    if handle is None:
+        raise RuntimeError(
+            "rebalance(shards=N) spawns controller-shard actors and must "
+            f"run in the process that initialized store {store_name!r}"
+        )
+    new_mesh = None
+    if shards > 1:
+        from torchstore_tpu.metadata.shards import ControllerShard
+
+        generation = len(handle.retired_shard_meshes or ()) + 1
+        new_mesh = await spawn_actors(
+            shards,
+            ControllerShard,
+            f"ts_{store_name}_ctrlshard_g{generation}",
+        )
+    try:
+        result = await handle.controller.reshard.call_one(
+            handle.controller, new_mesh.refs if new_mesh is not None else []
+        )
+    except BaseException:
+        # The old authority thawed controller-side; don't leak the new mesh.
+        if new_mesh is not None:
+            await new_mesh.stop()
+        raise
+    # Old shards are retired (they still drain scheduled reclaims); their
+    # processes stop with the store.
+    if handle.shard_mesh is not None:
+        if handle.retired_shard_meshes is None:
+            handle.retired_shard_meshes = []
+        handle.retired_shard_meshes.append(handle.shard_mesh)
+    handle.shard_mesh = new_mesh
+    # Re-route this client onto the new mesh immediately (other clients
+    # recover through the stale-topology retry + epoch confirmation).
+    await c.controller.load_topology()
+    return result
+
+
 def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
     """Merge every process's Chrome-trace file (``TORCHSTORE_TPU_TRACE``
     base + pid-suffixed siblings) into ONE Perfetto-loadable timeline with
@@ -1187,6 +1291,8 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
             await handle.volume_mesh.stop()
         if handle.shard_mesh is not None:
             await handle.shard_mesh.stop()
+        for mesh in handle.retired_shard_meshes or []:
+            await mesh.stop()
         for mesh in handle.repair_meshes or []:
             await mesh.stop()
         if handle.inproc_volume is not None:
@@ -1201,6 +1307,7 @@ __all__ = [
     "barrier",
     "client",
     "collect_trace",
+    "control_plan",
     "delete",
     "delete_batch",
     "delete_prefix",
@@ -1224,6 +1331,7 @@ __all__ = [
     "put_batch",
     "direct_staging_buffers",
     "put_state_dict",
+    "rebalance",
     "relay_topology",
     "repair",
     "reset_client",

@@ -10,7 +10,9 @@ fast path that lands transport writes directly in destination memory.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -260,6 +262,33 @@ class LocalClient:
         # None when disabled by config.
         self.plan_cache: Optional[SyncPlanCache] = (
             SyncPlanCache() if self._config.plan_cache else None
+        )
+        # Per-tenant admission gate (control plane, client-side half):
+        # None unless armed — the unthrottled hot path pays one attribute
+        # check per batch. The local overload probe is the router's
+        # per-shard inflight view; slo_report overload feeds refresh()
+        # when a harness ships it in.
+        self._admission = None
+        if self._config.control_admission:
+            from torchstore_tpu.control.admission import AdmissionController
+
+            self._admission = AdmissionController(
+                self._config.admit_rate_hz,
+                burst=self._config.admit_burst,
+                tenant=self._config.tenant,
+                overload_inflight=self._config.overload_inflight,
+            )
+            self._admission.bind_local_signal(
+                self._controller.inflight_snapshot
+            )
+        # Hot-key read spreading (replica_spread): a stable per-client salt
+        # rotates which equally-eligible replica sorts first, per key —
+        # otherwise every client drains the same deterministic first choice
+        # and the policy engine's hot-key splits never share load.
+        self._spread_salt: Optional[str] = (
+            f"{os.getpid()}-{id(self):x}"
+            if self._config.replica_spread
+            else None
         )
 
     @property
@@ -542,6 +571,12 @@ class LocalClient:
         watermark: Optional[tuple] = None,
         unchanged: Optional[dict] = None,
     ) -> int:
+        if self._admission is not None:
+            # Backpressure BEFORE any volume sees bytes: a bursting tenant
+            # queues at its own bucket, not inside the landing pool.
+            delay = self._admission.admit(len(items))
+            if delay > 0.0:
+                await asyncio.sleep(delay)
         await self._ensure_setup()
         if self._volumes_stale:
             await self._refresh_health()
@@ -862,6 +897,10 @@ class LocalClient:
             )
         if not isinstance(items, dict):
             items = {key: None for key in items}
+        if self._admission is not None:
+            delay = self._admission.admit(len(items))
+            if delay > 0.0:
+                await asyncio.sleep(delay)
         await self._ensure_setup()
         if self._config.one_sided:
             # Covered warm batch: every member served straight from stamped
@@ -1543,16 +1582,23 @@ class LocalClient:
             pass
         # Prefer healthy volumes first (replica failover), then the
         # caller's preferred replica (a relay-distributed local copy),
-        # then this client's own volume, then stable order (locality).
+        # then this client's own volume, then stable order (locality) —
+        # or, with replica_spread on, a per-(client, key) salted rotation
+        # so split replicas of a hot key share the read load across
+        # clients instead of all draining the same first choice.
         # Known-dead and supervisor-quarantined volumes stay as a last
         # resort: if they hold the only copy the fetch still tries them
         # and surfaces the real error.
+        salt = self._spread_salt
         ordered = sorted(
             infos,
             key=lambda v: (
                 v in self._dead_volumes or v in self._avoid_volumes,
                 v != prefer_volume,
                 v != own_id,
+                zlib.crc32(f"{salt}|{req.key}|{v}".encode())
+                if salt is not None
+                else 0,
                 v,
             ),
         )

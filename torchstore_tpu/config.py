@@ -199,6 +199,70 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "(lease_acquire without an explicit ttl_s; renew to keep a "
            "version pinned past it). A crashed cohort's pin expires "
            "instead of retaining capacity forever."),
+    # --- control plane (torchstore_tpu/control/) ----------------------------
+    EnvVar("TORCHSTORE_TPU_CONTROL_INTERVAL_S", "float", 0,
+           "Placement policy engine reconcile period, seconds: every "
+           "interval the controller snapshots fleet telemetry, runs the "
+           "pure solver, and applies/audits the resulting actions "
+           "(migrations, hot-key splits, relay re-ordering, frequency-"
+           "aware demotions). <= 0 (the default) disables the periodic "
+           "loop; ts.rebalance() / ts.control_plan() still serve on "
+           "demand."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_OVERLOAD_RATIO", "float", 2.0,
+           "Solver: a volume whose rolling-window traffic exceeds this "
+           "multiple of the fleet mean counts as overloaded and sheds "
+           "keys (migrations stop once it projects under the settle "
+           "ratio)."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_MIN_WINDOW_BYTES", "int", 65536,
+           "Solver: volumes whose rolling window moved fewer than this "
+           "many bytes are ignored entirely — an idle fleet must plan "
+           "zero actions."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_HOT_KEY_MIN_BYTES", "int", 1048576,
+           "Solver: a key must move at least this many bytes in the "
+           "window before it is hot enough to split across an additional "
+           "replica."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_MIN_EDGE_BYTES", "int", 1048576,
+           "Solver: relay trees re-order members by measured edge "
+           "proximity only when the dominant consumer edge carried at "
+           "least this many bytes."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_COOLDOWN_S", "float", 30.0,
+           "Solver hysteresis: a subject acted on (or attempted) within "
+           "this window is not acted on again, and a reversal of a prior "
+           "action is damped for twice the window — the engine must "
+           "converge, not oscillate."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_MAX_ACTIONS", "int", 8,
+           "Solver: cap on actions per reconcile round (highest-impact "
+           "first); convergence happens over rounds, not in one "
+           "stop-the-world batch."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_ADMISSION", "bool", False,
+           "Per-tenant admission control: client put/get batches reserve "
+           "a token per logical op from a tenant-labeled bucket and "
+           "sleep out any deficit BEFORE touching a volume. The refill "
+           "rate scales down while overload signals (per-shard metadata "
+           "RPC inflight, per-volume landing_inflight) exceed "
+           "TORCHSTORE_TPU_CONTROL_OVERLOAD_INFLIGHT."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_ADMIT_RATE", "float", 512.0,
+           "Admission control: steady-state refill rate, logical ops per "
+           "second per client."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_ADMIT_BURST", "float", None,
+           "Admission control: bucket depth, ops (how far a tenant may "
+           "burst above the steady rate before queuing at its own "
+           "bucket). Default: 2x the admit rate."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_OVERLOAD_INFLIGHT", "int", 16,
+           "Admission control: overload knee. While the deepest observed "
+           "inflight signal exceeds this, the refill factor scales down "
+           "proportionally (knee/depth, floored at 0.1); throttle "
+           "engage/release transitions are recorded as flight-recorder "
+           "decision events."),
+    EnvVar("TORCHSTORE_TPU_CONTROL_REPLICA_SPREAD", "bool", False,
+           "Hot-key read spreading: clients rotate which replica they "
+           "read first by a stable per-client salt instead of every "
+           "client draining the same deterministic first choice — the "
+           "read-side half of the policy engine's hot-key splits."),
+    EnvVar("TORCHSTORE_TPU_TENANT", "str", "",
+           "Tenant/cohort label this process's client carries: admission "
+           "buckets, loadgen op records, and scoreboard rows are keyed "
+           "by it (empty reads as 'default')."),
     # --- cold-start provisioning (prewarm) ----------------------------------
     EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
            "put_state_dict derives a manifest and provisions pools/dials "
@@ -599,6 +663,46 @@ class StoreConfig:
     )
     meta_stamped: bool = field(
         default_factory=lambda: _env_bool("TORCHSTORE_TPU_META_STAMPED", True)
+    )
+
+    # --- control plane (client-side half) -----------------------------------
+    # Per-tenant admission control: when on, put/get batches reserve tokens
+    # from a tenant-labeled bucket whose refill scales down under fleet
+    # overload signals (see torchstore_tpu/control/admission.py). The
+    # solver/engine knobs are CONTROLLER-side env reads (control/engine.py).
+    control_admission: bool = field(
+        default_factory=lambda: _env_bool(
+            "TORCHSTORE_TPU_CONTROL_ADMISSION", False
+        )
+    )
+    admit_rate_hz: float = field(
+        default_factory=lambda: _env_float(
+            "TORCHSTORE_TPU_CONTROL_ADMIT_RATE", 512.0
+        )
+    )
+    # None: the bucket defaults to 2x the rate (AdmissionController).
+    admit_burst: Optional[float] = field(
+        default_factory=lambda: (
+            float(v)
+            if (v := os.environ.get("TORCHSTORE_TPU_CONTROL_ADMIT_BURST"))
+            else None
+        )
+    )
+    overload_inflight: int = field(
+        default_factory=lambda: _env_int(
+            "TORCHSTORE_TPU_CONTROL_OVERLOAD_INFLIGHT", 16
+        )
+    )
+    # Hot-key read spreading: rotate first-replica choice by a stable
+    # per-client salt so split replicas actually share the read load.
+    replica_spread: bool = field(
+        default_factory=lambda: _env_bool(
+            "TORCHSTORE_TPU_CONTROL_REPLICA_SPREAD", False
+        )
+    )
+    # Tenant/cohort label for admission buckets and loadgen attribution.
+    tenant: str = field(
+        default_factory=lambda: _env_str("TORCHSTORE_TPU_TENANT", "")
     )
 
     # --- cold-start provisioning (prewarm) ----------------------------------

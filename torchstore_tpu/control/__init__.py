@@ -1,0 +1,55 @@
+"""Control plane: close the telemetry -> placement loop (ROADMAP item 1).
+
+The store emits every signal a scheduler could want — ``ts.traffic_matrix``
+edges, hot-key windows, per-volume overload, stage-attributed SLO
+violations — and exposes every actuator (``pull_from`` migration,
+placement-epoch bumps, relay re-parenting, tier demotion, metadata
+resharding). This package connects them:
+
+- :mod:`torchstore_tpu.control.snapshot` — the frozen
+  :class:`TelemetrySnapshot` the solver reads, plus the builder that
+  normalizes raw telemetry dicts into it.
+- :mod:`torchstore_tpu.control.solver` — the PURE placement policy:
+  ``solve(snapshot, policy, history)`` returns typed actions, no fleet,
+  no clock, no I/O (unit-testable over hand-built snapshots).
+- :mod:`torchstore_tpu.control.engine` — the controller-side executor:
+  scrapes telemetry, runs the solver, applies actions through the real
+  actuators, and records every decision (inputs, action, outcome) as a
+  flight-recorder ``decision`` event + ``ts_control_*`` metrics.
+- :mod:`torchstore_tpu.control.admission` — the client-side per-tenant
+  token bucket admission control refilled from ``slo_report`` overload
+  signals.
+
+Separation of powers is the design invariant: the solver DECIDES, the
+engine ACTS, and neither imports the other's dependencies — the solver
+must stay importable (and testable) with no fleet and no asyncio.
+"""
+
+from torchstore_tpu.control.admission import AdmissionController, TokenBucket
+from torchstore_tpu.control.snapshot import (
+    KeyStat,
+    RelayView,
+    TelemetrySnapshot,
+    VolumeLoad,
+    build_snapshot,
+)
+from torchstore_tpu.control.solver import (
+    Action,
+    ActionRecord,
+    ControlPolicy,
+    solve,
+)
+
+__all__ = [
+    "Action",
+    "ActionRecord",
+    "AdmissionController",
+    "ControlPolicy",
+    "KeyStat",
+    "RelayView",
+    "TelemetrySnapshot",
+    "TokenBucket",
+    "VolumeLoad",
+    "build_snapshot",
+    "solve",
+]

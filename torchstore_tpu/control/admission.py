@@ -1,0 +1,175 @@
+"""Per-tenant admission control: client-side token-bucket backpressure.
+
+One cohort's burst must not starve the landing pool for everyone else.
+Each client carries an :class:`AdmissionController` (armed by
+``TORCHSTORE_TPU_CONTROL_ADMISSION``, labeled by the client's tenant):
+``put_batch``/``get_batch`` reserve one token per logical op and sleep
+out any deficit BEFORE touching a volume, so a bursting tenant queues at
+its own bucket instead of inside the fleet's landing brackets.
+
+The bucket's refill is modulated by overload signals — the per-shard
+metadata-RPC inflight depth this client observes locally on every
+refresh, plus whatever ``ts.slo_report()`` overload view is fed to
+:meth:`AdmissionController.refresh` (per-volume ``landing_inflight``).
+Past ``overload_inflight`` the effective rate scales down
+proportionally; throttle ENGAGE/RELEASE transitions (never individual
+waits) are recorded as flight-recorder ``decision`` events.
+
+:class:`TokenBucket` itself is pure over an injected clock value, so the
+rate math is unit-testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Mapping, Optional
+
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+
+_THROTTLED = obs_metrics.counter(
+    "ts_control_admission_throttled_total",
+    "Logical ops delayed by the admission token bucket, by tenant",
+)
+_WAIT_S = obs_metrics.counter(
+    "ts_control_admission_wait_s_total",
+    "Total seconds admission control held ops back, by tenant",
+)
+_FACTOR = obs_metrics.gauge(
+    "ts_control_admission_factor",
+    "Current admission refill factor (1.0 = unthrottled), by tenant",
+)
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``reserve(now, cost)`` consumes and
+    returns the seconds the caller must wait (0.0 when tokens covered
+    it). Tokens may go negative — concurrent reservers queue fairly
+    behind each other's deficits instead of racing the refill."""
+
+    def __init__(self, rate_hz: float, burst: float) -> None:
+        self.rate_hz = max(1e-6, float(rate_hz))
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._last: Optional[float] = None
+
+    def set_rate(self, rate_hz: float) -> None:
+        self.rate_hz = max(1e-6, float(rate_hz))
+
+    def reserve(self, now: float, cost: float = 1.0) -> float:
+        if self._last is None:
+            self._last = now
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self._tokens = min(self.burst, self._tokens + elapsed * self.rate_hz)
+        self._tokens -= cost
+        if self._tokens >= 0.0:
+            return 0.0
+        return -self._tokens / self.rate_hz
+
+
+class AdmissionController:
+    """One client's per-tenant admission gate (see module docstring).
+
+    ``admit(ops)`` returns the delay to sleep (the async client awaits
+    it; tests call it with injected ``now``). ``refresh`` re-derives the
+    throttle factor from the freshest overload signals — cheap enough to
+    run inline every ``REFRESH_OPS`` admissions."""
+
+    REFRESH_OPS = 64
+
+    def __init__(
+        self,
+        rate_hz: float,
+        burst: Optional[float] = None,
+        tenant: str = "",
+        overload_inflight: int = 16,
+        min_factor: float = 0.1,
+    ) -> None:
+        self.tenant = tenant or "default"
+        self.base_rate_hz = max(1e-6, float(rate_hz))
+        self.overload_inflight = max(1, int(overload_inflight))
+        self.min_factor = min(1.0, max(0.01, float(min_factor)))
+        self.bucket = TokenBucket(
+            self.base_rate_hz,
+            self.base_rate_hz * 2 if burst is None else burst,
+        )
+        self.factor = 1.0
+        self._throttling = False
+        self._since_refresh = 0
+        self._local_signal = None  # () -> Mapping[str, int] inflight view
+        _FACTOR.set(1.0, tenant=self.tenant)
+
+    def bind_local_signal(self, fn) -> None:
+        """Attach the zero-cost local overload probe (the metadata
+        router's ``inflight_snapshot``)."""
+        self._local_signal = fn
+
+    # -- overload feedback -------------------------------------------------
+
+    def refresh(self, slo_overload: Optional[Mapping[str, Any]] = None) -> float:
+        """Re-derive the refill factor from overload signals: the local
+        per-shard metadata-RPC inflight plus (when provided) the
+        ``slo_report()["overload"]`` per-volume ``landing_inflight``
+        view. Returns the new factor."""
+        depth = 0
+        if self._local_signal is not None:
+            try:
+                local = self._local_signal() or {}
+            except Exception:  # noqa: BLE001 - telemetry must not gate ops
+                local = {}
+            depth = max((int(n) for n in local.values()), default=0)
+        for entry in ((slo_overload or {}).get("volumes") or {}).values():
+            depth = max(depth, int((entry or {}).get("landing_inflight", 0)))
+        meta = (slo_overload or {}).get("metadata_rpc_inflight") or {}
+        depth = max(depth, max((int(n) for n in meta.values()), default=0))
+        if depth <= self.overload_inflight:
+            factor = 1.0
+        else:
+            factor = max(self.min_factor, self.overload_inflight / depth)
+        self._set_factor(factor, depth)
+        return factor
+
+    def _set_factor(self, factor: float, depth: int) -> None:
+        self.factor = factor
+        self.bucket.set_rate(self.base_rate_hz * factor)
+        _FACTOR.set(factor, tenant=self.tenant)
+        throttling = factor < 1.0
+        if throttling != self._throttling:
+            # State TRANSITIONS only — a decision event per admitted op
+            # would be flight-ring noise.
+            self._throttling = throttling
+            obs_recorder.record(
+                "decision",
+                "admission_throttle" if throttling else "admission_release",
+                tenant=self.tenant,
+                factor=round(factor, 4),
+                inflight=depth,
+                rate_hz=round(self.bucket.rate_hz, 3),
+            )
+
+    # -- the gate ----------------------------------------------------------
+
+    def admit(self, ops: int = 1, now: Optional[float] = None) -> float:
+        """Reserve ``ops`` tokens; returns the seconds the caller must
+        sleep before proceeding (0.0 on the unthrottled fast path)."""
+        self._since_refresh += 1
+        if self._since_refresh >= self.REFRESH_OPS:
+            self._since_refresh = 0
+            self.refresh()
+        delay = self.bucket.reserve(
+            time.monotonic() if now is None else now, float(max(1, ops))
+        )
+        if delay > 0.0:
+            _THROTTLED.inc(ops, tenant=self.tenant)
+            _WAIT_S.inc(delay, tenant=self.tenant)
+        return delay
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "tenant": self.tenant,
+            "rate_hz": self.base_rate_hz,
+            "factor": self.factor,
+            "burst": self.bucket.burst,
+            "throttling": self._throttling,
+        }

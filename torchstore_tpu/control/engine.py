@@ -1,0 +1,402 @@
+"""The control engine: scrape telemetry, run the solver, apply actions.
+
+One ``ControlEngine`` lives inside the Controller (coordinator) process.
+Its reconcile round is strictly phased — SNAPSHOT (scrape every healthy
+volume's ``stats()``, the index's replica placement for the keys that
+moved bytes, relay membership, and tier-pressure cold keys into a frozen
+:class:`TelemetrySnapshot`), SOLVE (the pure policy in
+``control/solver.py``), ACT (apply each action through the real
+actuators: ``pull_from`` migration via the index authority, relay member
+preference, per-key tier demotion) — so the decision inputs the audit
+trail records are exactly what the solver saw.
+
+Every applied (or refused) action lands in the flight recorder as a
+``decision`` event and in the ``ts_control_*`` metrics; ``plan()`` is the
+dry-run half ``ts.control_plan()`` serves (solve, record nothing, touch
+nothing). Client-fed telemetry (the fleet traffic matrix, the SLO
+overload report) is folded in when provided — the periodic loop runs on
+what the coordinator can reach alone.
+
+Failure domains: one action failing never aborts the round; a
+``control.migrate`` faultpoint fires inside each migration so chaos
+schedules can kill a volume mid-move (the index-side generation check
+then reclaims or abandons — loudly, as a ``decision`` outcome).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Mapping, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu.control.snapshot import TelemetrySnapshot, build_snapshot
+from torchstore_tpu.control.solver import (
+    DEMOTE,
+    MIGRATE,
+    RELAY_ORDER,
+    RESHARD,
+    SPLIT,
+    Action,
+    ActionRecord,
+    ControlPolicy,
+    solve,
+)
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+
+logger = get_logger("torchstore_tpu.control.engine")
+
+_DECISIONS = obs_metrics.counter(
+    "ts_control_decisions_total",
+    "Control-plane decisions, by action kind and outcome",
+)
+_MIGRATION_BYTES = obs_metrics.counter(
+    "ts_control_migration_bytes_total",
+    "Logical bytes moved by control-plane migrations and splits",
+)
+_RECONCILES = obs_metrics.counter(
+    "ts_control_reconciles_total",
+    "Control-engine reconcile rounds, by trigger",
+)
+_LAST_ACTIONS = obs_metrics.gauge(
+    "ts_control_last_actions",
+    "Actions the last reconcile round decided",
+)
+
+# History depth: enough rounds to remember every damped subject without
+# growing unboundedly on a long-lived fleet.
+_HISTORY = 256
+
+
+def policy_from_env() -> ControlPolicy:
+    """The solver thresholds, with ``TORCHSTORE_TPU_CONTROL_*`` overrides
+    (same raw-environ pattern as the controller's other knobs — the engine
+    lives in the controller process, not behind StoreConfig)."""
+
+    def _f(name: str, default: float) -> float:
+        raw = os.environ.get(name)
+        return float(raw) if raw not in (None, "") else default
+
+    base = ControlPolicy()
+    return ControlPolicy(
+        overload_ratio=_f(
+            "TORCHSTORE_TPU_CONTROL_OVERLOAD_RATIO", base.overload_ratio
+        ),
+        min_window_bytes=int(
+            _f("TORCHSTORE_TPU_CONTROL_MIN_WINDOW_BYTES", base.min_window_bytes)
+        ),
+        hot_key_min_bytes=int(
+            _f(
+                "TORCHSTORE_TPU_CONTROL_HOT_KEY_MIN_BYTES",
+                base.hot_key_min_bytes,
+            )
+        ),
+        min_edge_bytes=int(
+            _f("TORCHSTORE_TPU_CONTROL_MIN_EDGE_BYTES", base.min_edge_bytes)
+        ),
+        cooldown_s=_f("TORCHSTORE_TPU_CONTROL_COOLDOWN_S", base.cooldown_s),
+        max_actions=int(
+            _f("TORCHSTORE_TPU_CONTROL_MAX_ACTIONS", base.max_actions)
+        ),
+    )
+
+
+class ControlEngine:
+    """Controller-side executor for the placement policy (see module doc).
+
+    ``host`` is the Controller actor instance — the engine reaches the
+    fleet only through its surface (``volume_refs``, ``idx``, relay
+    state), never through raw index structures."""
+
+    def __init__(self, host: Any, policy: Optional[ControlPolicy] = None):
+        self.host = host
+        self.policy = policy or policy_from_env()
+        self.history: deque[ActionRecord] = deque(maxlen=_HISTORY)
+        self._rounds = 0
+
+    # ---- SNAPSHOT --------------------------------------------------------
+
+    async def snapshot(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+    ) -> TelemetrySnapshot:
+        """Freeze what the coordinator can see right now, folding in any
+        client-fed traffic matrix / SLO overload view."""
+        import asyncio
+
+        host = self.host
+        quarantined = host.quarantined_ids()
+        live = {
+            vid: ref
+            for vid, ref in host.volume_refs.items()
+            if vid not in quarantined
+        }
+
+        async def one_stats(vid: str, ref: Any):
+            try:
+                return vid, await asyncio.wait_for(
+                    ref.stats.call_one(), timeout=10.0
+                )
+            except Exception as exc:  # noqa: BLE001 - a dark volume is the
+                # supervisor's problem; the solver plans around it
+                logger.debug("control snapshot: stats(%s) failed: %s", vid, exc)
+                return vid, None
+
+        results = await asyncio.gather(
+            *(one_stats(vid, ref) for vid, ref in live.items())
+        )
+        volume_stats = {vid: st for vid, st in results if st is not None}
+
+        # Replica placement for every key the window saw moving bytes —
+        # the solver needs it to tell single-replica hot keys (migrate)
+        # from already-split ones.
+        seen: set[str] = set()
+        for st in volume_stats.values():
+            for row in st.get("hot_keys") or ():
+                seen.add(row["key"])
+            for row in (st.get("ledger") or {}).get("keys") or ():
+                seen.add(row["key"])
+        for rows in ((traffic or {}).get("keys") or {}).values():
+            for row in rows or ():
+                seen.add(row["key"])
+        key_placement: dict[str, tuple[str, ...]] = {}
+        for key in sorted(seen):
+            infos = await host.idx.get_entry(key)
+            if infos:
+                key_placement[key] = tuple(sorted(infos))
+
+        # Per-key demotion candidates, only where tier pressure exists.
+        pins = sorted(host._leases.pinned_groups())
+        cold_keys: dict[str, list[str]] = {}
+        for vid, st in volume_stats.items():
+            tier = st.get("tier") or {}
+            budget = int(tier.get("budget_bytes", 0) or 0)
+            resident = int(tier.get("resident_bytes", 0) or 0)
+            if budget <= 0 or resident < self.policy.demote_pct * budget:
+                continue
+            ref = live.get(vid)
+            if ref is None:
+                continue
+            try:
+                cold = await asyncio.wait_for(
+                    ref.tier_cold_keys.call_one(
+                        pins, self.policy.demote_keys_per_round
+                    ),
+                    timeout=10.0,
+                )
+            except Exception:  # noqa: BLE001 - candidates are optional
+                continue
+            if cold:
+                cold_keys[vid] = list(cold)
+
+        # Relay membership: channel -> (root of the newest live run, the
+        # refcounted member volumes). Channels with no live run carry no
+        # measured tree to re-order.
+        relays: dict[str, tuple[str, list[str]]] = {}
+        best_version: dict[str, int] = {}
+        for run in host._relay_runs.values():
+            if run.get("dead"):
+                continue
+            channel = run["channel"]
+            ch = host._relay_channels.get(channel)
+            if ch is None:
+                continue
+            if run["version"] >= best_version.get(channel, -1):
+                best_version[channel] = run["version"]
+                relays[channel] = (run["root"], sorted(ch["members"]))
+
+        return build_snapshot(
+            traffic=traffic,
+            overload=overload,
+            volume_stats=volume_stats,
+            placement=dict(host.volume_hostnames),
+            key_placement=key_placement,
+            cold_keys=cold_keys,
+            n_shards=len(host._shard_refs) or 1,
+            relays=relays,
+            generated_ts=time.monotonic(),
+        )
+
+    # ---- SOLVE -----------------------------------------------------------
+
+    async def plan(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+    ) -> dict[str, Any]:
+        """Dry run: what the engine WOULD do, touching nothing and
+        recording nothing (``ts.control_plan()``)."""
+        snap = await self.snapshot(traffic=traffic, overload=overload)
+        actions = solve(snap, self.policy, self.history)
+        return {
+            "actions": [a.describe() for a in actions],
+            "snapshot": snap.describe(),
+            "history": len(self.history),
+        }
+
+    # ---- ACT -------------------------------------------------------------
+
+    async def reconcile(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+        trigger: str = "interval",
+    ) -> dict[str, Any]:
+        """One full round: snapshot, solve, apply. Returns the per-action
+        outcomes (also recorded as ``decision`` events)."""
+        await faults.afire("control.reconcile")
+        _RECONCILES.inc(trigger=trigger)
+        self._rounds += 1
+        snap = await self.snapshot(traffic=traffic, overload=overload)
+        actions = solve(snap, self.policy, self.history)
+        _LAST_ACTIONS.set(len(actions))
+        outcomes = []
+        for action in actions:
+            outcome = await self._apply(snap, action)
+            outcomes.append({**action.describe(), "outcome": outcome})
+            # Failed actions enter history too: a migration that raced or
+            # errored must cool down, not retry every round.
+            self.history.append(
+                ActionRecord(
+                    ts=snap.generated_ts,
+                    kind=action.kind,
+                    subject=action.subject,
+                    src_volume=action.src_volume,
+                    dst_volume=action.dst_volume,
+                )
+            )
+        return {
+            "round": self._rounds,
+            "trigger": trigger,
+            "actions": outcomes,
+            "snapshot": snap.describe(),
+        }
+
+    async def _apply(self, snap: TelemetrySnapshot, action: Action) -> str:
+        import asyncio
+
+        try:
+            if action.kind in (MIGRATE, SPLIT):
+                return await self._apply_move(snap, action)
+            if action.kind == RELAY_ORDER:
+                return self._apply_relay_order(snap, action)
+            if action.kind == DEMOTE:
+                return await self._apply_demote(snap, action)
+            if action.kind == RESHARD:
+                return self._apply_reshard(snap, action)
+            return self._decision(snap, action, "skipped: unknown kind")
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - one action's failure
+            # must not abort the round; the outcome says it failed
+            logger.warning(
+                "control action %s/%s failed: %s",
+                action.kind,
+                action.subject,
+                exc,
+            )
+            return self._decision(
+                snap, action, f"error: {type(exc).__name__}: {exc}"
+            )
+
+    async def _apply_move(
+        self, snap: TelemetrySnapshot, action: Action
+    ) -> str:
+        """Online key migration (MIGRATE drops the source replica after the
+        copy lands; SPLIT keeps it). The copy itself — pull_from, the
+        write-generation race check, indexing — lives with the index
+        authority (``idx.migrate_key``), same layering as auto-repair."""
+        await faults.afire("control.migrate")
+        result = await self.host.idx.migrate_key(
+            action.subject,
+            action.src_volume,
+            action.dst_volume,
+            drop_src=action.kind == MIGRATE,
+        )
+        status = result.get("status", "error")
+        nbytes = int(result.get("nbytes", 0) or 0)
+        if status == "ok" and nbytes:
+            _MIGRATION_BYTES.inc(nbytes)
+        return self._decision(
+            snap,
+            action,
+            "applied" if status == "ok" else f"abandoned: {status}",
+            nbytes=nbytes,
+        )
+
+    def _apply_relay_order(
+        self, snap: TelemetrySnapshot, action: Action
+    ) -> str:
+        """Prefer measured-proximity member order for the channel's NEXT
+        relay trees (live runs keep their mid-version tree — stability
+        beats topological optimality, same rule as membership joins)."""
+        host = self.host
+        ch = host._relay_channels.get(action.subject)
+        if ch is None:
+            return self._decision(snap, action, "abandoned: channel gone")
+        host._relay_prefer[action.subject] = tuple(action.order)
+        ch["epoch"] += 1
+        return self._decision(
+            snap, action, "applied", members=len(action.order)
+        )
+
+    async def _apply_demote(
+        self, snap: TelemetrySnapshot, action: Action
+    ) -> str:
+        """Per-key frequency-aware demotion: spill exactly the idle keys
+        (regardless of watermark), then fold the tier flips into the
+        index — the same feedback loop as the background sweeper."""
+        host = self.host
+        ref = host.volume_refs.get(action.src_volume)
+        if ref is None:
+            return self._decision(snap, action, "abandoned: volume gone")
+        pins = sorted(host._leases.pinned_groups())
+        rep = await ref.tier_sweep.call_one(pins, list(action.keys))
+        if not rep.get("enabled"):
+            return self._decision(snap, action, "abandoned: tier disabled")
+        await host.idx.set_tiers(
+            action.src_volume,
+            list(rep.get("spilled", ())),
+            list(rep.get("fault_ins", ())),
+        )
+        return self._decision(
+            snap, action, "applied", spilled=len(rep.get("spilled", ()))
+        )
+
+    def _apply_reshard(
+        self, snap: TelemetrySnapshot, action: Action
+    ) -> str:
+        """The engine cannot spawn shard actors (the owner process does);
+        a reshard decision is surfaced — loudly — for ``ts.rebalance(
+        shards=N)`` to execute. The decision event IS the actuation here."""
+        return self._decision(
+            snap, action, "deferred: run ts.rebalance(shards=%d)" % action.shards
+        )
+
+    # ---- audit -----------------------------------------------------------
+
+    def _decision(
+        self,
+        snap: TelemetrySnapshot,
+        action: Action,
+        outcome: str,
+        **extra: Any,
+    ) -> str:
+        """The ONE decision-audit chokepoint: inputs (the snapshot summary
+        the solver saw), the chosen action, and what happened."""
+        _DECISIONS.inc(kind=action.kind, outcome=outcome.split(":")[0])
+        obs_recorder.record(
+            "decision",
+            f"control/{action.kind}",
+            subject=action.subject,
+            reason=action.reason,
+            outcome=outcome,
+            action=action.describe(),
+            inputs=snap.describe(),
+            **extra,
+        )
+        return outcome

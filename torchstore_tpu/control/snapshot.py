@@ -1,0 +1,223 @@
+"""Frozen telemetry snapshot: the one input the placement solver reads.
+
+The solver (control/solver.py) must be a pure function — testable with a
+hand-built snapshot, no fleet, no clock. This module defines that input
+shape and the builder that folds raw telemetry (``ts.traffic_matrix()``
+output, ``ts.slo_report()["overload"]``, per-volume ``stats()`` dicts,
+the controller's own placement/index views) into it. Everything is a
+plain frozen dataclass over dicts/tuples: the builder copies, the solver
+only reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+
+@dataclass(frozen=True)
+class VolumeLoad:
+    """One volume's recent load view. ``window_bytes``/``window_ops`` are
+    the rolling one-to-two-window ledger totals (the "how loaded RIGHT
+    NOW" signal), never lifetime counters."""
+
+    volume_id: str
+    host: str = ""
+    entries: int = 0
+    stored_bytes: int = 0
+    window_ops: int = 0
+    window_bytes: int = 0
+    landing_inflight: int = 0
+    # Spill-tier pressure (0/0 when tiering is disabled on this volume).
+    tier_resident_bytes: int = 0
+    tier_budget_bytes: int = 0
+
+
+@dataclass(frozen=True)
+class KeyStat:
+    """One key's recent traffic plus its current replica placement."""
+
+    key: str
+    ops: int = 0
+    bytes: int = 0
+    volumes: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RelayView:
+    """One relay channel's membership: the origin (root) volume and the
+    member volumes its published versions fan out to."""
+
+    channel: str
+    root: str
+    members: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class TelemetrySnapshot:
+    """Everything the solver may look at, frozen at scrape time.
+
+    ``edges`` is host-to-host recent wire bytes (``{src: {dst: bytes}}``);
+    ``hot_keys`` is hottest-first; ``cold_keys`` maps a volume id to keys
+    with no recent traffic (the per-key demotion candidates);
+    ``meta_inflight`` is the per-shard metadata-RPC queue-depth signal
+    (``{"coord": n, "s0": n, ...}``)."""
+
+    generated_ts: float = 0.0
+    volumes: Mapping[str, VolumeLoad] = field(default_factory=dict)
+    edges: Mapping[str, Mapping[str, int]] = field(default_factory=dict)
+    hot_keys: tuple[KeyStat, ...] = ()
+    cold_keys: Mapping[str, tuple[str, ...]] = field(default_factory=dict)
+    meta_inflight: Mapping[str, int] = field(default_factory=dict)
+    n_shards: int = 1
+    relays: tuple[RelayView, ...] = ()
+
+    def total_window_bytes(self) -> int:
+        return sum(v.window_bytes for v in self.volumes.values())
+
+    def describe(self) -> dict:
+        """Compact JSON-serializable summary (rides decision events)."""
+        return {
+            "volumes": {
+                vid: {"window_bytes": v.window_bytes, "host": v.host}
+                for vid, v in self.volumes.items()
+            },
+            "hot_keys": [
+                {"key": k.key, "bytes": k.bytes, "replicas": len(k.volumes)}
+                for k in self.hot_keys[:5]
+            ],
+            "meta_inflight": dict(self.meta_inflight),
+            "n_shards": self.n_shards,
+        }
+
+
+def _edge_bytes(traffic: Optional[Mapping[str, Any]]) -> dict[str, dict[str, int]]:
+    """Flatten ``traffic_matrix()["edges"]`` cells to plain byte counts."""
+    out: dict[str, dict[str, int]] = {}
+    for src, dsts in ((traffic or {}).get("edges") or {}).items():
+        row = out.setdefault(src, {})
+        for dst, cell in dsts.items():
+            row[dst] = row.get(dst, 0) + int(
+                cell.get("bytes", 0) if isinstance(cell, Mapping) else cell
+            )
+    return out
+
+
+def build_snapshot(
+    *,
+    traffic: Optional[Mapping[str, Any]] = None,
+    overload: Optional[Mapping[str, Any]] = None,
+    volume_stats: Optional[Mapping[str, Mapping[str, Any]]] = None,
+    placement: Optional[Mapping[str, str]] = None,
+    key_placement: Optional[Mapping[str, Any]] = None,
+    cold_keys: Optional[Mapping[str, Any]] = None,
+    n_shards: int = 1,
+    relays: Optional[Mapping[str, Any]] = None,
+    generated_ts: float = 0.0,
+) -> TelemetrySnapshot:
+    """Normalize raw telemetry into a :class:`TelemetrySnapshot`.
+
+    Every input is optional — the builder folds whatever view the caller
+    could reach (the controller engine scrapes volume ``stats()`` and its
+    own index; the client API additionally has the fleet traffic matrix
+    and SLO overload report) and leaves the rest empty. ``placement``
+    maps volume id -> host; ``key_placement`` maps key -> iterable of
+    volume ids holding a committed copy; ``relays`` maps channel ->
+    ``(root_volume, members)``.
+    """
+    placement = dict(placement or {})
+    vols: dict[str, VolumeLoad] = {}
+    key_bytes: dict[str, list[int]] = {}  # key -> [ops, bytes]
+
+    for vid, st in (volume_stats or {}).items():
+        st = st or {}
+        ledger = st.get("ledger") or {}
+        window = ledger.get("window") or {}
+        over = st.get("overload") or {}
+        tier = st.get("tier") or {}
+        vols[vid] = VolumeLoad(
+            volume_id=vid,
+            host=placement.get(vid, ledger.get("host", "")),
+            entries=int(st.get("entries", 0)),
+            stored_bytes=int(st.get("stored_bytes", 0)),
+            window_ops=int(window.get("ops", 0)),
+            window_bytes=int(window.get("bytes", 0)),
+            landing_inflight=int(over.get("landing_inflight", 0)),
+            tier_resident_bytes=int(tier.get("resident_bytes", 0)),
+            tier_budget_bytes=int(tier.get("budget_bytes", 0)),
+        )
+        for row in st.get("hot_keys") or ():
+            stat = key_bytes.setdefault(row["key"], [0, 0])
+            stat[0] += int(row.get("ops", 0))
+            stat[1] += int(row.get("bytes", 0))
+
+    # slo_report overload refines/fills the per-volume window + inflight
+    # view (it already folded ledger windows fleet-side).
+    over_volumes = (overload or {}).get("volumes") or {}
+    for vid, entry in over_volumes.items():
+        base = vols.get(vid) or VolumeLoad(
+            volume_id=vid, host=placement.get(vid, "")
+        )
+        vols[vid] = VolumeLoad(
+            volume_id=vid,
+            host=base.host,
+            entries=base.entries,
+            stored_bytes=base.stored_bytes,
+            window_ops=max(base.window_ops, int(entry.get("window_ops", 0))),
+            window_bytes=max(
+                base.window_bytes, int(entry.get("window_bytes", 0))
+            ),
+            landing_inflight=max(
+                base.landing_inflight, int(entry.get("landing_inflight", 0))
+            ),
+            tier_resident_bytes=base.tier_resident_bytes,
+            tier_budget_bytes=base.tier_budget_bytes,
+        )
+    for vid, host in placement.items():
+        if vid not in vols:
+            vols[vid] = VolumeLoad(volume_id=vid, host=host)
+
+    # Per-key rolling windows from every ledger the traffic matrix saw
+    # (client processes see the one-sided serves no volume can).
+    for rows in ((traffic or {}).get("keys") or {}).values():
+        for row in rows or ():
+            stat = key_bytes.setdefault(row["key"], [0, 0])
+            stat[0] += int(row.get("ops", 0))
+            stat[1] += int(row.get("bytes", 0))
+
+    kp = {
+        key: tuple(vids) for key, vids in (key_placement or {}).items()
+    }
+    hot = tuple(
+        KeyStat(key=key, ops=stat[0], bytes=stat[1], volumes=kp.get(key, ()))
+        for key, stat in sorted(
+            key_bytes.items(), key=lambda kv: kv[1][1], reverse=True
+        )
+    )
+
+    meta_inflight = {
+        str(shard): int(n)
+        for shard, n in (
+            (overload or {}).get("metadata_rpc_inflight") or {}
+        ).items()
+    }
+
+    relay_views = tuple(
+        RelayView(
+            channel=channel, root=str(root), members=tuple(members)
+        )
+        for channel, (root, members) in sorted((relays or {}).items())
+    )
+
+    return TelemetrySnapshot(
+        generated_ts=generated_ts,
+        volumes=vols,
+        edges=_edge_bytes(traffic),
+        hot_keys=hot,
+        cold_keys={
+            vid: tuple(keys) for vid, keys in (cold_keys or {}).items()
+        },
+        meta_inflight=meta_inflight,
+        n_shards=max(1, int(n_shards)),
+        relays=relay_views,
+    )

@@ -1,0 +1,426 @@
+"""The placement policy solver: a pure function over a frozen snapshot.
+
+``solve(snapshot, policy, history)`` returns the typed actions the engine
+(control/engine.py) should apply. No clock, no I/O, no fleet — the same
+snapshot and history always produce the same plan, so every policy
+behavior (skew -> co-locate, hot key -> split, idle -> no-op, oscillation
+damping) is unit-testable over hand-built snapshots.
+
+Decision families, in priority order:
+
+1. ``migrate_key`` — one volume's rolling-window bytes exceed
+   ``overload_ratio`` x the fleet mean: move its hottest keys onto the
+   least-loaded volume, preferring a volume on the dominant CONSUMER host
+   (the heaviest outgoing edge from the hot volume's host) so serves
+   become host-local.
+2. ``split_hot_key`` — a single key dominates its volume's window
+   (``hot_key_frac``) with fewer than ``max_replicas`` committed copies:
+   add a replica on the least-loaded volume not already holding it.
+3. ``relay_order`` — a relay channel's measured edge traffic implies a
+   better member ordering than the default sorted-id one: heaviest
+   consumers attach nearest the root.
+4. ``demote_keys`` — a tiered volume past ``demote_pct`` of its budget
+   with keys that moved NO bytes in the window: demote exactly those
+   (per-key frequency-aware, replacing whole-version LRU pressure).
+5. ``reshard`` — sustained per-shard metadata-RPC queue depth at or over
+   ``reshard_inflight_high``: double the shard count (capped).
+
+Hysteresis / damping rules (the oscillation tests pin these):
+
+- Enter/exit split: migration triggers at ``overload_ratio`` but any
+  imbalance under ``settle_ratio`` is left alone — a fleet between the
+  two thresholds is "settling" and produces no new plan.
+- Cooldown: a subject (key, volume, channel, or the shard plane) acted
+  on within ``cooldown_s`` of ``snapshot.generated_ts`` is never acted
+  on again, and a migration that would REVERSE a recent move (same key,
+  src and dst swapped) is dropped even past the cooldown window.
+- Budget: at most ``max_actions`` actions per round, highest priority
+  first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+from torchstore_tpu.control.snapshot import TelemetrySnapshot, VolumeLoad
+
+# Action kinds, in priority order (solve() emits them in this order and
+# truncates at policy.max_actions).
+MIGRATE = "migrate_key"
+SPLIT = "split_hot_key"
+RELAY_ORDER = "relay_order"
+DEMOTE = "demote_keys"
+RESHARD = "reshard"
+
+KINDS = (MIGRATE, SPLIT, RELAY_ORDER, DEMOTE, RESHARD)
+
+
+@dataclass(frozen=True)
+class Action:
+    """One decided action. ``subject`` is the hysteresis identity (the
+    key for migrations/splits, the volume for demotions, the channel for
+    relay ordering, ``"shards"`` for resharding); the remaining fields
+    depend on ``kind`` and ride ``detail``."""
+
+    kind: str
+    subject: str
+    reason: str
+    src_volume: str = ""
+    dst_volume: str = ""
+    keys: tuple[str, ...] = ()
+    order: tuple[str, ...] = ()
+    shards: int = 0
+    detail: dict = field(default_factory=dict)
+
+    def describe(self) -> dict[str, Any]:
+        out = {
+            "kind": self.kind,
+            "subject": self.subject,
+            "reason": self.reason,
+        }
+        if self.src_volume:
+            out["src_volume"] = self.src_volume
+        if self.dst_volume:
+            out["dst_volume"] = self.dst_volume
+        if self.keys:
+            out["keys"] = list(self.keys)
+        if self.order:
+            out["order"] = list(self.order)
+        if self.shards:
+            out["shards"] = self.shards
+        if self.detail:
+            out["detail"] = dict(self.detail)
+        return out
+
+
+@dataclass(frozen=True)
+class ActionRecord:
+    """One applied action, as the engine remembers it for hysteresis."""
+
+    ts: float
+    kind: str
+    subject: str
+    src_volume: str = ""
+    dst_volume: str = ""
+
+
+@dataclass(frozen=True)
+class ControlPolicy:
+    """Solver thresholds. Defaults are deliberately conservative: a
+    balanced fleet must solve to an empty plan."""
+
+    # Migration enter/exit thresholds over the fleet-mean window bytes.
+    overload_ratio: float = 2.0
+    settle_ratio: float = 1.5
+    # Ignore volumes/keys below this much recent traffic entirely.
+    min_window_bytes: int = 1 << 16
+    migrate_keys_per_round: int = 4
+    # Hot-key split: one key >= this fraction of its volume's window.
+    hot_key_frac: float = 0.5
+    hot_key_min_bytes: int = 1 << 20
+    max_replicas: int = 3
+    # Relay proximity: re-order only when the heaviest relevant edge
+    # moved at least this many bytes in the window.
+    min_edge_bytes: int = 1 << 20
+    # Per-key demotion: trigger past this fraction of the tier budget.
+    demote_pct: float = 0.85
+    demote_keys_per_round: int = 32
+    # Elastic reshard: per-shard inflight metadata RPCs that motivate a
+    # shard-count doubling.
+    reshard_inflight_high: int = 32
+    max_shards: int = 8
+    # Damping.
+    cooldown_s: float = 30.0
+    max_actions: int = 8
+
+
+def _recent(
+    history: Iterable[ActionRecord], now: float, cooldown_s: float
+) -> list[ActionRecord]:
+    return [r for r in history if now - r.ts < cooldown_s]
+
+
+def _cooled(recent: list[ActionRecord], kind: str, subject: str) -> bool:
+    """Whether (kind, subject) is inside its cooldown window."""
+    return any(r.kind == kind and r.subject == subject for r in recent)
+
+
+def _reversal(
+    history: Iterable[ActionRecord], key: str, src: str, dst: str
+) -> bool:
+    """A migrate that would undo ANY remembered move of the same key —
+    dropped regardless of cooldown (the anti-oscillation rule)."""
+    return any(
+        r.kind == MIGRATE
+        and r.subject == key
+        and r.src_volume == dst
+        and r.dst_volume == src
+        for r in history
+    )
+
+
+def _pick_target(
+    snapshot: TelemetrySnapshot,
+    src: VolumeLoad,
+    exclude: Iterable[str] = (),
+) -> Optional[VolumeLoad]:
+    """The migration/split target: the least-loaded volume (by window
+    bytes, stored bytes as tiebreak) that isn't excluded, preferring
+    volumes on the dominant consumer host of ``src``'s traffic."""
+    excluded = set(exclude) | {src.volume_id}
+    candidates = [
+        v for vid, v in snapshot.volumes.items() if vid not in excluded
+    ]
+    if not candidates:
+        return None
+    consumer_hosts = sorted(
+        (snapshot.edges.get(src.host) or {}).items(),
+        key=lambda kv: kv[1],
+        reverse=True,
+    )
+    for host, _nbytes in consumer_hosts:
+        if host == src.host:
+            continue
+        on_host = [v for v in candidates if v.host == host]
+        if on_host:
+            return min(
+                on_host, key=lambda v: (v.window_bytes, v.stored_bytes)
+            )
+    return min(candidates, key=lambda v: (v.window_bytes, v.stored_bytes))
+
+
+def _solve_migrations(
+    snapshot: TelemetrySnapshot,
+    policy: ControlPolicy,
+    recent: list[ActionRecord],
+    history: list[ActionRecord],
+) -> list[Action]:
+    loads = [
+        v for v in snapshot.volumes.values() if v.window_bytes > 0
+    ]
+    if len(snapshot.volumes) < 2 or not loads:
+        return []
+    mean = snapshot.total_window_bytes() / max(1, len(snapshot.volumes))
+    hot = max(loads, key=lambda v: v.window_bytes)
+    if hot.window_bytes < policy.min_window_bytes:
+        return []
+    # Hysteresis enter threshold; between settle and overload: no-op.
+    if hot.window_bytes < policy.overload_ratio * max(mean, 1.0):
+        return []
+    target = _pick_target(snapshot, hot)
+    if target is None or target.window_bytes >= hot.window_bytes:
+        return []
+    out: list[Action] = []
+    # Move the hot volume's hottest keys until the projected imbalance
+    # drops under the EXIT threshold (settle_ratio) or the round budget
+    # runs out. Keys with other replicas already serving stay put — a
+    # split (below) spreads those.
+    excess = hot.window_bytes - policy.settle_ratio * max(mean, 1.0)
+    moved = 0
+    for stat in snapshot.hot_keys:
+        if len(out) >= policy.migrate_keys_per_round or moved >= excess:
+            break
+        if hot.volume_id not in stat.volumes or len(stat.volumes) > 1:
+            continue
+        if target.volume_id in stat.volumes:
+            continue
+        if _cooled(recent, MIGRATE, stat.key) or _cooled(
+            recent, SPLIT, stat.key
+        ):
+            continue
+        if _reversal(history, stat.key, hot.volume_id, target.volume_id):
+            continue
+        out.append(
+            Action(
+                kind=MIGRATE,
+                subject=stat.key,
+                reason=(
+                    f"volume {hot.volume_id} window {hot.window_bytes}B >= "
+                    f"{policy.overload_ratio:g}x fleet mean {mean:.0f}B"
+                ),
+                src_volume=hot.volume_id,
+                dst_volume=target.volume_id,
+                keys=(stat.key,),
+                detail={"key_bytes": stat.bytes},
+            )
+        )
+        moved += stat.bytes
+    return out
+
+
+def _solve_splits(
+    snapshot: TelemetrySnapshot,
+    policy: ControlPolicy,
+    recent: list[ActionRecord],
+    claimed: frozenset[str] = frozenset(),
+) -> list[Action]:
+    out: list[Action] = []
+    for stat in snapshot.hot_keys:
+        if stat.key in claimed:
+            continue  # already migrating this round; one plan per key
+        if stat.bytes < policy.hot_key_min_bytes or not stat.volumes:
+            continue
+        if len(stat.volumes) >= policy.max_replicas:
+            continue
+        home = snapshot.volumes.get(stat.volumes[0])
+        if home is None or home.window_bytes <= 0:
+            continue
+        if stat.bytes < policy.hot_key_frac * home.window_bytes:
+            continue
+        if _cooled(recent, SPLIT, stat.key) or _cooled(
+            recent, MIGRATE, stat.key
+        ):
+            continue
+        target = _pick_target(snapshot, home, exclude=stat.volumes)
+        if target is None:
+            continue
+        out.append(
+            Action(
+                kind=SPLIT,
+                subject=stat.key,
+                reason=(
+                    f"key moved {stat.bytes}B >= "
+                    f"{policy.hot_key_frac:g} of volume "
+                    f"{home.volume_id}'s window with "
+                    f"{len(stat.volumes)} replica(s)"
+                ),
+                src_volume=home.volume_id,
+                dst_volume=target.volume_id,
+                keys=(stat.key,),
+                detail={"replicas": len(stat.volumes)},
+            )
+        )
+    return out
+
+
+def _solve_relay_orders(
+    snapshot: TelemetrySnapshot,
+    policy: ControlPolicy,
+    recent: list[ActionRecord],
+) -> list[Action]:
+    out: list[Action] = []
+    for relay in snapshot.relays:
+        if len(relay.members) < 2 or _cooled(
+            recent, RELAY_ORDER, relay.channel
+        ):
+            continue
+        root_host = (
+            snapshot.volumes.get(relay.root) or VolumeLoad(relay.root)
+        ).host
+        root_edges = snapshot.edges.get(root_host) or {}
+
+        def weight(vid: str) -> int:
+            host = (
+                snapshot.volumes.get(vid) or VolumeLoad(vid)
+            ).host
+            return int(root_edges.get(host, 0))
+
+        default = sorted(set(relay.members) - {relay.root})
+        measured = sorted(default, key=lambda v: (-weight(v), v))
+        if measured == default or weight(measured[0]) < policy.min_edge_bytes:
+            continue
+        out.append(
+            Action(
+                kind=RELAY_ORDER,
+                subject=relay.channel,
+                reason=(
+                    f"measured origin-edge traffic orders {measured[0]} "
+                    f"({weight(measured[0])}B) ahead of sorted-id default"
+                ),
+                order=tuple(measured),
+                detail={"root": relay.root},
+            )
+        )
+    return out
+
+
+def _solve_demotions(
+    snapshot: TelemetrySnapshot,
+    policy: ControlPolicy,
+    recent: list[ActionRecord],
+) -> list[Action]:
+    out: list[Action] = []
+    for vid, vol in sorted(snapshot.volumes.items()):
+        if vol.tier_budget_bytes <= 0 or _cooled(recent, DEMOTE, vid):
+            continue
+        if vol.tier_resident_bytes < policy.demote_pct * vol.tier_budget_bytes:
+            continue
+        cold = snapshot.cold_keys.get(vid) or ()
+        if not cold:
+            continue
+        out.append(
+            Action(
+                kind=DEMOTE,
+                subject=vid,
+                reason=(
+                    f"resident {vol.tier_resident_bytes}B >= "
+                    f"{policy.demote_pct:g} of tier budget "
+                    f"{vol.tier_budget_bytes}B with {len(cold)} idle key(s)"
+                ),
+                src_volume=vid,
+                keys=tuple(cold[: policy.demote_keys_per_round]),
+            )
+        )
+    return out
+
+
+def _solve_reshard(
+    snapshot: TelemetrySnapshot,
+    policy: ControlPolicy,
+    recent: list[ActionRecord],
+) -> list[Action]:
+    if _cooled(recent, RESHARD, "shards"):
+        return []
+    if snapshot.n_shards >= policy.max_shards:
+        return []
+    depth = max(
+        (
+            n
+            for shard, n in snapshot.meta_inflight.items()
+            if shard != "coord"
+        ),
+        default=0,
+    )
+    if snapshot.n_shards == 1:
+        depth = max(depth, snapshot.meta_inflight.get("coord", 0))
+    if depth < policy.reshard_inflight_high:
+        return []
+    target = min(policy.max_shards, max(2, snapshot.n_shards * 2))
+    return [
+        Action(
+            kind=RESHARD,
+            subject="shards",
+            reason=(
+                f"per-shard metadata-RPC inflight {depth} >= "
+                f"{policy.reshard_inflight_high} at {snapshot.n_shards} "
+                f"shard(s)"
+            ),
+            shards=target,
+        )
+    ]
+
+
+def solve(
+    snapshot: TelemetrySnapshot,
+    policy: Optional[ControlPolicy] = None,
+    history: Iterable[ActionRecord] = (),
+) -> list[Action]:
+    """The pure policy: actions the engine should apply, highest priority
+    first, capped at ``policy.max_actions``. ``history`` is the engine's
+    applied-action memory; records within ``cooldown_s`` of
+    ``snapshot.generated_ts`` suppress same-subject re-decisions, and any
+    remembered migration suppresses its exact reversal."""
+    policy = policy or ControlPolicy()
+    history = list(history)
+    recent = _recent(history, snapshot.generated_ts, policy.cooldown_s)
+    actions: list[Action] = []
+    actions.extend(_solve_migrations(snapshot, policy, recent, history))
+    # A key already moving this round must not also split: the migration
+    # drops the very source copy the split would fan out from.
+    claimed = frozenset(a.subject for a in actions)
+    actions.extend(_solve_splits(snapshot, policy, recent, claimed))
+    actions.extend(_solve_relay_orders(snapshot, policy, recent))
+    actions.extend(_solve_demotions(snapshot, policy, recent))
+    actions.extend(_solve_reshard(snapshot, policy, recent))
+    return actions[: policy.max_actions]

@@ -21,6 +21,7 @@ from typing import Any, Optional
 from torchstore_tpu import faults
 from torchstore_tpu import relay as relay_mod
 from torchstore_tpu import tiering
+from torchstore_tpu.control.engine import ControlEngine
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.metadata.index_core import (  # noqa: F401 - re-exported
     IndexCore,
@@ -143,6 +144,10 @@ class Controller(Actor):
         self._relay_channels: dict[str, dict] = {}
         self._relay_runs: dict[str, dict] = {}
         self._relay_tasks: set = set()
+        # Control-engine preferred member order per channel (measured edge
+        # proximity): build_tree attaches these nearest the root in the
+        # NEXT trees built; absent channels keep the sorted-id default.
+        self._relay_prefer: dict[str, tuple[str, ...]] = {}
         # Cohort retention leases (torchstore_tpu/tiering/leases.py): the
         # authority on which (channel, version) pairs are pinned.
         # notify_delete_batch refuses to reap a pinned version's keys, the
@@ -163,6 +168,21 @@ class Controller(Actor):
             os.environ.get("TORCHSTORE_TPU_TIER_SWEEP_INTERVAL_S", 2.0)
         )
         self._tier_task = None
+        # Control plane (torchstore_tpu/control/): the policy engine that
+        # closes the telemetry -> placement loop. The reconcile loop runs
+        # only when TORCHSTORE_TPU_CONTROL_INTERVAL_S is positive;
+        # ts.control_plan() / ts.rebalance() reach the engine on demand
+        # either way.
+        self._control_engine = ControlEngine(self)
+        self._control_interval = float(
+            os.environ.get("TORCHSTORE_TPU_CONTROL_INTERVAL_S", 0.0) or 0.0
+        )
+        self._control_task = None
+        # Elastic-reshard gate for the UNSHARDED metadata plane: while set
+        # (an unset Event), coordinator-side index mutations park until the
+        # reshard swaps the authority — the sharded case parks on the
+        # shards themselves (metadata/shards.py freeze-via-park).
+        self._reshard_gate = None
         # Layer-streamed sync state: sd_key -> {"version", "sealed",
         # "watermarks": {store_key: version}}. ``version`` is the stream in
         # flight (or last begun), ``sealed`` the highest sealed version, and
@@ -271,6 +291,7 @@ class Controller(Actor):
             _VOLUME_HEALTH.set(1, volume=vid)
         self._start_supervisor()
         self._start_tier_sweeper()
+        self._start_control_loop()
         from torchstore_tpu.metadata import stamped as stamped_mod
 
         if stamped_mod.enabled():
@@ -433,6 +454,7 @@ class Controller(Actor):
         Under a SHARDED metadata plane clients never call this endpoint:
         the router fans the batch to the owning shards and records the
         watermark here afterwards (``stream_watermark``)."""
+        await self._reshard_wait()
         if self._shard_refs:
             raise RuntimeError(
                 "this store's metadata plane is sharded: notify_put_batch "
@@ -587,6 +609,7 @@ class Controller(Actor):
         volumes held each key so the client can clear the data plane.
         Sharded stores route through delete_guard -> shard delete_keys ->
         delete_finish instead (the router owns the ordering)."""
+        await self._reshard_wait()
         if self._shard_refs:
             raise RuntimeError(
                 "this store's metadata plane is sharded: deletes route "
@@ -1059,7 +1082,12 @@ class Controller(Actor):
 
         members = self._relay_healthy_members(channel)
         root = str(volume_ids[0])
-        parents = relay_mod.build_tree(root, members, self._relay_fanout)
+        parents = relay_mod.build_tree(
+            root,
+            members,
+            self._relay_fanout,
+            prefer=self._relay_prefer.get(channel),
+        )
         if not parents:
             return None  # nobody to relay to (or origin is the only member)
         while len(self._relay_runs) >= self.MAX_RELAY_RUNS:
@@ -1395,7 +1423,10 @@ class Controller(Actor):
             if run["channel"] != channel or run.get("dead"):
                 continue
             fresh = relay_mod.build_tree(
-                run["root"], members, self._relay_fanout
+                run["root"],
+                members,
+                self._relay_fanout,
+                prefer=self._relay_prefer.get(channel),
             )
             added = False
             for child, parent in fresh.items():
@@ -1630,6 +1661,202 @@ class Controller(Actor):
         deterministic entry the benches/tests use instead of waiting out
         the background interval. Returns a per-volume summary."""
         return await self._tier_sweep_once()
+
+    # ---- control plane (torchstore_tpu/control) --------------------------
+
+    def _start_control_loop(self) -> None:
+        """(Re)start the policy engine's reconcile loop — called from
+        init(); idempotent across re-inits. Off unless the interval is
+        positive (``ts.control_plan()``/``ts.rebalance()`` still serve)."""
+        if self._control_task is not None:
+            self._control_task.cancel()
+            self._control_task = None
+        if self._control_interval <= 0:
+            return
+        self._control_task = spawn_logged(
+            self._control_loop(),
+            name="controller.control_reconcile",
+            tasks=self._health_tasks,
+            log=logger,
+        )
+
+    async def _control_loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self._control_interval)
+            try:
+                await self._control_engine.reconcile(trigger="interval")
+            except Exception:  # noqa: BLE001 - one bad round must not
+                # kill the engine (volumes may be mid-repair/reshard)
+                logger.exception("control reconcile failed; retrying next round")
+
+    @endpoint
+    async def control_plan(
+        self,
+        traffic: Optional[dict] = None,
+        overload: Optional[dict] = None,
+    ) -> dict[str, Any]:
+        """Dry run (``ts.control_plan()``): the actions the policy engine
+        WOULD take on a fresh telemetry snapshot, applying nothing. The
+        caller may feed its fleet-wide traffic matrix and SLO overload
+        view — signals only clients can fully assemble."""
+        return await self._control_engine.plan(
+            traffic=traffic, overload=overload
+        )
+
+    @endpoint
+    async def control_reconcile(
+        self,
+        traffic: Optional[dict] = None,
+        overload: Optional[dict] = None,
+    ) -> dict[str, Any]:
+        """One reconcile round NOW (``ts.rebalance()`` manual trigger):
+        snapshot, solve, apply, audit. Safe alongside the periodic loop —
+        actions cool down by subject, so back-to-back rounds converge."""
+        return await self._control_engine.reconcile(
+            traffic=traffic, overload=overload, trigger="manual"
+        )
+
+    async def _reshard_wait(self) -> None:
+        gate = self._reshard_gate
+        if gate is not None:
+            await gate.wait()
+
+    @endpoint
+    async def reshard(
+        self, coordinator: ActorRef, shard_refs: list[ActorRef]
+    ) -> dict[str, Any]:
+        """Runtime elastic reshard of the metadata plane: move the whole
+        index onto a NEW shard mesh (``ts.rebalance(shards=N)`` spawns it;
+        1 -> N, N -> M, and N -> 1 merges all route here) with zero lost
+        keys and zero failed client ops.
+
+        Protocol (freeze-via-park): (1) FREEZE the current authority —
+        sharded mutations park on their shard, unsharded ones park on the
+        coordinator gate; reads keep serving the frozen index throughout.
+        (2) EXPORT every (volume, meta, write_gen) entry. (3) INIT the new
+        mesh and REPLAY the export through ``reindex`` (generation seeding
+        wakes long-pollers into a resync instead of blocking them).
+        (4) SWAP ``self.idx`` + the advertised topology, bump the
+        placement epoch (one bump: stamped readers re-confirm against the
+        new mesh). (5) RETIRE the old shards — their parked mutations wake
+        raising the stale-topology error the router answers with a
+        topology reload + one retry. A failure before the swap thaws the
+        old authority and re-raises: the store keeps serving exactly as
+        before."""
+        import asyncio
+
+        from torchstore_tpu.metadata.shards import RemoteIndex
+
+        old_refs = list(self._shard_refs)
+        n_new = len(shard_refs)
+        # Phase 1+2: freeze the current authority and export its entries.
+        if old_refs:
+            await asyncio.gather(
+                *(ref.shard_freeze.call_one() for ref in old_refs)
+            )
+            parts = await asyncio.gather(
+                *(ref.export_entries.call_one() for ref in old_refs)
+            )
+            entries = [e for part in parts for e in part]
+        else:
+            self._reshard_gate = asyncio.Event()
+            entries = self.core.export_entries()
+        exported_keys = len({meta.key for _, meta, _ in entries})
+        try:
+            quarantined = sorted(self._quarantined_ids())
+            if n_new <= 1:
+                # Merge back to the coordinator-hosted core: a fresh core
+                # adopts the export (the idle core may hold a pre-shard
+                # index — replaying into it would resurrect stale entries).
+                old_writer = self.core.meta_writer
+                if old_writer is not None:
+                    old_writer.close()
+                self.core.teardown()
+                self.core = IndexCore(self)
+                count = await self.core.reindex(entries)
+                from torchstore_tpu.metadata import stamped as stamped_mod
+
+                if stamped_mod.enabled():
+                    self.core.meta_writer = stamped_mod.MetaStampWriter(
+                        self.core.meta_payload
+                    )
+                    self.core.meta_writer.mark_dirty()
+                self.idx = self.core
+                self._shard_refs = []
+                self._shard_stamped = []
+            else:
+                stamped = []
+                for i, ref in enumerate(shard_refs):
+                    res = await ref.shard_init.call_one(
+                        i,
+                        n_new,
+                        coordinator,
+                        self.volume_refs,
+                        self.volume_hostnames,
+                        quarantined,
+                    )
+                    stamped.append(res.get("stamped"))
+                new_idx = RemoteIndex(list(shard_refs))
+                count = await new_idx.reindex(entries)
+                self._shard_refs = list(shard_refs)
+                self._shard_stamped = stamped
+                self.idx = new_idx
+                if self.core.meta_writer is not None:
+                    # The coordinator's own index segment retires with its
+                    # authority; one-sided readers fall back and reload.
+                    self.core.meta_writer.close()
+                    self.core.meta_writer = None
+        except BaseException:
+            # Thaw: the old authority resumes exactly as frozen — parked
+            # mutations proceed against it, nothing was swapped.
+            if old_refs:
+                await asyncio.gather(
+                    *(ref.shard_thaw.call_one() for ref in old_refs),
+                    return_exceptions=True,
+                )
+            elif self._reshard_gate is not None:
+                self._reshard_gate.set()
+                self._reshard_gate = None
+            raise
+        # Phase 4: one epoch bump — every cached plan/location re-resolves
+        # and every stamped reader re-confirms against the new topology.
+        self._bump_epoch()
+        # Phase 5: retire the old authority. Parked mutations wake into
+        # the stale-topology raise the router retries through.
+        if old_refs:
+            await asyncio.gather(
+                *(ref.shard_retire.call_one() for ref in old_refs),
+                return_exceptions=True,
+            )
+        if self._reshard_gate is not None:
+            self._reshard_gate.set()
+            self._reshard_gate = None
+        obs_recorder.record(
+            "decision",
+            "control/reshard_applied",
+            shards=max(1, n_new),
+            was=len(old_refs) or 1,
+            keys=exported_keys,
+            reindexed=count,
+            epoch=self._placement_epoch,
+        )
+        logger.warning(
+            "metadata plane resharded %d -> %d shard(s): %d key(s) "
+            "replayed, placement epoch %d",
+            len(old_refs) or 1,
+            max(1, n_new),
+            exported_keys,
+            self._placement_epoch,
+        )
+        return {
+            "shards": max(1, n_new),
+            "was": len(old_refs) or 1,
+            "keys": exported_keys,
+            "reindexed": count,
+            "epoch": self._placement_epoch,
+        }
 
     @endpoint
     async def lease_acquire(
@@ -2237,6 +2464,13 @@ class Controller(Actor):
         if self._tier_task is not None:
             self._tier_task.cancel()
             self._tier_task = None
+        if self._control_task is not None:
+            self._control_task.cancel()
+            self._control_task = None
+        if self._reshard_gate is not None:
+            self._reshard_gate.set()
+            self._reshard_gate = None
+        self._relay_prefer.clear()
         self._leases.clear()
         for task in list(self._health_tasks):
             task.cancel()

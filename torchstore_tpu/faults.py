@@ -57,6 +57,18 @@ store's hot paths:
     bulk.send_frame       bulk transport frame send (client and server)
     bulk.recv_frame       bulk server frame receive (supports drop-frame)
     rendezvous.dispatch   rendezvous server op dispatch
+    control.reconcile     policy-engine reconcile entry (control/engine.py),
+                          fired before the snapshot is taken: raise aborts
+                          the whole round (interval loop logs and retries
+                          next tick), wedge freezes the engine without
+                          touching serving paths
+    control.migrate       per-action entry of every engine-driven key
+                          migration, fired before idx.migrate_key: die
+                          inside the SOURCE volume (arm volume.get there
+                          instead) or raise here mid-plan — the committed
+                          generation must survive on the source replica and
+                          the engine must abandon the action loudly (a
+                          ``decision`` event with outcome=abandoned)
 
 Cost when disarmed: ONE dict lookup (``_armed.get(name)`` on an empty dict)
 — measured indistinguishable from noise on the many_keys bench. Sites fire
@@ -113,6 +125,8 @@ REGISTRY: frozenset[str] = frozenset(
         "controller.notify",
         "controller.locate",
         "controller.shard_dispatch",
+        "control.reconcile",
+        "control.migrate",
         "volume.put",
         "volume.get",
         "volume.handshake",

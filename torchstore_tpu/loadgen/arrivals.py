@@ -16,6 +16,15 @@ overriding the defaults):
               the first ``burst_frac`` of every ``period_s`` window
     diurnal   sinusoid between ``rate_hz`` and ``peak_rate_hz`` over
               ``period_s`` (a day, time-compressed to the run length)
+    skewed    the placement-bench profile: Poisson gaps at ``rate_hz``,
+              but the harness reading this kind ALSO draws shared-key
+              gets Zipf-weighted (``zipf_alpha``; see
+              :func:`zipf_weights` — a few keys take most of the reads)
+              and gives ONE tenant cohort a burst schedule
+              (``peak_rate_hz``/``period_s``/``burst_frac``) while the
+              rest stay at baseline — the skewed-traffic shape the
+              control plane's hot-key splits and admission control are
+              measured against
 
 Churn (:func:`churn_sessions`) turns one logical client into alternating
 live/offline sessions: live spans are exponential around
@@ -30,7 +39,7 @@ import math
 import random
 from typing import Union
 
-PATTERNS = ("steady", "poisson", "burst", "diurnal")
+PATTERNS = ("steady", "poisson", "burst", "diurnal", "skewed")
 
 # A pattern's instantaneous rate never falls below this (a zero-rate
 # trough would make next_gap infinite and wedge the client loop).
@@ -48,6 +57,7 @@ class ArrivalPattern:
         peak_rate_hz: float = 0.0,
         period_s: float = 1.0,
         burst_frac: float = 0.25,
+        zipf_alpha: float = 1.1,
     ) -> None:
         if kind not in PATTERNS:
             raise ValueError(
@@ -58,9 +68,10 @@ class ArrivalPattern:
         self.peak_rate_hz = max(float(peak_rate_hz), self.rate_hz)
         self.period_s = max(1e-3, float(period_s))
         self.burst_frac = min(1.0, max(0.0, float(burst_frac)))
+        self.zipf_alpha = max(0.0, float(zipf_alpha))
 
     def rate_at(self, t: float) -> float:
-        if self.kind in ("steady", "poisson"):
+        if self.kind in ("steady", "poisson", "skewed"):
             return self.rate_hz
         phase = (t % self.period_s) / self.period_s
         if self.kind == "burst":
@@ -93,6 +104,7 @@ class ArrivalPattern:
             "peak_rate_hz": self.peak_rate_hz,
             "period_s": self.period_s,
             "burst_frac": self.burst_frac,
+            "zipf_alpha": self.zipf_alpha,
         }
 
 
@@ -104,6 +116,20 @@ def make_pattern(spec: Union[str, dict, ArrivalPattern]) -> ArrivalPattern:
     if isinstance(spec, str):
         return ArrivalPattern(kind=spec)
     return ArrivalPattern(**spec)
+
+
+def zipf_weights(n: int, alpha: float = 1.1) -> list[float]:
+    """Normalized Zipf popularity weights for ranks ``0..n-1``.
+
+    Rank ``i`` gets weight ``1/(i+1)**alpha``; with the default alpha the
+    top handful of keys soak up most of the draws, which is exactly the
+    hot-key shape the control plane's split/co-locate policies target.
+    ``alpha == 0`` degrades to uniform."""
+    if n <= 0:
+        return []
+    raw = [1.0 / float(i + 1) ** alpha for i in range(n)]
+    total = sum(raw)
+    return [w / total for w in raw]
 
 
 def churn_sessions(

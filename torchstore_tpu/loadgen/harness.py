@@ -44,7 +44,11 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from torchstore_tpu.loadgen import report as report_mod
-from torchstore_tpu.loadgen.arrivals import churn_sessions, make_pattern
+from torchstore_tpu.loadgen.arrivals import (
+    churn_sessions,
+    make_pattern,
+    zipf_weights,
+)
 
 _OPS = ("get", "put", "stream", "pinned")
 
@@ -68,6 +72,14 @@ class LoadSpec:
     mix: dict = field(default_factory=lambda: {"get": 0.8, "put": 0.2})
     value_kb: float = 4.0
     shared_keys: int = 64
+    # Tenant cohorts: every logical client gets a stable tenant label
+    # ("t0".."t{n-1}", round-robin over the global client index) carried
+    # through its op records into the merged scoreboard's by_tenant
+    # block. Under the "skewed" pattern, tenant t0 is the BURSTING
+    # tenant: its clients run a burst schedule (peak_rate_hz, or 5x
+    # baseline when unset) while every other tenant stays at baseline —
+    # the isolation shape admission control is judged on.
+    tenants: int = 1
     # Churn: per-client session turnover rate (0 = stable membership);
     # joins/leaves ride relay membership when relay_channel is set.
     churn_rate_hz: float = 0.0
@@ -197,13 +209,48 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
         await client.get_batch(warm_dests)  # locate + record plans
         await client.get_batch(warm_dests)  # warm one-sided pass
 
+    # Skewed profile: Zipf-weighted shared-key draws (hot keys emerge)
+    # plus one bursting tenant cohort; every other pattern keeps the
+    # uniform pick and a single flat cohort.
+    zipf_cum = None
+    if pattern.kind == "skewed" and shared:
+        import itertools
+
+        zipf_cum = list(
+            itertools.accumulate(zipf_weights(len(shared), pattern.zipf_alpha))
+        )
+    n_tenants = max(1, int(spec.tenants))
+    burst_pattern = None
+    if pattern.kind == "skewed" and n_tenants > 1:
+        peak = pattern.peak_rate_hz
+        if peak <= pattern.rate_hz:
+            peak = pattern.rate_hz * 5.0
+        burst_pattern = make_pattern(
+            {
+                "kind": "burst",
+                "rate_hz": pattern.rate_hz,
+                "peak_rate_hz": peak,
+                "period_s": pattern.period_s,
+                "burst_frac": pattern.burst_frac,
+            }
+        )
+
     counts = {op: 0 for op in ops}
     errors: dict[str, int] = {}
     samples: dict[str, list[float]] = {op: [] for op in ops}
+    by_tenant: dict[str, dict] = {}
 
-    def observe(op: str, dur_s: float) -> None:
-        counts[op] += 1
-        bucket = samples[op]
+    def _tenant_bucket(tenant: str) -> dict:
+        bucket = by_tenant.get(tenant)
+        if bucket is None:
+            bucket = by_tenant[tenant] = {
+                "counts": {op: 0 for op in ops},
+                "errors": {},
+                "samples": {op: [] for op in ops},
+            }
+        return bucket
+
+    def _decimated_append(bucket: list, dur_s: float) -> None:
         if len(bucket) >= spec.max_samples:
             # Decimate in place (drop every other sample) — a uniform
             # thinning that keeps quantiles representative while bounding
@@ -211,9 +258,24 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
             del bucket[::2]
         bucket.append(dur_s)
 
+    def observe(op: str, dur_s: float, tenant: str) -> None:
+        counts[op] += 1
+        _decimated_append(samples[op], dur_s)
+        t = _tenant_bucket(tenant)
+        t["counts"][op] += 1
+        _decimated_append(t["samples"][op], dur_s)
+
     async def one_client(client_idx: int, stop_at: float) -> None:
         rng = _client_rng(spec, driver_idx, client_idx)
         slow = rng.random() < spec.slow_reader_frac
+        tenant = (
+            f"t{(driver_idx * spec.clients_per_process + client_idx) % n_tenants}"
+        )
+        client_pattern = (
+            burst_pattern
+            if burst_pattern is not None and tenant == "t0"
+            else pattern
+        )
         own_key = own_keys[client_idx]
         own_val = np.random.default_rng(client_idx).standard_normal(
             n_elem, dtype=np.float32
@@ -239,7 +301,7 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
                     now = time.monotonic() - t0
                     if now >= leave_t or time.monotonic() >= stop_at:
                         return
-                    gap = pattern.next_gap(now, rng)
+                    gap = client_pattern.next_gap(now, rng)
                     await asyncio.sleep(
                         min(gap, max(0.0, leave_t - now))
                     )
@@ -256,7 +318,12 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
                     t_op = time.perf_counter()
                     try:
                         if op == "get":
-                            key = shared[rng.randrange(len(shared))]
+                            if zipf_cum is None:
+                                key = shared[rng.randrange(len(shared))]
+                            else:
+                                key = rng.choices(
+                                    shared, cum_weights=zipf_cum
+                                )[0]
                             dest = dests.get(key)
                             if dest is None:
                                 dest = dests[key] = np.zeros(
@@ -286,8 +353,10 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
                             )
                     except Exception:  # noqa: BLE001 - counted, run goes on
                         errors[op] = errors.get(op, 0) + 1
+                        t_err = _tenant_bucket(tenant)["errors"]
+                        t_err[op] = t_err.get(op, 0) + 1
                     else:
-                        observe(op, time.perf_counter() - t_op)
+                        observe(op, time.perf_counter() - t_op, tenant)
                         if slow and op == "get":
                             await asyncio.sleep(spec.slow_reader_ms / 1e3)
             finally:
@@ -331,6 +400,7 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
         "counts": counts,
         "errors": errors,
         "samples": samples,
+        "by_tenant": by_tenant,
         "window_s": time.monotonic() - t_start,
         "slo": obs_timeline.slo_report(),
     }

@@ -35,10 +35,16 @@ def merge_driver_reports(reports: list[dict]) -> dict:
     timed out are simply absent — the caller tracks ``failed_drivers``).
 
     Returns ``{"ops", "ops_per_s", "window_s", "by_op": {op: {"count",
-    "errors", "p50_ms", "p99_ms"}}, "errors", "slo": merged scoreboard,
-    "drivers"}``."""
+    "errors", "p50_ms", "p99_ms"}}, "errors", "by_tenant": {tenant:
+    {"count", "errors", "ops_per_s", "by_op"}}, "slo": merged scoreboard,
+    "drivers"}``. ``by_tenant`` is present only when at least one driver
+    labeled its clients (``LoadSpec.tenants > 1`` or the skewed profile)
+    — each tenant's quantiles fold over that tenant's concatenated
+    samples, same discipline as the fleet-wide ones."""
     by_op: dict[str, dict] = {}
     samples: dict[str, list[float]] = {}
+    tenant_ops: dict[str, dict] = {}
+    tenant_samples: dict[str, dict] = {}
     windows: list[float] = []
     total_ops = 0
     total_errors = 0
@@ -54,13 +60,24 @@ def merge_driver_reports(reports: list[dict]) -> dict:
             total_errors += int(errs)
         for op, vals in (rep.get("samples") or {}).items():
             samples.setdefault(op, []).extend(vals)
+        for tenant, bucket in (rep.get("by_tenant") or {}).items():
+            t_ops = tenant_ops.setdefault(tenant, {})
+            t_samples = tenant_samples.setdefault(tenant, {})
+            for op, count in (bucket.get("counts") or {}).items():
+                row = t_ops.setdefault(op, {"count": 0, "errors": 0})
+                row["count"] += int(count)
+            for op, errs in (bucket.get("errors") or {}).items():
+                row = t_ops.setdefault(op, {"count": 0, "errors": 0})
+                row["errors"] += int(errs)
+            for op, vals in (bucket.get("samples") or {}).items():
+                t_samples.setdefault(op, []).extend(vals)
     for op, row in by_op.items():
         row["p50_ms"] = quantile_ms(samples.get(op, []), 0.5)
         row["p99_ms"] = quantile_ms(samples.get(op, []), 0.99)
         vals = samples.get(op)
         row["max_ms"] = round(max(vals) * 1e3, 3) if vals else None
     window = max(windows) if windows else 0.0
-    return {
+    merged = {
         "ops": total_ops,
         "errors": total_errors,
         "ops_per_s": round(total_ops / window, 1) if window > 0 else 0.0,
@@ -71,6 +88,26 @@ def merge_driver_reports(reports: list[dict]) -> dict:
         ),
         "drivers": len(reports),
     }
+    if tenant_ops:
+        by_tenant: dict[str, dict] = {}
+        for tenant in sorted(tenant_ops):
+            t_ops = tenant_ops[tenant]
+            t_samples = tenant_samples.get(tenant, {})
+            for op, row in t_ops.items():
+                row["p50_ms"] = quantile_ms(t_samples.get(op, []), 0.5)
+                row["p99_ms"] = quantile_ms(t_samples.get(op, []), 0.99)
+            count = sum(row["count"] for row in t_ops.values())
+            errs = sum(row["errors"] for row in t_ops.values())
+            by_tenant[tenant] = {
+                "count": count,
+                "errors": errs,
+                "ops_per_s": (
+                    round(count / window, 1) if window > 0 else 0.0
+                ),
+                "by_op": t_ops,
+            }
+        merged["by_tenant"] = by_tenant
+    return merged
 
 
 def _merge_stage_tables(tables: list[dict]) -> dict:

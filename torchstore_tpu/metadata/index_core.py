@@ -896,6 +896,105 @@ class IndexCore:
                 await asyncio.sleep(0)  # yield between batches
         return repaired
 
+    @staticmethod
+    def _info_metas(key: str, info: StorageInfo) -> list[Request]:
+        """Meta-only Requests reconstructing one replica's footprint —
+        the same idiom auto-repair plans with."""
+        if info.object_type == ObjectType.OBJECT:
+            return [Request(key=key, is_object=True)]
+        if info.object_type == ObjectType.TENSOR:
+            return [Request(key=key, tensor_meta=info.tensor_meta)]
+        return [
+            Request(key=key, tensor_slice=ts, tensor_meta=info.tensor_meta)
+            for ts in info.tensor_slices.values()
+        ]
+
+    @staticmethod
+    def _info_nbytes(info: StorageInfo) -> int:
+        if info.object_type == ObjectType.TENSOR_SLICE:
+            itemsize = (
+                info.tensor_meta.np_dtype.itemsize
+                if info.tensor_meta is not None
+                else 4
+            )
+            return sum(
+                ts.nelements * itemsize for ts in info.tensor_slices.values()
+            )
+        if info.tensor_meta is not None:
+            return int(info.tensor_meta.nbytes)
+        return 0
+
+    async def migrate_key(
+        self, key: str, src: str, dst: str, drop_src: bool = True
+    ) -> dict[str, Any]:
+        """Online replica move/add for the control engine: pull ``key``'s
+        committed copy from ``src`` onto ``dst`` volume-to-volume, index
+        the new copy, and (``drop_src``) detach + conditionally reclaim
+        the source replica — readers keep serving throughout (the copy is
+        a landing like any put; the detach is structural and bumps).
+
+        Raced overwrites are detected by write-generation snapshot, same
+        rule as auto-repair: the pulled bytes are reclaimed on ``dst``
+        instead of indexed, and the source replica is left untouched —
+        the engine's decision audit reports the race as abandoned.
+
+        Returns ``{"status": "ok"|"missing"|"present"|"raced",
+        "nbytes": int}``."""
+        infos = self.index.get(key)
+        if infos is None or src not in infos:
+            return {"status": "missing", "nbytes": 0}
+        if dst in infos:
+            return {"status": "present", "nbytes": 0}
+        lost = infos[src]
+        metas = self._info_metas(key, lost)
+        src_gen = lost.write_gen
+        src_ref = self.host.volume_refs.get(src)
+        dst_ref = self.host.volume_refs.get(dst)
+        if src_ref is None or dst_ref is None:
+            return {"status": "missing", "nbytes": 0}
+        result = await dst_ref.pull_from.call_one(
+            src_ref,
+            metas,
+            src_hostname=self.host.volume_hostnames.get(src, ""),
+            src_volume=src,
+        )
+        infos = self.index.get(key)
+        cur = infos.get(src) if infos else None
+        if cur is None or cur.write_gen != src_gen:
+            # Overwritten/deleted while the copy was in flight: the pulled
+            # bytes may be stale — reclaim on the target, keep the source.
+            self.schedule_reclaim(dst, {key: -1})
+            return {"status": "raced", "nbytes": 0}
+        gens = result.get("write_gens", {})
+        info = infos.get(dst)
+        for m in metas:
+            if info is None:
+                info = infos[dst] = StorageInfo.from_meta(m)
+            else:
+                info.merge(m)
+        info.write_gen = max(info.write_gen, gens.get(key, 0))
+        if drop_src and len(infos) > 1:
+            infos.pop(src, None)
+            self.schedule_reclaim(src, {key: src_gen})
+        await self.host.on_structural()
+        await self.bump({key})
+        return {"status": "ok", "nbytes": self._info_nbytes(info)}
+
+    def export_entries(self) -> list[tuple[str, Request, int]]:
+        """Every (volume_id, meta-only Request, write_gen) this core's
+        index holds — the exact ``reindex`` input shape, so a metadata
+        reshard can freeze, export, and replay the whole slice onto a new
+        shard mesh with zero lost keys. Tier states are NOT exported:
+        after a reshard, demoted keys read as resident until the next
+        sweep re-folds them (cost: one fault-in-shaped fallback, never
+        correctness)."""
+        out: list[tuple[str, Request, int]] = []
+        for key, infos in self.index.items():
+            for vid, info in infos.items():
+                for meta in self._info_metas(key, info):
+                    out.append((vid, meta, info.write_gen))
+        return out
+
     async def detach_volume(self, volume_id: str) -> dict[str, Any]:
         """Drop every index entry on ``volume_id`` (volume replacement).
         Returns what it held so the repairer can re-replicate: see

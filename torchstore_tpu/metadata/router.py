@@ -34,6 +34,7 @@ from torchstore_tpu.logging import get_logger
 from torchstore_tpu.metadata import INDEX_OPS, shard_of
 from torchstore_tpu.metadata import stamped as stamped_mod
 from torchstore_tpu.metadata.shards import (
+    is_stale_topology,
     partition_keys,
     partition_metas,
     slice_write_gens,
@@ -245,6 +246,27 @@ class MetadataRouter:
         return ep
 
     async def _dispatch(self, op: str, timeout, args, kwargs) -> Any:
+        # An op that races a runtime reshard (ts.rebalance(shards=N)) hits a
+        # retired shard (STALE_TOPOLOGY_MSG) or a coordinator that went
+        # sharded under us: reload the topology from the coordinator and
+        # retry ONCE against the new mesh. Safe to replay: both raises fire
+        # at endpoint entry, strictly before any index mutation. kwargs is
+        # copied per attempt because the sharded paths pop() from it.
+        try:
+            return await self._dispatch_once(op, timeout, args, dict(kwargs))
+        except RuntimeError as exc:
+            if not is_stale_topology(exc):
+                raise
+            logger.info(
+                "metadata op %s hit a resharded topology (%s); reloading "
+                "and retrying once",
+                op,
+                exc,
+            )
+            await self.load_topology()
+            return await self._dispatch_once(op, timeout, args, dict(kwargs))
+
+    async def _dispatch_once(self, op: str, timeout, args, kwargs) -> Any:
         if self.shard_refs and op in INDEX_OPS:
             return await self._dispatch_sharded(op, timeout, args, kwargs)
         _count_rpc(op)

@@ -32,6 +32,28 @@ from torchstore_tpu.transport.types import Request
 
 logger = get_logger("torchstore_tpu.metadata.shards")
 
+# The one error string the reshard protocol speaks: a retired shard raises
+# it, the router's sharded dispatch recognizes it, reloads the metadata
+# topology from the coordinator, and retries once against the new mesh —
+# so a client op that raced a reshard completes instead of failing.
+STALE_TOPOLOGY_MSG = (
+    "stale metadata topology: shard retired by reshard; reload topology"
+)
+
+
+def is_stale_topology(exc: BaseException) -> bool:
+    """True when ``exc`` means the caller's cached metadata topology is
+    stale and a reload+retry will succeed: either a retired shard from a
+    reshard swap, or the coordinator refusing an index op because the plane
+    went sharded after the client loaded topology (1→N reshard)."""
+    if not isinstance(exc, RuntimeError):
+        return False
+    text = str(exc)
+    return (
+        "stale metadata topology" in text
+        or "metadata plane is sharded" in text
+    )
+
 
 def partition_keys(keys, n_shards: int) -> dict[int, list]:
     out: dict[int, list] = {}
@@ -72,6 +94,14 @@ class ControllerShard(Actor):
         self.volume_hostnames: dict[str, str] = {}
         self._quarantined: set = set()
         self._last_epoch: Optional[int] = None
+        # Elastic-reshard lifecycle: freeze-via-park. While ``_frozen`` is
+        # an (unset) Event, mutations PARK on it instead of failing — the
+        # coordinator exports this shard's entries meanwhile (reads still
+        # serve). ``shard_retire`` then wakes the parked ops to raise
+        # STALE_TOPOLOGY_MSG, which the router turns into a reload+retry
+        # against the new mesh: zero failed client ops across the window.
+        self._frozen: Optional[asyncio.Event] = None
+        self._retired = False
 
     # ---- IndexCore host surface ------------------------------------------
 
@@ -108,6 +138,8 @@ class ControllerShard(Actor):
         self.core = IndexCore(self)
         self.shard_id = int(shard_id)
         self.n_shards = int(n_shards)
+        self._frozen = None
+        self._retired = False
         self.coordinator = coordinator
         self.volume_refs = dict(volume_refs)
         self.volume_hostnames = dict(volume_hostnames)
@@ -140,6 +172,59 @@ class ControllerShard(Actor):
         self.volume_refs[volume_id] = ref
         self.volume_hostnames[volume_id] = hostname
 
+    # ---- elastic-reshard lifecycle ---------------------------------------
+
+    def _check_retired(self) -> None:
+        if self._retired:
+            raise RuntimeError(STALE_TOPOLOGY_MSG)
+
+    async def _mutation_gate(self) -> None:
+        """Park mutations while frozen; raise stale-topology once retired.
+        Reads bypass this (a frozen shard's index is immutable, so serving
+        reads from it is exactly as consistent as before the freeze)."""
+        self._check_retired()
+        if self._frozen is not None:
+            await self._frozen.wait()
+            self._check_retired()
+
+    @endpoint
+    async def shard_freeze(self) -> int:
+        """Stop the index moving: mutations park until retire (or thaw via
+        re-init). Returns the number of index keys frozen — the count the
+        coordinator cross-checks against its export."""
+        if self._frozen is None:
+            self._frozen = asyncio.Event()
+        return len(self.core.index)
+
+    @endpoint
+    async def export_entries(self) -> list:
+        """This shard's whole slice in ``reindex`` input shape (call while
+        frozen — exporting a moving index would lose racing notifies)."""
+        return self.core.export_entries()
+
+    @endpoint
+    async def shard_thaw(self) -> None:
+        """Abort a reshard before the swap: wake parked mutations to run
+        against THIS still-authoritative shard (not retired, so the gate
+        falls through). Idempotent."""
+        if self._frozen is not None and not self._retired:
+            gate, self._frozen = self._frozen, None
+            gate.set()
+
+    @endpoint
+    async def shard_retire(self) -> None:
+        """Terminal: wake parked mutations to raise stale-topology, close
+        the stamped segment (one-sided readers fall back to RPC, which
+        reloads them onto the new mesh), and drop the index. Pending
+        reclaim drainers keep running — their volume refs stay valid and
+        the stale bytes they guard must still be deleted."""
+        self._retired = True
+        if self._frozen is not None:
+            self._frozen.set()
+        if self.core.meta_writer is not None:
+            self.core.meta_writer.close()
+            self.core.meta_writer = None
+
     # ---- client-routed index ops -----------------------------------------
 
     @endpoint
@@ -150,11 +235,13 @@ class ControllerShard(Actor):
         require_fully_committed: bool = True,
     ):
         await faults.afire("controller.shard_dispatch")
+        self._check_retired()
         return await self.core.locate(keys, missing_ok, require_fully_committed)
 
     @endpoint
     async def contains(self, key: str) -> str:
         await faults.afire("controller.shard_dispatch")
+        self._check_retired()
         return await self.core.contains(key)
 
     @endpoint
@@ -174,6 +261,7 @@ class ControllerShard(Actor):
         the coordinator in the same dispatch), else None."""
         await faults.afire("controller.shard_dispatch")
         await faults.afire("controller.notify")
+        await self._mutation_gate()
         volume_ids = [volume_id] if isinstance(volume_id, str) else volume_id
         structural = await self.core.apply_put_batch(
             metas,
@@ -190,6 +278,7 @@ class ControllerShard(Actor):
         """Index-drop for this shard's keys (the router already ran the
         coordinator's lease guard). Deletions are structural."""
         await faults.afire("controller.shard_dispatch")
+        await self._mutation_gate()
         self.core.count_deletes(len(keys))
         by_volume = self.core.delete_keys(keys)
         deleted = {k for vkeys in by_volume.values() for k in vkeys}
@@ -201,6 +290,7 @@ class ControllerShard(Actor):
     @endpoint
     async def keys(self, prefix: Optional[str] = None) -> list[str]:
         await faults.afire("controller.shard_dispatch")
+        self._check_retired()
         return await self.core.keys_list(prefix)
 
     @endpoint
@@ -229,7 +319,15 @@ class ControllerShard(Actor):
     async def merge_copies(
         self, volume_id: str, metas: list[Request], write_gens: dict[str, int]
     ) -> list[str]:
+        await self._mutation_gate()
         return sorted(await self.core.merge_copies(volume_id, metas, write_gens))
+
+    @endpoint
+    async def migrate_key(
+        self, key: str, src: str, dst: str, drop_src: bool = True
+    ) -> dict[str, Any]:
+        await self._mutation_gate()
+        return await self.core.migrate_key(key, src, dst, drop_src=drop_src)
 
     @endpoint
     async def auto_repair(self, volume_id: str, healthy: list[str]) -> int:
@@ -370,6 +468,19 @@ class RemoteIndex:
         )
         return {k for part in results for k in part}
 
+    async def migrate_key(
+        self, key: str, src: str, dst: str, drop_src: bool = True
+    ) -> dict[str, Any]:
+        return await self._ref(key).migrate_key.call_one(
+            key, src, dst, drop_src
+        )
+
+    async def export_entries(self) -> list:
+        parts = await asyncio.gather(
+            *(ref.export_entries.call_one() for ref in self.shard_refs)
+        )
+        return [entry for part in parts for entry in part]
+
     async def auto_repair_pass(self, volume_id: str, healthy: list[str]) -> int:
         return sum(
             await asyncio.gather(
@@ -506,8 +617,10 @@ class RemoteIndex:
 
 # Re-exported for the router's use (one partitioning vocabulary).
 __all__ = [
+    "STALE_TOPOLOGY_MSG",
     "ControllerShard",
     "RemoteIndex",
+    "is_stale_topology",
     "partition_keys",
     "partition_metas",
     "slice_write_gens",

@@ -37,17 +37,26 @@ ROOT_FANOUT = 1
 
 
 def build_tree(
-    root: str, members: Iterable[str], fanout: int
+    root: str,
+    members: Iterable[str],
+    fanout: int,
+    prefer: Optional[Iterable[str]] = None,
 ) -> dict[str, str]:
     """Parent map ``{child: parent}`` over ``members`` rooted at ``root``.
 
     ``root`` (the origin volume) is excluded from the member set if listed;
     it takes :data:`ROOT_FANOUT` children, every other node up to
-    ``fanout``. Members are attached breadth-first in sorted-id order.
-    Returns ``{}`` when there is nothing to relay to.
+    ``fanout``. Members are attached breadth-first in sorted-id order —
+    unless ``prefer`` names members first (the control plane's measured
+    edge-proximity order: heaviest consumers attach nearest the root);
+    unnamed members follow in sorted-id order, so the tree stays
+    deterministic for any (members, prefer) pair. Returns ``{}`` when
+    there is nothing to relay to.
     """
     fanout = max(1, int(fanout))
-    order = sorted(set(members) - {root})
+    pool = set(members) - {root}
+    order = [v for v in (prefer or ()) if v in pool]
+    order += sorted(pool - set(order))
     parents: dict[str, str] = {}
     slots: deque[list] = deque()
     slots.append([root, ROOT_FANOUT])

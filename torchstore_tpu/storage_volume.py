@@ -521,59 +521,110 @@ class StorageVolume(Actor):
             self._tier.discard(key)
         self._tier.touch(keys)
 
+    async def _tier_demote_key(self, tier, kv, key: str) -> bool:
+        """Demote one resident key to the disk tier (caller holds
+        ``_tier_lock``). Returns True when the key's memory copy was
+        dropped; a failed spill leaves the entry fully resident and served.
+        Shared by the watermark sweep and the control plane's named-key
+        demotion so both paths cross the same faultpoint and landing
+        bracket."""
+        import asyncio
+
+        entry = kv.get(key)
+        if entry is None:
+            return False
+        before = self._entry_nbytes(key)
+        try:
+            # The faultpoint fires INSIDE the failure domain: a raise (or a
+            # crash-safe write failure) aborts THIS key's demotion only —
+            # the entry stays fully resident and served.
+            await faults.afire("volume.spill")
+            tier.spill(key, entry)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - a failed spill
+            # must leave the entry fully resident + served
+            logger.exception(
+                "spill of %r failed; entry stays resident", key
+            )
+            return False
+        # Drop the memory copy under the landing bracket: one-sided readers
+        # of the retired entry fall back (stamps tombstone) instead of
+        # tearing.
+        self._landing_open()
+        try:
+            self.store.delete(key)
+            self.ctx.delete_key(key)
+        finally:
+            self._landing_close()
+        self._apply_residency_delta([key], before)
+        return True
+
     @endpoint
-    async def tier_sweep(self, pins: Optional[list[str]] = None) -> dict:
+    async def tier_cold_keys(
+        self, pins: Optional[list[str]] = None, limit: int = 64
+    ) -> list[str]:
+        """Coldest resident keys, coldest version group first (``pins`` —
+        leased ``channel/vN`` groups — are exempt), up to ``limit``. A
+        read-only advisory view for the control plane's per-key demotion
+        planner: no lock, no state change, just the LRU order the next
+        watermark sweep would walk."""
+        tier = self._tier
+        if tier is None:
+            return []
+        kv = getattr(self.store, "kv", {})
+        out: list[str] = []
+        for _group, keys in tier.cold_groups(kv, pins or ()):
+            for key in keys:
+                if key in kv:
+                    out.append(key)
+                    if len(out) >= limit:
+                        return out
+        return out
+
+    @endpoint
+    async def tier_sweep(
+        self,
+        pins: Optional[list[str]] = None,
+        demote_keys: Optional[list[str]] = None,
+    ) -> dict:
         """Run one spill pass: when resident bytes exceed the HIGH
         watermark, demote cold version groups (LRU by access; ``pins`` —
         leased ``channel/vN`` groups — are exempt) until under LOW. Also
         drains the fault-in feedback list so the controller can flip index
         tier states back to resident. Called by the controller's background
-        sweeper and by ``ts.tier_sweep()`` on demand."""
-        import asyncio
+        sweeper and by ``ts.tier_sweep()`` on demand.
 
+        ``demote_keys`` names specific keys the control plane decided to
+        spill regardless of the watermark (frequency-aware demotion: the
+        policy engine picks per-key cold candidates from the traffic
+        ledger instead of whole-version LRU). Named keys demote first,
+        then the watermark pass runs as usual."""
         tier = self._tier
         if tier is None:
             return {"enabled": False, "spilled": [], "fault_ins": []}
+        from torchstore_tpu.tiering import version_group
+
         spilled: list[str] = []
+        pinned = set(pins or ())
         async with self._tier_lock:
             fault_ins = tier.drain_faulted()
+            kv = getattr(self.store, "kv", {})
+            for key in dict.fromkeys(demote_keys or ()):
+                if key in tier.spilled:
+                    continue
+                vg = version_group(key)
+                if vg is not None and f"{vg[0]}/v{vg[1]}" in pinned:
+                    continue  # leased groups stay exempt on this path too
+                if await self._tier_demote_key(tier, kv, key):
+                    spilled.append(key)
             if self._resident_bytes > tier.high_bytes:
-                kv = getattr(self.store, "kv", {})
                 for _group, keys in tier.cold_groups(kv, pins or ()):
                     if self._resident_bytes <= tier.low_bytes:
                         break
                     for key in keys:
-                        entry = kv.get(key)
-                        if entry is None:
-                            continue
-                        before = self._entry_nbytes(key)
-                        try:
-                            # The faultpoint fires INSIDE the failure
-                            # domain: a raise (or a crash-safe write
-                            # failure) aborts THIS key's demotion only —
-                            # the entry stays fully resident and served.
-                            await faults.afire("volume.spill")
-                            tier.spill(key, entry)
-                        except asyncio.CancelledError:
-                            raise
-                        except Exception:  # noqa: BLE001 - a failed spill
-                            # must leave the entry fully resident + served
-                            logger.exception(
-                                "spill of %r failed; entry stays resident",
-                                key,
-                            )
-                            continue
-                        # Drop the memory copy under the landing bracket:
-                        # one-sided readers of the retired entry fall back
-                        # (stamps tombstone) instead of tearing.
-                        self._landing_open()
-                        try:
-                            self.store.delete(key)
-                            self.ctx.delete_key(key)
-                        finally:
-                            self._landing_close()
-                        self._apply_residency_delta([key], before)
-                        spilled.append(key)
+                        if await self._tier_demote_key(tier, kv, key):
+                            spilled.append(key)
         if spilled:
             logger.info(
                 "volume %s spilled %d key(s) to the disk tier "
