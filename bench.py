@@ -593,6 +593,108 @@ async def ledger_overhead_section(
         await ts.shutdown("bench_ledger")
 
 
+async def history_overhead_section(
+    n_keys: int = 1024,
+    key_kb: float = 4,
+    reps: int = 16,
+) -> dict:
+    """Time-series history cost (ISSUE 17 acceptance): the warm zero-RPC
+    many-keys get leg timed with the history sampler + trend detectors
+    running HOT (50 ms sweeps — 20x the production default, so a real
+    deployment sits well inside whatever this measures) vs history
+    DISABLED, interleaved rep-for-rep so both sides see the same host
+    mood. Min-of-reps on each side; ``overhead_pct`` is the acceptance
+    number (budget: <= 1% at full scale; KB-scale smoke runs only assert
+    structure)."""
+    import os
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.observability import history as obs_history
+
+    await ts.initialize(
+        store_name="bench_history",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    store = obs_history.series_store()
+    was_enabled = store.enabled
+    interval_was = os.environ.get(obs_history.ENV_HISTORY_INTERVAL)
+    try:
+        # The sampler re-reads the interval env every sweep — but it may be
+        # mid-way through a sleep at the OLD (1 s default) interval, longer
+        # than a KB-scale section's whole life. Restart it so the 50 ms
+        # cadence takes effect now: the ON legs then sample (and run every
+        # detector) 20x harder than production.
+        os.environ[obs_history.ENV_HISTORY_INTERVAL] = "0.05"
+        obs_history.stop_history()
+        obs_history.maybe_start_history()
+        # Prime one sweep synchronously so the rings are warm (and
+        # retained_series below is deterministic) before any timed rep.
+        store.sample()
+
+        n_elem = max(1, int(key_kb * 1024 // 4))
+        items = {
+            f"ho/{i}": np.random.rand(n_elem).astype(np.float32)
+            for i in range(n_keys)
+        }
+        total = sum(v.nbytes for v in items.values())
+        await ts.put_batch(items, store_name="bench_history")
+        dests = {k: np.empty_like(v) for k, v in items.items()}
+        # Recording get: re-records the one-sided plans so every timed rep
+        # below is the pure warm stamped-memcpy shape.
+        await ts.get_batch(dict(dests), store_name="bench_history")
+
+        async def one_rep() -> float:
+            t0 = time.perf_counter()
+            await ts.get_batch(dict(dests), store_name="bench_history")
+            return time.perf_counter() - t0
+
+        on_times: list[float] = []
+        off_times: list[float] = []
+        for _ in range(max(2, reps)):
+            store.set_enabled(True)
+            on_times.append(await one_rep())
+            store.set_enabled(False)
+            off_times.append(await one_rep())
+        on_s, off_s = min(on_times), min(off_times)
+        overhead_pct = (on_s / off_s - 1.0) * 100.0 if off_s > 0 else 0.0
+        out = {
+            "n_keys": n_keys,
+            "key_kb": key_kb,
+            "total_mb": round(total / 1e6, 2),
+            "reps": max(2, reps),
+            "sample_interval_s": 0.05,
+            "retained_series": len(store),
+            "on_us_per_key": round(on_s / n_keys * 1e6, 3),
+            "off_us_per_key": round(off_s / n_keys * 1e6, 3),
+            # Can be slightly negative under host noise — reported raw so
+            # the record is honest about measurement resolution.
+            "overhead_pct": round(overhead_pct, 2),
+        }
+        print(
+            f"# history_overhead ({n_keys} x {key_kb:.0f} KB warm one-sided "
+            f"gets, 50ms sweeps over {out['retained_series']} series): "
+            f"{out['on_us_per_key']:.2f} us/key history-on vs "
+            f"{out['off_us_per_key']:.2f} off ({out['overhead_pct']:+.2f}% "
+            "— budget <= 1%)",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        # Restore the PRE-SECTION state (an operator running the bench
+        # with TORCHSTORE_TPU_HISTORY=0 must not get sampling force-
+        # enabled for every later section).
+        if interval_was is None:
+            os.environ.pop(obs_history.ENV_HISTORY_INTERVAL, None)
+        else:
+            os.environ[obs_history.ENV_HISTORY_INTERVAL] = interval_was
+        # Re-arm the sampler at the production cadence, then restore the
+        # exact pre-section enabled flag.
+        obs_history.stop_history()
+        obs_history.maybe_start_history()
+        store.set_enabled(was_enabled)
+        await ts.shutdown("bench_history")
+
+
 async def streamed_sync_section(
     n_layers: int = 16,
     layer_kb: float = 256,
@@ -2382,6 +2484,11 @@ async def run(
     ledger_overhead = await ledger_overhead_section(
         n_keys=ledger_keys, reps=ledger_reps
     )
+    # Time-series history overhead (ISSUE 17): the sampler + trend
+    # detectors at 20x production sweep rate on the same warm get leg.
+    history_overhead = await history_overhead_section(
+        n_keys=ledger_keys, reps=ledger_reps
+    )
     # Streamed-sync section (ISSUE 9): the simulated train→publish→decode
     # loop, barrier vs layer-streamed, on its own fleet.
     streamed = await streamed_sync_section(
@@ -2497,6 +2604,11 @@ async def run(
         # "ledger_overhead".
         "ledger_overhead_pct": ledger_overhead["overhead_pct"],
         "ledger_overhead": ledger_overhead,
+        # ISSUE-17 acceptance: history sampler + detector cost on the same
+        # warm get leg (budget <= 1% at full scale); full section under
+        # "history_overhead".
+        "history_overhead_pct": history_overhead["overhead_pct"],
+        "history_overhead": history_overhead,
         # ISSUE-9 headline stats at top level: how much of the publish
         # window the streamed acquire overlapped (acceptance > 0) and the
         # first decoded layer relative to publish completion (negative =

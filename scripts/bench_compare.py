@@ -67,6 +67,9 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "heal_s": ("lower", 1.0, "rel"),
     "failover_get_s": ("lower", 1.0, "rel"),
     "ledger_overhead_pct": ("lower", 2.0, "abs"),
+    # History sampler + trend detectors (ISSUE 17): budget <= 1% on the
+    # warm get leg even at the bench's 20x production sweep rate.
+    "history_overhead_pct": ("lower", 1.0, "abs"),
     # Broadcast fan-out (ISSUE 11). The egress ratio is deterministic at a
     # given K (1/K when every layer rides the tree), so even a small
     # absolute drift means relay hops leaked reads back to the origin; the
@@ -150,6 +153,10 @@ def extract_metrics(doc: dict) -> dict[str, float]:
         pct = flat["ledger_overhead"].get("overhead_pct")
         if pct is not None:
             flat["ledger_overhead_pct"] = pct
+    if isinstance(flat.get("history_overhead"), dict):
+        pct = flat["history_overhead"].get("overhead_pct")
+        if pct is not None:
+            flat["history_overhead_pct"] = pct
     out: dict[str, float] = {}
     for name in THRESHOLDS:
         value = flat.get(name)

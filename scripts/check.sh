@@ -6,7 +6,7 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (15 rules,
+#   1. tslint --fail-on-new     repo-specific static analysis (16 rules,
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
 #                               --regen-metric-docs after editing knobs or
@@ -16,6 +16,8 @@
 #   3. bench + trajectory smoke pytest over test_bench_smoke.py (the REAL
 #                               bench.py code path at KB scale, incl. the
 #                               ledger_overhead telemetry-cost section,
+#                               the history_overhead sampler+detector
+#                               cost section (<= 1% budget at scale),
 #                               the relay fanout section's O(1)-egress
 #                               bound, the tiered-capacity section's
 #                               spill/fault-in/warm-leased-get gates,

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""ts-top: live terminal console for a torchstore_tpu fleet.
+
+Renders, once per refresh, from the fleet's retained time-series history
+(``ts.history()``) and live scoreboards:
+
+- ops/s and get-p99 sparklines (last ~2 minutes, 1s buckets),
+- per-volume heat: open landing brackets, resident doorbell plans,
+  rolling window ops — with trend markers when a sustained/ramp detector
+  is firing on that volume,
+- the SLO scoreboard with trend arrows (^ ramping, ~ drifting, ! sustained
+  over threshold, = quiet),
+- the control-plane decision tail (planned actions + recent decision /
+  fault / slo flight events).
+
+No dependencies beyond the repo: plain ANSI clear-and-redraw, stdlib only.
+
+Two ways to attach:
+
+- ``--store NAME`` (default ``torchstore_tpu``): join the fleet as a
+  client and read ``ts.history()`` / ``ts.slo_report()`` /
+  ``ts.control_plan()`` / ``ts.flight_record()``.
+- ``--url http://host:port``: poll one process's HTTP exporter
+  (``/history.json`` + ``/slo.json``; TORCHSTORE_TPU_METRICS_PORT) —
+  no store membership needed, single-process view.
+
+``--once`` renders a single frame and exits (non-interactive capture, CI
+smoke); otherwise refreshes every ``--interval`` seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+import urllib.request
+
+SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+CSI_CLEAR = "\x1b[2J\x1b[H"
+
+TREND_MARKS = {"sustained": "!", "ramp": "^", "drift": "~"}
+
+
+# --------------------------------------------------------------------------
+# pure rendering (unit-testable: data dict in, string out)
+# --------------------------------------------------------------------------
+
+
+def spark(values: list[float], width: int = 60) -> str:
+    """A unicode sparkline of the last ``width`` values, min-max scaled."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return "(no data)"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in vals:
+        frac = (v - lo) / span if span > 0 else 0.5
+        out.append(SPARK_CHARS[1 + int(frac * (len(SPARK_CHARS) - 2))])
+    return "".join(out)
+
+
+def fleet_rate_series(history_doc: dict, name: str) -> list[list]:
+    """Fleet ops/s per 1s bucket from a ``ts.history()`` doc: exact
+    cumulative-counter diffs per process/label series, summed per bucket."""
+    from torchstore_tpu.observability import history as obs_history
+
+    merged: dict[float, float] = {}
+    for proc_doc in (history_doc.get("processes") or {}).values():
+        for sid, entry in (proc_doc.get("series") or {}).items():
+            if sid == name or sid.startswith(name + "{"):
+                for ts, rate in obs_history.counter_rate_points(
+                    entry["points"]
+                ):
+                    merged[ts] = merged.get(ts, 0.0) + rate
+    return [[ts, merged[ts]] for ts in sorted(merged)]
+
+
+def fleet_gauge_series(history_doc: dict, sid_exact: str) -> list[list]:
+    """Worst per-bucket value of one gauge series across processes."""
+    from torchstore_tpu.observability import history as obs_history
+
+    rows = [
+        entry["points"]
+        for proc_doc in (history_doc.get("processes") or {}).values()
+        for sid, entry in (proc_doc.get("series") or {}).items()
+        if sid == sid_exact
+    ]
+    return [[r[0], r[2]] for r in obs_history.merge_points(rows, how="max")]
+
+
+def trend_arrow(trends: dict) -> str:
+    """One status mark summarizing a process's active detectors."""
+    marks = [
+        TREND_MARKS.get(result.get("kind"), "?")
+        for result in (trends or {}).values()
+        if result.get("active")
+    ]
+    return "".join(sorted(set(marks))) or "="
+
+
+def render_frame(data: dict, width: int = 72) -> str:
+    """One full console frame from collected fleet data (see
+    ``collect_store`` / ``collect_url`` for the dict shape — every key is
+    optional; absent sections render as absent, never crash)."""
+    lines: list[str] = []
+    now = data.get("generated_ts") or time.time()
+    source = data.get("source", "?")
+    lines.append(
+        f"ts-top — {source} — "
+        f"{time.strftime('%H:%M:%S', time.localtime(now))}"
+    )
+    lines.append("─" * width)
+
+    history_doc = data.get("history") or {}
+    ops = fleet_rate_series(history_doc, "ts_client_ops_total")
+    p99 = fleet_gauge_series(history_doc, 'ts_op_p99_seconds{op="get"}')
+    ops_now = ops[-1][1] if ops else 0.0
+    p99_now_ms = p99[-1][1] * 1e3 if p99 else 0.0
+    lines.append(
+        f"  ops/s   {spark([v for _t, v in ops])}  {ops_now:8.1f}"
+    )
+    lines.append(
+        f"  get p99 {spark([v for _t, v in p99])}  {p99_now_ms:6.2f}ms"
+    )
+
+    slo = data.get("slo") or {}
+    trends = slo.get("trends") or {}
+    lines.append("")
+    lines.append(f"SLOs [{trend_arrow(trends)}]")
+    for name, row in sorted((slo.get("slos") or {}).items()):
+        mark = "VIOLATED" if row.get("violated") else "ok"
+        current = row.get("current")
+        cur = f"{current:g}" if current is not None else "-"
+        lines.append(
+            f"  {name:<24} {cur:>10} / {row.get('threshold'):g}"
+            f"  [{mark}]  x{row.get('violations', 0)}"
+        )
+    for name, result in sorted(trends.items()):
+        if result.get("active"):
+            detail = (
+                f"{result.get('duration_s', 0):.0f}s"
+                if result.get("kind") == "sustained"
+                else f"z={result.get('z', 0):.1f}"
+                if result.get("kind") == "drift"
+                else f"slope={result.get('slope', 0):.2f}/s"
+            )
+            lines.append(
+                f"  trend {TREND_MARKS.get(result.get('kind'), '?')} "
+                f"{name}: {result.get('series')} ({detail})"
+            )
+
+    volumes = (data.get("overload") or {}).get("volumes") or {}
+    if volumes:
+        lines.append("")
+        lines.append("volumes")
+        max_ops = max(
+            (int(v.get("window_ops") or 0) for v in volumes.values()),
+            default=0,
+        )
+        for vid, v in sorted(volumes.items()):
+            w_ops = int(v.get("window_ops") or 0)
+            bar_w = int(20 * w_ops / max_ops) if max_ops else 0
+            lines.append(
+                f"  {vid:<14} land={int(v.get('landing_inflight') or 0):<4}"
+                f" plans={int(v.get('doorbell_plans') or 0):<4}"
+                f" ops={w_ops:<8} {'#' * bar_w:<20}"
+                f" [{trend_arrow(v.get('trends'))}]"
+            )
+
+    plan = data.get("plan") or {}
+    actions = plan.get("actions") or []
+    sustained = (plan.get("snapshot") or {}).get("sustained_overload") or {}
+    if actions or sustained:
+        lines.append("")
+        lines.append("control plane")
+        for vid, dets in sorted(sustained.items()):
+            lines.append(f"  sustained_overload {vid}: {', '.join(dets)}")
+        for action in actions[-6:]:
+            lines.append(
+                f"  plan {action.get('kind')} {action.get('subject')}: "
+                f"{action.get('reason', '')[:48]}"
+            )
+
+    events = data.get("events") or []
+    if events:
+        lines.append("")
+        lines.append("recent decisions / faults")
+        for event in events[-6:]:
+            ts_s = time.strftime(
+                "%H:%M:%S", time.localtime(event.get("ts") or 0)
+            )
+            lines.append(
+                f"  {ts_s} [{event.get('kind')}] {event.get('name')} "
+                f"({event.get('process', '?')})"
+            )
+
+    errors = (data.get("history") or {}).get("errors") or {}
+    if errors:
+        lines.append("")
+        lines.append(
+            "unreachable: " + ", ".join(sorted(errors)) + ""
+        )
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# collectors
+# --------------------------------------------------------------------------
+
+
+async def collect_store(store_name: str) -> dict:
+    """One refresh's data via store membership (fleet view)."""
+    import torchstore_tpu as ts
+
+    history_doc = await ts.history(
+        series=(
+            "ts_client_ops_total*",
+            "ts_op_p99_seconds*",
+            "ts_landing_inflight*",
+        ),
+        since=120.0,
+        store_name=store_name,
+    )
+    slo = await ts.slo_report(store_name=store_name)
+    plan = await ts.control_plan(store_name=store_name)
+    record = await ts.flight_record(store_name=store_name)
+    events = [
+        e
+        for e in record.get("events") or []
+        if e.get("kind") in ("decision", "fault", "slo", "health")
+    ]
+    return {
+        "source": f"store:{store_name}",
+        "generated_ts": time.time(),
+        "history": history_doc,
+        "slo": slo,
+        "overload": slo.get("overload") or {},
+        "plan": plan,
+        "events": events,
+    }
+
+
+def collect_url(url: str, timeout: float = 5.0) -> dict:
+    """One refresh's data from a single process's HTTP exporter."""
+    base = url.rstrip("/")
+
+    def fetch(path: str) -> dict:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    history_local = fetch(
+        "/history.json?series=ts_client_ops_total*,ts_op_p99_seconds*,"
+        "ts_landing_inflight*&since=120"
+    )
+    try:
+        slo = fetch("/slo.json")
+    except Exception:  # noqa: BLE001 - older exporters: history still renders
+        slo = {}
+    return {
+        "source": url,
+        "generated_ts": time.time(),
+        # Same shape as ts.history() so the renderer doesn't care which
+        # attach mode produced the frame.
+        "history": {"processes": {"local": history_local}, "errors": {}},
+        "slo": slo,
+    }
+
+
+async def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="live terminal console for a torchstore_tpu fleet"
+    )
+    parser.add_argument("--store", default=None, help="store name to join")
+    parser.add_argument(
+        "--url", default=None, help="poll an HTTP exporter instead"
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="refresh seconds"
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (no screen clear)",
+    )
+    args = parser.parse_args()
+    if args.url and args.store:
+        parser.error("--store and --url are mutually exclusive")
+    store_name = args.store or "torchstore_tpu"
+
+    while True:
+        if args.url:
+            data = collect_url(args.url)
+        else:
+            data = await collect_store(store_name)
+        frame = render_frame(data)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write(CSI_CLEAR + frame)
+        sys.stdout.flush()
+        await asyncio.sleep(max(0.2, args.interval))
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(asyncio.run(main()))
+    except KeyboardInterrupt:
+        sys.exit(0)

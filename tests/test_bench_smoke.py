@@ -327,6 +327,35 @@ async def test_bench_ledger_overhead_section_tiny():
 
 
 @pytest.mark.anyio
+async def test_bench_history_overhead_section_tiny():
+    """The history_overhead section standalone at KB scale: real warm
+    one-sided gets timed with the sampler+detectors hot (50 ms sweeps) vs
+    disabled, and both the enabled flag and the interval env restored
+    afterwards (a bench crash must never leave history off or stuck at
+    the 20x sweep rate)."""
+    import os
+
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+    from torchstore_tpu.observability import history as obs_history
+
+    interval_before = os.environ.get(obs_history.ENV_HISTORY_INTERVAL)
+    enabled_before = obs_history.series_store().enabled
+    out = await bench.history_overhead_section(n_keys=16, key_kb=4, reps=2)
+    assert out["on_us_per_key"] > 0 and out["off_us_per_key"] > 0
+    assert "overhead_pct" in out
+    assert out["sample_interval_s"] == 0.05
+    # The ON legs actually retained series (the sampler ran hot).
+    assert out["retained_series"] > 0
+    assert os.environ.get(obs_history.ENV_HISTORY_INTERVAL) == interval_before
+    assert obs_history.series_store().enabled == enabled_before
+    json.dumps(out)
+
+
+@pytest.mark.anyio
 async def test_bench_capacity_section_tiny():
     """The capacity section standalone (``bench.py --capacity``) at KB
     scale: a real tier-enabled fleet whose working set is 2x the pool

@@ -540,3 +540,73 @@ async def test_loadgen_chaos_kill_zero_loss_and_scoreboard_violations(
         ), report["overload"]
     finally:
         await ts.shutdown("lg_chaos")
+
+
+# --------------------------------------------------------------------------
+# diurnal shape reconstruction from ts.history() alone (ISSUE 17)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_diurnal_arrival_shape_reconstructable_from_history():
+    """ISSUE 17 acceptance: a diurnal loadgen run's arrival shape is
+    reconstructable from the history rings alone — no per-op samples, just
+    the merged ``history.ops_per_s`` artifact. A least-squares sinusoid
+    fit at the spec'd period recovers a peak/trough ops/s ratio within
+    25% of the spec'd ``peak_rate_hz / rate_hz`` ratio."""
+    period_s = 8.0
+    base_hz, peak_hz = 12.0, 48.0  # per client; spec ratio 4.0
+    await ts.initialize(num_storage_volumes=2, store_name="lg_diurnal")
+    try:
+        spec = LoadSpec(
+            store_name="lg_diurnal",
+            # 1.5 periods: a full period survives in the interior even
+            # when a loaded machine delays the driver's first buckets.
+            duration_s=12.0,
+            processes=1,  # one driver: one arrival-process phase to fit
+            clients_per_process=6,
+            pattern={
+                "kind": "diurnal",
+                "rate_hz": base_hz,
+                "peak_rate_hz": peak_hz,
+                "period_s": period_s,
+            },
+            mix={"get": 0.7, "put": 0.3},
+            shared_keys=8,
+            value_kb=1.0,
+            seed=23,
+            # Tight sampler cadence: bucket closing values land within
+            # 0.1s of the bucket boundary, so per-bucket counter diffs
+            # track the true 1s arrival counts.
+            env={"TORCHSTORE_TPU_HISTORY_INTERVAL_S": "0.1"},
+        )
+        merged = await run_fleet_load(spec)
+        assert merged["failed_drivers"] == 0, merged.get("driver_errors")
+        assert merged["errors"] == 0, merged["by_op"]
+        hist = merged.get("history") or {}
+        assert hist.get("step_s") == 1.0, hist.keys()
+        assert hist.get("get_p99_ms"), "p99 gauge series missing"
+        rows = hist["ops_per_s"]
+        # Drop the ramp-up/teardown edge buckets; the interior must still
+        # cover at least one full period.
+        interior = rows[1:-1]
+        assert len(interior) >= period_s, rows
+        t = np.array([r[0] for r in interior], dtype=np.float64)
+        y = np.array([r[1] for r in interior], dtype=np.float64)
+        # Unknown phase (wall-clock bucket grid vs run start): fit
+        # mean + a*sin + b*cos at the KNOWN period, amplitude = |(a, b)|.
+        w = 2.0 * np.pi / period_s
+        design = np.stack(
+            [np.ones_like(t), np.sin(w * t), np.cos(w * t)], axis=1
+        )
+        (mean, a, b), *_ = np.linalg.lstsq(design, y, rcond=None)
+        amp = float(np.hypot(a, b))
+        assert mean > 0 and amp > 0 and amp < mean, (mean, amp)
+        measured_ratio = (mean + amp) / (mean - amp)
+        spec_ratio = peak_hz / base_hz
+        assert spec_ratio * 0.75 <= measured_ratio <= spec_ratio * 1.25, (
+            f"reconstructed peak/trough {measured_ratio:.2f} vs spec "
+            f"{spec_ratio:.1f}: interior={interior}"
+        )
+    finally:
+        await ts.shutdown("lg_diurnal")

@@ -1,0 +1,621 @@
+"""Fleet time-series history, trend detection, and ts-top (ISSUE 17).
+
+Covers the retention layer (observability/history.py: ring math, the
+downsample min/max/last discipline, counter-rate derivation across a
+process restart, the series cap), the detectors (observability/detect.py:
+sustained / drift / ramp with injected clocks), the fleet surfaces
+(``ts.history()`` with a dead volume, ``/history.json`` on the HTTP
+exporter, flight-recorder dumps embedding vitals), the ISSUE-17 acceptance
+leg (an induced ``shm.landing_stamp`` delay ramp makes the
+sustained-overload detector fire in ``slo_report()["trends"]`` AND in
+``ts.control_plan()``'s snapshot BEFORE any instantaneous SLO gate trips),
+and the ts-top console (pure renderers plus one live frame per attach
+mode).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.observability import detect as obs_detect
+from torchstore_tpu.observability import history as obs_history
+from torchstore_tpu.observability import http_exporter
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO_ROOT / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+# ring math (pure units)
+# --------------------------------------------------------------------------
+
+
+class TestRing:
+    def test_same_bucket_merges_min_max_last_sum_count(self):
+        ring = obs_history._Ring(1.0, 8)
+        ring.add(100.2, 5.0)
+        ring.add(100.7, 1.0)
+        ring.add(100.9, 3.0)
+        rows = ring.points(0.0)
+        assert rows == [[100.0, 1.0, 5.0, 3.0, 9.0, 3]]
+
+    def test_stale_slot_is_overwritten_not_merged(self):
+        ring = obs_history._Ring(1.0, 4)
+        ring.add(10.0, 1.0)  # bucket 10 -> slot 2
+        ring.add(14.0, 2.0)  # bucket 14 -> slot 2 again: retention wrap
+        rows = ring.points(0.0)
+        assert rows == [[14.0, 2.0, 2.0, 2.0, 2.0, 1]]
+
+    def test_points_filter_and_order(self):
+        ring = obs_history._Ring(1.0, 16)
+        for t in (5.5, 3.2, 7.9):
+            ring.add(t, t)
+        rows = ring.points(4.0)
+        assert [r[0] for r in rows] == [5.0, 7.0]
+
+    def test_spike_survives_downsample_to_60s_via_max(self):
+        """One 1-second spike inside a quiet minute: the 60s ring's mean
+        barely moves, but its max column still shows the spike and last
+        shows the closing value — the downsample contract."""
+        series = obs_history.Series("s", "gauge", obs_history.LEVELS)
+        t0 = 6000.0  # 60s-aligned: the whole minute lands in one bucket
+        for i in range(60):
+            series.add(t0 + i, 250.0 if i == 17 else 1.0)
+        coarse = series.rings[2].points(0.0)
+        assert len(coarse) == 1
+        _ts, vmin, vmax, vlast, vsum, count = coarse[0]
+        assert vmax == 250.0 and vmin == 1.0 and vlast == 1.0
+        assert count == 60 and vsum == 59 * 1.0 + 250.0
+        # The 1s ring still holds the spike bucket exactly.
+        fine = series.rings[0].points(t0 + 17)
+        assert fine[0][:4] == [t0 + 17, 250.0, 250.0, 250.0]
+
+
+class _FakeRegistry:
+    """A registry stand-in: ``sample_values()`` rows are scripted per
+    sweep so restart semantics are testable without forking."""
+
+    def __init__(self):
+        self.rows = []
+
+    def sample_values(self):
+        return list(self.rows)
+
+
+class TestSeriesStore:
+    def test_query_picks_finest_covering_level(self):
+        store = obs_history.SeriesStore()
+        now = 10_000.0
+        for dt in range(6):
+            store.observe("g", "gauge", float(dt), now=now - dt)
+        assert store.query(series="g", since=60, now=now)["step_s"] == 1.0
+        assert store.query(series="g", since=2000, now=now)["step_s"] == 10.0
+        assert store.query(series="g", since=20000, now=now)["step_s"] == 60.0
+        assert store.query(series="g", level=60.0, now=now)["step_s"] == 60.0
+        with pytest.raises(ValueError, match="unknown history level"):
+            store.query(series="g", level=5, now=now)
+
+    def test_absolute_since_timestamp(self):
+        store = obs_history.SeriesStore()
+        t0 = 2_000_000_000.0
+        store.observe("g", "gauge", 1.0, now=t0)
+        store.observe("g", "gauge", 2.0, now=t0 + 100)
+        doc = store.query(series="g", since=t0 + 50, now=t0 + 101)
+        assert [r[0] for r in doc["series"]["g"]["points"]] == [t0 + 100]
+
+    def test_counter_rate_derivation_survives_restart(self):
+        """A counter dropping below its predecessor is a process restart:
+        the new value IS the delta, the rate never goes negative."""
+        store = obs_history.SeriesStore()
+        fake = _FakeRegistry()
+        t0 = 5_000.0
+        for dt, value in ((0, 10.0), (1, 16.0), (2, 4.0)):
+            fake.rows = [("ts_fake_total", "counter", (), value)]
+            store.sample(registry=fake, now=t0 + dt)
+        doc = store.query(series="ts_fake_total:rate", level=0, now=t0 + 3)
+        points = doc["series"]["ts_fake_total:rate"]["points"]
+        assert [(r[0], r[3]) for r in points] == [(t0 + 1, 6.0), (t0 + 2, 4.0)]
+        assert all(r[3] >= 0 for r in points)
+        # The raw cumulative series is retained alongside.
+        raw = store.query(series="ts_fake_total", level=0, now=t0 + 3)
+        assert len(raw["series"]["ts_fake_total"]["points"]) == 3
+
+    def test_max_series_cap_drops_never_allocates(self):
+        store = obs_history.SeriesStore(max_series=4)
+        for i in range(6):
+            store.observe(f"g{i}", "gauge", 1.0, now=1000.0)
+        assert len(store) == 4
+        assert store._dropped == {"g4", "g5"}
+
+    def test_disabled_store_samples_nothing(self):
+        store = obs_history.SeriesStore()
+        store.set_enabled(False)
+        fake = _FakeRegistry()
+        fake.rows = [("ts_fake_total", "counter", (), 1.0)]
+        assert store.sample(registry=fake, now=1.0) == 0.0
+        assert len(store) == 0
+
+
+class TestMergeHelpers:
+    def test_series_matches_bare_name_covers_labeled_variants(self):
+        assert obs_history.series_matches("ts_x", ("ts_x",))
+        assert obs_history.series_matches('ts_x{v="1"}', ("ts_x",))
+        assert not obs_history.series_matches("ts_xy", ("ts_x",))
+        assert obs_history.series_matches("ts_xy", ("ts_x*",))
+        assert obs_history.series_matches('ts_x{v="1"}', ('ts_x{v="1"}',))
+        assert not obs_history.series_matches('ts_x{v="2"}', ('ts_x{v="1"}',))
+
+    def test_merge_points_sum_and_max(self):
+        a = [[0.0, 1.0, 2.0, 1.5, 3.0, 2]]
+        b = [[0.0, 0.5, 4.0, 1.0, 5.0, 4], [1.0, 9.0, 9.0, 9.0, 9.0, 1]]
+        summed = obs_history.merge_points([a, b], how="sum")
+        assert summed == [
+            [0.0, 1.5, 6.0, 2.5, 8.0, 6],
+            [1.0, 9.0, 9.0, 9.0, 9.0, 1],
+        ]
+        worst = obs_history.merge_points([a, b], how="max")
+        assert worst[0] == [0.0, 0.5, 4.0, 1.5, 5.0, 4]
+        with pytest.raises(ValueError, match="merge_points"):
+            obs_history.merge_points([a], how="avg")
+
+    def test_counter_rate_points_skip_first_and_restart(self):
+        rows = [
+            [0.0, 10.0, 10.0, 10.0, 10.0, 1],
+            [1.0, 16.0, 16.0, 16.0, 16.0, 1],
+            [3.0, 4.0, 4.0, 4.0, 4.0, 1],  # restart: 4 < 16, gap of 2s
+        ]
+        assert obs_history.counter_rate_points(rows) == [
+            [1.0, 6.0],
+            [3.0, 2.0],
+        ]
+
+
+# --------------------------------------------------------------------------
+# detectors (pure functions, injected clocks)
+# --------------------------------------------------------------------------
+
+
+def _rows(vals, t0=0.0, step=1.0):
+    return [
+        [t0 + i * step, v, v, v, v, 1] for i, v in enumerate(vals)
+    ]
+
+
+class TestDetectors:
+    def test_sustained_counts_trailing_run_only(self):
+        result = obs_detect.sustained(
+            _rows([5, 5, 0, 5, 5]), threshold=1.0, min_samples=3
+        )
+        assert not result["active"] and result["samples"] == 2
+        result = obs_detect.sustained(
+            _rows([5, 5, 0, 5, 5]), threshold=1.0, min_samples=2
+        )
+        assert result["active"]
+        assert result["since_ts"] == 3.0 and result["duration_s"] == 1.0
+        # Latest bucket under threshold: run resets to zero.
+        result = obs_detect.sustained(
+            _rows([5, 5, 0]), threshold=1.0, min_samples=1
+        )
+        assert not result["active"] and result["samples"] == 0
+
+    def test_ewma_drift_fires_on_jump_and_clamps_flat_baseline(self):
+        quiet = _rows([1.0] * 20)
+        assert not obs_detect.ewma_drift(quiet, z=3.0)["active"]
+        jump = _rows([1.0] * 20 + [100.0])
+        result = obs_detect.ewma_drift(jump, z=3.0)
+        # Zero-variance baseline: clamped to MAX_Z, never Infinity.
+        assert result["active"] and result["z"] == obs_detect.MAX_Z
+        short = obs_detect.ewma_drift(_rows([1.0, 99.0]), min_samples=8)
+        assert not short["active"] and short["samples"] == 2
+
+    def test_ramp_least_squares_slope(self):
+        rising = _rows([2.0 * i for i in range(10)])
+        result = obs_detect.ramp(rising, min_slope=1.0)
+        assert result["active"] and result["slope"] == pytest.approx(2.0)
+        assert not obs_detect.ramp(rising, min_slope=0.0)["active"]
+        flat = obs_detect.ramp(_rows([7.0] * 10), min_slope=1.0)
+        assert not flat["active"] and flat["slope"] == pytest.approx(0.0)
+
+    def test_evaluate_detector_rejects_unknown_kind(self):
+        det = obs_detect.Detector(name="x", series="ts_landing_inflight", kind="wat")
+        with pytest.raises(ValueError, match="unknown detector kind"):
+            obs_detect.evaluate_detector(det, [])
+
+    def test_evaluate_trends_worst_labeled_series_wins(self):
+        store = obs_history.SeriesStore()
+        now = 50_000.0
+        for dt in range(10):
+            store.observe(
+                'ts_landing_inflight{volume="v0"}', "gauge", 40.0, now=now - dt
+            )
+            store.observe(
+                'ts_landing_inflight{volume="v1"}', "gauge", 0.0, now=now - dt
+            )
+        dets = (
+            obs_detect.Detector(
+                name="landing_inflight_sustained",
+                series="ts_landing_inflight",
+                kind="sustained",
+                threshold=16.0,
+                min_samples=5,
+            ),
+        )
+        trends = obs_detect.evaluate_trends(store=store, detectors=dets, now=now)
+        result = trends["landing_inflight_sustained"]
+        assert result["active"] and result["kind"] == "sustained"
+        assert result["series"] == 'ts_landing_inflight{volume="v0"}'
+        assert obs_detect.active_sustained(trends) == {
+            "landing_inflight_sustained": result
+        }
+        # An inactive result never makes the control-plane subset.
+        assert obs_detect.active_sustained(
+            {"a": {"active": False, "kind": "sustained"}}
+        ) == {}
+
+
+# --------------------------------------------------------------------------
+# ts-top pure renderers
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ts_top():
+    return _load_script("ts_top")
+
+
+class TestTsTopRender:
+    def test_spark_scales_and_survives_empty(self, ts_top):
+        assert ts_top.spark([]) == "(no data)"
+        line = ts_top.spark([0.0, 5.0, 10.0])
+        assert len(line) == 3 and line[0] != line[2]
+        assert len(set(ts_top.spark([3.0, 3.0, 3.0]))) == 1
+
+    def test_trend_arrow_marks(self, ts_top):
+        assert ts_top.trend_arrow({}) == "="
+        arrow = ts_top.trend_arrow(
+            {
+                "a": {"kind": "sustained", "active": True},
+                "b": {"kind": "ramp", "active": True},
+                "c": {"kind": "drift", "active": False},
+            }
+        )
+        assert arrow == "".join(sorted("!^"))
+
+    def test_fleet_rate_and_gauge_series_fold_processes(self, ts_top):
+        doc = {
+            "processes": {
+                "client": {
+                    "series": {
+                        'ts_client_ops_total{op="put"}': {
+                            "kind": "counter",
+                            "points": _rows([0.0, 10.0, 30.0]),
+                        },
+                        'ts_op_p99_seconds{op="get"}': {
+                            "kind": "gauge",
+                            "points": _rows([0.010, 0.020, 0.015]),
+                        },
+                    }
+                },
+                "volume:v0": {
+                    "series": {
+                        'ts_client_ops_total{op="put"}': {
+                            "kind": "counter",
+                            "points": _rows([0.0, 5.0, 5.0]),
+                        },
+                        'ts_op_p99_seconds{op="get"}': {
+                            "kind": "gauge",
+                            "points": _rows([0.040, 0.001, 0.001]),
+                        },
+                    }
+                },
+            }
+        }
+        ops = ts_top.fleet_rate_series(doc, "ts_client_ops_total")
+        assert ops == [[1.0, 15.0], [2.0, 20.0]]
+        p99 = ts_top.fleet_gauge_series(doc, 'ts_op_p99_seconds{op="get"}')
+        assert p99 == [[0.0, 0.040], [1.0, 0.020], [2.0, 0.015]]
+
+    def test_render_frame_full_and_empty(self, ts_top):
+        data = {
+            "source": "store:unit",
+            "generated_ts": 1_700_000_000.0,
+            "history": {
+                "processes": {
+                    "client": {
+                        "series": {
+                            "ts_client_ops_total": {
+                                "kind": "counter",
+                                "points": _rows([0.0, 4.0, 12.0]),
+                            }
+                        }
+                    }
+                },
+                "errors": {"volume:v1": "ActorDiedError"},
+            },
+            "slo": {
+                "slos": {
+                    "get_p99_ms": {
+                        "threshold": 50.0,
+                        "current": 75.0,
+                        "violated": True,
+                        "violations": 3,
+                    }
+                },
+                "trends": {
+                    "landing_inflight_sustained": {
+                        "kind": "sustained",
+                        "active": True,
+                        "series": 'ts_landing_inflight{volume="v0"}',
+                        "duration_s": 12.0,
+                    }
+                },
+            },
+            "overload": {
+                "volumes": {
+                    "v0": {
+                        "landing_inflight": 9,
+                        "doorbell_plans": 2,
+                        "window_ops": 100,
+                        "trends": {
+                            "landing_inflight_sustained": {
+                                "kind": "sustained",
+                                "active": True,
+                            }
+                        },
+                    }
+                }
+            },
+            "plan": {
+                "actions": [
+                    {"kind": "migrate", "subject": "k", "reason": "hot"}
+                ],
+                "snapshot": {
+                    "sustained_overload": {
+                        "v0": ["landing_inflight_sustained"]
+                    }
+                },
+            },
+            "events": [{"ts": 1.0, "kind": "fault", "name": "shm.landing"}],
+        }
+        frame = ts_top.render_frame(data)
+        assert "ts-top — store:unit" in frame
+        assert "ops/s" in frame and "get p99" in frame
+        assert "VIOLATED" in frame
+        assert "trend ! landing_inflight_sustained" in frame
+        assert "v0" in frame and "[!]" in frame
+        assert "sustained_overload v0: landing_inflight_sustained" in frame
+        assert "plan migrate k" in frame
+        assert "[fault] shm.landing" in frame
+        assert "unreachable: volume:v1" in frame
+        # Every section optional: an empty frame still renders.
+        assert ts_top.render_frame({}).startswith("ts-top")
+
+
+# --------------------------------------------------------------------------
+# fleet surfaces
+# --------------------------------------------------------------------------
+
+
+class TestLocalSurfaces:
+    def test_history_json_http_roundtrip(self, monkeypatch):
+        """/history.json serves the same rings SeriesStore.query does,
+        with series/since/level query params honored."""
+        # A long pytest session can fill the process-global store to its
+        # series cap; this test's series must not be the one dropped.
+        monkeypatch.setenv(obs_history.ENV_HISTORY_MAX_SERIES, "100000")
+        store = obs_history.series_store()
+        sid = "ts_hist_rt_gauge"
+        store.observe(sid, "gauge", 7.0)
+        exp = http_exporter.start_http_exporter(0, host="127.0.0.1")
+        try:
+            base = f"http://127.0.0.1:{exp.port}"
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/history.json?series={sid},ts_none*&since=300"
+                    "&level=0",
+                    timeout=10,
+                ).read()
+            )
+            assert doc["step_s"] == 1.0
+            local = store.query(series=sid, since=300, level=0)
+            assert doc["series"][sid]["points"] == local["series"][sid]["points"]
+            assert doc["series"][sid]["points"][-1][3] == 7.0
+        finally:
+            exp.close()
+
+    def test_flight_dump_embeds_history_vitals(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHSTORE_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv(obs_history.ENV_HISTORY_MAX_SERIES, "100000")
+        sid = 'ts_landing_inflight{volume="hist_fr"}'
+        obs_history.series_store().observe(sid, "gauge", 11.0)
+        rec = obs_recorder.FlightRecorder(maxlen=8)
+        rec.record("fault", "unit.history")
+        path = rec.dump("unit:history")
+        assert path and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        # The curated DEFAULT_DUMP_SERIES vitals ride every post-mortem.
+        assert sid in doc["history"]["series"]
+        assert doc["history"]["series"][sid]["points"][-1][3] == 11.0
+
+
+@pytest.mark.anyio
+async def test_fleet_history_merges_and_tolerates_dead_volume():
+    """ts.history() collects client + controller + every volume's rings;
+    a dead volume lands in errors, never fails the scrape."""
+    from torchstore_tpu.runtime import ActorDiedError
+
+    await ts.initialize(store_name="hist_dead", num_storage_volumes=2)
+    try:
+        await ts.put(
+            "hist/k", np.ones(64, np.float32), store_name="hist_dead"
+        )
+        # Give every process at least one sampler sweep.
+        await asyncio.sleep(1.5)
+        doc = await ts.history(store_name="hist_dead")
+        assert "client" in doc["processes"]
+        assert "controller" in doc["processes"]
+        volumes = [k for k in doc["processes"] if k.startswith("volume:")]
+        assert len(volumes) == 2, doc["processes"].keys()
+        client_doc = doc["processes"]["client"]
+        assert client_doc["levels"] == [list(lv) for lv in obs_history.LEVELS]
+        handle = ts.api._stores["hist_dead"]
+        victim = handle.volume_mesh._processes[0]
+        victim.terminate()
+        victim.join(10.0)
+        doc = await ts.history(store_name="hist_dead")
+        assert len(doc["errors"]) == 1, doc["errors"]
+        assert "client" in doc["processes"]
+    finally:
+        try:
+            await ts.shutdown("hist_dead")
+        except (ActorDiedError, Exception):
+            pass
+
+
+@pytest.mark.anyio
+async def test_sustained_overload_fires_before_slo_gate(monkeypatch):
+    """ISSUE 17 acceptance: under an induced ``shm.landing_stamp`` delay
+    ramp the sustained-overload detector fires in
+    ``slo_report()["trends"]`` AND reaches ``ts.control_plan()``'s
+    snapshot while every instantaneous SLO gate is still green — the
+    burst-vs-regime-change distinction the detectors exist for."""
+    monkeypatch.setenv("TORCHSTORE_TPU_HISTORY_INTERVAL_S", "0.1")
+    monkeypatch.setenv("TORCHSTORE_TPU_TREND_INFLIGHT", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_TREND_SUSTAIN_SAMPLES", "2")
+    # Instantaneous gates parked far away: nothing may trip them.
+    monkeypatch.setenv("TORCHSTORE_TPU_SLO_PUT_P99_MS", "60000")
+    monkeypatch.setenv("TORCHSTORE_TPU_SLO_GET_P99_MS", "60000")
+    await ts.initialize(
+        store_name="hist_sus",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    stop = asyncio.Event()
+
+    async def hammer(key, arr):
+        while not stop.is_set():
+            await ts.put(key, arr, store_name="hist_sus")
+
+    tasks = []
+    try:
+        arrs = {
+            f"sus/{i}": np.random.rand(4096).astype(np.float32)
+            for i in range(3)
+        }
+        for key, arr in arrs.items():
+            await ts.put(key, arr, store_name="hist_sus")
+        # Every put holds its landing bracket an extra 250ms: inflight
+        # stays pinned >= 1 — a held regime, not a burst.
+        await ts.inject_fault(
+            "shm.landing_stamp", "delay", delay_ms=250, store_name="hist_sus"
+        )
+        tasks = [
+            asyncio.create_task(hammer(k, a)) for k, a in arrs.items()
+        ]
+        fired = None
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            report = await ts.slo_report(store_name="hist_sus")
+            active = {
+                name: result
+                for name, result in (report.get("trends") or {}).items()
+                if "landing_inflight_sustained" in name
+                and result.get("active")
+            }
+            if active:
+                fired = (report, active)
+                break
+            await asyncio.sleep(0.3)
+        assert fired is not None, "sustained detector never fired"
+        report, active = fired
+        # The detector beat the instantaneous gates: both parked SLOs are
+        # green at the moment the trend is already active.
+        for name in ("put_p99_ms", "get_p99_ms"):
+            assert not report["slos"][name]["violated"], report["slos"][name]
+        # Volume-side detections surface with their process key.
+        assert any(name.startswith("volume:") for name in active), active
+        # ... and the SAME signal reaches the control plane's snapshot.
+        plan = await ts.control_plan(store_name="hist_sus")
+        sustained = plan["snapshot"]["sustained_overload"]
+        assert sustained, plan["snapshot"]
+        assert any(
+            "landing_inflight_sustained" in dets
+            for dets in sustained.values()
+        ), sustained
+    finally:
+        stop.set()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        try:
+            await ts.clear_faults(store_name="hist_sus")
+        finally:
+            await ts.shutdown("hist_sus")
+
+
+@pytest.mark.anyio
+async def test_ts_top_renders_live_frames_both_attach_modes(ts_top):
+    """One real frame per attach mode: --store (fleet view) and --url
+    (single-process exporter view)."""
+    await ts.initialize(
+        store_name="hist_top",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        arr = np.random.rand(1024).astype(np.float32)
+        await ts.put("top/k", arr, store_name="hist_top")
+        out = await ts.get("top/k", store_name="hist_top")
+        np.testing.assert_array_equal(out, arr)
+        await asyncio.sleep(1.2)  # one sampler sweep so sparklines have data
+        data = await ts_top.collect_store("hist_top")
+        frame = ts_top.render_frame(data)
+        assert "ts-top — store:hist_top" in frame
+        assert "ops/s" in frame and "SLOs" in frame
+        exp = http_exporter.start_http_exporter(0, host="127.0.0.1")
+        try:
+            data = ts_top.collect_url(f"http://127.0.0.1:{exp.port}")
+            frame = ts_top.render_frame(data)
+            assert f"127.0.0.1:{exp.port}" in frame
+            assert "ops/s" in frame
+        finally:
+            exp.close()
+    finally:
+        await ts.shutdown("hist_top")
+
+
+@pytest.mark.anyio
+async def test_capture_telemetry_doc_includes_history():
+    """The capture_telemetry doc (what --watch appends per line) carries
+    the history plane next to traffic/slo/control_plan."""
+    mod = _load_script("capture_telemetry")
+    await ts.initialize(
+        store_name="telemetry_capture",
+        strategy=ts.SingletonStrategy(default_transport_type="shm"),
+    )
+    try:
+        await ts.put(
+            "cap/k", np.ones(256, np.float32), store_name="telemetry_capture"
+        )
+        doc = await mod._capture(ts, include_record=False)
+        assert set(doc) >= {"captured_ts", "traffic", "slo", "control_plan", "history"}
+        assert "client" in doc["history"]["processes"]
+        json.dumps(doc)  # the JSONL line must serialize
+    finally:
+        await ts.shutdown("telemetry_capture")

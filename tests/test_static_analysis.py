@@ -39,6 +39,7 @@ from torchstore_tpu.analysis.checkers import (  # noqa: E402
     endpoint_drift,
     env_registry,
     fork_safety,
+    history_discipline,
     landing_copy,
     metric_discipline,
     orphan_task,
@@ -470,6 +471,52 @@ def test_metric_docs_table_drift(tmp_path):
     )
     assert _msgs(metric_discipline.check(proj)) == []
     assert "ts_docs_total" in fresh_table and "counted things" in fresh_table
+
+
+# --------------------------------------------------------------------------
+# history-discipline
+# --------------------------------------------------------------------------
+
+
+def test_history_discipline_rules(tmp_path):
+    """Detector series selectors: literal + registered passes (including
+    ``:rate`` derivations, label globs, and histogram ``_count`` series);
+    a non-literal selector, a glob in the NAME part, and an unregistered
+    name are each a finding."""
+    proj = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/metrics_def.py": """
+                from torchstore_tpu.observability import metrics as m
+                _G = m.gauge("ts_landing_inflight", "open landing brackets")
+                _C = m.counter("ts_client_ops_total", "client ops")
+                _H = m.histogram("ts_op_seconds", "op latency")
+                """,
+            "torchstore_tpu/dets.py": """
+                from torchstore_tpu.observability.detect import Detector
+
+                SELECTOR = "ts_landing_inflight"
+
+                GOOD = (
+                    Detector(name="a", series="ts_landing_inflight", kind="sustained"),
+                    Detector("b", 'ts_client_ops_total:rate{op="put"}', "ramp"),
+                    Detector(name="c", series="ts_op_seconds_count", kind="drift"),
+                    Detector(name="d", series='ts_landing_inflight{volume="*"}', kind="ramp"),
+                    Detector(name="e", series="ts_landing_inflight*", kind="ramp"),
+                )
+                BAD_NONLITERAL = Detector(name="f", series=SELECTOR, kind="sustained")
+                BAD_GLOB = Detector(name="g", series="ts_*_inflight", kind="sustained")
+                BAD_UNREGISTERED = Detector(name="h", series="ts_gone_gauge", kind="drift")
+                """,
+        },
+    )
+    msgs = _msgs(history_discipline.check(proj))
+    assert any("non-literal" in m for m in msgs), msgs
+    assert any("globs the" in m and "ts_*_inflight" in m for m in msgs), msgs
+    assert any(
+        "does not resolve" in m and "ts_gone_gauge" in m for m in msgs
+    ), msgs
+    assert len(msgs) == 3, msgs
 
 
 # --------------------------------------------------------------------------

@@ -25,6 +25,7 @@ from torchstore_tpu.api import (
     get,
     get_batch,
     direct_staging_buffers,
+    history,
     get_state_dict,
     get_state_dict_streamed,
     state_dict_stream,
@@ -61,6 +62,7 @@ from torchstore_tpu.config import StoreConfig
 from torchstore_tpu.logging import init_logging
 from torchstore_tpu.observability import (
     maybe_start_dumper,
+    maybe_start_history,
     maybe_start_http_exporter,
     span,
 )
@@ -81,6 +83,10 @@ init_logging()
 # back to an ephemeral port, published via the ts_metrics_http_port gauge).
 maybe_start_dumper()
 maybe_start_http_exporter()
+# ... and its 1 Hz time-series history sampler (TORCHSTORE_TPU_HISTORY,
+# default on; bounded rings, ~1% CPU budget) so every process can answer
+# "what did this look like five minutes ago" without external scrapers.
+maybe_start_history()
 
 __version__ = "0.1.0"
 
@@ -114,6 +120,7 @@ __all__ = [
     "get",
     "get_batch",
     "get_state_dict",
+    "history",
     "get_state_dict_streamed",
     "state_dict_stream",
     "initialize",

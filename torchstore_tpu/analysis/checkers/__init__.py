@@ -15,6 +15,7 @@ from torchstore_tpu.analysis.checkers import (
     endpoint_drift,
     env_registry,
     fork_safety,
+    history_discipline,
     landing_copy,
     metric_discipline,
     one_sided,
@@ -42,4 +43,5 @@ CHECKERS = {
     shard_discipline.RULE: shard_discipline.check,
     stage_discipline.RULE: stage_discipline.check,
     control_discipline.RULE: control_discipline.check,
+    history_discipline.RULE: history_discipline.check,
 }

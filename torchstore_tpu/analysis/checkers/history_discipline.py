@@ -1,0 +1,145 @@
+"""history-discipline: trend detectors must name a registered series.
+
+A :class:`~torchstore_tpu.observability.detect.Detector` is bound to its
+input by a series selector STRING (``"ts_landing_inflight"``,
+``'ts_op_p99_seconds{op="get"}'``). Nothing at runtime ties that string to
+the instrument registry: rename the metric and the detector silently goes
+blind — ``evaluate_trends()`` finds no matching series, reports
+``active: False`` forever, and the control plane's sustained-overload
+signal dies without a single error. That is the worst possible failure
+mode for an alerting layer.
+
+Rule: every ``Detector(...)`` construction must pass ``series`` as a
+STRING LITERAL whose instrument name resolves against the registration
+scan that already powers ``--regen-metric-docs``
+(``metric_discipline.collect_sites``):
+
+- the name part (selector minus any ``{label}`` suffix, ``:rate``
+  derivation, and trailing ``*``) must be a registered instrument — or a
+  histogram's derived ``_count``/``_sum``/``_bucket`` series of one;
+- a remaining glob in the NAME part defeats static verification and is
+  flagged (glob the labels, not the name);
+- a non-literal ``series`` argument is flagged for the same reason the
+  stage catalog is enforced statically: drift must be caught in review,
+  not discovered as a detector that never fires.
+
+``observability/detect.py`` itself is NOT exempt — the stock catalog is
+exactly what this rule must keep honest.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, dotted_name
+from torchstore_tpu.analysis.checkers import metric_discipline
+
+RULE = "history-discipline"
+
+# Histogram registrations surface as derived series under these suffixes
+# (metrics.sample_values samples <name>_count; Prometheus renderers emit
+# _sum/_bucket too).
+_DERIVED_SUFFIXES = ("_count", "_sum", "_bucket")
+
+
+def _series_arg(call: ast.Call) -> ast.expr | None:
+    """The ``series`` argument of a Detector(name, series, kind, ...)."""
+    for kw in call.keywords:
+        if kw.arg == "series":
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _base_name(selector: str) -> str:
+    """Selector -> the instrument name it must resolve to."""
+    base = selector.split("{", 1)[0]
+    if base.endswith(":rate"):
+        base = base[: -len(":rate")]
+    while base.endswith("*"):
+        base = base[:-1]
+    return base
+
+
+def _resolves(base: str, registered: set[str]) -> bool:
+    if base in registered:
+        return True
+    for suffix in _DERIVED_SUFFIXES:
+        if base.endswith(suffix) and base[: -len(suffix)] in registered:
+            return True
+    return False
+
+
+def check(project: Project) -> list[Finding]:
+    registered = {
+        name
+        for _path, _line, name, _kind in metric_discipline.collect_sites(
+            project.root, project
+        )
+    }
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] != "Detector":
+                continue
+            series = _series_arg(node)
+            if series is None:
+                continue  # arity error: Python itself will fail louder
+            if not (
+                isinstance(series, ast.Constant)
+                and isinstance(series.value, str)
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            "Detector constructed with a non-literal "
+                            "series selector: the instrument binding is "
+                            "enforced statically — pass a registered "
+                            "metric name literal so a rename cannot "
+                            "silently orphan the detector"
+                        ),
+                    )
+                )
+                continue
+            base = _base_name(series.value)
+            if any(ch in base for ch in "*?["):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"Detector series {series.value!r} globs the "
+                            "instrument NAME — that defeats the static "
+                            "registered-name check (glob the label part, "
+                            "not the name)"
+                        ),
+                    )
+                )
+                continue
+            if not _resolves(base, registered):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"Detector series {series.value!r} does not "
+                            f"resolve to a registered instrument "
+                            f"({base!r} is not in the registration scan): "
+                            "a renamed or removed metric would leave this "
+                            "detector permanently quiet — bind it to a "
+                            "registered name"
+                        ),
+                    )
+                )
+    return findings

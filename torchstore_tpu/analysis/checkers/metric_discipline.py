@@ -85,6 +85,10 @@ ALLOWED_LABEL_KEYS = {
     # Metadata-plane inflight: one series per controller shard
     # ("coord"/"s<i>" — bounded by controller_shards, metadata/router.py).
     "shard",
+    # Trend plane: one series per detector in the stock catalog
+    # (observability/detect.py default_detectors — a closed, code-reviewed
+    # set; history-discipline pins each one to a registered instrument).
+    "detector",
 }
 
 

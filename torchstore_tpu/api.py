@@ -15,6 +15,7 @@ import asyncio
 import base64
 import os
 import pickle
+import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -854,6 +855,69 @@ async def flight_record(store_name: Optional[str] = DEFAULT_STORE) -> dict:
     return {"events": events, "errors": errors}
 
 
+async def history(
+    series: Optional[Any] = None,
+    since: Optional[float] = None,
+    store_name: Optional[str] = DEFAULT_STORE,
+) -> dict:
+    """Fleet time-series history: every process's retained metric rings.
+
+    Each torchstore process samples its own registry into bounded
+    multi-resolution rings (observability/history.py). This collects
+    them — this client's, the controller's, and every reachable
+    volume's, riding the ``stats()`` endpoints the way ledgers and
+    hot_keys do — without merging (label-identical series from different
+    processes are different series; ``observability.history.merge_points``
+    folds them when a consumer wants fleet totals).
+
+    ``series`` is a glob or list of globs over series ids
+    (``name{k="v"}``; a bare name also matches its labeled variants);
+    ``since`` is a lookback in seconds (default 300) or an absolute wall
+    timestamp. ``store_name=None`` returns the local view only.
+
+    Returns ``{"generated_ts", "processes": {"client" | "controller" |
+    "volume:<vid>": <SeriesStore.query() doc>}, "errors": {...}}`` —
+    unreachable processes land in ``errors``, never fail the scrape."""
+    from torchstore_tpu.observability import history as obs_history
+
+    request = {"series": series, "since": since}
+    doc: dict = {
+        "generated_ts": time.time(),
+        "processes": {
+            "client": obs_history.history(series=series, since=since)
+        },
+        "errors": {},
+    }
+    if store_name is None:
+        return doc
+    try:
+        c = client(store_name)
+        await c._ensure_setup()
+    except Exception as exc:  # noqa: BLE001 - no fleet: local view serves
+        doc["errors"]["fleet"] = f"{type(exc).__name__}: {exc}"
+        return doc
+    try:
+        stats = await c.controller.stats.call_one(history=request)
+        if stats.get("history"):
+            doc["processes"]["controller"] = stats["history"]
+    except Exception as exc:  # noqa: BLE001 - dead controller
+        doc["errors"]["controller"] = f"{type(exc).__name__}: {exc}"[:200]
+
+    async def scrape(vid: str) -> None:
+        try:
+            st = await c._volume_refs[vid].actor.stats.call_one(
+                history=request
+            )
+        except Exception as exc:  # noqa: BLE001 - dead volume: report it
+            doc["errors"][f"volume:{vid}"] = f"{type(exc).__name__}: {exc}"[:200]
+            return
+        if st.get("history"):
+            doc["processes"][f"volume:{vid}"] = st["history"]
+
+    await asyncio.gather(*(scrape(vid) for vid in sorted(c._volume_refs or {})))
+    return doc
+
+
 async def sync_timeline(
     key: str, store_name: str = DEFAULT_STORE
 ) -> Optional[dict]:
@@ -932,9 +996,20 @@ async def slo_report(store_name: Optional[str] = DEFAULT_STORE) -> dict:
         # p99 here rivaling the client's put.transport p99.
         if st.get("stages"):
             entry["stages"] = st["stages"]
+        if st.get("trends"):
+            entry["trends"] = st["trends"]
         overload["volumes"][vid] = entry
 
     await asyncio.gather(*(scrape(vid) for vid in sorted(c._volume_refs or {})))
+    # Active volume-side trends surface at top level next to the client's
+    # own (report["trends"], from timeline.slo_report) so "which process
+    # is in a regime change" needs no drill-down: keys are
+    # volume:<vid>:<detector>.
+    trends = report.setdefault("trends", {})
+    for vid, entry in overload["volumes"].items():
+        for name, result in (entry.get("trends") or {}).items():
+            if result.get("active"):
+                trends[f"volume:{vid}:{name}"] = result
     return report
 
 

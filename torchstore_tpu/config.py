@@ -340,6 +340,39 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "fault storm at most one post-mortem per kind per this many "
            "seconds is written (the rest are counted in "
            "ts_flight_dumps_dropped_total). 0 disables the limit."),
+    EnvVar("TORCHSTORE_TPU_HISTORY", "bool", True,
+           "Time-series history: a background sampler sweeps every "
+           "registry instrument into bounded multi-resolution rings "
+           "(1s x 300 / 10s x 360 / 60s x 360, min/max/last per bucket; "
+           "counters also derive :rate series), queried locally via "
+           "observability.history() and fleet-wide via ts.history()."),
+    EnvVar("TORCHSTORE_TPU_HISTORY_INTERVAL_S", "float", 1,
+           "History sampling period, seconds. The measured sweep cost "
+           "may stretch the effective period (see "
+           "TORCHSTORE_TPU_HISTORY_BUDGET_PCT)."),
+    EnvVar("TORCHSTORE_TPU_HISTORY_MAX_SERIES", "int", 256,
+           "Hard cap on distinct series a process's history store will "
+           "track; overflow series are counted in "
+           "ts_history_series_dropped_total, never allocated."),
+    EnvVar("TORCHSTORE_TPU_HISTORY_BUDGET_PCT", "float", 1,
+           "CPU budget for the history sampler as a percent of one core: "
+           "the effective interval is raised to sweep_cost / budget so "
+           "sampling can never exceed this fraction, however many series "
+           "the registry grows."),
+    EnvVar("TORCHSTORE_TPU_HISTORY_DUMP_SERIES", "str", None,
+           "Comma-separated series globs embedded in flight-recorder "
+           "post-mortems (default: a curated vitals set — op quantiles, "
+           "landing inflight, client op counters, doorbell residency, "
+           "metadata queue depth, SLO breach counts)."),
+    EnvVar("TORCHSTORE_TPU_TREND_SUSTAIN_SAMPLES", "int", 5,
+           "Consecutive history samples at/over threshold before a "
+           "sustained-kind trend detector fires (burst vs regime-change "
+           "discrimination for slo_report()['trends'] and the control "
+           "snapshot's sustained_overload signal)."),
+    EnvVar("TORCHSTORE_TPU_TREND_INFLIGHT", "int", None,
+           "Landing-inflight threshold for the sustained/ramp trend "
+           "detectors (default: TORCHSTORE_TPU_CONTROL_OVERLOAD_INFLIGHT "
+           "— 'the solver's own overload line, held')."),
     # --- SLOs (TORCHSTORE_TPU_SLO_* is a registered dynamic family:
     # operators may add their own; these are the shipped, wired-up bars.
     # Unset = disabled; breaches log + count ts_slo_violations_total) ----

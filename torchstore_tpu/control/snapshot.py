@@ -71,6 +71,14 @@ class TelemetrySnapshot:
     meta_inflight: Mapping[str, int] = field(default_factory=dict)
     n_shards: int = 1
     relays: tuple[RelayView, ...] = ()
+    # Trend-detector verdicts (observability/detect.py): volume id ->
+    # {detector_name: result} for volumes whose SUSTAINED-kind detectors
+    # are currently firing — "this is a regime change, not a burst". The
+    # solver relaxes its migration hysteresis for exactly these volumes;
+    # an empty mapping (no history plane, all quiet) changes nothing.
+    sustained_overload: Mapping[str, Mapping[str, Any]] = field(
+        default_factory=dict
+    )
 
     def total_window_bytes(self) -> int:
         return sum(v.window_bytes for v in self.volumes.values())
@@ -88,6 +96,9 @@ class TelemetrySnapshot:
             ],
             "meta_inflight": dict(self.meta_inflight),
             "n_shards": self.n_shards,
+            "sustained_overload": {
+                vid: sorted(dets) for vid, dets in self.sustained_overload.items()
+            },
         }
 
 
@@ -128,9 +139,16 @@ def build_snapshot(
     placement = dict(placement or {})
     vols: dict[str, VolumeLoad] = {}
     key_bytes: dict[str, list[int]] = {}  # key -> [ops, bytes]
+    sustained: dict[str, dict[str, Any]] = {}
+
+    def _fold_sustained(vid: str, trends: Optional[Mapping[str, Any]]) -> None:
+        for name, result in (trends or {}).items():
+            if result.get("active") and result.get("kind") == "sustained":
+                sustained.setdefault(vid, {})[name] = dict(result)
 
     for vid, st in (volume_stats or {}).items():
         st = st or {}
+        _fold_sustained(vid, st.get("trends"))
         ledger = st.get("ledger") or {}
         window = ledger.get("window") or {}
         over = st.get("overload") or {}
@@ -155,6 +173,7 @@ def build_snapshot(
     # view (it already folded ledger windows fleet-side).
     over_volumes = (overload or {}).get("volumes") or {}
     for vid, entry in over_volumes.items():
+        _fold_sustained(vid, entry.get("trends"))
         base = vols.get(vid) or VolumeLoad(
             volume_id=vid, host=placement.get(vid, "")
         )
@@ -220,4 +239,5 @@ def build_snapshot(
         meta_inflight=meta_inflight,
         n_shards=max(1, int(n_shards)),
         relays=relay_views,
+        sustained_overload=sustained,
     )

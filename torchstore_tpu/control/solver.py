@@ -205,8 +205,18 @@ def _solve_migrations(
     hot = max(loads, key=lambda v: v.window_bytes)
     if hot.window_bytes < policy.min_window_bytes:
         return []
-    # Hysteresis enter threshold; between settle and overload: no-op.
-    if hot.window_bytes < policy.overload_ratio * max(mean, 1.0):
+    # Hysteresis enter threshold; between settle and overload: no-op —
+    # UNLESS the trend plane says this volume's overload is sustained
+    # (observability/detect.py via snapshot.sustained_overload): a held
+    # regime change enters at the EXIT threshold instead, because the
+    # hysteresis band exists to ignore bursts and this is provably not
+    # one. A volume merely spiking still needs the full overload_ratio.
+    enter = (
+        policy.settle_ratio
+        if hot.volume_id in snapshot.sustained_overload
+        else policy.overload_ratio
+    )
+    if hot.window_bytes < enter * max(mean, 1.0):
         return []
     target = _pick_target(snapshot, hot)
     if target is None or target.window_bytes >= hot.window_bytes:
@@ -237,7 +247,12 @@ def _solve_migrations(
                 subject=stat.key,
                 reason=(
                     f"volume {hot.volume_id} window {hot.window_bytes}B >= "
-                    f"{policy.overload_ratio:g}x fleet mean {mean:.0f}B"
+                    f"{enter:g}x fleet mean {mean:.0f}B"
+                    + (
+                        " (sustained overload)"
+                        if hot.volume_id in snapshot.sustained_overload
+                        else ""
+                    )
                 ),
                 src_volume=hot.volume_id,
                 dst_volume=target.volume_id,

@@ -2413,11 +2413,19 @@ class Controller(Actor):
         return count
 
     @endpoint
-    async def stats(self, include_volumes: bool = False) -> dict:
+    async def stats(
+        self,
+        include_volumes: bool = False,
+        history: Optional[dict] = None,
+    ) -> dict:
         """Store-level observability: counters + index summary.
         ``include_volumes=True`` additionally fans out to every volume for
         its data-plane view (entries, stored bytes, SHM segment economics);
-        unreachable volumes report an ``error`` string instead."""
+        unreachable volumes report an ``error`` string instead.
+        ``history={"series": ..., "since": ...}`` embeds this process's
+        retained time-series rings under ``"history"`` and forwards the
+        request to any volume fan-out (ts.history() rides this; routine
+        scrapes omit it)."""
         # Index rollup (op counters, key/byte totals, pending reclaims)
         # comes from the authority — summed across shards when sharded.
         summary = await self.idx.summary()
@@ -2437,13 +2445,22 @@ class Controller(Actor):
             # process-local, so remote clients reach these through stats().
             "metrics": obs_metrics.metrics_snapshot(),
         }
+        if history is not None:
+            from torchstore_tpu.observability import history as obs_history
+
+            out["history"] = obs_history.history(
+                series=history.get("series"), since=history.get("since")
+            )
         if include_volumes:
             import asyncio
 
             async def one(vid: str, ref: ActorRef):
                 try:
                     return vid, await asyncio.wait_for(
-                        ref.stats.call_one(), timeout=10.0
+                        ref.stats.call_one(history=history)
+                        if history is not None
+                        else ref.stats.call_one(),
+                        timeout=10.0,
                     )
                 except Exception as exc:  # noqa: BLE001 - reported inline
                     return vid, {"error": f"{type(exc).__name__}: {exc}"}

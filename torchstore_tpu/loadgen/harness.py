@@ -139,9 +139,15 @@ def _driver_main(env: dict, spec_json: str, driver_idx: int, conn) -> None:
     _os.environ.setdefault("TORCHSTORE_TPU_LOG_LEVEL", "ERROR")
     from torchstore_tpu import config as _config_mod
     from torchstore_tpu import faults as _faults
+    from torchstore_tpu import observability as _obs
 
     _config_mod._default_config = None
     _faults.reinit_after_fork()
+    # Same story as runtime.actors._child_main: the forkserver's history
+    # sampler thread died in the fork and its rings are another process's
+    # — without this the driver ships an EMPTY history doc home and the
+    # diurnal-shape artifact silently vanishes.
+    _obs.reinit_after_fork()
     spec = LoadSpec.from_json(spec_json)
     try:
         out = _asyncio.run(_drive(spec, driver_idx))
@@ -395,6 +401,8 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
     await asyncio.gather(
         *(one_client(i, stop_at) for i in range(spec.clients_per_process))
     )
+    from torchstore_tpu.observability import history as obs_history
+
     return {
         "driver": driver_idx,
         "counts": counts,
@@ -403,6 +411,13 @@ async def _drive(spec: LoadSpec, driver_idx: int) -> dict:
         "by_tenant": by_tenant,
         "window_s": time.monotonic() - t_start,
         "slo": obs_timeline.slo_report(),
+        # This driver's retained op-rate + tail series over the run window
+        # (merge_history folds the fleet's by timestamp bucket, so a
+        # diurnal arrival shape is reconstructable from the artifact).
+        "history": obs_history.history(
+            series=("ts_client_ops_total*", "ts_op_p99_seconds*"),
+            since=spec.duration_s + 60.0,
+        ),
     }
 
 

@@ -88,6 +88,11 @@ def merge_driver_reports(reports: list[dict]) -> dict:
         ),
         "drivers": len(reports),
     }
+    hist = merge_history(
+        [rep["history"] for rep in reports if rep.get("history")]
+    )
+    if hist:
+        merged["history"] = hist
     if tenant_ops:
         by_tenant: dict[str, dict] = {}
         for tenant in sorted(tenant_ops):
@@ -108,6 +113,58 @@ def merge_driver_reports(reports: list[dict]) -> dict:
             }
         merged["by_tenant"] = by_tenant
     return merged
+
+
+def merge_history(histories: list[dict]) -> dict:
+    """Fold per-driver history docs (``observability.history()`` views of
+    each driver's ``ts_client_ops_total`` / ``ts_op_p99_seconds`` rings)
+    into the run's time-series shape:
+
+    - ``ops_per_s``: EXACT per-bucket fleet rate — successive diffs of
+      each driver's cumulative op counters (restart-safe), summed across
+      op labels and drivers per timestamp bucket. Exact because the
+      counters are cumulative: whatever the sampler's phase, the diff over
+      a bucket boundary is precisely the ops that landed between them.
+    - ``get_p99_ms``: worst per-bucket get p99 across drivers (a gauge —
+      max is the only honest fleet fold without the underlying samples).
+
+    Returns ``{"ops_per_s": [[ts, rate], ...], "get_p99_ms": [[ts, ms],
+    ...], "step_s"}`` (lists oldest-first), or ``{}`` when no driver
+    shipped history (TORCHSTORE_TPU_HISTORY=0)."""
+    from torchstore_tpu.observability import history as obs_history
+
+    ops_rates: list[list] = []
+    p99_points: list[list] = []
+    step = None
+    for doc in histories:
+        local = (doc or {}).get("processes", {}).get("client") or doc or {}
+        series = local.get("series") or {}
+        if step is None and local.get("step_s"):
+            step = local["step_s"]
+        for sid, entry in series.items():
+            if sid.startswith("ts_client_ops_total{") or sid == "ts_client_ops_total":
+                ops_rates.append(
+                    obs_history.counter_rate_points(entry["points"])
+                )
+            elif sid == 'ts_op_p99_seconds{op="get"}':
+                p99_points.append(entry["points"])
+    out: dict = {}
+    if ops_rates:
+        merged: dict[float, float] = {}
+        for rows in ops_rates:
+            for ts, rate in rows:
+                merged[ts] = merged.get(ts, 0.0) + rate
+        out["ops_per_s"] = [
+            [ts, round(merged[ts], 3)] for ts in sorted(merged)
+        ]
+    if p99_points:
+        folded = obs_history.merge_points(p99_points, how="max")
+        out["get_p99_ms"] = [
+            [row[0], round(row[2] * 1e3, 3)] for row in folded
+        ]
+    if out and step is not None:
+        out["step_s"] = step
+    return out
 
 
 def _merge_stage_tables(tables: list[dict]) -> dict:

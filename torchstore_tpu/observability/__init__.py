@@ -43,15 +43,35 @@ operable plane:
   keys by bytes/ops per process, and a ``TORCHSTORE_TPU_SLOW_OP_MS``
   threshold that turns outliers into logs, ``ts_slow_ops_total`` counts,
   and trace annotations.
+- **Time-series history + trends** (``observability.history`` /
+  ``observability.detect``): every process retains bounded multi-resolution
+  rings of its own instruments (1s/10s/60s, spikes survive via per-bucket
+  max), merged fleet-wide by ``ts.history()``; pure drift/sustained/ramp
+  detectors turn the rings into ``slo_report()["trends"]`` and the control
+  snapshot's ``sustained_overload`` signal.
 """
 
 from torchstore_tpu.observability import (
     aggregate,
     context,
+    detect,
+    history,
     ledger,
     profile,
     recorder,
     timeline,
+)
+from torchstore_tpu.observability.detect import (
+    Detector,
+    default_detectors,
+    evaluate_trends,
+)
+from torchstore_tpu.observability.history import (
+    ENV_HISTORY,
+    ENV_HISTORY_INTERVAL,
+    SeriesStore,
+    maybe_start_history,
+    series_store,
 )
 from torchstore_tpu.observability.http_exporter import (
     ENV_METRICS_PORT,
@@ -108,38 +128,49 @@ def reinit_after_fork() -> None:
     _metrics.reinit_dumper_after_fork()
     _http.reinit_after_fork()
     recorder.reinit_after_fork()
+    history.reinit_after_fork()
 
 __all__ = [
+    "ENV_HISTORY",
+    "ENV_HISTORY_INTERVAL",
     "ENV_METRICS_DUMP",
     "ENV_METRICS_INTERVAL",
     "ENV_METRICS_PORT",
     "ENV_SLOW_OP_MS",
     "ENV_TRACE",
     "Counter",
+    "Detector",
     "Gauge",
     "Histogram",
     "MetricsHTTPExporter",
     "MetricsRegistry",
+    "SeriesStore",
     "TraceCollector",
     "aggregate",
     "collect_trace",
     "collector",
     "context",
     "counter",
+    "default_detectors",
+    "detect",
     "dump_metrics",
+    "evaluate_trends",
     "flush_trace",
     "gauge",
     "get_registry",
     "histogram",
+    "history",
     "hot_keys",
     "ledger",
     "maybe_start_dumper",
+    "maybe_start_history",
     "maybe_start_http_exporter",
     "merge_traces",
     "metrics_snapshot",
     "profile",
     "record_op",
     "recorder",
+    "series_store",
     "render_prometheus_snapshot",
     "reset_metrics",
     "span",

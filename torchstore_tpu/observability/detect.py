@@ -1,0 +1,312 @@
+"""Trend/anomaly detectors over history series: burst vs regime change.
+
+The control plane's instantaneous gates (SLO thresholds, the solver's
+hysteresis ratios) cannot tell a two-second burst from a sustained regime
+change — both look identical in a point-in-time snapshot. These detectors
+read the :mod:`~torchstore_tpu.observability.history` rings instead and
+answer the question the PR 16 solver and the future elastic autoscaler
+actually ask: *has this signal been bad for a while, and which way is it
+heading?*
+
+Three detector kinds, all **pure functions over point rows** (injectable
+clocks, no hidden state — every evaluation recomputes from the ring):
+
+- ``sustained`` — value ≥ threshold for ≥ N consecutive samples (the
+  autoscaler trigger ROADMAP item 4 is specified against).
+- ``drift`` — EWMA-baseline z-score: the latest sample against the
+  exponentially-weighted mean/variance of its own past (catches a p99
+  quietly leaving its historical band long before an absolute SLO trips).
+- ``ramp`` — least-squares slope over the window (catches "heading for the
+  cliff" while still under every threshold).
+
+``evaluate_trends()`` runs the catalog against the local
+:class:`~torchstore_tpu.observability.history.SeriesStore`, publishes
+``ts_trend_active{detector=...}``, and is surfaced as
+``ts.slo_report()["trends"]`` and — via volume ``stats()`` — the control
+snapshot's ``sustained_overload`` field.
+
+Detector ``series`` selectors MUST name a registered instrument literally:
+tslint rule ``history-discipline`` checks the literal against the same
+registration scan that powers ``--regen-metric-docs``, so a renamed metric
+cannot silently orphan its detector.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Iterable, Optional
+
+from torchstore_tpu.observability import history as obs_history
+from torchstore_tpu.observability import metrics as obs_metrics
+
+ENV_TREND_SUSTAIN_SAMPLES = "TORCHSTORE_TPU_TREND_SUSTAIN_SAMPLES"
+ENV_TREND_INFLIGHT = "TORCHSTORE_TPU_TREND_INFLIGHT"
+# The control plane's instantaneous gate — the sustained detector defaults
+# to the same threshold so "sustained_overload" means "the solver's own
+# overload line, held".
+_ENV_CONTROL_INFLIGHT = "TORCHSTORE_TPU_CONTROL_OVERLOAD_INFLIGHT"
+
+# How far back an evaluation reads (level-0 ring, 1s buckets): three
+# minutes gives drift a baseline without ever touching coarser rings.
+EVAL_LOOKBACK_S = 180.0
+
+# z-scores are clamped here: a flat baseline (zero variance) makes any
+# deviation infinitely surprising, which serializes as Infinity and breaks
+# JSON consumers.
+MAX_Z = 99.0
+
+_TREND_ACTIVE = obs_metrics.gauge(
+    "ts_trend_active",
+    "Whether this trend detector is currently firing (1) or quiet (0)",
+)
+
+
+@dataclass(frozen=True)
+class Detector:
+    """One catalog entry: a detector kind bound to a series selector.
+
+    ``series`` must be a literal registered-instrument selector (see
+    module docstring). ``kind`` is ``"sustained"``, ``"drift"`` or
+    ``"ramp"``; the remaining fields parameterize whichever kind is
+    chosen and are ignored by the others.
+    """
+
+    name: str
+    series: str
+    kind: str
+    threshold: float = 0.0
+    min_samples: int = 5
+    z: float = 3.0
+    min_slope: float = 0.0
+
+
+def _last_values(points: Iterable[Iterable[float]]) -> list[tuple[float, float]]:
+    """``(ts, last)`` per bucket — detectors read the bucket's closing
+    value; spikes are the ``max`` column's job and stay visible there."""
+    return [(row[0], row[3]) for row in points]
+
+
+def sustained(
+    points: Iterable[Iterable[float]],
+    threshold: float,
+    min_samples: int,
+) -> dict:
+    """Value ≥ threshold for the trailing ≥ ``min_samples`` consecutive
+    buckets. Returns ``{"active", "samples", "value", "since_ts",
+    "duration_s"}`` where ``samples`` is the trailing run length (0 when
+    the latest bucket is under threshold)."""
+    vals = _last_values(points)
+    run = 0
+    since_ts = None
+    for ts, v in reversed(vals):
+        if v < threshold:
+            break
+        run += 1
+        since_ts = ts
+    active = run >= max(1, min_samples)
+    last_ts, last_v = vals[-1] if vals else (None, 0.0)
+    return {
+        "active": active,
+        "samples": run,
+        "value": last_v,
+        "since_ts": since_ts if run else None,
+        "duration_s": (last_ts - since_ts) if (run and last_ts is not None) else 0.0,
+    }
+
+
+def ewma_drift(
+    points: Iterable[Iterable[float]],
+    z: float = 3.0,
+    min_samples: int = 8,
+    alpha: float = 0.3,
+) -> dict:
+    """z-score of the latest bucket against the EWMA mean/variance of every
+    earlier bucket. Returns ``{"active", "z", "value", "baseline",
+    "samples"}``. Needs ``min_samples`` buckets of baseline before it can
+    fire (a two-sample history has no notion of 'normal')."""
+    vals = [v for _ts, v in _last_values(points)]
+    n = len(vals)
+    if n < max(2, min_samples):
+        return {
+            "active": False, "z": 0.0,
+            "value": vals[-1] if vals else 0.0,
+            "baseline": vals[-1] if vals else 0.0,
+            "samples": n,
+        }
+    mean = vals[0]
+    var = 0.0
+    for v in vals[1:-1]:
+        d = v - mean
+        mean += alpha * d
+        var = (1 - alpha) * (var + alpha * d * d)
+    last = vals[-1]
+    std = math.sqrt(var)
+    if std > 0:
+        score = (last - mean) / std
+        score = max(-MAX_Z, min(MAX_Z, score))
+    else:
+        score = 0.0 if last == mean else math.copysign(MAX_Z, last - mean)
+    return {
+        "active": abs(score) >= z,
+        "z": score,
+        "value": last,
+        "baseline": mean,
+        "samples": n,
+    }
+
+
+def ramp(
+    points: Iterable[Iterable[float]],
+    min_slope: float,
+    min_samples: int = 5,
+) -> dict:
+    """Least-squares slope (value units per second) over the window.
+    Active only when ``min_slope > 0`` and the fitted slope reaches it.
+    Returns ``{"active", "slope", "value", "samples"}``."""
+    vals = _last_values(points)
+    n = len(vals)
+    if n < max(2, min_samples):
+        return {
+            "active": False, "slope": 0.0,
+            "value": vals[-1][1] if vals else 0.0, "samples": n,
+        }
+    t0 = vals[0][0]
+    xs = [ts - t0 for ts, _v in vals]
+    ys = [v for _ts, v in vals]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    slope = (
+        sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+        if denom > 0
+        else 0.0
+    )
+    return {
+        "active": bool(min_slope > 0 and slope >= min_slope),
+        "slope": slope,
+        "value": ys[-1],
+        "samples": n,
+    }
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def default_detectors() -> tuple[Detector, ...]:
+    """The stock catalog. Thresholds re-read env on every call so tests
+    (and operators mid-incident) can retune without restarting anything."""
+    inflight = _env_int(
+        ENV_TREND_INFLIGHT, _env_int(_ENV_CONTROL_INFLIGHT, 16)
+    )
+    sustain = max(1, _env_int(ENV_TREND_SUSTAIN_SAMPLES, 5))
+    return (
+        Detector(
+            name="landing_inflight_sustained",
+            series="ts_landing_inflight",
+            kind="sustained",
+            threshold=float(inflight),
+            min_samples=sustain,
+        ),
+        Detector(
+            name="landing_inflight_ramp",
+            series="ts_landing_inflight",
+            kind="ramp",
+            min_slope=max(1.0, inflight / 4.0),
+            min_samples=sustain,
+        ),
+        Detector(
+            name="get_p99_drift",
+            series='ts_op_p99_seconds{op="get"}',
+            kind="drift",
+            z=3.0,
+        ),
+        Detector(
+            name="put_p99_drift",
+            series='ts_op_p99_seconds{op="put"}',
+            kind="drift",
+            z=3.0,
+        ),
+    )
+
+
+def evaluate_detector(
+    det: Detector, points: Iterable[Iterable[float]]
+) -> dict:
+    if det.kind == "sustained":
+        return sustained(points, det.threshold, det.min_samples)
+    if det.kind == "drift":
+        return ewma_drift(points, z=det.z, min_samples=max(2, det.min_samples))
+    if det.kind == "ramp":
+        return ramp(points, det.min_slope, det.min_samples)
+    raise ValueError(f"unknown detector kind {det.kind!r} ({det.name})")
+
+
+def evaluate_trends(
+    store: Optional["obs_history.SeriesStore"] = None,
+    detectors: Optional[Iterable[Detector]] = None,
+    now: Optional[float] = None,
+) -> dict:
+    """Run the catalog against the local history rings.
+
+    Each detector's selector may match several labeled series (a volume
+    process has one ``ts_landing_inflight`` series per hosted volume id);
+    the WORST match wins — worst = active first, then highest value /
+    |z| / slope — and its series id is reported so the operator knows
+    which label-set fired. Returns ``{detector_name: {"kind", "series",
+    "active", ...result...}}`` and publishes ``ts_trend_active``.
+    """
+    store = store if store is not None else obs_history.series_store()
+    dets = tuple(detectors) if detectors is not None else default_detectors()
+    view = store.query(
+        series=[d.series for d in dets],
+        since=EVAL_LOOKBACK_S,
+        level=0,
+        now=now,
+    )
+    all_series: dict[str, Any] = view["series"]
+    out: dict[str, dict] = {}
+    for det in dets:
+        best: Optional[dict] = None
+        for sid, entry in all_series.items():
+            if not obs_history.series_matches(sid, (det.series,)):
+                continue
+            result = evaluate_detector(det, entry["points"])
+            result["series"] = sid
+            if best is None or _worse(result, best):
+                best = result
+        if best is None:
+            best = {"active": False, "series": det.series, "samples": 0}
+        best["kind"] = det.kind
+        out[det.name] = best
+        _TREND_ACTIVE.set(1.0 if best["active"] else 0.0, detector=det.name)
+    return out
+
+
+def _worse(a: dict, b: dict) -> bool:
+    """Whether result ``a`` outranks ``b`` for the same detector."""
+    if a["active"] != b["active"]:
+        return a["active"]
+    for field in ("duration_s", "slope"):
+        if field in a and field in b and a[field] != b[field]:
+            return a[field] > b[field]
+    if "z" in a and "z" in b and abs(a["z"]) != abs(b["z"]):
+        return abs(a["z"]) > abs(b["z"])
+    return a.get("value", 0.0) > b.get("value", 0.0)
+
+
+def active_sustained(trends: dict) -> dict:
+    """The subset of trend results the control snapshot folds in as
+    ``sustained_overload``: active ``sustained``-kind detections only —
+    drift/ramp inform operators, but only a *held* overload may relax the
+    solver's migration hysteresis."""
+    return {
+        name: result
+        for name, result in (trends or {}).items()
+        if result.get("active") and result.get("kind") == "sustained"
+    }

@@ -1,0 +1,591 @@
+"""Per-process time-series history: bounded multi-resolution metric rings.
+
+Every other observability surface in the store is a point-in-time snapshot
+(registry scrapes, ``slo_report()`` live values) or a two-bucket rolling
+window (ledgers, stage digests). Nothing retains *history*, so "sustained
+``ts_landing_inflight`` saturation" — the trigger the elastic autoscaler
+(ROADMAP item 4) is specified against — is literally unobservable. This
+module is the retention layer:
+
+- A background :class:`SeriesStore` sampler sweeps every registry
+  instrument every ``TORCHSTORE_TPU_HISTORY_INTERVAL_S`` seconds (default
+  1) into RRD-style multi-resolution rings — 1s x 300 slots (5 min raw),
+  10s x 360 (1 h), 60s x 360 (6 h). Each slot keeps min/max/last/sum/count
+  so a one-sample spike SURVIVES downsampling (the 60s ring's ``max`` still
+  shows it) and bucket means stay exact (``sum``/``count``).
+- Counters additionally derive an instantaneous **rate** series
+  (``<name>:rate{labels}``), reset-safe across process restarts
+  (Prometheus semantics: a value below its predecessor is a restart, the
+  new value IS the delta — rates never go negative).
+- Everything is budget-bounded: rings are fixed preallocated arrays,
+  series count is capped (``TORCHSTORE_TPU_HISTORY_MAX_SERIES``; overflow
+  is counted in ``ts_history_series_dropped_total``, never allocated), and
+  each sweep's measured cost gates the effective interval
+  (``TORCHSTORE_TPU_HISTORY_BUDGET_PCT``: the sampler never spends more
+  than that fraction of one core).
+
+Fleet story: ``ts.history(series=..., since=...)`` rides the volume /
+controller ``stats()`` endpoints the way ledgers and hot_keys do (the
+history payload is request-gated — routine stats scrapes stay cheap), the
+HTTP exporter serves ``/history.json``, flight-recorder post-mortems embed
+the last five minutes of curated vitals
+(``TORCHSTORE_TPU_HISTORY_DUMP_SERIES``), and detectors
+(observability/detect.py) turn the rings into ``slo_report()["trends"]``
+and the control plane's ``sustained_overload`` signal.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+from array import array
+from typing import Any, Iterable, Optional, Union
+
+from torchstore_tpu.observability import metrics as obs_metrics
+
+ENV_HISTORY = "TORCHSTORE_TPU_HISTORY"
+ENV_HISTORY_INTERVAL = "TORCHSTORE_TPU_HISTORY_INTERVAL_S"
+ENV_HISTORY_MAX_SERIES = "TORCHSTORE_TPU_HISTORY_MAX_SERIES"
+ENV_HISTORY_BUDGET_PCT = "TORCHSTORE_TPU_HISTORY_BUDGET_PCT"
+ENV_HISTORY_DUMP_SERIES = "TORCHSTORE_TPU_HISTORY_DUMP_SERIES"
+
+# Ring levels as (step_s, slots): 5 minutes at 1s, an hour at 10s, six
+# hours at 60s. ~48 bytes/slot -> ~49 KB per series, fully preallocated.
+LEVELS: tuple[tuple[float, int], ...] = ((1.0, 300), (10.0, 360), (60.0, 360))
+
+# Default lookback for history()/dump queries when the caller gives none.
+DEFAULT_SINCE_S = 300.0
+
+# ``since`` values below this are relative lookbacks in seconds; at or
+# above it they are absolute wall timestamps (the year-2001 boundary — no
+# real scrape wants a 31-year lookback).
+_ABS_TS_FLOOR = 1e9
+
+# Curated vitals embedded in flight-recorder post-mortems when
+# TORCHSTORE_TPU_HISTORY_DUMP_SERIES is unset: the series an operator
+# reads first in any incident (op tails, landing pressure, op rates,
+# doorbell residency, metadata queue depth, SLO breach counts).
+DEFAULT_DUMP_SERIES = (
+    "ts_op_p99_seconds*",
+    "ts_op_p50_seconds*",
+    "ts_landing_inflight*",
+    "ts_client_ops_total*",
+    "ts_doorbell_plans_resident*",
+    "ts_meta_rpc_inflight*",
+    "ts_slo_violations_total*",
+)
+
+_SAMPLE_COST = obs_metrics.gauge(
+    "ts_history_sample_seconds",
+    "Wall-clock cost of the last history sampling sweep",
+)
+_SWEEPS = obs_metrics.counter(
+    "ts_history_sweeps_total", "History sampling sweeps completed"
+)
+_SERIES_GAUGE = obs_metrics.gauge(
+    "ts_history_series", "Time-series tracked by this process's SeriesStore"
+)
+_DROPPED = obs_metrics.counter(
+    "ts_history_series_dropped_total",
+    "Distinct series refused by the TORCHSTORE_TPU_HISTORY_MAX_SERIES cap",
+)
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_HISTORY, "1").strip().lower() not in (
+        "0", "false", "no", "off", "",
+    )
+
+
+def _env_interval_s() -> float:
+    try:
+        return max(
+            0.01, float(os.environ.get(ENV_HISTORY_INTERVAL, "1") or "1")
+        )
+    except ValueError:
+        return 1.0
+
+
+def _env_max_series() -> int:
+    try:
+        return max(
+            16, int(os.environ.get(ENV_HISTORY_MAX_SERIES, "256") or "256")
+        )
+    except ValueError:
+        return 256
+
+
+def _env_budget_frac() -> float:
+    """Fraction of one core the sampler may spend (default 1%)."""
+    try:
+        pct = float(os.environ.get(ENV_HISTORY_BUDGET_PCT, "1") or "1")
+    except ValueError:
+        pct = 1.0
+    return max(0.0, pct) / 100.0
+
+
+def _env_dump_series() -> tuple[str, ...]:
+    raw = os.environ.get(ENV_HISTORY_DUMP_SERIES)
+    if not raw:
+        return DEFAULT_DUMP_SERIES
+    globs = tuple(g.strip() for g in raw.split(",") if g.strip())
+    return globs or DEFAULT_DUMP_SERIES
+
+
+def render_series_id(
+    name: str, label_key: Iterable[tuple[str, str]] = ()
+) -> str:
+    """The canonical series identity: ``name`` or ``name{k="v",...}`` over
+    the registry's sorted label-key tuples — one stable string per labeled
+    series, merge-safe across processes."""
+    pairs = list(label_key)
+    if not pairs:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in pairs)
+    return name + "{" + inner + "}"
+
+
+class _Ring:
+    """One resolution level of one series: fixed parallel arrays indexed by
+    ``bucket_id % slots``. A slot whose stored bucket id differs from the
+    incoming sample's is stale retention — it is overwritten, never merged
+    — so the ring always holds the LAST ``slots`` buckets with no shifting
+    and no per-sample allocation."""
+
+    __slots__ = (
+        "step", "slots", "bucket", "vmin", "vmax", "vlast", "vsum", "count",
+    )
+
+    def __init__(self, step: float, slots: int) -> None:
+        self.step = float(step)
+        self.slots = int(slots)
+        self.bucket = array("q", [-1]) * self.slots
+        self.vmin = array("d", [0.0]) * self.slots
+        self.vmax = array("d", [0.0]) * self.slots
+        self.vlast = array("d", [0.0]) * self.slots
+        self.vsum = array("d", [0.0]) * self.slots
+        self.count = array("q", [0]) * self.slots
+
+    def add(self, ts: float, value: float) -> None:
+        b = int(ts // self.step)
+        i = b % self.slots
+        if self.bucket[i] != b:
+            self.bucket[i] = b
+            self.vmin[i] = self.vmax[i] = self.vlast[i] = value
+            self.vsum[i] = value
+            self.count[i] = 1
+            return
+        if value < self.vmin[i]:
+            self.vmin[i] = value
+        if value > self.vmax[i]:
+            self.vmax[i] = value
+        self.vlast[i] = value
+        self.vsum[i] += value
+        self.count[i] += 1
+
+    def points(self, since_ts: float) -> list[list]:
+        """``[[bucket_start_ts, min, max, last, sum, count], ...]`` for
+        every retained bucket at or after ``since_ts``, oldest first."""
+        since_b = int(since_ts // self.step)
+        rows = [
+            [
+                self.bucket[i] * self.step,
+                self.vmin[i],
+                self.vmax[i],
+                self.vlast[i],
+                self.vsum[i],
+                self.count[i],
+            ]
+            for i in range(self.slots)
+            if self.bucket[i] >= since_b
+        ]
+        rows.sort(key=lambda r: r[0])
+        return rows
+
+
+class Series:
+    """One tracked series: a ring per level plus the previous raw sample
+    (counters only — the rate derivation's state)."""
+
+    __slots__ = ("sid", "kind", "rings", "prev_value", "prev_ts")
+
+    def __init__(
+        self, sid: str, kind: str, levels: Iterable[tuple[float, int]]
+    ) -> None:
+        self.sid = sid
+        self.kind = kind
+        self.rings = tuple(_Ring(step, slots) for step, slots in levels)
+        self.prev_value: Optional[float] = None
+        self.prev_ts: Optional[float] = None
+
+    def add(self, ts: float, value: float) -> None:
+        for ring in self.rings:
+            ring.add(ts, value)
+
+
+class SeriesStore:
+    """Every series this process retains, behind one lock (the sampler is
+    the single writer; queries copy points out under the lock — sweeps are
+    a few hundred series and both sides are O(slots))."""
+
+    def __init__(
+        self,
+        levels: Iterable[tuple[float, int]] = LEVELS,
+        max_series: Optional[int] = None,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.levels = tuple(levels)
+        self._max_series = max_series
+        self._series: dict[str, Series] = {}
+        self._dropped: set[str] = set()
+        self.enabled = _env_enabled()
+        self.last_cost_s = 0.0
+
+    @property
+    def max_series(self) -> int:
+        return (
+            self._max_series
+            if self._max_series is not None
+            else _env_max_series()
+        )
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._dropped.clear()
+
+    def _get_or_create_locked(self, sid: str, kind: str) -> Optional[Series]:
+        series = self._series.get(sid)
+        if series is not None:
+            return series
+        if len(self._series) >= self.max_series:
+            if sid not in self._dropped:
+                self._dropped.add(sid)
+                _DROPPED.inc()
+            return None
+        series = self._series[sid] = Series(sid, kind, self.levels)
+        return series
+
+    def observe(
+        self,
+        sid: str,
+        kind: str,
+        value: float,
+        now: Optional[float] = None,
+    ) -> None:
+        """Feed one sample directly (tests, non-registry sources). The
+        background sweep uses :meth:`sample`."""
+        now = time.time() if now is None else now
+        with self._lock:
+            series = self._get_or_create_locked(sid, kind)
+            if series is not None:
+                series.add(now, value)
+
+    def sample(
+        self,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        now: Optional[float] = None,
+    ) -> float:
+        """One sweep over every registry instrument; returns the sweep's
+        wall cost in seconds (the budget gate's input). Counters feed
+        their raw cumulative series AND a derived ``:rate`` series; a
+        counter value below its predecessor is a process restart — the
+        new value is the whole delta, so rates never go negative."""
+        if not self.enabled:
+            return 0.0
+        registry = registry if registry is not None else obs_metrics.get_registry()
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+        rows = registry.sample_values()
+        with self._lock:
+            for name, kind, label_key, value in rows:
+                sid = render_series_id(name, label_key)
+                series = self._get_or_create_locked(sid, kind)
+                if series is None:
+                    continue
+                series.add(now, value)
+                if kind != "counter":
+                    continue
+                prev_v, prev_t = series.prev_value, series.prev_ts
+                series.prev_value, series.prev_ts = value, now
+                if prev_t is None or now <= prev_t:
+                    continue
+                delta = value - prev_v if value >= prev_v else value
+                rate_sid = render_series_id(f"{name}:rate", label_key)
+                rate = self._get_or_create_locked(rate_sid, "rate")
+                if rate is not None:
+                    rate.add(now, delta / (now - prev_t))
+            n_series = len(self._series)
+        cost = time.perf_counter() - t0
+        self.last_cost_s = cost
+        _SAMPLE_COST.set(round(cost, 6))
+        _SERIES_GAUGE.set(n_series)
+        _SWEEPS.inc()
+        return cost
+
+    def _pick_level(
+        self, lookback_s: float, level: Optional[Union[int, float]]
+    ) -> int:
+        if level is not None:
+            if isinstance(level, int) and 0 <= level < len(self.levels):
+                return level
+            for i, (step, _slots) in enumerate(self.levels):
+                if step == float(level):
+                    return i
+            raise ValueError(
+                f"unknown history level {level!r}; levels: {self.levels}"
+            )
+        for i, (step, slots) in enumerate(self.levels):
+            if step * slots >= lookback_s:
+                return i
+        return len(self.levels) - 1
+
+    def query(
+        self,
+        series: Optional[Union[str, Iterable[str]]] = None,
+        since: Optional[float] = None,
+        level: Optional[Union[int, float]] = None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Retained points as plain data.
+
+        ``series`` is a glob (or list of globs) over series ids; a
+        selector without a label part also matches every labeled variant
+        of that name (``"ts_landing_inflight"`` matches
+        ``ts_landing_inflight{volume="v0"}``). ``since`` is a lookback in
+        seconds when small, an absolute wall timestamp when it looks like
+        one (>= 1e9); default 300 s. ``level`` pins a ring (index or step
+        seconds); by default the finest ring that covers the lookback
+        serves the query.
+
+        Returns ``{"generated_ts", "interval_s", "step_s", "levels",
+        "series": {sid: {"kind", "points": [[ts, min, max, last, sum,
+        count], ...]}}}``.
+        """
+        now = time.time() if now is None else now
+        if since is None:
+            since_ts = now - DEFAULT_SINCE_S
+        elif since >= _ABS_TS_FLOOR:
+            since_ts = since
+        else:
+            since_ts = now - max(0.0, since)
+        lookback = max(1.0, now - since_ts)
+        idx = self._pick_level(lookback, level)
+        if series is None:
+            globs: Optional[tuple[str, ...]] = None
+        elif isinstance(series, str):
+            globs = (series,)
+        else:
+            globs = tuple(series)
+        out: dict[str, dict] = {}
+        with self._lock:
+            for sid, ser in self._series.items():
+                if globs is not None and not series_matches(sid, globs):
+                    continue
+                points = ser.rings[idx].points(since_ts)
+                if points:
+                    out[sid] = {"kind": ser.kind, "points": points}
+        return {
+            "generated_ts": now,
+            "interval_s": _env_interval_s(),
+            "step_s": self.levels[idx][0],
+            "levels": [list(lv) for lv in self.levels],
+            "series": out,
+        }
+
+
+def series_matches(sid: str, globs: Iterable[str]) -> bool:
+    """Whether ``sid`` matches any selector glob. A bare selector (no
+    ``{``, no trailing ``*``) additionally matches its labeled variants —
+    so detector catalogs and lint rules can name the registered instrument
+    without knowing its label sets."""
+    for g in globs:
+        if fnmatch.fnmatchcase(sid, g):
+            return True
+        if "{" not in g and not g.endswith("*") and fnmatch.fnmatchcase(
+            sid, g + "{*"
+        ):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# process singleton + background sampler
+# --------------------------------------------------------------------------
+
+_store = SeriesStore()
+# Fork story matches the metrics dumper: observability.reinit_after_fork()
+# resets the started-flag and re-arms the sampler thread in actor children;
+# the lock is never held across a spawn.
+_sampler_lock = threading.Lock()  # tslint: disable=fork-safety
+_sampler_thread: Optional[threading.Thread] = None
+_sampler_stop: Optional[threading.Event] = None
+
+
+def series_store() -> SeriesStore:
+    return _store
+
+
+def history(
+    series: Optional[Union[str, Iterable[str]]] = None,
+    since: Optional[float] = None,
+    level: Optional[Union[int, float]] = None,
+) -> dict:
+    """This process's retained history (see :meth:`SeriesStore.query`).
+    ``ts.history()`` merges this view with the controller's and every
+    reachable volume's."""
+    return _store.query(series=series, since=since, level=level)
+
+
+def dump_vitals() -> dict:
+    """The curated last-five-minutes payload flight-recorder post-mortems
+    embed (``TORCHSTORE_TPU_HISTORY_DUMP_SERIES`` globs, default
+    :data:`DEFAULT_DUMP_SERIES`)."""
+    return _store.query(series=_env_dump_series(), since=DEFAULT_SINCE_S)
+
+
+def _sampler_loop(stop: threading.Event) -> None:
+    while True:
+        cost = 0.0
+        try:
+            cost = _store.sample()
+            if _store.enabled:
+                # Keep ts_trend_active and the cached trend results fresh
+                # even when nobody is polling slo_report().
+                from torchstore_tpu.observability import detect as obs_detect
+
+                obs_detect.evaluate_trends(_store)
+        except Exception:  # noqa: BLE001 - the sampler must never die
+            pass
+        interval = _env_interval_s()
+        budget = _env_budget_frac()
+        if budget > 0 and cost > 0:
+            # Cost gate: a sweep that took C seconds forces the effective
+            # interval up to C/budget so sampling never exceeds its CPU
+            # fraction, however many series the registry grows.
+            interval = max(interval, cost / budget)
+        if stop.wait(interval):
+            return
+
+
+def maybe_start_history() -> bool:
+    """Start the background sampler once per process unless
+    ``TORCHSTORE_TPU_HISTORY=0``. Idempotent; returns whether a sampler is
+    running. Called from ``torchstore_tpu`` import."""
+    global _sampler_thread, _sampler_stop
+    if not _env_enabled():
+        return False
+    with _sampler_lock:
+        if _sampler_thread is not None and _sampler_thread.is_alive():
+            return True
+        _store.enabled = True
+        stop = _sampler_stop = threading.Event()
+        thread = threading.Thread(
+            target=_sampler_loop,
+            args=(stop,),
+            name="torchstore-tpu-history",
+            daemon=True,
+        )
+        thread.start()
+        _sampler_thread = thread
+    return True
+
+
+def stop_history() -> None:
+    """Stop the sampler thread (tests; idempotent). Retained rings stay —
+    history outlives its collector by design."""
+    global _sampler_thread, _sampler_stop
+    with _sampler_lock:
+        stop, _sampler_stop = _sampler_stop, None
+        thread, _sampler_thread = _sampler_thread, None
+    if stop is not None:
+        stop.set()
+    if thread is not None:
+        thread.join(timeout=5.0)
+
+
+def reset_history() -> None:
+    """Drop every retained point (tests, bench warmup). The store object
+    and sampler survive — exactly the registry-reset contract."""
+    _store.clear()
+
+
+def reinit_after_fork() -> bool:
+    """Re-arm in an actor child. Forked children inherit the parent's
+    rings (another process's history) and a sampler flag whose thread died
+    in the fork: drop the points, re-read the env, start fresh. Under
+    spawn the child's own import already started a live sampler — keep
+    it (the rings are genuinely this process's)."""
+    with _sampler_lock:
+        alive = _sampler_thread is not None and _sampler_thread.is_alive()
+        if not alive:
+            _store.clear()
+            _store.enabled = _env_enabled()
+    if alive:
+        return True
+    stop_history()
+    return maybe_start_history()
+
+
+# --------------------------------------------------------------------------
+# fleet merge helpers (ts.history / loadgen report / ts-top)
+# --------------------------------------------------------------------------
+
+
+def merge_points(
+    point_lists: Iterable[Iterable[Iterable[float]]], how: str = "sum"
+) -> list[list]:
+    """Merge ``[ts, min, max, last, sum, count]`` rows from several
+    processes by timestamp bucket. ``how="sum"`` adds min/max/last/sum
+    across processes per bucket (rates, counts); ``how="max"`` keeps the
+    worst (gauges like p99). Rows come back oldest first."""
+    if how not in ("sum", "max"):
+        raise ValueError(f"merge_points: how={how!r} (want 'sum' or 'max')")
+    merged: dict[float, list] = {}
+    for rows in point_lists:
+        for row in rows or ():
+            ts, vmin, vmax, vlast, vsum, count = row
+            cur = merged.get(ts)
+            if cur is None:
+                merged[ts] = [ts, vmin, vmax, vlast, vsum, count]
+            elif how == "sum":
+                cur[1] += vmin
+                cur[2] += vmax
+                cur[3] += vlast
+                cur[4] += vsum
+                cur[5] += count
+            else:
+                cur[1] = min(cur[1], vmin)
+                cur[2] = max(cur[2], vmax)
+                cur[3] = max(cur[3], vlast)
+                cur[4] = max(cur[4], vsum)
+                cur[5] = max(cur[5], count)
+    return [merged[ts] for ts in sorted(merged)]
+
+
+def counter_rate_points(rows: Iterable[Iterable[float]]) -> list[list]:
+    """Exact per-bucket rates from a CUMULATIVE counter series' points:
+    successive ``last`` diffs over successive bucket timestamps —
+    bucket-true ops/s with none of the instantaneous-rate sampling noise.
+    A drop between buckets is a restart (the new value is the delta).
+    Returns ``[[ts, rate], ...]``; the first bucket has no predecessor and
+    is skipped."""
+    out: list[list] = []
+    prev_ts: Optional[float] = None
+    prev_v: Optional[float] = None
+    for row in rows:
+        ts, vlast = row[0], row[3]
+        if prev_ts is not None and ts > prev_ts:
+            delta = vlast - prev_v if vlast >= prev_v else vlast
+            out.append([ts, delta / (ts - prev_ts)])
+        prev_ts, prev_v = ts, vlast
+    return out

@@ -1,4 +1,6 @@
-"""Opt-in live HTTP metrics endpoint: ``/metrics`` + ``/healthz``.
+"""Opt-in live HTTP metrics endpoint: ``/metrics`` + ``/healthz`` (+
+``/metrics.json``, ``/history.json``, ``/slo.json`` — the surfaces
+``scripts/ts_top.py`` polls in --url mode).
 
 Set ``TORCHSTORE_TPU_METRICS_PORT`` and every torchstore process starts a
 stdlib ``http.server`` thread serving its own registry in Prometheus text —
@@ -77,10 +79,54 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                     obs_metrics.get_registry().render_json(),
                 )
+            elif path == "/history.json":
+                self._send(
+                    200,
+                    "application/json",
+                    json.dumps(self._history_doc()),
+                )
+            elif path == "/slo.json":
+                from torchstore_tpu.observability import (
+                    timeline as obs_timeline,
+                )
+
+                self._send(
+                    200, "application/json", json.dumps(obs_timeline.slo_report())
+                )
             else:
                 self._send(404, "text/plain", "not found\n")
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper went away mid-response
+
+    def _history_doc(self) -> dict:
+        """This process's retained time-series rings
+        (``/history.json?series=<glob>[,<glob>...]&since=<s>&level=<i>``)
+        — what ts_top.py polls in --url mode."""
+        from urllib.parse import parse_qs
+
+        from torchstore_tpu.observability import history as obs_history
+
+        query = parse_qs(
+            self.path.split("?", 1)[1] if "?" in self.path else ""
+        )
+        series = None
+        if query.get("series"):
+            series = [
+                g for raw in query["series"] for g in raw.split(",") if g
+            ] or None
+        since = None
+        if query.get("since"):
+            try:
+                since = float(query["since"][0])
+            except ValueError:
+                since = None
+        level = None
+        if query.get("level"):
+            try:
+                level = int(query["level"][0])
+            except ValueError:
+                level = None
+        return obs_history.history(series=series, since=since, level=level)
 
 
 class MetricsHTTPExporter:

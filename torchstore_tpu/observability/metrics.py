@@ -239,6 +239,35 @@ class MetricsRegistry:
             metrics = dict(self._metrics)
         return {name: metric.snapshot() for name, metric in sorted(metrics.items())}
 
+    def sample_values(self) -> list[tuple]:
+        """Flat numeric view for the history sampler: one
+        ``(name, kind, label_key, value)`` row per labeled series, where
+        ``label_key`` is the canonical sorted ``((k, v), ...)`` tuple.
+        Histograms are sampled as their ``<name>_count`` counter — the
+        per-bucket vectors belong to scrapes, not 1 Hz retention."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        rows: list[tuple] = []
+        for metric in metrics:
+            if isinstance(metric, Histogram):
+                with metric._lock:
+                    items = [
+                        (key, float(state["count"]))
+                        for key, state in metric._series.items()
+                    ]
+                name = metric.name + "_count"
+                for key, count in items:
+                    rows.append((name, "counter", key, count))
+            else:
+                with metric._lock:
+                    items = [
+                        (key, float(value))
+                        for key, value in metric._series.items()
+                    ]
+                for key, value in items:
+                    rows.append((metric.name, metric.kind, key, value))
+        return rows
+
     def render_json(self) -> str:
         return json.dumps(
             {"ts": time.time(), "pid": os.getpid(), "metrics": self.snapshot()}

@@ -195,6 +195,17 @@ class FlightRecorder:
             "host": _hostname(),
             "events": events,
         }
+        # A post-mortem should carry the last five minutes of this
+        # process's vitals (op tails, landing pressure, op rates), not
+        # just events — the ring answers "what was it doing" while the
+        # history answers "what was it trending toward". Never let a
+        # history failure cost the dump itself.
+        try:
+            from torchstore_tpu.observability import history as obs_history
+
+            payload["history"] = obs_history.dump_vitals()
+        except Exception:  # noqa: BLE001 - post-mortem survives regardless
+            pass
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             os.makedirs(flight_dir(), exist_ok=True)

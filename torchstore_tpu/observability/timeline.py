@@ -445,7 +445,8 @@ def slo_report() -> dict:
     stage digests — the dominant stage with the full per-stage breakdown.
 
     Returns ``{"slos": {name: {...}}, "stages": {op: breakdown},
-    "generated_ts": wall_ts}``. ``ts.slo_report()`` wraps this with fleet
+    "trends": {detector: result}, "generated_ts": wall_ts}``.
+    ``ts.slo_report()`` wraps this with fleet
     overload signals; loadgen drivers ship it home per process and
     ``loadgen.report.merge_slo_reports`` folds driver scoreboards into the
     fleet view."""
@@ -477,9 +478,20 @@ def slo_report() -> dict:
             entry["dominant_stage"] = _stages.dominant(op)
             entry["stages"] = _stages.breakdown(op)
         slos[name] = entry
+    # Trend detectors over the local history rings: the "is this a burst
+    # or a regime change" companion to the instantaneous gates above.
+    # History may be disabled (TORCHSTORE_TPU_HISTORY=0) or mid-bootstrap;
+    # the scoreboard must not care.
+    try:
+        from torchstore_tpu.observability import detect as obs_detect
+
+        trends = obs_detect.evaluate_trends()
+    except Exception:  # noqa: BLE001 - scoreboard survives without trends
+        trends = {}
     return {
         "slos": slos,
         "stages": _stages.snapshot(),
+        "trends": trends,
         "generated_ts": time.time(),
     }
 

@@ -24,6 +24,8 @@ import numpy as np
 
 from torchstore_tpu import faults
 from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import detect as obs_detect
+from torchstore_tpu.observability import history as obs_history
 from torchstore_tpu.observability import ledger as obs_ledger
 from torchstore_tpu.observability import metrics as obs_metrics
 from torchstore_tpu.observability import profile as obs_profile
@@ -1068,10 +1070,15 @@ class StorageVolume(Actor):
         return result
 
     @endpoint
-    async def stats(self) -> dict:
+    async def stats(self, history: Optional[dict] = None) -> dict:
         """Data-plane observability: stored entry/byte counts plus SHM
         segment economics (live/retired/pooled bytes, outstanding read
-        leases) — the per-volume view controller.stats() aggregates."""
+        leases) — the per-volume view controller.stats() aggregates.
+
+        ``history={"series": ..., "since": ...}`` additionally returns
+        this process's retained time-series rings under ``"history"``
+        (``ts.history()`` rides this; routine scrapes omit it and stay
+        cheap)."""
         entries = 0
         stored_bytes = 0
         kv = getattr(self.store, "kv", {})
@@ -1104,7 +1111,16 @@ class StorageVolume(Actor):
             # process's per-stage wall-time digests.
             "overload": self._overload_signals(),
             "stages": obs_timeline.stage_quantiles().snapshot(),
+            # Trend detector results over this process's history rings
+            # (sustained landing-inflight etc.): ts.slo_report folds the
+            # active ones fleet-wide, the control snapshot reads the
+            # sustained kind as its sustained_overload signal.
+            "trends": obs_detect.evaluate_trends(),
         }
+        if history is not None:
+            out["history"] = obs_history.history(
+                series=history.get("series"), since=history.get("since")
+            )
         if self._tier is not None:
             out["tier"] = {
                 "resident_bytes": self._resident_bytes,
