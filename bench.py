@@ -2209,6 +2209,285 @@ async def placement_section(
         await ts.shutdown(store)
 
 
+async def autoscale_section(
+    n_drivers: int = 4,
+    n_logical: int = 32,
+    period_s: float = 8.0,
+    periods: float = 2.0,
+    n_volumes_fixed: int = 4,
+    value_kb: float = 16.0,
+    shared_keys: int = 32,
+    base_rate_hz: float = 0.5,
+    peak_rate_hz: float = 16.0,
+    get_p99_gate_ms: float = 500.0,
+    out_window_mb: float = 8.0,
+    idle_window_mb: float = 4.0,
+    ledger_window_s: float = 2.0,
+    volume_seconds_gate: float = 0.60,
+    autoscale_tick_s: float = 0.4,
+    settle_s: float = 4.0,
+) -> dict:
+    """Elastic fleet autoscaling + cold tier (ISSUE 18), gated behind
+    ``--autoscale``. Two diurnal loadgen legs plus a scale-to-zero leg:
+
+    1. **Fixed fleet** — ``n_volumes_fixed`` volumes provisioned for the
+       diurnal peak run the whole window (the static-provisioning cost
+       baseline); a 5 Hz sampler integrates live-volume-seconds.
+    2. **Autoscaled fleet** — ONE volume plus the autoscale engine
+       (``ts.autoscale()`` driven at ``autoscale_tick_s``) rides the
+       same sinusoid: scale-out at the crest, graceful drain + retire in
+       the trough. Asserted: zero failed drivers / op errors, get p99
+       under ``get_p99_gate_ms``, the fleet actually breathed (peak size
+       > 1, post-settle size back to 1), and live-volume-seconds at most
+       ``volume_seconds_gate`` of the fixed leg's — the elasticity
+       dividend.
+    3. **Scale-to-zero** — ``ts.blob_checkpoint()`` the surviving fleet,
+       shut EVERYTHING down, cold-start a fresh fleet and time
+       ``ts.blob_restore()`` until every committed key is re-landed and
+       a sample key verifies byte-identical.
+
+    Emits ``autoscale_volume_seconds_ratio``, ``autoscale_get_p99_ms``,
+    and ``cold_restore_s`` headline keys (gated by bench_compare)."""
+    import asyncio as _asyncio
+    import os as _os
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.loadgen import LoadSpec, run_fleet_load
+
+    duration_s = period_s * periods
+    pattern = {
+        "kind": "diurnal",
+        "rate_hz": base_rate_hz,
+        "peak_rate_hz": peak_rate_hz,
+        "period_s": period_s,
+    }
+
+    def _spec(store: str, seed: int) -> "LoadSpec":
+        return LoadSpec(
+            store_name=store,
+            duration_s=duration_s,
+            processes=n_drivers,
+            clients_per_process=n_logical,
+            pattern=pattern,
+            rate_hz=base_rate_hz,
+            mix={"get": 0.8, "put": 0.2},
+            value_kb=value_kb,
+            shared_keys=shared_keys,
+            seed=seed,
+            env={"TORCHSTORE_TPU_SLO_GET_P99_MS": str(get_p99_gate_ms)},
+        )
+
+    async def _sampled_leg(store: str, spec, tick_autoscale: bool) -> dict:
+        """Run one loadgen leg while sampling live fleet size (and, on
+        the autoscaled leg, driving ``ts.autoscale()`` rounds)."""
+        client = ts.client(store)
+        await client._ensure_setup()
+        samples: list[tuple[float, int]] = []
+        vol_seconds = 0.0
+        stop = _asyncio.Event()
+
+        async def sampler():
+            nonlocal vol_seconds
+            last = time.monotonic()
+            while not stop.is_set():
+                if tick_autoscale:
+                    try:
+                        await ts.autoscale(store_name=store)
+                    except Exception as exc:  # noqa: BLE001 - a failed
+                        # round must not kill the sampler mid-leg; the
+                        # leg's own assertions judge the outcome
+                        print(
+                            f"# autoscale round failed: {exc}",
+                            file=sys.stderr,
+                        )
+                vmap = await client.controller.get_volume_map.call_one()
+                live = sum(
+                    1
+                    for info in vmap.values()
+                    if info.get("health") != "quarantined"
+                )
+                now = time.monotonic()
+                vol_seconds += live * (now - last)
+                last = now
+                samples.append((round(now, 3), live))
+                try:
+                    await _asyncio.wait_for(
+                        stop.wait(), timeout=autoscale_tick_s / 2
+                    )
+                except _asyncio.TimeoutError:
+                    pass
+
+        sampler_task = _asyncio.ensure_future(sampler())
+        try:
+            report = await run_fleet_load(spec)
+        finally:
+            stop.set()
+            await sampler_task
+        get_row = report["by_op"].get("get") or {}
+        assert report["failed_drivers"] == 0, report.get("driver_errors")
+        assert report["errors"] == 0, report["by_op"]
+        return {
+            "report": report,
+            "get_p99_ms": get_row.get("p99_ms"),
+            "volume_seconds": vol_seconds,
+            "fleet_sizes": [n for _t, n in samples],
+        }
+
+    # ---- leg 1: fixed fleet provisioned for the peak --------------------
+    fixed_store = "bench_as_fixed"
+    await ts.initialize(
+        num_storage_volumes=n_volumes_fixed, store_name=fixed_store
+    )
+    try:
+        fixed = await _sampled_leg(
+            fixed_store, _spec(fixed_store, seed=18), tick_autoscale=False
+        )
+    finally:
+        await ts.shutdown(fixed_store)
+    print(
+        f"# autoscale fixed leg: {n_volumes_fixed} volumes x "
+        f"{duration_s:.0f} s -> {fixed['volume_seconds']:.1f} vol-s, "
+        f"{fixed['report']['ops_per_s']:.0f} ops/s, get p99 "
+        f"{fixed['get_p99_ms']:.2f} ms",
+        file=sys.stderr,
+    )
+
+    # ---- leg 2: elastic fleet under the same sinusoid -------------------
+    blob_dir = _tempfile.mkdtemp(prefix="ts_bench_blob_")
+    knobs = {
+        "TORCHSTORE_TPU_AUTOSCALE_MAX_VOLUMES": str(n_volumes_fixed),
+        "TORCHSTORE_TPU_AUTOSCALE_OUT_WINDOW_BYTES": str(
+            int(out_window_mb * 1024 * 1024)
+        ),
+        "TORCHSTORE_TPU_AUTOSCALE_IDLE_WINDOW_BYTES": str(
+            int(idle_window_mb * 1024 * 1024)
+        ),
+        "TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS": "2",
+        "TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S": str(
+            max(0.2, period_s / 10)
+        ),
+        "TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND": "64",
+        "TORCHSTORE_TPU_LEDGER_WINDOW_S": str(ledger_window_s),
+        "TORCHSTORE_TPU_BLOB_ENABLED": "1",
+        "TORCHSTORE_TPU_BLOB_DIR": blob_dir,
+    }
+    saved = {k: _os.environ.get(k) for k in knobs}
+    _os.environ.update(knobs)
+    auto_store = "bench_as_auto"
+    cold_store = "bench_as_cold"
+    try:
+        await ts.initialize(num_storage_volumes=1, store_name=auto_store)
+        try:
+            auto = await _sampled_leg(
+                auto_store, _spec(auto_store, seed=19), tick_autoscale=True
+            )
+            peak_fleet = max(auto["fleet_sizes"] or [1])
+            # Settle: keep ticking with no load until the trough drains
+            # the fleet back to its floor.
+            deadline = time.monotonic() + settle_s + period_s
+            final_fleet = peak_fleet
+            while time.monotonic() < deadline:
+                rep = await ts.autoscale(store_name=auto_store)
+                for act in rep.get("actions", []):
+                    print(
+                        f"# autoscale settle: {act['kind']} "
+                        f"[{act.get('reason')}] -> {act.get('outcome')}",
+                        file=sys.stderr,
+                    )
+                vmap = await ts.client(
+                    auto_store
+                ).controller.get_volume_map.call_one()
+                final_fleet = len(vmap)
+                if final_fleet <= 1:
+                    break
+                await _asyncio.sleep(autoscale_tick_s)
+            # The scale-to-zero leg: checkpoint, tear the world down.
+            ckpt = await ts.blob_checkpoint(store_name=auto_store)
+            assert not ckpt["errors"], ckpt
+        finally:
+            await ts.shutdown(auto_store)
+            ts.reset_client()
+
+        assert peak_fleet > 1, (
+            f"autoscaler never scaled out (fleet sizes {auto['fleet_sizes']})"
+        )
+        assert final_fleet < peak_fleet, (
+            f"fleet never drained back: peak {peak_fleet}, "
+            f"final {final_fleet}"
+        )
+        ratio = (
+            auto["volume_seconds"] / fixed["volume_seconds"]
+            if fixed["volume_seconds"] > 0
+            else 0.0
+        )
+        assert ratio <= volume_seconds_gate, (
+            f"autoscaled fleet burned {ratio:.2f}x the fixed fleet's "
+            f"volume-seconds (gate {volume_seconds_gate})"
+        )
+        auto_p99 = auto["get_p99_ms"]
+        assert auto_p99 is not None and auto_p99 < get_p99_gate_ms, (
+            f"autoscaled get p99 {auto_p99} ms >= SLO gate "
+            f"{get_p99_gate_ms} ms"
+        )
+        print(
+            f"# autoscale elastic leg: fleet 1 -> {peak_fleet} -> "
+            f"{final_fleet}, {auto['volume_seconds']:.1f} vol-s "
+            f"({ratio:.2f}x fixed), {auto['report']['ops_per_s']:.0f} "
+            f"ops/s, get p99 {auto_p99:.2f} ms (gate "
+            f"{get_p99_gate_ms:.0f} ms)",
+            file=sys.stderr,
+        )
+
+        # ---- leg 3: cold restore from the blob manifest -----------------
+        await ts.initialize(num_storage_volumes=1, store_name=cold_store)
+        try:
+            t0 = time.perf_counter()
+            restore = await ts.blob_restore(store_name=cold_store)
+            cold_restore_s = time.perf_counter() - t0
+            assert restore["restored"] == ckpt["keys"], restore
+            assert not restore["failed"], restore
+            sample_key = f"{auto_store}/shared/0"
+            got = np.asarray(await ts.get(sample_key, store_name=cold_store))
+            assert got.nbytes > 0 and np.isfinite(got).all()
+        finally:
+            await ts.shutdown(cold_store)
+        print(
+            f"# autoscale cold restore: {restore['restored']} keys in "
+            f"{cold_restore_s:.2f} s from the blob manifest",
+            file=sys.stderr,
+        )
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                _os.environ.pop(key, None)
+            else:
+                _os.environ[key] = val
+        _shutil.rmtree(blob_dir, ignore_errors=True)
+
+    return {
+        "drivers": n_drivers,
+        "logical_clients": n_drivers * n_logical,
+        "duration_s": duration_s,
+        "period_s": period_s,
+        "n_volumes_fixed": n_volumes_fixed,
+        "autoscale_volume_seconds_ratio": round(ratio, 3),
+        "autoscale_get_p99_ms": round(auto_p99, 3),
+        "cold_restore_s": round(cold_restore_s, 3),
+        "volume_seconds_fixed": round(fixed["volume_seconds"], 1),
+        "volume_seconds_autoscaled": round(auto["volume_seconds"], 1),
+        "peak_fleet": peak_fleet,
+        "final_fleet": final_fleet,
+        "fixed_get_p99_ms": round(fixed["get_p99_ms"] or 0.0, 3),
+        "fixed_ops_per_s": fixed["report"]["ops_per_s"],
+        "autoscaled_ops_per_s": auto["report"]["ops_per_s"],
+        "restored_keys": restore["restored"],
+        "get_p99_gate_ms": get_p99_gate_ms,
+        "volume_seconds_gate": volume_seconds_gate,
+    }
+
+
 async def run(
     n_tensors: int = N_TENSORS,
     tensor_mb: float = TENSOR_MB,
@@ -2740,6 +3019,13 @@ if __name__ == "__main__":
         # Standalone placement run: one JSON line with the skewed-traffic
         # recovery ratio, tenant isolation, and migrated bytes.
         print(json.dumps(asyncio.run(placement_section())))
+        sys.exit(0)
+    if "--autoscale" in sys.argv:
+        # Standalone elastic-fleet run (gated: not part of the default
+        # headline): one JSON line with the diurnal fixed-vs-autoscaled
+        # volume-seconds ratio, the autoscaled get p99, and the
+        # scale-to-zero cold-restore wall clock.
+        print(json.dumps(asyncio.run(autoscale_section())))
         sys.exit(0)
     if "--delta-sync" in sys.argv:
         # Standalone quantized/delta wire-tier run: one JSON line with the

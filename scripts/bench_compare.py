@@ -125,6 +125,17 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "rebalance_recovery_ratio": ("higher", 0.30, "rel"),
     "tenant_isolation_p99_ratio": ("lower", 1.00, "rel"),
     "migration_bytes": ("higher", 0.90, "rel"),
+    # Elastic fleet autoscaling + cold tier (ISSUE 18, --autoscale runs
+    # only). The volume-seconds ratio divides two integrals over the SAME
+    # diurnal profile, so host weather cancels — the section already
+    # asserts the <= 0.60 elasticity gate, and the trajectory budget
+    # (absolute: the ratio lives in [0, 1]) only catches the autoscaler
+    # going timid (ratio creeping toward 1.0 = static provisioning); the
+    # autoscaled p99 is budgeted like the other tail legs; cold restore
+    # is blob I/O + re-landing, budgeted loosely against host weather.
+    "autoscale_volume_seconds_ratio": ("lower", 0.15, "abs"),
+    "autoscale_get_p99_ms": ("lower", 1.00, "rel"),
+    "cold_restore_s": ("lower", 1.00, "rel"),
 }
 
 

@@ -3,7 +3,10 @@
 prints one JSON doc to stdout holding the traffic matrix, the SLO
 scoreboard (``ts.slo_report()``), the control plane's dry-run view
 (``ts.control_plan()`` — what the policy engine WOULD do over this
-traffic), and the fleet's retained time-series history (``ts.history()``),
+traffic), the elastic plane's dry-run view (``ts.autoscale_plan()`` plus
+the live fleet size it solved against — a ``--watch`` run leaves a
+fleet-size time series), and the fleet's retained time-series history
+(``ts.history()``),
 and writes the merged flight record to /tmp/ts_flight_record.json
 (tpu_watch.sh moves both into its OUTDIR during a device capture). Safe to
 run anywhere a store can boot.
@@ -26,11 +29,19 @@ async def _capture(ts, include_record: bool) -> dict:
     matrix = await ts.traffic_matrix(store_name="telemetry_capture")
     slo = await ts.slo_report(store_name="telemetry_capture")
     plan = await ts.control_plan(store_name="telemetry_capture")
+    scale = await ts.autoscale_plan(store_name="telemetry_capture")
     doc = {
         "captured_ts": time.time(),
         "traffic": matrix,
         "slo": slo,
         "control_plan": plan,
+        # The elastic plane's dry run: what the autoscaler WOULD do over
+        # this traffic, plus the fleet view it solved against (live/
+        # draining counts, idle-round hysteresis, blob-spill backlog). A
+        # --watch run therefore leaves a fleet-size time series — one
+        # fleet.volumes sample per capture line.
+        "autoscale_plan": scale,
+        "fleet_size": (scale.get("fleet") or {}).get("volumes"),
         "history": await ts.history(store_name="telemetry_capture"),
     }
     if include_record:
@@ -101,7 +112,9 @@ async def main() -> int:
             f"# captured {len(record['events'])} flight event(s), "
             f"{len(doc['traffic']['edges'])} matrix source host(s), "
             f"{len(doc['control_plan'].get('actions') or ())} planned "
-            f"control action(s), {n_hist} client history series, "
+            f"control action(s), {len(doc['autoscale_plan'].get('actions') or ())} "
+            f"planned autoscale action(s) over {doc['fleet_size']} volume(s), "
+            f"{n_hist} client history series, "
             f"{1 + max(0, args.watch)} capture(s)",
             file=sys.stderr,
         )

@@ -31,7 +31,10 @@
 #                               the placement section's skewed-loadgen
 #                               control loop: plan non-empty on skew,
 #                               decisions applied, zero failed gets
-#                               mid-migration) and
+#                               mid-migration, and the autoscale section's
+#                               diurnal elasticity loop: fleet 1 -> N ->
+#                               back, volume-seconds vs a fixed fleet,
+#                               blob checkpoint -> cold restore) and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

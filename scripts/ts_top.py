@@ -8,6 +8,10 @@ Renders, once per refresh, from the fleet's retained time-series history
 - per-volume heat: open landing brackets, resident doorbell plans,
   rolling window ops — with trend markers when a sustained/ramp detector
   is firing on that volume,
+- the elastic fleet pane: fleet-size sparkline (``ts_fleet_volumes`` /
+  ``ts_fleet_draining`` gauges), tier residency (memory / disk-spill /
+  blob bytes summed across volumes), and the autoscaler's dry-run plan
+  (``ts.autoscale_plan()``),
 - the SLO scoreboard with trend arrows (^ ramping, ~ drifting, ! sustained
   over threshold, = quiet),
 - the control-plane decision tail (planned actions + recent decision /
@@ -91,6 +95,31 @@ def fleet_gauge_series(history_doc: dict, sid_exact: str) -> list[list]:
     return [[r[0], r[2]] for r in obs_history.merge_points(rows, how="max")]
 
 
+def fleet_gauge_sum_series(history_doc: dict, name: str) -> list[list]:
+    """Per-bucket sum of one gauge's closing values across processes —
+    fleet totals for per-volume residency gauges (``ts_blob_bytes``,
+    ``ts_tier_resident_bytes``, ...)."""
+    from torchstore_tpu.observability import history as obs_history
+
+    rows = [
+        entry["points"]
+        for proc_doc in (history_doc.get("processes") or {}).values()
+        for sid, entry in (proc_doc.get("series") or {}).items()
+        if sid == name or sid.startswith(name + "{")
+    ]
+    return [[r[0], r[3]] for r in obs_history.merge_points(rows, how="sum")]
+
+
+def fmt_bytes(n: float) -> str:
+    """Human-scaled byte count (``1.5M``); exact below 1 KiB."""
+    n = float(n)
+    for unit in ("", "K", "M", "G", "T"):
+        if abs(n) < 1024.0 or unit == "T":
+            return f"{n:.0f}{unit}" if unit == "" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}T"
+
+
 def trend_arrow(trends: dict) -> str:
     """One status mark summarizing a process's active detectors."""
     marks = [
@@ -170,6 +199,44 @@ def render_frame(data: dict, width: int = 72) -> str:
                 f" [{trend_arrow(v.get('trends'))}]"
             )
 
+    # Elastic fleet + cold tier: size history from the engine's gauges,
+    # residency totals summed across volume processes, and the
+    # autoscaler's dry-run view of what it would do next.
+    size_hist = fleet_gauge_series(history_doc, "ts_fleet_volumes")
+    autoscale = data.get("autoscale") or {}
+    afleet = autoscale.get("fleet") or {}
+    if size_hist or afleet:
+        lines.append("")
+        lines.append("fleet")
+        draining_hist = fleet_gauge_series(history_doc, "ts_fleet_draining")
+        size_now = afleet.get(
+            "volumes", int(size_hist[-1][1]) if size_hist else 0
+        )
+        draining_now = len(afleet.get("draining") or ()) or (
+            int(draining_hist[-1][1]) if draining_hist else 0
+        )
+        lines.append(
+            f"  size    {spark([v for _t, v in size_hist])}  "
+            f"{size_now} vol ({draining_now} draining, "
+            f"idle {afleet.get('idle_rounds', 0)} round(s))"
+        )
+        mem = fleet_gauge_sum_series(history_doc, "ts_tier_resident_bytes")
+        spill = fleet_gauge_sum_series(history_doc, "ts_tier_spilled_bytes")
+        blob = fleet_gauge_sum_series(history_doc, "ts_blob_bytes")
+        if mem or spill or blob:
+            backlog = sum((afleet.get("spilled_keys") or {}).values())
+            lines.append(
+                f"  tier    mem {fmt_bytes(mem[-1][1] if mem else 0)}"
+                f" | spill {fmt_bytes(spill[-1][1] if spill else 0)}"
+                f" | blob {fmt_bytes(blob[-1][1] if blob else 0)}"
+                + (f" ({backlog} key(s) blob-eligible)" if backlog else "")
+            )
+        for action in (autoscale.get("actions") or [])[-4:]:
+            lines.append(
+                f"  plan {action.get('kind')} {action.get('subject')}: "
+                f"{action.get('reason', '')[:48]}"
+            )
+
     plan = data.get("plan") or {}
     actions = plan.get("actions") or []
     sustained = (plan.get("snapshot") or {}).get("sustained_overload") or {}
@@ -220,12 +287,18 @@ async def collect_store(store_name: str) -> dict:
             "ts_client_ops_total*",
             "ts_op_p99_seconds*",
             "ts_landing_inflight*",
+            "ts_fleet_volumes",
+            "ts_fleet_draining",
+            "ts_tier_resident_bytes*",
+            "ts_tier_spilled_bytes*",
+            "ts_blob_bytes*",
         ),
         since=120.0,
         store_name=store_name,
     )
     slo = await ts.slo_report(store_name=store_name)
     plan = await ts.control_plan(store_name=store_name)
+    autoscale = await ts.autoscale_plan(store_name=store_name)
     record = await ts.flight_record(store_name=store_name)
     events = [
         e
@@ -239,6 +312,7 @@ async def collect_store(store_name: str) -> dict:
         "slo": slo,
         "overload": slo.get("overload") or {},
         "plan": plan,
+        "autoscale": autoscale,
         "events": events,
     }
 
@@ -253,7 +327,9 @@ def collect_url(url: str, timeout: float = 5.0) -> dict:
 
     history_local = fetch(
         "/history.json?series=ts_client_ops_total*,ts_op_p99_seconds*,"
-        "ts_landing_inflight*&since=120"
+        "ts_landing_inflight*,ts_fleet_volumes,ts_fleet_draining,"
+        "ts_tier_resident_bytes*,ts_tier_spilled_bytes*,ts_blob_bytes*"
+        "&since=120"
     )
     try:
         slo = fetch("/slo.json")

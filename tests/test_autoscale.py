@@ -1,0 +1,362 @@
+"""Elastic fleet autoscaling (torchstore_tpu/autoscale/, ISSUE 18).
+
+Two layers, mirroring tests/test_control_plane.py:
+
+- **Solver**: a pure function over a frozen ``TelemetrySnapshot`` plus the
+  engine-side ``FleetView`` — every scaling behavior is pinned over
+  hand-built inputs with no fleet and no clock: saturation/overload/mean-
+  window scale-out, the idle-rounds drain entry, drain continuation →
+  retire, the size envelope, and every anti-flap rule (cooldown, reversal
+  damping, one-drain-at-a-time, max_actions).
+- **Fleet**: ``ts.autoscale_plan()`` / ``ts.autoscale()`` end to end on a
+  real store — scale-out actually spawns + attaches a volume, the idle
+  fleet drains it back through graceful key migration, the retired
+  process is stopped, and every committed key survives the round trip.
+
+The chaos legs (volume killed mid-drain, kill-all → cold restore) live in
+tests/test_chaos.py; the blob tier's own unit tests in
+tests/test_blob_tier.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu.control.snapshot import TelemetrySnapshot, VolumeLoad
+from torchstore_tpu.control.solver import ActionRecord
+from torchstore_tpu.autoscale.solver import (
+    BLOB_DEMOTE,
+    DRAIN,
+    RETIRE,
+    SCALE_OUT,
+    AutoscalePolicy,
+    FleetView,
+    solve,
+)
+
+NOW = 1000.0
+
+# KB-scale thresholds so fixtures stay readable.
+POLICY = AutoscalePolicy(
+    min_volumes=1,
+    max_volumes=4,
+    out_inflight=8,
+    out_window_bytes=10_000,
+    idle_window_bytes=1_000,
+    idle_rounds=3,
+    cooldown_s=60.0,
+)
+
+
+def _vol(vid, window=0, stored=0, entries=0, inflight=0):
+    return VolumeLoad(
+        volume_id=vid,
+        host="h",
+        entries=entries,
+        stored_bytes=stored,
+        window_bytes=window,
+        landing_inflight=inflight,
+    )
+
+
+def _snap(volumes, sustained=None):
+    return TelemetrySnapshot(
+        generated_ts=NOW,
+        volumes={v.volume_id: v for v in volumes},
+        sustained_overload=sustained or {},
+    )
+
+
+def _kinds(actions):
+    return [a.kind for a in actions]
+
+
+# ---------------------------------------------------------------------------
+# solver: scale-out triggers
+# ---------------------------------------------------------------------------
+
+
+class TestScaleOut:
+    def test_saturated_landing_brackets(self):
+        snap = _snap([_vol("v0", inflight=9), _vol("v1")])
+        actions = solve(snap, FleetView(max_volumes=4), POLICY)
+        assert _kinds(actions) == [SCALE_OUT]
+        assert actions[0].subject == "fleet" and actions[0].count == 1
+        assert "saturated" in actions[0].reason
+
+    def test_fleet_mean_window(self):
+        snap = _snap([_vol("v0", window=15_000), _vol("v1", window=9_000)])
+        actions = solve(snap, FleetView(max_volumes=4), POLICY)
+        assert _kinds(actions) == [SCALE_OUT]
+        assert "fleet-mean window" in actions[0].reason
+
+    def test_sustained_overload_trend(self):
+        """The PR 17 history detectors' sustained fold votes for scale-out
+        even when the point-in-time snapshot looks calm."""
+        snap = _snap(
+            [_vol("v0", window=100), _vol("v1")],
+            sustained={"v0": {"landing_inflight": {"kind": "sustained"}}},
+        )
+        actions = solve(snap, FleetView(max_volumes=4), POLICY)
+        assert _kinds(actions) == [SCALE_OUT]
+        assert "sustained overload trend" in actions[0].reason
+
+    def test_quiet_fleet_plans_nothing(self):
+        snap = _snap([_vol("v0", window=500), _vol("v1", window=500)])
+        assert solve(snap, FleetView(max_volumes=4), POLICY) == []
+
+    def test_max_volumes_ceiling(self):
+        snap = _snap([_vol(f"v{i}", inflight=9) for i in range(4)])
+        assert solve(snap, FleetView(max_volumes=4), POLICY) == []
+
+    def test_cooldown_suppresses_repeat(self):
+        snap = _snap([_vol("v0", inflight=9)])
+        hist = [ActionRecord(ts=NOW - 10, kind=SCALE_OUT, subject="fleet")]
+        assert solve(snap, FleetView(max_volumes=4), POLICY, hist) == []
+        # Past the window the same signal fires again.
+        hist = [ActionRecord(ts=NOW - 100, kind=SCALE_OUT, subject="fleet")]
+        assert _kinds(
+            solve(snap, FleetView(max_volumes=4), POLICY, hist)
+        ) == [SCALE_OUT]
+
+    def test_reversal_damping_after_drain(self):
+        """A diurnal edge right after scale-in must not saw-tooth: a
+        recent drain/retire suppresses scale-out regardless of signals."""
+        snap = _snap([_vol("v0", inflight=9)])
+        for kind in (DRAIN, RETIRE):
+            hist = [ActionRecord(ts=NOW - 10, kind=kind, subject="v9")]
+            assert solve(snap, FleetView(max_volumes=4), POLICY, hist) == []
+
+    def test_no_scale_out_while_draining(self):
+        snap = _snap([_vol("v0", inflight=9), _vol("v1", entries=3)])
+        actions = solve(
+            snap, FleetView(draining=frozenset({"v1"}), max_volumes=4), POLICY
+        )
+        assert _kinds(actions) == [DRAIN]  # continuation only, no out
+
+
+# ---------------------------------------------------------------------------
+# solver: scale-in (drain entry) + drain lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestScaleIn:
+    IDLE = [_vol("v0", window=100, stored=900), _vol("v1", window=50, stored=100)]
+
+    def test_idle_rounds_hysteresis(self):
+        snap = _snap(self.IDLE)
+        assert solve(snap, FleetView(idle_rounds=2), POLICY) == []
+        actions = solve(snap, FleetView(idle_rounds=3), POLICY)
+        assert _kinds(actions) == [DRAIN]
+        # Victim: the emptiest volume, so the drain moves the least data.
+        assert actions[0].subject == "v1"
+        assert actions[0].count == POLICY.drain_keys_per_round
+
+    def test_min_volumes_floor(self):
+        snap = _snap([_vol("v0", window=10)])
+        assert solve(snap, FleetView(idle_rounds=99), POLICY) == []
+
+    def test_busy_volume_blocks_idle(self):
+        for busy in (_vol("v1", window=5_000), _vol("v1", inflight=1)):
+            snap = _snap([_vol("v0", window=100), busy])
+            assert solve(snap, FleetView(idle_rounds=99), POLICY) == []
+
+    def test_sustained_overload_blocks_idle(self):
+        snap = _snap(
+            self.IDLE,
+            sustained={"v0": {"landing_inflight": {"kind": "sustained"}}},
+        )
+        assert _kinds(solve(snap, FleetView(idle_rounds=99), POLICY)) == [
+            SCALE_OUT
+        ]
+
+    def test_reversal_damping_after_scale_out(self):
+        snap = _snap(self.IDLE)
+        hist = [ActionRecord(ts=NOW - 10, kind=SCALE_OUT, subject="fleet")]
+        assert solve(snap, FleetView(idle_rounds=99), POLICY, hist) == []
+
+    def test_one_drain_at_a_time(self):
+        """Three idle volumes, one already draining: the round continues
+        that drain and never opens a second one."""
+        snap = _snap(self.IDLE + [_vol("v2", entries=5)])
+        actions = solve(
+            snap, FleetView(draining=frozenset({"v2"}), idle_rounds=99), POLICY
+        )
+        assert [(a.kind, a.subject) for a in actions] == [(DRAIN, "v2")]
+
+    def test_drain_continues_through_cooldown(self):
+        """Continuation is NOT cooldown-gated: a started drain converges
+        one batch per round instead of stalling a window per batch."""
+        snap = _snap([_vol("v0"), _vol("v1", entries=7)])
+        hist = [ActionRecord(ts=NOW - 1, kind=DRAIN, subject="v1")]
+        actions = solve(
+            snap, FleetView(draining=frozenset({"v1"})), POLICY, hist
+        )
+        assert [(a.kind, a.subject) for a in actions] == [(DRAIN, "v1")]
+        assert "7 entries remain" in actions[0].reason
+
+    def test_empty_draining_volume_retires(self):
+        snap = _snap([_vol("v0"), _vol("v1", entries=0)])
+        actions = solve(snap, FleetView(draining=frozenset({"v1"})), POLICY)
+        assert [(a.kind, a.subject) for a in actions] == [(RETIRE, "v1")]
+
+
+# ---------------------------------------------------------------------------
+# solver: blob demotion + budget
+# ---------------------------------------------------------------------------
+
+
+class TestBlobDemote:
+    def test_demotes_spilled_backlog_when_enabled(self):
+        snap = _snap([_vol("v0"), _vol("v1")])
+        fleet = FleetView(blob_enabled=True, spilled_keys={"v0": 5, "v1": 0})
+        actions = solve(snap, fleet, POLICY)
+        assert [(a.kind, a.subject) for a in actions] == [(BLOB_DEMOTE, "v0")]
+        assert actions[0].count == POLICY.blob_keys_per_round
+
+    def test_disabled_or_overloaded_skips(self):
+        snap = _snap([_vol("v0")])
+        assert solve(snap, FleetView(spilled_keys={"v0": 5}), POLICY) == []
+        hot = _snap([_vol("v0", inflight=9)])
+        fleet = FleetView(
+            blob_enabled=True, max_volumes=4, spilled_keys={"v0": 5}
+        )
+        assert _kinds(solve(hot, fleet, POLICY)) == [SCALE_OUT]
+
+    def test_per_volume_cooldown(self):
+        snap = _snap([_vol("v0")])
+        fleet = FleetView(blob_enabled=True, spilled_keys={"v0": 5})
+        hist = [ActionRecord(ts=NOW - 10, kind=BLOB_DEMOTE, subject="v0")]
+        assert solve(snap, fleet, POLICY, hist) == []
+
+    def test_max_actions_budget(self):
+        snap = _snap([_vol(f"v{i}", entries=2) for i in range(6)])
+        fleet = FleetView(
+            draining=frozenset(f"v{i}" for i in range(6)), max_volumes=8
+        )
+        policy = AutoscalePolicy(max_actions=2)
+        assert len(solve(snap, fleet, policy)) == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet: ts.autoscale() end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def elastic_env(monkeypatch):
+    """Tight thresholds + 1 s ledger windows so the diurnal cycle runs in
+    seconds: a few puts trigger scale-out, and the traffic window decays
+    fast enough for the idle drain to follow."""
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_OUT_WINDOW_BYTES", "4096")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS", "2")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S", "0.2")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_MAX_VOLUMES", "2")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND", "8")
+    monkeypatch.setenv("TORCHSTORE_TPU_LEDGER_WINDOW_S", "1")
+
+
+async def test_autoscale_plan_quiet_fleet(elastic_env):
+    await ts.initialize(store_name="asq")
+    try:
+        plan = await ts.autoscale_plan(store_name="asq")
+        assert plan["actions"] == []
+        assert plan["fleet"]["volumes"] == 1
+        assert plan["fleet"]["draining"] == []
+    finally:
+        await ts.shutdown("asq")
+
+
+async def test_scale_out_drain_retire_cycle(elastic_env):
+    """The full diurnal story on one box: load → ts.autoscale() spawns and
+    attaches a volume (placement-visible immediately), idle → the fleet
+    drains it gracefully (every key migrated, zero loss) and retires the
+    actor process; every decision lands in the flight recorder."""
+    await ts.initialize(store_name="ascyc")
+    try:
+        arrs = {
+            f"k{i}": np.arange(2000, dtype=np.float32) + i for i in range(8)
+        }
+        for k, v in arrs.items():
+            await ts.put(k, v, store_name="ascyc")
+        r = await ts.autoscale(store_name="ascyc")
+        assert r["spawned"] == ["scale-0"], r["actions"]
+        c = ts.client("ascyc")
+        vmap = await c.controller.get_volume_map.call_one()
+        assert len(vmap) == 2 and "scale-0" in vmap
+        # At the ceiling now: a second round must not spawn a third.
+        r = await ts.autoscale(store_name="ascyc")
+        assert not r["spawned"]
+        # Go idle; the window decays and the fleet converges back to 1.
+        for _ in range(30):
+            await asyncio.sleep(0.5)
+            r = await ts.autoscale(store_name="ascyc")
+            vmap = await c.controller.get_volume_map.call_one()
+            if len(vmap) == 1:
+                break
+        assert len(vmap) == 1, vmap
+        assert r["stopped"] == ["scale-0"]
+        for k, v in arrs.items():
+            got = await ts.get(k, store_name="ascyc")
+            assert np.array_equal(got, v), k
+        # Audit trail: every scale transition is a decision event.
+        record = await ts.flight_record(store_name="ascyc")
+        decided = {
+            e["name"]
+            for e in record["events"]
+            if e.get("kind") == "decision"
+            and str(e.get("name", "")).startswith("autoscale/")
+        }
+        assert "autoscale/scale_out" in decided, decided
+        assert "autoscale/drain_volume" in decided, decided
+        assert "autoscale/retire_volume" in decided, decided
+    finally:
+        await ts.shutdown("ascyc")
+
+
+async def test_draining_volume_excluded_from_placement(elastic_env, monkeypatch):
+    """While a volume drains, clients stop offering it for new puts (the
+    volume map exposes health="draining") — but reads of keys still
+    resident there keep serving until the migration empties it."""
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS", "1")
+    await ts.initialize(num_storage_volumes=2, store_name="asdr")
+    try:
+        c = ts.client("asdr")
+        await c._ensure_setup()
+        old = {f"d{i}": np.arange(64, dtype=np.float32) + i for i in range(6)}
+        for k, v in old.items():
+            await ts.put(k, v, store_name="asdr")
+        # Idle out until the engine marks a victim draining; with a
+        # 1-key-per-round quantum it stays mid-drain for several rounds.
+        draining: list[str] = []
+        vmap: dict = {}
+        for _ in range(30):
+            await asyncio.sleep(0.5)
+            await ts.autoscale(store_name="asdr")
+            vmap = await c.controller.get_volume_map.call_one()
+            draining = [
+                vid
+                for vid, info in vmap.items()
+                if info.get("health") == "draining"
+            ]
+            if draining:
+                break
+        assert draining, vmap
+        victim = draining[0]
+        await c._refresh_health()
+        new = {f"n{i}": np.arange(64, dtype=np.float32) - i for i in range(6)}
+        for k, v in new.items():
+            await ts.put(k, v, store_name="asdr")
+        locs = await c.controller.locate_volumes.call_one(sorted(new))
+        for key, vols in locs.items():
+            assert victim not in vols, (key, victim, vols)
+        for k, v in {**old, **new}.items():
+            got = await ts.get(k, store_name="asdr")
+            assert np.array_equal(got, v), k
+    finally:
+        await ts.shutdown("asdr")

@@ -554,3 +554,48 @@ async def test_bench_placement_section_tiny():
     assert all(row["count"] > 0 for row in tenants.values()), tenants
     assert out["migration_bytes"] >= 0, out
     json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_autoscale_section_tiny():
+    """The autoscale section standalone (``bench.py --autoscale``) at
+    tiny load: real diurnal loadgen drivers against a real fleet, the
+    autoscale engine scaling 1 -> N -> back while the sampler integrates
+    volume-seconds, then blob checkpoint -> full teardown -> cold
+    restore. The section asserts its own acceptance internally — zero
+    failed drivers / op errors, p99 under the gate, the fleet actually
+    breathed, the volume-seconds gate, byte-valid restore — so this
+    smoke proves those assertions can never ship broken. The <= 0.60
+    elasticity dividend is the full-scale run's bench_compare contract;
+    the smoke's gate is relaxed (2-volume ceiling leaves little room)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.autoscale_section(
+        n_drivers=2,
+        n_logical=4,
+        period_s=3.0,
+        periods=1.0,
+        n_volumes_fixed=2,
+        value_kb=8.0,
+        shared_keys=8,
+        base_rate_hz=1.0,
+        peak_rate_hz=40.0,
+        get_p99_gate_ms=2000.0,
+        out_window_mb=0.5,
+        idle_window_mb=0.25,
+        ledger_window_s=1.0,
+        volume_seconds_gate=1.05,
+        autoscale_tick_s=0.3,
+        settle_s=3.0,
+    )
+    assert out["peak_fleet"] > 1, out
+    assert out["final_fleet"] < out["peak_fleet"], out
+    assert 0 < out["autoscale_volume_seconds_ratio"] <= 1.05, out
+    assert 0 < out["autoscale_get_p99_ms"] < out["get_p99_gate_ms"], out
+    assert out["cold_restore_s"] > 0, out
+    assert out["restored_keys"] > 0, out
+    json.dumps(out)

@@ -1,0 +1,326 @@
+"""Blob cold tier (torchstore_tpu/tiering/blob.py, ISSUE 18).
+
+Bottom-up: the emulated object store's contract (crash-safe puts, torn
+writers invisible to list, the latency/rate service envelope, the
+``blob.io`` faultpoint), the per-volume ``BlobTier`` bookkeeping
+(archive/load/restore/discard, restart resume, reset-vs-purge
+durability), the fleet manifest, and finally the live fleet paths:
+disk→blob demotion via ``blob_sweep``, byte-identical fault-in through
+plain gets, and ``ts.blob_checkpoint()`` → scale-to-zero →
+``ts.blob_restore()`` onto a brand-new fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import torchstore_tpu as ts
+from torchstore_tpu import faults
+from torchstore_tpu.tiering import blob as blob_mod
+from torchstore_tpu.tiering.blob import (
+    MANIFEST_OBJECT,
+    BlobStore,
+    BlobTier,
+    read_fleet_manifest,
+    write_fleet_manifest,
+)
+from torchstore_tpu.transport.types import Request, TensorMeta
+
+
+@pytest.fixture
+def store(tmp_path):
+    return BlobStore(root=str(tmp_path / "blob"))
+
+
+def _tensor_entry(key, arr):
+    return [Request(key=key, tensor_meta=TensorMeta.of(arr))], {0: arr}
+
+
+# ---------------------------------------------------------------------------
+# BlobStore: the emulated object service
+# ---------------------------------------------------------------------------
+
+
+class TestBlobStore:
+    def test_put_get_head_list_delete(self, store):
+        assert store.put("a/b/k0", b"hello") == 5
+        store.put("a/b/k1", b"world!")
+        store.put("other", b"x")
+        assert store.get("a/b/k0") == b"hello"
+        size, mtime = store.head("a/b/k1")
+        assert size == 6 and mtime > 0
+        assert store.list("a/b/") == ["a/b/k0", "a/b/k1"]
+        assert store.list() == ["a/b/k0", "a/b/k1", "other"]
+        assert store.delete("a/b/k0") is True
+        assert store.delete("a/b/k0") is False  # idempotent
+        assert store.list("a/b/") == ["a/b/k1"]
+
+    def test_missing_object_raises(self, store):
+        with pytest.raises(KeyError):
+            store.get("nope")
+        with pytest.raises(KeyError):
+            store.head("nope")
+
+    def test_overwrite_replaces(self, store):
+        store.put("k", b"v1")
+        store.put("k", b"v2-longer")
+        assert store.get("k") == b"v2-longer"
+        assert store.list() == ["k"]
+
+    def test_torn_put_invisible_to_list(self, store):
+        """A writer killed between write-temp and rename leaves only a
+        ``*.tmp.<pid>`` file — never a trusted object."""
+        store.put("good", b"data")
+        torn = store._path("torn") + ".tmp.12345"
+        with open(torn, "wb") as f:
+            f.write(b"partial")
+        assert store.list() == ["good"]
+        with pytest.raises(KeyError):
+            store.get("torn")
+
+    def test_foreign_files_skipped(self, store, tmp_path):
+        store.put("k", b"v")
+        # Not urlsafe-b64 of anything: must not break list().
+        with open(os.path.join(store.root, "README~"), "w") as f:
+            f.write("not an object")
+        assert store.list() == ["k"]
+
+    def test_latency_and_rate_envelope(self, tmp_path):
+        fast = BlobStore(root=str(tmp_path / "f"), latency_ms=0, rate_mbps=0)
+        slow = BlobStore(root=str(tmp_path / "s"), latency_ms=40, rate_mbps=1)
+        payload = b"x" * 100_000  # 0.1 s at 1 MB/s
+        t0 = time.monotonic()
+        fast.put("k", payload)
+        fast_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        slow.put("k", payload)
+        slow_s = time.monotonic() - t0
+        # 40 ms latency + ~100 ms rate stall, minus scheduler slack.
+        assert slow_s >= 0.1
+        assert slow_s > fast_s
+
+    def test_blob_io_faultpoint(self, store):
+        faults.arm("blob.io", "raise", count=1)
+        try:
+            with pytest.raises(faults.FaultInjectedError):
+                store.put("k", b"v")
+            store.put("k", b"v")  # budget spent: next op serves
+            assert store.get("k") == b"v"
+        finally:
+            faults.disarm("blob.io")
+
+
+# ---------------------------------------------------------------------------
+# BlobTier: per-volume bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class TestBlobTier:
+    def test_archive_load_round_trip(self, store):
+        tier = BlobTier("v0", store=store)
+        arr = np.arange(256, dtype=np.float32)
+        metas, values = _tensor_entry("t", arr)
+        nbytes = tier.archive("t", metas, values)
+        assert nbytes > 0 and tier.archived == {"t": nbytes}
+        assert tier.archived_bytes == nbytes
+        got_metas, got_values = tier.load("t")
+        assert got_metas[0].key == "t"
+        assert np.array_equal(got_values[0], arr)
+        # Objects ride the same envelope.
+        obj = {"step": 7, "tags": ["a", "b"]}
+        tier.archive("o", [Request(key="o", is_object=True)], {0: obj})
+        ometas, ovalues = tier.load("o")
+        assert ometas[0].is_object and ovalues[0] == obj
+        with pytest.raises(KeyError):
+            tier.load("missing")
+
+    def test_restored_drops_object(self, store):
+        tier = BlobTier("v0", store=store)
+        tier.archive("t", *_tensor_entry("t", np.zeros(8)))
+        tier.restored("t", reason="get")
+        assert tier.archived == {}
+        assert store.list(tier.prefix) == []
+
+    def test_discard_idempotent(self, store):
+        tier = BlobTier("v0", store=store)
+        tier.archive("t", *_tensor_entry("t", np.zeros(8)))
+        assert tier.discard("t") is True
+        assert tier.discard("t") is False
+        assert store.list(tier.prefix) == []
+
+    def test_restart_resumes_archive(self, store):
+        """A restarted volume process seeds ``archived`` from the store:
+        the blob tier survives the process, not just the object bytes."""
+        t1 = BlobTier("v0", store=store)
+        arr = np.arange(64, dtype=np.int32)
+        n = t1.archive("t", *_tensor_entry("t", arr))
+        t2 = BlobTier("v0", store=store)
+        assert t2.archived == {"t": n}
+        _m, values = t2.load("t")
+        assert np.array_equal(values[0], arr)
+        # Volumes do not see each other's namespaces.
+        assert BlobTier("v1", store=store).archived == {}
+
+    def test_manifest_excludes_warmer_tiers(self, store):
+        tier = BlobTier("v0", store=store)
+        tier.archive("a", *_tensor_entry("a", np.zeros(4)))
+        tier.archive("b", *_tensor_entry("b", np.ones(4)))
+        items = tier.manifest(exclude={"a"})
+        assert [item["meta"].key for item in items] == ["b"]
+        assert all(item["mtime"] > 0 for item in items)
+
+    def test_reset_keeps_objects_purge_deletes(self, store):
+        tier = BlobTier("v0", store=store)
+        tier.archive("t", *_tensor_entry("t", np.zeros(8)))
+        tier.reset()
+        assert tier.archived == {}
+        # The objects are the durable tier: a fresh view resumes them.
+        assert "t" in BlobTier("v0", store=store).archived
+        tier2 = BlobTier("v0", store=store)
+        tier2.purge()
+        assert BlobTier("v0", store=store).archived == {}
+        assert store.list() == []
+
+
+class TestFleetManifest:
+    def test_round_trip_and_absent(self, store):
+        assert read_fleet_manifest(store) is None
+        keys = {
+            "k0": {"object": "vol/v0/k0", "nbytes": 10, "write_gen": 2},
+            "k1": {"object": "vol/v1/k1", "nbytes": 20, "write_gen": 1},
+        }
+        write_fleet_manifest(store, keys, extra={"volumes": 2})
+        doc = read_fleet_manifest(store)
+        assert doc["keys"] == keys
+        assert doc["volumes"] == 2
+        # Crash-safe like any put: the manifest object is valid JSON on
+        # disk, no temp debris beside it.
+        raw = store.get(MANIFEST_OBJECT)
+        assert json.loads(raw.decode())["keys"]["k1"]["nbytes"] == 20
+
+
+# ---------------------------------------------------------------------------
+# fleet: demote / fault-in / checkpoint / cold restore
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def blob_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TORCHSTORE_TPU_BLOB_ENABLED", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_BLOB_DIR", str(tmp_path / "blobfleet"))
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_ENABLED", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_TIER_BUDGET_BYTES", str(1 << 20))
+    return str(tmp_path / "blobfleet")
+
+
+async def _demote_all(c, keys):
+    """Force disk spill then blob demotion for ``keys`` on every volume."""
+    swept = []
+    for vid, ref in c._volume_refs.items():
+        await ref.actor.tier_sweep.call_one(demote_keys=list(keys))
+        rep = await ref.actor.blob_sweep.call_one(32)
+        swept.extend(rep["archived"])
+    return swept
+
+
+async def test_blob_demote_and_fault_in(blob_env):
+    await ts.initialize(num_storage_volumes=2, store_name="blobf")
+    try:
+        arrs = {
+            f"k{i}": np.arange(500, dtype=np.float32) * (i + 1)
+            for i in range(5)
+        }
+        arrs["obj"] = {"step": 3, "lr": 0.1}
+        for k, v in arrs.items():
+            await ts.put(k, v, store_name="blobf")
+        c = ts.client("blobf")
+        await c._ensure_setup()
+        swept = await _demote_all(c, arrs)
+        assert sorted(swept) == sorted(arrs)
+        # Residency is visible in stats while the bytes live in blob only.
+        blob_keys = 0
+        for ref in c._volume_refs.values():
+            st = await ref.actor.stats.call_one()
+            blob_keys += st.get("tier", {}).get("blob_keys", 0)
+        assert blob_keys == len(arrs)
+        # Plain gets fault the entries back in, byte-identical.
+        for k, v in arrs.items():
+            got = await ts.get(k, store_name="blobf")
+            if isinstance(v, dict):
+                assert got == v
+            else:
+                assert np.array_equal(got, v), k
+        # Fault-in consumed the blob copies (promotion, not a cache).
+        blob_keys = 0
+        for ref in c._volume_refs.values():
+            st = await ref.actor.stats.call_one()
+            blob_keys += st.get("tier", {}).get("blob_keys", 0)
+        assert blob_keys == 0
+    finally:
+        await ts.shutdown("blobf")
+
+
+async def test_overwrite_discards_stale_blob_copy(blob_env):
+    await ts.initialize(store_name="blobow")
+    try:
+        await ts.put("k", np.zeros(100, dtype=np.float32), store_name="blobow")
+        c = ts.client("blobow")
+        await c._ensure_setup()
+        await _demote_all(c, ["k"])
+        fresh = np.ones(100, dtype=np.float32)
+        await ts.put("k", fresh, store_name="blobow")
+        got = await ts.get("k", store_name="blobow")
+        assert np.array_equal(got, fresh)
+        for ref in c._volume_refs.values():
+            st = await ref.actor.stats.call_one()
+            assert st.get("tier", {}).get("blob_keys", 0) == 0
+    finally:
+        await ts.shutdown("blobow")
+
+
+async def test_checkpoint_scale_to_zero_cold_restore(blob_env):
+    """The headline: checkpoint the fleet to blob, kill EVERYTHING, start
+    a brand-new fleet, ``ts.blob_restore()`` — every committed key comes
+    back byte-identical with zero client errors."""
+    arrs = {
+        f"w{i}": np.arange(800, dtype=np.float32) + i * 1000 for i in range(4)
+    }
+    arrs["meta"] = {"epoch": 12}
+    await ts.initialize(num_storage_volumes=2, store_name="blobckpt")
+    try:
+        for k, v in arrs.items():
+            await ts.put(k, v, store_name="blobckpt")
+        rep = await ts.blob_checkpoint(store_name="blobckpt")
+        assert rep["keys"] == len(arrs) and not rep["errors"], rep
+    finally:
+        await ts.shutdown("blobckpt")
+        ts.reset_client()
+
+    # Scale-to-zero happened above: no volume survives. Fresh fleet.
+    await ts.initialize(num_storage_volumes=1, store_name="blobcold")
+    try:
+        rep = await ts.blob_restore(store_name="blobcold")
+        assert rep["restored"] == len(arrs), rep
+        assert not rep["failed"], rep
+        for k, v in arrs.items():
+            got = await ts.get(k, store_name="blobcold")
+            if isinstance(v, dict):
+                assert got == v
+            else:
+                assert np.array_equal(got, v), k
+    finally:
+        await ts.shutdown("blobcold")
+
+
+async def test_blob_restore_requires_manifest(blob_env):
+    await ts.initialize(store_name="blobnomf")
+    try:
+        with pytest.raises(RuntimeError):
+            await ts.blob_restore(store_name="blobnomf")
+    finally:
+        await ts.shutdown("blobnomf")

@@ -840,3 +840,183 @@ async def test_chaos_reshard_under_live_traffic(fast_health):
         await _assert_no_loss("chaos_reshard", expected)
     finally:
         await ts.shutdown("chaos_reshard")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: elastic fleet + cold tier under fire
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def elastic_chaos_env(monkeypatch):
+    """Second-scale autoscale thresholds (1 s ledger windows, 1 idle
+    round, 1-key drain quanta) with auto-repair off so the fleet size is
+    exactly what the scale engine decides."""
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S", "0.2")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_LEDGER_WINDOW_S", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTO_REPAIR", "0")
+
+
+async def _drain_started(store_name: str, rounds: int = 30) -> str:
+    """Run autoscale rounds until some volume is marked draining; returns
+    its id (the drain stays mid-flight: 1-key quanta)."""
+    client = ts.client(store_name)
+    for _ in range(rounds):
+        await asyncio.sleep(0.5)
+        await ts.autoscale(store_name=store_name)
+        vmap = await client.controller.get_volume_map.call_one()
+        for vid, info in vmap.items():
+            if info.get("health") == "draining":
+                return vid
+    raise AssertionError(f"no drain started after {rounds} rounds: {vmap}")
+
+
+async def test_chaos_volume_killed_mid_drain(fast_health, elastic_chaos_env):
+    """ISSUE 18 leg 1: the drain victim dies with entries still resident.
+    The injected-raise determinism check runs first (an ``autoscale.drain``
+    raise surfaces as an ``error:`` outcome, never a silent round); then
+    the real kill — the health loop quarantines the dark volume, the
+    drain is ABANDONED loudly (``drain_abandoned`` health event), later
+    autoscale rounds neither wedge nor plan for the corpse, and zero
+    committed generations are lost (the survivor holds every replica)."""
+    await ts.initialize(
+        num_storage_volumes=2,
+        strategy=LocalRankStrategy(replication=2),
+        store_name="chaos_drain",
+    )
+    try:
+        expected = await _seed_hot_key("chaos_drain")
+        victim = await _drain_started("chaos_drain")
+
+        # Leg 1 (determinism): a raise at the faultpoint fails the action
+        # loudly; the round reports it and continues.
+        await ts.inject_fault(
+            "autoscale.drain", "raise", count=1, scope="controller",
+            store_name="chaos_drain",
+        )
+        rep = await ts.autoscale(store_name="chaos_drain")
+        outcomes = [a["outcome"] for a in rep["actions"]]
+        assert any(o.startswith("error:") for o in outcomes), outcomes
+        await _assert_no_loss("chaos_drain", expected)
+
+        # Leg 2: kill the half-drained victim for real.
+        await _kill_volume("chaos_drain", victim)
+        client = ts.client("chaos_drain")
+        gone = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            rep = await ts.autoscale(store_name="chaos_drain")  # never wedges
+            vmap = await client.controller.get_volume_map.call_one()
+            state = vmap.get(victim, {}).get("health")
+            if state in (None, "quarantined"):
+                gone = True
+                break
+            await asyncio.sleep(0.3)
+        assert gone, f"victim {victim} never quarantined: {vmap}"
+
+        record = await ts.flight_record(store_name="chaos_drain")
+        assert any(
+            e.get("kind") == "health"
+            and e.get("name") == f"drain_abandoned/{victim}"
+            for e in record["events"]
+        ), "drain abandonment was silent"
+        # Post-abandon rounds plan nothing for the corpse.
+        rep = await ts.autoscale(store_name="chaos_drain")
+        assert all(a["subject"] != victim for a in rep["actions"]), rep
+        await _assert_no_loss("chaos_drain", expected)
+    finally:
+        await ts.clear_faults(store_name="chaos_drain")
+        await ts.shutdown("chaos_drain")
+
+
+async def test_chaos_spawn_fault_fails_loudly(fast_health, monkeypatch):
+    """A raise at ``autoscale.spawn`` aborts the spawn batch: the round
+    still reports the deferred scale-out decision, ``spawned`` stays
+    empty, nothing leaks — and the NEXT round (fault budget spent, fresh
+    traffic) completes the scale-out it owed."""
+    from torchstore_tpu import faults
+
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_OUT_WINDOW_BYTES", "4096")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S", "0.2")
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTOSCALE_MAX_VOLUMES", "2")
+    monkeypatch.setenv("TORCHSTORE_TPU_LEDGER_WINDOW_S", "30")
+    await ts.initialize(store_name="chaos_spawn")
+    try:
+        hot = np.arange(4096, dtype=np.float32)
+        for i in range(4):
+            await ts.put(f"s{i}", hot + i, store_name="chaos_spawn")
+        faults.arm("autoscale.spawn", "raise", count=1)  # spawns run HERE
+        try:
+            rep = await ts.autoscale(store_name="chaos_spawn")
+        finally:
+            faults.disarm("autoscale.spawn")
+        assert rep["spawned"] == [], rep
+        assert any(
+            a["kind"] == "scale_out" and a["outcome"].startswith("deferred")
+            for a in rep["actions"]
+        ), rep["actions"]
+        await asyncio.sleep(0.4)  # cooldown; windows stay hot (30 s)
+        rep = await ts.autoscale(store_name="chaos_spawn")
+        assert rep["spawned"] == ["scale-0"], rep
+        client = ts.client("chaos_spawn")
+        vmap = await client.controller.get_volume_map.call_one()
+        assert "scale-0" in vmap
+        for i in range(4):
+            got = await ts.get(f"s{i}", store_name="chaos_spawn")
+            np.testing.assert_array_equal(np.asarray(got), hot + i)
+    finally:
+        await ts.shutdown("chaos_spawn")
+
+
+async def test_chaos_kill_all_volumes_cold_restore(
+    fast_health, monkeypatch, tmp_path
+):
+    """ISSUE 18 leg 2, the scale-to-zero acceptance: checkpoint the fleet
+    into the blob tier, KILL every volume process (not a graceful stop),
+    cold-start a brand-new fleet, ``ts.blob_restore()`` — every committed
+    key comes back byte-identical. A ``blob.io`` raise injected into the
+    restore path must surface in ``failed``, never as silent loss."""
+    monkeypatch.setenv("TORCHSTORE_TPU_BLOB_ENABLED", "1")
+    monkeypatch.setenv("TORCHSTORE_TPU_BLOB_DIR", str(tmp_path / "coldblob"))
+    monkeypatch.setenv("TORCHSTORE_TPU_AUTO_REPAIR", "0")
+    expected = {
+        f"ck/{i}": np.arange(700, dtype=np.float32) * (i + 1)
+        for i in range(5)
+    }
+    await ts.initialize(num_storage_volumes=2, store_name="chaos_cold")
+    try:
+        for key, arr in expected.items():
+            await ts.put(key, arr, store_name="chaos_cold")
+        rep = await ts.blob_checkpoint(store_name="chaos_cold")
+        assert rep["keys"] == len(expected) and not rep["errors"], rep
+        client = ts.client("chaos_cold")
+        vmap = await client.controller.get_volume_map.call_one()
+        for vid in sorted(vmap):
+            await _kill_volume("chaos_cold", vid)
+    finally:
+        await ts.shutdown("chaos_cold")
+        ts.reset_client()
+
+    await ts.initialize(num_storage_volumes=2, store_name="chaos_cold2")
+    try:
+        from torchstore_tpu import faults
+
+        # The restore's blob reads run in THIS process: an injected I/O
+        # raise fails the restore LOUDLY (here on the very first blob op,
+        # the manifest read) — never a quietly partial fleet.
+        faults.arm("blob.io", "raise", count=1)
+        try:
+            with pytest.raises(faults.FaultInjectedError):
+                await ts.blob_restore(store_name="chaos_cold2")
+        finally:
+            faults.disarm("blob.io")
+        rep = await ts.blob_restore(store_name="chaos_cold2")
+        assert rep["restored"] == len(expected), rep
+        assert not rep["failed"], rep
+        for key, arr in expected.items():
+            got = await ts.get(key, store_name="chaos_cold2")
+            np.testing.assert_array_equal(np.asarray(got), arr)
+    finally:
+        await ts.shutdown("chaos_cold2")

@@ -334,6 +334,36 @@ class TestTsTopRender:
         p99 = ts_top.fleet_gauge_series(doc, 'ts_op_p99_seconds{op="get"}')
         assert p99 == [[0.0, 0.040], [1.0, 0.020], [2.0, 0.015]]
 
+    def test_fleet_gauge_sum_series_totals_volumes(self, ts_top):
+        doc = {
+            "processes": {
+                "volume:v0": {
+                    "series": {
+                        "ts_blob_bytes": {
+                            "kind": "gauge",
+                            "points": _rows([100.0, 200.0]),
+                        }
+                    }
+                },
+                "volume:v1": {
+                    "series": {
+                        "ts_blob_bytes": {
+                            "kind": "gauge",
+                            "points": _rows([50.0, 25.0]),
+                        }
+                    }
+                },
+            }
+        }
+        total = ts_top.fleet_gauge_sum_series(doc, "ts_blob_bytes")
+        assert total == [[0.0, 150.0], [1.0, 225.0]]
+        assert ts_top.fleet_gauge_sum_series(doc, "ts_absent") == []
+
+    def test_fmt_bytes_scales(self, ts_top):
+        assert ts_top.fmt_bytes(512) == "512"
+        assert ts_top.fmt_bytes(2048) == "2.0K"
+        assert ts_top.fmt_bytes(3 * 1024 * 1024) == "3.0M"
+
     def test_render_frame_full_and_empty(self, ts_top):
         data = {
             "source": "store:unit",
@@ -395,7 +425,28 @@ class TestTsTopRender:
                 },
             },
             "events": [{"ts": 1.0, "kind": "fault", "name": "shm.landing"}],
+            "autoscale": {
+                "actions": [
+                    {
+                        "kind": "scale_out",
+                        "subject": "fleet",
+                        "reason": "landing brackets saturated on v0",
+                    }
+                ],
+                "fleet": {
+                    "volumes": 3,
+                    "draining": ["v2"],
+                    "idle_rounds": 0,
+                    "spilled_keys": {"v0": 5},
+                },
+            },
         }
+        data["history"]["processes"]["client"]["series"][
+            "ts_fleet_volumes"
+        ] = {"kind": "gauge", "points": _rows([1.0, 2.0, 3.0])}
+        data["history"]["processes"]["client"]["series"][
+            "ts_blob_bytes"
+        ] = {"kind": "gauge", "points": _rows([0.0, 4096.0])}
         frame = ts_top.render_frame(data)
         assert "ts-top — store:unit" in frame
         assert "ops/s" in frame and "get p99" in frame
@@ -406,6 +457,10 @@ class TestTsTopRender:
         assert "plan migrate k" in frame
         assert "[fault] shm.landing" in frame
         assert "unreachable: volume:v1" in frame
+        assert "3 vol (1 draining" in frame
+        assert "blob 4.0K" in frame
+        assert "5 key(s) blob-eligible" in frame
+        assert "plan scale_out fleet: landing brackets saturated" in frame
         # Every section optional: an empty frame still renders.
         assert ts_top.render_frame({}).startswith("ts-top")
 

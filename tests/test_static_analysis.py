@@ -1263,6 +1263,57 @@ def test_control_discipline_pragma(tmp_path):
     assert result.new == []
 
 
+def test_control_discipline_autoscale_scope(tmp_path):
+    """ISSUE 18: the rule also covers ``torchstore_tpu/autoscale/`` and
+    the fleet actuators (drain marking, retire detach/drop, blob
+    demote/archive endpoint wrappers) — a silent scale actuation is
+    flagged, an audited one passes, and the same names outside both
+    planes stay out of scope (the api-layer spawn executor owns its own
+    event discipline)."""
+    from torchstore_tpu.analysis.checkers import control_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/autoscale/engine.py": """
+                class Engine:
+                    async def silent_drain(self, host, vid, dst, key):
+                        host.mark_draining(vid)  # seeded defect
+                        await host.idx.migrate_key(
+                            key, vid, dst, drop_src=True
+                        )  # seeded defect: no decision event
+
+                    async def silent_demote(self, ref):
+                        await ref.blob_sweep.call_one(8)  # seeded defect
+
+                    async def audited_retire(self, host, vid, snap, action):
+                        await host.idx.detach_volume(vid)
+                        await host.drop_volume(vid)
+                        return self._decision(snap, action, "applied")
+            """,
+            "torchstore_tpu/api.py": """
+                async def spawn_executor(controller, vid, ref, hostname):
+                    return await controller.attach_volume.call_one(
+                        vid, ref, hostname
+                    )
+            """,
+        },
+    )
+    findings = control_discipline.check(project)
+    assert all(
+        f.path == "torchstore_tpu/autoscale/engine.py" for f in findings
+    )
+    flagged = sorted(
+        (msg.split("'")[1], msg.split("'")[3])
+        for msg in _msgs(findings, "control-discipline")
+    )
+    assert flagged == [
+        ("blob_sweep", "silent_demote"),
+        ("mark_draining", "silent_drain"),
+        ("migrate_key", "silent_drain"),
+    ], flagged
+
+
 def test_control_discipline_live_tree_clean():
     """The live tree stays clean under the new rule (baseline stays
     empty): every engine actuator path returns through ``_decision()``,

@@ -11,7 +11,11 @@ SHM / bulk-TCP (ICI-adjacent / DCN) / RPC transport ladder.
 from torchstore_tpu.api import (
     DEFAULT_STORE,
     Shard,
+    autoscale,
+    autoscale_plan,
     barrier,
+    blob_checkpoint,
+    blob_restore,
     clear_faults,
     client,
     collect_trace,
@@ -106,7 +110,11 @@ __all__ = [
     "TransportType",
     "WeightPublisher",
     "WeightSubscriber",
+    "autoscale",
+    "autoscale_plan",
     "barrier",
+    "blob_checkpoint",
+    "blob_restore",
     "clear_faults",
     "client",
     "collect_trace",

@@ -1,16 +1,21 @@
 """control-discipline: every actuator call site in ``torchstore_tpu/control/``
-must record a flight-recorder ``decision`` event in the same function.
+and ``torchstore_tpu/autoscale/`` must record a flight-recorder
+``decision`` event in the same function.
 
 The control plane's whole audit story (ISSUE 16) is that *no* placement
 mutation happens silently: the engine funnels every applied/deferred/
 abandoned action through ``_decision()``, which increments
 ``ts_control_decisions_total`` and records a ``decision`` flight-recorder
-event. A new actuator call site that skips the funnel would mutate
-placement invisibly — exactly the regression this rule pins.
+event. The autoscale plane (ISSUE 18) inherits the same contract for
+scale/drain/retire/demote actuations. A new actuator call site that
+skips the funnel would mutate the fleet invisibly — exactly the
+regression this rule pins.
 
-Mechanics: for each function scope in a ``control/`` module, if the scope
-calls an actuator — ``migrate_key``, ``pull_from``, ``tier_sweep``,
-``set_tiers`` (directly or through an endpoint wrapper like
+Mechanics: for each function scope in a ``control/`` or ``autoscale/``
+module, if the scope calls an actuator — ``migrate_key``, ``pull_from``,
+``tier_sweep``, ``set_tiers``, ``attach_volume``, ``detach_volume``,
+``drop_volume``, ``mark_draining``, ``blob_sweep``, ``blob_archive``
+(directly or through an endpoint wrapper like
 ``ref.tier_sweep.call_one``), or re-parents a relay by assigning into
 ``_relay_prefer`` — the same scope must also contain a decision-audit
 call: a call to ``_decision``/``record_decision``, or a ``record(...)``
@@ -18,9 +23,9 @@ whose first argument is the literal ``"decision"``. Nested function
 bodies are separate scopes (the audit must live where the actuation
 lives, not in a sibling closure).
 
-Modules outside ``control/`` are out of scope: the storage/metadata
+Modules outside these planes are out of scope: the storage/metadata
 planes call these same primitives on their own authority (auto-repair,
-reclaim) with their own event discipline.
+reclaim, the api-layer spawn executor) with their own event discipline.
 """
 
 from __future__ import annotations
@@ -38,10 +43,21 @@ from torchstore_tpu.analysis.core import (
 
 RULE = "control-discipline"
 
-_SCOPE_PREFIX = "torchstore_tpu/control/"
+_SCOPE_PREFIXES = ("torchstore_tpu/control/", "torchstore_tpu/autoscale/")
 
-# Attribute names that mutate placement/tier/relay state when called.
-_ACTUATORS = {"migrate_key", "pull_from", "tier_sweep", "set_tiers"}
+# Attribute names that mutate placement/tier/relay/fleet state when called.
+_ACTUATORS = {
+    "migrate_key",
+    "pull_from",
+    "tier_sweep",
+    "set_tiers",
+    "attach_volume",
+    "detach_volume",
+    "drop_volume",
+    "mark_draining",
+    "blob_sweep",
+    "blob_archive",
+}
 
 # Endpoint-invocation wrappers: ``ref.tier_sweep.call_one(...)`` actuates
 # tier_sweep even though the call tail is ``call_one``.
@@ -88,7 +104,7 @@ def _relay_assign_target(node: ast.AST) -> bool:
 def check(project: Project) -> list[Finding]:
     findings: list[Finding] = []
     for sf in project.files:
-        if sf.tree is None or not sf.path.startswith(_SCOPE_PREFIX):
+        if sf.tree is None or not sf.path.startswith(_SCOPE_PREFIXES):
             continue
         for func, body in iter_function_scopes(sf.tree):
             actuations: list[tuple[int, str]] = []  # (line, actuator)

@@ -56,6 +56,8 @@ class _StoreHandle:
     repair_meshes: list = None  # replacement volumes spawned by repair()
     shard_mesh: Any = None  # ControllerShard actors (sharded metadata plane)
     retired_shard_meshes: list = None  # pre-reshard meshes (stopped at shutdown)
+    autoscale_meshes: list = None  # [{"vid", "mesh"}] spawned by ts.autoscale()
+    volume_env_fn: Any = None  # per-rank env overrides (reused by autoscale)
 
 
 # Per-process store registry: forked actor children never reuse the parent's
@@ -228,6 +230,8 @@ async def initialize(
         repair_meshes=[],
         shard_mesh=shard_mesh,
         retired_shard_meshes=[],
+        autoscale_meshes=[],
+        volume_env_fn=volume_env_fn,
     )
     return controller
 
@@ -1303,6 +1307,256 @@ async def rebalance(
     return result
 
 
+async def autoscale_plan(store_name: str = DEFAULT_STORE) -> dict:
+    """Dry run of the elastic-fleet policy engine: assemble the autoscale
+    telemetry snapshot (fleet traffic + SLO overload + per-volume stats
+    with spilled-key counts), run the pure solver, and return the actions
+    it WOULD take — applying nothing, recording nothing, not even
+    advancing the idle-round hysteresis counter. Returns ``{"actions":
+    [{kind, subject, reason, ...}], "snapshot": {...}, "fleet": {...}}``."""
+    c = client(store_name)
+    await c._ensure_setup()
+    traffic, overload = await _control_signals(store_name)
+    return await c.controller.autoscale_plan.call_one(
+        traffic=traffic, overload=overload
+    )
+
+
+async def autoscale(store_name: str = DEFAULT_STORE) -> dict:
+    """Run ONE autoscale round now — snapshot, solve, apply, audit — and
+    execute any deferred ``scale_out`` actions by actually spawning fresh
+    volume actors (actor spawning is client-side, so the controller defers
+    spawns exactly like ``rebalance(shards=N)`` defers resharding).
+
+    Drain / retire / blob-demote actions apply controller-side inside the
+    round. Scale-out spawns happen HERE, in the process that initialized
+    the store: each new volume gets a unique forced volume id, the store's
+    base volume env (plus ``volume_env_fn`` overrides at a fresh rank),
+    and is attached through ``controller.attach_volume`` — then one
+    control-plane reconcile runs so hot-key splits can seed placement onto
+    the new capacity immediately. Retired autoscale-spawned volumes have
+    their actor processes stopped (fixed fleet volumes retire from the
+    placement maps but their processes stop with the store).
+
+    Safe alongside the periodic loop
+    (``TORCHSTORE_TPU_AUTOSCALE_INTERVAL_S``): per-subject cooldowns and
+    reversal damping keep back-to-back rounds from thrashing. Returns the
+    round report with ``spawned``/``stopped`` volume-id lists merged in."""
+    c = client(store_name)
+    await c._ensure_setup()
+    traffic, overload = await _control_signals(store_name)
+    result = await c.controller.autoscale_reconcile.call_one(
+        traffic=traffic, overload=overload
+    )
+    handle = _stores.get(store_name)
+    actions = result.get("actions", [])
+    wants = sum(
+        int(a.get("count") or 1)
+        for a in actions
+        if a.get("kind") == "scale_out"
+        and str(a.get("outcome", "")).startswith("deferred")
+    )
+    spawned: list[str] = []
+    stopped: list[str] = []
+    if wants:
+        if handle is None or not handle.owner:
+            # Only the initializing process owns actor spawning; other
+            # processes surface the deferral for it to pick up.
+            result["spawn_deferred"] = wants
+        else:
+            spawned = await _autoscale_spawn(store_name, handle, wants)
+            if spawned:
+                # Seed placement onto the new capacity immediately: one
+                # control round can split hot keys / rebalance replicas
+                # instead of waiting for the next interval.
+                try:
+                    await c.controller.control_reconcile.call_one(
+                        traffic=traffic, overload=overload
+                    )
+                except Exception as exc:  # noqa: BLE001 - placement seeding
+                    # is best-effort; the periodic loop converges anyway
+                    logger.warning(
+                        "autoscale: placement seeding reconcile failed: %s",
+                        exc,
+                    )
+            await c.refresh_volumes()
+    retired = {
+        str(a.get("subject"))
+        for a in actions
+        if a.get("kind") == "retire_volume"
+        and str(a.get("outcome", "")).startswith("applied")
+    }
+    if retired and handle is not None and handle.owner:
+        # Reclaim the processes of autoscale-spawned volumes that just
+        # retired — THIS is what makes scale-in save volume-seconds.
+        for rec in handle.autoscale_meshes or []:
+            if rec["vid"] in retired and rec["mesh"] is not None:
+                await rec["mesh"].stop()
+                rec["mesh"] = None
+                stopped.append(rec["vid"])
+    if retired:
+        await c.refresh_volumes()
+    result["spawned"] = spawned
+    result["stopped"] = stopped
+    return result
+
+
+async def _autoscale_spawn(
+    store_name: str, handle: _StoreHandle, count: int
+) -> list[str]:
+    """Spawn ``count`` fresh storage volumes and attach them to the live
+    fleet (the actuator half of a ``scale_out`` decision). Each spawn
+    crosses the ``autoscale.spawn`` faultpoint; a failed spawn stops the
+    batch and reports what DID attach rather than raising away the round."""
+    from torchstore_tpu import faults
+
+    strategy = await handle.controller.get_strategy.call_one()
+    if handle.autoscale_meshes is None:
+        handle.autoscale_meshes = []
+    spawned: list[str] = []
+    for _ in range(count):
+        gen = len(handle.autoscale_meshes)
+        vid = f"scale-{gen}"
+        try:
+            await faults.afire("autoscale.spawn")
+            mesh = await spawn_actors(
+                1,
+                StorageVolume,
+                f"ts_{store_name}_volume_{vid}",
+                strategy,
+                env_fn=lambda rank, _vid=vid, _gen=gen: {
+                    **handle.volume_env,
+                    **(
+                        (handle.volume_env_fn(_gen) or {})
+                        if handle.volume_env_fn
+                        else {}
+                    ),
+                    "TORCHSTORE_TPU_VOLUME_ID": _vid,
+                },
+            )
+        except Exception as exc:  # noqa: BLE001 - partial scale-out is
+            # still progress; the next round retries the remainder
+            logger.warning("autoscale: spawning %s failed: %s", vid, exc)
+            break
+        handle.autoscale_meshes.append({"vid": vid, "mesh": mesh})
+        new_ref = mesh.refs[0]
+        try:
+            info = await new_ref.get_id.call_one()
+            await handle.controller.attach_volume.call_one(
+                vid, new_ref, info["hostname"]
+            )
+        except Exception as exc:  # noqa: BLE001 - an unattachable volume
+            # must not leak its process
+            logger.warning("autoscale: attaching %s failed: %s", vid, exc)
+            await mesh.stop()
+            handle.autoscale_meshes[-1]["mesh"] = None
+            break
+        spawned.append(vid)
+    if spawned:
+        logger.info(
+            "autoscale(%s): spawned + attached %s", store_name, spawned
+        )
+    return spawned
+
+
+async def blob_checkpoint(store_name: str = DEFAULT_STORE) -> dict:
+    """Archive every live volume's committed payloads into the blob cold
+    tier and write the durable fleet manifest — the prerequisite for
+    scale-to-zero. After this returns, the whole fleet can be killed and a
+    fresh one cold-started with ``ts.blob_restore()`` recovering every
+    committed generation from the blob tier. Requires
+    ``TORCHSTORE_TPU_BLOB_ENABLED=1``. Returns ``{"outcome", "keys",
+    "volumes", "errors"}``."""
+    c = client(store_name)
+    await c._ensure_setup()
+    return await c.controller.blob_checkpoint.call_one()
+
+
+async def blob_restore(store_name: str = DEFAULT_STORE) -> dict:
+    """Cold-start restore: read the durable fleet manifest from the blob
+    tier, decode each archived object, and land every committed key into
+    the (fresh) fleet via the targeted-replication path — byte-for-byte
+    the payloads the last ``ts.blob_checkpoint()`` captured. Keys restore
+    round-robin across live volumes and are indexed with fresh write
+    generations (reclaim tokens stay sound on the new fleet). Failed keys
+    are reported, never abort the rest. Returns ``{"restored", "failed",
+    "keys", "seconds"}`` and audits the round as an
+    ``autoscale/blob_restore`` decision."""
+    from torchstore_tpu.observability import recorder as obs_recorder
+    from torchstore_tpu.tiering import blob as blob_mod
+    from torchstore_tpu.transport.types import Request
+
+    if not blob_mod.enabled():
+        raise RuntimeError(
+            "blob tier disabled; set TORCHSTORE_TPU_BLOB_ENABLED=1"
+        )
+    store = blob_mod.BlobStore()
+    doc = blob_mod.read_fleet_manifest(store)
+    if doc is None:
+        raise RuntimeError(
+            "no fleet manifest in the blob tier; run ts.blob_checkpoint() "
+            "on a live fleet first"
+        )
+    c = client(store_name)
+    await c._ensure_setup()
+    vmap = await c.controller.get_volume_map.call_one()
+    vids = sorted(
+        vid
+        for vid, info in vmap.items()
+        if info.get("health") not in ("quarantined", "draining")
+    )
+    if not vids:
+        raise RuntimeError("no live volumes to restore onto")
+    t0 = time.perf_counter()
+    restored: list[str] = []
+    failed: list[str] = []
+    for i, (key, info) in enumerate(sorted(doc.get("keys", {}).items())):
+        try:
+            metas, values = blob_mod.BlobTier.decode_entry(
+                store.get(info["object"])
+            )
+            requests = []
+            for idx, meta in enumerate(metas):
+                val = values[idx]
+                if meta.is_object:
+                    requests.append(Request(key=key, is_object=True, objects=val))
+                elif meta.tensor_slice is not None:
+                    requests.append(
+                        Request.from_tensor_slice(key, meta.tensor_slice, val)
+                    )
+                else:
+                    requests.append(Request.from_tensor(key, val))
+            await c.replicate_to(vids[i % len(vids)], requests)
+            restored.append(key)
+        except Exception as exc:  # noqa: BLE001 - reported, not fatal
+            logger.warning("blob_restore: %r failed: %s", key, exc)
+            failed.append(key)
+    seconds = time.perf_counter() - t0
+    obs_recorder.record(
+        "decision",
+        "autoscale/blob_restore",
+        subject="fleet",
+        reason="cold restore from the blob-tier fleet manifest",
+        outcome="applied" if not failed else "applied: %d failed" % len(failed),
+        restored=len(restored),
+        failed=len(failed),
+        seconds=round(seconds, 3),
+    )
+    logger.info(
+        "blob_restore(%s): %d key(s) restored, %d failed, %.2fs",
+        store_name,
+        len(restored),
+        len(failed),
+        seconds,
+    )
+    return {
+        "restored": len(restored),
+        "failed": failed,
+        "keys": len(doc.get("keys", {})),
+        "seconds": seconds,
+    }
+
+
 def collect_trace(out_path: Optional[str] = None) -> Optional[dict]:
     """Merge every process's Chrome-trace file (``TORCHSTORE_TPU_TRACE``
     base + pid-suffixed siblings) into ONE Perfetto-loadable timeline with
@@ -1370,6 +1624,9 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
             await mesh.stop()
         for mesh in handle.repair_meshes or []:
             await mesh.stop()
+        for rec in handle.autoscale_meshes or []:
+            if rec["mesh"] is not None:
+                await rec["mesh"].stop()
         if handle.inproc_volume is not None:
             await _stop_colocated_volume(handle.inproc_volume)
         await stop_singleton(f"ts_{store_name}_controller")
@@ -1379,7 +1636,11 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
 __all__ = [
     "DEFAULT_STORE",
     "Shard",
+    "autoscale",
+    "autoscale_plan",
     "barrier",
+    "blob_checkpoint",
+    "blob_restore",
     "client",
     "collect_trace",
     "control_plan",

@@ -1,0 +1,41 @@
+"""Elastic fleet autoscaling: capacity follows load.
+
+The subsystem that closes ROADMAP item 4 — the fleet size was fixed at
+``initialize()`` while traffic is diurnal. It mirrors the control plane's
+layering exactly:
+
+- ``autoscale/solver.py`` — a pure function over the control plane's
+  frozen :class:`~torchstore_tpu.control.snapshot.TelemetrySnapshot`
+  (extended with the engine-side fleet view: draining set, fleet
+  bounds). Scale OUT on sustained landing-inflight saturation / SLO
+  overload trends, scale IN on sustained fleet-wide idle, with the same
+  hysteresis/cooldown discipline as ``control/solver.py``.
+- ``autoscale/engine.py`` — the controller-side executor
+  (:class:`AutoscaleEngine`): periodic loop behind
+  ``TORCHSTORE_TPU_AUTOSCALE_INTERVAL_S``, manual ``ts.autoscale()``
+  trigger, ``ts.autoscale_plan()`` dry run. Every action — spawn
+  deferral, drain, retire, blob demotion, checkpoint — flows through
+  its ``_decision()`` audit chokepoint (tslint ``control-discipline``
+  enforces this for every actuator call site in this package).
+
+Spawn itself happens CLIENT-side (``ts.autoscale()`` in the process
+that initialized the store, which owns actor spawning — the same split
+as ``ts.rebalance(shards=N)``); the engine surfaces scale-out as a
+``deferred`` decision and adopts the new volume via the controller's
+``attach_volume`` endpoint.
+"""
+
+from torchstore_tpu.autoscale.engine import AutoscaleEngine, policy_from_env
+from torchstore_tpu.autoscale.solver import (
+    AutoscaleAction,
+    AutoscalePolicy,
+    solve,
+)
+
+__all__ = [
+    "AutoscaleAction",
+    "AutoscaleEngine",
+    "AutoscalePolicy",
+    "policy_from_env",
+    "solve",
+]

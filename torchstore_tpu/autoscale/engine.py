@@ -1,0 +1,581 @@
+"""The autoscale engine: scrape the fleet, solve, scale — audited.
+
+One ``AutoscaleEngine`` lives inside the Controller (coordinator)
+process next to the control engine and mirrors its phased round:
+SNAPSHOT (a stats-only fan-out folded into the same frozen
+:class:`TelemetrySnapshot` the placement solver reads, plus the
+engine-side :class:`FleetView` — draining set, size envelope, the
+consecutive-idle-round counter, the blob tier's spilled backlog), SOLVE
+(the pure policy in ``autoscale/solver.py``), ACT.
+
+Actuation split (the one asymmetry vs. the control engine): the
+coordinator cannot spawn actors — the owner process that called
+``initialize()`` does. A ``scale_out`` action is therefore surfaced as a
+``deferred`` decision and executed by ``ts.autoscale()`` client-side
+(spawn via the initialize spawn path + ``volume_env_fn``, adopt via the
+controller's ``attach_volume`` endpoint, then a control reconcile seeds
+placement onto the empty volume). Drain, retire, and blob demotion ARE
+coordinator-reachable and apply inline: drain marks the volume draining
+(clients route puts around it, reads keep serving) and migrates resident
+keys batch-by-batch through ``idx.migrate_key`` — the same online-move
+actuator as control migrations — and retire detaches the empty volume
+from the index and the fleet maps.
+
+Every applied, deferred, refused, or failed action lands in the flight
+recorder as a ``decision`` event (``autoscale/<kind>``) and in the
+``ts_autoscale_*`` metrics; ``plan()`` is the dry-run half
+``ts.autoscale_plan()`` serves. ``checkpoint()`` is the scale-to-zero
+half: every volume archives its committed payloads into the blob tier
+and the engine writes the durable fleet manifest a cold restore replays.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Mapping, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu.autoscale.solver import (
+    BLOB_DEMOTE,
+    DRAIN,
+    RETIRE,
+    SCALE_OUT,
+    AutoscaleAction,
+    AutoscalePolicy,
+    FleetView,
+    _fleet_idle,
+    solve,
+)
+from torchstore_tpu.control.snapshot import TelemetrySnapshot, build_snapshot
+from torchstore_tpu.control.solver import ActionRecord
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.tiering import blob as blob_mod
+
+logger = get_logger("torchstore_tpu.autoscale.engine")
+
+_DECISIONS = obs_metrics.counter(
+    "ts_autoscale_decisions_total",
+    "Autoscale decisions, by action kind and outcome",
+)
+_ROUNDS = obs_metrics.counter(
+    "ts_autoscale_rounds_total",
+    "Autoscale reconcile rounds, by trigger",
+)
+_LAST_ACTIONS = obs_metrics.gauge(
+    "ts_autoscale_last_actions",
+    "Actions the last autoscale round decided",
+)
+_FLEET_VOLUMES = obs_metrics.gauge(
+    "ts_fleet_volumes",
+    "Storage volumes currently attached to the fleet",
+)
+_FLEET_DRAINING = obs_metrics.gauge(
+    "ts_fleet_draining",
+    "Storage volumes currently draining toward retirement",
+)
+
+# Engine-only action kind: not solver-emitted — ``checkpoint()`` routes
+# the manual scale-to-zero archive through the same audit chokepoint.
+BLOB_CHECKPOINT = "blob_checkpoint"
+
+# Same damping-memory depth as the control engine.
+_HISTORY = 256
+
+
+def policy_from_env() -> AutoscalePolicy:
+    """Solver thresholds with ``TORCHSTORE_TPU_AUTOSCALE_*`` overrides
+    (raw-environ pattern: the engine lives in the controller process,
+    not behind StoreConfig)."""
+
+    def _f(name: str, default: float) -> float:
+        raw = os.environ.get(name)
+        return float(raw) if raw not in (None, "") else default
+
+    base = AutoscalePolicy()
+    return AutoscalePolicy(
+        min_volumes=int(
+            _f("TORCHSTORE_TPU_AUTOSCALE_MIN_VOLUMES", base.min_volumes)
+        ),
+        max_volumes=int(
+            _f("TORCHSTORE_TPU_AUTOSCALE_MAX_VOLUMES", base.max_volumes)
+        ),
+        out_inflight=int(
+            _f("TORCHSTORE_TPU_AUTOSCALE_OUT_INFLIGHT", base.out_inflight)
+        ),
+        out_window_bytes=int(
+            _f(
+                "TORCHSTORE_TPU_AUTOSCALE_OUT_WINDOW_BYTES",
+                base.out_window_bytes,
+            )
+        ),
+        idle_window_bytes=int(
+            _f(
+                "TORCHSTORE_TPU_AUTOSCALE_IDLE_WINDOW_BYTES",
+                base.idle_window_bytes,
+            )
+        ),
+        idle_rounds=int(
+            _f("TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS", base.idle_rounds)
+        ),
+        drain_keys_per_round=int(
+            _f(
+                "TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND",
+                base.drain_keys_per_round,
+            )
+        ),
+        blob_keys_per_round=int(
+            _f(
+                "TORCHSTORE_TPU_AUTOSCALE_BLOB_KEYS_PER_ROUND",
+                base.blob_keys_per_round,
+            )
+        ),
+        cooldown_s=_f("TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S", base.cooldown_s),
+        max_actions=int(
+            _f("TORCHSTORE_TPU_AUTOSCALE_MAX_ACTIONS", base.max_actions)
+        ),
+    )
+
+
+async def _maybe_await(value: Any) -> Any:
+    """``host.idx`` is the in-process IndexCore or the sharded
+    RemoteIndex; ``export_entries`` is sync on one, async on the other."""
+    if hasattr(value, "__await__"):
+        return await value
+    return value
+
+
+class AutoscaleEngine:
+    """Controller-side executor for the scale policy (see module doc).
+
+    ``host`` is the Controller actor instance — the engine reaches the
+    fleet only through its surface (``volume_refs``, ``idx``, the
+    ``_draining`` set and health/epoch helpers), never through raw
+    index structures."""
+
+    def __init__(self, host: Any, policy: Optional[AutoscalePolicy] = None):
+        self.host = host
+        self.policy = policy or policy_from_env()
+        self.history: deque[ActionRecord] = deque(maxlen=_HISTORY)
+        self._rounds = 0
+        self._idle_rounds = 0
+
+    # ---- SNAPSHOT --------------------------------------------------------
+
+    async def snapshot(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+    ) -> tuple[TelemetrySnapshot, dict[str, int]]:
+        """Freeze the fleet load view the scale solver reads: a
+        stats-only fan-out (no key placement / cold-key / relay legs —
+        the scale solver never reads them), folded through the same
+        ``build_snapshot`` normalizer as the control engine. Also
+        returns the per-volume disk-spilled key counts (the blob
+        demotion backlog the TelemetrySnapshot doesn't carry)."""
+        import asyncio
+
+        host = self.host
+        quarantined = host.quarantined_ids()
+        live = {
+            vid: ref
+            for vid, ref in host.volume_refs.items()
+            if vid not in quarantined
+        }
+
+        async def one_stats(vid: str, ref: Any):
+            try:
+                return vid, await asyncio.wait_for(
+                    ref.stats.call_one(), timeout=10.0
+                )
+            except Exception as exc:  # noqa: BLE001 - a dark volume is the
+                # supervisor's problem; the solver plans around it
+                logger.debug(
+                    "autoscale snapshot: stats(%s) failed: %s", vid, exc
+                )
+                return vid, None
+
+        results = await asyncio.gather(
+            *(one_stats(vid, ref) for vid, ref in live.items())
+        )
+        volume_stats = {vid: st for vid, st in results if st is not None}
+        spilled = {
+            vid: int((st.get("tier") or {}).get("spilled_keys", 0) or 0)
+            for vid, st in volume_stats.items()
+        }
+        snap = build_snapshot(
+            traffic=traffic,
+            overload=overload,
+            volume_stats=volume_stats,
+            # Only volumes that ANSWERED: build_snapshot backfills
+            # placement-only vids as zero-load rows, and a zero-load
+            # phantom (dark or quarantined) would look like the ideal
+            # drain victim and re-enter the draining set forever.
+            placement={
+                vid: hostname
+                for vid, hostname in host.volume_hostnames.items()
+                if vid in volume_stats
+            },
+            n_shards=len(host._shard_refs) or 1,
+            generated_ts=time.monotonic(),
+        )
+        self.publish_fleet_gauges()
+        return snap, spilled
+
+    def publish_fleet_gauges(self) -> None:
+        """Refresh the fleet-size gauges (the PR 17 history sampler
+        retains them, feeding the ts_top fleet pane) — called on every
+        snapshot and on every attach/drain/retire transition."""
+        _FLEET_VOLUMES.set(len(self.host.volume_refs))
+        _FLEET_DRAINING.set(len(self.host._draining))
+
+    def _fleet_view(self, spilled: Mapping[str, int]) -> FleetView:
+        return FleetView(
+            draining=frozenset(self.host._draining),
+            min_volumes=self.policy.min_volumes,
+            max_volumes=self.policy.max_volumes,
+            idle_rounds=self._idle_rounds,
+            blob_enabled=blob_mod.enabled(),
+            spilled_keys=dict(spilled),
+        )
+
+    # ---- SOLVE -----------------------------------------------------------
+
+    async def plan(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+    ) -> dict[str, Any]:
+        """Dry run: what the engine WOULD do, touching nothing and
+        recording nothing (``ts.autoscale_plan()``). The idle-round
+        hysteresis counter is not advanced — planning is side-effect
+        free."""
+        snap, spilled = await self.snapshot(traffic=traffic, overload=overload)
+        fleet = self._fleet_view(spilled)
+        actions = solve(snap, fleet, self.policy, self.history)
+        return {
+            "actions": [a.describe() for a in actions],
+            "snapshot": snap.describe(),
+            "fleet": {
+                "volumes": len(self.host.volume_refs),
+                "draining": sorted(fleet.draining),
+                "idle_rounds": fleet.idle_rounds,
+                "blob_enabled": fleet.blob_enabled,
+                "spilled_keys": dict(spilled),
+            },
+            "history": len(self.history),
+        }
+
+    # ---- ACT -------------------------------------------------------------
+
+    async def reconcile(
+        self,
+        traffic: Optional[Mapping[str, Any]] = None,
+        overload: Optional[Mapping[str, Any]] = None,
+        trigger: str = "interval",
+    ) -> dict[str, Any]:
+        """One full round: snapshot, advance the idle hysteresis
+        counter, solve, apply. Returns the per-action outcomes (also
+        recorded as ``decision`` events)."""
+        _ROUNDS.inc(trigger=trigger)
+        self._rounds += 1
+        snap, spilled = await self.snapshot(traffic=traffic, overload=overload)
+        live = {
+            vid: v
+            for vid, v in snap.volumes.items()
+            if vid not in self.host._draining
+        }
+        if live and _fleet_idle(snap, live, self.policy):
+            self._idle_rounds += 1
+        else:
+            self._idle_rounds = 0
+        fleet = self._fleet_view(spilled)
+        actions = solve(snap, fleet, self.policy, self.history)
+        _LAST_ACTIONS.set(len(actions))
+        outcomes = []
+        for action in actions:
+            outcome = await self._apply(snap, action)
+            outcomes.append({**action.describe(), "outcome": outcome})
+            # Failed actions enter history too: a drain that errored must
+            # cool down, not retry every round.
+            self.history.append(
+                ActionRecord(
+                    ts=snap.generated_ts,
+                    kind=action.kind,
+                    subject=action.subject,
+                    src_volume=action.volume,
+                )
+            )
+        return {
+            "round": self._rounds,
+            "trigger": trigger,
+            "actions": outcomes,
+            "snapshot": snap.describe(),
+            "fleet": {
+                "volumes": len(self.host.volume_refs),
+                "draining": sorted(self.host._draining),
+                "idle_rounds": self._idle_rounds,
+            },
+        }
+
+    async def _apply(
+        self, snap: TelemetrySnapshot, action: AutoscaleAction
+    ) -> str:
+        import asyncio
+
+        try:
+            if action.kind == SCALE_OUT:
+                return self._apply_scale_out(snap, action)
+            if action.kind == DRAIN:
+                return await self._apply_drain(snap, action)
+            if action.kind == RETIRE:
+                return await self._apply_retire(snap, action)
+            if action.kind == BLOB_DEMOTE:
+                return await self._apply_blob_demote(snap, action)
+            return self._decision(snap, action, "skipped: unknown kind")
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - one action's failure
+            # must not abort the round; the outcome says it failed
+            logger.warning(
+                "autoscale action %s/%s failed: %s",
+                action.kind,
+                action.subject,
+                exc,
+            )
+            return self._decision(
+                snap, action, f"error: {type(exc).__name__}: {exc}"
+            )
+
+    def _apply_scale_out(
+        self, snap: TelemetrySnapshot, action: AutoscaleAction
+    ) -> str:
+        """The engine cannot spawn volume actors (the owner process
+        does); a scale-out decision is surfaced — loudly — for
+        ``ts.autoscale()`` to execute via the spawn + ``attach_volume``
+        path. The decision event IS the actuation here, mirroring the
+        control engine's reshard deferral."""
+        return self._decision(
+            snap, action, "deferred: run ts.autoscale() to spawn %d" % action.count
+        )
+
+    def _migration_target(
+        self, snap: TelemetrySnapshot, src: str
+    ) -> Optional[str]:
+        """Least-loaded live volume to receive a draining volume's keys
+        (excluding draining and quarantined peers)."""
+        host = self.host
+        quarantined = host.quarantined_ids()
+        candidates = [
+            v
+            for vid, v in snap.volumes.items()
+            if vid != src
+            and vid not in host._draining
+            and vid not in quarantined
+            and vid in host.volume_refs
+        ]
+        if not candidates:
+            return None
+        best = min(
+            candidates,
+            key=lambda v: (v.window_bytes, v.stored_bytes, v.volume_id),
+        )
+        return best.volume_id
+
+    async def _apply_drain(
+        self, snap: TelemetrySnapshot, action: AutoscaleAction
+    ) -> str:
+        """Graceful drain, one batch per round: mark the volume draining
+        (clients exclude it from NEW placements while reads keep
+        serving), then migrate up to ``action.count`` resident keys onto
+        live volumes through ``idx.migrate_key`` — the same online-move
+        actuator (pull_from + write-generation race check) as control
+        migrations and auto-repair."""
+        await faults.afire("autoscale.drain")
+        host = self.host
+        vid = action.volume
+        if vid not in host.volume_refs:
+            host.clear_draining(vid)
+            return self._decision(snap, action, "abandoned: volume gone")
+        newly = host.mark_draining(vid)
+        dst = self._migration_target(snap, vid)
+        if dst is None:
+            return self._decision(
+                snap, action, "abandoned: no migration target", marked=newly
+            )
+        entries = await _maybe_await(host.idx.export_entries())
+        resident = sorted({meta.key for evid, meta, _gen in entries if evid == vid})
+        moved = abandoned = 0
+        nbytes = 0
+        for key in resident[: max(1, action.count)]:
+            result = await host.idx.migrate_key(key, vid, dst, drop_src=True)
+            status = result.get("status", "error")
+            if status == "ok":
+                moved += 1
+                nbytes += int(result.get("nbytes", 0) or 0)
+            elif status == "present":
+                # Another replica already lives on dst; dropping the
+                # draining copy is still required — detach happens when
+                # migrate_key sees it, so count it as progress.
+                moved += 1
+            else:
+                abandoned += 1
+        return self._decision(
+            snap,
+            action,
+            "applied",
+            marked=newly,
+            dst_volume=dst,
+            moved=moved,
+            abandoned=abandoned,
+            nbytes=nbytes,
+            remaining=max(0, len(resident) - moved),
+        )
+
+    async def _apply_retire(
+        self, snap: TelemetrySnapshot, action: AutoscaleAction
+    ) -> str:
+        """Terminal drain state: verify the index really holds nothing on
+        the volume (the stats-derived snapshot may lag), then detach it
+        from the index and every fleet map. The volume actor itself is
+        stopped by the owner process (``ts.autoscale()``) — the engine
+        only removes it from service."""
+        await faults.afire("autoscale.drain")
+        host = self.host
+        vid = action.volume
+        entries = await _maybe_await(host.idx.export_entries())
+        remaining = sorted({meta.key for evid, meta, _gen in entries if evid == vid})
+        if remaining:
+            return self._decision(
+                snap,
+                action,
+                "abandoned: %d entries remain" % len(remaining),
+            )
+        report = await host.idx.detach_volume(vid)
+        await host.drop_volume(vid)
+        return self._decision(
+            snap,
+            action,
+            "applied",
+            lost=len(report.get("lost", ())),
+            volumes=len(host.volume_refs),
+        )
+
+    async def _apply_blob_demote(
+        self, snap: TelemetrySnapshot, action: AutoscaleAction
+    ) -> str:
+        """Push up to ``action.count`` of the volume's disk-spilled keys
+        one rung down into the blob tier (the volume picks oldest-first;
+        index tier state is unchanged — the keys stay TIERED, only the
+        backing store moves)."""
+        ref = self.host.volume_refs.get(action.volume)
+        if ref is None:
+            return self._decision(snap, action, "abandoned: volume gone")
+        rep = await ref.blob_sweep.call_one(max(1, action.count))
+        if not rep.get("enabled"):
+            return self._decision(snap, action, "abandoned: blob tier disabled")
+        return self._decision(
+            snap,
+            action,
+            "applied",
+            archived=len(rep.get("archived", ())),
+            nbytes=int(rep.get("nbytes", 0) or 0),
+        )
+
+    # ---- scale-to-zero ---------------------------------------------------
+
+    async def checkpoint(self) -> dict[str, Any]:
+        """Archive every live volume's committed payloads into the blob
+        tier and write the durable fleet manifest — the prerequisite for
+        scale-to-zero (``ts.blob_checkpoint()``). Returns the manifest
+        summary; the archive itself is audited as a ``blob_checkpoint``
+        decision."""
+        import asyncio
+
+        host = self.host
+        action = AutoscaleAction(
+            kind=BLOB_CHECKPOINT,
+            subject="fleet",
+            reason="archive committed payloads for scale-to-zero",
+        )
+        snap, _spilled = await self.snapshot()
+        if not blob_mod.enabled():
+            outcome = self._decision(
+                snap, action, "abandoned: blob tier disabled"
+            )
+            return {"outcome": outcome, "keys": 0}
+        quarantined = host.quarantined_ids()
+        live = {
+            vid: ref
+            for vid, ref in host.volume_refs.items()
+            if vid not in quarantined
+        }
+
+        # The actuator fan-out stays in THIS scope (not a closure) so the
+        # control-discipline rule sees it beside its _decision audit.
+        vids = list(live)
+        results = await asyncio.gather(
+            *(live[vid].blob_archive.call_one() for vid in vids),
+            return_exceptions=True,
+        )
+        merged: dict[str, dict[str, Any]] = {}
+        errors = 0
+        for vid, rep in zip(vids, results):
+            if isinstance(rep, BaseException):
+                if isinstance(rep, asyncio.CancelledError):
+                    raise rep
+                # A failed archive shows up as missing keys in the manifest
+                # count; the decision outcome carries the error tally.
+                logger.warning("blob_archive(%s) failed: %s", vid, rep)
+                errors += 1
+                continue
+            for key, entry in (rep.get("objects") or {}).items():
+                known = merged.get(key)
+                if known is None or entry.get("write_gen", 0) >= known.get(
+                    "write_gen", 0
+                ):
+                    merged[key] = dict(entry)
+        store = blob_mod.BlobStore()
+        blob_mod.write_fleet_manifest(
+            store, merged, extra={"volumes": sorted(live)}
+        )
+        outcome = self._decision(
+            snap,
+            action,
+            "applied" if not errors else "applied: %d volume(s) errored" % errors,
+            keys=len(merged),
+            volumes=len(live),
+        )
+        return {
+            "outcome": outcome,
+            "keys": len(merged),
+            "volumes": len(live),
+            "errors": errors,
+        }
+
+    # ---- audit -----------------------------------------------------------
+
+    def _decision(
+        self,
+        snap: TelemetrySnapshot,
+        action: AutoscaleAction,
+        outcome: str,
+        **extra: Any,
+    ) -> str:
+        """The ONE decision-audit chokepoint: inputs (the snapshot
+        summary the solver saw), the chosen action, and what happened."""
+        _DECISIONS.inc(kind=action.kind, outcome=outcome.split(":")[0])
+        obs_recorder.record(
+            "decision",
+            f"autoscale/{action.kind}",
+            subject=action.subject,
+            reason=action.reason,
+            outcome=outcome,
+            action=action.describe(),
+            inputs=snap.describe(),
+            **extra,
+        )
+        return outcome

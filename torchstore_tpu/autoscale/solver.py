@@ -1,0 +1,285 @@
+"""The autoscale policy solver: a pure function over a frozen snapshot.
+
+``solve(snapshot, fleet, policy, history)`` returns the typed actions
+the engine (autoscale/engine.py) should apply. No clock, no I/O, no
+fleet access — the same inputs always produce the same plan, so every
+scaling behavior (saturation → scale out, sustained idle → drain, drain
+→ retire, anti-flap damping) is unit-testable over hand-built
+snapshots, exactly like ``control/solver.py``.
+
+Decision families, in priority order:
+
+1. ``retire_volume`` — a draining volume the index shows EMPTY: drop it
+   from the fleet (the terminal drain state).
+2. ``drain_volume`` (continuation) — a draining volume still holding
+   entries: migrate the next batch of resident keys onto live volumes.
+3. ``scale_out`` — sustained ``ts_landing_inflight`` saturation (the
+   PR 17 trend detectors' ``sustained_overload`` fold), point-in-time
+   landing-bracket saturation past ``out_inflight``, or fleet-mean
+   window bytes past ``out_window_bytes``, with room under
+   ``max_volumes``: add one volume (the engine defers the spawn to
+   ``ts.autoscale()`` — the owner process holds the spawner).
+4. ``drain_volume`` (entry) — the WHOLE fleet idle (every volume under
+   ``idle_window_bytes`` with an empty landing bracket, no sustained
+   overload) for ``idle_rounds`` consecutive engine rounds, with room
+   above ``min_volumes``: gracefully drain the emptiest volume.
+5. ``blob_demote`` — blob tier enabled, fleet not overloaded, and a
+   volume holds disk-spilled keys: push the cold tail one rung further
+   down (disk → blob) so an eventual scale-to-zero has everything
+   durable.
+
+Hysteresis / damping (the flap tests pin these):
+
+- One scale direction per round, and never a new drain while another
+  volume is still draining.
+- Cooldown: ``scale_out`` cools fleet-wide, ``drain_volume`` /
+  ``blob_demote`` per volume — within ``cooldown_s`` of the snapshot a
+  subject is never re-acted.
+- Reversal damping: a recent drain/retire suppresses scale-out and a
+  recent scale-out suppresses drain entry, regardless of the signals —
+  diurnal edges must not saw-tooth the fleet.
+- Budget: at most ``max_actions`` actions per round, priority order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping
+
+from torchstore_tpu.control.snapshot import TelemetrySnapshot
+from torchstore_tpu.control.solver import ActionRecord
+
+# Action kinds, in priority order (solve() emits them in this order and
+# truncates at policy.max_actions).
+RETIRE = "retire_volume"
+DRAIN = "drain_volume"
+SCALE_OUT = "scale_out"
+BLOB_DEMOTE = "blob_demote"
+
+KINDS = (RETIRE, DRAIN, SCALE_OUT, BLOB_DEMOTE)
+
+
+@dataclass(frozen=True)
+class AutoscaleAction:
+    """One decided scale action. ``subject`` is the hysteresis identity
+    (``"fleet"`` for scale-out, the volume id otherwise)."""
+
+    kind: str
+    subject: str
+    reason: str
+    volume: str = ""
+    count: int = 0
+    detail: dict = field(default_factory=dict)
+
+    def describe(self) -> dict[str, Any]:
+        out = {
+            "kind": self.kind,
+            "subject": self.subject,
+            "reason": self.reason,
+        }
+        if self.volume:
+            out["volume"] = self.volume
+        if self.count:
+            out["count"] = self.count
+        if self.detail:
+            out["detail"] = dict(self.detail)
+        return out
+
+
+@dataclass(frozen=True)
+class FleetView:
+    """The engine-side fleet state the TelemetrySnapshot doesn't carry:
+    what is mid-drain, the configured size envelope, how long the fleet
+    has been idle (the engine's consecutive-idle-round counter — the
+    cheap "sustained" fold for a signal with no per-process history
+    ring), and the blob tier's per-volume spilled backlog."""
+
+    draining: frozenset[str] = frozenset()
+    min_volumes: int = 1
+    max_volumes: int = 8
+    idle_rounds: int = 0
+    blob_enabled: bool = False
+    spilled_keys: Mapping[str, int] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """Solver thresholds. Defaults are deliberately conservative: a
+    healthy steady fleet must solve to an empty plan."""
+
+    min_volumes: int = 1
+    max_volumes: int = 8
+    # Scale-out triggers: any volume's open landing brackets at/past
+    # this depth, or fleet-mean rolling-window bytes past this size.
+    out_inflight: int = 8
+    out_window_bytes: int = 32 << 20
+    # Scale-in entry: EVERY live volume under this window with an empty
+    # landing bracket, for this many consecutive engine rounds.
+    idle_window_bytes: int = 1 << 16
+    idle_rounds: int = 3
+    # Work quanta per applied action.
+    drain_keys_per_round: int = 64
+    blob_keys_per_round: int = 32
+    # Damping.
+    cooldown_s: float = 60.0
+    max_actions: int = 4
+
+
+def _recent(
+    history: Iterable[ActionRecord], now: float, cooldown_s: float
+) -> list[ActionRecord]:
+    return [r for r in history if now - r.ts < cooldown_s]
+
+
+def _cooled(recent: list[ActionRecord], kind: str, subject: str) -> bool:
+    return any(r.kind == kind and r.subject == subject for r in recent)
+
+
+def _fleet_idle(
+    snapshot: TelemetrySnapshot, live: dict, policy: AutoscalePolicy
+) -> bool:
+    if snapshot.sustained_overload:
+        return False
+    return all(
+        v.window_bytes <= policy.idle_window_bytes
+        and v.landing_inflight == 0
+        for v in live.values()
+    )
+
+
+def solve(
+    snapshot: TelemetrySnapshot,
+    fleet: FleetView,
+    policy: AutoscalePolicy,
+    history: Iterable[ActionRecord] = (),
+) -> list[AutoscaleAction]:
+    """The pure scale plan (see module doc for the decision families)."""
+    now = snapshot.generated_ts
+    history = list(history)
+    recent = _recent(history, now, policy.cooldown_s)
+    live = {
+        vid: v
+        for vid, v in snapshot.volumes.items()
+        if vid not in fleet.draining
+    }
+    actions: list[AutoscaleAction] = []
+
+    # 1/2. Draining volumes first: retire the empty ones, keep migrating
+    # the rest. Continuation is not cooldown-gated — a started drain must
+    # converge, not stall a cooldown window per batch.
+    for vid in sorted(fleet.draining):
+        v = snapshot.volumes.get(vid)
+        if v is not None and v.entries == 0:
+            actions.append(
+                AutoscaleAction(
+                    kind=RETIRE,
+                    subject=vid,
+                    volume=vid,
+                    reason="drained volume holds no index entries",
+                )
+            )
+        else:
+            actions.append(
+                AutoscaleAction(
+                    kind=DRAIN,
+                    subject=vid,
+                    volume=vid,
+                    count=policy.drain_keys_per_round,
+                    reason=(
+                        "drain in progress: %d entries remain"
+                        % (v.entries if v is not None else -1)
+                    ),
+                )
+            )
+
+    # 3. Scale out on saturation/overload.
+    saturated = sorted(
+        vid
+        for vid, v in live.items()
+        if v.landing_inflight >= policy.out_inflight
+    )
+    mean_window = (
+        sum(v.window_bytes for v in live.values()) / len(live)
+        if live
+        else 0.0
+    )
+    sustained = sorted(snapshot.sustained_overload)
+    want_out = bool(sustained or saturated) or (
+        mean_window >= policy.out_window_bytes
+    )
+    recently_in = any(r.kind in (DRAIN, RETIRE) for r in recent)
+    if (
+        want_out
+        and not fleet.draining
+        and not recently_in  # reversal damping: no saw-tooth
+        and len(live) < fleet.max_volumes
+        and not _cooled(recent, SCALE_OUT, "fleet")
+    ):
+        if sustained:
+            reason = "sustained overload trend on %s" % ", ".join(sustained)
+        elif saturated:
+            reason = "landing brackets saturated on %s" % ", ".join(saturated)
+        else:
+            reason = "fleet-mean window %d B >= %d B" % (
+                int(mean_window),
+                policy.out_window_bytes,
+            )
+        actions.append(
+            AutoscaleAction(
+                kind=SCALE_OUT,
+                subject="fleet",
+                count=1,
+                reason=reason,
+                detail={"volumes": len(live)},
+            )
+        )
+
+    # 4. Scale in on sustained idle (never in the same round as an out).
+    recently_out = any(r.kind == SCALE_OUT for r in recent)
+    if (
+        not want_out
+        and not fleet.draining
+        and not recently_out  # reversal damping, other direction
+        and fleet.idle_rounds >= policy.idle_rounds
+        and len(live) > fleet.min_volumes
+        and _fleet_idle(snapshot, live, policy)
+        and live
+    ):
+        victim = min(
+            live.values(), key=lambda v: (v.stored_bytes, v.volume_id)
+        )
+        if not _cooled(recent, DRAIN, victim.volume_id):
+            actions.append(
+                AutoscaleAction(
+                    kind=DRAIN,
+                    subject=victim.volume_id,
+                    volume=victim.volume_id,
+                    count=policy.drain_keys_per_round,
+                    reason=(
+                        "fleet idle %d round(s); %d live > min %d"
+                        % (fleet.idle_rounds, len(live), fleet.min_volumes)
+                    ),
+                )
+            )
+
+    # 5. Blob demotion: push the disk-spilled cold tail down a rung.
+    if fleet.blob_enabled and not want_out:
+        for vid in sorted(fleet.spilled_keys):
+            if not fleet.spilled_keys[vid] or vid not in live:
+                continue
+            if _cooled(recent, BLOB_DEMOTE, vid):
+                continue
+            actions.append(
+                AutoscaleAction(
+                    kind=BLOB_DEMOTE,
+                    subject=vid,
+                    volume=vid,
+                    count=policy.blob_keys_per_round,
+                    reason=(
+                        "%d spilled key(s) eligible for the blob tier"
+                        % fleet.spilled_keys[vid]
+                    ),
+                )
+            )
+
+    return actions[: policy.max_actions]

@@ -384,8 +384,12 @@ class LocalClient:
 
     async def _refresh_health(self) -> None:
         """Re-read the controller's per-volume health (one cheap RPC, only
-        after an epoch bump): quarantined volumes go into the avoid set so
-        puts route around them and get ordering deprioritizes them."""
+        after an epoch bump): quarantined AND draining volumes go into the
+        avoid set so puts route around them — a draining volume (autoscale
+        scale-in) keeps serving reads but must take no new placements or
+        the drain never converges. Volumes the autoscaler attached or
+        retired since the last refresh are adopted here too (the attach/
+        retire epoch bump is what triggered this refresh)."""
         self._volumes_stale = False
         try:
             vmap = await self._controller.get_volume_map.call_one()
@@ -394,8 +398,13 @@ class LocalClient:
         self._avoid_volumes = {
             vid
             for vid, info in vmap.items()
-            if info.get("health") == "quarantined"
+            if info.get("health") in ("quarantined", "draining")
         }
+        if set(vmap) != set(self._volume_refs or {}):
+            # Fleet membership changed (autoscale attach/retire): rebuild
+            # the wrapped volume refs so puts can target new volumes and
+            # stop holding refs to retired ones.
+            await self._load_volumes()
 
     async def placement_epoch(self) -> int:
         """Fetch + adopt the controller's current placement epoch — the

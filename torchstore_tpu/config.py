@@ -263,6 +263,77 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "Tenant/cohort label this process's client carries: admission "
            "buckets, loadgen op records, and scoreboard rows are keyed "
            "by it (empty reads as 'default')."),
+    # --- elastic fleet autoscaling (torchstore_tpu/autoscale/) --------------
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_INTERVAL_S", "float", 0,
+           "Elastic-fleet autoscaler reconcile period, seconds: every "
+           "interval the controller snapshots fleet telemetry, runs the "
+           "pure autoscale solver, and applies/audits scale decisions "
+           "(drain, retire, blob demotion; scale-out spawns defer to "
+           "ts.autoscale() client-side). <= 0 (the default) disables the "
+           "periodic loop; ts.autoscale() / ts.autoscale_plan() still "
+           "serve on demand."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_MIN_VOLUMES", "int", 1,
+           "Autoscale solver: never drain the fleet below this many live "
+           "volumes (scale-in floor)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_MAX_VOLUMES", "int", 8,
+           "Autoscale solver: never scale the fleet above this many live "
+           "volumes (scale-out ceiling)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_OUT_INFLIGHT", "int", 8,
+           "Autoscale solver: any volume holding at least this many open "
+           "landing brackets in the snapshot counts as saturated and "
+           "votes for scale-out (a sustained landing-inflight trend from "
+           "the history detectors votes the same way)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_OUT_WINDOW_BYTES", "int", 33554432,
+           "Autoscale solver: mean rolling-window bytes per live volume "
+           "at or above this threshold votes for scale-out (sustained "
+           "fleet-wide pressure, not one hot volume — that is the "
+           "placement engine's job)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_IDLE_WINDOW_BYTES", "int", 65536,
+           "Autoscale solver: the fleet counts as idle only when EVERY "
+           "live volume's rolling window moved fewer than this many "
+           "bytes (and no landing brackets are open, and no sustained "
+           "overload trend is active)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_IDLE_ROUNDS", "int", 3,
+           "Autoscale hysteresis: scale-in (drain entry) requires this "
+           "many CONSECUTIVE idle reconcile rounds first — one quiet "
+           "snapshot between bursts must not start retiring capacity."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_DRAIN_KEYS_PER_ROUND", "int", 64,
+           "Autoscale: resident keys migrated off a draining volume per "
+           "reconcile round (graceful drain is incremental; the volume "
+           "retires only when its index entry count reaches zero)."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_BLOB_KEYS_PER_ROUND", "int", 32,
+           "Autoscale: spilled (disk-tier) keys demoted to the blob cold "
+           "tier per volume per reconcile round when the blob tier is "
+           "enabled."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_COOLDOWN_S", "float", 60.0,
+           "Autoscale hysteresis: a subject acted on (or attempted) "
+           "within this window is not acted on again, and a reversal "
+           "(scale-out after scale-in, or vice versa) is damped for "
+           "twice the window — the fleet must converge, not flap."),
+    EnvVar("TORCHSTORE_TPU_AUTOSCALE_MAX_ACTIONS", "int", 4,
+           "Autoscale solver: cap on actions per reconcile round "
+           "(retire/drain continuations first); convergence happens over "
+           "rounds, not in one stop-the-world batch."),
+    # --- blob cold tier (torchstore_tpu/tiering/blob.py) --------------------
+    EnvVar("TORCHSTORE_TPU_BLOB_ENABLED", "bool", False,
+           "Enable the object-storage-style blob cold tier: volumes "
+           "archive cold spilled entries below the disk tier, fault them "
+           "back in through the get-RPC bracket, and the fleet gains "
+           "scale-to-zero (ts.blob_checkpoint() + ts.blob_restore())."),
+    EnvVar("TORCHSTORE_TPU_BLOB_DIR", "path", None,
+           "Blob store root directory (shared by every volume — it "
+           "emulates one bucket). Default: <tmpdir>/torchstore_tpu_blob. "
+           "Objects persist across fleet restarts; point tests at a "
+           "per-run directory for isolation."),
+    EnvVar("TORCHSTORE_TPU_BLOB_LATENCY_MS", "float", 0,
+           "Injected per-operation latency, milliseconds, on every blob "
+           "store op (put/get/list/delete) — emulates object-storage "
+           "round-trip time so benches and chaos runs exercise realistic "
+           "cold-tier economics."),
+    EnvVar("TORCHSTORE_TPU_BLOB_RATE_MBPS", "float", 0,
+           "Blob store throughput cap, MiB/s: data-bearing ops stall to "
+           "stay under it (an emulated egress/ingress rate limit). <= 0 "
+           "(the default) disables the cap."),
     # --- cold-start provisioning (prewarm) ----------------------------------
     EnvVar("TORCHSTORE_TPU_PREWARM_AUTO", "bool", True,
            "put_state_dict derives a manifest and provisions pools/dials "

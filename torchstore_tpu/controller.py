@@ -21,6 +21,7 @@ from typing import Any, Optional
 from torchstore_tpu import faults
 from torchstore_tpu import relay as relay_mod
 from torchstore_tpu import tiering
+from torchstore_tpu.autoscale.engine import AutoscaleEngine
 from torchstore_tpu.control.engine import ControlEngine
 from torchstore_tpu.logging import get_logger
 from torchstore_tpu.metadata.index_core import (  # noqa: F401 - re-exported
@@ -178,6 +179,20 @@ class Controller(Actor):
             os.environ.get("TORCHSTORE_TPU_CONTROL_INTERVAL_S", 0.0) or 0.0
         )
         self._control_task = None
+        # Autoscale plane (torchstore_tpu/autoscale/): the elastic-fleet
+        # engine that scales volume count to the measured load. The
+        # reconcile loop runs only when TORCHSTORE_TPU_AUTOSCALE_INTERVAL_S
+        # is positive; ts.autoscale_plan() / ts.autoscale() reach the
+        # engine on demand either way. ``_draining`` is the graceful
+        # scale-in set: clients exclude these volumes from NEW placements
+        # (get_volume_map health reads "draining") while reads keep
+        # serving until every resident key has migrated off.
+        self._autoscale_engine = AutoscaleEngine(self)
+        self._autoscale_interval = float(
+            os.environ.get("TORCHSTORE_TPU_AUTOSCALE_INTERVAL_S", 0.0) or 0.0
+        )
+        self._autoscale_task = None
+        self._draining: set[str] = set()
         # Elastic-reshard gate for the UNSHARDED metadata plane: while set
         # (an unset Event), coordinator-side index mutations park until the
         # reshard swaps the authority — the sharded case parks on the
@@ -289,9 +304,12 @@ class Controller(Actor):
         }
         for vid in self.volume_refs:
             _VOLUME_HEALTH.set(1, volume=vid)
+        self._draining.clear()
         self._start_supervisor()
         self._start_tier_sweeper()
         self._start_control_loop()
+        self._start_autoscale_loop()
+        self._autoscale_engine.publish_fleet_gauges()
         from torchstore_tpu.metadata import stamped as stamped_mod
 
         if stamped_mod.enabled():
@@ -1718,6 +1736,151 @@ class Controller(Actor):
             traffic=traffic, overload=overload, trigger="manual"
         )
 
+    # ---- autoscale plane (torchstore_tpu/autoscale) ----------------------
+
+    def _start_autoscale_loop(self) -> None:
+        """(Re)start the autoscale engine's reconcile loop — called from
+        init(); idempotent across re-inits. Off unless the interval is
+        positive (``ts.autoscale_plan()``/``ts.autoscale()`` still
+        serve)."""
+        if self._autoscale_task is not None:
+            self._autoscale_task.cancel()
+            self._autoscale_task = None
+        if self._autoscale_interval <= 0:
+            return
+        self._autoscale_task = spawn_logged(
+            self._autoscale_loop(),
+            name="controller.autoscale_reconcile",
+            tasks=self._health_tasks,
+            log=logger,
+        )
+
+    async def _autoscale_loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self._autoscale_interval)
+            try:
+                await self._autoscale_engine.reconcile(trigger="interval")
+            except Exception:  # noqa: BLE001 - one bad round must not
+                # kill the engine (volumes may be mid-repair/drain)
+                logger.exception(
+                    "autoscale reconcile failed; retrying next round"
+                )
+
+    @endpoint
+    async def autoscale_plan(
+        self,
+        traffic: Optional[dict] = None,
+        overload: Optional[dict] = None,
+    ) -> dict[str, Any]:
+        """Dry run (``ts.autoscale_plan()``): the scale actions the
+        engine WOULD take on a fresh fleet snapshot, applying nothing."""
+        return await self._autoscale_engine.plan(
+            traffic=traffic, overload=overload
+        )
+
+    @endpoint
+    async def autoscale_reconcile(
+        self,
+        traffic: Optional[dict] = None,
+        overload: Optional[dict] = None,
+    ) -> dict[str, Any]:
+        """One autoscale round NOW (``ts.autoscale()`` manual trigger):
+        snapshot, solve, apply drains/retires/demotions inline, surface
+        scale-out as a deferred decision the caller executes (spawn +
+        ``attach_volume``). Safe alongside the periodic loop — actions
+        cool down by subject."""
+        return await self._autoscale_engine.reconcile(
+            traffic=traffic, overload=overload, trigger="manual"
+        )
+
+    @endpoint
+    async def blob_checkpoint(self) -> dict[str, Any]:
+        """Archive every live volume's committed payloads into the blob
+        tier and write the durable fleet manifest (``ts.blob_checkpoint()``
+        — the scale-to-zero prerequisite)."""
+        return await self._autoscale_engine.checkpoint()
+
+    @endpoint
+    async def attach_volume(
+        self, volume_id: str, new_ref: ActorRef, hostname: str
+    ) -> dict[str, Any]:
+        """Adopt a freshly spawned volume into the live fleet (scale-out:
+        ``ts.autoscale()`` spawns, this attaches). The volume starts
+        empty and healthy; shards learn its ref BEFORE the epoch bump so
+        no placement can route to a volume a shard can't reach."""
+        if volume_id in self.volume_refs:
+            raise ValueError(f"volume {volume_id!r} already attached")
+        self.volume_refs[volume_id] = new_ref
+        self.volume_hostnames[volume_id] = hostname
+        self._vol_health[volume_id] = {"state": "ok", "misses": 0, "oks": 0}
+        _VOLUME_HEALTH.set(1, volume=volume_id)
+        if self._shard_refs:
+            import asyncio
+
+            await asyncio.gather(
+                *(
+                    ref.update_volume_ref.call_one(
+                        volume_id, new_ref, hostname
+                    )
+                    for ref in self._shard_refs
+                )
+            )
+        self._bump_epoch()
+        self._push_health()
+        self._autoscale_engine.publish_fleet_gauges()
+        obs_recorder.record("health", f"attached/{volume_id}")
+        return {"volumes": len(self.volume_refs)}
+
+    def mark_draining(self, volume_id: str) -> bool:
+        """Flag a volume as draining (autoscale scale-in): clients see
+        ``health == "draining"`` in get_volume_map and route NEW
+        placements around it while reads keep serving the resident keys
+        until migration empties it. Returns True when newly marked."""
+        if volume_id in self._draining:
+            return False
+        self._draining.add(volume_id)
+        h = self._vol_health.setdefault(
+            volume_id, {"state": "ok", "misses": 0, "oks": 0}
+        )
+        if h["state"] != "quarantined":
+            h["state"] = "draining"
+        _VOLUME_HEALTH.set(0.75, volume=volume_id)
+        obs_recorder.record("health", f"draining/{volume_id}")
+        self._bump_epoch()
+        self._push_health()
+        self._autoscale_engine.publish_fleet_gauges()
+        return True
+
+    def clear_draining(self, volume_id: str) -> None:
+        """Abandon a drain (volume vanished or scale-in reversed): the
+        volume rejoins normal placement if still healthy."""
+        if volume_id not in self._draining:
+            return
+        self._draining.discard(volume_id)
+        h = self._vol_health.get(volume_id)
+        if h is not None and h["state"] == "draining":
+            h["state"] = "ok"
+            _VOLUME_HEALTH.set(1, volume=volume_id)
+        self._bump_epoch()
+        self._push_health()
+        self._autoscale_engine.publish_fleet_gauges()
+
+    async def drop_volume(self, volume_id: str) -> None:
+        """Remove a retired volume from every fleet map (the retire
+        actuator already detached its — empty — index slice). Relay
+        trees re-shape around it exactly as they do on quarantine."""
+        self.volume_refs.pop(volume_id, None)
+        self.volume_hostnames.pop(volume_id, None)
+        self._vol_health.pop(volume_id, None)
+        self._draining.discard(volume_id)
+        await self._relay_on_quarantine(volume_id)
+        self._bump_epoch()
+        self._push_health()
+        self._autoscale_engine.publish_fleet_gauges()
+        obs_recorder.record("health", f"retired/{volume_id}")
+
     async def _reshard_wait(self) -> None:
         gate = self._reshard_gate
         if gate is not None:
@@ -2209,6 +2372,16 @@ class Controller(Actor):
                         tasks=self._health_tasks,
                         log=logger,
                     )
+                    # A draining volume that went dark abandons its drain:
+                    # quarantine + auto-repair own recovery from here (the
+                    # autoscale engine's next round sees it gone from the
+                    # draining set and plans nothing for it).
+                    if vid in self._draining:
+                        self._draining.discard(vid)
+                        obs_recorder.record(
+                            "health", f"drain_abandoned/{vid}"
+                        )
+                        self._autoscale_engine.publish_fleet_gauges()
                     # Broadcast trees route around the dark node NOW:
                     # orphaned subtrees re-attach to a healthy ancestor and
                     # resume from their last landed watermark.
@@ -2484,6 +2657,10 @@ class Controller(Actor):
         if self._control_task is not None:
             self._control_task.cancel()
             self._control_task = None
+        if self._autoscale_task is not None:
+            self._autoscale_task.cancel()
+            self._autoscale_task = None
+        self._draining.clear()
         if self._reshard_gate is not None:
             self._reshard_gate.set()
             self._reshard_gate = None

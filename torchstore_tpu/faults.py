@@ -69,6 +69,20 @@ store's hot paths:
                           generation must survive on the source replica and
                           the engine must abandon the action loudly (a
                           ``decision`` event with outcome=abandoned)
+    autoscale.spawn       client-side entry of every scale-out volume spawn
+                          (api._autoscale_spawn, before spawn_actors): raise
+                          stops the spawn batch — already-attached volumes
+                          stay attached, the round reports the shortfall
+    autoscale.drain       autoscale-engine entry of every drain/retire
+                          action (autoscale/engine.py, before the first
+                          actuator touch): raise mid-drain must leave every
+                          committed generation readable — the drain decision
+                          lands errored and the next round resumes it
+    blob.io               inside EVERY blob-store operation (put/get/list/
+                          delete in tiering/blob.py, before bytes move):
+                          raise makes a demotion abandon (entry stays on
+                          disk, still served), a restore surface the error
+                          to its get, a checkpoint report the volume errored
 
 Cost when disarmed: ONE dict lookup (``_armed.get(name)`` on an empty dict)
 — measured indistinguishable from noise on the many_keys bench. Sites fire
@@ -127,6 +141,9 @@ REGISTRY: frozenset[str] = frozenset(
         "controller.shard_dispatch",
         "control.reconcile",
         "control.migrate",
+        "autoscale.spawn",
+        "autoscale.drain",
+        "blob.io",
         "volume.put",
         "volume.get",
         "volume.handshake",

@@ -103,6 +103,12 @@ class TrafficLedger:
 
     def __init__(self, window_s: Optional[float] = None) -> None:
         self.enabled = _env_enabled()
+        # An explicit window is pinned; the env-derived default is re-read
+        # at every rotation check so TORCHSTORE_TPU_LEDGER_WINDOW_S can be
+        # retuned after the process singleton is constructed (the module
+        # imports — and so builds the singleton — before tests and bench
+        # sections get a chance to set their knobs).
+        self._pinned = window_s is not None
         self.window_s = window_s if window_s is not None else _env_window_s()
         self._lock = threading.Lock()
         # (peer_host, volume, transport, direction) -> [ops, bytes]
@@ -170,6 +176,8 @@ class TrafficLedger:
         writes AND reads: an idle process's snapshot must not keep serving
         hour-old keys as "hot right now" — after one idle window the stale
         bucket slides to previous, after two both are dropped."""
+        if not self._pinned:
+            self.window_s = _env_window_s()
         now = time.monotonic()
         elapsed = now - self._win_started
         if elapsed < self.window_s:

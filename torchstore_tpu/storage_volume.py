@@ -310,8 +310,20 @@ class StorageVolume(Actor):
 
         if tiering_spill.enabled():
             self._tier = tiering_spill.SpillTier(self.volume_id)
-        # Serializes spill/fault-in mutations of the tier bookkeeping
-        # across endpoint tasks (both are cold-path; the warm path never
+        # Blob cold tier (torchstore_tpu/tiering/blob.py): the third rung
+        # below disk. Disk-spilled entries demote further into the emulated
+        # object store on autoscale ``blob_demote`` decisions (blob_sweep),
+        # blob_archive checkpoints everything for scale-to-zero, and
+        # archived keys fault back in through the same get-RPC bracket as
+        # the disk tier. None unless TORCHSTORE_TPU_BLOB_ENABLED is set —
+        # the warm path then pays exactly one attribute check.
+        self._blob = None
+        from torchstore_tpu.tiering import blob as tiering_blob
+
+        if tiering_blob.enabled():
+            self._blob = tiering_blob.BlobTier(self.volume_id)
+        # Serializes spill/fault-in/blob mutations of the tier bookkeeping
+        # across endpoint tasks (all are cold-path; the warm path never
         # touches the lock).
         import asyncio
 
@@ -477,8 +489,6 @@ class StorageVolume(Actor):
         keys = [meta.key for meta in metas if meta.key in tier.spilled]
         if not keys:
             return
-        from torchstore_tpu.transport import landing as landing_mod
-
         async with self._tier_lock:
             for key in dict.fromkeys(keys):
                 if key not in tier.spilled:
@@ -488,35 +498,74 @@ class StorageVolume(Actor):
                     dmetas, dvalues = tier.load(key)
                 except KeyError:
                     continue
-                values: dict[int, Any] = {}
-                copy_pairs = []
-                for idx, dmeta in enumerate(dmetas):
-                    val = dvalues[idx]
-                    if isinstance(val, np.ndarray) and val.size:
-                        dst = np.empty_like(val)
-                        copy_pairs.append((dst, val))
-                        values[idx] = dst
-                    else:
-                        values[idx] = val
-                stamp_pairs = self._stamp_pairs(dmetas)
-                before = self._entry_nbytes(key)
-                await self._begin_landing(stamp_pairs)
-                try:
-                    if copy_pairs:
-                        await landing_mod.land_async(
-                            copy_pairs, stage="fault_in"
-                        )
-                    self.store.store(dmetas, values)
-                finally:
-                    self._end_landing(stamp_pairs)
-                self._apply_residency_delta([key], before)
+                await self._promote_entry(key, dmetas, dvalues)
                 tier.faulted_in(key, reason)
+        self._publish_residency()
+
+    async def _promote_entry(
+        self, key: str, dmetas: list[Request], dvalues: dict[int, Any]
+    ) -> None:
+        """Land a colder-tier entry back into the memory tier through the
+        shared landing pool, bracketed by the volume's landing stamps
+        (shared by the disk and blob fault-in paths — one-sided readers
+        racing the promotion see busy/moved and fall back, never a torn
+        tensor). Caller holds ``_tier_lock`` and owns the tier-side
+        bookkeeping (``faulted_in``/``restored``)."""
+        from torchstore_tpu.transport import landing as landing_mod
+
+        values: dict[int, Any] = {}
+        copy_pairs = []
+        for idx, _dmeta in enumerate(dmetas):
+            val = dvalues[idx]
+            if isinstance(val, np.ndarray) and val.size:
+                dst = np.empty_like(val)
+                copy_pairs.append((dst, val))
+                values[idx] = dst
+            else:
+                values[idx] = val
+        stamp_pairs = self._stamp_pairs(dmetas)
+        before = self._entry_nbytes(key)
+        await self._begin_landing(stamp_pairs)
+        try:
+            if copy_pairs:
+                await landing_mod.land_async(copy_pairs, stage="fault_in")
+            self.store.store(dmetas, values)
+        finally:
+            self._end_landing(stamp_pairs)
+        self._apply_residency_delta([key], before)
+
+    async def _blob_fault_in(self, metas: list[Request], reason: str) -> None:
+        """Promote any BLOB-archived keys among ``metas`` back into the
+        memory tier before they are served — the bottom rung of the same
+        ladder as ``_tier_fault_in``, riding the identical landing
+        bracket. The warm path exits on the first check: one attribute +
+        one dict read."""
+        blob = self._blob
+        if blob is None or not blob.archived:
+            return
+        keys = [meta.key for meta in metas if meta.key in blob.archived]
+        if not keys:
+            return
+        async with self._tier_lock:
+            for key in dict.fromkeys(keys):
+                if key not in blob.archived:
+                    continue  # a concurrent fault-in already promoted it
+                await faults.afire("volume.fault_in")
+                try:
+                    dmetas, dvalues = blob.load(key)
+                except KeyError:
+                    continue
+                await self._promote_entry(key, dmetas, dvalues)
+                blob.restored(key, reason)
         self._publish_residency()
 
     def _tier_after_put(self, keys) -> None:
         """Post-landing tier bookkeeping for fresh writes: a stale disk
-        copy is garbage the moment new bytes land resident, and the write
-        refreshes the version group's LRU clock."""
+        (or blob) copy is garbage the moment new bytes land resident, and
+        the write refreshes the version group's LRU clock."""
+        if self._blob is not None and self._blob.archived:
+            for key in keys:
+                self._blob.discard(key)
         if self._tier is None:
             return
         for key in keys:
@@ -649,16 +698,110 @@ class StorageVolume(Actor):
         }
 
     @endpoint
+    async def blob_sweep(self, limit: int = 32) -> dict:
+        """Demote SPILLED (disk-tier) entries one rung further down into
+        the blob cold tier: load the crash-safe disk copy, materialise the
+        memmap-backed values, archive them as one blob object, then drop
+        the disk copy. Only keys already cold enough to have spilled are
+        eligible — the blob tier sits strictly below disk. Driven by the
+        autoscale plane's BLOB_DEMOTE action and ``ts.autoscale()``."""
+        blob = self._blob
+        tier = self._tier
+        if blob is None or tier is None:
+            return {"enabled": False, "archived": []}
+        archived: list[str] = []
+        nbytes = 0
+        async with self._tier_lock:
+            for key in sorted(tier.spilled)[: max(1, limit)]:
+                try:
+                    dmetas, dvalues = tier.load(key)
+                except KeyError:
+                    continue
+                # Materialise memmap-backed values before pickling: the
+                # disk file they map is deleted the moment we discard the
+                # spilled copy below.
+                values = {
+                    idx: (np.array(v) if isinstance(v, np.ndarray) else v)
+                    for idx, v in dvalues.items()
+                }
+                nbytes += blob.archive(key, dmetas, values)
+                tier.discard(key)
+                archived.append(key)
+        if archived:
+            blob.demoted(archived, nbytes)
+        self._publish_residency()
+        return {
+            "enabled": True,
+            "archived": archived,
+            "nbytes": nbytes,
+            "remaining_spilled": len(tier.spilled),
+        }
+
+    @endpoint
+    async def blob_archive(self) -> dict:
+        """Checkpoint every committed entry on this volume into the blob
+        cold tier (scale-to-zero): resident entries and spilled disk
+        copies are archived as blob objects; entries already archived are
+        carried forward. Memory/disk copies are NOT dropped — this is a
+        durable snapshot, not a demotion. Returns the per-key object map
+        (blob object name, payload bytes, committed write generation) the
+        controller folds into the fleet manifest."""
+        blob = self._blob
+        if blob is None:
+            return {"enabled": False, "objects": {}}
+        from torchstore_tpu.tiering.spill import SpillTier
+
+        objects: dict[str, dict] = {}
+
+        def _note(key: str, n: int) -> None:
+            objects[key] = {
+                "object": blob.object_name(key),
+                "nbytes": n,
+                "write_gen": self._write_gens.get(key, 0),
+            }
+
+        async with self._tier_lock:
+            kv = getattr(self.store, "kv", {})
+            for key in sorted(kv):
+                entry = kv.get(key)
+                if entry is None:
+                    continue
+                dmetas, dvalues = SpillTier.entry_requests(key, entry)
+                values = {
+                    idx: (np.array(v) if isinstance(v, np.ndarray) else v)
+                    for idx, v in dvalues.items()
+                }
+                _note(key, blob.archive(key, dmetas, values))
+            tier = self._tier
+            if tier is not None:
+                for key in sorted(tier.spilled):
+                    if key in objects:
+                        continue
+                    try:
+                        dmetas, dvalues = tier.load(key)
+                    except KeyError:
+                        continue
+                    values = {
+                        idx: (np.array(v) if isinstance(v, np.ndarray) else v)
+                        for idx, v in dvalues.items()
+                    }
+                    _note(key, blob.archive(key, dmetas, values))
+            for key, n in sorted(blob.archived.items()):
+                if key not in objects:
+                    _note(key, n)
+        return {"enabled": True, "objects": objects}
+
+    @endpoint
     async def put(self, buffer: TransportBuffer, metas: list[Request]) -> Any:
         await faults.afire("volume.put")
         t0 = time.perf_counter()
-        if self._tier is not None:
+        if self._tier is not None or self._blob is not None:
             # Sharded overwrites land shard-by-shard: promote a spilled
             # entry FIRST so sibling shards survive the partial overwrite
-            # (whole-entry puts below simply discard the stale disk copy).
-            await self._tier_fault_in(
-                [m for m in metas if m.tensor_slice is not None], "put"
-            )
+            # (whole-entry puts below simply discard the stale cold copy).
+            sharded = [m for m in metas if m.tensor_slice is not None]
+            await self._tier_fault_in(sharded, "put")
+            await self._blob_fault_in(sharded, "put")
         pairs = self._stamp_pairs(metas)
         t_land = time.perf_counter()
         await self._begin_landing(pairs)
@@ -716,13 +859,16 @@ class StorageVolume(Actor):
     ) -> TransportBuffer:
         await faults.afire("volume.get")
         t0 = time.perf_counter()
-        if self._tier is not None:
-            # Cold keys fault back in from the disk tier HERE — inside the
-            # existing transport ladder (this get RPC is exactly where the
-            # one-sided/doorbell paths already fall back to), never via a
-            # new per-get RPC. Resident keys pay one dict check.
+        if self._tier is not None or self._blob is not None:
+            # Cold keys fault back in from the disk/blob tiers HERE —
+            # inside the existing transport ladder (this get RPC is
+            # exactly where the one-sided/doorbell paths already fall back
+            # to), never via a new per-get RPC. Resident keys pay one dict
+            # check per enabled tier.
             await self._tier_fault_in(metas, "get")
-            self._tier.touch([meta.key for meta in metas])
+            await self._blob_fault_in(metas, "get")
+            if self._tier is not None:
+                self._tier.touch([meta.key for meta in metas])
         entries = [self.store.get_data(meta) for meta in metas]
         t_land = time.perf_counter()
         await maybe_await(buffer.handle_get_request(self.ctx, metas, entries))
@@ -763,8 +909,9 @@ class StorageVolume(Actor):
 
     @endpoint
     async def get_meta(self, metas: list[Request]) -> list[Any]:
-        if self._tier is not None:
+        if self._tier is not None or self._blob is not None:
             await self._tier_fault_in(metas, "get_meta")
+            await self._blob_fault_in(metas, "get_meta")
         return [self.store.get_meta(meta) for meta in metas]
 
     @endpoint
@@ -779,11 +926,19 @@ class StorageVolume(Actor):
         self._landing_open()
         try:
             for key in keys:
+                # A key can live in several tiers at once (a blob
+                # checkpoint keeps the resident copy): drop EVERY copy and
+                # count the key once.
+                dropped = False
                 if self.store.delete(key):
                     self.ctx.delete_key(key)
+                    dropped = True
+                if self._tier is not None and self._tier.discard(key):
+                    dropped = True  # spilled copy: the disk tier held it
+                if self._blob is not None and self._blob.discard(key):
+                    dropped = True  # archived copy in the blob cold tier
+                if dropped:
                     deleted += 1
-                elif self._tier is not None and self._tier.discard(key):
-                    deleted += 1  # spilled-only copy: the disk tier held it
                 self._write_gens.pop(key, None)
         finally:
             self._landing_close()
@@ -821,11 +976,16 @@ class StorageVolume(Actor):
                     kept_fresh.append(key)
                     kept_gens[key] = current
                     continue
+                dropped = False
                 if self.store.delete(key):
                     self.ctx.delete_key(key)
+                    dropped = True
+                if self._tier is not None and self._tier.discard(key):
+                    dropped = True  # stale copy lived in the disk tier
+                if self._blob is not None and self._blob.discard(key):
+                    dropped = True  # checkpointed copy in the blob tier
+                if dropped:
                     removed.append(key)
-                elif self._tier is not None and self._tier.discard(key):
-                    removed.append(key)  # stale copy lived in the disk tier
                 self._write_gens.pop(key, None)
         finally:
             self._landing_close()
@@ -897,12 +1057,12 @@ class StorageVolume(Actor):
         )
 
         config = default_config()
-        if self._tier is not None:
+        if self._tier is not None or self._blob is not None:
             # Same rule as put: sharded pulls overwrite per shard, so a
             # spilled local copy must promote first to keep its siblings.
-            await self._tier_fault_in(
-                [m for m in metas if m.tensor_slice is not None], "pull"
-            )
+            sharded = [m for m in metas if m.tensor_slice is not None]
+            await self._tier_fault_in(sharded, "pull")
+            await self._blob_fault_in(sharded, "pull")
         src_ref = StorageVolumeRef(
             actor=src,
             volume_id=src_volume or "",
@@ -994,6 +1154,13 @@ class StorageVolume(Actor):
             # Spilled entries' bytes live ONLY in the disk tier: an index
             # rebuild that skipped them would silently lose cold versions.
             items.extend(self._tier.manifest())
+        if self._blob is not None:
+            # Same rule one rung down: blob-archived entries whose bytes
+            # left both memory and disk must still surface in rebuilds.
+            seen = {
+                item["meta"].key for item in items if isinstance(item, dict)
+            }
+            items.extend(self._blob.manifest(exclude=seen))
         for item in items:
             if isinstance(item, dict):
                 gen = self._write_gens.get(item["meta"].key)
@@ -1130,6 +1297,10 @@ class StorageVolume(Actor):
                 "high_bytes": self._tier.high_bytes,
                 "low_bytes": self._tier.low_bytes,
             }
+        if self._blob is not None:
+            out.setdefault("tier", {})
+            out["tier"]["blob_bytes"] = self._blob.archived_bytes
+            out["tier"]["blob_keys"] = len(self._blob.archived)
         from torchstore_tpu.transport.shared_memory import ShmServerCache
 
         cache = self.ctx.peek(ShmServerCache)
@@ -1186,6 +1357,10 @@ class StorageVolume(Actor):
             self._write_gens.clear()
             if self._tier is not None:
                 self._tier.reset()
+            if self._blob is not None:
+                # Bookkeeping-only: blob OBJECTS are the durable cold tier
+                # scale-to-zero restores from — reset() must not wipe them.
+                self._blob.reset()
         finally:
             self._landing_close()
         self._install_doorbell_hook()

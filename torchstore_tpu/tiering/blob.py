@@ -1,0 +1,378 @@
+"""Blob cold tier: an object-storage-style backend below the disk tier.
+
+One ``BlobTier`` lives inside each ``StorageVolume`` process (built at
+init when ``TORCHSTORE_TPU_BLOB_ENABLED`` is set). It is the third rung
+of the tiering ladder — memory (tmpfs) → disk (``tiering/spill.py``) →
+blob — and owns:
+
+- a per-volume view over the shared :class:`BlobStore`: an emulated
+  object store (put/get/list/delete over a flat namespace) rooted at
+  ``TORCHSTORE_TPU_BLOB_DIR``, with the latency + throughput envelope of
+  a real bucket injected per op (``TORCHSTORE_TPU_BLOB_LATENCY_MS``,
+  ``TORCHSTORE_TPU_BLOB_RATE_MBPS``) so benches measure cold-tier
+  behavior, not local-disk behavior;
+- the archived-set bookkeeping the volume's serve path consults (one
+  dict membership test on the warm path, exactly like the spill tier's
+  ``spilled``), seeded from the store at init so a restarted volume
+  pointed at the same blob root resumes serving its archived set;
+- the durable fleet **manifest** (:func:`write_fleet_manifest`): a
+  committed index snapshot + blob object map written crash-safe
+  (write-temp → fsync → rename, the FileBackedStore protocol), which is
+  what makes scale-to-zero real — kill every volume, cold-start a fresh
+  fleet, and ``ts.blob_restore()`` replays every committed generation
+  out of the blob tier with zero loss.
+
+Demotion disk→blob is decision-driven (the autoscale engine's
+``blob_demote`` action → ``StorageVolume.blob_sweep``), not watermark-
+driven: blob round trips are expensive enough that each one should be
+auditable. Fault-in rides the existing get-RPC bracket
+(``StorageVolume._blob_fault_in``), same as the disk tier.
+
+Every BlobStore op crosses the ``blob.io`` faultpoint, so chaos
+schedules can fail/delay/kill mid-archive and mid-restore. Blob bytes
+ride the ledger's DISK transport label (local I/O, never wire traffic —
+same rule as the spill tier).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Iterable, Optional
+
+from torchstore_tpu import faults
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.observability import recorder as obs_recorder
+from torchstore_tpu.transport.types import Request
+
+logger = get_logger("torchstore_tpu.tiering.blob")
+
+_BLOB_OPS = obs_metrics.counter(
+    "ts_blob_ops_total", "Blob-store operations, by op (put/get/list/delete)"
+)
+_BLOB_DEMOTIONS = obs_metrics.counter(
+    "ts_blob_demotions_total", "Entries demoted from the disk tier to blob"
+)
+_BLOB_RESTORES = obs_metrics.counter(
+    "ts_blob_restores_total",
+    "Blob-archived entries restored to the memory tier, by reason",
+)
+_BLOB_BYTES = obs_metrics.gauge(
+    "ts_blob_bytes", "Bytes archived in this volume's blob tier"
+)
+_BLOB_KEYS = obs_metrics.gauge(
+    "ts_blob_keys", "Entries archived in this volume's blob tier"
+)
+
+# The fleet manifest's object name — flat-namespace, outside any volume
+# prefix so a fresh fleet (new volume ids) finds it.
+MANIFEST_OBJECT = "fleet/MANIFEST"
+_VOLUME_PREFIX = "vols/"
+
+
+def enabled() -> bool:
+    return os.environ.get(
+        "TORCHSTORE_TPU_BLOB_ENABLED", "0"
+    ).strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def blob_root() -> str:
+    return os.environ.get("TORCHSTORE_TPU_BLOB_DIR") or os.path.join(
+        tempfile.gettempdir(), "torchstore_tpu_blob"
+    )
+
+
+class BlobStore:
+    """Emulated object store: a flat object namespace over one local
+    directory. Object names map to urlsafe-b64 filenames (names may hold
+    ``/`` freely, as bucket keys do); every put is crash-safe
+    (write-temp → fsync → rename) so a process killed mid-put never
+    leaves a torn object a restore would trust. Each op fires the
+    ``blob.io`` faultpoint and pays the configured latency/rate envelope."""
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        latency_ms: Optional[float] = None,
+        rate_mbps: Optional[float] = None,
+    ) -> None:
+        self.root = root or blob_root()
+        if latency_ms is None:
+            latency_ms = float(
+                os.environ.get("TORCHSTORE_TPU_BLOB_LATENCY_MS", 0) or 0
+            )
+        if rate_mbps is None:
+            rate_mbps = float(
+                os.environ.get("TORCHSTORE_TPU_BLOB_RATE_MBPS", 0) or 0
+            )
+        self.latency_s = max(0.0, latency_ms) / 1e3
+        self.rate_bps = max(0.0, rate_mbps) * 1e6
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(
+            self.root, base64.urlsafe_b64encode(name.encode()).decode()
+        )
+
+    def _op(self, op: str, nbytes: int = 0) -> None:
+        """The emulated service envelope, crossed by EVERY op: the chaos
+        faultpoint first (a die here is a volume lost mid-blob-I/O), then
+        the per-request latency, then the throughput-proportional stall."""
+        faults.fire("blob.io")
+        _BLOB_OPS.inc(op=op)
+        stall = self.latency_s
+        if self.rate_bps > 0 and nbytes:
+            stall += nbytes / self.rate_bps
+        if stall > 0:
+            time.sleep(stall)
+
+    def put(self, name: str, data: bytes) -> int:
+        self._op("put", len(data))
+        path = self._path(name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return len(data)
+
+    def get(self, name: str) -> bytes:
+        path = self._path(name)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise KeyError(name) from None
+        self._op("get", len(data))
+        return data
+
+    def head(self, name: str) -> tuple[int, float]:
+        """(size, mtime) without transferring the payload (no rate stall)."""
+        try:
+            st = os.stat(self._path(name))
+        except FileNotFoundError:
+            raise KeyError(name) from None
+        return int(st.st_size), float(st.st_mtime)
+
+    def list(self, prefix: str = "") -> list[str]:
+        self._op("list")
+        out = []
+        for fname in os.listdir(self.root):
+            if fname.endswith(".tmp") or ".tmp." in fname:
+                continue  # torn put from a killed writer: never an object
+            try:
+                name = base64.urlsafe_b64decode(fname.encode()).decode()
+            except Exception:  # noqa: BLE001 - foreign file in the root
+                continue
+            if name.startswith(prefix):
+                out.append(name)
+        return sorted(out)
+
+    def delete(self, name: str) -> bool:
+        self._op("delete")
+        try:
+            os.remove(self._path(name))
+            return True
+        except FileNotFoundError:
+            return False
+
+
+class BlobTier:
+    """Per-volume view of the blob tier (see module doc)."""
+
+    def __init__(self, volume_id: str, store: Optional[BlobStore] = None):
+        self.volume_id = str(volume_id)
+        self.store = store or BlobStore()
+        self.prefix = f"{_VOLUME_PREFIX}{self.volume_id}/"
+        # key -> archived bytes; the ONE structure the serve path consults.
+        # Seeded from the store: a restarted volume resumes its archive.
+        self.archived: dict[str, int] = {}
+        for name in self.store.list(self.prefix):
+            key = name[len(self.prefix):]
+            try:
+                size, _ = self.store.head(name)
+            except KeyError:
+                continue  # deleted between list and head
+            self.archived[key] = size
+        self.publish_gauges()
+
+    def _object(self, key: str) -> str:
+        return self.prefix + key
+
+    def object_name(self, key: str) -> str:
+        """The blob object name for ``key`` (what the fleet manifest's
+        object map records)."""
+        return self._object(key)
+
+    @property
+    def archived_bytes(self) -> int:
+        return sum(self.archived.values())
+
+    def publish_gauges(self) -> None:
+        _BLOB_BYTES.set(self.archived_bytes, volume=self.volume_id)
+        _BLOB_KEYS.set(len(self.archived), volume=self.volume_id)
+
+    # ---- payload protocol ------------------------------------------------
+
+    @staticmethod
+    def encode_entry(metas: list[Request], values: dict[int, Any]) -> bytes:
+        """One self-contained blob object per entry: the (metas, values)
+        pair in StorageImpl.store shape — exactly what a restore re-lands,
+        with no side lookup needed."""
+        return pickle.dumps((metas, values), protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def decode_entry(data: bytes) -> tuple[list[Request], dict[int, Any]]:
+        metas, values = pickle.loads(data)
+        return metas, values
+
+    # ---- archive / restore ----------------------------------------------
+
+    def archive(
+        self, key: str, metas: list[Request], values: dict[int, Any]
+    ) -> int:
+        """Persist one entry to the blob store (crash-safe); returns the
+        object byte count. The caller decides whether this is a demotion
+        (drop the disk copy afterwards) or a checkpoint copy (keep it)."""
+        nbytes = self.store.put(self._object(key), self.encode_entry(metas, values))
+        self.archived[key] = nbytes
+        obs_ledger.record(
+            obs_ledger.DISK,
+            obs_ledger.EGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=[(key, nbytes)],
+        )
+        return nbytes
+
+    def demoted(self, keys: list, nbytes: int) -> None:
+        """Record a disk→blob demotion batch (the volume's ``blob_sweep``
+        already archived the keys and dropped the disk copies)."""
+        _BLOB_DEMOTIONS.inc(len(keys))
+        obs_recorder.record(
+            "tier",
+            "blob_demote",
+            keys=len(keys),
+            nbytes=nbytes,
+            volume=self.volume_id,
+        )
+        self.publish_gauges()
+
+    def load(self, key: str) -> tuple[list[Request], dict[int, Any]]:
+        """(metas, values) for an archived entry, ready to re-land into
+        the memory tier. Raises KeyError when not archived."""
+        if key not in self.archived:
+            raise KeyError(key)
+        return self.decode_entry(self.store.get(self._object(key)))
+
+    def restored(self, key: str, reason: str) -> None:
+        """Bookkeeping after the volume re-landed ``key``: drop the blob
+        copy and record the promotion."""
+        nbytes = self.archived.pop(key, 0)
+        self.store.delete(self._object(key))
+        _BLOB_RESTORES.inc(reason=reason)
+        obs_ledger.record(
+            obs_ledger.DISK,
+            obs_ledger.INGRESS,
+            nbytes,
+            volume=self.volume_id,
+            items=[(key, nbytes)],
+        )
+        obs_recorder.record(
+            "tier",
+            "blob_restore",
+            key=key,
+            nbytes=nbytes,
+            volume=self.volume_id,
+            reason=reason,
+        )
+        self.publish_gauges()
+
+    def discard(self, key: str) -> bool:
+        """Drop a stale blob copy (the key was overwritten or deleted
+        above this tier); idempotent."""
+        existed = self.archived.pop(key, None) is not None
+        if existed:
+            self.store.delete(self._object(key))
+            self.publish_gauges()
+        return existed
+
+    def manifest(self, exclude: Iterable[str] = ()) -> list[dict]:
+        """Blob-archived entries' meta-only manifest (controller index
+        rebuilds must see the blob tier too — archived bytes may be the
+        only copy). ``exclude`` skips keys a warmer tier already reported.
+        Each item costs one object get (the metas live in the payload) —
+        acceptable for the rebuild path, never on the serve path."""
+        skip = set(exclude)
+        items: list[dict] = []
+        for key in sorted(self.archived):
+            if key in skip:
+                continue
+            name = self._object(key)
+            try:
+                metas, _values = self.decode_entry(self.store.get(name))
+                _size, mtime = self.store.head(name)
+            except Exception:  # noqa: BLE001 - a torn/raced object must
+                # not fail the whole rebuild
+                continue
+            for meta in metas:
+                items.append({"meta": meta.meta_only(), "mtime": mtime})
+        return items
+
+    def reset(self) -> None:
+        """Drop the process-local bookkeeping ONLY: the blob objects are
+        the durable cold tier — a volume reset (test teardown, store
+        shutdown) must not destroy the archive a later cold restore
+        replays. Tests isolate runs with per-run TORCHSTORE_TPU_BLOB_DIR
+        roots; ``purge()`` is the destructive wipe."""
+        self.archived.clear()
+        self.publish_gauges()
+
+    def purge(self) -> None:
+        """Destructive wipe: delete every archived object (test cleanup)."""
+        for key in list(self.archived):
+            self.store.delete(self._object(key))
+        self.archived.clear()
+        self.publish_gauges()
+
+
+# ---- fleet manifest (scale-to-zero) -------------------------------------
+
+
+def write_fleet_manifest(
+    store: BlobStore, keys: dict[str, dict], extra: Optional[dict] = None
+) -> dict:
+    """Persist the fleet manifest: the committed-key index snapshot +
+    blob object map a cold restore replays. ``keys`` maps each committed
+    key to ``{"object": blob object name, "nbytes": payload bytes,
+    "write_gen": generation}``. Crash-safe via BlobStore.put (write-temp
+    → fsync → rename): a writer killed mid-checkpoint leaves the PREVIOUS
+    manifest intact, never a torn one."""
+    doc = {
+        "version": 1,
+        "generated": time.time(),
+        "count": len(keys),
+        "keys": keys,
+        **(extra or {}),
+    }
+    store.put(MANIFEST_OBJECT, json.dumps(doc).encode())
+    obs_recorder.record(
+        "tier", "blob_manifest", count=len(keys), root=store.root
+    )
+    return doc
+
+
+def read_fleet_manifest(store: BlobStore) -> Optional[dict]:
+    """The last committed fleet manifest, or None when no checkpoint has
+    ever completed (a torn write never surfaces here: puts are atomic)."""
+    try:
+        return json.loads(store.get(MANIFEST_OBJECT).decode())
+    except KeyError:
+        return None
