@@ -318,6 +318,43 @@ async def test_scale_out_drain_retire_cycle(elastic_env):
         await ts.shutdown("ascyc")
 
 
+async def test_periodic_retire_reclaims_spawned_process(elastic_env):
+    """A volume retired by the controller's PERIODIC loop (a round no
+    client participates in) must still get its actor process reclaimed:
+    the next ts.autoscale() reconciles spawned meshes against the live
+    volume map instead of relying on the retire action landing in its
+    own round — otherwise the process idles until shutdown, negating
+    the volume-seconds saving scale-in exists for."""
+    await ts.initialize(store_name="asper")
+    try:
+        for i in range(8):
+            await ts.put(
+                f"p{i}",
+                np.arange(2000, dtype=np.float32) + i,
+                store_name="asper",
+            )
+        r = await ts.autoscale(store_name="asper")
+        assert r["spawned"] == ["scale-0"], r["actions"]
+        c = ts.client("asper")
+        # Drive the drain → retire cycle through the CONTROLLER endpoint
+        # — the same path the periodic loop takes; no mesh stop can
+        # happen in these rounds.
+        vmap: dict = {}
+        for _ in range(40):
+            await asyncio.sleep(0.25)
+            await c.controller.autoscale_reconcile.call_one()
+            vmap = await c.controller.get_volume_map.call_one()
+            if "scale-0" not in vmap:
+                break
+        assert "scale-0" not in vmap, vmap
+        # The orphaned actor process is reclaimed by the NEXT manual
+        # round, whatever that round itself decides.
+        r = await ts.autoscale(store_name="asper")
+        assert r["stopped"] == ["scale-0"], r
+    finally:
+        await ts.shutdown("asper")
+
+
 async def test_draining_volume_excluded_from_placement(elastic_env, monkeypatch):
     """While a volume drains, clients stop offering it for new puts (the
     volume map exposes health="draining") — but reads of keys still

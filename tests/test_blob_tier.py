@@ -146,6 +146,32 @@ class TestBlobTier:
         assert tier.archived == {}
         assert store.list(tier.prefix) == []
 
+    def test_pinned_restore_keeps_checkpoint_object(self, store):
+        """A fault-in promotion of a checkpoint-pinned key must KEEP the
+        blob object — the fleet manifest references it, and dropping it
+        would destroy the durable copy a cold restore replays."""
+        tier = BlobTier("v0", store=store)
+        n = tier.archive("t", *_tensor_entry("t", np.zeros(8)))
+        tier.pin(["t"])
+        tier.restored("t", reason="get")
+        assert tier.archived == {"t": n}
+        assert store.list(tier.prefix) == [tier.object_name("t")]
+        # An overwrite ABOVE the tier still supersedes the checkpoint.
+        assert tier.discard("t") is True
+        assert store.list(tier.prefix) == []
+
+    def test_pins_seed_from_manifest(self, store):
+        """A restarted volume keeps honoring the last committed manifest:
+        its keys come back pinned, other volumes' keys do not."""
+        t1 = BlobTier("v0", store=store)
+        n = t1.archive("t", *_tensor_entry("t", np.zeros(8)))
+        write_fleet_manifest(
+            store,
+            {"t": {"object": t1.object_name("t"), "nbytes": n, "write_gen": 1}},
+        )
+        assert BlobTier("v0", store=store).pinned == {"t"}
+        assert BlobTier("v1", store=store).pinned == set()
+
     def test_discard_idempotent(self, store):
         tier = BlobTier("v0", store=store)
         tier.archive("t", *_tensor_entry("t", np.zeros(8)))
@@ -315,6 +341,58 @@ async def test_checkpoint_scale_to_zero_cold_restore(blob_env):
                 assert np.array_equal(got, v), k
     finally:
         await ts.shutdown("blobcold")
+
+
+async def test_reads_after_checkpoint_preserve_cold_copies(blob_env):
+    """Ordinary traffic AFTER a checkpoint must not destroy it: resident
+    keys never re-fault from blob (no wasted round trip, no deleted
+    object), and a blob-only key's fault-in keeps its pinned checkpoint
+    object — so a later kill-all + ``ts.blob_restore()`` still recovers
+    every committed key byte-identical."""
+    arrs = {
+        f"c{i}": np.arange(300, dtype=np.float32) * (i + 1) for i in range(3)
+    }
+    arrs["obj"] = {"step": 9}
+    await ts.initialize(num_storage_volumes=2, store_name="blobrd")
+    try:
+        for k, v in arrs.items():
+            await ts.put(k, v, store_name="blobrd")
+        c = ts.client("blobrd")
+        await c._ensure_setup()
+        # One key lives blob-ONLY before the checkpoint (demoted): its
+        # post-checkpoint read exercises the pinned fault-in path.
+        assert await _demote_all(c, ["c0"]) == ["c0"]
+        rep = await ts.blob_checkpoint(store_name="blobrd")
+        assert rep["keys"] == len(arrs) and not rep["errors"], rep
+        for k, v in arrs.items():
+            got = await ts.get(k, store_name="blobrd")
+            if isinstance(v, dict):
+                assert got == v
+            else:
+                assert np.array_equal(got, v), k
+        # Every checkpointed object survived the reads.
+        blob_keys = 0
+        for ref in c._volume_refs.values():
+            st = await ref.actor.stats.call_one()
+            blob_keys += st.get("tier", {}).get("blob_keys", 0)
+        assert blob_keys == len(arrs)
+    finally:
+        await ts.shutdown("blobrd")
+        ts.reset_client()
+
+    await ts.initialize(num_storage_volumes=1, store_name="blobrd2")
+    try:
+        rep = await ts.blob_restore(store_name="blobrd2")
+        assert rep["restored"] == len(arrs), rep
+        assert not rep["failed"], rep
+        for k, v in arrs.items():
+            got = await ts.get(k, store_name="blobrd2")
+            if isinstance(v, dict):
+                assert got == v
+            else:
+                assert np.array_equal(got, v), k
+    finally:
+        await ts.shutdown("blobrd2")
 
 
 async def test_blob_restore_requires_manifest(blob_env):

@@ -1386,15 +1386,24 @@ async def autoscale(store_name: str = DEFAULT_STORE) -> dict:
         if a.get("kind") == "retire_volume"
         and str(a.get("outcome", "")).startswith("applied")
     }
-    if retired and handle is not None and handle.owner:
-        # Reclaim the processes of autoscale-spawned volumes that just
-        # retired — THIS is what makes scale-in save volume-seconds.
-        for rec in handle.autoscale_meshes or []:
-            if rec["vid"] in retired and rec["mesh"] is not None:
+    if (
+        handle is not None
+        and handle.owner
+        and any(rec["mesh"] is not None for rec in handle.autoscale_meshes or [])
+    ):
+        # Reclaim the processes of autoscale-spawned volumes no longer
+        # attached to the fleet — THIS is what makes scale-in save
+        # volume-seconds. Reconciling against the controller's live
+        # volume map (not just this round's retire actions) also sweeps
+        # volumes the periodic loop retired between manual rounds, whose
+        # processes would otherwise idle until shutdown.
+        attached = set(await c.controller.get_volume_map.call_one())
+        for rec in handle.autoscale_meshes:
+            if rec["mesh"] is not None and rec["vid"] not in attached:
                 await rec["mesh"].stop()
                 rec["mesh"] = None
                 stopped.append(rec["vid"])
-    if retired:
+    if retired or stopped:
         await c.refresh_volumes()
     result["spawned"] = spawned
     result["stopped"] = stopped

@@ -468,9 +468,9 @@ class AutoscaleEngine:
         self, snap: TelemetrySnapshot, action: AutoscaleAction
     ) -> str:
         """Push up to ``action.count`` of the volume's disk-spilled keys
-        one rung down into the blob tier (the volume picks oldest-first;
-        index tier state is unchanged — the keys stay TIERED, only the
-        backing store moves)."""
+        one rung down into the blob tier (the volume picks the coldest
+        version groups by its LRU clock; index tier state is unchanged —
+        the keys stay TIERED, only the backing store moves)."""
         ref = self.host.volume_refs.get(action.volume)
         if ref is None:
             return self._decision(snap, action, "abandoned: volume gone")

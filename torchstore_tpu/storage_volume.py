@@ -538,17 +538,32 @@ class StorageVolume(Actor):
         """Promote any BLOB-archived keys among ``metas`` back into the
         memory tier before they are served — the bottom rung of the same
         ladder as ``_tier_fault_in``, riding the identical landing
-        bracket. The warm path exits on the first check: one attribute +
-        one dict read."""
+        bracket. Only keys whose SOLE copy lives in blob promote: an
+        archived key still resident (or still on the disk tier, which
+        ``_tier_fault_in`` just promoted) is a ``blob_archive`` checkpoint
+        copy — re-landing it would pay a pointless blob round trip and,
+        worse, let ``restored()`` destroy the durable copy the fleet
+        manifest references. The warm path exits on the first check: one
+        attribute + one dict read."""
         blob = self._blob
         if blob is None or not blob.archived:
             return
-        keys = [meta.key for meta in metas if meta.key in blob.archived]
+        kv = getattr(self.store, "kv", {})
+        tier = self._tier
+
+        def _blob_only(key: str) -> bool:
+            return (
+                key in blob.archived
+                and key not in kv
+                and (tier is None or key not in tier.spilled)
+            )
+
+        keys = [meta.key for meta in metas if _blob_only(meta.key)]
         if not keys:
             return
         async with self._tier_lock:
             for key in dict.fromkeys(keys):
-                if key not in blob.archived:
+                if not _blob_only(key):
                     continue  # a concurrent fault-in already promoted it
                 await faults.afire("volume.fault_in")
                 try:
@@ -703,16 +718,26 @@ class StorageVolume(Actor):
         the blob cold tier: load the crash-safe disk copy, materialise the
         memmap-backed values, archive them as one blob object, then drop
         the disk copy. Only keys already cold enough to have spilled are
-        eligible — the blob tier sits strictly below disk. Driven by the
-        autoscale plane's BLOB_DEMOTE action and ``ts.autoscale()``."""
+        eligible — the blob tier sits strictly below disk — and they
+        demote coldest version group first (the spill tier's LRU clock;
+        keys outside any version group, which the clock never tracks,
+        demote ahead of tracked ones). Driven by the autoscale plane's
+        BLOB_DEMOTE action and ``ts.autoscale()``."""
         blob = self._blob
         tier = self._tier
         if blob is None or tier is None:
             return {"enabled": False, "archived": []}
+        from torchstore_tpu.tiering import version_group
+
+        def _coldness(key: str) -> tuple:
+            vg = version_group(key)
+            group = f"{vg[0]}/v{vg[1]}" if vg is not None else ""
+            return (tier.access.get(group, 0.0), key)
+
         archived: list[str] = []
         nbytes = 0
         async with self._tier_lock:
-            for key in sorted(tier.spilled)[: max(1, limit)]:
+            for key in sorted(tier.spilled, key=_coldness)[: max(1, limit)]:
                 try:
                     dmetas, dvalues = tier.load(key)
                 except KeyError:
@@ -789,6 +814,9 @@ class StorageVolume(Actor):
             for key, n in sorted(blob.archived.items()):
                 if key not in objects:
                     _note(key, n)
+            # Every object the manifest will reference is a checkpoint
+            # copy now: a later fault-in promotion must keep it.
+            blob.pin(objects)
         return {"enabled": True, "objects": objects}
 
     @endpoint

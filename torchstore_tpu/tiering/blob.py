@@ -144,14 +144,17 @@ class BlobStore:
         return len(data)
 
     def get(self, name: str) -> bytes:
-        path = self._path(name)
+        # The service envelope fires BEFORE the read (head() supplies the
+        # byte count for the rate stall) so a chaos schedule arming
+        # ``blob.io`` can fail a get before any bytes move, and the
+        # emulated latency models the request, not a post-I/O penalty.
+        size, _ = self.head(name)
+        self._op("get", size)
         try:
-            with open(path, "rb") as f:
-                data = f.read()
+            with open(self._path(name), "rb") as f:
+                return f.read()
         except FileNotFoundError:
             raise KeyError(name) from None
-        self._op("get", len(data))
-        return data
 
     def head(self, name: str) -> tuple[int, float]:
         """(size, mtime) without transferring the payload (no rate stall)."""
@@ -201,6 +204,22 @@ class BlobTier:
             except KeyError:
                 continue  # deleted between list and head
             self.archived[key] = size
+        # Keys whose blob objects are CHECKPOINT copies the fleet manifest
+        # references (blob_archive pins them): restored() keeps those
+        # objects, because a fault-in promotion must never destroy the
+        # durability copy a later cold restore replays. Seeded from the
+        # last committed manifest so a restarted volume keeps honoring it.
+        self.pinned: set[str] = set()
+        try:
+            doc = read_fleet_manifest(self.store)
+        except Exception:  # noqa: BLE001 - a broken manifest must not
+            # fail volume init; the next checkpoint rewrites it
+            doc = None
+        if doc:
+            for info in (doc.get("keys") or {}).values():
+                name = str(info.get("object", ""))
+                if name.startswith(self.prefix):
+                    self.pinned.add(name[len(self.prefix):])
         self.publish_gauges()
 
     def _object(self, key: str) -> str:
@@ -252,6 +271,13 @@ class BlobTier:
         )
         return nbytes
 
+    def pin(self, keys: Iterable[str]) -> None:
+        """Mark keys' blob objects as checkpoint copies (the fleet
+        manifest references them): ``restored()`` keeps a pinned object
+        on promotion — only an overwrite/delete above this tier
+        (``discard``) may drop it."""
+        self.pinned.update(keys)
+
     def demoted(self, keys: list, nbytes: int) -> None:
         """Record a disk→blob demotion batch (the volume's ``blob_sweep``
         already archived the keys and dropped the disk copies)."""
@@ -273,10 +299,17 @@ class BlobTier:
         return self.decode_entry(self.store.get(self._object(key)))
 
     def restored(self, key: str, reason: str) -> None:
-        """Bookkeeping after the volume re-landed ``key``: drop the blob
-        copy and record the promotion."""
-        nbytes = self.archived.pop(key, 0)
-        self.store.delete(self._object(key))
+        """Bookkeeping after the volume re-landed ``key``. A demoted
+        object (the sole copy) is dropped with the promotion; a pinned
+        CHECKPOINT object is kept — the fleet manifest references it, and
+        deleting it here would destroy the durable copy a later cold
+        restore replays."""
+        kept = key in self.pinned
+        if kept:
+            nbytes = self.archived.get(key, 0)
+        else:
+            nbytes = self.archived.pop(key, 0)
+            self.store.delete(self._object(key))
         _BLOB_RESTORES.inc(reason=reason)
         obs_ledger.record(
             obs_ledger.DISK,
@@ -292,12 +325,15 @@ class BlobTier:
             nbytes=nbytes,
             volume=self.volume_id,
             reason=reason,
+            kept=kept,
         )
         self.publish_gauges()
 
     def discard(self, key: str) -> bool:
         """Drop a stale blob copy (the key was overwritten or deleted
-        above this tier); idempotent."""
+        above this tier — new bytes supersede even a checkpoint copy);
+        idempotent."""
+        self.pinned.discard(key)
         existed = self.archived.pop(key, None) is not None
         if existed:
             self.store.delete(self._object(key))
@@ -333,6 +369,7 @@ class BlobTier:
         replays. Tests isolate runs with per-run TORCHSTORE_TPU_BLOB_DIR
         roots; ``purge()`` is the destructive wipe."""
         self.archived.clear()
+        self.pinned.clear()
         self.publish_gauges()
 
     def purge(self) -> None:
@@ -340,6 +377,7 @@ class BlobTier:
         for key in list(self.archived):
             self.store.delete(self._object(key))
         self.archived.clear()
+        self.pinned.clear()
         self.publish_gauges()
 
 
