@@ -6,11 +6,14 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (16 rules,
+#   1. tslint --fail-on-new     repo-specific static analysis (20 rules:
+#                               16 syntactic + the 4 flow-aware CFG rules
+#                               bracket/epoch/await-atomicity/decision-flow;
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
 #                               --regen-metric-docs after editing knobs or
-#                               instruments)
+#                               instruments). Also emits tslint.sarif for
+#                               CI code-scanning upload.
 #   2. metric namespace shim    scripts/check_metric_names.py (historical
 #                               entry point; same checker as tslint)
 #   3. bench + trajectory smoke pytest over test_bench_smoke.py (the REAL
@@ -48,7 +51,7 @@ run() {
     "$@" || rc=$?
 }
 
-run python scripts/tslint.py --fail-on-new
+run python scripts/tslint.py --fail-on-new --sarif tslint.sarif
 run python scripts/check_metric_names.py
 if [ "${1:-}" != "--quick" ]; then
     run env JAX_PLATFORMS=cpu python -m pytest \

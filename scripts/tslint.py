@@ -1,16 +1,21 @@
 #!/usr/bin/env python
 """tslint — the repo's static-analysis suite (torchstore_tpu/analysis/).
 
-Ten checkers grounded in real shipped bug classes: endpoint-drift,
-async-blocking, cancellation-swallow, orphan-task, fork-safety,
-env-registry, metric-discipline, landing-copy, retry-discipline,
-one-sided-discipline. See docs/ARCHITECTURE.md ("Static analysis") for
-the rule catalog and the baseline workflow.
+Twenty checkers grounded in real shipped bug classes — sixteen syntactic
+single-node rules (endpoint-drift, async-blocking, cancellation-swallow,
+orphan-task, fork-safety, env-registry, metric-discipline, landing-copy,
+retry-discipline, one-sided-discipline, stream/quant/shard/stage/control/
+history discipline) plus four flow-aware rules built on the per-function
+CFG in analysis/flow.py (bracket-discipline, epoch-discipline,
+await-atomicity, decision-flow). See docs/ARCHITECTURE.md ("Static
+analysis") for the rule catalog and the baseline workflow.
 
 Usage:
     python scripts/tslint.py                 # report; exit 1 on NEW findings
-    python scripts/tslint.py --json          # machine-readable report
+    python scripts/tslint.py --json          # machine-readable report (incl.
+                                             # per-rule timing)
     python scripts/tslint.py --fail-on-new   # gate mode: print only new findings
+    python scripts/tslint.py --sarif out.sarif  # also write a SARIF 2.1.0 log
     python scripts/tslint.py --rules orphan-task,cancellation-swallow
     python scripts/tslint.py --write-baseline  # re-grandfather current findings
     python scripts/tslint.py --regen-env-docs  # rewrite docs/API.md env table
@@ -145,6 +150,12 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="rewrite the baseline from current findings and exit 0",
     )
+    parser.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help="also write a SARIF 2.1.0 log to PATH ('-' for stdout); exit "
+        "code is unchanged",
+    )
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument(
         "--regen-env-docs",
@@ -180,6 +191,17 @@ def main(argv: list[str] | None = None) -> int:
             f"{os.path.relpath(args.baseline, args.root)}"
         )
         return 0
+
+    if args.sarif:
+        from torchstore_tpu.analysis.sarif import to_sarif
+
+        doc = json.dumps(to_sarif(result, CHECKERS), indent=2)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                f.write(doc)
+                f.write("\n")
 
     if args.json:
         print(json.dumps(result.to_dict(), indent=2))

@@ -1,0 +1,450 @@
+"""Unit tests for the CFG/dataflow engine (torchstore_tpu/analysis/flow.py),
+independent of any checker.
+
+The flow-aware rules are only as sound as the graph underneath them, so the
+lowering cases that historically hide bugs are pinned here directly:
+try/finally with a raise inside the handler, nested brackets, loop-carried
+opens, ``return`` inside ``with``, and the exception edge every ``await``
+must carry (CancelledError surfaces at each one)."""
+
+import ast
+import pathlib
+import sys
+import textwrap
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from torchstore_tpu.analysis.flow import (  # noqa: E402
+    build_cfg,
+    dominated_by,
+    escaping_opens,
+    iter_cfgs,
+    nodes_between,
+    post_dominated_by,
+)
+
+
+def _cfg(src, name=None):
+    tree = ast.parse(textwrap.dedent(src))
+    for cfg in iter_cfgs(tree):
+        if name is None or cfg.name == name:
+            return cfg
+    raise AssertionError(f"no function {name!r} in source")
+
+
+def _tail(call):
+    f = call.func
+    return getattr(f, "attr", getattr(f, "id", None))
+
+
+def _calls(node, name):
+    return node.stmt is not None and any(_tail(c) == name for c in node.calls)
+
+
+def _node_calling(cfg, name):
+    for n in cfg.stmt_nodes():
+        if _calls(n, name):
+            return n
+    raise AssertionError(f"no node calling {name!r}")
+
+
+def _escapes(cfg, opn="open_b", close="close_b", **kw):
+    pairs = escaping_opens(
+        cfg, lambda n: _calls(n, opn), lambda n: _calls(n, close), **kw
+    )
+    return sorted({(n.lineno, why) for n, why in pairs})
+
+
+# --------------------------------------------------------------------------
+# Graph shape
+# --------------------------------------------------------------------------
+
+
+def test_straight_line_has_exception_edges_everywhere():
+    cfg = _cfg(
+        """
+        def f():
+            a = setup()
+            b = a.compute()
+            return b
+        """
+    )
+    stmts = [n for n in cfg.stmt_nodes() if n.stmt is not None]
+    assert len(stmts) == 3
+    # Even plain assignments can raise: every real statement carries an
+    # exception edge to the synthetic raise exit.
+    assert all(cfg.raise_id in n.exc for n in stmts)
+
+
+def test_loop_has_back_edge():
+    cfg = _cfg(
+        """
+        def f(items):
+            for it in items:
+                work(it)
+            done()
+        """
+    )
+    head = next(n for n in cfg.stmt_nodes() if n.label == "for")
+    body = _node_calling(cfg, "work")
+    assert head.id in body.succ  # back-edge
+    assert body.id in head.succ
+
+
+def test_await_nodes_are_annotated_and_raise():
+    cfg = _cfg(
+        """
+        async def f(x):
+            y = await fetch(x)
+            z = plain(y)
+            return z
+        """
+    )
+    fetch = _node_calling(cfg, "fetch")
+    plain = _node_calling(cfg, "plain")
+    assert fetch.has_await and not plain.has_await
+    # CancelledError can surface at the await: exception edge mandatory.
+    assert cfg.raise_id in fetch.exc
+
+
+def test_async_for_and_async_with_headers_count_as_awaits():
+    cfg = _cfg(
+        """
+        async def f(src, lock):
+            async with lock:
+                async for item in src:
+                    use(item)
+        """
+    )
+    labels = {n.label: n for n in cfg.stmt_nodes() if n.stmt is not None}
+    assert labels["with"].has_await
+    assert labels["for"].has_await
+
+
+def test_nested_def_bodies_are_opaque():
+    cfg = _cfg(
+        """
+        def outer():
+            open_b()
+            def inner():
+                close_b()
+            return inner
+        """,
+        name="outer",
+    )
+    # inner's close_b is not visible in outer's CFG...
+    assert not any(_calls(n, "close_b") for n in cfg.stmt_nodes())
+    # ...so the open escapes on both exits.
+    assert _escapes(cfg) == [(3, "raise"), (3, "return")]
+
+
+# --------------------------------------------------------------------------
+# Bracket escapes (the PR 7 shape and friends)
+# --------------------------------------------------------------------------
+
+
+def test_bare_open_escapes_on_raise_but_finally_covers():
+    bare = _cfg(
+        """
+        def f():
+            open_b()
+            work()
+            close_b()
+        """
+    )
+    assert _escapes(bare) == [(3, "raise")]
+
+    covered = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                work()
+            finally:
+                close_b()
+        """
+    )
+    assert _escapes(covered) == []
+
+
+def test_try_finally_with_raise_in_handler_still_closes():
+    # A handler that re-raises a DIFFERENT exception still traverses the
+    # finally on its way out — the close must be seen on that path.
+    cfg = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                work()
+            except ValueError:
+                note()
+                raise RuntimeError("wrapped")
+            finally:
+                close_b()
+        """
+    )
+    assert _escapes(cfg) == []
+
+
+def test_raise_in_handler_without_finally_escapes():
+    cfg = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                work()
+                close_b()
+            except ValueError:
+                raise RuntimeError("wrapped")
+        """
+    )
+    # The handler path exits with the bracket open; so does a non-ValueError
+    # raise out of work().
+    assert (3, "raise") in _escapes(cfg)
+
+
+def test_except_without_catch_all_keeps_escape_edge():
+    caught = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                work()
+            except BaseException:
+                close_b()
+                raise
+            close_b()
+        """
+    )
+    assert _escapes(caught) == []
+
+    narrow = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                work()
+            except ValueError:
+                close_b()
+                raise
+            close_b()
+        """
+    )
+    # A TypeError out of work() matches no handler and escapes open.
+    assert _escapes(narrow) == [(3, "raise")]
+
+
+def test_nested_brackets_inner_escape_only():
+    cfg = _cfg(
+        """
+        def f():
+            open_a()
+            try:
+                open_b()
+                work()
+                close_b()
+            finally:
+                close_a()
+        """
+    )
+    # Outer bracket is finally-covered; inner one leaks if work() raises.
+    assert _escapes(cfg, "open_a", "close_a") == []
+    assert _escapes(cfg, "open_b", "close_b") == [(5, "raise")]
+
+
+def test_loop_carried_open_escapes_only_on_raise():
+    cfg = _cfg(
+        """
+        def f(items):
+            for it in items:
+                open_b(it)
+                work(it)
+                close_b(it)
+        """
+    )
+    # Every normal iteration closes before the back-edge; only a raise out
+    # of work() leaves the bracket open.
+    assert _escapes(cfg) == [(4, "raise")]
+
+
+def test_open_closed_on_break_path_vs_not():
+    leaky = _cfg(
+        """
+        def f(items):
+            for it in items:
+                open_b(it)
+                if stop(it):
+                    break
+                close_b(it)
+            done()
+        """
+    )
+    assert (4, "return") in _escapes(leaky)
+
+    clean = _cfg(
+        """
+        def f(items):
+            for it in items:
+                open_b(it)
+                try:
+                    if stop(it):
+                        break
+                finally:
+                    close_b(it)
+            done()
+        """
+    )
+    # break traverses the finally copy: closed on the way out of the loop.
+    assert _escapes(clean) == []
+
+
+def test_return_inside_with_escapes_open():
+    cfg = _cfg(
+        """
+        def f():
+            open_b()
+            with ctx():
+                if fast:
+                    return early()
+            close_b()
+        """
+    )
+    esc = _escapes(cfg)
+    assert (3, "return") in esc  # the early return skips the close
+    assert (3, "raise") in esc  # and ctx()/early() can raise
+
+    covered = _cfg(
+        """
+        def f():
+            open_b()
+            try:
+                with ctx():
+                    if fast:
+                        return early()
+            finally:
+                close_b()
+        """
+    )
+    # return-through-finally: the close runs before the function exits.
+    assert _escapes(covered) == []
+
+
+def test_escape_normal_ok_licenses_return_not_raise():
+    cfg = _cfg(
+        """
+        async def f():
+            open_b()
+            await hook()
+        """
+    )
+    assert _escapes(cfg, escape_normal_ok=True) == [(3, "raise")]
+    fixed = _cfg(
+        """
+        async def f():
+            open_b()
+            try:
+                await hook()
+            except BaseException:
+                close_b()
+                raise
+        """
+    )
+    assert _escapes(fixed, escape_normal_ok=True) == []
+
+
+def test_open_own_exception_edge_is_not_an_escape():
+    # If the open call itself raises, the bracket never opened.
+    cfg = _cfg(
+        """
+        def f():
+            open_b()
+            close_b()
+        """
+    )
+    assert _escapes(cfg) == []
+
+
+# --------------------------------------------------------------------------
+# nodes_between / dominance
+# --------------------------------------------------------------------------
+
+
+def test_nodes_between_sees_awaits_on_exception_paths_too():
+    cfg = _cfg(
+        """
+        async def f():
+            open_b()
+            try:
+                quick()
+            except ValueError:
+                await slow_recover()
+            close_b()
+        """
+    )
+    opn = _node_calling(cfg, "open_b")
+    mids = nodes_between(cfg, opn, lambda n: _calls(n, "close_b"))
+    assert any(n.has_await for n in mids)  # the handler await is inside
+
+
+def test_post_dominated_by_over_normal_edges():
+    cfg = _cfg(
+        """
+        def f(self):
+            mutate()
+            if bad:
+                raise ValueError("aborted")
+            bump()
+        """
+    )
+    mut = _node_calling(cfg, "mutate")
+    # The raise path terminates without reaching the exit: vacuously fine.
+    assert post_dominated_by(cfg, mut, lambda n: _calls(n, "bump"))
+
+    leaky = _cfg(
+        """
+        def f(self):
+            mutate()
+            if some:
+                bump()
+        """
+    )
+    mut2 = _node_calling(leaky, "mutate")
+    assert not post_dominated_by(leaky, mut2, lambda n: _calls(n, "bump"))
+
+
+def test_dominated_by_requires_fact_on_every_path_in():
+    cfg = _cfg(
+        """
+        def f(self):
+            audit()
+            act()
+        """
+    )
+    act = _node_calling(cfg, "act")
+    assert dominated_by(cfg, act, lambda n: _calls(n, "audit"))
+
+    branchy = _cfg(
+        """
+        def f(self):
+            if loud:
+                audit()
+            act()
+        """
+    )
+    act2 = _node_calling(branchy, "act")
+    assert not dominated_by(branchy, act2, lambda n: _calls(n, "audit"))
+
+
+def test_build_cfg_smoke_over_live_tree():
+    # Every function in the shipped package must lower without error (the
+    # checkers iterate all of them on every run).
+    count = 0
+    for path in (REPO_ROOT / "torchstore_tpu").rglob("*.py"):
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cfg = build_cfg(node)
+                assert cfg.entry.succ, f"{path}:{node.name} has no entry edge"
+                count += 1
+    assert count > 500, count

@@ -1322,3 +1322,1013 @@ def test_control_discipline_live_tree_clean():
     root = str(pathlib.Path(__file__).resolve().parents[1])
     result = run_checks(root, rules=["control-discipline"])
     assert result.new == [], [str(f) for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# 17. bracket-discipline (flow-aware, ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+def test_bracket_discipline_catches_pr7_begin_landing_verbatim(tmp_path):
+    """The exact PR 7 review finding, now mechanical: the pre-fix
+    ``_begin_landing`` body where ``faults.afire`` can raise after
+    ``begin_writes`` + ``_landing_open`` have run, leaking the inflight
+    count and the odd stamps forever."""
+    from torchstore_tpu.analysis.checkers import bracket_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/storage_volume.py": """
+                from torchstore_tpu import faults
+
+                class StorageVolume:
+                    async def _begin_landing(self, pairs):
+                        cache = self._shm_cache()
+                        if cache is not None:
+                            cache.begin_writes(pairs)
+                        self._landing_open()
+                        await faults.afire("shm.landing_stamp")
+
+                    def _end_landing(self, pairs):
+                        cache = self._shm_cache()
+                        if cache is not None:
+                            cache.end_writes(pairs)
+                        self._landing_close()
+            """,
+        },
+    )
+    findings = bracket_discipline.check(project)
+    raise_escapes = [f for f in findings if "raise can escape" in f.message]
+    assert raise_escapes, [f.render() for f in findings]
+    kinds = {f.message.split(" bracket", 1)[0] for f in raise_escapes}
+    # Both the per-entry stamp bracket and the volume-wide inflight
+    # counter leak on the raise path.
+    assert "stamp-writes" in kinds and "landing-inflight" in kinds, kinds
+    # And the NORMAL exit is licensed — _begin_landing's contract is to
+    # return with the bracket open for the caller's try/finally.
+    assert not any("return path" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_bracket_discipline_fixed_begin_landing_passes(tmp_path):
+    """The shipped PR 7 fix shape (except BaseException: close; raise)
+    is clean, with the open inside the guarded region."""
+    from torchstore_tpu.analysis.checkers import bracket_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/storage_volume.py": """
+                from torchstore_tpu import faults
+
+                class StorageVolume:
+                    async def _begin_landing(self, pairs):
+                        cache = self._shm_cache()
+                        if cache is not None:
+                            cache.begin_writes(pairs)
+                        try:
+                            self._landing_open()
+                            await faults.afire("shm.landing_stamp")
+                        except BaseException:
+                            self._end_landing(pairs)
+                            raise
+
+                    def _end_landing(self, pairs):
+                        cache = self._shm_cache()
+                        if cache is not None:
+                            cache.end_writes(pairs)
+                        self._landing_close()
+            """,
+        },
+    )
+    assert bracket_discipline.check(project) == []
+
+
+def test_bracket_discipline_caller_must_close_on_all_paths(tmp_path):
+    """A CALLER holding the landing bracket (it contains both begin and
+    end) must close on every path: the try/finally idiom passes, a bare
+    sequence is flagged on the raise path."""
+    from torchstore_tpu.analysis.checkers import bracket_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/storage_volume.py": """
+                class StorageVolume:
+                    async def put_ok(self, pairs, reqs):
+                        await self._begin_landing(pairs)
+                        try:
+                            await self._land(reqs)
+                        finally:
+                            self._end_landing(pairs)
+
+                    async def put_leaky(self, pairs, reqs):
+                        await self._begin_landing(pairs)
+                        await self._land(reqs)
+                        self._end_landing(pairs)
+            """,
+        },
+    )
+    findings = bracket_discipline.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "'put_leaky'" in findings[0].message
+    assert "raise can escape" in findings[0].message
+
+
+def test_bracket_discipline_lease_pairs_only_when_paired(tmp_path):
+    """Acquire-only functions transfer lease ownership to their caller and
+    are skipped; a function with both acquire and release must not leak
+    on the return path."""
+    from torchstore_tpu.analysis.checkers import bracket_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/weight_channel.py": """
+                class Channel:
+                    async def acquire_only(self, client, version):
+                        return await client.lease_acquire("o", self.name, version)
+
+                    async def leaky_paired(self, client, version):
+                        lease = await client.lease_acquire("o", self.name, version)
+                        if await self.fast_path(lease):
+                            return lease["payload"]
+                        await client.lease_release(lease["lease_id"])
+                        return None
+            """,
+        },
+    )
+    findings = bracket_discipline.check(project)
+    assert findings, "paired acquire/release with an escaping return must flag"
+    assert all("'leaky_paired'" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_bracket_discipline_live_tree_clean():
+    """The live tree is clean (baseline stays empty): every bracket open
+    reaches its close on all paths, or carries a justified pragma (the
+    lease handoff in weight_channel._pinned_lease)."""
+    result = run_checks(str(REPO_ROOT), rules=["bracket-discipline"])
+    assert result.new == [], [f.render() for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# 18. epoch-discipline (flow-aware, ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+def test_epoch_discipline_catches_missing_bump_on_one_branch(tmp_path):
+    """The historical shape: a structural mutation whose epoch bump sits
+    behind a condition the mutation does not share — one branch returns
+    with clients still routing on the stale placement."""
+    from torchstore_tpu.analysis.checkers import epoch_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def notify_delete_batch(self, keys):
+                        by_volume = self.core.delete_keys(keys)
+                        if self.quiet:
+                            return by_volume
+                        self._bump_epoch()
+                        return by_volume
+            """,
+        },
+    )
+    findings = epoch_discipline.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "'delete_keys'" in findings[0].message
+    assert "'notify_delete_batch'" in findings[0].message
+
+
+def test_epoch_discipline_bump_on_every_path_passes(tmp_path):
+    """Unconditional bump after the mutation passes; so does a bump routed
+    through the coordinator endpoint wrapper, and a mutation whose only
+    bump-free paths are explicit raises (the abort is not client-visible)."""
+    from torchstore_tpu.analysis.checkers import epoch_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def delete_finish(self, keys):
+                        by_volume = self.core.delete_keys(keys)
+                        self._bump_epoch()
+                        return by_volume
+
+                    async def guarded(self, keys):
+                        if self.sharded:
+                            raise RuntimeError("route via shards")
+                        out = self.core.delete_keys(keys)
+                        self._bump_epoch()
+                        return out
+            """,
+            "torchstore_tpu/metadata/shards.py": """
+                class ControllerShard:
+                    async def on_structural(self):
+                        await self.coordinator.bump_placement_epoch.call_one()
+
+                    async def drop(self, vid):
+                        self.core.detach_volume(vid)
+                        await self.coordinator.bump_placement_epoch.call_one()
+            """,
+        },
+    )
+    assert epoch_discipline.check(project) == []
+
+
+def test_epoch_discipline_out_of_scope_files_exempt(tmp_path):
+    """The same call names outside the three structural-state files are
+    someone else's protocol (e.g. the autoscale engine calls detach_volume
+    through the controller endpoint, which owns the bump)."""
+    from torchstore_tpu.analysis.checkers import epoch_discipline
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/autoscale/engine.py": """
+                class Engine:
+                    async def retire(self, ref, vid):
+                        await ref.detach_volume.call_one(vid)
+            """,
+        },
+    )
+    assert epoch_discipline.check(project) == []
+
+
+def test_epoch_discipline_live_tree_clean():
+    """The live tree is clean (baseline stays empty): every raw structural
+    mutation is post-dominated by a bump, or carries a pragma naming the
+    protocol that owns it (conditional-bump gates, the sharded 3-phase
+    delete)."""
+    result = run_checks(str(REPO_ROOT), rules=["epoch-discipline"])
+    assert result.new == [], [f.render() for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# 19. await-atomicity (flow-aware, ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+def test_await_atomicity_catches_await_inside_publish_bracket(tmp_path):
+    """An ``await`` injected between ``_publish_open`` and
+    ``_publish_close`` parks the metadata seqlock odd for an unbounded
+    time — every reader burns its torn-read retries."""
+    from torchstore_tpu.analysis.checkers import await_atomicity
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/metadata/stamped.py": """
+                import asyncio
+
+                class MetaStampWriter:
+                    async def publish_now(self, blob):
+                        seq = self._publish_open()
+                        self.words[2] = len(blob)
+                        await asyncio.sleep(0)
+                        self._publish_close(seq)
+            """,
+        },
+    )
+    findings = await_atomicity.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "await suspends" in findings[0].message
+    assert "'publish_now'" in findings[0].message
+
+
+def test_await_atomicity_blocking_call_in_bracket_flagged_sync_too(tmp_path):
+    """async_blocking's table is reused: a known-blocking call between the
+    open and close wedges readers even in a sync writer."""
+    from torchstore_tpu.analysis.checkers import await_atomicity
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/metadata/stamped.py": """
+                import time
+
+                class MetaStampWriter:
+                    def publish_now(self, blob):
+                        seq = self._publish_open()
+                        time.sleep(0.01)
+                        self._publish_close(seq)
+            """,
+        },
+    )
+    findings = await_atomicity.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "known-blocking call (sleep)" in findings[0].message
+
+
+def test_await_atomicity_clean_bracket_and_awaits_outside_pass(tmp_path):
+    from torchstore_tpu.analysis.checkers import await_atomicity
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/metadata/stamped.py": """
+                import asyncio
+
+                class MetaStampWriter:
+                    async def publish_now(self, payload_fn):
+                        blob = await asyncio.to_thread(payload_fn)
+                        seq = self._publish_open()
+                        self.words[2] = len(blob)
+                        self._publish_close(seq)
+                        await asyncio.sleep(0)
+            """,
+        },
+    )
+    assert await_atomicity.check(project) == []
+
+
+def test_await_atomicity_catches_lock_skipping_dict_mutation(tmp_path):
+    """The PR 18 ledger-singleton race shape: one async path mutates a
+    shared dict under the module's asyncio.Lock, a second path mutates it
+    with no lock held — the lock guards nothing."""
+    from torchstore_tpu.analysis.checkers import await_atomicity
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/puller.py": """
+                import asyncio
+
+                class Puller:
+                    def __init__(self):
+                        self._conns = {}
+                        self._lock = asyncio.Lock()
+
+                    async def get_conn(self, key):
+                        async with self._lock:
+                            if key not in self._conns:
+                                self._conns[key] = dial(key)
+                        return self._conns[key]
+
+                    async def close(self):
+                        self._conns.clear()
+            """,
+        },
+    )
+    findings = await_atomicity.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "'_conns'" in findings[0].message
+    assert "'close'" in findings[0].message
+
+
+def test_await_atomicity_lock_held_everywhere_passes(tmp_path):
+    from torchstore_tpu.analysis.checkers import await_atomicity
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/puller.py": """
+                import asyncio
+
+                class Puller:
+                    def __init__(self):
+                        self._conns = {}
+                        self._lock = asyncio.Lock()
+
+                    async def get_conn(self, key):
+                        async with self._lock:
+                            if key not in self._conns:
+                                self._conns[key] = dial(key)
+                            return self._conns[key]
+
+                    async def close(self):
+                        async with self._lock:
+                            self._conns.clear()
+
+                    async def read_only_ok(self, key):
+                        return self._conns.get(key)
+            """,
+        },
+    )
+    assert await_atomicity.check(project) == []
+
+
+def test_await_atomicity_live_tree_clean():
+    """The live tree is clean (baseline stays empty): the stamp-bracket
+    landing path is deliberately NOT in the atomic set (holding across the
+    awaited landing copy is the design), and every shared dict mutation
+    takes its module's lock."""
+    result = run_checks(str(REPO_ROOT), rules=["await-atomicity"])
+    assert result.new == [], [f.render() for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# 20. decision-flow (flow-aware, ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+def test_decision_flow_catches_early_return_skipping_audit(tmp_path):
+    """The control-discipline blind spot, closed: the function DOES call
+    ``_decision`` (same scope — the old rule passes), but an early return
+    between the actuation and the audit leaves an unrecorded mutation."""
+    from torchstore_tpu.analysis.checkers import control_discipline, decision_flow
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def apply_move(self, snap, action):
+                        await self.host.idx.migrate_key(
+                            action.subject, action.src, action.dst, drop_src=True
+                        )
+                        if snap.quiet:
+                            return None
+                        return self._decision(snap, action, "applied")
+            """,
+        },
+    )
+    # Same-scope rule is blind to this by design...
+    assert control_discipline.check(project) == []
+    # ...the flow-aware rule is not.
+    findings = decision_flow.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "'migrate_key'" in findings[0].message
+    assert "'apply_move'" in findings[0].message
+
+
+def test_decision_flow_post_dominating_and_dominating_audits_pass(tmp_path):
+    """Both sanctioned idioms pass: act-then-return-_decision on every
+    branch (the _apply_* shape), and audit-before-act (the checkpoint
+    shape). An exception edge out of the actuator is exempt — _apply's
+    wrapper funnels the error through _decision itself."""
+    from torchstore_tpu.analysis.checkers import decision_flow
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/autoscale/engine.py": """
+                class Engine:
+                    async def apply_retire(self, snap, action):
+                        await self.ref.detach_volume.call_one(action.vid)
+                        if snap.drop:
+                            await self.ref.drop_volume.call_one(action.vid)
+                            return self._decision(snap, action, "dropped")
+                        return self._decision(snap, action, "detached")
+
+                    async def checkpoint(self, snap, action, ref):
+                        self._decision(snap, action, "archiving")
+                        await ref.blob_archive.call_one(action.vid)
+            """,
+        },
+    )
+    assert decision_flow.check(project) == []
+
+
+def test_decision_flow_relay_reparent_needs_audit_on_path(tmp_path):
+    from torchstore_tpu.analysis.checkers import decision_flow
+
+    project = _project(
+        tmp_path,
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    def reparent(self, host, channel, order, snap, action):
+                        host._relay_prefer[channel] = tuple(order)
+                        if not self.verbose:
+                            return
+                        self._decision(snap, action, "reparented")
+            """,
+        },
+    )
+    findings = decision_flow.check(project)
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "'_relay_prefer'" in findings[0].message
+
+
+def test_decision_flow_live_tree_clean():
+    """The live tree is clean (baseline stays empty): every engine
+    actuator is dominated or post-dominated by its decision event on
+    every normal path."""
+    result = run_checks(str(REPO_ROOT), rules=["decision-flow"])
+    assert result.new == [], [f.render() for f in result.new]
+
+
+# --------------------------------------------------------------------------
+# Fixture completeness: every registered rule has a dirty AND a clean fixture
+# --------------------------------------------------------------------------
+
+_ENV_ENTRIES, _ENV_PREFIXES, _ = env_registry.parse_registry(
+    textwrap.dedent(_FIXTURE_CONFIG)
+)
+_ENV_DOCS_OK = (
+    "# API\n\n"
+    + env_registry.DOCS_BEGIN
+    + "\n"
+    + env_registry.render_env_table(_ENV_ENTRIES)
+    + "\n"
+    + env_registry.DOCS_END
+    + "\n"
+)
+
+_STAGE_TIMELINE_STUB = """
+    def observe_stage(op, stage, dur_s):
+        _stages.observe(op, stage, dur_s)
+    """
+
+# rule -> (dirty fixture files, clean fixture files). The meta-test below
+# holds this table to the CHECKERS registry, so registering rule #21 without
+# a detectable-defect fixture and a quiet fixture fails tier-1 immediately —
+# a rule nobody can demonstrate firing is a no-op waiting to happen.
+RULE_FIXTURES = {
+    "endpoint-drift": (
+        {
+            "torchstore_tpu/vol.py": _ACTOR_SRC,
+            "torchstore_tpu/caller.py": """
+                async def go(ref, buf, metas):
+                    await ref.putt.call_one(buf, metas)
+                """,
+        },
+        {
+            "torchstore_tpu/vol.py": _ACTOR_SRC,
+            "torchstore_tpu/caller.py": """
+                async def go(ref, buf, metas):
+                    await ref.put.call_one(buf, metas)
+                """,
+        },
+    ),
+    "async-blocking": (
+        {
+            "torchstore_tpu/m.py": """
+                import time
+                async def f():
+                    time.sleep(1)
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+                async def f():
+                    await asyncio.sleep(1)
+                """,
+        },
+    ),
+    "cancellation-swallow": (
+        {
+            "torchstore_tpu/m.py": """
+                async def f(op):
+                    try:
+                        await op()
+                    except BaseException:
+                        pass
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                async def f(op):
+                    try:
+                        await op()
+                    except BaseException:
+                        cleanup()
+                        raise
+                """,
+        },
+    ),
+    "orphan-task": (
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+                def spawn():
+                    asyncio.create_task(work())
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+                async def spawn():
+                    t = asyncio.create_task(work())
+                    await t
+                """,
+        },
+    ),
+    "fork-safety": (
+        {
+            "torchstore_tpu/m.py": """
+                import threading
+                _registry = {}
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                _registry = {}
+
+                def reinit_after_fork():
+                    _registry.clear()
+                """,
+        },
+    ),
+    "env-registry": (
+        {
+            "torchstore_tpu/config.py": _FIXTURE_CONFIG,
+            "torchstore_tpu/m.py": """
+                import os
+                bad = os.environ.get("TORCHSTORE_TPU_BAR")
+                """,
+        },
+        {
+            "torchstore_tpu/config.py": _FIXTURE_CONFIG,
+            "torchstore_tpu/m.py": """
+                import os
+                ok = os.environ.get("TORCHSTORE_TPU_FOO", "7")
+                dead = os.environ.get("TORCHSTORE_TPU_DEAD")
+                """,
+            "docs/API.md": _ENV_DOCS_OK,
+        },
+    ),
+    "metric-discipline": (
+        {
+            "torchstore_tpu/m.py": """
+                from torchstore_tpu.observability import metrics as m
+                _BAD = m.gauge("Bad-Name", "not snake case")
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                from torchstore_tpu.observability import metrics as m
+                _C = m.counter("ts_thing_total", "help")
+                """,
+        },
+    ),
+    "landing-copy": (
+        {
+            "torchstore_tpu/transport/somexport.py": """
+                import numpy as np
+                def land(dst, src):
+                    np.copyto(dst, src)
+                """,
+        },
+        {
+            "torchstore_tpu/transport/somexport.py": """
+                from torchstore_tpu.native import copy_into
+                def land(dst, src):
+                    copy_into(dst, src)
+                """,
+        },
+    ),
+    "retry-discipline": (
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+                async def drain():
+                    while True:
+                        try:
+                            await push()
+                            return
+                        except ConnectionError:
+                            await asyncio.sleep(1.0)
+                """,
+        },
+        {
+            "torchstore_tpu/m.py": """
+                import asyncio
+                async def drain(policy):
+                    attempt = 0
+                    while policy.should_retry(attempt):
+                        try:
+                            await push()
+                            return
+                        except ConnectionError:
+                            await asyncio.sleep(policy.backoff(attempt))
+                            attempt += 1
+                """,
+        },
+    ),
+    "one-sided-discipline": (
+        {
+            "torchstore_tpu/client.py": """
+                def bad(seg, meta):
+                    return seg.view(meta)
+                """,
+        },
+        {
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu.transport import shared_memory as shm
+                def good(seg, meta):
+                    return shm.segment_read_view(seg, meta)
+                """,
+        },
+    ),
+    "stream-discipline": (
+        {
+            "torchstore_tpu/weight_channel.py": """
+                async def acquire(state, key):
+                    return state["watermarks"][key]
+                """,
+        },
+        {
+            "torchstore_tpu/weight_channel.py": """
+                from torchstore_tpu import stream_sync
+                def fine(state, keys, version):
+                    return stream_sync.inconsistent_keys(state, keys, version)
+                """,
+        },
+    ),
+    "quant-discipline": (
+        {
+            "torchstore_tpu/weight_channel.py": """
+                def bad(marker):
+                    return marker.get("scales")
+                """,
+        },
+        {
+            "torchstore_tpu/state_dict_utils.py": """
+                def codec_home(info):
+                    return info["scales"]
+                """,
+        },
+    ),
+    "shard-discipline": (
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def peek(self, key):
+                        return self.index.get(key)
+                """,
+        },
+        {
+            "torchstore_tpu/metadata/index_core.py": """
+                class IndexCore:
+                    def get(self, key):
+                        return self.index.get(key)
+                """,
+        },
+    ),
+    "stage-discipline": (
+        {
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu.observability import timeline as obs_timeline
+                def drifted(dur):
+                    obs_timeline.observe_stage("get", "landing_copy", dur)
+                """,
+            "torchstore_tpu/observability/timeline.py": _STAGE_TIMELINE_STUB,
+        },
+        {
+            "torchstore_tpu/client.py": """
+                from torchstore_tpu.observability import timeline as obs_timeline
+                def fine(dur):
+                    obs_timeline.observe_stage("get", "landing", dur)
+                """,
+            "torchstore_tpu/observability/timeline.py": _STAGE_TIMELINE_STUB,
+        },
+    ),
+    "control-discipline": (
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def silent_move(self, key, src, dst):
+                        return await self.host.idx.migrate_key(
+                            key, src, dst, drop_src=True
+                        )
+                """,
+        },
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def audited_move(self, snap, action):
+                        await self.host.idx.migrate_key(
+                            action.subject, action.src, action.dst, drop_src=True
+                        )
+                        return self._decision(snap, action, "applied")
+                """,
+        },
+    ),
+    "history-discipline": (
+        {
+            "torchstore_tpu/dets.py": """
+                from torchstore_tpu.observability.detect import Detector
+                SELECTOR = "ts_landing_inflight"
+                BAD = Detector(name="f", series=SELECTOR, kind="sustained")
+                """,
+        },
+        {
+            "torchstore_tpu/metrics_def.py": """
+                from torchstore_tpu.observability import metrics as m
+                _G = m.gauge("ts_landing_inflight", "open landing brackets")
+                """,
+            "torchstore_tpu/dets.py": """
+                from torchstore_tpu.observability.detect import Detector
+                GOOD = Detector(
+                    name="a", series="ts_landing_inflight", kind="sustained"
+                )
+                """,
+        },
+    ),
+    "bracket-discipline": (
+        {
+            "torchstore_tpu/storage_volume.py": """
+                class StorageVolume:
+                    async def put_leaky(self, pairs, reqs):
+                        await self._begin_landing(pairs)
+                        await self._land(reqs)
+                        self._end_landing(pairs)
+                """,
+        },
+        {
+            "torchstore_tpu/storage_volume.py": """
+                class StorageVolume:
+                    async def put_ok(self, pairs, reqs):
+                        await self._begin_landing(pairs)
+                        try:
+                            await self._land(reqs)
+                        finally:
+                            self._end_landing(pairs)
+                """,
+        },
+    ),
+    "epoch-discipline": (
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def notify_delete_batch(self, keys):
+                        by_volume = self.core.delete_keys(keys)
+                        if self.loud:
+                            self._bump_epoch()
+                        return by_volume
+                """,
+        },
+        {
+            "torchstore_tpu/controller.py": """
+                class Controller:
+                    async def notify_delete_batch(self, keys):
+                        by_volume = self.core.delete_keys(keys)
+                        self._bump_epoch()
+                        return by_volume
+                """,
+        },
+    ),
+    "await-atomicity": (
+        {
+            "torchstore_tpu/metadata/stamped.py": """
+                import asyncio
+                class MetaStampWriter:
+                    async def publish_now(self, blob):
+                        seq = self._publish_open()
+                        await asyncio.sleep(0)
+                        self._publish_close(seq)
+                """,
+        },
+        {
+            "torchstore_tpu/metadata/stamped.py": """
+                class MetaStampWriter:
+                    def publish_now(self, blob):
+                        seq = self._publish_open()
+                        self.words[2] = len(blob)
+                        self._publish_close(seq)
+                """,
+        },
+    ),
+    "decision-flow": (
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def apply_move(self, snap, action):
+                        await self.host.idx.migrate_key(
+                            action.subject, action.src, action.dst, drop_src=True
+                        )
+                        if snap.quiet:
+                            return None
+                        return self._decision(snap, action, "applied")
+                """,
+        },
+        {
+            "torchstore_tpu/control/engine.py": """
+                class Engine:
+                    async def apply_move(self, snap, action):
+                        await self.host.idx.migrate_key(
+                            action.subject, action.src, action.dst, drop_src=True
+                        )
+                        return self._decision(snap, action, "applied")
+                """,
+        },
+    ),
+}
+
+
+def test_rule_fixtures_cover_every_registered_rule():
+    """Registering a rule without fixtures is itself a tier-1 failure."""
+    assert set(RULE_FIXTURES) == set(CHECKERS), (
+        "every rule in CHECKERS needs a (dirty, clean) entry in RULE_FIXTURES: "
+        f"missing={sorted(set(CHECKERS) - set(RULE_FIXTURES))} "
+        f"stale={sorted(set(RULE_FIXTURES) - set(CHECKERS))}"
+    )
+    assert len(CHECKERS) == 20, sorted(CHECKERS)
+
+
+@pytest.mark.parametrize("rule", sorted(CHECKERS))
+def test_rule_dirty_fixture_detects(rule, tmp_path):
+    dirty, _clean = RULE_FIXTURES[rule]
+    findings = CHECKERS[rule](_project(tmp_path, dirty))
+    assert findings, f"{rule}: dirty fixture produced no finding"
+    assert all(f.rule == rule for f in findings), [f.render() for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(CHECKERS))
+def test_rule_clean_fixture_is_quiet(rule, tmp_path):
+    _dirty, clean = RULE_FIXTURES[rule]
+    findings = CHECKERS[rule](_project(tmp_path, clean))
+    assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------------------------------------
+# Runtime budget, per-rule timing, SARIF (ISSUE 19 satellites)
+# --------------------------------------------------------------------------
+
+
+def test_full_gate_budget_timing_and_sarif(tmp_path):
+    """One full 20-rule gate over the live tree, in a fresh interpreter the
+    way CI runs it: must finish well under the 30 s budget (parallel
+    checkers + the parse cache), expose per-rule wall time in the JSON
+    report, and emit a SARIF 2.1.0 log whose rule table matches the
+    registry — with zero results, because the tree is clean."""
+    import time
+
+    sarif_path = tmp_path / "gate.sarif"
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO_ROOT / "scripts" / "tslint.py"),
+            "--fail-on-new",
+            "--json",
+            "--sarif",
+            str(sarif_path),
+        ],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 30.0, f"tslint gate took {elapsed:.1f}s (budget: 30s)"
+
+    doc = json.loads(proc.stdout)
+    assert len(doc["rules"]) == 20, doc["rules"]
+    assert doc["new"] == 0
+    assert set(doc["rule_seconds"]) == set(doc["rules"])
+    assert all(v >= 0.0 for v in doc["rule_seconds"].values())
+
+    sarif = json.loads(sarif_path.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    assert sorted(r["id"] for r in rules) == sorted(doc["rules"])
+    assert all(r["shortDescription"]["text"] for r in rules)
+    assert all(r["help"]["text"] for r in rules)
+    assert run["results"] == []
+
+
+def test_sarif_fingerprints_and_baseline_states(tmp_path):
+    """SARIF results carry the repo's line-independent finding identity:
+    the fingerprint survives the finding moving to another line, and a
+    baselined finding is emitted as note/unchanged rather than error/new."""
+    from torchstore_tpu.analysis.sarif import to_sarif
+
+    src = """
+        import asyncio
+
+        def spawn():
+            asyncio.create_task(work())
+        """
+    _project(tmp_path, {"torchstore_tpu/m.py": src})
+    result = run_checks(str(tmp_path), rules=["orphan-task"])
+    doc = to_sarif(result, CHECKERS)
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "orphan-task"
+    assert res["level"] == "error" and res["baselineState"] == "new"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "torchstore_tpu/m.py"
+    fp = res["partialFingerprints"]["tslintIdentity/v1"]
+
+    # Shift the defect down three lines: identity (and fingerprint) stable.
+    (tmp_path / "torchstore_tpu" / "m.py").write_text(
+        "\n\n\n" + textwrap.dedent(src)
+    )
+    shifted = to_sarif(run_checks(str(tmp_path), rules=["orphan-task"]), CHECKERS)
+    (res2,) = shifted["runs"][0]["results"]
+    assert res2["partialFingerprints"]["tslintIdentity/v1"] == fp
+    assert res2["locations"][0]["physicalLocation"]["region"]["startLine"] != loc[
+        "region"
+    ]["startLine"]
+
+    # Grandfathered: same result, downgraded presentation.
+    baseline = tmp_path / "baseline.json"
+    save_baseline(str(baseline), result.findings)
+    gated = run_checks(
+        str(tmp_path), rules=["orphan-task"], baseline_path=str(baseline)
+    )
+    doc3 = to_sarif(gated, CHECKERS)
+    (res3,) = doc3["runs"][0]["results"]
+    assert res3["level"] == "note" and res3["baselineState"] == "unchanged"
+    assert res3["partialFingerprints"]["tslintIdentity/v1"] == fp

@@ -10,10 +10,14 @@ docs/ARCHITECTURE.md.
 
 from torchstore_tpu.analysis.checkers import (
     async_blocking,
+    await_atomicity,
+    bracket_discipline,
     cancellation,
     control_discipline,
+    decision_flow,
     endpoint_drift,
     env_registry,
+    epoch_discipline,
     fork_safety,
     history_discipline,
     landing_copy,
@@ -44,4 +48,8 @@ CHECKERS = {
     stage_discipline.RULE: stage_discipline.check,
     control_discipline.RULE: control_discipline.check,
     history_discipline.RULE: history_discipline.check,
+    bracket_discipline.RULE: bracket_discipline.check,
+    epoch_discipline.RULE: epoch_discipline.check,
+    await_atomicity.RULE: await_atomicity.check,
+    decision_flow.RULE: decision_flow.check,
 }

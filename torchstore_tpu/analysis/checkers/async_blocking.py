@@ -53,6 +53,32 @@ _BLOCKING_TAILS = {
 }
 
 
+def blocking_reason(node: ast.Call) -> str | None:
+    """Why this call blocks the calling thread, or None if it is not in the
+    known-blocking table. Shared with await-atomicity, which bans the same
+    calls inside seqlock publish brackets (where a stalled thread wedges
+    every reader, async or not)."""
+    dotted = dotted_name(node.func)
+    tail = call_tail(node)
+    if dotted is not None and dotted in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[dotted]
+    if isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_NAMES:
+        return _BLOCKING_NAMES[node.func.id]
+    if tail in _BLOCKING_TAILS:
+        return _BLOCKING_TAILS[tail]
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "result"
+        and not node.args
+        and not node.keywords
+    ):
+        return (
+            ".result() on a concurrent Future blocks the event "
+            "loop (await it, or asyncio.wrap_future first)"
+        )
+    return None
+
+
 def check(project: Project) -> list[Finding]:
     findings: list[Finding] = []
     for sf in project.files:
@@ -64,25 +90,7 @@ def check(project: Project) -> list[Finding]:
             for node in walk_scope(fn.body):
                 if not isinstance(node, ast.Call):
                     continue
-                msg = None
-                dotted = dotted_name(node.func)
-                tail = call_tail(node)
-                if dotted is not None and dotted in _BLOCKING_DOTTED:
-                    msg = _BLOCKING_DOTTED[dotted]
-                elif isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_NAMES:
-                    msg = _BLOCKING_NAMES[node.func.id]
-                elif tail in _BLOCKING_TAILS:
-                    msg = _BLOCKING_TAILS[tail]
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "result"
-                    and not node.args
-                    and not node.keywords
-                ):
-                    msg = (
-                        ".result() on a concurrent Future blocks the event "
-                        "loop (await it, or asyncio.wrap_future first)"
-                    )
+                msg = blocking_reason(node)
                 if msg is not None:
                     findings.append(
                         Finding(

@@ -1,0 +1,239 @@
+"""await-atomicity: no suspension point inside an atomic seqlock bracket,
+and no dict mutated both under and outside an ``asyncio.Lock``.
+
+Two interleaving-race shapes, one rule:
+
+**(a) Awaits inside an atomic publish bracket.** The metadata seqlock
+bracket (``_publish_open`` … ``_publish_close``) keeps the sequence word
+odd while the writer mutates the mapped words; readers spin until it
+settles even. The bracket is correct only if the writer gets from open to
+close without suspending: an ``await`` (or a call into async_blocking's
+known-blocking table — a stalled thread is the same wedge without the
+event loop's help) strictly between open and close parks the bracket odd
+for an unbounded time and every reader burns its torn-read retries. The
+checker walks every CFG path between an open and its close — normal and
+exception edges both — and flags any node that can suspend. The DATA-plane
+landing bracket (``begin_writes``/``_begin_landing``) is deliberately NOT
+in the atomic set: it is designed to be held across the awaited landing
+copy (readers of those specific keys retry by contract while bytes land).
+
+**(b) Lock-skipping dict mutation.** The PR 18 ledger-singleton race:
+a module holds an ``asyncio.Lock`` and mutates a shared dict under it on
+one path, but a second path mutates the same dict with no lock held —
+the lock guards nothing. The checker collects, per module, every dict
+attribute/name initialized with a literal ``{}``/``dict()`` alongside an
+``asyncio.Lock()``, then flags identities that are subscript-mutated both
+inside an ``async with <lock>`` body and outside any lock in an
+``async def`` of the same module. Read-only access is fine; the race
+needs two mutators.
+
+Suppressions carry ``# tslint: disable=await-atomicity`` with the
+invariant that makes the interleaving safe.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, call_tail
+from torchstore_tpu.analysis.checkers.async_blocking import blocking_reason
+from torchstore_tpu.analysis.flow import FlowNode, iter_cfgs, nodes_between
+
+RULE = "await-atomicity"
+
+# (open, close) pairs that must be suspension-free between them.
+ATOMIC_BRACKETS = (("_publish_open", "_publish_close"),)
+
+
+def _calls(node: FlowNode, name: str) -> bool:
+    return any(call_tail(c) == name for c in node.calls)
+
+
+def _suspension(node: FlowNode) -> str | None:
+    if node.has_await:
+        return "await suspends the coroutine"
+    for c in node.calls:
+        reason = blocking_reason(c)
+        if reason is not None:
+            return f"known-blocking call ({call_tail(c)})"
+    return None
+
+
+def _check_brackets(sf, findings: list[Finding]) -> None:
+    for cfg in iter_cfgs(sf.tree):
+        for opn, close in ATOMIC_BRACKETS:
+            for node in cfg.stmt_nodes():
+                if not _calls(node, opn):
+                    continue
+                for mid in nodes_between(
+                    cfg, node, lambda n, c=close: _calls(n, c)
+                ):
+                    why = _suspension(mid)
+                    if why is None:
+                        continue
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=mid.lineno,
+                            message=(
+                                f"suspension point inside the {opn}/"
+                                f"{close} bracket in '{cfg.name}': {why} "
+                                "while the seqlock is odd — readers spin "
+                                "until their torn-read retries are "
+                                "exhausted; move it outside the bracket"
+                            ),
+                        )
+                    )
+
+
+# -- (b) lock-skipping dict mutation ---------------------------------------
+
+
+def _attr_or_name(node: ast.AST) -> str | None:
+    """Identity for ``self._x`` / ``cls._x`` / module-level ``_x``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and call_tail(value) == "Lock"
+    )
+
+
+def _is_dict_ctor(value: ast.AST) -> bool:
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True
+    return (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Name)
+        and value.func.id == "dict"
+        and not value.args
+    )
+
+
+def _mutated_dict(node: ast.AST) -> str | None:
+    """The identity a statement subscript-mutates, or None."""
+    target = None
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                target = t.value
+    elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Subscript):
+        target = node.target.value
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                target = t.value
+    elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        call = node.value
+        if call_tail(call) in ("pop", "setdefault", "update", "clear", "popitem"):
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                target = f.value
+    if target is None:
+        return None
+    return _attr_or_name(target)
+
+
+def _lock_names_in_items(stmt) -> set:
+    names = set()
+    for item in stmt.items:
+        expr = item.context_expr
+        # ``async with self._lock:`` / ``async with _lock:``
+        name = _attr_or_name(expr)
+        if name:
+            names.add(name)
+    return names
+
+
+def _check_lock_skew(sf, findings: list[Finding]) -> None:
+    tree = sf.tree
+    # Identities initialized as bare dicts and as asyncio Locks anywhere in
+    # the module (class bodies, __init__, module level).
+    dicts: set = set()
+    locks: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            name = _attr_or_name(node.targets[0])
+            if name is None:
+                continue
+            if _is_dict_ctor(node.value):
+                dicts.add(name)
+            elif _is_lock_ctor(node.value):
+                locks.add(name)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            name = _attr_or_name(node.target)
+            if name is None:
+                continue
+            if _is_dict_ctor(node.value):
+                dicts.add(name)
+            elif _is_lock_ctor(node.value):
+                locks.add(name)
+    if not dicts or not locks:
+        return
+
+    # Mutation sites, split by whether a known lock is held. Only async
+    # functions count — a sync mutator can't interleave with the loop.
+    guarded: dict = {}
+    bare: dict = {}
+
+    def scan(body, lock_held: bool, fname: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.Lambda)):
+                continue
+            if isinstance(stmt, ast.AsyncFunctionDef):
+                continue  # separate scope, scanned at its own def
+            held_here = lock_held
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if _lock_names_in_items(stmt) & locks:
+                    held_here = True
+            name = _mutated_dict(stmt)
+            if name in dicts:
+                side = guarded if lock_held else bare
+                side.setdefault(name, []).append((stmt.lineno, fname))
+            for child_body in (
+                getattr(stmt, "body", []),
+                getattr(stmt, "orelse", []),
+                getattr(stmt, "finalbody", []),
+            ):
+                if child_body:
+                    scan(child_body, held_here, fname)
+            for handler in getattr(stmt, "handlers", []):
+                scan(handler.body, held_here, fname)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            scan(node.body, False, node.name)
+
+    for name in sorted(set(guarded) & set(bare)):
+        for line, fname in sorted(set(bare[name])):
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=line,
+                    message=(
+                        f"dict '{name}' is mutated under an asyncio.Lock "
+                        f"elsewhere in this module but '{fname}' mutates "
+                        "it with no lock held — the lock guards nothing; "
+                        "take the same lock (or pragma with the invariant "
+                        "that serializes these paths)"
+                    ),
+                )
+            )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith("torchstore_tpu/"):
+            continue
+        _check_brackets(sf, findings)
+        _check_lock_skew(sf, findings)
+    return findings

@@ -1,0 +1,139 @@
+"""bracket-discipline: every seqlock/lease bracket open must reach its
+close on ALL paths, including exception edges.
+
+The store's one-sided planes are bracketed: a writer opens a stamp bracket
+(``begin_writes`` — stamps go odd, readers retry), does the mutation, and
+closes it (``end_writes`` — stamps settle even). A bracket that opens and
+never closes is not a crash, it is a WEDGE: every reader of those keys
+retries forever, and the landing inflight counter blocks volume retirement.
+PR 7 shipped exactly this — ``_begin_landing`` could raise out of its fault
+hook after ``begin_writes`` + ``_landing_open`` had run, leaking the
+inflight count until a reviewer caught it by hand. This rule makes that
+review mechanical: for each known bracket pair, every reachable open site
+must have its matching close on every CFG path out of the function —
+normal AND exception — unless the function's contract is to return with
+the bracket open (the ``_begin_landing`` implementer idiom, where the
+normal-exit escape is the point but a raise must still unwind).
+
+A close "matches" if it is the pair's own close or a recognized composite
+closer (``_end_landing`` closes both the stamp bracket and the inflight
+counter). Lease brackets (``lease_acquire``/``lease_release``) are checked
+only in functions that contain BOTH calls — acquire-only functions
+transfer ownership to the caller by design.
+
+Fix pattern: ``try/finally`` around the bracketed region, or an
+``except BaseException: <close>; raise`` when the close must not run on
+the normal path. Justified escapes carry a
+``# tslint: disable=bracket-discipline`` pragma with a comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from torchstore_tpu.analysis.core import Finding, Project, call_tail
+from torchstore_tpu.analysis.flow import FlowNode, escaping_opens, iter_cfgs
+
+RULE = "bracket-discipline"
+
+
+@dataclass(frozen=True)
+class BracketSpec:
+    kind: str  # short human name for the message
+    opens: frozenset
+    closes: frozenset
+    # Wrapper functions whose CONTRACT is to return with this bracket open
+    # (they ARE the open): normal-exit escapes are fine there, exception
+    # escapes are not.
+    escape_ok_normal: frozenset = field(default_factory=frozenset)
+    # Only check functions containing both an open and a close — for
+    # brackets where acquire-only functions hand ownership to the caller.
+    paired_only: bool = False
+
+
+SPECS = (
+    BracketSpec(
+        kind="landing",
+        opens=frozenset({"_begin_landing"}),
+        closes=frozenset({"_end_landing"}),
+        paired_only=True,  # callers hold across awaited landings by design
+    ),
+    BracketSpec(
+        kind="stamp-writes",
+        opens=frozenset({"begin_writes"}),
+        closes=frozenset({"end_writes", "_end_landing"}),
+        escape_ok_normal=frozenset({"_begin_landing"}),
+    ),
+    BracketSpec(
+        kind="landing-inflight",
+        opens=frozenset({"_landing_open"}),
+        closes=frozenset({"_landing_close", "_end_landing"}),
+        escape_ok_normal=frozenset({"_begin_landing"}),
+    ),
+    BracketSpec(
+        kind="meta-publish",
+        opens=frozenset({"_publish_open"}),
+        closes=frozenset({"_publish_close"}),
+    ),
+    BracketSpec(
+        kind="lease",
+        opens=frozenset({"lease_acquire"}),
+        closes=frozenset({"lease_release"}),
+        paired_only=True,
+    ),
+)
+
+
+def _calls_any(node: FlowNode, names: frozenset) -> bool:
+    return any(call_tail(c) in names for c in node.calls)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith("torchstore_tpu/"):
+            continue
+        for cfg in iter_cfgs(sf.tree):
+            fn_calls = {
+                call_tail(c) for n in cfg.stmt_nodes() for c in n.calls
+            }
+            for spec in SPECS:
+                if not fn_calls & spec.opens:
+                    continue
+                if spec.paired_only and not fn_calls & spec.closes:
+                    continue
+                normal_ok = cfg.name in spec.escape_ok_normal
+                escapes = escaping_opens(
+                    cfg,
+                    is_open=lambda n, s=spec: _calls_any(n, s.opens),
+                    is_close=lambda n, s=spec: _calls_any(n, s.closes),
+                    escape_normal_ok=normal_ok,
+                )
+                seen: set = set()
+                for node, why in escapes:
+                    key = (spec.kind, node.id, why)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    verb = (
+                        "a raise can escape"
+                        if why == "raise"
+                        else "a return path exits"
+                    )
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=(
+                                f"{spec.kind} bracket opened in "
+                                f"'{cfg.name}' but {verb} before "
+                                f"{'/'.join(sorted(spec.closes))} — an open "
+                                "bracket wedges readers/retirement forever; "
+                                "close it in a finally (or except "
+                                "BaseException: close; raise)"
+                            ),
+                        )
+                    )
+    return findings

@@ -1,0 +1,96 @@
+"""decision-flow: every actuator call site must meet a ``_decision()``
+audit on its OWN control-flow path, not merely in the same function.
+
+control-discipline (rule 16) checks that a function which actuates also
+audits — somewhere. Its blind spot is exactly the shape audits rot into:
+an early return between the actuator and the ``_decision()`` call, or an
+actuator on a branch the audit-bearing path never joins. The fleet then
+mutates with no flight-recorder event, and the post-incident
+reconstruction (ISSUE 16's whole point) has a hole where the action was.
+
+This rule closes the gap with the CFG: an actuator call site in
+``control/``/``autoscale/`` passes iff a decision-audit call *dominates*
+it (audit strictly before the actuation on every normal path from entry —
+the "record intent, then act" idiom of ``checkpoint``) or *post-dominates*
+it (every normal path from the actuation to the exit audits before
+returning — the ``_apply_*`` idiom of act-then-``return self._decision``).
+The actuator node's own exception edge is exempt: a raise out of the
+actuation is caught by ``_apply``'s wrapper, which funnels the error
+through ``_decision(..., "error: ...")`` itself.
+
+Actuator/audit vocabularies are shared with control-discipline (both
+rules run; this one subsumes but does not replace the scope check).
+Suppressions carry ``# tslint: disable=decision-flow`` naming the audit
+path that covers the site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project
+from torchstore_tpu.analysis.checkers.control_discipline import (
+    _SCOPE_PREFIXES,
+    _actuator_name,
+    _is_audit_call,
+    _relay_assign_target,
+)
+from torchstore_tpu.analysis.flow import (
+    FlowNode,
+    dominated_by,
+    iter_cfgs,
+    post_dominated_by,
+)
+
+RULE = "decision-flow"
+
+
+def _node_actuator(node: FlowNode) -> str | None:
+    for c in node.calls:
+        name = _actuator_name(c)
+        if name is not None:
+            return name
+    if isinstance(node.stmt, (ast.Assign, ast.AugAssign)):
+        targets = (
+            node.stmt.targets
+            if isinstance(node.stmt, ast.Assign)
+            else [node.stmt.target]
+        )
+        if any(_relay_assign_target(t) for t in targets):
+            return "_relay_prefer"
+    return None
+
+
+def _is_audit(node: FlowNode) -> bool:
+    return any(_is_audit_call(c) for c in node.calls)
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not sf.path.startswith(_SCOPE_PREFIXES):
+            continue
+        for cfg in iter_cfgs(sf.tree):
+            for node in cfg.stmt_nodes():
+                name = _node_actuator(node)
+                if name is None or _is_audit(node):
+                    continue
+                if dominated_by(cfg, node, _is_audit):
+                    continue
+                if post_dominated_by(cfg, node, _is_audit):
+                    continue
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"actuator '{name}' in '{cfg.name}' has a "
+                            "normal path that skips the _decision() "
+                            "audit (early return or unaudited branch) — "
+                            "every actuation must be dominated or "
+                            "post-dominated by the decision event"
+                        ),
+                    )
+                )
+    return findings

@@ -1,0 +1,118 @@
+"""epoch-discipline: structural index mutations must be followed by a
+placement-epoch bump on every normal path.
+
+Clients route around the controller using the placement epoch in the
+stamped metadata header: a cached placement is valid only while the epoch
+matches. Any structural mutation — keys deleted, copies detached, a volume
+detached or the index rebuilt — that is NOT followed by
+``_bump_epoch`` / ``bump_placement_epoch`` / ``on_structural`` leaves
+clients happily reading a placement that no longer exists (the PR 18
+phantom-volume drain loop was this shape). The discipline is centralized —
+``Controller._bump_epoch`` is "the ONE way the placement epoch moves" —
+so the rule is a post-dominance check: in the three files that own
+structural state (``controller.py``, ``metadata/index_core.py``,
+``metadata/shards.py``), every call site of a RAW mutator must be
+post-dominated by a bump call on all normal paths out of the function.
+
+Raw mutators are the non-self-bumping structural ops
+(``apply_put_batch``, ``delete_keys``, ``detach_meta``,
+``detach_volume``, ``reindex``); wrappers that bump internally
+(``migrate_key``, ``merge_copies``, ``auto_repair_pass``,
+``replace_volume``, ``drop_volume``) are deliberately not in the set —
+their CALLERS are covered because the bump happens inside. Exception
+paths are exempt: an escaping raise aborts the operation before the
+mutation is client-visible, and the endpoint layer surfaces the error.
+Sites where bump ownership is transferred by protocol (the sharded
+three-phase delete, a conditional bump gated on the same flag as the
+mutation) carry a ``# tslint: disable=epoch-discipline`` pragma with the
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project, call_tail, dotted_name
+from torchstore_tpu.analysis.flow import FlowNode, iter_cfgs, post_dominated_by
+
+RULE = "epoch-discipline"
+
+_SCOPE_FILES = (
+    "torchstore_tpu/controller.py",
+    "torchstore_tpu/metadata/index_core.py",
+    "torchstore_tpu/metadata/shards.py",
+)
+
+# Raw structural mutators: calling one of these changes client-visible
+# placement without moving the epoch itself. apply_put_batch is NOT here —
+# it reports on_structural internally when the batch detaches copies, so
+# its callers are covered (its own detach_meta sites are checked below).
+_MUTATORS = {
+    "delete_keys",
+    "detach_meta",
+    "detach_volume",
+    "reindex",
+}
+
+_BUMPS = {"_bump_epoch", "bump_placement_epoch", "on_structural"}
+
+# ``coordinator.bump_placement_epoch.call_one()`` bumps even though the
+# call tail is the endpoint wrapper.
+_ENDPOINT_WRAPPERS = {"call_one", "call", "broadcast", "choose"}
+
+
+def _names_in_call(node: ast.Call) -> set:
+    tail = call_tail(node)
+    names = {tail} if tail else set()
+    if tail in _ENDPOINT_WRAPPERS:
+        dotted = dotted_name(node.func)
+        if dotted:
+            names |= set(dotted.split("."))
+    return names
+
+
+def _is_bump(node: FlowNode) -> bool:
+    return any(_names_in_call(c) & _BUMPS for c in node.calls)
+
+
+def _mutator_in(node: FlowNode) -> str | None:
+    for c in node.calls:
+        hits = _names_in_call(c) & _MUTATORS
+        if hits:
+            return sorted(hits)[0]
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path not in _SCOPE_FILES:
+            continue
+        for cfg in iter_cfgs(sf.tree):
+            # The raw mutator's own definition mutates state directly —
+            # its CALLERS own the bump, per the centralized-bump design.
+            if cfg.name in _MUTATORS:
+                continue
+            for node in cfg.stmt_nodes():
+                name = _mutator_in(node)
+                if name is None or _is_bump(node):
+                    continue
+                if post_dominated_by(cfg, node, _is_bump):
+                    continue
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"structural mutation '{name}' in "
+                            f"'{cfg.name}' is not followed by a "
+                            "placement-epoch bump on every normal path — "
+                            "clients keep routing on the stale placement; "
+                            "bump via _bump_epoch/on_structural after the "
+                            "mutation (or pragma with the protocol that "
+                            "owns the bump)"
+                        ),
+                    )
+                )
+    return findings

@@ -29,6 +29,8 @@ import ast
 import json
 import os
 import re
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
@@ -110,6 +112,26 @@ class SourceFile:
         return bool(rules) and (rule in rules or "all" in rules)
 
 
+# (root, abspath, mtime_ns, size) -> SourceFile. SourceFile is immutable
+# once built (checkers only read it), so a file whose stat signature hasn't
+# moved can reuse its parse across Project constructions — the test suite
+# builds Project(REPO_ROOT) once per live-tree test and this collapses all
+# of those re-parses into one.
+_PARSE_CACHE: dict[tuple[str, str, int, int], SourceFile] = {}
+
+
+def _cached_source_file(root: str, abspath: str) -> SourceFile:
+    try:
+        st = os.stat(abspath)
+        key = (root, abspath, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return SourceFile(root, abspath)
+    sf = _PARSE_CACHE.get(key)
+    if sf is None:
+        sf = _PARSE_CACHE[key] = SourceFile(root, abspath)
+    return sf
+
+
 class Project:
     """The scanned tree, parsed once and shared by every checker."""
 
@@ -117,7 +139,9 @@ class Project:
         self.root = os.path.abspath(root)
         if paths is None:
             paths = discover_files(self.root)
-        self.files: list[SourceFile] = [SourceFile(self.root, p) for p in sorted(paths)]
+        self.files: list[SourceFile] = [
+            _cached_source_file(self.root, p) for p in sorted(paths)
+        ]
 
     def file(self, relpath: str) -> Optional[SourceFile]:
         for sf in self.files:
@@ -187,6 +211,7 @@ class RunResult:
     new: list[Finding] = field(default_factory=list)
     baselined: list[Finding] = field(default_factory=list)
     rules: tuple[str, ...] = ()
+    timings: dict[str, float] = field(default_factory=dict)  # rule -> seconds
 
     def to_dict(self) -> dict:
         new_keys = {f.key for f in self.new}
@@ -195,6 +220,12 @@ class RunResult:
             "total": len(self.findings),
             "new": len(self.new),
             "baselined": len(self.baselined),
+            "rule_seconds": {
+                rule: round(sec, 4)
+                for rule, sec in sorted(
+                    self.timings.items(), key=lambda kv: -kv[1]
+                )
+            },
             "findings": [
                 dict(f.to_dict(), baselined=f.key not in new_keys)
                 for f in self.findings
@@ -224,16 +255,37 @@ def run_checks(
             )
         selected = {k: v for k, v in selected.items() if k in wanted}
 
+    # Checkers are pure functions of the read-only Project, so they run
+    # concurrently; per-rule wall time is recorded so --json can point at
+    # the slowest rule when the runtime budget regresses.
+    timings: dict[str, float] = {}
+
+    def _run_one(item: tuple) -> list[Finding]:
+        rule, checkfn = item
+        t0 = time.perf_counter()
+        try:
+            return checkfn(project)
+        finally:
+            timings[rule] = time.perf_counter() - t0
+
+    if len(selected) > 1:
+        with ThreadPoolExecutor(
+            max_workers=min(8, len(selected)), thread_name_prefix="tslint"
+        ) as pool:
+            per_rule = list(pool.map(_run_one, selected.items()))
+    else:
+        per_rule = [_run_one(item) for item in selected.items()]
+
     findings: list[Finding] = []
-    for rule, checkfn in selected.items():
-        for f in checkfn(project):
+    for batch in per_rule:
+        for f in batch:
             sf = project.file(f.path)
             if sf is not None and sf.disabled(f.rule, f.line):
                 continue
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
 
-    result = RunResult(findings=findings, rules=tuple(selected))
+    result = RunResult(findings=findings, rules=tuple(selected), timings=timings)
     budget = load_baseline(baseline_path) if baseline_path else {}
     for f in findings:
         if budget.get(f.key, 0) > 0:

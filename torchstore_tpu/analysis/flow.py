@@ -1,0 +1,667 @@
+"""Intraprocedural CFG + dataflow layer for flow-aware tslint rules.
+
+The syntactic checkers under ``analysis/checkers/`` match single AST nodes;
+the ordering disciplines the store actually depends on — seqlock write
+brackets that must close on every path, structural index mutations followed
+by a placement-epoch bump, no ``await`` inside a stamp bracket — are
+properties of PATHS, including the exception paths no single-node match can
+see (PR 7's raise-escaping ``_begin_landing`` leaked the inflight count
+forever and was caught by a human; this module makes that review
+mechanical).
+
+What it builds, per function (sync or async, methods and nested defs
+included):
+
+- One :class:`FlowNode` per simple statement and per compound-statement
+  header (the ``if``/``while`` test, the ``for`` iterable, the ``with``
+  context expression). Nested function/lambda bodies are OPAQUE — they are
+  a single definition node in the enclosing CFG and get their own CFG.
+- **Normal edges** (``succ``) for fallthrough, branches, and loop
+  back-edges, and **exception edges** (``exc``) out of every statement
+  that can raise, routed to the innermost enclosing handler dispatch /
+  ``finally`` copy / the function's synthetic RAISE exit. The can-raise
+  model is deliberately conservative: only ``pass``/``break``/``continue``/
+  ``global``/``nonlocal`` are raise-free, so "provable straight-line code"
+  between a bracket open and close means *no statement between them at
+  all* — anything else needs the close on the exception path too.
+- **``finally`` lowering by duplication**: each ``finally`` body is lowered
+  once per continuation that traverses it (normal completion, the
+  exception path, and each ``return``/``break``/``continue`` that jumps
+  through it), so "the close post-dominates the open via ``finally``"
+  falls out of plain reachability with no special casing.
+- ``except`` handler dispatch is a synthetic node; a handler list with no
+  catch-all (bare ``except``, ``Exception``, ``BaseException``) keeps an
+  escape edge to the outer handler, and a raise INSIDE a handler routes
+  through the ``finally`` copy before escaping.
+- **``await`` annotation**: every node records whether it contains an
+  ``await`` expression (``async for``/``async with`` headers count), which
+  is both the await-atomicity checker's subject and an implicit can-raise
+  (CancelledError surfaces at every await).
+
+On top of the graph, generic solvers:
+
+- :func:`escaping_opens` — the bracket lattice: a boolean open/closed state
+  propagated over normal + exception edges; reports every open site from
+  which the function exit (or the raise exit) is reachable while open.
+- :func:`dominated_by` / :func:`post_dominated_by` — must-reach facts over
+  NORMAL edges only (an explicit ``raise`` or an escaping exception
+  terminates a path without violating post-dominance; exception-path
+  completeness is bracket-discipline's job, not epoch/decision flow's).
+- :func:`nodes_between` — the nodes on some open→close path, for "no await
+  strictly inside the bracket".
+
+Everything here is stdlib-only (``ast``) and read-only over the shared
+one-parse :class:`~torchstore_tpu.analysis.core.Project`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "FlowNode",
+    "FunctionCFG",
+    "build_cfg",
+    "iter_cfgs",
+    "escaping_opens",
+    "dominated_by",
+    "post_dominated_by",
+    "nodes_between",
+    "solve_forward",
+]
+
+
+# Statement types that can never raise. Everything else gets an exception
+# edge: even ``x = y`` can NameError, and an await can always deliver
+# CancelledError. Conservatism is the point — a bracket is only provably
+# closed on the exception path via ``finally`` or an except-all that closes.
+_NO_RAISE_STMTS = (ast.Pass, ast.Break, ast.Continue, ast.Global, ast.Nonlocal)
+
+
+@dataclass
+class FlowNode:
+    """One CFG node: a simple statement, a compound-statement header, or a
+    synthetic entry/exit/raise/dispatch marker."""
+
+    id: int
+    kind: str  # "entry" | "exit" | "raise" | "stmt" | "except"
+    stmt: Optional[ast.AST] = None
+    label: str = ""
+    lineno: int = 0
+    succ: set = field(default_factory=set)  # normal out-edges (node ids)
+    exc: set = field(default_factory=set)  # exception out-edges (node ids)
+    has_await: bool = False
+    calls: tuple = ()  # ast.Call nodes in this statement (own scope only)
+
+    def render(self) -> str:
+        return f"[{self.id}] {self.kind} {self.label} L{self.lineno}"
+
+
+class FunctionCFG:
+    """The per-function graph plus its three synthetic anchors."""
+
+    def __init__(self, func) -> None:
+        self.func = func
+        self.is_async = isinstance(func, ast.AsyncFunctionDef)
+        self.name = func.name
+        self.nodes: list[FlowNode] = []
+        self.entry_id = self._new("entry").id
+        self.exit_id = self._new("exit").id
+        self.raise_id = self._new("raise").id
+
+    def _new(
+        self,
+        kind: str,
+        stmt: Optional[ast.AST] = None,
+        label: str = "",
+        lineno: int = 0,
+    ) -> FlowNode:
+        node = FlowNode(
+            id=len(self.nodes), kind=kind, stmt=stmt, label=label, lineno=lineno
+        )
+        self.nodes.append(node)
+        return node
+
+    @property
+    def entry(self) -> FlowNode:
+        return self.nodes[self.entry_id]
+
+    @property
+    def exit(self) -> FlowNode:
+        return self.nodes[self.exit_id]
+
+    @property
+    def raise_exit(self) -> FlowNode:
+        return self.nodes[self.raise_id]
+
+    def node(self, nid: int) -> FlowNode:
+        return self.nodes[nid]
+
+    def stmt_nodes(self) -> Iterator[FlowNode]:
+        for n in self.nodes:
+            if n.kind == "stmt":
+                yield n
+
+    def preds(self, include_exc: bool = True) -> dict[int, set]:
+        out: dict[int, set] = {n.id: set() for n in self.nodes}
+        for n in self.nodes:
+            for s in n.succ:
+                out[s].add(n.id)
+            if include_exc:
+                for s in n.exc:
+                    out[s].add(n.id)
+        return out
+
+    def render(self) -> str:  # debugging aid, exercised by tests
+        lines = []
+        for n in self.nodes:
+            lines.append(
+                f"{n.render()} -> {sorted(n.succ)} exc-> {sorted(n.exc)}"
+                + (" AWAIT" if n.has_await else "")
+            )
+        return "\n".join(lines)
+
+
+def _own_scope_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/lambda bodies
+    (their statements belong to their own CFG) nor comprehension bodies'
+    lambdas; comprehensions themselves stay visible (they run inline)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _exprs_of_header(stmt: ast.AST) -> list[ast.AST]:
+    """The expressions evaluated by a compound statement's HEADER (the part
+    that belongs to the header node, body statements excluded)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    return [stmt]
+
+
+def _collect_marks(exprs: Iterable[ast.AST], async_header: bool = False):
+    """(has_await, calls) for the given own-scope expressions."""
+    has_await = async_header
+    calls = []
+    for expr in exprs:
+        if expr is None:
+            continue
+        for sub in _own_scope_walk(expr):
+            if isinstance(sub, ast.Await):
+                has_await = True
+            elif isinstance(sub, ast.Call):
+                calls.append(sub)
+    return has_await, tuple(calls)
+
+
+@dataclass(frozen=True)
+class _Ctx:
+    """Lowering context: where exceptions, breaks, continues, and returns
+    go from here, and which finally bodies a jump must traverse."""
+
+    exc: int  # node id receiving in-flight exceptions
+    brk: Optional[int] = None  # loop exit (post-finally chain target)
+    cont: Optional[int] = None  # loop head
+    # finally bodies between here and the function exit, innermost first:
+    # (finalbody, ctx_for_that_finally). return traverses all of them.
+    ret_finallies: tuple = ()
+    # finally bodies between here and the innermost loop, innermost first.
+    # break/continue traverse these.
+    loop_finallies: tuple = ()
+
+
+class _Lowerer:
+    def __init__(self, cfg: FunctionCFG) -> None:
+        self.cfg = cfg
+
+    # -- edge helpers ------------------------------------------------------
+
+    def _connect(self, ends: Iterable[int], target: int) -> None:
+        for e in ends:
+            self.cfg.node(e).succ.add(target)
+
+    def _stmt_node(self, stmt: ast.AST, label: str, ctx: _Ctx) -> FlowNode:
+        async_header = isinstance(stmt, (ast.AsyncFor, ast.AsyncWith))
+        has_await, calls = _collect_marks(_exprs_of_header(stmt), async_header)
+        node = self.cfg._new(
+            "stmt", stmt, label, getattr(stmt, "lineno", 0)
+        )
+        node.has_await = has_await
+        node.calls = calls
+        if has_await or not isinstance(stmt, _NO_RAISE_STMTS):
+            node.exc.add(ctx.exc)
+        return node
+
+    # -- jump-through-finally ----------------------------------------------
+
+    def _through_finallies(
+        self, finallies: tuple, final_target: int
+    ) -> int:
+        """Lower a fresh copy of each pending finally body (innermost
+        first), chain them, and return the id the JUMP statement should
+        edge to. With no pending finallies this is just ``final_target``."""
+        target = final_target
+        # Build outermost-last: chain inner copy -> outer copy -> target.
+        for body, fctx in reversed(finallies):
+            entry, ends = self._block(body, fctx)
+            self._connect(ends, target)
+            target = entry
+        return target
+
+    # -- block lowering ----------------------------------------------------
+
+    def _block(self, stmts: list, ctx: _Ctx) -> tuple[int, set]:
+        """Lower a statement list. Returns (entry_id, open_ends). The entry
+        is a real node id to point edges at; open_ends are node ids whose
+        normal successor is the code AFTER this block. An empty block
+        lowers to a synthetic pass-through node."""
+        if not stmts:
+            node = self.cfg._new("stmt", None, "<empty>", 0)
+            return node.id, {node.id}
+        entry: Optional[int] = None
+        ends: set = set()
+        prev_ends: Optional[set] = None
+        for stmt in stmts:
+            s_entry, s_ends = self._stmt(stmt, ctx)
+            if entry is None:
+                entry = s_entry
+            if prev_ends is not None:
+                self._connect(prev_ends, s_entry)
+            prev_ends = s_ends
+            if not s_ends:
+                # Terminal statement (return/raise/break/continue): the
+                # rest of the block is unreachable but still lowered so
+                # its nodes exist (dead-code opens are never flagged —
+                # they are unreachable from entry).
+                prev_ends = set()
+        ends = prev_ends if prev_ends is not None else set()
+        return entry, ends
+
+    def _stmt(self, stmt: ast.AST, ctx: _Ctx) -> tuple[int, set]:
+        """Lower one statement. Returns (entry_id, open_ends)."""
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            test = self._stmt_node(stmt, "if", ctx)
+            b_entry, b_ends = self._block(stmt.body, ctx)
+            test.succ.add(b_entry)
+            ends = set(b_ends)
+            if stmt.orelse:
+                o_entry, o_ends = self._block(stmt.orelse, ctx)
+                test.succ.add(o_entry)
+                ends |= o_ends
+            else:
+                ends.add(test.id)
+            return test.id, ends
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._stmt_node(
+                stmt, "while" if isinstance(stmt, ast.While) else "for", ctx
+            )
+            after = cfg._new("stmt", None, "<loop-exit>", getattr(stmt, "lineno", 0))
+            body_ctx = _Ctx(
+                exc=ctx.exc,
+                brk=after.id,
+                cont=head.id,
+                ret_finallies=ctx.ret_finallies,
+                loop_finallies=(),
+            )
+            b_entry, b_ends = self._block(stmt.body, body_ctx)
+            head.succ.add(b_entry)
+            self._connect(b_ends, head.id)  # back-edge
+            if stmt.orelse:
+                o_entry, o_ends = self._block(stmt.orelse, ctx)
+                head.succ.add(o_entry)
+                self._connect(o_ends, after.id)
+            else:
+                head.succ.add(after.id)
+            return head.id, {after.id}
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._stmt_node(stmt, "with", ctx)
+            b_entry, b_ends = self._block(stmt.body, ctx)
+            head.succ.add(b_entry)
+            return head.id, set(b_ends)
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, ctx)
+
+        if isinstance(stmt, ast.Match):
+            head = self._stmt_node(stmt, "match", ctx)
+            ends: set = {head.id}  # no case may match
+            for case in stmt.cases:
+                c_entry, c_ends = self._block(case.body, ctx)
+                head.succ.add(c_entry)
+                ends |= c_ends
+            return head.id, ends
+
+        if isinstance(stmt, ast.Return):
+            has_await, calls = _collect_marks([stmt.value] if stmt.value else [])
+            node = cfg._new("stmt", stmt, "return", stmt.lineno)
+            node.has_await = has_await
+            node.calls = calls
+            node.exc.add(ctx.exc)
+            target = self._through_finallies(ctx.ret_finallies, cfg.exit_id)
+            node.succ.add(target)
+            return node.id, set()
+
+        if isinstance(stmt, ast.Raise):
+            node = self._stmt_node(stmt, "raise", ctx)
+            node.succ.clear()  # a raise only leaves via the exception edge
+            return node.id, set()
+
+        if isinstance(stmt, ast.Break):
+            node = cfg._new("stmt", stmt, "break", stmt.lineno)
+            target = self._through_finallies(
+                ctx.loop_finallies, ctx.brk if ctx.brk is not None else cfg.exit_id
+            )
+            node.succ.add(target)
+            return node.id, set()
+
+        if isinstance(stmt, ast.Continue):
+            node = cfg._new("stmt", stmt, "continue", stmt.lineno)
+            target = self._through_finallies(
+                ctx.loop_finallies, ctx.cont if ctx.cont is not None else cfg.exit_id
+            )
+            node.succ.add(target)
+            return node.id, set()
+
+        # Simple statement (incl. nested def/class definitions: opaque).
+        node = self._stmt_node(stmt, type(stmt).__name__.lower(), ctx)
+        return node.id, {node.id}
+
+    # -- try/except/finally ------------------------------------------------
+
+    @staticmethod
+    def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        names = []
+        t = handler.type
+        if isinstance(t, ast.Tuple):
+            names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
+        else:
+            names = [getattr(t, "id", getattr(t, "attr", ""))]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def _try(self, stmt: ast.Try, ctx: _Ctx) -> tuple[int, set]:
+        cfg = self.cfg
+        finalbody = stmt.finalbody or []
+
+        # Exception continuation once the try is done with an exception:
+        # through a fresh finally copy (if any) to the outer handler.
+        if finalbody:
+            fexc_entry, fexc_ends = self._block(finalbody, ctx)
+            self._connect(fexc_ends, ctx.exc)
+            unhandled_target = fexc_entry
+        else:
+            unhandled_target = ctx.exc
+
+        # Context for code INSIDE the try body: exceptions go to the
+        # handler dispatch; returns/breaks/continues traverse this finally
+        # first, then any outer ones.
+        if stmt.handlers:
+            dispatch = cfg._new("except", stmt, "except-dispatch", stmt.lineno)
+        else:
+            dispatch = None
+
+        inner_finallies_ret = ctx.ret_finallies
+        inner_finallies_loop = ctx.loop_finallies
+        if finalbody:
+            # The finally copy a jump traverses sees the OUTER ctx (an
+            # exception raised inside the finally propagates outward).
+            inner_finallies_ret = ((finalbody, ctx),) + ctx.ret_finallies
+            inner_finallies_loop = ((finalbody, ctx),) + ctx.loop_finallies
+
+        body_ctx = _Ctx(
+            exc=dispatch.id if dispatch is not None else unhandled_target,
+            brk=ctx.brk,
+            cont=ctx.cont,
+            ret_finallies=inner_finallies_ret,
+            loop_finallies=inner_finallies_loop,
+        )
+        b_entry, b_ends = self._block(stmt.body, body_ctx)
+
+        # orelse runs after a clean body; its exceptions are NOT caught by
+        # this try's handlers but do traverse the finally.
+        orelse_ctx = _Ctx(
+            exc=unhandled_target,
+            brk=ctx.brk,
+            cont=ctx.cont,
+            ret_finallies=inner_finallies_ret,
+            loop_finallies=inner_finallies_loop,
+        )
+        if stmt.orelse:
+            o_entry, o_ends = self._block(stmt.orelse, orelse_ctx)
+            self._connect(b_ends, o_entry)
+            clean_ends = o_ends
+        else:
+            clean_ends = b_ends
+
+        # Handlers: exceptions inside a handler body go through the finally
+        # to the outer handler; jumps traverse the finally too.
+        handler_ends: set = set()
+        if dispatch is not None:
+            caught_all = False
+            handler_ctx = _Ctx(
+                exc=unhandled_target,
+                brk=ctx.brk,
+                cont=ctx.cont,
+                ret_finallies=inner_finallies_ret,
+                loop_finallies=inner_finallies_loop,
+            )
+            for handler in stmt.handlers:
+                h_entry, h_ends = self._block(handler.body, handler_ctx)
+                dispatch.succ.add(h_entry)
+                handler_ends |= h_ends
+                if self._is_catch_all(handler):
+                    caught_all = True
+            if not caught_all:
+                # The in-flight exception may match no handler: escape.
+                dispatch.succ.add(unhandled_target)
+
+        # Normal completion (clean body/orelse or a handler that fell
+        # through) runs ITS OWN finally copy, then continues after the try.
+        done_ends = clean_ends | handler_ends
+        if finalbody:
+            fnorm_entry, fnorm_ends = self._block(finalbody, ctx)
+            self._connect(done_ends, fnorm_entry)
+            ends = fnorm_ends
+        else:
+            ends = done_ends
+
+        entry = b_entry
+        return entry, set(ends)
+
+
+def build_cfg(func) -> FunctionCFG:
+    """Build the CFG for one ``FunctionDef`` / ``AsyncFunctionDef``."""
+    cfg = FunctionCFG(func)
+    lowerer = _Lowerer(cfg)
+    ctx = _Ctx(exc=cfg.raise_id)
+    entry, ends = lowerer._block(func.body, ctx)
+    cfg.entry.succ.add(entry)
+    lowerer._connect(ends, cfg.exit_id)
+    return cfg
+
+
+def iter_cfgs(tree: ast.AST) -> Iterator[FunctionCFG]:
+    """A CFG for every function in ``tree`` (methods and nested included)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield build_cfg(node)
+
+
+# --------------------------------------------------------------------------
+# Solvers
+# --------------------------------------------------------------------------
+
+
+def solve_forward(
+    cfg: FunctionCFG,
+    is_fact: Callable[[FlowNode], bool],
+    include_exc: bool = True,
+) -> set:
+    """Generic forward MUST-reach: the node ids at which every path from
+    the entry has already traversed a fact node (the fact node itself
+    counts at its own id). The meet is intersection — one fact-free path
+    in kills the fact. Unreachable nodes report True vacuously."""
+    nodes = cfg.nodes
+    preds = cfg.preds(include_exc=include_exc)
+    # OUT[n] = IN[n] or is_fact(n); IN[n] = AND over preds OUT.
+    out = {n.id: True for n in nodes}  # top = "fact on all paths so far"
+    out[cfg.entry_id] = False
+    changed = True
+    while changed:
+        changed = False
+        for n in nodes:
+            if n.id == cfg.entry_id:
+                continue
+            p = preds[n.id]
+            if p:
+                new_in = all(out[q] for q in p)
+            else:
+                new_in = True  # unreachable: vacuous
+            new_out = new_in or is_fact(n)
+            if new_out != out[n.id]:
+                out[n.id] = new_out
+                changed = True
+    return {n.id for n in nodes if out[n.id]}
+
+
+def _reachable_from_entry(cfg: FunctionCFG) -> set:
+    seen: set = set()
+    stack = [cfg.entry_id]
+    while stack:
+        nid = stack.pop()
+        if nid in seen:
+            continue
+        seen.add(nid)
+        node = cfg.node(nid)
+        stack.extend(node.succ)
+        stack.extend(node.exc)
+    return seen
+
+
+def escaping_opens(
+    cfg: FunctionCFG,
+    is_open: Callable[[FlowNode], bool],
+    is_close: Callable[[FlowNode], bool],
+    escape_normal_ok: bool = False,
+) -> list[tuple[FlowNode, str]]:
+    """Every reachable open node from which the function can be left with
+    the bracket still open. Returns (open_node, "raise"|"return") pairs.
+
+    The open's OWN exception edge leaves with the bracket closed (if the
+    open call raised, the bracket never opened); a close node's out-edges
+    all leave closed (the close ran). ``escape_normal_ok`` licenses the
+    bracket-implementation idiom — a wrapper whose CONTRACT is to return
+    with the bracket open (``_begin_landing``) — while still requiring the
+    exception path to close (the exact PR 7 invariant)."""
+    reachable = _reachable_from_entry(cfg)
+    findings: list[tuple[FlowNode, str]] = []
+    for node in cfg.nodes:
+        if node.id not in reachable or not is_open(node):
+            continue
+        # DFS with state open=True from the open's NORMAL successors.
+        seen: set = set()
+        stack = list(node.succ)
+        escaped_raise = False
+        escaped_return = False
+        while stack:
+            nid = stack.pop()
+            if nid in seen:
+                continue
+            seen.add(nid)
+            cur = cfg.node(nid)
+            if nid == cfg.raise_id:
+                escaped_raise = True
+                continue
+            if nid == cfg.exit_id:
+                escaped_return = True
+                continue
+            if is_close(cur):
+                continue  # bracket closed on this path; stop propagating
+            stack.extend(cur.succ)
+            stack.extend(cur.exc)
+        if escaped_raise:
+            findings.append((node, "raise"))
+        if escaped_return and not escape_normal_ok:
+            findings.append((node, "return"))
+    return findings
+
+
+def nodes_between(
+    cfg: FunctionCFG,
+    open_node: FlowNode,
+    is_close: Callable[[FlowNode], bool],
+) -> list[FlowNode]:
+    """The statement nodes on some path strictly between ``open_node`` and
+    a close node (close nodes excluded), over normal AND exception edges —
+    i.e. everything that can execute while the bracket is held."""
+    seen: set = set()
+    stack = list(open_node.succ)
+    out: list[FlowNode] = []
+    while stack:
+        nid = stack.pop()
+        if nid in seen or nid in (cfg.exit_id, cfg.raise_id):
+            continue
+        seen.add(nid)
+        cur = cfg.node(nid)
+        if is_close(cur):
+            continue
+        if cur.kind == "stmt" and cur.stmt is not None:
+            out.append(cur)
+        stack.extend(cur.succ)
+        stack.extend(cur.exc)
+    out.sort(key=lambda n: n.id)
+    return out
+
+
+def dominated_by(
+    cfg: FunctionCFG, node: FlowNode, is_fact: Callable[[FlowNode], bool]
+) -> bool:
+    """True when every NORMAL path from the entry to ``node`` traverses a
+    fact node strictly before it (the fact dominates the node)."""
+    facts = solve_forward(cfg, is_fact, include_exc=False)
+    if node.id in facts and not is_fact(node):
+        return True
+    # solve_forward counts the node's own fact at its own id; dominance
+    # wants the fact strictly before, so recompute IN for this node.
+    preds = cfg.preds(include_exc=False)
+    p = preds[node.id]
+    return bool(p) and all(q in facts for q in p)
+
+
+def post_dominated_by(
+    cfg: FunctionCFG, node: FlowNode, is_fact: Callable[[FlowNode], bool]
+) -> bool:
+    """True when no NORMAL path from ``node`` reaches the function exit
+    without traversing a fact node. Exception edges are not followed: an
+    escaping raise aborts the operation and is the CALLER's audit/bump
+    problem (bracket-discipline owns exception-path completeness)."""
+    seen: set = set()
+    stack = list(node.succ)
+    while stack:
+        nid = stack.pop()
+        if nid in seen:
+            continue
+        seen.add(nid)
+        if nid == cfg.exit_id:
+            return False
+        cur = cfg.node(nid)
+        if is_fact(cur):
+            continue
+        stack.extend(cur.succ)
+    return True

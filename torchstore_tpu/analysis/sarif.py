@@ -1,0 +1,109 @@
+"""SARIF 2.1.0 serialization for tslint results.
+
+CI code-scanning UIs (GitHub code scanning, most SARIF viewers) ingest one
+``sarif-log`` document per run. The mapping is deliberately thin:
+
+- one ``run`` with ``tool.driver.rules`` built from the checker modules'
+  docstrings (first line = shortDescription, full docstring = help text),
+- one ``result`` per finding, ``level: error`` for NEW findings and
+  ``level: note`` + ``baselineState: unchanged`` for baselined ones,
+- ``partialFingerprints`` derived from the repo's existing line-independent
+  ``(rule, path, message)`` finding identity, so a finding keeps its
+  identity across unrelated edits exactly as the committed baseline does.
+
+stdlib-only, like everything under ``analysis/``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+
+from torchstore_tpu.analysis.core import Finding, RunResult
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+_TOOL_NAME = "tslint"
+_INFO_URI = "https://example.invalid/torchstore_tpu/docs/ARCHITECTURE.md"
+
+
+def _fingerprint(finding: Finding) -> str:
+    ident = "|".join(finding.key)
+    return hashlib.sha256(ident.encode("utf-8")).hexdigest()
+
+
+def _rule_docs(checkers: dict) -> dict[str, tuple[str, str]]:
+    """rule -> (short, full) help text from each checker module docstring."""
+    docs: dict[str, tuple[str, str]] = {}
+    for rule, checkfn in checkers.items():
+        module = sys.modules.get(getattr(checkfn, "__module__", ""), None)
+        doc = (getattr(module, "__doc__", None) or rule).strip()
+        short = doc.splitlines()[0].strip()
+        docs[rule] = (short, doc)
+    return docs
+
+
+def to_sarif(result: RunResult, checkers: dict) -> dict:
+    """One SARIF log for one ``run_checks`` result."""
+    docs = _rule_docs({r: checkers[r] for r in result.rules if r in checkers})
+    rules_obj = [
+        {
+            "id": rule,
+            "name": rule,
+            "shortDescription": {"text": docs.get(rule, (rule, rule))[0]},
+            "help": {"text": docs.get(rule, (rule, rule))[1]},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rule in result.rules
+    ]
+    rule_index = {rule: i for i, rule in enumerate(result.rules)}
+    new_keys = {f.key for f in result.new}
+
+    results_obj = []
+    for f in result.findings:
+        is_new = f.key in new_keys
+        results_obj.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": rule_index.get(f.rule, -1),
+                "level": "error" if is_new else "note",
+                "baselineState": "new" if is_new else "unchanged",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {"startLine": max(1, f.line)},
+                        }
+                    }
+                ],
+                "partialFingerprints": {
+                    "tslintIdentity/v1": _fingerprint(f),
+                },
+            }
+        )
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": _TOOL_NAME,
+                        "informationUri": _INFO_URI,
+                        "rules": rules_obj,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results_obj,
+            }
+        ],
+    }

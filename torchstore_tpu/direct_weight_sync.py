@@ -1503,13 +1503,16 @@ class DirectWeightSyncDest:
         return arr.reshape(out_shape), r0
 
     async def close(self) -> None:
-        for pool in self._conns.values():
-            for _, writer, _ in pool["conns"]:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
-        self._conns.clear()
+        # Under the pool lock: close racing a _get_conn mid-dial would
+        # otherwise leak the freshly opened connection past the clear().
+        async with self._lock:
+            for pool in self._conns.values():
+                for _, writer, _ in pool["conns"]:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+            self._conns.clear()
         for seg in self._segments.values():
             seg.close()
         self._segments.clear()

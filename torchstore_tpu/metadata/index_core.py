@@ -421,7 +421,10 @@ class IndexCore:
                     stale_gens.setdefault(vid, {})[meta.key] = pre_gens[vid]
                 else:
                     stale_gens.setdefault(vid, {}).setdefault(meta.key, -1)
-                self.detach_meta(meta, vid)
+                # The epoch bump below is gated on `structural`, seeded
+                # from bool(detach_volume_ids) — the exact condition that
+                # makes this loop run, so every detach IS bump-covered.
+                self.detach_meta(meta, vid)  # tslint: disable=epoch-discipline
             if supersede:
                 # Full overwrite: volumes outside this put's replica set
                 # that still hold THIS meta (same coordinates for shards,
@@ -438,7 +441,9 @@ class IndexCore:
                     ):
                         continue  # holds other shards only: not superseded
                     stale_gens.setdefault(vid, {})[meta.key] = prev.write_gen
-                    self.detach_meta(meta, vid)
+                    # `structural = True` on the next line routes this
+                    # detach into the on_structural bump below.
+                    self.detach_meta(meta, vid)  # tslint: disable=epoch-discipline
                     structural = True
         if stale_gens:
             # The detached replica may be wedged-but-ALIVE and still holding

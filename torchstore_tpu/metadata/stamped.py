@@ -208,14 +208,27 @@ class MetaStampWriter:
             )
             self._tombstone()
             return
-        words = self.words
-        words[0] = seq = int(words[0]) + 1  # odd: publish in flight
-        self._gen += 1
-        self.seg.mmap[HEADER_BYTES : HEADER_BYTES + len(blob)] = blob
-        words[1] = self._gen
-        words[2] = len(blob)
-        words[3] = int(self.epoch_fn())
-        words[0] = seq + 1  # even: stable
+        seq = self._publish_open()
+        try:
+            self._gen += 1
+            self.seg.mmap[HEADER_BYTES : HEADER_BYTES + len(blob)] = blob
+            self.words[1] = self._gen
+            self.words[2] = len(blob)
+            self.words[3] = int(self.epoch_fn())
+        except BaseException:
+            # A raise mid-bracket (epoch_fn blowing up, a torn mmap after
+            # the segment shrank underneath us) must not leave the seq
+            # word odd forever — every reader would spin its torn-read
+            # retries out on a bracket nobody will ever close. The header
+            # is half-written and can't be trusted, so tombstone it (the
+            # handler runs BEFORE the finally: the marker lands while the
+            # bracket is still odd, never visible as a stable half-header)
+            # and serve via RPC permanently.
+            self.words[2] = TOMBSTONE
+            self._dead = True
+            raise
+        finally:
+            self._publish_close(seq)
         _PUBLISHES.inc()
         _PUBLISH_BYTES.set(len(blob))
         # Duty-cycle cap: the next publish waits at least cost/DUTY_CYCLE,
@@ -225,11 +238,23 @@ class MetaStampWriter:
             self.interval_s, cost / self.DUTY_CYCLE
         )
 
+    def _publish_open(self) -> int:
+        """Open the seqlock bracket: seq word goes odd, readers retry.
+        Returns the odd seq to hand back to :meth:`_publish_close`."""
+        seq = int(self.words[0]) + 1
+        self.words[0] = seq
+        return seq
+
+    def _publish_close(self, seq: int) -> None:
+        """Close the bracket: seq settles even, the publish is stable."""
+        self.words[0] = seq + 1
+
     def _tombstone(self) -> None:
-        words = self.words
-        words[0] = int(words[0]) + 1
-        words[2] = TOMBSTONE
-        words[0] = int(words[0]) + 1
+        seq = self._publish_open()
+        try:
+            self.words[2] = TOMBSTONE
+        finally:
+            self._publish_close(seq)
         self._dead = True
 
     def close(self) -> None:

@@ -449,8 +449,8 @@ class StorageVolume(Actor):
         cache = self._shm_cache()
         if cache is not None:
             cache.begin_writes(pairs)
-        self._landing_open()
         try:
+            self._landing_open()
             await faults.afire("shm.landing_stamp")
         except BaseException:
             # A raise-action fault (or cancellation during a delay/wedge)

@@ -693,7 +693,12 @@ class WeightSubscriber:
         live instead of releasing it under the other holder."""
         self._read_seq += 1
         owner = f"{self._lease_owner}:r{self._read_seq}"
-        lease = await client.lease_acquire(owner, self.name, version)
+        # Bracket contract lives in the CALLER: _pinned_read releases in
+        # its finally; the normal return here hands the lease over open by
+        # design, and the renewed-pin KeyError path deliberately leaves a
+        # COALESCED lease to its other holder (releasing it would strip a
+        # live read's GC protection).
+        lease = await client.lease_acquire(owner, self.name, version)  # tslint: disable=bracket-discipline
         if lease.get("resident_keys") == 0:
             # Nothing indexed under this version: GC'd or never published.
             # Fail BEFORE the pull with a precise error (the pull's
