@@ -1375,6 +1375,236 @@ async def fanout_section(
         await ts.shutdown("bench_fanout")
 
 
+async def cross_host_section(
+    k_hosts: int = 4,
+    layer_kb: float = 4096,
+    rounds: int = 5,
+    emulate_gbps: float = 1.0,
+) -> dict:
+    """Cross-host one-sided tier (ISSUE 20): emulated ``k_hosts``-host
+    topology (``TORCHSTORE_TPU_HOSTNAME`` overlays) over a paced DCN
+    (``TORCHSTORE_TPU_BULK_EMULATE_GBPS``), measuring the two tentpole
+    claims against their pull-side baselines:
+
+    - **Push-on-publish first-layer latency**: after each publish, the
+      subscribed client's get serves from the push-staged arena (local
+      memcpy) vs the doorbell-pull leg that pays the paced wire at read
+      time. Acceptance: ``push_speedup`` >= 2x.
+    - **Metadata-relay egress**: ``k_hosts`` mirrors fan through the relay
+      tree (root out-degree 1), so the index host serves ONE image copy
+      per update however many hosts subscribe. Acceptance:
+      ``meta_egress_ratio`` (root egress / fleet-delivered bytes, the
+      all-subscribers-pull baseline) <= 1.5 / k_hosts.
+    - **Zero metadata RPCs warm**: a block of warm remote gets moves no
+      ``traffic_matrix()["metadata"]["rpcs"]`` cell (the scrape's own
+      "stats" RPC excepted) — locations, epochs, and write-gen validation
+      all serve from the mirrored stamped replica."""
+    import os as _os
+
+    import torchstore_tpu as ts
+    from torchstore_tpu.metadata import mirror as mirror_mod
+    from torchstore_tpu.transport import bulk as bulk_mod
+
+    saved_env = {
+        k: _os.environ.get(k)
+        for k in (
+            "TORCHSTORE_TPU_HOSTNAME",
+            "TORCHSTORE_TPU_BULK_EMULATE_GBPS",
+            "TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS",
+        )
+    }
+    _os.environ["TORCHSTORE_TPU_HOSTNAME"] = "xh-vol"
+    _os.environ["TORCHSTORE_TPU_BULK_EMULATE_GBPS"] = str(emulate_gbps)
+    _os.environ["TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS"] = "10"
+    extra_mirrors: list = []
+    try:
+        await ts.initialize(
+            store_name="bench_xhost",
+            strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+        )
+        # The bench process itself must NOT pace: the client-side put is
+        # the publisher's local hand-off; only the volume's serves (push
+        # frames, doorbell replies) model the DCN hop under measurement.
+        bulk_mod.set_emulated_gbps(0)
+        client = ts.client("bench_xhost")
+        coordinator = client._controller.coordinator
+        topo = await coordinator.metadata_topology.call_one()
+        feed = topo.get("meta_feed")
+        assert feed, "metadata feed did not start"
+
+        # k_hosts - 1 extra subscriber hosts + the measuring client: the
+        # controller fans them through the relay tree (root serves ONE).
+        for i in range(1, k_hosts):
+            _os.environ["TORCHSTORE_TPU_HOSTNAME"] = f"xh-sub{i}"
+            m = mirror_mod.MetadataMirror(
+                coordinator, (feed["host"], feed["port"])
+            )
+            await m.start()
+            assert await m.wait_ready(10.0), f"mirror xh-sub{i} never ready"
+            extra_mirrors.append(m)
+        _os.environ["TORCHSTORE_TPU_HOSTNAME"] = "xh-client"
+        await client._load_volumes()
+        router = client._controller
+        assert router._mirror is not None, "client mirror did not arm"
+
+        n_elem = max(1, int(layer_kb * 1024 // 4))
+        key = "xh/layer"
+        await ts.put(
+            key, np.zeros(n_elem, np.float32), store_name="bench_xhost"
+        )
+        # Cold get: doorbell-plan registration + push subscription.
+        await ts.get(key, store_name="bench_xhost")
+        deadline = time.monotonic() + 10.0
+        while router.stamped_locate([key]) is None:
+            assert time.monotonic() < deadline, "mirror never caught up"
+            await asyncio.sleep(0.01)
+        cache = client._ctx.get_cache(bulk_mod.BulkClientCache)
+
+        def _staged_gen() -> int:
+            gens = [
+                max(e["gens"])
+                for e in cache.push_staging.values()
+                if e.get("gens")
+            ]
+            return max(gens, default=-1)
+
+        def _meta_flow() -> tuple[int, int]:
+            # Every mirror (the client's + the K-1 extras) lives in THIS
+            # process, so the local ledger holds the whole fleet's feed
+            # ingress cells WITH the transport dimension the folded
+            # matrix drops: total = fleet-delivered image bytes (the
+            # all-subscribers-pull baseline), root = the slice the index
+            # host actually served (everything else rode subscriber->
+            # subscriber relay hops).
+            from torchstore_tpu.observability import ledger as obs_ledger
+
+            root = total = 0
+            for cell in obs_ledger.snapshot()["cells"]:
+                if cell["transport"] != mirror_mod.MIRROR_TRANSPORT:
+                    continue
+                total += cell["bytes"]
+                if cell["peer_host"] == "xh-vol":
+                    root += cell["bytes"]
+            return root, total
+
+        root0, total0 = _meta_flow()
+
+        async def timed_get(expect: float) -> float:
+            t0 = time.perf_counter()
+            got = await ts.get(key, store_name="bench_xhost")
+            dt = time.perf_counter() - t0
+            arr = np.asarray(got)
+            assert arr[0] == expect and arr[-1] == expect, "wrong bytes"
+            return dt
+
+        # Push leg: publish, wait for the watermark-time push to stage,
+        # then read — the wire crossing happened BEFORE the read.
+        push_lat: list[float] = []
+        for r in range(rounds):
+            fill = float(r + 1)
+            seen = _staged_gen()
+            await ts.put(
+                key, np.full(n_elem, fill, np.float32),
+                store_name="bench_xhost",
+            )
+            deadline = time.monotonic() + 10.0
+            while _staged_gen() <= seen:
+                assert (
+                    time.monotonic() < deadline
+                ), "push session never staged the publish"
+                await asyncio.sleep(0.005)
+            push_lat.append(await timed_get(fill))
+
+        # Zero-metadata-RPC warm block (no puts interleaved).
+        meta0 = (await ts.traffic_matrix("bench_xhost"))["metadata"]
+        for _ in range(3):
+            await timed_get(float(rounds))
+        meta1 = (await ts.traffic_matrix("bench_xhost"))["metadata"]
+        rpc_moves = {
+            op: meta1["rpcs"].get(op, 0) - meta0["rpcs"].get(op, 0)
+            for op in set(meta1["rpcs"]) | set(meta0["rpcs"])
+        }
+        rpc_moves = {
+            op: n for op, n in rpc_moves.items() if n and op != "stats"
+        }
+
+        # Doorbell-pull baseline: same publishes, but the read pays the
+        # paced wire (push serving disabled at read time).
+        _os.environ["TORCHSTORE_TPU_PUSH_SESSIONS"] = "0"
+        try:
+            bell_lat: list[float] = []
+            for r in range(rounds):
+                fill = float(rounds + r + 1)
+                await ts.put(
+                    key, np.full(n_elem, fill, np.float32),
+                    store_name="bench_xhost",
+                )
+                bell_lat.append(await timed_get(fill))
+        finally:
+            _os.environ.pop("TORCHSTORE_TPU_PUSH_SESSIONS", None)
+
+        root1, total1 = _meta_flow()
+        meta_total = max(1, total1 - total0)
+        meta_root = root1 - root0
+        push_p50 = float(np.median(push_lat))
+        bell_p50 = float(np.median(bell_lat))
+        out = {
+            "k_hosts": k_hosts,
+            "layer_kb": layer_kb,
+            "emulate_gbps": emulate_gbps,
+            "push_first_layer_ms": round(push_p50 * 1e3, 3),
+            "doorbell_first_layer_ms": round(bell_p50 * 1e3, 3),
+            # ISSUE-20 acceptance: >= 2x lower first-layer latency.
+            "push_speedup": round(bell_p50 / max(push_p50, 1e-9), 3),
+            "meta_delivered_mb": round(meta_total / 1e6, 4),
+            # ISSUE-20 acceptance: <= 1.5 / k_hosts of the all-subscribers-
+            # pull baseline (every mirror pulling straight from the root).
+            "meta_egress_ratio": round(meta_root / meta_total, 4),
+            "meta_egress_bound": round(1.5 / k_hosts, 4),
+            "warm_metadata_rpcs": rpc_moves,
+            "push_serves": int(bulk_mod._PUSH_SERVES.total()),
+        }
+        print(
+            f"# cross_host (K={k_hosts} hosts, {layer_kb:.0f} KB layers, "
+            f"{emulate_gbps} GB/s emulated): first layer push "
+            f"{out['push_first_layer_ms']:.2f} ms vs doorbell "
+            f"{out['doorbell_first_layer_ms']:.2f} ms "
+            f"(speedup {out['push_speedup']}x); meta egress ratio "
+            f"{out['meta_egress_ratio']} (bound {out['meta_egress_bound']}); "
+            f"warm metadata RPCs {rpc_moves or 'none'}",
+            file=sys.stderr,
+        )
+        if rpc_moves:
+            print(
+                "# cross_host WARN: warm remote gets issued metadata RPCs — "
+                "the mirrored stamped plane is not serving the warm path",
+                file=sys.stderr,
+            )
+        if out["push_speedup"] < 2.0:
+            print(
+                "# cross_host WARN: push-on-publish first-layer speedup "
+                "below the 2x acceptance bound",
+                file=sys.stderr,
+            )
+        if out["meta_egress_ratio"] > out["meta_egress_bound"]:
+            print(
+                "# cross_host WARN: metadata relay egress above the 1.5/K "
+                "bound — the feed tree is not absorbing the fan-out",
+                file=sys.stderr,
+            )
+        return out
+    finally:
+        for m in extra_mirrors:
+            m.close()
+        await ts.shutdown("bench_xhost")
+        for k, v in saved_env.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        bulk_mod.set_emulated_gbps(None)
+
+
 async def capacity_section(
     n_versions: int = 8,
     n_keys: int = 16,
@@ -2998,6 +3228,13 @@ if __name__ == "__main__":
         # Standalone fan-out run: one JSON line with the tree vs
         # point-to-point trainer-host egress and deep-hop overlap.
         print(json.dumps(asyncio.run(fanout_section())))
+        sys.exit(0)
+    if "--cross-host" in sys.argv:
+        # Standalone cross-host run (gated: not part of the default
+        # headline): one JSON line with the push vs doorbell first-layer
+        # latencies, the metadata-relay egress ratio, and the warm
+        # metadata-RPC audit over the emulated multi-host topology.
+        print(json.dumps(asyncio.run(cross_host_section())))
         sys.exit(0)
     if "--capacity" in sys.argv:
         # Standalone tiered-capacity run: one JSON line with the

@@ -136,6 +136,16 @@ THRESHOLDS: dict[str, tuple[str, float, str]] = {
     "autoscale_volume_seconds_ratio": ("lower", 0.15, "abs"),
     "autoscale_get_p99_ms": ("lower", 1.00, "rel"),
     "cold_restore_s": ("lower", 1.00, "rel"),
+    # Cross-host one-sided tier (ISSUE 20, --cross-host runs only). The
+    # push speedup divides two latencies from the SAME paced run, so host
+    # weather largely cancels — a real drop means reads stopped serving
+    # from the push-staged arena (back to paying the wire at read time);
+    # the metadata egress ratio is structural at fixed K (1/K when every
+    # image rides the relay tree), so even a small absolute drift means
+    # subscribers leaked feed reads back to the index host.
+    "push_speedup": ("higher", 0.40, "rel"),
+    "push_first_layer_ms": ("lower", 1.00, "rel"),
+    "meta_egress_ratio": ("lower", 0.10, "abs"),
 }
 
 

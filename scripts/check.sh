@@ -6,8 +6,8 @@
 #   scripts/check.sh --quick    # static analysis only (skip pytest)
 #
 # Stages:
-#   1. tslint --fail-on-new     repo-specific static analysis (20 rules:
-#                               16 syntactic + the 4 flow-aware CFG rules
+#   1. tslint --fail-on-new     repo-specific static analysis (21 rules:
+#                               17 syntactic + the 4 flow-aware CFG rules
 #                               bracket/epoch/await-atomicity/decision-flow;
 #                               incl. env-registry + metric-discipline docs
 #                               drift — regen with --regen-env-docs /
@@ -37,7 +37,12 @@
 #                               mid-migration, and the autoscale section's
 #                               diurnal elasticity loop: fleet 1 -> N ->
 #                               back, volume-seconds vs a fixed fleet,
-#                               blob checkpoint -> cold restore) and
+#                               blob checkpoint -> cold restore, and the
+#                               cross_host section's one-sided tier:
+#                               push-vs-doorbell first-layer speedup,
+#                               zero warm metadata RPCs against the
+#                               local mirror, relay-tree egress bound)
+#                               and
 #                               test_bench_compare.py (the BENCH_r*
 #                               regression gate itself)
 #

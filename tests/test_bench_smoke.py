@@ -599,3 +599,33 @@ async def test_bench_autoscale_section_tiny():
     assert out["cold_restore_s"] > 0, out
     assert out["restored_keys"] > 0, out
     json.dumps(out)
+
+
+@pytest.mark.anyio
+async def test_bench_cross_host_section_tiny():
+    """The cross_host section standalone (``bench.py --cross-host``) at KB
+    scale: an emulated 3-host topology over a paced 0.2 Gbps DCN, real
+    metadata mirrors fanned through the relay tree and a real push
+    session staging layers ahead of the read. The ISSUE-20 acceptance
+    trio — push first-layer >= 2x faster than doorbell-pull, zero warm
+    metadata RPCs, index-host egress <= 1.5/K of delivered mirror bytes
+    — is asserted here at smoke scale so it can never ship broken."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    out = await bench.cross_host_section(
+        k_hosts=3, layer_kb=64, rounds=2, emulate_gbps=0.05
+    )
+    # Push-staged reads skip the paced wire entirely; even at 64 KB the
+    # doorbell leg pays ~1.3 ms of emulated DCN the push leg does not.
+    assert out["push_speedup"] >= 2.0, out
+    assert out["push_serves"] > 0, out
+    # Warm remote gets resolve everything against the local mirror: no
+    # metadata RPC counter cell moved (dict of moved cells, empty = none).
+    assert not out["warm_metadata_rpcs"], out
+    # Relay tree: root serves one image copy regardless of subscribers.
+    assert out["meta_egress_ratio"] <= out["meta_egress_bound"], out
+    json.dumps(out)

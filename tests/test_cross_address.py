@@ -3,9 +3,18 @@ when nothing listens on 127.0.0.1 — controller/volume actors and the bulk
 data plane bound to 127.0.0.2 (and a second store on 127.0.0.3), with the
 client dialing across addresses. Any hardcoded 127.0.0.1 in the actor
 server, bulk listener, or client dial path fails this test. Also asserts
-the propagated trace id survives the cross-address hop (PR 2)."""
+the propagated trace id survives the cross-address hop (PR 2).
 
+Cross-HOST tier (PR 20): `TORCHSTORE_TPU_HOSTNAME` overlays emulate a
+multi-host fleet in one process tree, so the metadata-mirror + push-session
+planes are exercised exactly as a real DCN deployment would drive them —
+warm remote acquires must issue ZERO metadata RPCs, and killing a mirror's
+relay parent mid-stream must fall back loudly (never serve mixed
+generations) until the re-parented subscription resumes."""
+
+import asyncio
 import json
+import time
 
 import numpy as np
 import pytest
@@ -95,3 +104,196 @@ async def test_cross_address_fleet(tmp_path, monkeypatch):
         }
         stitched += len(pids) >= 2
     assert stitched >= 1, "no trace id crossed the 127.0.0.2/127.0.0.3 hop"
+
+
+@pytest.mark.anyio
+async def test_cross_host_mirror_zero_rpc_warm(monkeypatch):
+    """Warm remote acquire over the cross-host one-sided tier: with the
+    client on a DIFFERENT (emulated) host than every stamped publisher,
+    the mirror replica serves locates/epochs locally and the push session
+    stages fresh layers — repeated warm gets issue ZERO metadata RPCs
+    (``ts.traffic_matrix()["metadata"]`` is the measured assertion)."""
+    import torchstore_tpu as ts
+    from torchstore_tpu.transport import bulk as bulk_mod
+
+    monkeypatch.setenv("TORCHSTORE_TPU_HOSTNAME", "mirror-vol-host")
+    monkeypatch.setenv("TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS", "10")
+    await ts.initialize(
+        store_name="xmirror",
+        strategy=ts.SingletonStrategy(default_transport_type="bulk"),
+    )
+    try:
+        payload = np.arange(4096, dtype=np.float32)
+        await ts.put("m/warm", payload, store_name="xmirror")
+
+        # Become a REMOTE host: reload the topology under a different
+        # identity, so every stamped publisher is cross-host and the
+        # router arms the mirror instead of same-host shm.
+        monkeypatch.setenv("TORCHSTORE_TPU_HOSTNAME", "mirror-client-host")
+        client = ts.client("xmirror")
+        await client._load_volumes()
+        router = client._controller
+        assert router._mirror is not None, "mirror did not arm cross-host"
+        assert await router._mirror.wait_ready(5.0)
+
+        # Cold get: RPC locate + doorbell-plan registration are allowed
+        # here (this is the one-time plan establishment).
+        got = await ts.get("m/warm", store_name="xmirror")
+        np.testing.assert_array_equal(np.asarray(got), payload)
+
+        # Wait until the mirrored index resolves the key locally — from
+        # here on the warm path has everything it needs with zero RPCs.
+        deadline = time.monotonic() + 5.0
+        while router.stamped_locate(["m/warm"]) is None:
+            assert (
+                time.monotonic() < deadline
+            ), "mirror never replicated the index image"
+            await asyncio.sleep(0.02)
+
+        # A fresh put AFTER the plan is registered: the volume pushes the
+        # new generation at watermark time into the client's staging
+        # arena (push-on-publish), so the next read's first byte is a
+        # local memcpy.
+        payload2 = np.arange(4096, dtype=np.float32) * 2.0
+        await ts.put("m/warm", payload2, store_name="xmirror")
+        cache = client._ctx.get_cache(bulk_mod.BulkClientCache)
+        deadline = time.monotonic() + 5.0
+        while not cache.push_staging:
+            assert (
+                time.monotonic() < deadline
+            ), "push session never staged the fresh layer"
+            await asyncio.sleep(0.02)
+
+        before = (await ts.traffic_matrix("xmirror"))["metadata"]
+        push_serves0 = bulk_mod._PUSH_SERVES.total()
+        for _ in range(3):
+            got = await ts.get("m/warm", store_name="xmirror")
+            np.testing.assert_array_equal(np.asarray(got), payload2)
+        after = (await ts.traffic_matrix("xmirror"))["metadata"]
+        diff = {
+            op: after["rpcs"].get(op, 0) - before["rpcs"].get(op, 0)
+            for op in set(after["rpcs"]) | set(before["rpcs"])
+        }
+        # traffic_matrix itself scrapes the fleet over one counted
+        # "stats" RPC per call — nothing else may move.
+        hot = {op: n for op, n in diff.items() if n and op != "stats"}
+        assert not hot, f"warm remote gets issued metadata RPCs: {hot}"
+        assert sum(after["stamped"].values()) > sum(
+            before["stamped"].values()
+        ), "warm reads were not served from the mirrored stamped plane"
+        assert bulk_mod._PUSH_SERVES.total() > push_serves0, (
+            "warm gets never served from the push-staged arena"
+        )
+    finally:
+        await ts.shutdown("xmirror")
+
+
+@pytest.mark.anyio
+async def test_cross_host_mirror_chaos_reparent(monkeypatch):
+    """Chaos leg: kill the mirror's relay PARENT mid-stream. The client's
+    stamped reads must fall back LOUDLY to RPC (``mirror_lag``, never a
+    silent stale serve), every read during the dark window must be a
+    single committed generation (no tearing/blending), and the mirror
+    must re-subscribe AROUND the dead parent and resume."""
+    import torchstore_tpu as ts
+    from torchstore_tpu.metadata import mirror as mirror_mod
+    from torchstore_tpu.metadata import stamped as stamped_mod
+
+    monkeypatch.setenv("TORCHSTORE_TPU_HOSTNAME", "chaos-vol-host")
+    monkeypatch.setenv("TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS", "10")
+    monkeypatch.setenv("TORCHSTORE_TPU_META_MIRROR_HEARTBEAT_S", "0.05")
+    monkeypatch.setenv("TORCHSTORE_TPU_META_MIRROR_LAG_S", "0.4")
+    await ts.initialize(store_name="xchaos")
+    try:
+        client = ts.client("xchaos")
+        coordinator = client._controller.coordinator
+        topo = await coordinator.metadata_topology.call_one()
+        feed = topo.get("meta_feed")
+        assert feed, "controller did not start the metadata feed"
+
+        # An intermediate relay hop: the FIRST subscriber takes the root
+        # feed's only slot (ROOT_FANOUT=1)...
+        monkeypatch.setenv("TORCHSTORE_TPU_HOSTNAME", "chaos-hop-host")
+        hop = mirror_mod.MetadataMirror(
+            coordinator, (feed["host"], feed["port"])
+        )
+        await hop.start()
+        assert await hop.wait_ready(5.0)
+
+        # ...so the CLIENT's mirror is fanned through the hop, exactly
+        # the one-deep relay shape a real trainer-host tree produces.
+        monkeypatch.setenv("TORCHSTORE_TPU_HOSTNAME", "chaos-client-host")
+        await client._load_volumes()
+        router = client._controller
+        assert router._mirror is not None
+        assert await router._mirror.wait_ready(5.0)
+        assert router._mirror._parent_hostname == "chaos-hop-host"
+
+        async def _put_fill(i: int) -> None:
+            await ts.put(
+                "c/key",
+                np.full(1024, float(i), dtype=np.float32),
+                store_name="xchaos",
+            )
+
+        def _assert_uniform(arr) -> None:
+            arr = np.asarray(arr)
+            assert arr.shape == (1024,)
+            assert (arr == arr[0]).all(), (
+                "mixed-generation read: blended fills "
+                f"{sorted(set(arr.tolist()))[:4]}"
+            )
+
+        await _put_fill(0)
+        deadline = time.monotonic() + 5.0
+        while router.stamped_locate(["c/key"]) is None:
+            assert time.monotonic() < deadline, "replica never caught up"
+            await asyncio.sleep(0.02)
+
+        # Loud-fallback ladder, deterministically: rewind the replica's
+        # receive clock past the lag bound and read IN THE SAME TICK
+        # (stamped reads are synchronous — no heartbeat can interleave).
+        # The read must refuse the stale mirror and count mirror_lag.
+        fb0 = stamped_mod.STAMPED_FALLBACKS.value(reason="mirror_lag")
+        router._mirror._last_rx = time.monotonic() - 60.0
+        assert router.stamped_locate(["c/key"]) is None
+        assert (
+            stamped_mod.STAMPED_FALLBACKS.value(reason="mirror_lag") > fb0
+        ), "stale mirror served silently (no mirror_lag fallback)"
+        # The RPC plane still answers correctly through the dark window.
+        _assert_uniform(await ts.get("c/key", store_name="xchaos"))
+
+        # Kill the relay parent MID-STREAM: writes keep landing while the
+        # tree re-forms; the client's mirror must re-subscribe around the
+        # dead hop (down-set) and land back on the root feed.
+        resub0 = mirror_mod._RESUBSCRIBES.total()
+        hop.close()
+        gen = 1
+        deadline = time.monotonic() + 15.0
+        reparented = False
+        while time.monotonic() < deadline:
+            await _put_fill(gen)
+            _assert_uniform(await ts.get("c/key", store_name="xchaos"))
+            gen += 1
+            if (
+                router._mirror._parent_hostname != "chaos-hop-host"
+                and router._mirror.fresh()
+            ):
+                reparented = True
+                break
+            await asyncio.sleep(0.05)
+        assert reparented, "mirror never re-parented around the dead hop"
+        assert mirror_mod._RESUBSCRIBES.total() > resub0
+
+        # Resumed replica serves the LATEST committed generation warm.
+        await _put_fill(gen)
+        deadline = time.monotonic() + 5.0
+        while True:
+            hits = router.stamped_locate(["c/key"])
+            if hits is not None:
+                break
+            assert time.monotonic() < deadline, "replica never resumed"
+            await asyncio.sleep(0.02)
+        _assert_uniform(await ts.get("c/key", store_name="xchaos"))
+    finally:
+        await ts.shutdown("xchaos")

@@ -22,6 +22,7 @@ from torchstore_tpu.analysis.checkers import (
     history_discipline,
     landing_copy,
     metric_discipline,
+    mirror_discipline,
     one_sided,
     orphan_task,
     quant_discipline,
@@ -45,6 +46,7 @@ CHECKERS = {
     stream_discipline.RULE: stream_discipline.check,
     quant_discipline.RULE: quant_discipline.check,
     shard_discipline.RULE: shard_discipline.check,
+    mirror_discipline.RULE: mirror_discipline.check,
     stage_discipline.RULE: stage_discipline.check,
     control_discipline.RULE: control_discipline.check,
     history_discipline.RULE: history_discipline.check,

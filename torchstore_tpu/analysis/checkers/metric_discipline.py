@@ -82,6 +82,10 @@ ALLOWED_LABEL_KEYS = {
     # SLO violations: one series per configured TORCHSTORE_TPU_SLO_* knob
     # (a small operator-set family, observability/timeline.py).
     "slo",
+    # Metadata mirror feed: one series per stamped segment source — the
+    # coordinator plus one per index shard (metadata/mirror.py), a
+    # deployment-sized closed set.
+    "source",
     # Metadata-plane inflight: one series per controller shard
     # ("coord"/"s<i>" — bounded by controller_shards, metadata/router.py).
     "shard",

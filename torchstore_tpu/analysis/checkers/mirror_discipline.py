@@ -1,0 +1,73 @@
+"""mirror-discipline: METADATA segments are attached only through stamped/.
+
+The cross-host metadata tier (metadata/mirror.py) republishes the fleet's
+seqlock-stamped METADATA segments into per-host local replicas; which
+segment name backs a given logical reader is a MOVING TARGET — the feed
+tombstones and re-creates replica segments on every topology reshape, and
+``stamped.attach_reader`` is the one accessor that absorbs gone/renamed/
+cross-mount publishers (returning None so the RPC plane serves loudly).
+A raw ``MetaStampReader(...)`` construction outside the stamped/mirror
+modules pins a segment NAME: it works until the first reshape, then reads
+a tombstoned (or recycled) segment forever — the silent-stale failure the
+whole torn/stale fallback ladder exists to rule out.
+
+Rule: outside ``torchstore_tpu/metadata/stamped.py`` and
+``torchstore_tpu/metadata/mirror.py``, any call whose callee name is
+``MetaStampReader`` is forbidden — attach through
+``stamped.attach_reader(descriptor)`` (local publishers) or
+``MetadataMirror.descriptors()`` (remote publishers) instead. Writer
+construction stays legal everywhere: publishers own their segments'
+lifecycles, readers must not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torchstore_tpu.analysis.core import Finding, Project
+
+RULE = "mirror-discipline"
+
+_EXEMPT_FILES = (
+    "torchstore_tpu/metadata/stamped.py",
+    "torchstore_tpu/metadata/mirror.py",
+)
+
+_FORBIDDEN = "MetaStampReader"
+
+_MESSAGE = (
+    "raw MetaStampReader attach outside metadata/stamped.py//mirror.py: "
+    "segment names move on every reshape — attach through "
+    "stamped.attach_reader(descriptor) (or MetadataMirror.descriptors() "
+    "for remote publishers) so gone/renamed segments fall back loudly "
+    "instead of pinning a tombstoned name"
+)
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path in _EXEMPT_FILES:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _callee_name(node.func) == _FORBIDDEN
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MESSAGE,
+                    )
+                )
+    return findings

@@ -1611,6 +1611,14 @@ async def shutdown(store_name: str = DEFAULT_STORE) -> None:
         from torchstore_tpu import state_dict_utils
 
         await state_dict_utils.close_direct_caches(handle.client)
+    # Cross-host metadata mirrors subscribe per (process, feed root);
+    # once the LAST store is gone their feeds are dead — close them so
+    # the receiver tasks and local replica segments don't outlive the
+    # fleet (they would spin re-subscribing against nothing).
+    if not _stores:
+        from torchstore_tpu.metadata import mirror as mirror_mod
+
+        mirror_mod.close_mirrors()
     # Release prewarmed-but-undrawn direct staging segments once the LAST
     # store is gone (the pool is process-local and advisory; another live
     # store may have prewarmed it, so a per-store shutdown must not discard

@@ -312,6 +312,14 @@ class LocalClient:
         await self._controller.load_topology(
             meta_stamped=self._config.meta_stamped
         )
+        # Arm push-on-publish validation: a push-staged arena serves only
+        # once the (possibly mirrored) stamped index confirms its pack-time
+        # write generations, so a warm push serve stays zero-RPC end to end.
+        from torchstore_tpu.transport.bulk import BulkClientCache
+
+        self._ctx.get_cache(BulkClientCache).push_validate = (
+            self._controller.stamped_write_gens
+        )
         strategy = await self._controller.get_strategy.call_one()
         vmap = await self._controller.get_volume_map.call_one()
         forced = strategy.default_transport_type if strategy else None
@@ -1894,18 +1902,19 @@ class LocalClient:
         report ready only once the broadcast tree landed them on that
         volume (ignored when the volume is not a live relay member).
 
-        Gate-less polls (``volume_id=None`` — the common streamed-acquire
-        shape) serve from the coordinator's stamped stream snapshot with
-        ZERO controller RPCs when it is attached same-host; relay-gated
-        polls need the coordinator's live run state and stay on the RPC
-        long-poll."""
+        Both gate-less AND relay-gated polls serve from the stamped stream
+        snapshot (same-host segment or this host's metadata mirror) with
+        ZERO controller RPCs when attached: the controller publishes the
+        relay-gate picture into the snapshot, so a gated poll applies the
+        exact wait_for_stream formula against the local replica. The RPC
+        long-poll stays the loud fallback (unattached, torn, stale, or
+        mirror past its lag bound)."""
         await self._ensure_setup()
-        if volume_id is None:
-            served = await self._controller.stamped_wait_stream(
-                key, version, known, timeout
-            )
-            if served is not None:
-                return served
+        served = await self._controller.stamped_wait_stream(
+            key, version, known, timeout, volume_id=volume_id
+        )
+        if served is not None:
+            return served
         return await self._controller.wait_for_stream.with_timeout(
             self._wait_rpc_timeout(timeout)
         ).call_one(key, version, known, timeout, volume_id)

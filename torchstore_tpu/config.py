@@ -113,6 +113,19 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "measure the cross-host regime the bulk transport targets "
            "(bench.py delta_sync uses it). 0 (production default) "
            "disables pacing entirely."),
+    EnvVar("TORCHSTORE_TPU_PUSH_SESSIONS", "bool", True,
+           "Push-on-publish bulk sessions: a client that caches a "
+           "doorbell plan also registers a persistent push subscription; "
+           "the volume then streams freshly committed layers into the "
+           "client's staging arena AT WATERMARK TIME, so the next warm "
+           "get's first byte is a local memcpy (validated against the "
+           "mirrored write generations before serving). Unsubscribed or "
+           "lagging sessions fall back loudly to the doorbell ring."),
+    EnvVar("TORCHSTORE_TPU_PUSH_STAGING_MAX_BYTES", "int", 1073741824,
+           "Per-client cap on push-staged arena bytes; staging past the "
+           "cap evicts oldest-staged plans first, and a single frame "
+           "larger than the cap is never staged (its reads stay on the "
+           "doorbell ring). Floor: 1 MiB."),
     EnvVar("TORCHSTORE_TPU_BULK_STRIPE_THRESHOLD", "int", 67108864,
            "Bulk transport payloads above this many bytes are striped "
            "across the pre-opened stripe connection set (puts, get "
@@ -165,6 +178,29 @@ ENV_REGISTRY: tuple[EnvVar, ...] = (
            "Size of each stamped metadata segment. A pickled view that "
            "outgrows it tombstones the segment (readers fall back to "
            "RPCs, loudly) rather than growing under attached readers."),
+    EnvVar("TORCHSTORE_TPU_META_MIRROR", "bool", True,
+           "Cross-host metadata mirroring: the coordinator runs a "
+           "metadata feed that pushes the stamped segment images over "
+           "persistent subscriptions (fanned through a relay tree, so "
+           "index-host egress stays O(1) in subscriber count); each "
+           "remote host's MetadataMirror republishes them into LOCAL shm "
+           "replicas, extending the zero-RPC warm metadata paths across "
+           "hosts. Off: remote clients use the RPC metadata plane only."),
+    EnvVar("TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS", "float", 20,
+           "Feed pump poll interval, milliseconds: how often the root "
+           "feed re-reads the local stamped segments and pushes changed "
+           "images to subscribers (bounds mirror replica staleness "
+           "alongside the publish debounce)."),
+    EnvVar("TORCHSTORE_TPU_META_MIRROR_HEARTBEAT_S", "float", 0.2,
+           "Feed heartbeat period, seconds: subscribers receive at least "
+           "one frame per period even when no image changed, so a quiet "
+           "feed is distinguishable from a dead parent."),
+    EnvVar("TORCHSTORE_TPU_META_MIRROR_LAG_S", "float", 1.5,
+           "Mirror staleness bound, seconds: a replica whose feed has "
+           "been silent longer than this reports unfresh — every stamped "
+           "read on that host falls back LOUDLY to the RPC plane "
+           "(reason=mirror_lag) and the subscription re-parents around "
+           "the dead feed (the down-set re-subscribe)."),
     # --- tiered capacity & multi-version serving (torchstore_tpu/tiering) ---
     EnvVar("TORCHSTORE_TPU_TIER_ENABLED", "bool", False,
            "Enable the disk spill tier: per-volume spill writers demote "

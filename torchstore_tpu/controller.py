@@ -96,6 +96,15 @@ class Controller(Actor):
         # Stamped stream/epoch segment (metadata/stamped.py): same-host
         # clients validate plans and poll streamed publishes one-sided.
         self._meta_writer = None
+        # Cross-host metadata relay (metadata/mirror.py): the root feed
+        # pushes this host's stamped wire images to subscriber mirrors,
+        # fanned out over the relay-tree shape so OUR egress stays O(1)
+        # in subscriber count. _meta_parents holds the assigned tree
+        # ("" = the root feed); _meta_subscribers each host's re-serve
+        # endpoint (a mirror's child feed).
+        self._meta_feed = None
+        self._meta_subscribers: dict[str, dict] = {}
+        self._meta_parents: dict[str, str] = {}
         # Health supervisor state: per-volume heartbeat bookkeeping. A
         # volume is 'ok' | 'probation' (answered pings again after a
         # quarantine; not yet trusted) | 'quarantined' (missed
@@ -246,23 +255,51 @@ class Controller(Actor):
         if self._meta_writer is not None:
             self._meta_writer.mark_dirty()
 
+    def _relay_stamped_view(self, stream_key: str) -> Optional[dict]:
+        """The relay-gate picture for one stream record, published INTO the
+        stamped snapshot so one-sided pollers apply the exact
+        ``wait_for_stream`` gate formula against a local replica. Only
+        gate-ELIGIBLE volumes (the same membership/quarantine/tree checks
+        as :meth:`_relay_gate_run`) get a landed entry — a volume absent
+        from ``landed`` polls ungated, matching the RPC's fail-safe."""
+        run = self._relay_runs.get(stream_key)
+        if run is None or run.get("dead"):
+            return None
+        ch = self._relay_channels.get(run["channel"])
+        if ch is None:
+            return None
+        quarantined = self._quarantined_ids()
+        landed = {}
+        for vid in {run["root"], *run["parents"]}:
+            if ch["members"].get(vid, 0) <= 0 or vid in quarantined:
+                continue
+            landed[vid] = sorted(run["landed"].get(vid, ()))
+        if not landed:
+            return None
+        return {"forwarded": sorted(run["metas"]), "landed": landed}
+
     def _streams_payload(self) -> dict:
-        """The one-sided stream view: per record, exactly what a gate-less
+        """The one-sided stream view: per record, exactly what
         ``wait_for_stream`` needs (version/sealed/watermarks/aliases/
-        quant). Published AFTER the watermark step commits, so a reader
-        can only under-see progress — never a watermark before its bytes."""
-        return {
-            "streams": {
-                key: {
-                    "version": rec["version"],
-                    "sealed": rec["sealed"],
-                    "watermarks": dict(rec["watermarks"]),
-                    "aliases": dict(rec.get("aliases") or {}),
-                    "quant": rec.get("quant"),
-                }
-                for key, rec in self._streams.items()
+        quant, plus the relay-gate picture for gated readers). Published
+        AFTER the watermark step commits — and the relay view is read in
+        the same tick as the watermarks — so a reader can only under-see
+        progress: never a watermark before its bytes, never a landed copy
+        before its index merge."""
+        streams = {}
+        for key, rec in self._streams.items():
+            entry = {
+                "version": rec["version"],
+                "sealed": rec["sealed"],
+                "watermarks": dict(rec["watermarks"]),
+                "aliases": dict(rec.get("aliases") or {}),
+                "quant": rec.get("quant"),
             }
-        }
+            relay_view = self._relay_stamped_view(key)
+            if relay_view is not None:
+                entry["relay"] = relay_view
+            streams[key] = entry
+        return {"streams": streams}
 
     # Direct-instantiation test compatibility: the reclaim machinery moved
     # into the core; these views keep white-box assertions working.
@@ -326,6 +363,13 @@ class Controller(Actor):
                 self.core.meta_writer = stamped_mod.MetaStampWriter(
                     self.core.meta_payload
                 )
+            if stamped_mod.mirror_enabled() and self._meta_feed is None:
+                # Cross-host metadata relay root: push the stamped wire
+                # images to subscriber mirrors (metadata/mirror.py).
+                from torchstore_tpu.metadata.mirror import MetaFeedServer
+
+                self._meta_feed = MetaFeedServer(self._meta_feed_sources)
+                await self._meta_feed.ensure_started()
         # Unclean-exit post-mortem: a controller dying with faults/errors
         # in its flight ring leaves the last seconds on disk.
         obs_recorder.recorder().arm_exit_dump()
@@ -388,7 +432,130 @@ class Controller(Actor):
                 ),
                 "index": index_descs,
             },
+            # Cross-host subscription root: a remote router hands this to
+            # mirror.ensure_mirror() and attaches the LOCAL replica instead
+            # of paying metadata RPCs over DCN.
+            "meta_feed": (
+                {"host": self._meta_feed.host, "port": self._meta_feed.port}
+                if self._meta_feed is not None and self._meta_feed.port
+                else None
+            ),
         }
+
+    def _meta_feed_sources(self) -> list:
+        """Descriptor table the feed pump polls: source 0 is the
+        coordinator segment (streams + placement epoch), 1+i the index
+        segments — positional identity mirrors adopt verbatim."""
+        coord = (
+            self._meta_writer.describe()
+            if self._meta_writer is not None
+            else None
+        )
+        if self._shard_refs:
+            index = list(self._shard_stamped)
+        else:
+            index = [
+                self.core.meta_writer.describe()
+                if self.core.meta_writer is not None
+                else None
+            ]
+        return [coord] + index
+
+    def _meta_assign_parent(self, host: str, down: set) -> str:
+        """Pick ``host``'s feed parent over the relay-tree shape: the root
+        feed ("" — out-degree ``relay.ROOT_FANOUT`` keeps the index host's
+        egress O(1)) or another subscriber's mirror, preferring in-capacity
+        then shallowest then least-loaded; ``down`` hosts and ``host``'s
+        own descendants (cycle avoidance) are never candidates. Over-
+        capacity assignment beats refusal — a full tree still feeds."""
+        kids: dict[str, int] = {}
+        for h, p in self._meta_parents.items():
+            if h != host:
+                kids[p] = kids.get(p, 0) + 1
+
+        def _depth(h: str) -> int:
+            d = 0
+            seen = set()
+            while h and h not in seen:
+                seen.add(h)
+                h = self._meta_parents.get(h, "")
+                d += 1
+            return d
+
+        def _descends_from_host(cand: str) -> bool:
+            seen = set()
+            while cand and cand not in seen:
+                if cand == host:
+                    return True
+                seen.add(cand)
+                cand = self._meta_parents.get(cand, "")
+            return False
+
+        scored = []
+        for cand in [""] + sorted(self._meta_subscribers):
+            if cand == host or cand in down or _descends_from_host(cand):
+                continue
+            cap = relay_mod.ROOT_FANOUT if not cand else self._relay_fanout
+            load = kids.get(cand, 0)
+            scored.append((int(load >= cap), _depth(cand), load, cand))
+        scored.sort()
+        parent = scored[0][3] if scored else ""
+        self._meta_parents[host] = parent
+        return parent
+
+    @endpoint
+    async def meta_subscribe(
+        self,
+        host: str,
+        feed_host: str,
+        feed_port: int,
+        down: Optional[list] = None,
+    ) -> dict[str, Any]:
+        """Subscribe ``host``'s MetadataMirror to the fleet's metadata
+        feed. ``down`` names parents the caller just lost (its re-subscribe
+        after a mid-stream parent death): they are dropped from the
+        subscriber table so no future assignment routes through them —
+        their own children re-parent the same way when their feeds go
+        quiet. Returns the assigned parent's feed endpoint."""
+        if self._meta_feed is None:
+            raise RuntimeError(
+                "metadata feed disabled (stamped or mirror tier off)"
+            )
+        host = str(host)
+        for dead in set(down or []):
+            dead = str(dead)
+            if dead != host:
+                self._meta_subscribers.pop(dead, None)
+                self._meta_parents.pop(dead, None)
+        self._meta_subscribers[host] = {
+            "host": str(feed_host),
+            "port": int(feed_port),
+        }
+        parent = self._meta_assign_parent(host, set(down or []))
+        if parent:
+            ep = self._meta_subscribers[parent]
+            return {
+                "parent_hostname": parent,
+                "host": ep["host"],
+                "port": ep["port"],
+            }
+        from torchstore_tpu.utils import get_hostname
+
+        # Root assignment: label the parent with THIS host's name so the
+        # subscriber's ingress ledger cells attribute the feed bytes to a
+        # real host edge (the index-host egress the relay tree bounds).
+        return {
+            "parent_hostname": get_hostname(),
+            "host": self._meta_feed.host,
+            "port": self._meta_feed.port,
+        }
+
+    @endpoint
+    async def meta_unsubscribe(self, host: str) -> None:
+        """Drop ``host`` from the metadata feed tree (clean shutdown). Its
+        children re-parent through their own quiet-feed re-subscription."""
+        self._meta_subscribers.pop(str(host), None)
+        self._meta_parents.pop(str(host), None)
 
     @endpoint
     async def get_volume_map(self) -> dict[str, dict]:
@@ -1228,6 +1395,7 @@ class Controller(Actor):
                 run["landed"].setdefault(str(vid), set()).add(marker_key)
         run["sealed"] = True
         self._relay_sync_tasks(run)
+        self._touch_streams()
         await self._relay_notify(run)
 
     def _relay_sync_tasks(self, run: dict) -> None:
@@ -1326,6 +1494,10 @@ class Controller(Actor):
             # per-key waiters; keys deleted mid-run are never re-indexed.
             touched = await self.idx.merge_copies(child, metas, gens)
             have.update(batch)
+            # The child's landed set moved: republish the stamped stream
+            # snapshot so relay-gated ONE-SIDED pollers (local segment or
+            # cross-host mirror) see the landing without an RPC.
+            self._touch_streams()
             _RELAY_FORWARDED.inc(len(batch), channel=run["channel"])
             if touched:
                 # Relay-gated wait_for_stream long-pollers wait on THIS
@@ -2692,6 +2864,11 @@ class Controller(Actor):
                 await self.idx.teardown()
             self._shard_refs = []
             self._shard_stamped = []
+        if self._meta_feed is not None:
+            self._meta_feed.close()
+            self._meta_feed = None
+        self._meta_subscribers.clear()
+        self._meta_parents.clear()
         if self._meta_writer is not None:
             self._meta_writer.close()
             self._meta_writer = None

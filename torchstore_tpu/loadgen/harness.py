@@ -99,6 +99,17 @@ class LoadSpec:
     # the store handle's PICKLED config from the initializing process —
     # env overrides here cannot reach them; use config_overrides.
     env: dict = field(default_factory=dict)
+    # Emulated multi-host topology: driver d runs under
+    # TORCHSTORE_TPU_HOSTNAME=hostnames[d % len(hostnames)], so a
+    # single-machine fleet exercises every cross-host path (metadata
+    # mirrors, push sessions, relay parenting) exactly as a real
+    # multi-host deployment would — get_hostname() is the only identity
+    # the planes ever consult. Empty/None = inherit the real hostname.
+    hostnames: list = field(default_factory=list)
+    # DCN emulation: >0 sets TORCHSTORE_TPU_BULK_EMULATE_GBPS in every
+    # driver, pacing bulk/push/mirror frames to the given line rate so
+    # cross-host latency comparisons aren't loopback-flattered.
+    emulate_gbps: float = 0.0
     # StoreConfig field overrides applied to each driver's client config
     # (dataclasses.replace) — e.g. {"one_sided": False} to force every
     # get onto the RPC plane (chaos legs measuring failover, which the
@@ -452,14 +463,25 @@ async def run_fleet_load(spec: LoadSpec) -> dict:
         k: v for k, v in os.environ.items() if k.startswith("TORCHSTORE_TPU_")
     }
     env.update({k: str(v) for k, v in (spec.env or {}).items()})
+    if spec.emulate_gbps and spec.emulate_gbps > 0:
+        env["TORCHSTORE_TPU_BULK_EMULATE_GBPS"] = str(spec.emulate_gbps)
     ctx = _mp_context()
     procs = []
     spec_json = spec.to_json()
     for d in range(spec.processes):
+        denv = env
+        if spec.hostnames:
+            # Per-driver host identity: the overlay is what makes the
+            # driver REMOTE to every volume/index host, arming the
+            # mirror + push-session planes instead of same-host shm.
+            denv = dict(env)
+            denv["TORCHSTORE_TPU_HOSTNAME"] = spec.hostnames[
+                d % len(spec.hostnames)
+            ]
         parent, child = ctx.Pipe()
         proc = ctx.Process(
             target=_driver_main,
-            args=(env, spec_json, d, child),
+            args=(denv, spec_json, d, child),
             daemon=True,
             name=f"ts-loadgen-{d}",
         )

@@ -1,0 +1,620 @@
+"""Cross-host metadata relay: stamped wire images pushed over DCN.
+
+PR 14's stamped metadata plane stops at the host boundary — a segment in
+/dev/shm is only attachable same-host, so DCN clients still pay a
+controller RPC for every locate/plan-validate/stream-poll. This module
+extends the one-sided tier across hosts:
+
+- The index host runs a **MetaFeedServer**: a persistent bulk-style TCP
+  feed that pushes every stamped segment's RAW wire image (the exact
+  seqlock payload ``metadata/stamped.py`` publishes — index snapshot,
+  stream watermarks, placement epoch) to its direct subscribers the
+  moment the origin generation moves, plus liveness heartbeats.
+- Subscribing hosts run a **MetadataMirror**: it republishes received
+  images into LOCAL shm under a fresh seqlock (generation and epoch
+  preserved — ``stamped.ImageStampWriter``), so every reader on that host
+  resolves locations, confirms plan epochs, and polls streamed publishes
+  against a LOCAL replica with zero controller round-trips. The mirror
+  also re-serves the feed to child subscribers: the controller assigns
+  parents over the PR 11 relay-tree shape (root out-degree
+  ``relay.ROOT_FANOUT``), so the index host's metadata egress stays O(1)
+  in subscriber count.
+- Staleness stays LOUD and one-directional: a mirror whose feed went
+  quiet past ``TORCHSTORE_TPU_META_MIRROR_LAG_S`` reports unfresh, and
+  every stamped read on that host falls back to the RPC path with
+  ``reason="mirror_lag"`` until the re-subscription (down-set re-parent
+  through the controller) catches the replica up. A lagging mirror can
+  only UNDER-see progress — never a watermark before its bytes.
+
+tslint rule ``mirror-discipline``: remote code reads mirrored metadata
+ONLY through this module's accessors (``attach_reader``); raw attachment
+of METADATA segments outside ``stamped.py``/``mirror.py`` is forbidden.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Callable, Optional
+
+from torchstore_tpu.logging import get_logger
+from torchstore_tpu.metadata import stamped as stamped_mod
+from torchstore_tpu.metadata.stamped import (  # noqa: F401 - re-exported
+    attach_reader,
+)
+from torchstore_tpu.observability import ledger as obs_ledger
+from torchstore_tpu.observability import metrics as obs_metrics
+from torchstore_tpu.utils import get_hostname, spawn_logged
+
+logger = get_logger("torchstore_tpu.metadata.mirror")
+
+# Wire frame: kind u8, source u32, gen u64, epoch u64, len u64 + payload.
+# Source identity is positional and stable per hello: 0 = coordinator
+# (streams + placement epoch), 1+i = index segment i (shard i, or the
+# unsharded core at i=0).
+_MFRAME = struct.Struct("<BIQQQ")
+KIND_HELLO = 0      # payload: pickled {"sources": [size_or_None, ...]}
+KIND_IMAGE = 1      # payload: the raw stamped wire image
+KIND_HEARTBEAT = 2  # no payload; liveness + lag bound
+
+MIRROR_TRANSPORT = "meta_mirror"
+
+_IMAGES = obs_metrics.counter(
+    "ts_meta_mirror_images_total",
+    "Stamped metadata wire images applied by this host's mirror, by source",
+)
+_IMAGE_BYTES = obs_metrics.counter(
+    "ts_meta_mirror_bytes_total",
+    "Payload bytes of stamped metadata images received by this mirror",
+)
+_RESUBSCRIBES = obs_metrics.counter(
+    "ts_meta_mirror_resubscribes_total",
+    "Mirror feed re-subscriptions (parent death / feed loss), by reason",
+)
+_FRESH = obs_metrics.gauge(
+    "ts_meta_mirror_fresh",
+    "1 while this host's metadata mirror is within its lag bound",
+)
+_SUBSCRIBERS = obs_metrics.gauge(
+    "ts_meta_feed_subscribers",
+    "Direct subscribers currently connected to this process's metadata feed",
+)
+
+
+async def _recv_exact(sock: socket.socket, view: memoryview) -> None:
+    loop = asyncio.get_running_loop()
+    pos = 0
+    total = view.nbytes
+    while pos < total:
+        n = await loop.sock_recv_into(sock, view[pos:])
+        if n == 0:
+            raise ConnectionError("meta feed peer closed mid-frame")
+        pos += n
+
+
+def _close_sock(sock: Optional[socket.socket]) -> None:
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _Subscriber:
+    """One connected feed subscriber: a bounded frame queue + sender task.
+    A consumer that stops draining (wedged child) overflows the queue and
+    is DROPPED — it re-subscribes through the controller rather than
+    back-pressuring the pump into stalling every other subscriber."""
+
+    QUEUE_MAX = 256
+
+    def __init__(self, server: "MetaFeedServer", sock: socket.socket) -> None:
+        self.server = server
+        self.sock = sock
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=self.QUEUE_MAX)
+        self.task: Optional[asyncio.Task] = None
+
+    def offer(self, frame: bytes) -> None:
+        try:
+            self.queue.put_nowait(frame)
+        except asyncio.QueueFull:
+            logger.warning(
+                "meta feed subscriber wedged (queue full); dropping it"
+            )
+            _close_sock(self.sock)
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                frame = await self.queue.get()
+                await loop.sock_sendall(self.sock, frame)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.server._drop_subscriber(self)
+            _close_sock(self.sock)
+
+
+class MetaFeedServer:
+    """Persistent metadata-image feed (root AND mirror re-serve roles).
+
+    Holds the latest wire image per source plus the source-size table; on
+    subscriber connect it replays hello + every current image, then pushes
+    updates/heartbeats as :meth:`update_image`/:meth:`heartbeat` land. The
+    ROOT's pump (``run_pump``) fills it by polling the local stamped
+    segments; a MIRROR fills it by forwarding frames from its parent."""
+
+    def __init__(
+        self,
+        sources_fn: Optional[Callable[[], list]] = None,
+    ) -> None:
+        self._sources_fn = sources_fn
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_task: Optional[asyncio.Task] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._tasks: set = set()
+        self.host: str = "127.0.0.1"
+        self.port: Optional[int] = None
+        self.sizes: list = []
+        self.latest: dict[int, tuple[int, int, bytes]] = {}
+        self._subs: list[_Subscriber] = []
+        # Root-pump attachments: source idx -> (segment name, reader).
+        self._readers: dict[int, tuple[str, Any]] = {}
+
+    # ---- lifecycle -------------------------------------------------------
+
+    async def ensure_started(self, bind_host: Optional[str] = None) -> tuple:
+        if self._listen_sock is None:
+            import os
+
+            bind_host = bind_host or os.environ.get(
+                "TORCHSTORE_TPU_BIND_HOST", "127.0.0.1"
+            )
+            family = (
+                socket.AF_INET6 if ":" in bind_host else socket.AF_INET
+            )
+            sock = socket.socket(family, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((bind_host, 0))
+            sock.listen(32)
+            sock.setblocking(False)
+            self._listen_sock = sock
+            self.port = sock.getsockname()[1]
+            advertise = os.environ.get("TORCHSTORE_TPU_ADVERTISE_HOST")
+            if advertise is None:
+                advertise = (
+                    socket.gethostname()
+                    if bind_host in ("0.0.0.0", "::")
+                    else bind_host
+                )
+            self.host = advertise
+            self._accept_task = asyncio.ensure_future(self._accept_loop())
+            if self._sources_fn is not None:
+                self._pump_task = asyncio.ensure_future(self.run_pump())
+            logger.info(
+                "meta feed bound %s:%s (advertised as %s)",
+                bind_host,
+                self.port,
+                self.host,
+            )
+        return self.host, self.port
+
+    async def _accept_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                conn, _ = await loop.sock_accept(self._listen_sock)
+            except asyncio.CancelledError:
+                raise
+            except OSError as exc:
+                if self._listen_sock is None or self._listen_sock.fileno() < 0:
+                    return
+                logger.warning("meta feed accept failed (%s); retrying", exc)
+                # Same forever-accept contract as the bulk listener: dying
+                # here would strand every future subscriber.
+                await asyncio.sleep(1.0)  # tslint: disable=retry-discipline
+                continue
+            conn.setblocking(False)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            spawn_logged(
+                self._adopt(conn),
+                name="meta_feed.adopt",
+                tasks=self._tasks,
+                log=logger,
+            )
+
+    async def _adopt(self, sock: socket.socket) -> None:
+        from torchstore_tpu.runtime.auth import server_authenticate_sock
+
+        if not await server_authenticate_sock(sock):
+            _close_sock(sock)
+            return
+        sub = _Subscriber(self, sock)
+        # Snapshot replay BEFORE joining the broadcast list: hello + every
+        # current image enqueue first, so the subscriber's view is ordered
+        # (snapshot, then updates) without a pump lock.
+        sub.offer(self._hello_frame())
+        for source in sorted(self.latest):
+            gen, epoch, blob = self.latest[source]
+            sub.offer(_MFRAME.pack(KIND_IMAGE, source, gen, epoch, len(blob)) + blob)
+        self._subs.append(sub)
+        _SUBSCRIBERS.set(len(self._subs))
+        sub.task = asyncio.ensure_future(sub.run())
+        self._tasks.add(sub.task)
+        sub.task.add_done_callback(self._tasks.discard)
+
+    def _drop_subscriber(self, sub: _Subscriber) -> None:
+        if sub in self._subs:
+            self._subs.remove(sub)
+            _SUBSCRIBERS.set(len(self._subs))
+
+    def _hello_frame(self) -> bytes:
+        payload = pickle.dumps({"sources": list(self.sizes)}, protocol=4)
+        return _MFRAME.pack(KIND_HELLO, 0, 0, 0, len(payload)) + payload
+
+    def _broadcast(self, frame: bytes) -> None:
+        for sub in list(self._subs):
+            sub.offer(frame)
+
+    # ---- feed input (pump or parent-forward) -----------------------------
+
+    def set_sizes(self, sizes: list) -> None:
+        """Adopt a new source table (reshard / first hello) and re-hello
+        every subscriber; stale per-source images beyond the new table are
+        dropped."""
+        if sizes == self.sizes:
+            return
+        self.sizes = list(sizes)
+        self.latest = {
+            s: img for s, img in self.latest.items() if s < len(sizes)
+        }
+        self._broadcast(self._hello_frame())
+
+    def update_image(
+        self, source: int, gen: int, epoch: int, blob: bytes
+    ) -> None:
+        prev = self.latest.get(source)
+        if prev is not None and prev[0] >= gen:
+            return
+        self.latest[source] = (gen, epoch, blob)
+        self._broadcast(
+            _MFRAME.pack(KIND_IMAGE, source, gen, epoch, len(blob)) + blob
+        )
+
+    def heartbeat(self) -> None:
+        self._broadcast(_MFRAME.pack(KIND_HEARTBEAT, 0, 0, 0, 0))
+
+    # ---- root pump -------------------------------------------------------
+
+    async def run_pump(self) -> None:
+        """Poll the local stamped segments (header-only when unchanged) and
+        push changed wire images + heartbeats to direct subscribers. Runs
+        in the index host's process; cancellation is shutdown."""
+        interval = stamped_mod.mirror_interval_s()
+        heartbeat_s = stamped_mod.mirror_heartbeat_s()
+        last_beat = 0.0
+        while True:
+            try:
+                self._pump_once()
+            except Exception:  # noqa: BLE001 - the feed is advisory; a bad
+                # tick must never kill the host serving RPCs
+                logger.exception("meta feed pump tick failed")
+            now = time.monotonic()
+            if now - last_beat >= heartbeat_s:
+                self.heartbeat()
+                last_beat = now
+            await asyncio.sleep(interval)
+
+    def _pump_once(self) -> None:
+        descs = list(self._sources_fn() or [])
+        sizes = [d.get("size") if d else None for d in descs]
+        # (Re)attach readers on segment change; detach removed sources.
+        for idx, desc in enumerate(descs):
+            name = desc.get("segment") if desc else None
+            cur = self._readers.get(idx)
+            if cur is not None and cur[0] != name:
+                cur[1].close()
+                self._readers.pop(idx, None)
+                cur = None
+            if cur is None and desc:
+                reader = stamped_mod.attach_reader(desc)
+                if reader is not None:
+                    self._readers[idx] = (name, reader)
+        for idx in [i for i in self._readers if i >= len(descs)]:
+            self._readers.pop(idx)[1].close()
+        self.set_sizes(sizes)
+        for idx, (_, reader) in list(self._readers.items()):
+            gen = reader.generation()
+            if gen is None:
+                continue
+            prev = self.latest.get(idx)
+            if prev is not None and prev[0] >= gen:
+                continue
+            try:
+                gen, epoch, blob = reader.read_image()
+            except stamped_mod.MetaUnavailable:
+                continue  # torn/tombstoned this tick: next tick re-checks
+            self.update_image(idx, gen, epoch, blob)
+
+    def close(self) -> None:
+        for task in (self._accept_task, self._pump_task):
+            if task is not None:
+                task.cancel()
+        for task in list(self._tasks):
+            task.cancel()
+        self._tasks.clear()
+        for sub in list(self._subs):
+            _close_sock(sub.sock)
+        self._subs.clear()
+        _SUBSCRIBERS.set(0)
+        for _, reader in self._readers.values():
+            reader.close()
+        self._readers.clear()
+        _close_sock(self._listen_sock)
+        self._listen_sock = None
+        self.port = None
+
+
+class MetadataMirror:
+    """This host's local replica of the fleet's stamped metadata plane.
+
+    Subscribes through the controller (``meta_subscribe`` assigns a relay
+    parent: the root feed or another host's mirror), republishes received
+    wire images into local shm segments, re-serves the feed to child
+    subscribers, and answers :meth:`fresh` for the router's mirror_lag
+    ladder. One instance per (process, feed root); see :func:`ensure_mirror`.
+    """
+
+    def __init__(self, coordinator: Any, root: tuple[str, int]) -> None:
+        self._coordinator = coordinator
+        self._root = root
+        self._server = MetaFeedServer()  # child re-serve; fed by _receiver
+        self._writers: list[Optional[stamped_mod.ImageStampWriter]] = []
+        self._sizes: list = []
+        self._last_rx = 0.0
+        self._ready = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._tasks: set = set()
+        self._parent_host = ""
+        self._parent_hostname = ""
+        self._closed = False
+
+    # ---- public surface (the sanctioned remote-read accessors) -----------
+
+    def fresh(self) -> bool:
+        """True while the mirrored replica is within its lag bound — the
+        gate every stamped read on this host checks before serving from
+        the mirror (stale -> loud ``mirror_lag`` fallback to RPC)."""
+        ok = (
+            self._ready.is_set()
+            and time.monotonic() - self._last_rx
+            <= stamped_mod.mirror_lag_s()
+        )
+        _FRESH.set(1 if ok else 0)
+        return ok
+
+    def descriptors(self) -> dict:
+        """Stamped-segment descriptors of the LOCAL replica, topology-
+        shaped exactly like ``metadata_topology()["stamped"]`` so the
+        router attaches through the identical path."""
+        descs = [
+            w.describe() if w is not None else None for w in self._writers
+        ]
+        return {
+            "coordinator": descs[0] if descs else None,
+            "index": descs[1:],
+        }
+
+    async def wait_ready(self, timeout: float) -> bool:
+        try:
+            await asyncio.wait_for(self._ready.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    # ---- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        await self._server.ensure_started()
+        self._task = asyncio.ensure_future(self._receiver())
+
+    async def _subscribe(self, down: Optional[list] = None) -> tuple[str, int]:
+        res = await self._coordinator.meta_subscribe.call_one(
+            get_hostname(),
+            self._server.host,
+            self._server.port,
+            down=down or [],
+        )
+        self._parent_hostname = res.get("parent_hostname", "")
+        return res["host"], res["port"]
+
+    async def _receiver(self) -> None:
+        """The subscription loop: connect to the assigned parent, apply
+        frames, and on loss/lag re-subscribe AROUND the dead parent (the
+        controller re-parents using the down set). Runs until close();
+        while disconnected the mirror simply reports unfresh and the RPC
+        path serves — so the loop retries forever, paced by the unified
+        backoff curve."""
+        from torchstore_tpu.config import RetryPolicy
+
+        policy = RetryPolicy.from_env()
+        streak = 0
+        down: list = []
+        while not self._closed:
+            sock = None
+            try:
+                host, port = await self._subscribe(down)
+                sock = await self._connect(host, port)
+                streak = 0
+                down = []
+                await self._consume(sock)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - feed loss heals by
+                # re-parenting; meanwhile RPC serves loudly
+                if self._closed:
+                    return
+                reason = (
+                    "lag" if isinstance(exc, asyncio.TimeoutError) else "conn"
+                )
+                _RESUBSCRIBES.inc(reason=reason)
+                _FRESH.set(0)
+                if self._parent_hostname:
+                    down = [self._parent_hostname]
+                logger.info(
+                    "meta mirror feed lost (%s: %s); re-subscribing around "
+                    "parent %r",
+                    reason,
+                    exc,
+                    self._parent_hostname,
+                )
+                # Forever-retry by design (see docstring): the policy
+                # supplies pacing only, never a deadline.
+                await asyncio.sleep(  # tslint: disable=retry-discipline
+                    policy.backoff(streak)
+                )
+                streak += 1
+            finally:
+                _close_sock(sock)
+
+    async def _connect(self, host: str, port: int) -> socket.socket:
+        from torchstore_tpu.runtime.auth import client_authenticate_sock
+
+        loop = asyncio.get_running_loop()
+        infos = await loop.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+        family, _, _, _, sockaddr = infos[0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            await asyncio.wait_for(loop.sock_connect(sock, sockaddr), 5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            await client_authenticate_sock(sock)
+        except BaseException:
+            _close_sock(sock)
+            raise
+        self._parent_host = host
+        return sock
+
+    async def _consume(self, sock: socket.socket) -> None:
+        header = bytearray(_MFRAME.size)
+        hview = memoryview(header)
+        lag = stamped_mod.mirror_lag_s()
+        while not self._closed:
+            # The parent heartbeats well inside the lag bound: a frame gap
+            # past it IS the parent-death signal (the chaos leg's trigger).
+            await asyncio.wait_for(_recv_exact(sock, hview), timeout=lag)
+            kind, source, gen, epoch, nbytes = _MFRAME.unpack(header)
+            blob = b""
+            if nbytes:
+                buf = bytearray(nbytes)
+                await asyncio.wait_for(
+                    _recv_exact(sock, memoryview(buf)), timeout=lag
+                )
+                blob = bytes(buf)
+            self._last_rx = time.monotonic()
+            if kind == KIND_HEARTBEAT:
+                self._server.heartbeat()
+                continue
+            if kind == KIND_HELLO:
+                cfg = pickle.loads(blob)
+                self._adopt_sizes(cfg.get("sources") or [])
+                # Ready on hello: the image replay follows immediately in
+                # the same snapshot burst, and a reader that races it just
+                # sees never_published -> loud RPC fallback.
+                self._ready.set()
+                continue
+            if kind != KIND_IMAGE or source >= len(self._writers):
+                continue
+            writer = self._writers[source]
+            if writer is None:
+                continue
+            if writer.publish_image(gen, epoch, blob):
+                _IMAGES.inc(source=str(source))
+                _IMAGE_BYTES.inc(len(blob))
+                # Mirror/push cells are REAL host->host edges: the receiver
+                # knows both endpoints, so this single ingress cell carries
+                # the attributable edge (the sender records nothing peer-
+                # aware — count-once, same rule as the data plane).
+                obs_ledger.record(
+                    MIRROR_TRANSPORT,
+                    obs_ledger.INGRESS,
+                    len(blob),
+                    peer_host=self._parent_hostname or self._parent_host,
+                    volume="meta",
+                )
+                self._server.update_image(source, gen, epoch, blob)
+            _FRESH.set(1)
+
+    def _adopt_sizes(self, sizes: list) -> None:
+        """(Re)build the local replica segments for a new source table. A
+        reshaped table (reshard) tombstones the old segments — attached
+        readers fall back loudly and the next topology reload re-attaches."""
+        if sizes == self._sizes and self._writers:
+            return
+        for writer in self._writers:
+            if writer is not None:
+                writer.close()
+        self._writers = [
+            stamped_mod.ImageStampWriter(size) if size else None
+            for size in sizes
+        ]
+        self._sizes = list(sizes)
+        self._server.set_sizes(sizes)
+        self._ready.clear()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+        for task in list(self._tasks):
+            task.cancel()
+        self._server.close()
+        for writer in self._writers:
+            if writer is not None:
+                writer.close()
+        self._writers = []
+        self._ready.clear()
+        _FRESH.set(0)
+
+
+# Per-process mirror registry, keyed by the root feed endpoint: every store
+# handle in a process pointing at the same fleet shares ONE subscription
+# (and one local replica) regardless of how many clients re-load topology.
+_MIRRORS: dict[tuple, MetadataMirror] = {}
+
+
+async def ensure_mirror(
+    coordinator: Any, feed: dict, timeout: float = 2.0
+) -> Optional[MetadataMirror]:
+    """Subscribe this process to the fleet's metadata feed (idempotent) and
+    return the mirror once its first full snapshot landed. Returns None
+    when the snapshot does not arrive within ``timeout`` — the caller
+    stays on the RPC path and the subscription keeps warming in the
+    background for the next topology load."""
+    key = (feed.get("host"), feed.get("port"))
+    if key[0] is None or key[1] is None:
+        return None
+    mirror = _MIRRORS.get(key)
+    if mirror is None or mirror._closed:
+        mirror = MetadataMirror(coordinator, key)
+        _MIRRORS[key] = mirror
+        await mirror.start()
+    if await mirror.wait_ready(timeout):
+        return mirror
+    return None
+
+
+def close_mirrors() -> None:
+    """Tear down every mirror in this process (tests / store shutdown)."""
+    for mirror in list(_MIRRORS.values()):
+        mirror.close()
+    _MIRRORS.clear()

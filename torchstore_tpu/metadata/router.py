@@ -126,6 +126,12 @@ class MetadataRouter:
         # stream/epoch segment.
         self._index_readers: list[Optional[stamped_mod.MetaStampReader]] = []
         self._stream_reader: Optional[stamped_mod.MetaStampReader] = None
+        # Cross-host: when the stamped publishers live on ANOTHER host,
+        # the readers above attach this host's MetadataMirror replica
+        # instead (metadata/mirror.py); every stamped read first checks
+        # mirror.fresh() and falls back loudly (reason="mirror_lag") when
+        # the feed went quiet past its lag bound.
+        self._mirror = None
 
     # -- ActorRef compatibility -------------------------------------------
 
@@ -186,6 +192,7 @@ class MetadataRouter:
         if self._stream_reader is not None:
             self._stream_reader.close()
         self._stream_reader = None
+        self._mirror = None
         if not (meta_stamped and stamped_mod.enabled()):
             return
         from torchstore_tpu.utils import get_hostname
@@ -195,16 +202,51 @@ class MetadataRouter:
         def _attach(desc) -> Optional[stamped_mod.MetaStampReader]:
             if not desc or desc.get("hostname") != local:
                 return None
-            try:
-                return stamped_mod.MetaStampReader(
-                    desc["segment"], desc["size"]
-                )
-            except OSError:
-                return None  # publisher gone / cross-mount: RPC serves
+            # Raw segment attachment stays inside stamped/mirror (tslint
+            # rule mirror-discipline): the accessor absorbs gone/cross-
+            # mount publishers — RPC serves.
+            return stamped_mod.attach_reader(desc)
 
         st = topo.get("stamped") or {}
         self._stream_reader = _attach(st.get("coordinator"))
         self._index_readers = [_attach(d) for d in st.get("index") or []]
+        feed = topo.get("meta_feed")
+        published = bool(
+            st.get("coordinator") or any(st.get("index") or [])
+        )
+        if (
+            feed
+            and published
+            and stamped_mod.mirror_enabled()
+            and self._stream_reader is None
+            and not any(self._index_readers)
+        ):
+            # The publishers are all REMOTE: subscribe this host's mirror
+            # and attach its local replica segments through the same path.
+            from torchstore_tpu.metadata import mirror as mirror_mod
+
+            mirror = await mirror_mod.ensure_mirror(self._coordinator, feed)
+            if mirror is not None:
+                self._mirror = mirror
+                md = mirror.descriptors()
+                self._stream_reader = stamped_mod.attach_reader(
+                    md.get("coordinator")
+                )
+                self._index_readers = [
+                    stamped_mod.attach_reader(d)
+                    for d in md.get("index") or []
+                ]
+
+    def _mirror_stale(self) -> bool:
+        """True when stamped reads are mirror-backed and the mirror fell
+        past its lag bound — every stamped entrypoint then falls back
+        LOUDLY to RPC until the re-subscription catches the replica up."""
+        if self._mirror is None:
+            return False
+        if self._mirror.fresh():
+            return False
+        stamped_mod.STAMPED_FALLBACKS.inc(reason="mirror_lag")
+        return True
 
     def _index_reader(
         self, key: str
@@ -450,6 +492,8 @@ class MetadataRouter:
         fails at the volume and the fetch retries with a fresh RPC locate."""
         if not self._index_readers or not any(self._index_readers):
             return None
+        if self._mirror_stale():
+            return None
         out: dict[str, dict] = {}
         payloads: dict[int, Any] = {}
         n = len(self._index_readers)
@@ -485,6 +529,8 @@ class MetadataRouter:
         or torn (the caller pays the RPC)."""
         if self._stream_reader is None:
             return None
+        if self._mirror_stale():
+            return None
         try:
             return self._stream_reader.epoch()
         except stamped_mod.MetaUnavailable as exc:
@@ -493,12 +539,52 @@ class MetadataRouter:
                 self._stream_reader = None
             return None
 
+    def stamped_write_gens(
+        self, keys: list[str], volume_id: str
+    ) -> Optional[dict[str, int]]:
+        """Committed write generations of ``keys``' replicas on
+        ``volume_id`` from the stamped (possibly mirrored) index — the
+        validation primitive for push-on-publish staging: a pushed layer
+        serves only once the committed index shows its generation on the
+        target volume. Returns None when any key/replica is unresolvable
+        or the segment is unattached/stale — the caller falls back to the
+        doorbell ring (never a silent serve of unvalidated bytes)."""
+        if not self._index_readers or not any(self._index_readers):
+            return None
+        if self._mirror_stale():
+            return None
+        out: dict[str, int] = {}
+        payloads: dict[int, Any] = {}
+        n = len(self._index_readers)
+        for key in keys:
+            idx = shard_of(key, n)
+            reader = self._index_readers[idx]
+            if reader is None:
+                return None
+            if idx not in payloads:
+                try:
+                    _, payload, _ = reader.read()
+                except stamped_mod.MetaUnavailable as exc:
+                    stamped_mod.STAMPED_FALLBACKS.inc(reason=exc.reason)
+                    if exc.reason in ("tombstone", "gone"):
+                        self._index_readers[idx] = None
+                    return None
+                payloads[idx] = payload
+            infos = payloads[idx].get(key)
+            info = infos.get(volume_id) if infos else None
+            if info is None:
+                return None
+            out[key] = int(getattr(info, "write_gen", 0) or 0)
+        count_stamped("write_gens")
+        return out
+
     async def stamped_wait_stream(
         self,
         key: str,
         version: int,
         known: int = 0,
         timeout: Optional[float] = None,
+        volume_id: Optional[str] = None,
     ) -> Optional[dict]:
         """One-sided ``wait_for_stream``: poll the coordinator's stamped
         stream snapshot until progress (same view shape and timeout
@@ -510,6 +596,8 @@ class MetadataRouter:
         short grace window before reporting missing."""
         reader = self._stream_reader
         if reader is None:
+            return None
+        if self._mirror_stale():
             return None
         version = int(version)
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -530,6 +618,12 @@ class MetadataRouter:
         sleep_s = 0.001
         served_once = False
         while True:
+            # Re-checked EVERY poll: a mirror parent dying mid-stream must
+            # flip this long-poll to the RPC path at the lag bound, not at
+            # the next acquire (the chaos-leg guarantee — a quiet replica
+            # can only under-see, and past the bound we stop trusting it).
+            if self._mirror_stale():
+                return None
             try:
                 gen, payload, _ = reader.read()
             except stamped_mod.MetaUnavailable as exc:
@@ -560,7 +654,7 @@ class MetadataRouter:
             else:
                 if known < 0:
                     served_once = True
-                view = self._stream_view(rec, version)
+                view = self._stream_view(rec, version, volume_id)
                 if (
                     served_once
                     or len(view["ready"]) > known
@@ -579,14 +673,39 @@ class MetadataRouter:
             sleep_s = min(0.02, sleep_s * 1.6)
 
     @staticmethod
-    def _stream_view(rec: dict, version: int) -> dict:
+    def _stream_view(
+        rec: dict, version: int, volume_id: Optional[str] = None
+    ) -> dict:
         marks = rec.get("watermarks") or {}
         ready = {k: v for k, v in marks.items() if v >= version}
+        sealed = rec["sealed"] >= version
+        # Relay gate, the EXACT wait_for_stream formula over the published
+        # gate picture: a gate-eligible volume only sees a forwarded key
+        # once its relay copy landed (so the acquire reads it locally
+        # instead of pulling cross-host from the origin). A volume absent
+        # from the snapshot's landed table polls ungated — the controller
+        # already applied the membership/quarantine fail-safe when it
+        # published the view.
+        relay = rec.get("relay")
+        if (
+            volume_id is not None
+            and relay is not None
+            and volume_id in relay["landed"]
+        ):
+            forwarded = set(relay["forwarded"])
+            landed = set(relay["landed"][volume_id])
+            local = {
+                k: v
+                for k, v in ready.items()
+                if k not in forwarded or k in landed
+            }
+            sealed = sealed and len(local) == len(ready)
+            ready = local
         rec_aliases = rec.get("aliases") or {}
         return {
             "missing": False,
             "version": rec["version"],
-            "sealed": rec["sealed"] >= version,
+            "sealed": sealed,
             "superseded": rec["version"] > version,
             "ready": sorted(ready),
             "watermarks": ready,

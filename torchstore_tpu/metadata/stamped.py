@@ -56,6 +56,13 @@ TOMBSTONE = (1 << 63) - 1
 ENV_META_STAMPED = "TORCHSTORE_TPU_META_STAMPED"
 ENV_META_PUBLISH_MS = "TORCHSTORE_TPU_META_PUBLISH_MS"
 ENV_META_SEGMENT_BYTES = "TORCHSTORE_TPU_META_SEGMENT_BYTES"
+# Cross-host metadata mirror (metadata/mirror.py): remote clients subscribe
+# to the index host's feed and republish received wire images into LOCAL
+# shm, so the one-sided warm paths work across the host boundary too.
+ENV_META_MIRROR = "TORCHSTORE_TPU_META_MIRROR"
+ENV_META_MIRROR_INTERVAL_MS = "TORCHSTORE_TPU_META_MIRROR_INTERVAL_MS"
+ENV_META_MIRROR_HEARTBEAT_S = "TORCHSTORE_TPU_META_MIRROR_HEARTBEAT_S"
+ENV_META_MIRROR_LAG_S = "TORCHSTORE_TPU_META_MIRROR_LAG_S"
 
 STAMPED_READS = obs_metrics.counter(
     "ts_meta_stamped_total",
@@ -94,6 +101,42 @@ def segment_bytes() -> int:
         return max(64 << 10, int(os.environ.get(ENV_META_SEGMENT_BYTES, 8 << 20)))
     except ValueError:
         return 8 << 20
+
+
+def mirror_enabled() -> bool:
+    return os.environ.get(ENV_META_MIRROR, "1").strip().lower() not in (
+        "0", "false", "no", "off", "",
+    )
+
+
+def mirror_interval_s() -> float:
+    try:
+        return max(
+            0.001,
+            float(os.environ.get(ENV_META_MIRROR_INTERVAL_MS, "20")) / 1e3,
+        )
+    except ValueError:
+        return 0.02
+
+
+def mirror_heartbeat_s() -> float:
+    try:
+        return max(
+            0.02, float(os.environ.get(ENV_META_MIRROR_HEARTBEAT_S, "0.2"))
+        )
+    except ValueError:
+        return 0.2
+
+
+def mirror_lag_s() -> float:
+    """Staleness bound on a mirror replica: reads older than this fall back
+    to the RPC path with ``reason="mirror_lag"`` (loud, never silent)."""
+    try:
+        return max(
+            0.1, float(os.environ.get(ENV_META_MIRROR_LAG_S, "1.5"))
+        )
+    except ValueError:
+        return 1.5
 
 
 class MetaUnavailable(Exception):
@@ -335,6 +378,34 @@ class MetaStampReader:
                 return epoch
         raise MetaUnavailable("torn")
 
+    def read_image(self) -> tuple[int, int, bytes]:
+        """Seqlock-consistent RAW snapshot ``(generation, epoch, payload
+        bytes)`` of the newest stable publish — NO unpickle. This is the
+        wire image the cross-host metadata feed ships: the mirror republishes
+        the exact bytes under its own local seqlock, preserving gen/epoch, so
+        a remote reader's decode path is byte-identical to a same-host one.
+        Raises MetaUnavailable exactly like :meth:`read`."""
+        if self._dead:
+            raise MetaUnavailable("gone")
+        words = self.words
+        for _ in range(self.MAX_TORN_RETRIES):
+            s1 = int(words[0])
+            if s1 & 1:
+                continue
+            gen = int(words[1])
+            ln = int(words[2])
+            epoch = int(words[3])
+            if ln == TOMBSTONE:
+                self._dead = True
+                raise MetaUnavailable("tombstone")
+            if gen == 0:
+                raise MetaUnavailable("never_published")
+            blob = bytes(self.seg.mmap[HEADER_BYTES : HEADER_BYTES + ln])
+            if int(words[0]) != s1:
+                continue  # torn: a publish raced the copy
+            return gen, epoch, blob
+        raise MetaUnavailable("torn")
+
     def generation(self) -> Optional[int]:
         """Header-only publish generation (None while torn/unpublished) —
         the cheap "anything new?" probe the stream poll loop spins on."""
@@ -363,3 +434,90 @@ class MetaStampReader:
         self._cached = None
         self._cached_gen = None
         self.words = None
+
+
+def attach_reader(desc: Optional[dict]) -> Optional[MetaStampReader]:
+    """THE sanctioned way to attach a reader to a METADATA segment outside
+    this module (tslint rule ``mirror-discipline``: raw ``MetaStampReader``
+    construction is confined to ``stamped.py``/``mirror.py`` so every
+    consumer inherits the same descriptor validation and the mirror's
+    accessors stay the single remote-read surface). Returns None for an
+    empty descriptor or an unmappable segment (publisher gone / cross-mount
+    attach): the caller stands down to the RPC path."""
+    if not desc or not desc.get("segment"):
+        return None
+    try:
+        return MetaStampReader(desc["segment"], desc["size"])
+    except (OSError, KeyError):
+        return None
+
+
+class ImageStampWriter:
+    """Seqlock republisher of received WIRE IMAGES (metadata/mirror.py's
+    local replica segments): writes the exact payload bytes the origin
+    published, preserving its generation and epoch words, under a local
+    seqlock bracket — readers attached to the mirror segment run the
+    identical torn/stale ladder they run against the origin. Monotonicity
+    is inherited: the feed delivers images in publish order per source, and
+    ``publish_image`` drops regressions defensively."""
+
+    def __init__(self, size: Optional[int] = None) -> None:
+        from torchstore_tpu.transport.shared_memory import ShmSegment
+
+        self.size = size or segment_bytes()
+        self.seg = ShmSegment.create(self.size, count=False)
+        self.words = np.frombuffer(self.seg.mmap, dtype=np.uint64, count=4)
+        self._gen = 0
+        self._dead = False
+
+    def describe(self) -> dict:
+        from torchstore_tpu.utils import get_hostname
+
+        return {
+            "segment": self.seg.name,
+            "size": self.size,
+            "hostname": get_hostname(),
+        }
+
+    def publish_image(self, gen: int, epoch: int, blob: bytes) -> bool:
+        """One bracketed republish of a received image; returns False when
+        the image was dropped (stale generation / outgrown segment)."""
+        if self._dead:
+            return False
+        if gen <= self._gen:
+            return False  # reordered/duplicate image: keep the newer view
+        if len(blob) > self.size - HEADER_BYTES:
+            # The origin's segment grew past ours (operator raised
+            # TORCHSTORE_TPU_META_SEGMENT_BYTES mid-fleet): tombstone so
+            # readers fall back loudly instead of serving a truncated view.
+            self._tombstone()
+            return False
+        seq = int(self.words[0]) + 1
+        self.words[0] = seq
+        try:
+            self.seg.mmap[HEADER_BYTES : HEADER_BYTES + len(blob)] = blob
+            self.words[1] = gen
+            self.words[2] = len(blob)
+            self.words[3] = int(epoch)
+            self._gen = gen
+        except BaseException:
+            self.words[2] = TOMBSTONE
+            self._dead = True
+            raise
+        finally:
+            self.words[0] = seq + 1
+        return True
+
+    def _tombstone(self) -> None:
+        seq = int(self.words[0]) + 1
+        self.words[0] = seq
+        try:
+            self.words[2] = TOMBSTONE
+        finally:
+            self.words[0] = seq + 1
+        self._dead = True
+
+    def close(self) -> None:
+        if not self._dead:
+            self._tombstone()
+        self.seg.unlink()

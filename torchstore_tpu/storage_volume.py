@@ -352,6 +352,15 @@ class StorageVolume(Actor):
 
         self.ctx.get_cache(BulkServerCache).server.doorbell_volume = self
 
+    def _notify_push(self, gens: dict[str, int]) -> None:
+        """Freshly committed write generations: kick the bulk server's
+        push-on-publish pump so subscribed plans stream to their clients
+        AT WATERMARK TIME (transport/bulk.py) instead of waiting for the
+        next doorbell ring."""
+        from torchstore_tpu.transport.bulk import BulkServerCache
+
+        self.ctx.get_cache(BulkServerCache).server.notify_landed(gens)
+
     @endpoint
     async def get_id(self) -> dict:
         return {
@@ -876,10 +885,9 @@ class StorageVolume(Actor):
         obs_recorder.record(
             "volume_op", "put", keys=len(metas), nbytes=nbytes
         )
-        return {
-            "reply": buffer.put_reply(),
-            "write_gens": self._bump_write_gens(metas),
-        }
+        gens = self._bump_write_gens(metas)
+        self._notify_push(gens)
+        return {"reply": buffer.put_reply(), "write_gens": gens}
 
     @endpoint
     async def get(
@@ -1139,7 +1147,9 @@ class StorageVolume(Actor):
             self._end_landing(pairs)
         self._apply_residency_delta(affected, before)
         self._tier_after_put(affected)
-        return {"write_gens": self._bump_write_gens(metas)}
+        gens = self._bump_write_gens(metas)
+        self._notify_push(gens)
+        return {"write_gens": gens}
 
     # ---- fault injection (test/chaos control plane) ----------------------
 

@@ -122,17 +122,33 @@ IDX_PACKED = 0xFFFFFFFB
 # or answers with an IDX_DOORBELL miss frame carrying a 1-byte reason —
 # the client then falls back loudly to the RPC path.
 IDX_DOORBELL = 0xFFFFFFFA
+# Push-on-publish subscription: an 8-byte plan id on a HELLO'd connection
+# registers a PERSISTENT per-(client, volume) push session for that doorbell
+# plan — the volume then streams the plan proactively every time its keys
+# are freshly watermarked, instead of waiting for the next ring.
+IDX_PUSH_SUB = 0xFFFFFFF9
+# Proactive push frame: the session field carries the PLAN id; the payload
+# is a u32 member count + per-member u64 write generations (pack-time, in
+# plan order) + the packed arena bytes. The client stages it and the next
+# acquire validates the generations against the MIRRORED watermark before
+# serving — first byte becomes a local memcpy.
+IDX_PUSHED = 0xFFFFFFF8
 _CONTROL_IDXS = frozenset({IDX_HELLO, IDX_ABORT, IDX_SESSION_OPEN, IDX_STRIPED})
 
 _U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
 
 # Doorbell miss reasons (1-byte reply payload -> fallback metric label).
+# evicted_plan vs unknown_plan is the silent-eviction fix: a ring that
+# misses because DOORBELL_PLANS_MAX cycled the table is attributable
+# (ts_doorbell_plans_evicted_total moved), not a mystery cold start.
 _DOORBELL_MISS = {
     0: "unknown_plan",
     1: "missing_key",
     2: "meta_drift",
     3: "torn",
     4: "busy",
+    5: "evicted_plan",
 }
 
 # Server-side cached get plans awaiting doorbells; wholesale clear on
@@ -162,6 +178,57 @@ _DOORBELL_PLANS = obs_metrics.gauge(
     "ts_doorbell_plans_resident",
     "One-sided doorbell get plans resident in this bulk server",
 )
+_DOORBELL_EVICTED = obs_metrics.counter(
+    "ts_doorbell_plans_evicted_total",
+    "Doorbell plans dropped by DOORBELL_PLANS_MAX table cycling",
+)
+_PUSH_SUBS = obs_metrics.gauge(
+    "ts_push_sessions_resident",
+    "Push-on-publish plan subscriptions resident in this bulk server",
+)
+_PUSH_FRAMES = obs_metrics.counter(
+    "ts_push_frames_total",
+    "Push-on-publish frames streamed by this bulk server, by outcome",
+)
+_PUSH_SERVES = obs_metrics.counter(
+    "ts_push_serves_total",
+    "Warm gets served from push-staged bytes (first byte = local memcpy)",
+)
+_PUSH_STAGED_BYTES = obs_metrics.gauge(
+    "ts_push_staged_bytes",
+    "Bytes currently resident in this client's push staging arenas",
+)
+
+
+def push_sessions_enabled() -> bool:
+    """Push-on-publish bulk sessions (TORCHSTORE_TPU_PUSH_SESSIONS,
+    default on): freshly-watermarked doorbell plans stream to subscribed
+    clients proactively; off = pull-on-acquire doorbell rings only."""
+    import os
+
+    return os.environ.get(
+        "TORCHSTORE_TPU_PUSH_SESSIONS", "1"
+    ).strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def push_staging_max_bytes() -> int:
+    """Per-client cap on push-staged bytes
+    (TORCHSTORE_TPU_PUSH_STAGING_MAX_BYTES, default 1 GiB): staging past it
+    evicts oldest-first — an evicted plan's next acquire falls back to the
+    doorbell ring, never OOMs the trainer host."""
+    import os
+
+    try:
+        return max(
+            1 << 20,
+            int(
+                os.environ.get(
+                    "TORCHSTORE_TPU_PUSH_STAGING_MAX_BYTES", 1 << 30
+                )
+            ),
+        )
+    except ValueError:
+        return 1 << 30
 
 # Volume-side session state (landed put bytes, abort markers) is purged after
 # this long without the matching RPC arriving — a crashed client must not
@@ -324,6 +391,19 @@ class BulkServer:
         # (plan_id -> {"metas": [Request], "serve_metas": [TensorMeta]}).
         self.doorbell_volume: Optional[Any] = None
         self.get_plans: dict[int, dict] = {}
+        # Plan ids dropped by DOORBELL_PLANS_MAX cycling (insertion-ordered,
+        # bounded): a ring that lands here misses as "evicted_plan", not
+        # "unknown_plan" — eviction churn is attributable, never silent.
+        self.evicted_plans: dict[int, None] = {}
+        # Push-on-publish sessions: plan_id -> subscribed client id, the
+        # reverse key index driving dirty marking, pack-time write gens per
+        # key, and the pump that streams dirty plans at watermark time.
+        self.push_subs: dict[int, int] = {}
+        self._push_keys: dict[str, set[int]] = {}
+        self._push_key_gens: dict[str, int] = {}
+        self._push_dirty: set[int] = set()
+        self._push_event = asyncio.Event()
+        self._push_task: Optional[asyncio.Task] = None
 
     async def ensure_started(self, bind_host: str) -> tuple[str, int]:
         if self._listen_sock is None:
@@ -433,6 +513,17 @@ class BulkServer:
                         name="bulk.doorbell",
                         tasks=self._send_tasks.setdefault(sock, set()),
                         log=logger,
+                    )
+                    continue
+                if idx == IDX_PUSH_SUB:
+                    payload = bytearray(nbytes)
+                    await _recv_exact(sock, memoryview(payload))
+                    (plan_id,) = _U64.unpack(payload[:8])
+                    # The session field doubles as the client id so a
+                    # subscription can ride a connection whose HELLO raced
+                    # this frame; pushes go to client_conns[client_id].
+                    self.subscribe_push(
+                        plan_id, client_id if client_id is not None else session
                     )
                     continue
                 if idx == IDX_ABORT:
@@ -630,7 +721,17 @@ class BulkServer:
         """Cache a served get batch as a doorbell plan; returns the plan id
         the client rings to repeat the batch without the get RPC."""
         if len(self.get_plans) >= DOORBELL_PLANS_MAX:
+            evicted = list(self.get_plans)
             self.get_plans.clear()
+            _DOORBELL_EVICTED.inc(len(evicted))
+            for pid in evicted:
+                # Remember WHO was cycled out (bounded, oldest dropped
+                # first) so the victim's next ring misses attributably;
+                # its push session dies with the plan.
+                self.evicted_plans[pid] = None
+                self._drop_push_sub(pid)
+            while len(self.evicted_plans) > 4 * DOORBELL_PLANS_MAX:
+                self.evicted_plans.pop(next(iter(self.evicted_plans)))
         plan_id = _new_id()
         self.get_plans[plan_id] = {
             "metas": list(metas),
@@ -638,6 +739,184 @@ class BulkServer:
         }
         _DOORBELL_PLANS.set(len(self.get_plans))
         return plan_id
+
+    # ---- push-on-publish sessions ----------------------------------------
+
+    def subscribe_push(self, plan_id: int, client_id: int) -> bool:
+        """Register a persistent push session for a registered plan: every
+        future watermark landing on the plan's keys streams the whole plan
+        to ``client_id``'s HELLO connection proactively. Unknown plans are
+        refused silently — the client's acquire just keeps ringing."""
+        if not push_sessions_enabled():
+            return False
+        plan = self.get_plans.get(plan_id)
+        if plan is None:
+            return False
+        self.push_subs[plan_id] = client_id
+        for meta in plan["metas"]:
+            self._push_keys.setdefault(meta.key, set()).add(plan_id)
+        _PUSH_SUBS.set(len(self.push_subs))
+        return True
+
+    def _drop_push_sub(self, plan_id: int) -> None:
+        if self.push_subs.pop(plan_id, None) is None:
+            return
+        for key in [k for k, p in self._push_keys.items() if plan_id in p]:
+            pids = self._push_keys[key]
+            pids.discard(plan_id)
+            if not pids:
+                del self._push_keys[key]
+        self._push_dirty.discard(plan_id)
+        _PUSH_SUBS.set(len(self.push_subs))
+
+    def notify_landed(self, gens: dict[str, int]) -> None:
+        """The volume just committed a put/pull batch (write gens bumped):
+        mark every subscribed plan touching those keys dirty and kick the
+        pump. Called synchronously from the volume's endpoint — must stay
+        O(touched plans), no IO."""
+        if gens:
+            for key, gen in gens.items():
+                prev = self._push_key_gens.get(key, 0)
+                if gen > prev:
+                    self._push_key_gens[key] = gen
+        if not self.push_subs or not gens:
+            return
+        dirty = False
+        for key in gens:
+            for pid in self._push_keys.get(key, ()):
+                self._push_dirty.add(pid)
+                dirty = True
+        if dirty:
+            self._push_event.set()
+            if self._push_task is None or self._push_task.done():
+                self._push_task = spawn_logged(
+                    self._push_pump(),
+                    name="bulk.push_pump",
+                    tasks=self._conn_tasks,
+                    log=logger,
+                )
+
+    async def _push_pump(self) -> None:
+        """Drain dirty plans into IDX_PUSHED frames until the set stays
+        empty past an idle window (re-spawned by the next notify). One
+        serial pump: pushes for one client never interleave frames, and a
+        burst of landings coalesces into one push per plan."""
+        idle_s = 5.0
+        while True:
+            self._push_event.clear()
+            dirty = list(self._push_dirty)
+            self._push_dirty.clear()
+            if not dirty:
+                try:
+                    await asyncio.wait_for(self._push_event.wait(), idle_s)
+                except asyncio.TimeoutError:
+                    if not self._push_dirty:
+                        return
+                continue
+            for plan_id in dirty:
+                try:
+                    await self._serve_push(plan_id)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 - push is an optimization;
+                    # a failed push must never kill the pump (the client's
+                    # doorbell ring still serves)
+                    logger.exception("push serve failed (plan=%s)", plan_id)
+
+    async def _serve_push(self, plan_id: int) -> None:
+        """Pack one dirty plan (same landing-stamp bracket as the doorbell
+        serve) and stream it to the subscribed client with its pack-time
+        write generations. Any impossibility DROPS the subscription — the
+        client's next acquire falls back loudly to the ring/RPC ladder."""
+        from torchstore_tpu.transport import landing
+
+        vol = self.doorbell_volume
+        plan = self.get_plans.get(plan_id)
+        client_id = self.push_subs.get(plan_id)
+        if client_id is None:
+            return
+        if vol is None or plan is None:
+            self._drop_push_sub(plan_id)
+            return
+        conn = self.client_conns.get(client_id)
+        if conn is None:
+            # The client's HELLO connection is gone (crashed/reset): a
+            # push session without a live lane is dead, loudly.
+            self._drop_push_sub(plan_id)
+            _PUSH_FRAMES.inc(outcome="dead_conn")
+            return
+        stamp0 = vol._landing_stamp
+        if vol._landing_inflight:
+            # Mid-landing: that landing's own notify re-dirties this plan
+            # only if it touches our keys, so re-dirty explicitly and let
+            # the pump retry after yielding.
+            self._push_dirty.add(plan_id)
+            self._push_event.set()
+            await asyncio.sleep(0.001)
+            return
+        arrays: list[np.ndarray] = []
+        keys: list[str] = []
+        try:
+            for meta, expect in zip(plan["metas"], plan["serve_metas"]):
+                arr = np.ascontiguousarray(vol.store.get_data(meta))
+                if TensorMeta.of(arr) != expect:
+                    # Shape/dtype drift: the client's staged unpack layout
+                    # is wrong now; the doorbell ring re-plans.
+                    self._drop_push_sub(plan_id)
+                    _PUSH_FRAMES.inc(outcome="meta_drift")
+                    return
+                arrays.append(arr)
+                keys.append(meta.key)
+        except KeyError:
+            self._drop_push_sub(plan_id)
+            _PUSH_FRAMES.inc(outcome="missing_key")
+            return
+        offsets, total = landing.compute_arena_layout(
+            [a.nbytes for a in arrays]
+        )
+        packed = np.empty(total, np.uint8)
+        pairs = [
+            (
+                packed[off : off + a.nbytes],
+                np.frombuffer(a, dtype=np.uint8),
+            )
+            for a, off in zip(arrays, offsets)
+            if a.nbytes
+        ]
+        await landing.land_async(pairs, stage="push")
+        if vol._landing_inflight or vol._landing_stamp != stamp0:
+            # A landing raced the pack: the arena may mix generations.
+            # Never ship it — re-dirty and let the pump retry clean.
+            self._push_dirty.add(plan_id)
+            self._push_event.set()
+            _PUSH_FRAMES.inc(outcome="torn_retry")
+            return
+        gens = [self._push_key_gens.get(k, 0) for k in keys]
+        sub = _U32.pack(len(keys)) + b"".join(_U64.pack(g) for g in gens)
+        view = memoryview(packed).cast("B")
+        # Volume-side egress accounting, peer-less like the doorbell serve:
+        # the RECEIVER's staging cell carries the attributable host->host
+        # edge (count-once rule), this keeps the volume's own totals honest.
+        if obs_ledger.ledger().enabled:
+            obs_ledger.record(
+                "bulk_push",
+                obs_ledger.EGRESS,
+                view.nbytes,
+                volume=str(getattr(vol, "volume_id", "")),
+                items=[
+                    (k, expect.nbytes)
+                    for k, expect in zip(keys, plan["serve_metas"])
+                ],
+            )
+        try:
+            async with conn[1]:
+                await _send_frame_raw(
+                    conn[0], plan_id, IDX_PUSHED, sub, view
+                )
+            _PUSH_FRAMES.inc(outcome="sent")
+        except (ConnectionError, OSError):
+            self._drop_push_sub(plan_id)
+            _PUSH_FRAMES.inc(outcome="dead_conn")
 
     async def _serve_doorbell(
         self,
@@ -670,7 +949,9 @@ class BulkServer:
         vol = self.doorbell_volume
         plan = self.get_plans.get(plan_id)
         if vol is None or plan is None:
-            return await miss(0)
+            return await miss(
+                5 if plan is None and plan_id in self.evicted_plans else 0
+            )
         stamp0 = vol._landing_stamp
         if vol._landing_inflight:
             return await miss(4)  # a landing is mid-flight right now
@@ -816,6 +1097,10 @@ class BulkClientConn:
         self.write_lock = asyncio.Lock()
         self.closed = False
         self.sessions: dict[int, _SessionEntry] = {}
+        # Push-on-publish sink: set by the cache when a push session rides
+        # this connection; receives (plan_id, raw_frame_bytes) for every
+        # IDX_PUSHED frame (session field = plan id, not a get session).
+        self.push_sink = None
         self._reader_task = asyncio.ensure_future(self._demux())
 
     async def _demux(self) -> None:
@@ -826,6 +1111,14 @@ class BulkClientConn:
             while True:
                 await _recv_exact(self.sock, header_view)
                 session, idx, nbytes = _FRAME.unpack(header)
+                if idx == IDX_PUSHED:
+                    buf = bytearray(nbytes)
+                    if nbytes:
+                        await _recv_exact(self.sock, memoryview(buf))
+                    sink = self.push_sink
+                    if sink is not None:
+                        sink(session, buf)
+                    continue
                 entry = self.sessions.get(session)
                 if idx == IDX_STRIPED:
                     await _recv_exact(self.sock, memoryview(sub))
@@ -942,14 +1235,89 @@ class BulkClientCache(TransportCache):
         # from plan-annotated get replies. Dropped wholesale on placement-
         # epoch bumps (the client owns that) and per-plan on any miss.
         self.doorbells: dict[tuple, dict] = {}
+        # Push-on-publish staging: plan_id -> {"gens": [u64...], "data":
+        # bytearray (packed arena), "volume_id", "hostname"} — the freshest
+        # pushed copy of each subscribed plan, insertion-ordered for
+        # oldest-first eviction at push_staging_max_bytes(). Serving is
+        # gated on the mirrored watermark (stamped_write_gens): staged gens
+        # must be at least the committed index's — never a stale serve.
+        self.push_staging: dict[int, dict] = {}
+        self.push_staged_bytes = 0
+        self.push_subscribed: set[int] = set()
+        # Wired by the client at volume load: (keys, volume_id) ->
+        # {key: committed write gen} off the stamped/mirrored index, or
+        # None when unattached/stale (push then misses "unvalidated").
+        self.push_validate = None
 
     DOORBELLS_MAX = 4096
 
+    def stage_push(
+        self, plan_id: int, raw: bytearray, volume_id: str, hostname: str
+    ) -> None:
+        """Adopt one IDX_PUSHED frame: parse the gen table, replace any
+        older staged copy, evict oldest-first past the staging cap, and
+        record the receiver-side ingress cell (the count-once host->host
+        edge — the volume's egress cell is peer-less)."""
+        if len(raw) < _U32.size:
+            return
+        (nk,) = _U32.unpack_from(raw, 0)
+        need = _U32.size + _U64.size * nk
+        if len(raw) < need:
+            return
+        gens = list(struct.unpack_from(f"<{nk}Q", raw, _U32.size))
+        data = bytes(memoryview(raw)[need:])
+        prev = self.push_staging.pop(plan_id, None)
+        if prev is not None:
+            self.push_staged_bytes -= len(prev["data"])
+        cap = push_staging_max_bytes()
+        if len(data) > cap:
+            _PUSH_STAGED_BYTES.set(self.push_staged_bytes)
+            return  # a single over-cap plan never stages
+        while self.push_staged_bytes + len(data) > cap and self.push_staging:
+            victim = self.push_staging.pop(next(iter(self.push_staging)))
+            self.push_staged_bytes -= len(victim["data"])
+        self.push_staging[plan_id] = {
+            "gens": gens,
+            "data": data,
+            "volume_id": volume_id,
+            "hostname": hostname,
+        }
+        self.push_staged_bytes += len(data)
+        _PUSH_STAGED_BYTES.set(self.push_staged_bytes)
+        if obs_ledger.ledger().enabled:
+            obs_ledger.record(
+                "bulk_push",
+                obs_ledger.INGRESS,
+                len(data),
+                peer_host=hostname or "",
+                volume=volume_id,
+            )
+
+    def push_sink_for(self, volume):
+        vid = volume.volume_id
+        hostname = getattr(volume, "hostname", "") or ""
+
+        def _sink(plan_id: int, raw: bytearray) -> None:
+            self.stage_push(plan_id, raw, vid, hostname)
+
+        return _sink
+
+    def drop_staged(self, plan_id: int) -> None:
+        prev = self.push_staging.pop(plan_id, None)
+        if prev is not None:
+            self.push_staged_bytes -= len(prev["data"])
+            _PUSH_STAGED_BYTES.set(self.push_staged_bytes)
+        self.push_subscribed.discard(plan_id)
+
     def drop_one_sided(self) -> int:
-        """Drop every cached doorbell plan (placement-epoch bump: the
-        placement the plans describe changed)."""
+        """Drop every cached doorbell plan AND push-staged arena
+        (placement-epoch bump: the placement they describe changed)."""
         n = len(self.doorbells)
         self.doorbells.clear()
+        self.push_staging.clear()
+        self.push_staged_bytes = 0
+        self.push_subscribed.clear()
+        _PUSH_STAGED_BYTES.set(0)
         return n
 
     def get_alive(self, volume_id: str) -> Optional[BulkClientConn]:
@@ -984,6 +1352,7 @@ class BulkClientCache(TransportCache):
 
     def delete_key(self, key: str) -> None:
         for dkey in [d for d in self.doorbells if any(k == key for k, _ in d[1])]:
+            self.drop_staged(self.doorbells[dkey].get("plan_id"))
             del self.doorbells[dkey]
 
     def clear(self) -> None:
@@ -996,6 +1365,10 @@ class BulkClientCache(TransportCache):
         self.stripe_conns.clear()
         self.endpoints.clear()
         self.doorbells.clear()
+        self.push_staging.clear()
+        self.push_staged_bytes = 0
+        self.push_subscribed.clear()
+        _PUSH_STAGED_BYTES.set(0)
 
 
 async def prewarm_connection(
@@ -1166,6 +1539,29 @@ class BulkTransportBuffer(TransportBuffer):
             dkey = self._doorbell_key(volume, requests)
             entry = cache.doorbells.get(dkey) if dkey is not None else None
             if entry is not None:
+                staged = (
+                    cache.push_staging.get(entry["plan_id"])
+                    if push_sessions_enabled()
+                    else None
+                )
+                if staged is not None:
+                    try:
+                        # Push-on-publish fast path: the plan's freshest
+                        # bytes were streamed at watermark time — validate
+                        # against the mirrored committed gens and serve
+                        # with a LOCAL memcpy, no wire wait at all.
+                        return await self._get_via_push(
+                            volume, requests, entry, staged, cache
+                        )
+                    except OneSidedMiss as miss:
+                        # Stale/unvalidatable staging: drop it and fall
+                        # THROUGH to the doorbell ring (same plan), which
+                        # serves a fresh consistent snapshot or escalates
+                        # to the RPC ladder itself.
+                        cache.drop_staged(entry["plan_id"])
+                        ONE_SIDED_FALLBACKS.inc(
+                            reason=f"push_{miss.reason}"
+                        )
                 try:
                     return await self._get_via_doorbell(volume, requests, entry)
                 except OneSidedMiss as miss:
@@ -1261,6 +1657,67 @@ class BulkTransportBuffer(TransportBuffer):
                     f"bulk session-open handshake failed (got frame {ack_idx})"
                 )
         return await super().get_from_storage_volume(volume, requests)
+
+    async def _get_via_push(
+        self, volume, requests: list[Request], entry: dict, staged: dict,
+        cache: "BulkClientCache",
+    ) -> list[Any]:
+        """Serve a warm get from the push-staged arena: the bytes already
+        crossed the wire at watermark time, so the reader's first byte is
+        a LOCAL memcpy. Correctness gate: the staged pack-time write gens
+        must be at least the COMMITTED gens the (possibly mirrored)
+        stamped index holds for every member on this volume — a staging
+        that missed a newer landing, or an unattached/lagging index, is a
+        loud :class:`OneSidedMiss` and the doorbell ring serves instead.
+        Never serves unvalidated bytes."""
+        from torchstore_tpu.transport import landing
+        from torchstore_tpu.transport.shared_memory import (
+            ONE_SIDED_READS,
+            OneSidedMiss,
+        )
+
+        if staged.get("volume_id") != volume.volume_id:
+            raise OneSidedMiss("wrong_volume")
+        gens = staged["gens"]
+        data = staged["data"]
+        if len(gens) != len(requests) or len(data) != int(entry["total"]):
+            raise OneSidedMiss("layout")
+        validate = cache.push_validate
+        committed = (
+            validate([r.key for r in requests], volume.volume_id)
+            if validate is not None
+            else None
+        )
+        if committed is None:
+            raise OneSidedMiss("unvalidated")
+        for req, gen in zip(requests, gens):
+            if gen < committed.get(req.key, 0):
+                raise OneSidedMiss("stale")
+        results: list[Any] = []
+        pairs: list[tuple[np.ndarray, np.ndarray]] = []
+        for req, meta, off in zip(requests, entry["metas"], entry["offsets"]):
+            count = int(np.prod(meta.shape)) if meta.shape else 1
+            arr = np.frombuffer(
+                data, dtype=meta.np_dtype, count=count, offset=off
+            ).reshape(meta.shape)
+            dest = req.destination_view
+            if dest is not None:
+                if (
+                    tuple(dest.shape) != tuple(meta.shape)
+                    or dest.dtype != meta.np_dtype
+                ):
+                    raise OneSidedMiss("shape")
+                pairs.append((dest, arr))
+                results.append(dest)
+            else:
+                results.append(arr)
+        await landing.land_async(pairs, stage="push", config=self.config)
+        ONE_SIDED_READS.inc(len(results), transport="bulk_push")
+        _PUSH_SERVES.inc()
+        # NO ledger cell here: the wire transfer was recorded at staging
+        # time (stage_push's ingress edge) — this serve is a local memcpy
+        # and recording it again would double-count the edge.
+        return results
 
     async def _get_via_doorbell(
         self, volume, requests: list[Request], entry: dict
@@ -1670,7 +2127,34 @@ class BulkTransportBuffer(TransportBuffer):
                     "offsets": offsets,
                     "total": total,
                 }
+                await self._subscribe_push(volume, cache, remote.doorbell_plan)
         return results
+
+    async def _subscribe_push(
+        self, volume, cache: "BulkClientCache", plan_id: int
+    ) -> None:
+        """Register the persistent push session for a freshly cached plan:
+        one IDX_PUSH_SUB frame on the promoted (HELLO'd) connection, whose
+        demux then stages every IDX_PUSHED frame the volume streams at
+        watermark time. Best-effort — a failed subscription just leaves
+        the plan on the doorbell-ring path."""
+        if not push_sessions_enabled():
+            return
+        conn = cache.get_alive(volume.volume_id)
+        if conn is None:
+            return
+        conn.push_sink = cache.push_sink_for(volume)
+        try:
+            await _send_frame(
+                conn.sock,
+                conn.write_lock,
+                cache.client_id,
+                IDX_PUSH_SUB,
+                memoryview(_U64.pack(plan_id)),
+            )
+            cache.push_subscribed.add(plan_id)
+        except (ConnectionError, OSError):
+            pass
 
     # ---- cleanup ---------------------------------------------------------
 
